@@ -1,0 +1,26 @@
+"""llmd_kv_cache_tpu — a TPU-native KV-cache management framework.
+
+A from-scratch rebuild of the capabilities of llm-d/llm-d-kv-cache for TPU
+fleets (vLLM-TPU / JAX engines), with three pillars:
+
+1. **KV-Cache Indexer** (`core/`, `index/`, `scoring/`) — a library keeping a
+   near-real-time global view of which KV-cache blocks live on which model
+   server, on which device tier (``tpu-hbm`` / ``cpu`` / ``shared-storage``),
+   and scoring candidate pods for a prompt by longest cached prefix.
+2. **KV offload data plane** (`offload/`, `ops/`) — moves paged KV blocks
+   between TPU HBM and shared storage through JAX/XLA host offload (device →
+   pinned-host transfers) and a native C++ I/O thread pool, replacing the
+   reference's CUDA D2H/H2D path (`kv_connectors/llmd_fs_backend/csrc/`).
+3. **Event plane & services** (`events/`, `services/`, `evictor/`) — ZMQ
+   KV-event ingestion with per-pod ordering, a gRPC-over-UDS tokenizer
+   sidecar, and a storage-lifecycle evictor.
+
+The `models/`, `ops/` and `parallel/` packages additionally ship a compact
+TPU-native paged-KV serving engine (JAX/Flax/Pallas) used as the in-tree
+stand-in for vLLM-TPU in end-to-end tests and benchmarks.
+
+Reference layer map: /root/reference — see SURVEY.md §1-2 for the component
+inventory this package mirrors.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+"""Block-key core: content-addressed KV-block hashing and per-block metadata.
+
+Counterpart of the reference's ``pkg/kvcache/kvblock/`` block-key layer.
+"""
+
+from .keys import EMPTY_BLOCK_HASH, KeyType, PodEntry
+from .token_processor import ChunkedTokenDatabase, TokenProcessorConfig
+from .extra_keys import (
+    BlockExtraFeatures,
+    PlaceholderRange,
+    compute_block_extra_features,
+    parse_raw_extra_keys,
+)
+from .hma import GroupCatalog, GroupMetadata
+
+__all__ = [
+    "EMPTY_BLOCK_HASH",
+    "KeyType",
+    "PodEntry",
+    "ChunkedTokenDatabase",
+    "TokenProcessorConfig",
+    "BlockExtraFeatures",
+    "PlaceholderRange",
+    "compute_block_extra_features",
+    "parse_raw_extra_keys",
+    "GroupCatalog",
+    "GroupMetadata",
+]

@@ -1,0 +1,139 @@
+"""Token → block-key hash chain.
+
+Counterpart of reference ``pkg/kvcache/kvblock/token_processor.go``. This is
+the content-addressing scheme the whole indexer rests on; it must stay
+byte-compatible with the engines' own block hashing:
+
+- tokens are chunked into fixed-size blocks (default 16); a trailing
+  partial block is dropped (``token_processor.go:184-197``)
+- each block's key is ``FNV-64a(canonical-CBOR([parent, chunk, extra]))``
+  chained on the previous block's key (``:146-158,160-176``)
+- the chain seed is ``FNV-64a(hash_seed)`` mixed with the model name via
+  one extra hash step ``hash(init, None, model_name)`` (``:114-118,131-134``)
+- ``hash_seed`` must align with the engines' ``PYTHONHASHSEED``-equivalent
+  (``:43-47``)
+- per-block multimodal extras taint the hash: ``extra`` is the block's list
+  of MM identifier entries encoded as ``[{"Hash": h}, ...]`` maps, matching
+  the reference's Go-struct CBOR encoding of ``[]MMHash`` (``:167-173``
+  with ``extra_keys.go:26-28``); text-only blocks hash ``extra = null``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from ..utils.cbor import canonical_cbor_encode
+from ..utils.fnv import fnv1a_64
+from .extra_keys import BlockExtraFeatures
+from .keys import EMPTY_BLOCK_HASH, BlockHash
+
+DEFAULT_BLOCK_SIZE = 16  # vLLM's default tokens-per-block
+
+
+@dataclass
+class TokenProcessorConfig:
+    """Configuration for the token processor.
+
+    ``block_size_tokens``: tokens per canonical block (0 → default 16).
+    ``hash_seed``: seeds the chain like vLLM's NONE_HASH; deployers must
+    align it across engines and indexer.
+    """
+
+    block_size_tokens: int = DEFAULT_BLOCK_SIZE
+    hash_seed: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TokenProcessorConfig":
+        if not d:
+            return cls()
+        block_size = d.get("blockSizeTokens", d.get("block_size_tokens", 0)) or 0
+        if block_size == 0:
+            # deprecated alias accepted for config compatibility
+            block_size = d.get("blockSize", d.get("block_size", 0)) or 0
+        if block_size == 0:
+            block_size = DEFAULT_BLOCK_SIZE
+        return cls(
+            block_size_tokens=block_size,
+            hash_seed=d.get("hashSeed", d.get("hash_seed", "")) or "",
+        )
+
+
+class ChunkedTokenDatabase:
+    """Concrete token processor implementing the chained block-hash scheme."""
+
+    def __init__(self, config: Optional[TokenProcessorConfig] = None):
+        cfg = config or TokenProcessorConfig()
+        block_size = cfg.block_size_tokens or DEFAULT_BLOCK_SIZE
+        if block_size <= 0:
+            raise ValueError(
+                f"block_size_tokens must be greater than 0, got {cfg.block_size_tokens}"
+            )
+        self._block_size = block_size
+        self._hash_seed = cfg.hash_seed
+        self._init_hash = fnv1a_64(self._hash_seed.encode("utf-8"))
+        # Per-model seed cache: the init step hashes the model name into the
+        # chain once; memoize since model cardinality is tiny.
+        self._model_seed_cache: dict[str, int] = {}
+
+    @property
+    def block_size(self) -> int:
+        return self._block_size
+
+    def _hash(self, parent: int, tokens: Optional[Sequence[int]], extra) -> int:
+        payload = [parent, list(tokens) if tokens is not None else None, extra]
+        return fnv1a_64(canonical_cbor_encode(payload))
+
+    def _get_init_hash(self, model_name: str) -> int:
+        cached = self._model_seed_cache.get(model_name)
+        if cached is None:
+            cached = self._hash(self._init_hash, None, model_name)
+            self._model_seed_cache[model_name] = cached
+        return cached
+
+    def _chunk_tokens(self, tokens: Sequence[int]) -> list[Sequence[int]]:
+        bs = self._block_size
+        n_full = len(tokens) // bs
+        return [tokens[i * bs:(i + 1) * bs] for i in range(n_full)]
+
+    def tokens_to_kv_block_keys(
+        self,
+        parent_key: BlockHash,
+        tokens: Sequence[int],
+        model_name: str,
+        extra_features: Optional[Sequence[Optional[BlockExtraFeatures]]] = None,
+    ) -> list[BlockHash]:
+        """Convert tokens into chained block keys.
+
+        ``parent_key`` continues an existing chain (``EMPTY_BLOCK_HASH`` to
+        start fresh from the model-seeded init hash). ``extra_features``, if
+        given, must have exactly one entry per full token chunk.
+        """
+        parent = parent_key if parent_key != EMPTY_BLOCK_HASH else self._get_init_hash(model_name)
+
+        chunks = self._chunk_tokens(tokens)
+        if not chunks:
+            return []
+
+        if extra_features is None:
+            extra_features = [None] * len(chunks)
+        elif len(extra_features) != len(chunks):
+            raise ValueError(
+                f"extra_features length {len(extra_features)} does not match token "
+                f"chunk count {len(chunks)} (block_size_tokens={self._block_size}, "
+                f"tokens={len(tokens)})"
+            )
+
+        keys: list[BlockHash] = []
+        prefix = parent
+        for chunk, features in zip(chunks, extra_features):
+            extra = None
+            if features is not None:
+                extra = [{"Hash": h} for h in features.mm_hashes]
+            prefix = self._hash(prefix, chunk, extra)
+            keys.append(prefix)
+        return keys
+
+
+# Backwards-friendly alias matching the reference interface name.
+TokenProcessor = ChunkedTokenDatabase

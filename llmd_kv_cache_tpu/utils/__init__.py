@@ -1,0 +1,7 @@
+"""Shared utilities: canonical CBOR, FNV hashing, LRU caches, logging."""
+
+from .cbor import canonical_cbor_encode
+from .fnv import fnv1a_32, fnv1a_64
+from .lru import LRUCache
+
+__all__ = ["canonical_cbor_encode", "fnv1a_32", "fnv1a_64", "LRUCache"]

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run on the CPU backend with a virtual 8-device mesh so multi-chip
+sharding logic is exercised without TPU hardware (the driver separately
+dry-run-compiles the multi-chip path; bench.py runs on the real chip).
+Environment must be set before the first ``jax`` import, hence module level.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,79 @@
+"""Canonical CBOR encoder tests against RFC 7049 Appendix A vectors."""
+
+import pytest
+
+from llmd_kv_cache_tpu.utils.cbor import canonical_cbor_encode as enc
+
+
+@pytest.mark.parametrize(
+    "value,expected_hex",
+    [
+        (0, "00"),
+        (1, "01"),
+        (10, "0a"),
+        (23, "17"),
+        (24, "1818"),
+        (25, "1819"),
+        (100, "1864"),
+        (1000, "1903e8"),
+        (1000000, "1a000f4240"),
+        (1000000000000, "1b000000e8d4a51000"),
+        (18446744073709551615, "1bffffffffffffffff"),
+        (-1, "20"),
+        (-10, "29"),
+        (-100, "3863"),
+        (-1000, "3903e7"),
+        (False, "f4"),
+        (True, "f5"),
+        (None, "f6"),
+        ("", "60"),
+        ("a", "6161"),
+        ("IETF", "6449455446"),
+        ("ü", "62c3bc"),
+        ("水", "63e6b0b4"),
+        (b"", "40"),
+        (b"\x01\x02\x03\x04", "4401020304"),
+        ([], "80"),
+        ([1, 2, 3], "83010203"),
+        ([1, [2, 3], [4, 5]], "8301820203820405"),
+        (list(range(1, 26)),
+         "98190102030405060708090a0b0c0d0e0f101112131415161718181819"),
+        ({}, "a0"),
+        ({1: 2, 3: 4}, "a201020304"),
+        ({"a": 1, "b": [2, 3]}, "a26161016162820203"),
+        (["a", {"b": "c"}], "826161a161626163"),
+    ],
+)
+def test_rfc7049_vectors(value, expected_hex):
+    assert enc(value).hex() == expected_hex
+
+
+def test_canonical_map_key_ordering():
+    # Canonical order: shorter encoded key first, then bytewise.
+    # "aa" (0x626161) sorts after "b" (0x6162) despite "aa" < "b" lexically.
+    assert enc({"aa": 1, "b": 2}).hex() == "a261620262616101"
+    # shorter-encoded int key (0x0a) sorts before the string key (0x6161)
+    assert enc({"a": 1, 10: 0}).hex() == "a20a00616101"
+
+
+def test_nested_hash_payload_shape():
+    # The exact payload shape used by the token processor:
+    # [parent uint64, [tokens...], extra]
+    payload = [0xCBF29CE484222325, [1, 2, 3], None]
+    encoded = enc(payload)
+    assert encoded.startswith(b"\x83")  # 3-element array
+    assert encoded.endswith(b"\xf6")  # null extra
+
+    # extra as list of {"Hash": str} maps
+    payload_mm = [5, [1], [{"Hash": "abc"}]]
+    encoded_mm = enc(payload_mm)
+    assert b"\x64Hash" in encoded_mm
+
+
+def test_large_tuple_same_as_list():
+    assert enc((1, 2)) == enc([1, 2])
+
+
+def test_unsupported_type_raises():
+    with pytest.raises(TypeError):
+        enc(object())

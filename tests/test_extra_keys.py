@@ -1,0 +1,101 @@
+"""Extra-keys parsing / recomputation and HMA group catalog tests."""
+
+from llmd_kv_cache_tpu.core import (
+    GroupCatalog,
+    GroupMetadata,
+    PlaceholderRange,
+    compute_block_extra_features,
+    parse_raw_extra_keys,
+)
+
+
+class TestParseRawExtraKeys:
+    def test_none_passthrough(self):
+        assert parse_raw_extra_keys(None) is None
+
+    def test_bare_string_format(self):
+        out = parse_raw_extra_keys([["hash-a", "hash-b"], None])
+        assert out is not None and len(out) == 2
+        assert out[0].mm_hashes == ["hash-a", "hash-b"]
+        assert out[1] is None
+
+    def test_legacy_tuple_format(self):
+        out = parse_raw_extra_keys([[["hash-a", 5]], [["hash-b", 0], "hash-c"]])
+        assert out[0].mm_hashes == ["hash-a"]
+        assert out[1].mm_hashes == ["hash-b", "hash-c"]
+
+    def test_unknown_entries_skipped(self):
+        out = parse_raw_extra_keys([[42, {"lora": 1}], ["h"]])
+        assert out[0] is None  # only unknown types → no features
+        assert out[1].mm_hashes == ["h"]
+
+    def test_empty_inner_is_none(self):
+        out = parse_raw_extra_keys([[]])
+        assert out == [None]
+
+
+class TestComputeBlockExtraFeatures:
+    def test_no_mm_returns_none(self):
+        assert compute_block_extra_features({}, {}, 4, 16) is None
+        assert compute_block_extra_features({"image": ["h"]}, {}, 4, 16) is None
+        assert compute_block_extra_features({"image": ["h"]}, {"image": []}, 0, 16) is None
+
+    def test_single_item_overlap(self):
+        # image placeholder covers tokens [2, 6) → blocks 0 and 1 of size 4
+        out = compute_block_extra_features(
+            {"image": ["img1"]},
+            {"image": [PlaceholderRange(offset=2, length=4)]},
+            block_size=4,
+            num_tokens=16,
+        )
+        assert len(out) == 4
+        assert out[0].mm_hashes == ["img1"]
+        assert out[1].mm_hashes == ["img1"]
+        assert out[2] is None and out[3] is None
+
+    def test_multiple_items_sorted(self):
+        out = compute_block_extra_features(
+            {"image": ["late", "early"]},
+            {"image": [PlaceholderRange(8, 4), PlaceholderRange(0, 4)]},
+            block_size=4,
+            num_tokens=12,
+        )
+        assert out[0].mm_hashes == ["early"]
+        assert out[1] is None
+        assert out[2].mm_hashes == ["late"]
+
+    def test_item_spanning_block_boundary_taints_both(self):
+        out = compute_block_extra_features(
+            {"audio": ["a1"]},
+            {"audio": [PlaceholderRange(3, 2)]},
+            block_size=4,
+            num_tokens=8,
+        )
+        assert out[0].mm_hashes == ["a1"]
+        assert out[1].mm_hashes == ["a1"]
+
+    def test_hashes_truncated_to_ranges(self):
+        # more hashes than placeholder ranges: zip stops at the shorter
+        out = compute_block_extra_features(
+            {"image": ["h1", "h2"]},
+            {"image": [PlaceholderRange(0, 2)]},
+            block_size=4,
+            num_tokens=4,
+        )
+        assert out[0].mm_hashes == ["h1"]
+
+
+class TestGroupCatalog:
+    def test_learn_get(self):
+        cat = GroupCatalog()
+        meta = GroupMetadata(kind="sliding_window", block_size=16, sliding_window_size=1024)
+        cat.learn("pod-a", 1, meta)
+        assert cat.get("pod-a", 1) == meta
+        assert cat.get("pod-a", 2) is None
+        assert cat.get("pod-b", 1) is None
+
+    def test_relearn_overwrites(self):
+        cat = GroupCatalog()
+        cat.learn("p", 0, GroupMetadata("full_attention", 16))
+        cat.learn("p", 0, GroupMetadata("full_attention", 32))
+        assert cat.get("p", 0).block_size == 32

@@ -1,0 +1,155 @@
+"""Token processor hash-chain tests.
+
+Mirrors the reference test strategy (``pkg/kvcache/kvblock/token_processor_test.go``):
+determinism, chain continuation, partial-block dropping, model/seed
+differentiation, extra-feature tainting — plus frozen golden vectors pinning
+the FNV-64a-over-canonical-CBOR scheme so accidental encoding changes break
+loudly.
+"""
+
+import pytest
+
+from llmd_kv_cache_tpu.core import (
+    EMPTY_BLOCK_HASH,
+    BlockExtraFeatures,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+
+
+def make_db(block_size=4, seed=""):
+    return ChunkedTokenDatabase(
+        TokenProcessorConfig(block_size_tokens=block_size, hash_seed=seed)
+    )
+
+
+class TestValidation:
+    def test_default_block_size(self):
+        db = ChunkedTokenDatabase()
+        assert db.block_size == 16
+
+    def test_zero_resolves_to_default(self):
+        db = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=0))
+        assert db.block_size == 16
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError, match="block_size_tokens must be greater than 0"):
+            ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=-1))
+
+    def test_from_dict_aliases(self):
+        cfg = TokenProcessorConfig.from_dict({"blockSizeTokens": 8, "hashSeed": "s"})
+        assert cfg.block_size_tokens == 8 and cfg.hash_seed == "s"
+        cfg = TokenProcessorConfig.from_dict({"blockSize": 32})
+        assert cfg.block_size_tokens == 32
+        assert TokenProcessorConfig.from_dict(None).block_size_tokens == 16
+
+
+class TestChaining:
+    def test_deterministic(self):
+        db = make_db()
+        tokens = list(range(12))
+        a = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m", None)
+        b = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m", None)
+        assert a == b
+        assert len(a) == 3
+
+    def test_partial_tail_dropped(self):
+        db = make_db()
+        assert len(db.tokens_to_kv_block_keys(0, list(range(7)), "m", None)) == 1
+        assert db.tokens_to_kv_block_keys(0, [1, 2, 3], "m", None) == []
+        assert db.tokens_to_kv_block_keys(0, [], "m", None) == []
+
+    def test_chain_continuation(self):
+        """Hashing all blocks at once == hashing incrementally with parent keys."""
+        db = make_db()
+        tokens = list(range(16))
+        full = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens, "m", None)
+        first_two = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, tokens[:8], "m", None)
+        rest = db.tokens_to_kv_block_keys(first_two[-1], tokens[8:], "m", None)
+        assert full == first_two + rest
+
+    def test_model_name_differentiates(self):
+        db = make_db()
+        a = db.tokens_to_kv_block_keys(0, list(range(4)), "model-a", None)
+        b = db.tokens_to_kv_block_keys(0, list(range(4)), "model-b", None)
+        assert a != b
+
+    def test_seed_differentiates(self):
+        a = make_db(seed="1").tokens_to_kv_block_keys(0, list(range(4)), "m", None)
+        b = make_db(seed="2").tokens_to_kv_block_keys(0, list(range(4)), "m", None)
+        assert a != b
+
+    def test_token_values_differentiate(self):
+        db = make_db()
+        a = db.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m", None)
+        b = db.tokens_to_kv_block_keys(0, [1, 2, 3, 5], "m", None)
+        assert a != b
+
+    def test_explicit_parent_skips_model_seed(self):
+        db = make_db()
+        a = db.tokens_to_kv_block_keys(12345, [1, 2, 3, 4], "model-a", None)
+        b = db.tokens_to_kv_block_keys(12345, [1, 2, 3, 4], "model-b", None)
+        assert a == b  # same parent → model name irrelevant
+
+
+class TestExtraFeatures:
+    def test_taint_changes_hash(self):
+        db = make_db()
+        plain = db.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m", None)
+        tainted = db.tokens_to_kv_block_keys(
+            0, [1, 2, 3, 4], "m", [BlockExtraFeatures(mm_hashes=["imghash"])]
+        )
+        assert plain != tainted
+
+    def test_none_entry_equals_text_only(self):
+        db = make_db()
+        plain = db.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m", None)
+        explicit = db.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m", [None])
+        assert plain == explicit
+
+    def test_length_mismatch_raises(self):
+        db = make_db()
+        with pytest.raises(ValueError, match="does not match token chunk count"):
+            db.tokens_to_kv_block_keys(0, list(range(8)), "m", [None])
+
+    def test_different_mm_hashes_differ(self):
+        db = make_db()
+        a = db.tokens_to_kv_block_keys(
+            0, [1, 2, 3, 4], "m", [BlockExtraFeatures(mm_hashes=["h1"])]
+        )
+        b = db.tokens_to_kv_block_keys(
+            0, [1, 2, 3, 4], "m", [BlockExtraFeatures(mm_hashes=["h2"])]
+        )
+        assert a != b
+
+
+class TestGoldenVectors:
+    """Frozen vectors: any change here is a breaking change to cache interop."""
+
+    def test_empty_seed_init(self):
+        # FNV-64a("") is the offset basis.
+        db = make_db(block_size=4, seed="")
+        keys = db.tokens_to_kv_block_keys(EMPTY_BLOCK_HASH, [1, 2, 3, 4], "meta-llama/Llama-3-8B", None)
+        assert keys == [GOLDEN_SINGLE_BLOCK]
+
+    def test_two_block_chain(self):
+        db = make_db(block_size=4, seed="42")
+        keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, [10, 20, 30, 40, 50, 60, 70, 80], "m", None
+        )
+        assert keys == GOLDEN_TWO_BLOCKS
+
+    def test_mm_tainted(self):
+        db = make_db(block_size=4, seed="")
+        keys = db.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, [1, 2, 3, 4], "m",
+            [BlockExtraFeatures(mm_hashes=["abc123"])],
+        )
+        assert keys == [GOLDEN_MM_BLOCK]
+
+
+# Golden values frozen from the initial implementation (FNV-64a over
+# canonical CBOR [parent, tokens, extra], model-seeded chain init).
+GOLDEN_SINGLE_BLOCK = 14278394143299064148
+GOLDEN_TWO_BLOCKS = [12118088016799067563, 7239110961410683472]
+GOLDEN_MM_BLOCK = 14175943945182728553

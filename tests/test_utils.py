@@ -1,0 +1,82 @@
+"""FNV, LRU and humanize utility tests."""
+
+import pytest
+
+from llmd_kv_cache_tpu.utils.fnv import fnv1a_32, fnv1a_64
+from llmd_kv_cache_tpu.utils.humanize import parse_bytes
+from llmd_kv_cache_tpu.utils.lru import LRUCache
+
+
+class TestFNV:
+    def test_fnv1a_64_known_vectors(self):
+        assert fnv1a_64(b"") == 0xCBF29CE484222325
+        assert fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+        assert fnv1a_64(b"foobar") == 0x85944171F73967E8
+
+    def test_fnv1a_32_known_vectors(self):
+        assert fnv1a_32(b"") == 0x811C9DC5
+        assert fnv1a_32(b"a") == 0xE40C292C
+        assert fnv1a_32(b"foobar") == 0xBF9CF968
+
+
+class TestLRU:
+    def test_basic_get_add(self):
+        c = LRUCache(2)
+        c.add("a", 1)
+        c.add("b", 2)
+        assert c.get("a") == 1
+        c.add("c", 3)  # evicts "b" ("a" was promoted by get)
+        assert c.get("b") is None
+        assert c.get("a") == 1
+        assert c.get("c") == 3
+
+    def test_peek_does_not_promote(self):
+        c = LRUCache(2)
+        c.add("a", 1)
+        c.add("b", 2)
+        c.peek("a")
+        c.add("c", 3)  # evicts "a": peek did not promote
+        assert c.get("a") is None
+
+    def test_get_or_add(self):
+        c = LRUCache(4)
+        v, existed = c.get_or_add("k", 1)
+        assert (v, existed) == (1, False)
+        v, existed = c.get_or_add("k", 2)
+        assert (v, existed) == (1, True)
+
+    def test_remove_len_keys(self):
+        c = LRUCache(4)
+        c.add(1, "x")
+        c.add(2, "y")
+        assert len(c) == 2
+        assert c.keys() == [1, 2]
+        assert c.remove(1)
+        assert not c.remove(1)
+        assert len(c) == 1
+
+    def test_zero_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            LRUCache(0)
+
+
+class TestHumanize:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("0", 0),
+            ("1024", 1024),
+            ("1kb", 1000),
+            ("1KiB", 1024),
+            ("2GiB", 2 * 1024**3),
+            ("2 GB", 2 * 1000**3),
+            ("1.5MiB", int(1.5 * 1024**2)),
+            (42, 42),
+        ],
+    )
+    def test_parse(self, s, expected):
+        assert parse_bytes(s) == expected
+
+    def test_bad_unit(self):
+        with pytest.raises(ValueError):
+            parse_bytes("5 parsecs")
