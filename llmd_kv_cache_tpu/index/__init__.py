@@ -1,0 +1,23 @@
+"""KV-block index backends.
+
+Counterpart of the reference's index layer (``pkg/kvcache/kvblock/``):
+a thread-safe store mapping request block keys → pod localities, with a
+dual key space (engine keys vs indexer-computed request keys).
+"""
+
+from .base import Index, IndexConfig, create_index
+from .in_memory import InMemoryIndex, InMemoryIndexConfig
+from .cost_aware import CostAwareMemoryIndex, CostAwareMemoryIndexConfig
+from .instrumented import InstrumentedIndex, TracedIndex
+
+__all__ = [
+    "Index",
+    "IndexConfig",
+    "create_index",
+    "InMemoryIndex",
+    "InMemoryIndexConfig",
+    "CostAwareMemoryIndex",
+    "CostAwareMemoryIndexConfig",
+    "InstrumentedIndex",
+    "TracedIndex",
+]

@@ -1,0 +1,164 @@
+"""Index contract and backend selection.
+
+Counterpart of reference ``pkg/kvcache/kvblock/index.go``. The index is
+LRU-bounded soft state that converges from the KV-event stream; it tracks,
+for each request key (content-addressed block hash), which pods hold the
+block and on which device tier.
+
+Dual key space (``index.go:108-155``): *request keys* are computed by the
+indexer from tokens at the canonical block size; *engine keys* are whatever
+hashes the engine itself emits. ``add`` learns the engine→request mapping
+from the length ratio of the two key lists (both derive from the same token
+count, so they divide evenly): 1:1, many:1 or 1:many.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..core.keys import BlockHash, KeyType, PodEntry
+
+
+class Index(abc.ABC):
+    """Thread-safe KV-block index backend contract."""
+
+    @abc.abstractmethod
+    def lookup(
+        self,
+        request_keys: Sequence[BlockHash],
+        pod_identifier_set: Optional[set[str]] = None,
+    ) -> dict[BlockHash, list[PodEntry]]:
+        """Return pods per request key, filtered to ``pod_identifier_set``.
+
+        An empty/None pod set returns all pods. A key present in the index
+        with an empty pod set terminates the scan early (prefix chain broken
+        at a once-known block); a key simply absent does not.
+        """
+
+    @abc.abstractmethod
+    def add(
+        self,
+        engine_keys: Optional[Sequence[BlockHash]],
+        request_keys: Sequence[BlockHash],
+        entries: Sequence[PodEntry],
+    ) -> None:
+        """Store request-key → pod entries; learn engine→request mappings.
+
+        ``engine_keys=None`` adds speculative entries with no mapping.
+        """
+
+    @abc.abstractmethod
+    def evict(
+        self,
+        key: BlockHash,
+        key_type: KeyType,
+        entries: Sequence[PodEntry],
+    ) -> None:
+        """Remove the given pod entries from a key.
+
+        ``KeyType.ENGINE`` resolves through the engine→request mapping
+        first; ``KeyType.REQUEST`` operates on the key directly.
+        """
+
+    @abc.abstractmethod
+    def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
+        """Resolve an engine key to its last (highest-index) request key.
+
+        Returns ``None`` when the mapping is unknown (e.g. already evicted);
+        reference raises an error (``in_memory.go:355-361``) — callers here
+        treat ``None`` identically.
+        """
+
+    @abc.abstractmethod
+    def clear(self, pod_identifier: str) -> None:
+        """Drop every entry for a pod, across all device tiers.
+
+        Backs the pod-wide AllBlocksCleared KV-event (engine prefix-cache
+        reset, e.g. after a weight rollout). O(N), off the hot path.
+        """
+
+
+def infer_engine_mappings(
+    engine_keys: Sequence[BlockHash], request_keys: Sequence[BlockHash]
+) -> dict[BlockHash, list[BlockHash]]:
+    """Infer engine→request key mappings from the length ratio.
+
+    Mirrors reference ``in_memory.go:164-180``: with ``n = max(len(e),
+    len(r))`` the i-th virtual slot maps ``engine[i*len(e)//n] →
+    request[i*len(r)//n]``, producing 1:1, many:1 or 1:many fan-outs.
+    """
+    mappings: dict[BlockHash, list[BlockHash]] = {}
+    ne, nr = len(engine_keys), len(request_keys)
+    if ne == 0 or nr == 0:
+        return mappings
+    n = max(ne, nr)
+    for i in range(n):
+        ek = engine_keys[i * ne // n]
+        rk = request_keys[i * nr // n]
+        mappings.setdefault(ek, []).append(rk)
+    return mappings
+
+
+@dataclass
+class IndexConfig:
+    """Backend selection config (reference ``index.go:29-57``).
+
+    Priority when several are set: cost-aware > redis > in-memory
+    (the reference also supports Valkey, same wire as Redis).
+    """
+
+    in_memory_config: Optional["InMemoryIndexConfig"] = None  # noqa: F821
+    cost_aware_memory_config: Optional["CostAwareMemoryIndexConfig"] = None  # noqa: F821
+    redis_config: Optional[dict] = None
+    enable_metrics: bool = False
+    # Wrap the backend with OTel spans per operation (child spans under
+    # score_tokens). Off by default: even no-op span managers cost on the
+    # lookup hot path.
+    enable_tracing: bool = False
+    metrics_logging_interval_s: float = 0.0
+
+    @classmethod
+    def default(cls) -> "IndexConfig":
+        from .in_memory import InMemoryIndexConfig
+
+        return cls(in_memory_config=InMemoryIndexConfig())
+
+
+def create_index(cfg: Optional[IndexConfig] = None) -> Index:
+    """Create an index backend per config priority (``index.go:60-106``)."""
+    from .in_memory import InMemoryIndex, InMemoryIndexConfig
+
+    if cfg is None:
+        cfg = IndexConfig.default()
+
+    idx: Index
+    if cfg.cost_aware_memory_config is not None:
+        from .cost_aware import CostAwareMemoryIndex
+
+        idx = CostAwareMemoryIndex(cfg.cost_aware_memory_config)
+    elif cfg.redis_config is not None:
+        from .redis_index import RedisIndex
+
+        idx = RedisIndex(cfg.redis_config)
+    elif cfg.in_memory_config is not None:
+        idx = InMemoryIndex(cfg.in_memory_config)
+    else:
+        idx = InMemoryIndex(InMemoryIndexConfig())
+
+    if cfg.enable_metrics:
+        from .instrumented import InstrumentedIndex
+
+        idx = InstrumentedIndex(idx)
+        if cfg.metrics_logging_interval_s > 0:
+            from ..metrics.collector import start_metrics_logging
+
+            start_metrics_logging(cfg.metrics_logging_interval_s)
+
+    if cfg.enable_tracing:
+        from .instrumented import TracedIndex
+
+        idx = TracedIndex(idx)
+
+    return idx

@@ -1,0 +1,101 @@
+"""Metrics and tracing decorators for Index backends.
+
+Counterparts of reference ``instrumented_index.go`` / ``traced_index.go``:
+wrap any Index with Prometheus counters on lookups/admissions/evictions and
+OTel spans around each operation. Wrapping is cheap and no-ops when tracing
+is unconfigured.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from ..core.keys import BlockHash, KeyType, PodEntry
+from ..metrics.collector import (
+    INDEX_ADMISSIONS,
+    INDEX_EVICTIONS,
+    INDEX_LOOKUP_HITS,
+    INDEX_LOOKUP_LATENCY,
+    INDEX_LOOKUP_REQUESTS,
+    INDEX_MAX_POD_HIT_COUNT,
+)
+from ..telemetry import tracer
+from .base import Index
+
+
+class InstrumentedIndex(Index):
+    """Prometheus-instrumented Index decorator."""
+
+    def __init__(self, inner: Index):
+        self._inner = inner
+
+    def lookup(self, request_keys, pod_identifier_set=None):
+        INDEX_LOOKUP_REQUESTS.inc()
+        start = time.perf_counter()
+        try:
+            result = self._inner.lookup(request_keys, pod_identifier_set)
+        finally:
+            INDEX_LOOKUP_LATENCY.observe(time.perf_counter() - start)
+        INDEX_LOOKUP_HITS.inc(len(result))
+        if result:
+            pod_hits: dict[str, int] = {}
+            for entries in result.values():
+                for e in entries:
+                    pod_hits[e.pod_identifier] = pod_hits.get(e.pod_identifier, 0) + 1
+            INDEX_MAX_POD_HIT_COUNT.inc(max(pod_hits.values()))
+        return result
+
+    def add(self, engine_keys, request_keys, entries):
+        self._inner.add(engine_keys, request_keys, entries)
+        INDEX_ADMISSIONS.inc(len(request_keys))
+
+    def evict(self, key, key_type, entries):
+        self._inner.evict(key, key_type, entries)
+        INDEX_EVICTIONS.inc()
+
+    def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
+        return self._inner.get_request_key(engine_key)
+
+    def clear(self, pod_identifier: str) -> None:
+        self._inner.clear(pod_identifier)
+
+
+class TracedIndex(Index):
+    """OTel-span Index decorator (no-op without a provider)."""
+
+    def __init__(self, inner: Index):
+        self._inner = inner
+        self._tracer = tracer()
+
+    def lookup(
+        self,
+        request_keys: Sequence[BlockHash],
+        pod_identifier_set=None,
+    ):
+        with self._tracer.span(
+            "llm_d.kv_cache.index.lookup", key_count=len(request_keys)
+        ) as span:
+            result = self._inner.lookup(request_keys, pod_identifier_set)
+            span.set_attribute("hit_count", len(result))
+            return result
+
+    def add(self, engine_keys, request_keys, entries):
+        with self._tracer.span(
+            "llm_d.kv_cache.index.add",
+            engine_key_count=len(engine_keys) if engine_keys else 0,
+            request_key_count=len(request_keys),
+            entry_count=len(entries),
+        ):
+            self._inner.add(engine_keys, request_keys, entries)
+
+    def evict(self, key: BlockHash, key_type: KeyType, entries: Sequence[PodEntry]):
+        with self._tracer.span("llm_d.kv_cache.index.evict", key_type=key_type.value):
+            self._inner.evict(key, key_type, entries)
+
+    def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
+        return self._inner.get_request_key(engine_key)
+
+    def clear(self, pod_identifier: str) -> None:
+        with self._tracer.span("llm_d.kv_cache.index.clear", pod=pod_identifier):
+            self._inner.clear(pod_identifier)

@@ -1,0 +1,21 @@
+"""Prometheus metrics (counterpart of ``pkg/kvcache/metrics/``)."""
+
+from .collector import (
+    INDEX_ADMISSIONS,
+    INDEX_EVICTIONS,
+    INDEX_LOOKUP_HITS,
+    INDEX_LOOKUP_LATENCY,
+    INDEX_LOOKUP_REQUESTS,
+    INDEX_MAX_POD_HIT_COUNT,
+    start_metrics_logging,
+)
+
+__all__ = [
+    "INDEX_ADMISSIONS",
+    "INDEX_EVICTIONS",
+    "INDEX_LOOKUP_HITS",
+    "INDEX_LOOKUP_LATENCY",
+    "INDEX_LOOKUP_REQUESTS",
+    "INDEX_MAX_POD_HIT_COUNT",
+    "start_metrics_logging",
+]

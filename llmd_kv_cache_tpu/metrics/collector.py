@@ -1,0 +1,75 @@
+"""Prometheus collectors for the KV-block index.
+
+Counterpart of reference ``pkg/kvcache/metrics/collector.go:29-93``: the same
+metric families (``kvcache_index_admissions_total`` etc.) on the default
+prometheus_client registry, plus an optional periodic "metrics beat" log line
+(``collector.go:97-165``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from prometheus_client import Counter, Gauge, Histogram
+
+from ..utils.logging import get_logger
+
+logger = get_logger("metrics")
+
+_NS = "kvcache_index"
+
+INDEX_ADMISSIONS = Counter(f"{_NS}_admissions_total", "Block keys admitted to the index")
+INDEX_EVICTIONS = Counter(f"{_NS}_evictions_total", "Block keys evicted from the index")
+INDEX_LOOKUP_REQUESTS = Counter(f"{_NS}_lookup_requests_total", "Index lookups served")
+INDEX_LOOKUP_HITS = Counter(f"{_NS}_lookup_hits_total", "Block keys found during lookups")
+# Accumulates the best per-pod hit count of each lookup, matching the
+# reference's counter semantics (collector.go:43-44). Hits are counted at
+# any position, not only the consecutive prefix.
+INDEX_MAX_POD_HIT_COUNT = Counter(
+    f"{_NS}_max_pod_hit_count",
+    "Sum over lookups of the highest per-pod block hit count (any position)",
+)
+INDEX_LOOKUP_LATENCY = Histogram(
+    f"{_NS}_lookup_latency_seconds",
+    "Index lookup latency",
+    buckets=(1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0),
+)
+
+TOKENIZATION_LATENCY = Histogram(
+    "kvcache_tokenization_latency_seconds",
+    "Tokenization / render latency",
+    buckets=(1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0),
+)
+
+_beat_thread: Optional[threading.Thread] = None
+_beat_stop = threading.Event()
+
+
+def start_metrics_logging(interval_s: float) -> None:
+    """Log a periodic one-line metrics beat. Idempotent, daemon thread."""
+    global _beat_thread
+    if _beat_thread is not None and _beat_thread.is_alive():
+        if not _beat_stop.is_set():
+            return
+        # A stop was requested but the old thread hasn't exited yet; wait it
+        # out so the restart below actually takes effect.
+        _beat_thread.join()
+    _beat_stop.clear()
+
+    def _beat() -> None:
+        while not _beat_stop.wait(interval_s):
+            logger.info(
+                "metrics beat: admissions=%d evictions=%d lookups=%d hits=%d",
+                INDEX_ADMISSIONS._value.get(),
+                INDEX_EVICTIONS._value.get(),
+                INDEX_LOOKUP_REQUESTS._value.get(),
+                INDEX_LOOKUP_HITS._value.get(),
+            )
+
+    _beat_thread = threading.Thread(target=_beat, name="kvtpu-metrics-beat", daemon=True)
+    _beat_thread.start()
+
+
+def stop_metrics_logging() -> None:
+    _beat_stop.set()

@@ -1,0 +1,20 @@
+"""Pod scoring and the indexer orchestrator."""
+
+from .scorer import (
+    KVCacheBackendConfig,
+    KVBlockScorerConfig,
+    LongestPrefixScorer,
+    create_scorer,
+    default_backend_configs,
+)
+from .indexer import Indexer, IndexerConfig
+
+__all__ = [
+    "KVCacheBackendConfig",
+    "KVBlockScorerConfig",
+    "LongestPrefixScorer",
+    "create_scorer",
+    "default_backend_configs",
+    "Indexer",
+    "IndexerConfig",
+]

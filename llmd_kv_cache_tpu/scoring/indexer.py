@@ -1,0 +1,123 @@
+"""Indexer orchestrator: tokens → block keys → index lookup → pod scores.
+
+Counterpart of reference ``pkg/kvcache/indexer.go``. This is the scheduler
+hot path (``ScoreTokens``, ``indexer.go:238-303``): embedded in an endpoint
+picker, it answers "which pods hold the longest cached prefix for these
+tokens, and how much of it" in a single in-process call.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..core.extra_keys import BlockExtraFeatures
+from ..core.keys import BlockHash, PodEntry
+from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
+from ..index.base import Index, IndexConfig, create_index
+from ..telemetry import tracer
+from ..utils.logging import get_logger
+from .scorer import KVBlockScorerConfig, LongestPrefixScorer, create_scorer
+
+logger = get_logger("indexer")
+
+
+@dataclass
+class IndexerConfig:
+    """Top-level config (reference ``indexer.go:39-61``): nested configs with
+    nil-tolerance — every field defaults sensibly when omitted."""
+
+    token_processor_config: TokenProcessorConfig = field(default_factory=TokenProcessorConfig)
+    index_config: Optional[IndexConfig] = None
+    scorer_config: KVBlockScorerConfig = field(default_factory=KVBlockScorerConfig)
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
+        if not d:
+            return cls()
+        cfg = cls(
+            token_processor_config=TokenProcessorConfig.from_dict(
+                d.get("tokenProcessorConfig", d.get("token_processor_config"))
+            ),
+            scorer_config=KVBlockScorerConfig.from_dict(
+                d.get("kvBlockScorerConfig", d.get("scorer_config"))
+            ),
+        )
+        index_dict = d.get("kvBlockIndexConfig", d.get("index_config"))
+        if index_dict:
+            from ..index.cost_aware import CostAwareMemoryIndexConfig
+            from ..index.in_memory import InMemoryIndexConfig
+
+            cfg.index_config = IndexConfig(
+                in_memory_config=InMemoryIndexConfig.from_dict(index_dict.get("inMemoryConfig"))
+                if index_dict.get("inMemoryConfig") is not None
+                else None,
+                cost_aware_memory_config=CostAwareMemoryIndexConfig.from_dict(
+                    index_dict.get("costAwareMemoryConfig")
+                )
+                if index_dict.get("costAwareMemoryConfig") is not None
+                else None,
+                redis_config=index_dict.get("redisConfig"),
+                enable_metrics=index_dict.get("enableMetrics", False),
+                metrics_logging_interval_s=index_dict.get("metricsLoggingInterval", 0.0),
+            )
+        return cfg
+
+
+class Indexer:
+    """KV-cache indexer: the library's main entry point."""
+
+    def __init__(
+        self,
+        config: Optional[IndexerConfig] = None,
+        index: Optional[Index] = None,
+    ):
+        self.config = config or IndexerConfig()
+        self.token_processor = ChunkedTokenDatabase(self.config.token_processor_config)
+        self.kv_block_index: Index = (
+            index if index is not None else create_index(self.config.index_config)
+        )
+        self.scorer: LongestPrefixScorer = create_scorer(self.config.scorer_config)
+        self._tracer = tracer()
+
+    def compute_block_keys(
+        self,
+        tokens: Sequence[int],
+        model_name: str,
+        extra_features: Optional[Sequence[Optional[BlockExtraFeatures]]] = None,
+    ) -> list[BlockHash]:
+        """Content-address tokens at the canonical block size
+        (reference ``indexer.go:178-195``)."""
+        return self.token_processor.tokens_to_kv_block_keys(
+            0, tokens, model_name, extra_features
+        )
+
+    def score_tokens(
+        self,
+        tokens: Sequence[int],
+        model_name: str,
+        pod_identifiers: Optional[set[str]] = None,
+        extra_features: Optional[Sequence[Optional[BlockExtraFeatures]]] = None,
+    ) -> dict[str, float]:
+        """Score candidate pods for the given tokens
+        (reference ``indexer.go:238-303``).
+
+        Returns pod → tier-weighted consecutive-prefix score. Pods in
+        ``pod_identifiers`` that hold nothing simply do not appear.
+        """
+        with self._tracer.span(
+            "llm_d.kv_cache.score_tokens",
+            model=model_name,
+            token_count=len(tokens),
+            pod_count=len(pod_identifiers) if pod_identifiers else 0,
+        ) as span:
+            block_keys = self.compute_block_keys(tokens, model_name, extra_features)
+            span.set_attribute("block_count", len(block_keys))
+            if not block_keys:
+                return {}
+
+            key_to_pods = self.kv_block_index.lookup(block_keys, pod_identifiers)
+            span.set_attribute("block_hit_count", len(key_to_pods))
+            span.set_attribute("block_hit_ratio", len(key_to_pods) / len(block_keys))
+
+            return self.scorer.score(block_keys, key_to_pods)

@@ -1,0 +1,5 @@
+"""Tracing (counterpart of ``pkg/telemetry/``)."""
+
+from .tracing import init_tracing, tracer
+
+__all__ = ["init_tracing", "tracer"]

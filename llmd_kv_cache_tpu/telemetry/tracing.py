@@ -1,0 +1,94 @@
+"""OpenTelemetry tracing with a no-op fallback.
+
+Counterpart of reference ``pkg/telemetry/tracing.go``: spans are attached
+unconditionally throughout the read/write paths via decorator wrappers and
+no-op when no provider is configured (``indexer.go:90-103``). ``init_tracing``
+configures an OTLP exporter from the standard ``OTEL_*`` env vars when the
+optional exporter packages are importable; in library mode the host process's
+global provider is used untouched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+try:
+    from opentelemetry import trace as _otel_trace
+except Exception:  # pragma: no cover - otel always present in this image
+    _otel_trace = None
+
+_SERVICE_NAME = "llmd-kv-cache-tpu"
+
+
+class _NoopSpan:
+    def set_attribute(self, *_args, **_kwargs) -> None:
+        pass
+
+    def record_exception(self, *_args, **_kwargs) -> None:
+        pass
+
+
+class _Tracer:
+    """Thin facade: OTel tracer when available, no-op otherwise."""
+
+    def __init__(self) -> None:
+        self._otel_tracer = None
+        if _otel_trace is not None:
+            self._otel_tracer = _otel_trace.get_tracer(_SERVICE_NAME)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes) -> Iterator[object]:
+        if self._otel_tracer is None:
+            yield _NoopSpan()
+            return
+        with self._otel_tracer.start_as_current_span(name) as sp:
+            for k, v in attributes.items():
+                sp.set_attribute(k, v)
+            yield sp
+
+
+_tracer: Optional[_Tracer] = None
+
+
+def tracer() -> _Tracer:
+    global _tracer
+    if _tracer is None:
+        _tracer = _Tracer()
+    return _tracer
+
+
+def init_tracing(service_name: Optional[str] = None) -> bool:
+    """Standalone-mode init from OTEL_* env (reference tracing.go:72-141).
+
+    Returns True when an OTLP exporter was installed; False when running in
+    library mode (host provider reused) or exporters are unavailable.
+    """
+    global _tracer
+    if _otel_trace is None:
+        return False
+    exporter_kind = os.environ.get("OTEL_TRACES_EXPORTER", "otlp")
+    if exporter_kind in ("none", ""):
+        return False
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import OTLPSpanExporter
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        from opentelemetry.sdk.trace.sampling import ParentBasedTraceIdRatio
+    except Exception:
+        return False
+
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", "http://localhost:4317")
+    ratio = float(os.environ.get("OTEL_TRACES_SAMPLER_ARG", "0.1"))
+    provider = TracerProvider(
+        resource=Resource.create(
+            {"service.name": os.environ.get("OTEL_SERVICE_NAME", service_name or _SERVICE_NAME)}
+        ),
+        sampler=ParentBasedTraceIdRatio(ratio),
+    )
+    provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint)))
+    _otel_trace.set_tracer_provider(provider)
+    _tracer = None  # rebuild against the new provider
+    return True

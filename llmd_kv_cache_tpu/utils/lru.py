@@ -73,6 +73,23 @@ class LRUCache(Generic[K, V]):
                 self._data.popitem(last=False)
             return value, False
 
+    def get_or_create(self, key: K, factory) -> tuple[V, bool]:
+        """Like ``get_or_add`` but constructs the value lazily on miss.
+
+        Avoids allocating a throwaway value on the hot path where the key
+        usually exists. Returns ``(stored_value, existed)``.
+        """
+        with self._lock:
+            existing = self._data.get(key, _SENTINEL)
+            if existing is not _SENTINEL:
+                self._data.move_to_end(key)
+                return existing, True  # type: ignore[return-value]
+            value = factory()
+            self._data[key] = value
+            if len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+            return value, False
+
     def remove(self, key: K) -> bool:
         with self._lock:
             if key in self._data:

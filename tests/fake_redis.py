@@ -1,0 +1,110 @@
+"""Minimal in-process fake Redis client for index tests.
+
+Plays the role miniredis plays in the reference test suite
+(``redis_test.go:22-31``): implements exactly the commands RedisIndex uses.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+
+
+class FakePipeline:
+    def __init__(self, client: "FakeRedis"):
+        self._client = client
+        self._ops: list[tuple] = []
+
+    def hkeys(self, key):
+        self._ops.append(("hkeys", key))
+        return self
+
+    def hset(self, key, field, value):
+        self._ops.append(("hset", key, field, value))
+        return self
+
+    def hdel(self, key, *fields):
+        self._ops.append(("hdel", key, fields))
+        return self
+
+    def zadd(self, key, mapping):
+        self._ops.append(("zadd", key, mapping))
+        return self
+
+    def execute(self):
+        results = []
+        for op in self._ops:
+            name, *args = op
+            if name == "hdel":
+                results.append(self._client.hdel(args[0], *args[1]))
+            else:
+                results.append(getattr(self._client, name)(*args))
+        self._ops = []
+        return results
+
+
+class FakeRedis:
+    def __init__(self):
+        self._hashes: dict[str, dict[str, str]] = {}
+        self._zsets: dict[str, dict[str, float]] = {}
+        self._lock = threading.RLock()
+
+    def pipeline(self):
+        return FakePipeline(self)
+
+    def hkeys(self, key):
+        with self._lock:
+            return [f.encode() for f in self._hashes.get(key, {})]
+
+    def hset(self, key, field, value):
+        with self._lock:
+            self._hashes.setdefault(key, {})[field] = value
+            return 1
+
+    def hdel(self, key, *fields):
+        with self._lock:
+            h = self._hashes.get(key)
+            if h is None:
+                return 0
+            removed = 0
+            for f in fields:
+                if isinstance(f, bytes):
+                    f = f.decode()
+                if f in h:
+                    del h[f]
+                    removed += 1
+            return removed
+
+    def hlen(self, key):
+        with self._lock:
+            return len(self._hashes.get(key, {}))
+
+    def delete(self, *keys):
+        with self._lock:
+            n = 0
+            for key in keys:
+                if self._hashes.pop(key, None) is not None:
+                    n += 1
+                if self._zsets.pop(key, None) is not None:
+                    n += 1
+            return n
+
+    def zadd(self, key, mapping):
+        with self._lock:
+            self._zsets.setdefault(key, {}).update(mapping)
+            return len(mapping)
+
+    def zrange(self, key, start, end):
+        with self._lock:
+            members = sorted(self._zsets.get(key, {}).items(), key=lambda kv: (kv[1], kv[0]))
+            names = [m.encode() for m, _ in members]
+            if end == -1:
+                return names[start:]
+            return names[start:end + 1]
+
+    def scan(self, cursor=0, match=None, count=None):
+        with self._lock:
+            keys = [k.encode() for k in list(self._hashes) + list(self._zsets)]
+            if match:
+                keys = [k for k in keys if fnmatch.fnmatch(k.decode(), match)]
+            return 0, keys

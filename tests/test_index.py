@@ -1,0 +1,247 @@
+"""Index contract test suite, run over every backend.
+
+Mirrors the reference's shared-suite approach (``index_test.go`` runs the
+same scenarios over in-memory and cost-aware; Redis is tested against
+miniredis — here a FakeRedis).
+"""
+
+import threading
+
+import pytest
+
+from llmd_kv_cache_tpu.core import KeyType, PodEntry
+from llmd_kv_cache_tpu.index import (
+    CostAwareMemoryIndex,
+    CostAwareMemoryIndexConfig,
+    InMemoryIndex,
+    InMemoryIndexConfig,
+    InstrumentedIndex,
+    IndexConfig,
+    create_index,
+)
+from llmd_kv_cache_tpu.index.base import infer_engine_mappings
+from llmd_kv_cache_tpu.index.instrumented import TracedIndex
+from llmd_kv_cache_tpu.index.redis_index import RedisIndex, RedisIndexConfig
+
+from fake_redis import FakeRedis
+
+
+def pod(name, tier="tpu-hbm", **kw):
+    return PodEntry(pod_identifier=name, device_tier=tier, **kw)
+
+
+@pytest.fixture(
+    params=["in_memory", "cost_aware", "redis", "instrumented", "traced"]
+)
+def index(request):
+    if request.param == "in_memory":
+        return InMemoryIndex(InMemoryIndexConfig(size=10_000, pod_cache_size=4))
+    if request.param == "cost_aware":
+        return CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost="64MiB"))
+    if request.param == "redis":
+        return RedisIndex(RedisIndexConfig(), client=FakeRedis())
+    if request.param == "instrumented":
+        return InstrumentedIndex(InMemoryIndex(InMemoryIndexConfig(size=1000)))
+    return TracedIndex(InMemoryIndex(InMemoryIndexConfig(size=1000)))
+
+
+class TestIndexContract:
+    def test_add_lookup_roundtrip(self, index):
+        index.add([11, 22], [11, 22], [pod("pod-a")])
+        result = index.lookup([11, 22])
+        assert set(result) == {11, 22}
+        assert result[11] == [pod("pod-a")]
+
+    def test_lookup_empty_keys_raises(self, index):
+        with pytest.raises(ValueError):
+            index.lookup([])
+
+    def test_add_empty_raises(self, index):
+        with pytest.raises(ValueError):
+            index.add(None, [], [pod("a")])
+        with pytest.raises(ValueError):
+            index.add(None, [1], [])
+
+    def test_lookup_filters_by_pod_set(self, index):
+        index.add([1], [1], [pod("pod-a"), pod("pod-b")])
+        result = index.lookup([1], {"pod-b"})
+        assert [e.pod_identifier for e in result[1]] == ["pod-b"]
+
+    def test_lookup_empty_pod_set_returns_all(self, index):
+        index.add([1], [1], [pod("pod-a"), pod("pod-b")])
+        result = index.lookup([1], set())
+        assert len(result[1]) == 2
+
+    def test_missing_key_does_not_break_scan(self, index):
+        index.add([1], [1], [pod("a")])
+        index.add([3], [3], [pod("a")])
+        result = index.lookup([1, 2, 3])
+        if isinstance(index, RedisIndex):
+            # Redis cannot tell "absent" from "known but empty": any gap
+            # early-stops the chain (same divergence as the reference's
+            # Redis backend, redis.go:216,231-232).
+            assert set(result) == {1}
+        else:
+            assert set(result) == {1, 3}
+
+    def test_engine_key_mapping_1to1(self, index):
+        index.add([101, 102], [201, 202], [pod("a")])
+        assert index.get_request_key(101) == 201
+        assert index.get_request_key(102) == 202
+
+    def test_engine_key_mapping_many_to_1(self, index):
+        # 4 engine keys, 2 request keys: E0,E1→R0; E2,E3→R1
+        index.add([1, 2, 3, 4], [10, 20], [pod("a")])
+        assert index.get_request_key(1) == 10
+        assert index.get_request_key(2) == 10
+        assert index.get_request_key(3) == 20
+        assert index.get_request_key(4) == 20
+
+    def test_engine_key_mapping_1_to_many(self, index):
+        # 1 engine key, 4 request keys: E0→[R0..R3]; resolution returns last
+        index.add([1], [10, 20, 30, 40], [pod("a")])
+        assert index.get_request_key(1) == 40
+
+    def test_get_request_key_unknown(self, index):
+        assert index.get_request_key(999) is None
+
+    def test_speculative_add_without_engine_keys(self, index):
+        index.add(None, [5], [pod("a", speculative=True)])
+        result = index.lookup([5])
+        assert result[5][0].speculative
+        assert index.get_request_key(5) is None
+
+    def test_evict_engine_key(self, index):
+        index.add([1], [10], [pod("a")])
+        index.evict(1, KeyType.ENGINE, [pod("a")])
+        assert index.lookup([10]) == {}
+        # mapping pruned once all request keys empty
+        assert index.get_request_key(1) is None
+
+    def test_evict_request_key(self, index):
+        index.add(None, [10], [pod("a")])
+        index.evict(10, KeyType.REQUEST, [pod("a")])
+        assert index.lookup([10]) == {}
+
+    def test_evict_unknown_engine_key_noop(self, index):
+        index.evict(12345, KeyType.ENGINE, [pod("a")])
+
+    def test_evict_empty_entries_raises(self, index):
+        with pytest.raises(ValueError):
+            index.evict(1, KeyType.ENGINE, [])
+
+    def test_evict_keeps_other_pods(self, index):
+        index.add([1], [10], [pod("a"), pod("b")])
+        index.evict(1, KeyType.ENGINE, [pod("a")])
+        result = index.lookup([10])
+        assert [e.pod_identifier for e in result[10]] == ["b"]
+        # mapping retained: request key still has pods
+        assert index.get_request_key(1) == 10
+
+    def test_clear_pod(self, index):
+        index.add([1, 2], [1, 2], [pod("a"), pod("b")])
+        index.add([3], [3], [pod("a")])
+        index.clear("a")
+        result = index.lookup([1, 2])
+        for key in (1, 2):
+            assert [e.pod_identifier for e in result[key]] == ["b"]
+        assert index.lookup([3]) == {}
+
+    def test_clear_matches_all_tiers(self, index):
+        index.add([1], [1], [pod("a", tier="tpu-hbm"), pod("a", tier="cpu"), pod("b")])
+        index.clear("a")
+        result = index.lookup([1])
+        assert [e.pod_identifier for e in result[1]] == ["b"]
+
+    def test_tier_entries_are_distinct(self, index):
+        index.add([1], [1], [pod("a", tier="tpu-hbm")])
+        index.add(None, [1], [pod("a", tier="cpu")])
+        result = index.lookup([1])
+        tiers = {e.device_tier for e in result[1]}
+        assert tiers == {"tpu-hbm", "cpu"}
+
+    def test_concurrent_add_evict(self, index):
+        """Event-storm smoke test: concurrent adders and evictors."""
+        errors = []
+
+        def adder(pod_name):
+            try:
+                for i in range(200):
+                    index.add([i], [i], [pod(pod_name)])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def evictor():
+            try:
+                for i in range(200):
+                    index.evict(i, KeyType.ENGINE, [pod("pod-0")])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=adder, args=(f"pod-{n}",)) for n in range(3)]
+        threads.append(threading.Thread(target=evictor))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestInMemorySpecifics:
+    def test_pod_cache_lru_bound(self):
+        idx = InMemoryIndex(InMemoryIndexConfig(size=100, pod_cache_size=2))
+        idx.add([1], [1], [pod("a"), pod("b"), pod("c")])
+        result = idx.lookup([1])
+        assert len(result[1]) == 2  # oldest (a) evicted
+
+    def test_empty_key_breaks_chain(self):
+        idx = InMemoryIndex(InMemoryIndexConfig(size=100))
+        idx.add([1, 2, 3], [1, 2, 3], [pod("a")])
+        idx.evict(2, KeyType.ENGINE, [pod("a")])
+        # key 2 removed entirely → absent, does not break; lookup returns 1,3
+        result = idx.lookup([1, 2, 3])
+        assert set(result) == {1, 3}
+
+
+class TestCostAwareSpecifics:
+    def test_budget_eviction(self):
+        idx = CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost=2000))
+        for i in range(20):
+            idx.add([i], [i], [pod(f"pod-{i}")])
+        assert idx.total_cost <= 2000
+        assert len(idx) < 20  # some keys evicted
+
+    def test_cost_returns_to_zero(self):
+        idx = CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost="1MiB"))
+        idx.add([1], [1], [pod("a")])
+        idx.evict(1, KeyType.ENGINE, [pod("a")])
+        assert idx.total_cost == 0
+
+    def test_bad_budget_rejected(self):
+        with pytest.raises(ValueError):
+            CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost=0))
+
+
+class TestMappingInference:
+    def test_ratios(self):
+        assert infer_engine_mappings([1, 2], [10, 20]) == {1: [10], 2: [20]}
+        assert infer_engine_mappings([1, 2, 3, 4], [10]) == {1: [10], 2: [10], 3: [10], 4: [10]}
+        assert infer_engine_mappings([1], [10, 20]) == {1: [10, 20]}
+        assert infer_engine_mappings([1, 2], [10, 20, 30, 40]) == {1: [10, 20], 2: [30, 40]}
+
+
+class TestFactory:
+    def test_default_is_in_memory(self):
+        idx = create_index(None)
+        assert isinstance(idx, InMemoryIndex)
+
+    def test_cost_aware_priority(self):
+        cfg = IndexConfig(
+            in_memory_config=InMemoryIndexConfig(),
+            cost_aware_memory_config=CostAwareMemoryIndexConfig(),
+        )
+        assert isinstance(create_index(cfg), CostAwareMemoryIndex)
+
+    def test_metrics_wrapping(self):
+        cfg = IndexConfig(in_memory_config=InMemoryIndexConfig(), enable_metrics=True)
+        assert isinstance(create_index(cfg), InstrumentedIndex)

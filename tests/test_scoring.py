@@ -1,0 +1,121 @@
+"""Scorer and Indexer orchestrator tests."""
+
+from llmd_kv_cache_tpu.core import PodEntry
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.scoring import (
+    Indexer,
+    IndexerConfig,
+    KVBlockScorerConfig,
+    KVCacheBackendConfig,
+    LongestPrefixScorer,
+    create_scorer,
+)
+from llmd_kv_cache_tpu.core.token_processor import TokenProcessorConfig
+
+
+def pod(name, tier="tpu-hbm"):
+    return PodEntry(pod_identifier=name, device_tier=tier)
+
+
+class TestLongestPrefixScorer:
+    def test_empty_keys(self):
+        assert LongestPrefixScorer().score([], {}) == {}
+
+    def test_simple_prefix(self):
+        s = LongestPrefixScorer()
+        key_to_pods = {1: [pod("a")], 2: [pod("a")], 3: [pod("a")]}
+        assert s.score([1, 2, 3], key_to_pods) == {"a": 3.0}
+
+    def test_prefix_break_stops_scoring(self):
+        s = LongestPrefixScorer()
+        # pod a holds blocks 1 and 3 but not 2 → only block 1 counts
+        key_to_pods = {1: [pod("a")], 3: [pod("a")]}
+        assert s.score([1, 2, 3], key_to_pods) == {"a": 1.0}
+
+    def test_pod_absent_from_first_key_never_scores(self):
+        s = LongestPrefixScorer()
+        key_to_pods = {2: [pod("b")]}
+        assert s.score([1, 2], key_to_pods) == {}
+
+    def test_tier_weighting(self):
+        s = LongestPrefixScorer({"tpu-hbm": 1.0, "cpu": 0.8})
+        key_to_pods = {
+            1: [pod("a"), pod("b", tier="cpu")],
+            2: [pod("a", tier="cpu"), pod("b", tier="cpu")],
+        }
+        scores = s.score([1, 2], key_to_pods)
+        assert scores["a"] == 1.0 + 0.8
+        assert abs(scores["b"] - 1.6) < 1e-9
+
+    def test_max_weight_across_tiers(self):
+        s = LongestPrefixScorer({"tpu-hbm": 1.0, "cpu": 0.8})
+        # pod holds the same block on both tiers → max weight wins
+        key_to_pods = {1: [pod("a", tier="cpu"), pod("a", tier="tpu-hbm")]}
+        assert s.score([1], key_to_pods) == {"a": 1.0}
+
+    def test_unknown_tier_defaults_to_one(self):
+        s = LongestPrefixScorer({"tpu-hbm": 1.0})
+        assert s.score([1], {1: [pod("a", tier="weird")]}) == {"a": 1.0}
+
+    def test_create_scorer_rejects_unknown_strategy(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            create_scorer(KVBlockScorerConfig(scoring_strategy="Nope"))
+
+    def test_custom_backend_weights(self):
+        s = create_scorer(
+            KVBlockScorerConfig(
+                backend_configs=[KVCacheBackendConfig(name="tpu-hbm", weight=3.0)]
+            )
+        )
+        assert s.score([1], {1: [pod("a")]}) == {"a": 3.0}
+
+
+class TestIndexer:
+    def make_indexer(self, block_size=4):
+        cfg = IndexerConfig(
+            token_processor_config=TokenProcessorConfig(block_size_tokens=block_size)
+        )
+        return Indexer(cfg, index=InMemoryIndex(InMemoryIndexConfig(size=1000)))
+
+    def test_score_tokens_end_to_end(self):
+        indexer = self.make_indexer()
+        tokens = list(range(16))
+        keys = indexer.compute_block_keys(tokens, "m")
+        assert len(keys) == 4
+        # pod-a holds the full chain; pod-b only the first two blocks
+        indexer.kv_block_index.add(keys, keys, [pod("a")])
+        indexer.kv_block_index.add(keys[:2], keys[:2], [pod("b")])
+        scores = indexer.score_tokens(tokens, "m")
+        assert scores == {"a": 4.0, "b": 2.0}
+
+    def test_score_tokens_pod_filter(self):
+        indexer = self.make_indexer()
+        tokens = list(range(8))
+        keys = indexer.compute_block_keys(tokens, "m")
+        indexer.kv_block_index.add(keys, keys, [pod("a"), pod("b")])
+        scores = indexer.score_tokens(tokens, "m", pod_identifiers={"b"})
+        assert scores == {"b": 2.0}
+
+    def test_score_tokens_no_full_block(self):
+        indexer = self.make_indexer()
+        assert indexer.score_tokens([1, 2], "m") == {}
+
+    def test_score_tokens_cold_index(self):
+        indexer = self.make_indexer()
+        assert indexer.score_tokens(list(range(16)), "m") == {}
+
+    def test_config_from_dict(self):
+        cfg = IndexerConfig.from_dict(
+            {
+                "tokenProcessorConfig": {"blockSizeTokens": 64, "hashSeed": "42"},
+                "kvBlockScorerConfig": {
+                    "backendConfigs": [{"name": "tpu-hbm", "weight": 2.0}]
+                },
+                "kvBlockIndexConfig": {"inMemoryConfig": {"size": 500}},
+            }
+        )
+        indexer = Indexer(cfg)
+        assert indexer.token_processor.block_size == 64
+        assert indexer.scorer.medium_weights == {"tpu-hbm": 2.0}
