@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Offline KV-events demo: dummy publisher → pool → index → pod scores.
+
+TPU-native counterpart of the reference's ``examples/kv_events/offline``
+(dummy ZMQ publisher feeding the indexer with no engine involved). Runs
+entirely in-process over tcp loopback and prints the scores a scheduler
+would see.
+
+Usage: PYTHONPATH=. python examples/offline_events.py
+"""
+
+import time
+
+from llmd_kv_cache_tpu.core import TokenProcessorConfig
+from llmd_kv_cache_tpu.events import BlockStoredEvent, Pool, PoolConfig, ZMQSubscriber
+from llmd_kv_cache_tpu.events.publisher import KVEventPublisher
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+
+ENDPOINT = "tcp://127.0.0.1:5557"
+MODEL = "meta-llama/Llama-3.1-8B-Instruct"
+BLOCK_SIZE = 16
+
+
+def main() -> None:
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK_SIZE, hash_seed="42"
+            )
+        )
+    )
+    pool = Pool(
+        PoolConfig(concurrency=4),
+        indexer.kv_block_index,
+        indexer.token_processor,
+    )
+    pool.start()
+
+    # Centralized delivery: the indexer binds, engines connect.
+    sub = ZMQSubscriber(ENDPOINT, "kv@", pool.add_task, bind=True)
+    sub.start()
+    time.sleep(0.2)
+
+    # Two fake vLLM-TPU pods with a shared 64-token system prefix; pod-a has
+    # also cached a 32-token continuation.
+    prefix = list(range(1000, 1064))
+    continuation = list(range(2000, 2032))
+
+    pub_a = KVEventPublisher(ENDPOINT, "vllm-tpu-pod-a", MODEL, bind=False)
+    pub_b = KVEventPublisher(ENDPOINT, "vllm-tpu-pod-b", MODEL, bind=False)
+    time.sleep(0.3)  # PUB slow-joiner settle
+
+    pub_a.publish([
+        BlockStoredEvent(block_hashes=[1, 2, 3, 4], tokens=prefix,
+                         parent_hash=0, block_size=BLOCK_SIZE),
+    ])
+    pub_a.publish([
+        BlockStoredEvent(block_hashes=[5, 6], tokens=continuation,
+                         parent_hash=4, block_size=BLOCK_SIZE),
+    ])
+    pub_b.publish([
+        BlockStoredEvent(block_hashes=[1, 2, 3, 4], tokens=prefix,
+                         parent_hash=0, block_size=BLOCK_SIZE),
+    ])
+
+    time.sleep(0.5)
+    pool.join()
+
+    full_prompt = prefix + continuation
+    scores = indexer.score_tokens(full_prompt, MODEL)
+    print(f"prompt: {len(full_prompt)} tokens "
+          f"({len(full_prompt) // BLOCK_SIZE} blocks)")
+    print("pod scores (tier-weighted consecutive prefix blocks):")
+    for pod_name, score in sorted(scores.items(), key=lambda kv: -kv[1]):
+        print(f"  {pod_name}: {score}")
+
+    expected = {"vllm-tpu-pod-a": 6.0, "vllm-tpu-pod-b": 4.0}
+    assert scores == expected, f"unexpected scores: {scores} != {expected}"
+    print("OK: scheduler would route to vllm-tpu-pod-a")
+
+    sub.stop()
+    pool.shutdown()
+    pub_a.close()
+    pub_b.close()
+
+
+if __name__ == "__main__":
+    main()

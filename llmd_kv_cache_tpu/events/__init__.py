@@ -1,0 +1,32 @@
+"""KV-event plane: ingestion of engine cache events over ZMQ.
+
+Counterpart of reference ``pkg/kvevents/``. Engines (vLLM-TPU, SGLang, or
+this repo's ``models.engine``) publish BlockStored / BlockRemoved /
+AllBlocksCleared events; a sharded worker pool ingests them into the index
+with per-pod ordering.
+"""
+
+from .model import (
+    AllBlocksClearedEvent,
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    EventBatch,
+    RawMessage,
+)
+from .pool import Pool, PoolConfig
+from .publisher import StorageEventPublisher
+from .subscriber_manager import SubscriberManager
+from .zmq_subscriber import ZMQSubscriber
+
+__all__ = [
+    "AllBlocksClearedEvent",
+    "BlockRemovedEvent",
+    "BlockStoredEvent",
+    "EventBatch",
+    "RawMessage",
+    "Pool",
+    "PoolConfig",
+    "StorageEventPublisher",
+    "SubscriberManager",
+    "ZMQSubscriber",
+]

@@ -1,0 +1,388 @@
+"""Sharded, per-pod-ordered event processing pool.
+
+Counterpart of reference ``pkg/kvevents/pool.go``. Messages are sharded
+across worker queues by FNV-1a(pod id) % concurrency (``pool.go:161-173``)
+so all events from one pod land on one worker and are processed in order —
+the system's own "parallelism". Workers ingest parsed events into the index:
+
+- BlockStored with tokens → learn HMA group, resolve parent engine key to a
+  request key, parse + realign extra keys to canonical granularity,
+  recompute request keys, ``index.add`` (``pool.go:312-425``)
+- BlockStored without tokens → device-tier (offload) update for known
+  blocks (``pool.go:262-299``)
+- BlockRemoved → evict each engine key (``pool.go:427-451``)
+- AllBlocksCleared → pod-wide ``index.clear`` (``pool.go:453-473``)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.extra_keys import BlockExtraFeatures, parse_raw_extra_keys
+from ..core.hma import GroupCatalog, GroupMetadata
+from ..core.keys import EMPTY_BLOCK_HASH, TIER_TPU_HBM, BlockHash, KeyType, PodEntry
+from ..core.token_processor import ChunkedTokenDatabase
+from ..index.base import Index
+from ..utils.fnv import fnv1a_32
+from ..utils.logging import get_logger
+from .adapters import create_adapter
+from .model import (
+    AllBlocksClearedEvent,
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    EventBatch,
+    EngineAdapter,
+    RawMessage,
+)
+
+logger = get_logger("events.pool")
+
+# Default tier for events that omit a medium. The reference defaults to
+# "gpu" (pool.go:32); on a TPU fleet the engine-resident tier is TPU HBM.
+DEFAULT_EVENT_SOURCE_TIER = TIER_TPU_HBM
+
+
+@dataclass
+class PodDiscoveryConfig:
+    """Kubernetes pod-reconciler knobs (``pool.go:56-76``)."""
+
+    pod_label_selector: str = "llm-d.ai/inference-serving=true"
+    pod_namespace: str = ""
+    socket_port: int = 5557
+
+
+@dataclass
+class PoolConfig:
+    """Event pool configuration (``pool.go:37-86``)."""
+
+    zmq_endpoint: str = ""
+    topic_filter: str = "kv@"
+    concurrency: int = 4
+    engine_type: str = "vllm"
+    discover_pods: bool = False
+    pod_discovery_config: PodDiscoveryConfig = field(default_factory=PodDiscoveryConfig)
+    # TPU addition closing the reference's documented DP gap
+    # (vllm_adapter.go:95, architecture.md "DP ranks WIP"): when True, pod
+    # identifiers become "<pod>|dp<rank>" for events tagged with a
+    # data-parallel rank, so routing can target a specific rank.
+    track_dp_rank: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PoolConfig":
+        if not d:
+            return cls()
+        cfg = cls(
+            zmq_endpoint=d.get("zmqEndpoint", d.get("zmq_endpoint", "")),
+            topic_filter=d.get("topicFilter", d.get("topic_filter", "kv@")),
+            concurrency=d.get("concurrency", 4) or 4,
+            engine_type=d.get("engineType", d.get("engine_type", "vllm")) or "vllm",
+            discover_pods=d.get("discoverPods", d.get("discover_pods", False)),
+            track_dp_rank=d.get("trackDPRank", d.get("track_dp_rank", False)),
+        )
+        pdc = d.get("podDiscoveryConfig", d.get("pod_discovery_config"))
+        if pdc:
+            cfg.pod_discovery_config = PodDiscoveryConfig(
+                pod_label_selector=pdc.get(
+                    "podLabelSelector",
+                    pdc.get("pod_label_selector", "llm-d.ai/inference-serving=true"),
+                ),
+                pod_namespace=pdc.get("podNamespace", pdc.get("pod_namespace", "")),
+                socket_port=pdc.get("socketPort", pdc.get("socket_port", 5557)) or 5557,
+            )
+        return cfg
+
+
+class Pool:
+    """Sharded worker pool ingesting KV events into an index.
+
+    Stateless: all key mappings are delegated to the Index, so multiple
+    replicas ingesting the same stream converge to the same soft state.
+    """
+
+    def __init__(
+        self,
+        cfg: Optional[PoolConfig],
+        index: Index,
+        token_processor: ChunkedTokenDatabase,
+        adapter: Optional[EngineAdapter] = None,
+    ):
+        self.cfg = cfg or PoolConfig()
+        self.index = index
+        self.token_processor = token_processor
+        self.adapter = adapter if adapter is not None else create_adapter(self.cfg.engine_type)
+        self.group_catalog = GroupCatalog()
+        self._queues: list[queue.Queue] = [
+            queue.Queue() for _ in range(self.cfg.concurrency)
+        ]
+        self._threads: list[threading.Thread] = []
+        self._started = False
+        self._shutdown = object()  # queue sentinel
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        """Start worker threads (non-blocking, idempotent)."""
+        if self._started:
+            return
+        self._started = True
+        for i in range(self.cfg.concurrency):
+            t = threading.Thread(
+                target=self._worker, args=(i,), name=f"kvevents-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info("started sharded event pool with %d workers", self.cfg.concurrency)
+
+    def shutdown(self) -> None:
+        """Drain queues and stop workers (idempotent)."""
+        if not self._started:
+            return
+        for q in self._queues:
+            q.put(self._shutdown)
+        for t in self._threads:
+            t.join()
+        self._threads.clear()
+        self._started = False
+
+    def join(self) -> None:
+        """Block until all currently queued tasks are processed (testing aid)."""
+        for q in self._queues:
+            q.join()
+
+    # -- ingestion --
+
+    def add_task(self, task: RawMessage) -> None:
+        """Queue a raw message on the shard owned by its pod."""
+        key = self.adapter.sharding_key(task)
+        shard = fnv1a_32(key.encode("utf-8")) % self.cfg.concurrency
+        self._queues[shard].put(task)
+
+    def _worker(self, worker_index: int) -> None:
+        q = self._queues[worker_index]
+        while True:
+            task = q.get()
+            try:
+                if task is self._shutdown:
+                    return
+                self._process_raw_message(task)
+            finally:
+                q.task_done()
+
+    def _process_raw_message(self, msg: RawMessage) -> None:
+        try:
+            pod_id, model_name, batch = self.adapter.parse_message(msg)
+        except Exception:
+            logger.exception("failed to parse message on topic %s", msg.topic)
+            return
+        try:
+            self.process_event_batch(batch, pod_id, model_name)
+        except Exception:
+            # Catch-all: a backend failure on one message must never kill
+            # the shard's worker thread.
+            logger.exception("failed to process event batch from %s", pod_id)
+
+    # -- event semantics --
+
+    def process_event_batch(
+        self, batch: EventBatch, pod_identifier: str, model_name: str
+    ) -> None:
+        """Apply a parsed event batch to the index (``pool.go:302-479``)."""
+        if (
+            self.cfg.track_dp_rank
+            and batch.data_parallel_rank is not None
+            and batch.data_parallel_rank >= 0
+        ):
+            pod_identifier = f"{pod_identifier}|dp{batch.data_parallel_rank}"
+
+        for event in batch.events:
+            if isinstance(event, BlockStoredEvent):
+                self._handle_block_stored(event, pod_identifier, model_name)
+            elif isinstance(event, BlockRemovedEvent):
+                self._handle_block_removed(event, pod_identifier)
+            elif isinstance(event, AllBlocksClearedEvent):
+                # Pod-wide: engines emit this with no tier; a tier-scoped
+                # clear is unsupported and would over-wipe.
+                try:
+                    self.index.clear(pod_identifier)
+                except Exception:
+                    logger.exception("failed to clear pod %s", pod_identifier)
+            else:  # pragma: no cover - adapter produces only known events
+                logger.debug("unknown event from pod %s: %r", pod_identifier, event)
+
+    def _handle_block_stored(
+        self, ev: BlockStoredEvent, pod_identifier: str, model_name: str
+    ) -> None:
+        device_tier = ev.device_tier.lower() if ev.device_tier else DEFAULT_EVENT_SOURCE_TIER
+
+        # LoRA adapters are distinct cache namespaces: use the LoRA name as
+        # the effective model for key derivation (pool.go:319-323).
+        effective_model = ev.lora_name if ev.lora_name else model_name
+
+        pod_entry = PodEntry(pod_identifier=pod_identifier, device_tier=device_tier)
+        if ev.group_idx is not None:
+            self.group_catalog.learn(
+                pod_identifier,
+                ev.group_idx,
+                GroupMetadata(
+                    kind=ev.kv_cache_spec_kind,
+                    block_size=ev.block_size,
+                    sliding_window_size=ev.kv_cache_spec_sliding_window,
+                ),
+            )
+            pod_entry = PodEntry(
+                pod_identifier=pod_identifier,
+                device_tier=device_tier,
+                has_group=True,
+                group_idx=ev.group_idx,
+            )
+        pod_entries = [pod_entry]
+
+        engine_keys: list[BlockHash] = ev.block_hashes
+
+        parent_request_key = EMPTY_BLOCK_HASH
+        if ev.parent_hash != 0:
+            try:
+                resolved = self.index.get_request_key(ev.parent_hash)
+            except Exception:
+                logger.exception("parent key resolution failed (pod %s)", pod_identifier)
+                resolved = None
+            if resolved is None:
+                logger.debug(
+                    "no request key for parent engine key %d (pod %s); dropping event",
+                    ev.parent_hash, pod_identifier,
+                )
+                return
+            parent_request_key = resolved
+
+        extra_features: Optional[list[Optional[BlockExtraFeatures]]] = None
+        if ev.extra_keys is not None:
+            try:
+                extra_features = parse_raw_extra_keys(ev.extra_keys)
+            except Exception:
+                logger.exception("failed to parse extra keys from pod %s", pod_identifier)
+                return
+
+        # Realign extra features from engine-block to canonical-block
+        # granularity (pool.go:366-378).
+        if extra_features is not None:
+            canonical_count = len(ev.tokens) // self.token_processor.block_size
+            if canonical_count == 0:
+                extra_features = None
+            elif len(extra_features) != canonical_count:
+                extra_features = realign_extra_features(extra_features, canonical_count)
+
+        try:
+            request_keys = self.token_processor.tokens_to_kv_block_keys(
+                parent_request_key, ev.tokens, effective_model, extra_features
+            )
+        except ValueError:
+            logger.exception("failed to generate request keys for pod %s", pod_identifier)
+            return
+
+        if not request_keys:
+            self._handle_device_tier_update(
+                ev.tokens, engine_keys, pod_entries, pod_identifier, device_tier
+            )
+            return
+
+        try:
+            self.index.add(engine_keys, request_keys, pod_entries)
+        except Exception:
+            logger.exception("failed to add event to index for pod %s", pod_identifier)
+
+    def _handle_device_tier_update(
+        self,
+        tokens: list[int],
+        engine_keys: list[BlockHash],
+        pod_entries: list[PodEntry],
+        pod_identifier: str,
+        device_tier: str,
+    ) -> None:
+        """Tokenless BlockStored = offload/location update (``pool.go:262-299``).
+
+        Resolve known engine keys to request keys and add the new tier entry.
+        Partial-block events (0 < tokens < block size) are skipped entirely.
+        """
+        if tokens or not engine_keys:
+            return
+
+        seen: set[BlockHash] = set()
+        resolved: list[BlockHash] = []
+        for ek in engine_keys:
+            try:
+                rk = self.index.get_request_key(ek)
+            except Exception:
+                logger.exception("engine key resolution failed (pod %s)", pod_identifier)
+                continue
+            if rk is None or rk in seen:
+                continue
+            seen.add(rk)
+            resolved.append(rk)
+
+        if resolved:
+            try:
+                self.index.add(None, resolved, pod_entries)
+            except Exception:
+                logger.exception(
+                    "failed to add device-tier update (pod %s, tier %s)",
+                    pod_identifier, device_tier,
+                )
+        else:
+            logger.debug(
+                "no indexed engine keys for device-tier update (pod %s, %d keys)",
+                pod_identifier, len(engine_keys),
+            )
+
+    def _handle_block_removed(self, ev: BlockRemovedEvent, pod_identifier: str) -> None:
+        device_tier = ev.device_tier.lower() if ev.device_tier else DEFAULT_EVENT_SOURCE_TIER
+        pod_entry = PodEntry(pod_identifier=pod_identifier, device_tier=device_tier)
+        if ev.group_idx is not None:
+            pod_entry = PodEntry(
+                pod_identifier=pod_identifier,
+                device_tier=device_tier,
+                has_group=True,
+                group_idx=ev.group_idx,
+            )
+        for engine_key in ev.block_hashes:
+            try:
+                self.index.evict(engine_key, KeyType.ENGINE, [pod_entry])
+            except Exception:
+                logger.exception(
+                    "failed to evict engine key %d from pod %s", engine_key, pod_identifier
+                )
+
+
+def realign_extra_features(
+    engine_features: list[Optional[BlockExtraFeatures]], canonical_block_count: int
+) -> Optional[list[Optional[BlockExtraFeatures]]]:
+    """Convert per-engine-block features to per-canonical-block granularity.
+
+    Mirrors reference ``pool.go:227-260``: for 1:many (engine block larger)
+    replicate each engine feature onto its canonical sub-blocks; for many:1
+    merge (union of MM hashes) constituent engine features into each
+    canonical block.
+    """
+    engine_count = len(engine_features)
+    if canonical_block_count == 0:
+        return None
+    if engine_count == 0 or engine_count == canonical_block_count:
+        return engine_features
+
+    canonical: list[Optional[BlockExtraFeatures]] = [None] * canonical_block_count
+
+    if engine_count < canonical_block_count:
+        for i in range(canonical_block_count):
+            canonical[i] = engine_features[i * engine_count // canonical_block_count]
+    else:
+        for i, ef in enumerate(engine_features):
+            if ef is None:
+                continue
+            ci = i * canonical_block_count // engine_count
+            if canonical[ci] is None:
+                canonical[ci] = BlockExtraFeatures()
+            canonical[ci].mm_hashes.extend(ef.mm_hashes)
+
+    return canonical

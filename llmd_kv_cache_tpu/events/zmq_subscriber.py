@@ -1,0 +1,117 @@
+"""ZMQ SUB subscriber for KV events.
+
+Counterpart of reference ``pkg/kvevents/zmq_subscriber.go``. Wire protocol:
+three frames ``[topic, 8-byte big-endian sequence, msgpack payload]``
+(``zmq_subscriber.go:121-135``). Two delivery modes:
+
+- **centralized**: the indexer *binds* a local endpoint and every engine
+  connects its PUB to it
+- **pod-discovery**: one subscriber per pod *dials* the pod's PUB endpoint
+
+Crash-only: an outer retry loop re-establishes the socket every 5 s forever
+(``zmq_subscriber.go:54-76``); a dead pod's subscriber just keeps retrying
+until the reconciler removes it.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Callable, Optional
+
+import zmq
+
+from ..utils.logging import get_logger
+from .model import RawMessage
+
+logger = get_logger("events.zmq")
+
+RETRY_INTERVAL_S = 5.0
+_POLL_INTERVAL_MS = 200
+
+
+class ZMQSubscriber:
+    """A resilient SUB socket feeding a Pool."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        topic_filter: str,
+        on_message: Callable[[RawMessage], None],
+        bind: bool = False,
+        context: Optional[zmq.Context] = None,
+    ):
+        self.endpoint = endpoint
+        self.topic_filter = topic_filter
+        self.on_message = on_message
+        self.bind = bind
+        self._ctx = context or zmq.Context.instance()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        """Start the subscriber loop in a daemon thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"zmq-sub-{self.endpoint}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * RETRY_INTERVAL_S)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._run_subscriber()
+            except Exception:
+                logger.exception("subscriber error for %s; retrying in %ss",
+                                 self.endpoint, RETRY_INTERVAL_S)
+            if self._stop.wait(RETRY_INTERVAL_S):
+                return
+
+    def _run_subscriber(self) -> None:
+        sock = self._ctx.socket(zmq.SUB)
+        try:
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.setsockopt_string(zmq.SUBSCRIBE, self.topic_filter)
+            if self.bind:
+                sock.bind(self.endpoint)
+            else:
+                sock.connect(self.endpoint)
+            logger.info("subscribed to %s (%s, filter=%r)",
+                        self.endpoint, "bind" if self.bind else "connect", self.topic_filter)
+
+            while not self._stop.is_set():
+                if not sock.poll(_POLL_INTERVAL_MS):
+                    continue
+                frames = sock.recv_multipart()
+                msg = self._parse_frames(frames)
+                if msg is not None:
+                    self.on_message(msg)
+        finally:
+            sock.close()
+
+    @staticmethod
+    def _parse_frames(frames: list[bytes]) -> Optional[RawMessage]:
+        if len(frames) != 3:
+            logger.warning("dropping message with %d frames (want 3)", len(frames))
+            return None
+        topic_raw, seq_raw, payload = frames
+        try:
+            topic = topic_raw.decode("utf-8")
+        except UnicodeDecodeError:
+            logger.warning("dropping message with non-utf8 topic")
+            return None
+        if len(seq_raw) < 8:
+            logger.warning("dropping message with %d-byte seq frame (want >= 8)", len(seq_raw))
+            return None
+        # Decode the first 8 bytes; longer frames are tolerated for interop
+        # (reference zmq_subscriber.go:130).
+        (sequence,) = struct.unpack(">Q", seq_raw[:8])
+        return RawMessage(topic=topic, sequence=sequence, payload=payload)

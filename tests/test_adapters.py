@@ -1,0 +1,193 @@
+"""Engine adapter decode tests with hand-built msgpack fixtures.
+
+Mirrors the reference adapter suites (``vllm_adapter_test.go``,
+``sglang_adapter_test.go``): positional arrays, omitted trailing fields,
+hash format variants, malformed payload rejection.
+"""
+
+import struct
+
+import msgpack
+import pytest
+
+from llmd_kv_cache_tpu.events import (
+    AllBlocksClearedEvent,
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    RawMessage,
+)
+from llmd_kv_cache_tpu.events.adapters import SGLangAdapter, VLLMAdapter, create_adapter
+from llmd_kv_cache_tpu.events.adapters.common import hash_to_uint64, parse_topic
+
+
+def make_msg(events, topic="kv@pod-1@model-a", ts=123.5, dp_rank=None, seq=7):
+    batch = [ts, events]
+    if dp_rank is not None:
+        batch.append(dp_rank)
+    return RawMessage(
+        topic=topic, sequence=seq, payload=msgpack.packb(batch, use_bin_type=True)
+    )
+
+
+class TestTopicParsing:
+    def test_standard(self):
+        assert parse_topic("kv@pod-1@meta/llama-3") == ("pod-1", "meta/llama-3")
+
+    def test_model_with_at(self):
+        assert parse_topic("kv@pod@model@lora") == ("pod", "model@lora")
+
+    def test_malformed(self):
+        assert parse_topic("kv@pod") == ("pod", "")
+        assert parse_topic("junk") == ("", "")
+
+
+class TestHashFormats:
+    def test_uint(self):
+        assert hash_to_uint64(5) == 5
+
+    def test_negative_int_wraps(self):
+        assert hash_to_uint64(-1) == 0xFFFFFFFFFFFFFFFF
+
+    def test_bytes_last8_be(self):
+        digest = bytes(range(32))
+        expected = int.from_bytes(digest[-8:], "big")
+        assert hash_to_uint64(digest) == expected
+
+    def test_short_bytes(self):
+        assert hash_to_uint64(b"\x01\x02") == 0x0102
+
+    def test_bad_types(self):
+        with pytest.raises(TypeError):
+            hash_to_uint64("nope")
+        with pytest.raises(TypeError):
+            hash_to_uint64(True)
+        with pytest.raises(ValueError):
+            hash_to_uint64(b"")
+
+
+class TestVLLMAdapter:
+    def setup_method(self):
+        self.adapter = VLLMAdapter()
+
+    def test_sharding_key(self):
+        assert self.adapter.sharding_key(make_msg([])) == "pod-1"
+
+    def test_full_block_stored(self):
+        ev = ["BlockStored", [1, 2], 99, list(range(32)), 16, 7, "cpu", "lora-x",
+              [["mm1"], None], 1, "sliding_window", 1024]
+        pod, model, batch = self.adapter.parse_message(make_msg([ev]))
+        assert (pod, model) == ("pod-1", "model-a")
+        assert batch.timestamp == 123.5
+        e = batch.events[0]
+        assert isinstance(e, BlockStoredEvent)
+        assert e.block_hashes == [1, 2]
+        assert e.parent_hash == 99
+        assert e.tokens == list(range(32))
+        assert e.block_size == 16
+        assert e.lora_id == 7
+        assert e.device_tier == "cpu"
+        assert e.lora_name == "lora-x"
+        assert e.extra_keys == [["mm1"], None]
+        assert e.group_idx == 1
+        assert e.kv_cache_spec_kind == "sliding_window"
+        assert e.kv_cache_spec_sliding_window == 1024
+
+    def test_minimal_block_stored_omitted_trailing(self):
+        ev = ["BlockStored", [10], None, [1, 2, 3], 16]
+        _, _, batch = self.adapter.parse_message(make_msg([ev]))
+        e = batch.events[0]
+        assert e.parent_hash == 0
+        assert e.lora_id is None and e.device_tier == "" and e.extra_keys is None
+        assert e.group_idx is None
+
+    def test_extra_trailing_fields_ignored(self):
+        ev = ["BlockStored", [10], None, [1], 16, None, None, None, None, None,
+              None, None, "future-field", 42]
+        _, _, batch = self.adapter.parse_message(make_msg([ev]))
+        assert batch.events[0].block_hashes == [10]
+
+    def test_block_stored_bytes_hashes(self):
+        digest = bytes(range(32))
+        ev = ["BlockStored", [digest], digest, [1], 16]
+        _, _, batch = self.adapter.parse_message(make_msg([ev]))
+        e = batch.events[0]
+        assert e.block_hashes == [hash_to_uint64(digest)]
+        assert e.parent_hash == hash_to_uint64(digest)
+
+    def test_block_removed(self):
+        ev = ["BlockRemoved", [5, 6], "cpu", 2]
+        _, _, batch = self.adapter.parse_message(make_msg([ev]))
+        e = batch.events[0]
+        assert isinstance(e, BlockRemovedEvent)
+        assert e.block_hashes == [5, 6]
+        assert e.device_tier == "cpu"
+        assert e.group_idx == 2
+
+    def test_block_removed_minimal(self):
+        _, _, batch = self.adapter.parse_message(make_msg([["BlockRemoved", [5]]]))
+        assert batch.events[0].device_tier == ""
+
+    def test_all_blocks_cleared(self):
+        _, _, batch = self.adapter.parse_message(make_msg([["AllBlocksCleared"]]))
+        assert isinstance(batch.events[0], AllBlocksClearedEvent)
+
+    def test_dp_rank(self):
+        _, _, batch = self.adapter.parse_message(make_msg([], dp_rank=3))
+        assert batch.data_parallel_rank == 3
+
+    def test_nested_raw_bytes_events(self):
+        # events may arrive as embedded msgpack blobs (RawMessage nesting)
+        inner = msgpack.packb(["AllBlocksCleared"], use_bin_type=True)
+        _, _, batch = self.adapter.parse_message(make_msg([inner]))
+        assert isinstance(batch.events[0], AllBlocksClearedEvent)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            [["BlockStored", [1]]],  # too few fields
+            [["BlockStored", "not-array", None, [1], 16]],
+            [["Unknown", 1]],
+            [[42, 1]],  # non-string tag
+            [[]],  # no tag
+        ],
+    )
+    def test_malformed_events_raise(self, bad):
+        with pytest.raises(ValueError):
+            self.adapter.parse_message(make_msg(bad))
+
+    def test_garbage_payload_raises(self):
+        msg = RawMessage(topic="kv@p@m", sequence=0, payload=b"\x00garbage")
+        with pytest.raises(Exception):
+            self.adapter.parse_message(msg)
+
+    def test_negative_group_idx_rejected(self):
+        ev = ["BlockStored", [1], None, [1], 16, None, None, None, None, -1]
+        with pytest.raises(ValueError, match="negative"):
+            self.adapter.parse_message(make_msg([ev]))
+
+
+class TestSGLangAdapter:
+    def test_hma_fields_cleared(self):
+        adapter = SGLangAdapter()
+        ev = ["BlockStored", [1], None, [1], 16, None, "cpu", None, None, 5,
+              "sliding_window", 100]
+        _, _, batch = adapter.parse_message(make_msg([ev]))
+        e = batch.events[0]
+        assert e.device_tier == "cpu"
+        assert e.group_idx is None
+        assert e.kv_cache_spec_kind == ""
+        assert e.kv_cache_spec_sliding_window is None
+
+    def test_block_removed_group_cleared(self):
+        adapter = SGLangAdapter()
+        _, _, batch = adapter.parse_message(make_msg([["BlockRemoved", [1], "cpu", 3]]))
+        assert batch.events[0].group_idx is None
+
+
+class TestFactory:
+    def test_create(self):
+        assert isinstance(create_adapter("vllm"), VLLMAdapter)
+        assert isinstance(create_adapter("sglang"), SGLangAdapter)
+        assert isinstance(create_adapter(None), VLLMAdapter)
+        with pytest.raises(ValueError):
+            create_adapter("tgi")

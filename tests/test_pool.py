@@ -1,0 +1,302 @@
+"""Event pool semantics tests, driven against a real in-memory index.
+
+Mirrors the reference ``pool_test.go`` approach: build parsed event batches
+and run them through ``process_event_batch`` / the full sharded pool.
+"""
+
+import msgpack
+import pytest
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, PodEntry, TokenProcessorConfig
+from llmd_kv_cache_tpu.events import (
+    AllBlocksClearedEvent,
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    EventBatch,
+    Pool,
+    PoolConfig,
+    RawMessage,
+)
+from llmd_kv_cache_tpu.events.pool import realign_extra_features
+from llmd_kv_cache_tpu.core.extra_keys import BlockExtraFeatures
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+
+BLOCK = 4  # canonical block size for tests
+MODEL = "model-a"
+POD = "pod-1"
+
+
+@pytest.fixture
+def processor():
+    return ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+
+
+@pytest.fixture
+def index():
+    return InMemoryIndex(InMemoryIndexConfig(size=10_000))
+
+
+@pytest.fixture
+def pool(index, processor):
+    return Pool(PoolConfig(concurrency=2), index, processor)
+
+
+def batch(*events, ts=1.0, dp=None):
+    return EventBatch(timestamp=ts, events=list(events), data_parallel_rank=dp)
+
+
+def stored(hashes, tokens, parent=0, block_size=BLOCK, **kw):
+    return BlockStoredEvent(
+        block_hashes=hashes, tokens=tokens, parent_hash=parent, block_size=block_size, **kw
+    )
+
+
+class TestBlockStored:
+    def test_basic_ingest(self, pool, index, processor):
+        tokens = list(range(8))
+        pool.process_event_batch(batch(stored([101, 102], tokens)), POD, MODEL)
+        request_keys = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        result = index.lookup(request_keys)
+        assert set(result) == set(request_keys)
+        assert result[request_keys[0]] == [PodEntry(POD, "tpu-hbm")]
+        # engine→request mapping learned
+        assert index.get_request_key(101) == request_keys[0]
+        assert index.get_request_key(102) == request_keys[1]
+
+    def test_default_tier_is_tpu_hbm(self, pool, index, processor):
+        pool.process_event_batch(batch(stored([1], list(range(4)))), POD, MODEL)
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk)[rk[0]][0].device_tier == "tpu-hbm"
+
+    def test_explicit_tier_lowercased(self, pool, index, processor):
+        pool.process_event_batch(
+            batch(stored([1], list(range(4)), device_tier="CPU")), POD, MODEL
+        )
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk)[rk[0]][0].device_tier == "cpu"
+
+    def test_parent_chain_resolution(self, pool, index, processor):
+        t1, t2 = list(range(4)), list(range(4, 8))
+        pool.process_event_batch(batch(stored([11], t1)), POD, MODEL)
+        # second event chains via engine parent hash 11
+        pool.process_event_batch(batch(stored([12], t2, parent=11)), POD, MODEL)
+        full_keys = processor.tokens_to_kv_block_keys(0, t1 + t2, MODEL)
+        result = index.lookup(full_keys)
+        assert set(result) == set(full_keys)
+
+    def test_unknown_parent_drops_event(self, pool, index, processor):
+        pool.process_event_batch(
+            batch(stored([12], list(range(4)), parent=999)), POD, MODEL
+        )
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk) == {}
+
+    def test_lora_name_overrides_model(self, pool, index, processor):
+        tokens = list(range(4))
+        pool.process_event_batch(
+            batch(stored([1], tokens, lora_name="my-lora")), POD, MODEL
+        )
+        lora_keys = processor.tokens_to_kv_block_keys(0, tokens, "my-lora")
+        base_keys = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        assert index.lookup(lora_keys) != {}
+        assert index.lookup(base_keys) == {}
+
+    def test_group_learning(self, pool, index, processor):
+        pool.process_event_batch(
+            batch(
+                stored(
+                    [1], list(range(4)), group_idx=2,
+                    kv_cache_spec_kind="sliding_window",
+                    kv_cache_spec_sliding_window=512,
+                )
+            ),
+            POD, MODEL,
+        )
+        meta = pool.group_catalog.get(POD, 2)
+        assert meta is not None
+        assert meta.kind == "sliding_window"
+        assert meta.sliding_window_size == 512
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        entry = index.lookup(rk)[rk[0]][0]
+        assert entry.has_group and entry.group_idx == 2
+
+    def test_many_to_one_engine_keys(self, pool, index, processor):
+        """Engine block size 2, canonical 4: two engine keys per request key."""
+        tokens = list(range(8))
+        pool.process_event_batch(
+            batch(stored([1, 2, 3, 4], tokens, block_size=2)), POD, MODEL
+        )
+        request_keys = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        assert index.get_request_key(1) == request_keys[0]
+        assert index.get_request_key(2) == request_keys[0]
+        assert index.get_request_key(3) == request_keys[1]
+        assert index.get_request_key(4) == request_keys[1]
+
+    def test_extra_keys_taint(self, pool, index, processor):
+        tokens = list(range(4))
+        pool.process_event_batch(
+            batch(stored([1], tokens, extra_keys=[["mmh"]])), POD, MODEL
+        )
+        plain_keys = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        tainted_keys = processor.tokens_to_kv_block_keys(
+            0, tokens, MODEL, [BlockExtraFeatures(mm_hashes=["mmh"])]
+        )
+        assert index.lookup(plain_keys) == {}
+        assert index.lookup(tainted_keys) != {}
+
+
+class TestDeviceTierUpdate:
+    def test_tokenless_stored_adds_tier(self, pool, index, processor):
+        tokens = list(range(8))
+        pool.process_event_batch(batch(stored([21, 22], tokens)), POD, MODEL)
+        # offload event: same engine keys, no tokens, storage tier
+        pool.process_event_batch(
+            batch(stored([21, 22], [], device_tier="SHARED_STORAGE")), POD, MODEL
+        )
+        rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        result = index.lookup(rks)
+        tiers0 = {e.device_tier for e in result[rks[0]]}
+        assert tiers0 == {"tpu-hbm", "shared_storage"}
+
+    def test_tokenless_unknown_keys_noop(self, pool, index):
+        pool.process_event_batch(
+            batch(stored([777], [], device_tier="SHARED_STORAGE")), POD, MODEL
+        )
+        # nothing indexed, nothing crashes
+
+    def test_partial_block_skipped(self, pool, index, processor):
+        """Events with 0 < tokens < block size must not become tier updates."""
+        pool.process_event_batch(batch(stored([31], list(range(4)))), POD, MODEL)
+        pool.process_event_batch(
+            batch(stored([31], [1, 2], device_tier="CPU")), POD, MODEL
+        )
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        tiers = {e.device_tier for e in index.lookup(rk)[rk[0]]}
+        assert tiers == {"tpu-hbm"}
+
+
+class TestRemoveAndClear:
+    def test_block_removed(self, pool, index, processor):
+        tokens = list(range(4))
+        pool.process_event_batch(batch(stored([41], tokens)), POD, MODEL)
+        pool.process_event_batch(
+            batch(BlockRemovedEvent(block_hashes=[41])), POD, MODEL
+        )
+        rk = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        assert index.lookup(rk) == {}
+
+    def test_remove_only_matching_tier(self, pool, index, processor):
+        tokens = list(range(4))
+        pool.process_event_batch(batch(stored([42], tokens)), POD, MODEL)
+        pool.process_event_batch(
+            batch(stored([42], [], device_tier="CPU")), POD, MODEL
+        )
+        # remove the HBM copy; CPU copy must survive
+        pool.process_event_batch(
+            batch(BlockRemovedEvent(block_hashes=[42])), POD, MODEL
+        )
+        rk = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        tiers = {e.device_tier for e in index.lookup(rk)[rk[0]]}
+        assert tiers == {"cpu"}
+
+    def test_all_blocks_cleared(self, pool, index, processor):
+        tokens = list(range(8))
+        other_tokens = list(range(100, 104))
+        pool.process_event_batch(batch(stored([51, 52], tokens)), POD, MODEL)
+        pool.process_event_batch(batch(stored([61], other_tokens)), "pod-2", MODEL)
+        pool.process_event_batch(batch(AllBlocksClearedEvent()), POD, MODEL)
+        rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        assert index.lookup(rks) == {}
+        rk2 = processor.tokens_to_kv_block_keys(0, other_tokens, MODEL)
+        assert index.lookup(rk2) != {}  # other pod untouched
+
+
+class TestDPRank:
+    def test_dp_rank_ignored_by_default(self, pool, index, processor):
+        pool.process_event_batch(batch(stored([1], list(range(4))), dp=3), POD, MODEL)
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk)[rk[0]][0].pod_identifier == POD
+
+    def test_dp_rank_tracked_when_enabled(self, index, processor):
+        pool = Pool(PoolConfig(concurrency=1, track_dp_rank=True), index, processor)
+        pool.process_event_batch(batch(stored([1], list(range(4))), dp=3), POD, MODEL)
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk)[rk[0]][0].pod_identifier == f"{POD}|dp3"
+
+
+class TestRealignExtraFeatures:
+    def test_passthrough_when_equal(self):
+        f = [BlockExtraFeatures(["a"])]
+        assert realign_extra_features(f, 1) is f
+
+    def test_one_to_many_replicates(self):
+        f = [BlockExtraFeatures(["a"]), None]
+        out = realign_extra_features(f, 4)
+        assert out[0].mm_hashes == ["a"]
+        assert out[1].mm_hashes == ["a"]
+        assert out[2] is None and out[3] is None
+
+    def test_many_to_one_merges(self):
+        f = [BlockExtraFeatures(["a"]), BlockExtraFeatures(["b"]),
+             None, BlockExtraFeatures(["c"])]
+        out = realign_extra_features(f, 2)
+        assert out[0].mm_hashes == ["a", "b"]
+        assert out[1].mm_hashes == ["c"]
+
+    def test_zero_canonical(self):
+        assert realign_extra_features([BlockExtraFeatures(["a"])], 0) is None
+
+
+class TestShardedPoolThreads:
+    def test_full_pipeline_via_raw_messages(self, index, processor):
+        """Raw msgpack messages through the sharded thread pool."""
+        pool = Pool(PoolConfig(concurrency=4), index, processor)
+        pool.start()
+        try:
+            tokens = list(range(8))
+            ev = ["BlockStored", [71, 72], None, tokens, BLOCK]
+            payload = msgpack.packb([1.0, [ev]], use_bin_type=True)
+            pool.add_task(RawMessage(topic=f"kv@{POD}@{MODEL}", sequence=0, payload=payload))
+            pool.join()
+            rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            assert set(index.lookup(rks)) == set(rks)
+        finally:
+            pool.shutdown()
+
+    def test_same_pod_same_shard_ordering(self, index, processor):
+        """Store→remove sequences for one pod retain order across 4 shards."""
+        pool = Pool(PoolConfig(concurrency=4), index, processor)
+        pool.start()
+        try:
+            tokens = list(range(4))
+            for i in range(50):
+                stored_ev = ["BlockStored", [1000 + i], None, tokens, BLOCK]
+                removed_ev = ["BlockRemoved", [1000 + i]]
+                pool.add_task(RawMessage(
+                    topic=f"kv@{POD}@{MODEL}", sequence=2 * i,
+                    payload=msgpack.packb([1.0, [stored_ev]], use_bin_type=True)))
+                pool.add_task(RawMessage(
+                    topic=f"kv@{POD}@{MODEL}", sequence=2 * i + 1,
+                    payload=msgpack.packb([1.0, [removed_ev]], use_bin_type=True)))
+            pool.join()
+            rk = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            # every store was followed by its remove, in order → empty index
+            assert index.lookup(rk) == {}
+        finally:
+            pool.shutdown()
+
+    def test_malformed_message_does_not_kill_worker(self, index, processor):
+        pool = Pool(PoolConfig(concurrency=1), index, processor)
+        pool.start()
+        try:
+            pool.add_task(RawMessage(topic="kv@p@m", sequence=0, payload=b"garbage"))
+            tokens = list(range(4))
+            ev = ["BlockStored", [81], None, tokens, BLOCK]
+            pool.add_task(RawMessage(
+                topic=f"kv@{POD}@{MODEL}", sequence=1,
+                payload=msgpack.packb([1.0, [ev]], use_bin_type=True)))
+            pool.join()
+            rk = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            assert index.lookup(rk) != {}
+        finally:
+            pool.shutdown()

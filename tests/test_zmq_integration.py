@@ -1,0 +1,183 @@
+"""ZMQ wire integration: publisher → subscriber → pool → index → scores.
+
+Mirrors the reference integration test (``tests/integration/kv_events_test.go``)
+plus the offline-publisher example flow, all in-process over tcp loopback.
+"""
+
+import time
+
+import pytest
+import zmq
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.events import (
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    Pool,
+    PoolConfig,
+    StorageEventPublisher,
+    SubscriberManager,
+    ZMQSubscriber,
+)
+from llmd_kv_cache_tpu.events.publisher import KVEventPublisher, encode_event
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+
+BLOCK = 4
+MODEL = "m"
+
+
+def wait_until(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def stack():
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+    index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    pool = Pool(PoolConfig(concurrency=2), index, processor)
+    pool.start()
+    yield processor, index, pool
+    pool.shutdown()
+
+
+class TestEncodeRoundTrip:
+    def test_stored_trims_trailing_defaults(self):
+        ev = BlockStoredEvent(block_hashes=[1], tokens=[1, 2], parent_hash=0, block_size=4)
+        assert encode_event(ev) == ["BlockStored", [1], None, [1, 2], 4]
+
+    def test_stored_keeps_middle_nones(self):
+        ev = BlockStoredEvent(
+            block_hashes=[1], tokens=[], parent_hash=0, block_size=4,
+            device_tier="SHARED_STORAGE",
+        )
+        assert encode_event(ev) == [
+            "BlockStored", [1], None, [], 4, None, "SHARED_STORAGE"
+        ]
+
+    def test_removed(self):
+        assert encode_event(BlockRemovedEvent(block_hashes=[2, 3])) == [
+            "BlockRemoved", [2, 3]
+        ]
+
+
+class TestZMQPipeline:
+    def test_engine_publisher_to_pool(self, stack):
+        processor, index, pool = stack
+        endpoint = "tcp://127.0.0.1:15701"
+
+        pub = KVEventPublisher(endpoint, pod_identifier="pod-a", model_name=MODEL, bind=True)
+        sub = ZMQSubscriber(endpoint, "kv@", pool.add_task, bind=False)
+        sub.start()
+        time.sleep(0.3)  # PUB/SUB slow-joiner settle
+
+        tokens = list(range(8))
+        try:
+            pub.publish([BlockStoredEvent(
+                block_hashes=[1, 2], tokens=tokens, parent_hash=0, block_size=BLOCK)])
+            rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            assert wait_until(lambda: index.lookup(rks) != {})
+            assert set(index.lookup(rks)) == set(rks)
+        finally:
+            sub.stop()
+            pub.close()
+
+    def test_storage_publisher_tier_update(self, stack):
+        processor, index, pool = stack
+        endpoint = "tcp://127.0.0.1:15702"
+
+        # Centralized delivery mode: the indexer-side subscriber binds and
+        # both the engine and the storage plane connect their PUB sockets.
+        sub = ZMQSubscriber(endpoint, "kv@", pool.add_task, bind=True)
+        sub.start()
+        time.sleep(0.2)
+        engine_pub = KVEventPublisher(endpoint, "pod-a", MODEL, bind=False)
+        storage_pub = StorageEventPublisher(endpoint, MODEL, bind=False)
+        time.sleep(0.3)
+
+        tokens = list(range(4))
+        try:
+            engine_pub.publish([BlockStoredEvent(
+                block_hashes=[9], tokens=tokens, parent_hash=0, block_size=BLOCK)])
+            rk = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            assert wait_until(lambda: index.lookup(rk) != {})
+
+            storage_pub.publish_block_stored([9], BLOCK)
+            assert wait_until(lambda: any(
+                e.device_tier == "shared_storage"
+                for e in index.lookup(rk).get(rk[0], [])))
+
+            storage_pub.publish_block_removed([9])
+            assert wait_until(lambda: all(
+                e.device_tier != "shared_storage"
+                for e in index.lookup(rk).get(rk[0], [])))
+        finally:
+            sub.stop()
+            engine_pub.close()
+            storage_pub.close()
+
+    def test_end_to_end_scoring(self, stack):
+        """Two pods publish; indexer scores routing preference correctly."""
+        processor, index, pool = stack
+        ep_a, ep_b = "tcp://127.0.0.1:15703", "tcp://127.0.0.1:15704"
+
+        pub_a = KVEventPublisher(ep_a, "pod-a", MODEL, bind=True)
+        pub_b = KVEventPublisher(ep_b, "pod-b", MODEL, bind=True)
+        mgr = SubscriberManager(pool.add_task)
+        mgr.ensure_subscriber("pod-a", ep_a)
+        mgr.ensure_subscriber("pod-b", ep_b)
+        time.sleep(0.3)
+
+        tokens = list(range(16))
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size_tokens=BLOCK)
+            ),
+            index=index,
+        )
+        try:
+            rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            # pod-a caches the whole prompt; pod-b only the first block
+            pub_a.publish([BlockStoredEvent(
+                block_hashes=[1, 2, 3, 4], tokens=tokens, parent_hash=0, block_size=BLOCK)])
+            pub_b.publish([BlockStoredEvent(
+                block_hashes=[1], tokens=tokens[:4], parent_hash=0, block_size=BLOCK)])
+            assert wait_until(lambda: len(index.lookup(rks)) == 4)
+
+            scores = indexer.score_tokens(tokens, MODEL)
+            assert scores == {"pod-a": 4.0, "pod-b": 1.0}
+        finally:
+            mgr.shutdown()
+            pub_a.close()
+            pub_b.close()
+
+
+class TestSubscriberManager:
+    def test_idempotent_and_endpoint_change(self):
+        mgr = SubscriberManager(lambda msg: None)
+        try:
+            assert mgr.ensure_subscriber("pod-x", "tcp://127.0.0.1:15710")
+            assert not mgr.ensure_subscriber("pod-x", "tcp://127.0.0.1:15710")
+            assert mgr.ensure_subscriber("pod-x", "tcp://127.0.0.1:15711")
+            assert mgr.endpoint_of("pod-x") == "tcp://127.0.0.1:15711"
+            assert mgr.pods() == ["pod-x"]
+            assert mgr.remove_subscriber("pod-x")
+            assert not mgr.remove_subscriber("pod-x")
+        finally:
+            mgr.shutdown()
+
+    def test_unreachable_endpoint_harmless(self, stack):
+        """Subscribers to dead pods retry forever without breaking others."""
+        _, _, pool = stack
+        mgr = SubscriberManager(pool.add_task)
+        try:
+            mgr.ensure_subscriber("dead-pod", "tcp://127.0.0.1:1")  # nothing there
+            time.sleep(0.2)
+            assert "dead-pod" in mgr.pods()
+        finally:
+            mgr.shutdown()
