@@ -1,0 +1,11 @@
+"""TPU-native model family: paged-KV transformer + mini serving engine.
+
+The in-tree stand-in for vLLM-TPU: exercises the whole cache stack (block
+hashing, KV events, prefix reuse, offload) end-to-end on TPU hardware and
+is the flagship model for benchmarks.
+"""
+
+from .llama import LlamaConfig, forward, init_params
+from .engine import MiniEngine, EngineConfig
+
+__all__ = ["LlamaConfig", "forward", "init_params", "MiniEngine", "EngineConfig"]

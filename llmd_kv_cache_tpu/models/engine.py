@@ -1,0 +1,472 @@
+"""Mini TPU serving engine: paged KV cache + prefix caching + KV events.
+
+The in-tree stand-in for vLLM-TPU. One engine instance ≙ one "pod": it
+manages a physical page pool with content-addressed prefix caching (block
+hashes computed by the same ``ChunkedTokenDatabase`` as the indexer, so
+engine keys ARE canonical keys — a 1:1 mapping), runs prefill/decode steps
+on the paged Llama model, and emits BlockStored / BlockRemoved /
+AllBlocksCleared events exactly like a real engine would, either to a ZMQ
+publisher or to any callback.
+
+Prefix caching semantics (mirroring vLLM's): on admission the prompt's
+full blocks are hashed along the chain; the longest prefix of blocks
+already resident is *reused* — those pages are attached to the new request
+and their tokens are never recomputed, which is where the TTFT win comes
+from. Evictions are LRU over unreferenced pages and emit BlockRemoved.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.keys import EMPTY_BLOCK_HASH
+from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
+from ..events.model import (
+    AllBlocksClearedEvent,
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    GenericEvent,
+)
+from ..utils.logging import get_logger
+from .llama import LlamaConfig, forward, init_kv_cache, init_params
+
+logger = get_logger("models.engine")
+
+EventSink = Callable[[list[GenericEvent]], None]
+
+
+@dataclass
+class EngineConfig:
+    model: LlamaConfig = field(default_factory=LlamaConfig.tiny)
+    num_pages: int = 512
+    max_pages_per_seq: int = 64
+    max_batch: int = 8
+    hash_seed: str = ""
+    model_name: str = "tiny-llama"
+    pod_identifier: str = "pod-0"
+
+
+@dataclass
+class _BlockInfo:
+    page: int
+    ref_count: int = 0
+    last_used: float = 0.0
+    parent_hash: int = 0
+    tokens: tuple[int, ...] = ()
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt: list[int]
+    max_new_tokens: int
+    # runtime state
+    output: list[int] = field(default_factory=list)
+    pages: list[int] = field(default_factory=list)  # physical pages, logical order
+    block_hashes: list[int] = field(default_factory=list)  # hash-chained, per full block
+    cached_len: int = 0  # tokens skipped via prefix cache at admission
+    computed_len: int = 0  # tokens with KV resident (cached + prefilled + decoded)
+    last_logits: Optional[np.ndarray] = None
+    done: bool = False
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+
+class BlockManager:
+    """Physical page pool with content-addressed prefix caching.
+
+    Page 0 is the reserved garbage page (see ``ops.kv_pages``). Full blocks
+    are indexed by chain hash; unreferenced pages stay cached until LRU
+    eviction reclaims them.
+    """
+
+    def __init__(self, cfg: EngineConfig, processor: ChunkedTokenDatabase,
+                 event_sink: Optional[EventSink] = None):
+        self.cfg = cfg
+        self.processor = processor
+        self.event_sink = event_sink
+        self.free_pages: list[int] = list(range(1, cfg.num_pages))  # 0 reserved
+        self.blocks: dict[int, _BlockInfo] = {}  # block_hash → info
+        self.page_to_hash: dict[int, int] = {}
+
+    # -- accounting --
+
+    def num_free(self) -> int:
+        return len(self.free_pages)
+
+    def num_cached_blocks(self) -> int:
+        return len(self.blocks)
+
+    def _emit(self, events: list[GenericEvent]) -> None:
+        if self.event_sink is not None and events:
+            self.event_sink(events)
+
+    # -- prefix cache --
+
+    def match_prefix(self, block_hashes: Sequence[int]) -> list[int]:
+        """Longest resident prefix: returns the pages for matched blocks."""
+        pages = []
+        for h in block_hashes:
+            info = self.blocks.get(h)
+            if info is None:
+                break
+            pages.append(info.page)
+        return pages
+
+    def acquire_prefix(self, block_hashes: Sequence[int]) -> list[int]:
+        """Reference the longest resident prefix; bumps ref counts."""
+        pages = self.match_prefix(block_hashes)
+        now = time.monotonic()
+        for h in block_hashes[: len(pages)]:
+            info = self.blocks[h]
+            info.ref_count += 1
+            info.last_used = now
+        return pages
+
+    def allocate_page(self) -> Optional[int]:
+        """Pop a free page, evicting LRU unreferenced blocks if needed."""
+        if not self.free_pages and not self._evict_one():
+            return None
+        return self.free_pages.pop()
+
+    def _evict_one(self) -> bool:
+        victim_hash = None
+        victim_time = float("inf")
+        for h, info in self.blocks.items():
+            if info.ref_count == 0 and info.last_used < victim_time:
+                victim_time = info.last_used
+                victim_hash = h
+        if victim_hash is None:
+            return False
+        info = self.blocks.pop(victim_hash)
+        self.page_to_hash.pop(info.page, None)
+        self.free_pages.append(info.page)
+        self._emit([BlockRemovedEvent(block_hashes=[victim_hash])])
+        return True
+
+    def commit_blocks(
+        self,
+        block_hashes: Sequence[int],
+        pages: Sequence[int],
+        tokens_per_block: Sequence[Sequence[int]],
+        parent_of_first: int,
+    ) -> list[int]:
+        """Register newly computed full blocks in the prefix cache.
+
+        Returns the canonical page per block: when a block's content is
+        already resident (recomputed duplicate), the existing page wins and
+        the redundant page is freed — the KV bytes are identical.
+
+        Emits one BlockStored event per *contiguous run* of newly stored
+        blocks, each with its own correct parent hash, so the indexer's
+        chained request-key recomputation never spans a gap (a duplicate in
+        the middle must not fuse two runs into one false chain).
+        """
+        now = time.monotonic()
+        canonical_pages: list[int] = []
+        events: list[GenericEvent] = []
+        run_hashes: list[int] = []
+        run_tokens: list[int] = []
+        run_parent = parent_of_first
+        parent = parent_of_first
+
+        def flush_run():
+            nonlocal run_hashes, run_tokens
+            if run_hashes:
+                events.append(
+                    BlockStoredEvent(
+                        block_hashes=list(run_hashes),
+                        tokens=list(run_tokens),
+                        parent_hash=run_parent,
+                        block_size=self.processor.block_size,
+                    )
+                )
+            run_hashes, run_tokens = [], []
+
+        for h, page, toks in zip(block_hashes, pages, tokens_per_block):
+            existing = self.blocks.get(h)
+            if existing is None:
+                self.blocks[h] = _BlockInfo(
+                    page=page, ref_count=1, last_used=now,
+                    parent_hash=parent, tokens=tuple(toks),
+                )
+                self.page_to_hash[page] = h
+                if not run_hashes:
+                    run_parent = parent
+                run_hashes.append(h)
+                run_tokens.extend(toks)
+                canonical_pages.append(page)
+            else:
+                # Recomputed duplicate: adopt the resident page, free ours.
+                existing.ref_count += 1
+                existing.last_used = now
+                if page != existing.page:
+                    self.free_pages.append(page)
+                canonical_pages.append(existing.page)
+                flush_run()
+            parent = h
+        flush_run()
+        self._emit(events)
+        return canonical_pages
+
+    def release(self, block_hashes: Sequence[int], orphan_pages: Sequence[int]) -> None:
+        """Drop a finished request's references; free unhashed pages."""
+        for h in block_hashes:
+            info = self.blocks.get(h)
+            if info is not None and info.ref_count > 0:
+                info.ref_count -= 1
+        self.free_pages.extend(orphan_pages)
+
+    def clear(self) -> None:
+        """Drop the whole prefix cache (weight rollout) and emit the reset."""
+        for info in self.blocks.values():
+            self.free_pages.append(info.page)
+        self.blocks.clear()
+        self.page_to_hash.clear()
+        self._emit([AllBlocksClearedEvent()])
+
+
+class MiniEngine:
+    """Single-pod batched serving engine over the paged Llama model."""
+
+    def __init__(
+        self,
+        cfg: Optional[EngineConfig] = None,
+        event_sink: Optional[EventSink] = None,
+        params=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg or EngineConfig()
+        mcfg = self.cfg.model
+        if self.cfg.max_pages_per_seq * self.cfg.max_batch > self.cfg.num_pages:
+            logger.warning("page pool smaller than worst-case demand; requests may stall")
+        self.processor = ChunkedTokenDatabase(
+            TokenProcessorConfig(
+                block_size_tokens=mcfg.page_size, hash_seed=self.cfg.hash_seed
+            )
+        )
+        self.block_manager = BlockManager(self.cfg, self.processor, event_sink)
+        self.params = params if params is not None else init_params(
+            jax.random.PRNGKey(seed), mcfg
+        )
+        self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
+        self.requests: dict[str, Request] = {}
+        self._running: list[str] = []
+
+    # -- admission --
+
+    def add_request(self, request_id: str, prompt: Sequence[int],
+                    max_new_tokens: int = 16) -> Request:
+        """Admit a request: acquire cached prefix pages, allocate the rest,
+        and run the prefill step for the uncached suffix."""
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        req = Request(request_id=request_id, prompt=prompt,
+                      max_new_tokens=max_new_tokens)
+        page_size = self.cfg.model.page_size
+        total_needed = (req.total_len + max_new_tokens + page_size - 1) // page_size + 1
+        if total_needed > self.cfg.max_pages_per_seq:
+            raise ValueError(
+                f"request needs {total_needed} pages "
+                f"(prompt {len(prompt)} + {max_new_tokens} new tokens) but "
+                f"max_pages_per_seq is {self.cfg.max_pages_per_seq}"
+            )
+        req.block_hashes = self.processor.tokens_to_kv_block_keys(
+            EMPTY_BLOCK_HASH, prompt, self.cfg.model_name
+        )
+
+        cached_pages = self.block_manager.acquire_prefix(req.block_hashes)
+        req.pages = list(cached_pages)
+        req.cached_len = len(cached_pages) * page_size
+        req.computed_len = req.cached_len
+
+        # Pages for the uncached remainder (incl. partial tail + decode room)
+        new_pages: list[int] = []
+        while len(req.pages) + len(new_pages) < total_needed:
+            page = self.block_manager.allocate_page()
+            if page is None:
+                # Roll back: return popped pages and drop the prefix refs so
+                # a failed admission cannot shrink the pool or pin blocks.
+                self.block_manager.free_pages.extend(new_pages)
+                self.block_manager.release(
+                    req.block_hashes[: len(cached_pages)], []
+                )
+                raise RuntimeError("out of KV pages")
+            new_pages.append(page)
+        req.pages.extend(new_pages)
+
+        self.requests[request_id] = req
+        self._running.append(request_id)
+
+        # Always compute at least the last prompt token (vLLM semantics: a
+        # full-prefix hit still recomputes one token to produce logits; the
+        # scatter rewrites identical KV into the shared page, which is
+        # benign).
+        self._prefill(req)
+        self._commit_full_blocks(req)
+        # Bootstrap decoding: the first generated token comes from the
+        # prefill step's final logits.
+        first_token = int(np.argmax(req.last_logits))
+        req.output.append(first_token)
+        if req.max_new_tokens <= 1:
+            req.done = True
+            self._finish(req)
+        return req
+
+    def _page_table_for(self, req: Request) -> np.ndarray:
+        table = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
+        table[: len(req.pages)] = req.pages
+        return table
+
+    def _prefill(self, req: Request) -> None:
+        """Run the model over the uncached prompt suffix in one step."""
+        page_size = self.cfg.model.page_size
+        start = min(req.cached_len, len(req.prompt) - 1)
+        suffix = req.prompt[start:]
+        # Bucket the padded length to powers of two (in pages) so the jit
+        # cache holds O(log max_seq) prefill shapes instead of one per
+        # suffix length — compiles are 20-40 s each on TPU.
+        pages_needed = max(1, (len(suffix) + page_size - 1) // page_size)
+        bucket = 1
+        while bucket < pages_needed:
+            bucket *= 2
+        seq = bucket * page_size
+        tokens = np.zeros((1, seq), np.int32)
+        tokens[0, : len(suffix)] = suffix
+
+        logits, self.k_cache, self.v_cache = forward(
+            self.params, self.cfg.model,
+            jnp.asarray(tokens),
+            self.k_cache, self.v_cache,
+            jnp.asarray(self._page_table_for(req))[None, :],
+            jnp.asarray([start], jnp.int32),
+            jnp.asarray([len(suffix)], jnp.int32),
+        )
+        req.computed_len = len(req.prompt)
+        req.last_logits = np.asarray(logits[0, len(suffix) - 1])
+
+    def _commit_full_blocks(self, req: Request) -> None:
+        """Register newly computed full prompt blocks in the prefix cache."""
+        page_size = self.cfg.model.page_size
+        n_full = len(req.prompt) // page_size
+        first_new = req.cached_len // page_size
+        if n_full <= first_new:
+            return
+        new_hashes = req.block_hashes[first_new:n_full]
+        new_pages = req.pages[first_new:n_full]
+        tokens_per_block = [
+            req.prompt[i * page_size:(i + 1) * page_size]
+            for i in range(first_new, n_full)
+        ]
+        parent = (
+            req.block_hashes[first_new - 1] if first_new > 0 else EMPTY_BLOCK_HASH
+        )
+        canonical = self.block_manager.commit_blocks(
+            new_hashes, new_pages, tokens_per_block, parent
+        )
+        # Adopt canonical pages (duplicates swapped to the resident copy).
+        req.pages[first_new:n_full] = canonical
+
+    # -- decode --
+
+    def step(self) -> dict[str, int]:
+        """One greedy decode step for every running request.
+
+        Returns {request_id: new_token}. Batched into a single jit call with
+        padding up to max_batch.
+        """
+        active = [self.requests[rid] for rid in self._running
+                  if not self.requests[rid].done]
+        emitted: dict[str, int] = {}
+        for chunk_start in range(0, len(active), self.cfg.max_batch):
+            chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
+            emitted.update(self._decode_chunk(chunk))
+        for rid in list(self._running):
+            req = self.requests[rid]
+            if req.done:
+                self._finish(req)
+        return emitted
+
+    def _finish(self, req: Request) -> None:
+        if req.request_id in self._running:
+            self._running.remove(req.request_id)
+        self._release(req)
+        # Drop the bookkeeping entry: callers keep the Request object they
+        # got from add_request; retaining every finished request would grow
+        # host memory unboundedly on a serving pod.
+        self.requests.pop(req.request_id, None)
+
+    def _decode_chunk(self, chunk: list[Request]) -> dict[str, int]:
+        # Pad to max_batch so decode compiles exactly once regardless of the
+        # active-request count; padded rows have new_lens=0 (all writes go
+        # to the garbage page, logits ignored).
+        b = self.cfg.max_batch
+        tokens = np.zeros((b, 1), np.int32)
+        ctx = np.zeros((b,), np.int32)
+        new_lens = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
+        for i, req in enumerate(chunk):
+            last = (req.output[-1] if req.output else req.prompt[-1])
+            tokens[i, 0] = last
+            # the last token's KV may not be computed yet when it came from
+            # sampling; positions: attend with context = computed_len
+            ctx[i] = req.computed_len
+            new_lens[i] = 1
+            tables[i] = self._page_table_for(req)
+
+        logits, self.k_cache, self.v_cache = forward(
+            self.params, self.cfg.model,
+            jnp.asarray(tokens), self.k_cache, self.v_cache,
+            jnp.asarray(tables),
+            jnp.asarray(ctx, jnp.int32),
+            jnp.asarray(new_lens),
+        )
+        out = {}
+        next_tokens = np.asarray(jnp.argmax(logits[:, 0], axis=-1))
+        for i, req in enumerate(chunk):
+            req.computed_len += 1
+            tok = int(next_tokens[i])
+            req.output.append(tok)
+            out[req.request_id] = tok
+            if len(req.output) >= req.max_new_tokens:
+                req.done = True
+        return out
+
+    def _release(self, req: Request) -> None:
+        page_size = self.cfg.model.page_size
+        n_hashed = min(len(req.prompt) // page_size, len(req.block_hashes))
+        hashed_pages = set(req.pages[:n_hashed])
+        orphans = [p for p in req.pages[n_hashed:] if p not in hashed_pages]
+        self.block_manager.release(req.block_hashes[:n_hashed], orphans)
+
+    # -- lifecycle --
+
+    def reset_cache(self) -> None:
+        """Drop all KV state (e.g. after a weight update).
+
+        In-flight requests are aborted and *released* first so their
+        unhashed pages (partial tail + decode room) return to the pool —
+        ``clear()`` only frees pages registered in the block map.
+        """
+        for rid in list(self._running):
+            req = self.requests[rid]
+            req.done = True
+            self._finish(req)
+        self.block_manager.clear()
+
+    def generate(self, request_id: str, prompt: Sequence[int],
+                 max_new_tokens: int = 16) -> list[int]:
+        """Convenience: admit one request and run it to completion."""
+        req = self.add_request(request_id, prompt, max_new_tokens)
+        while not req.done:
+            self.step()
+        return req.output

@@ -1,0 +1,168 @@
+"""Llama-family transformer with a paged KV cache, TPU-first.
+
+Pure-functional JAX: parameters are a pytree, the forward step is a single
+jit with static shapes (padded token blocks + masks, no data-dependent
+Python control flow), bfloat16 activations/weights with float32 softmax and
+norms. RoPE, RMSNorm, SwiGLU, grouped-query attention.
+
+One ``forward`` serves prefill and decode: queries at logical positions
+``ctx_lens + i`` attend to everything already in the paged cache plus
+themselves. The cache update (scatter) happens inside the jit so the whole
+token step is one XLA program; donate the caches for in-place updates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.kv_pages import scatter_kv_pages
+from ..ops.paged_attention import paged_attention
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 64
+    intermediate_size: int = 1408
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    page_size: int = 16
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        """Test-sized config (fast CPU compile)."""
+        return cls(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+        )
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialize parameters (truncated-normal projections, ones norms)."""
+    n_keys = 2 + cfg.num_layers
+    keys = jax.random.split(key, n_keys)
+    dt = cfg.dtype
+    h, hd = cfg.hidden_size, cfg.head_dim
+
+    def dense(k, shape, scale=0.02):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * scale).astype(dt)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(keys[2 + i], 7)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((h,), jnp.float32),
+                "wq": dense(lk[0], (h, cfg.num_heads * hd)),
+                "wk": dense(lk[1], (h, cfg.num_kv_heads * hd)),
+                "wv": dense(lk[2], (h, cfg.num_kv_heads * hd)),
+                "wo": dense(lk[3], (cfg.num_heads * hd, h)),
+                "mlp_norm": jnp.ones((h,), jnp.float32),
+                "w_gate": dense(lk[4], (h, cfg.intermediate_size)),
+                "w_up": dense(lk[5], (h, cfg.intermediate_size)),
+                "w_down": dense(lk[6], (cfg.intermediate_size, h)),
+            }
+        )
+
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, h), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), jnp.float32),
+        "lm_head": dense(keys[1], (h, cfg.vocab_size)),
+    }
+
+
+def init_kv_cache(cfg: LlamaConfig, num_pages: int) -> tuple[jax.Array, jax.Array]:
+    """Allocate the paged K and V pools: ``[layers, pages, page, kvh, hd]``."""
+    shape = (cfg.num_layers, num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: [b, s, heads, hd], positions: [b, s]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [batch, seq] int32 (padded)
+    k_cache: jax.Array,  # [layers, pages, page_size, kvh, hd] (donated)
+    v_cache: jax.Array,  # same (donated)
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+    ctx_lens: jax.Array,  # [batch] tokens already cached before this call
+    new_lens: jax.Array,  # [batch] valid new tokens in `tokens`
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One model step (prefill or decode).
+
+    Returns ``(logits [b, seq, vocab], k_cache, v_cache)``. Query i of
+    sequence b sits at logical position ``ctx_lens[b] + i``; padded
+    positions (``i >= new_lens[b]``) are masked and scatter to the garbage
+    page.
+    """
+    batch, seq = tokens.shape
+    positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
+    valid = jnp.arange(seq)[None, :] < new_lens[:, None]
+    total_lens = ctx_lens + new_lens
+
+    x = params["embed"][tokens]  # [b, s, h]
+
+    for li, layer in enumerate(params["layers"]):
+        attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = attn_in @ layer["wq"]
+        k = attn_in @ layer["wk"]
+        v = attn_in @ layer["wv"]
+        q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        k_cache = k_cache.at[li].set(
+            scatter_kv_pages(k_cache[li], k, page_table, positions, valid)
+        )
+        v_cache = v_cache.at[li].set(
+            scatter_kv_pages(v_cache[li], v, page_table, positions, valid)
+        )
+
+        attn = paged_attention(
+            q, k_cache[li], v_cache[li], page_table, positions, total_lens
+        )
+        x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
+
+        mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
+        up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
+        x = x + ((gate * up).astype(x.dtype) @ layer["w_down"])
+
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, k_cache, v_cache

@@ -1,0 +1,10 @@
+"""TPU compute ops: paged attention and KV-page gather/scatter.
+
+The XLA-level (jnp) implementations are the portable reference path (they
+run on the CPU backend in tests); Pallas kernels provide the TPU fast path.
+"""
+
+from .paged_attention import paged_attention
+from .kv_pages import gather_kv_pages, scatter_kv_pages
+
+__all__ = ["paged_attention", "gather_kv_pages", "scatter_kv_pages"]

@@ -1,0 +1,77 @@
+"""Paged KV-cache page scatter/gather.
+
+The paged cache is the TPU-native analogue of vLLM's block tables: one
+physical pool of pages per layer, shape ``[num_pages, page_size, kv_heads,
+head_dim]``, addressed through per-sequence page tables. Everything here is
+shape-static and jit-safe: padded positions are routed to a reserved
+garbage page (page 0) instead of branching.
+
+These ops are also the heart of the offload data plane: ``gather_kv_pages``
+is what assembles the contiguous block that gets DMA'd to pinned host
+memory (the role ``tensor_copier.cu`` plays in the reference — see
+SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Physical page 0 is reserved as the write target for padded/invalid
+# positions so scatters need no data-dependent control flow.
+GARBAGE_PAGE = 0
+
+
+def scatter_kv_pages(
+    cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    new_kv: jax.Array,  # [batch, seq, kv_heads, head_dim]
+    page_table: jax.Array,  # [batch, pages_per_seq] int32 (physical page ids)
+    positions: jax.Array,  # [batch, seq] int32 logical positions
+    valid: jax.Array,  # [batch, seq] bool
+) -> jax.Array:
+    """Write new K or V vectors into their pages; returns the updated cache.
+
+    Invalid slots scatter into the garbage page. Donate ``cache`` under jit
+    for an in-place update.
+    """
+    num_pages, page_size, kv_heads, head_dim = cache.shape
+    # Clamp: padded positions can point past the page table (their writes
+    # are redirected to the garbage page below anyway).
+    logical_page = jnp.minimum(positions // page_size, page_table.shape[1] - 1)
+    slot = positions % page_size
+    phys_page = jnp.take_along_axis(page_table, logical_page, axis=1)
+    flat_idx = phys_page * page_size + slot  # [batch, seq]
+    flat_idx = jnp.where(valid, flat_idx, GARBAGE_PAGE * page_size)
+
+    cache_flat = cache.reshape(num_pages * page_size, kv_heads, head_dim)
+    cache_flat = cache_flat.at[flat_idx].set(
+        new_kv.astype(cache.dtype), mode="drop", unique_indices=False
+    )
+    return cache_flat.reshape(num_pages, page_size, kv_heads, head_dim)
+
+
+def gather_kv_pages(
+    cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+) -> jax.Array:
+    """Gather each sequence's pages into logical order.
+
+    Returns ``[batch, pages_per_seq * page_size, kv_heads, head_dim]``.
+    """
+    batch, pages_per_seq = page_table.shape
+    _, page_size, kv_heads, head_dim = cache.shape
+    gathered = cache[page_table]  # [batch, pages_per_seq, page_size, kv, hd]
+    return gathered.reshape(batch, pages_per_seq * page_size, kv_heads, head_dim)
+
+
+def gather_pages_flat(
+    cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    page_ids: jax.Array,  # [n] int32 physical page ids
+) -> jax.Array:
+    """Gather arbitrary physical pages into one contiguous block.
+
+    The offload store path: selected pages → a contiguous
+    ``[n, page_size, kv_heads, head_dim]`` slab ready for a device→host
+    transfer.
+    """
+    return cache[page_ids]

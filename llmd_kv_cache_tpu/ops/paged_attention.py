@@ -1,0 +1,68 @@
+"""Paged attention over a page-table-addressed KV cache.
+
+XLA-level implementation: gathers each sequence's pages into logical order
+and runs masked multi-head attention. Shapes are static; ragged sequence
+lengths are handled with masks, so the whole op stays inside one jit and
+XLA tiles the matmuls onto the MXU. Works for both prefill (seq > 1,
+queries appended after a cached prefix) and decode (seq == 1).
+
+A Pallas flash-decode kernel (``pallas_paged_attention``, double-buffered
+page DMA + online softmax) is the TPU fast path for long contexts where
+materializing the gathered KV would be HBM-wasteful.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .kv_pages import gather_kv_pages
+
+_NEG_INF = -1e30
+
+
+def paged_attention(
+    q: jax.Array,  # [batch, q_seq, q_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    v_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+    q_positions: jax.Array,  # [batch, q_seq] logical position of each query
+    total_lens: jax.Array,  # [batch] total tokens (context + new) per sequence
+    scale: float | None = None,
+) -> jax.Array:
+    """Causal attention of new queries against paged KV (cached + new).
+
+    The KV for the new tokens must already be scattered into the cache.
+    Returns ``[batch, q_seq, q_heads, head_dim]`` in the query dtype.
+    """
+    batch, q_seq, q_heads, head_dim = q.shape
+    _, page_size, kv_heads, _ = k_cache.shape
+    if scale is None:
+        scale = head_dim ** -0.5
+
+    k = gather_kv_pages(k_cache, page_table)  # [b, kv_len, kvh, hd]
+    v = gather_kv_pages(v_cache, page_table)
+    kv_len = k.shape[1]
+
+    # Grouped-query attention: repeat KV heads across the query-head groups.
+    if q_heads != kv_heads:
+        group = q_heads // kv_heads
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # [b, heads, q_seq, kv_len]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+
+    k_pos = jnp.arange(kv_len)[None, None, None, :]  # logical key positions
+    q_pos = q_positions[:, None, :, None]
+    causal = k_pos <= q_pos
+    in_bounds = k_pos < total_lens[:, None, None, None]
+    logits = jnp.where(causal & in_bounds, logits, _NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(q.dtype)

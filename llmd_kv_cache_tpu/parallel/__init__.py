@@ -1,0 +1,18 @@
+"""Distributed execution: device meshes, shardings, parallel train/infer steps."""
+
+from .mesh import (
+    make_mesh,
+    mesh_fingerprint_fields,
+    param_pspecs,
+    shard_params,
+)
+from .train import make_train_state, train_step
+
+__all__ = [
+    "make_mesh",
+    "mesh_fingerprint_fields",
+    "param_pspecs",
+    "shard_params",
+    "make_train_state",
+    "train_step",
+]

@@ -8,7 +8,9 @@ Environment must be set before the first ``jax`` import, hence module level.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the environment pins JAX_PLATFORMS to the TPU
+# tunnel plugin, which would silently route "CPU" tests onto the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,224 @@
+"""Mini serving engine tests: prefix caching, events, e2e indexer loop."""
+
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.events.model import (
+    AllBlocksClearedEvent,
+    BlockRemovedEvent,
+    BlockStoredEvent,
+    EventBatch,
+)
+from llmd_kv_cache_tpu.events.pool import Pool, PoolConfig
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+
+
+def make_engine(events=None, pod="pod-0", seed=0, num_pages=64):
+    sink = events.append if events is not None else None
+
+    def sink_batch(evs):
+        events.extend(evs)
+
+    return MiniEngine(
+        EngineConfig(
+            model=LlamaConfig.tiny(),
+            num_pages=num_pages,
+            max_pages_per_seq=16,
+            model_name="tiny",
+            pod_identifier=pod,
+        ),
+        event_sink=sink_batch if events is not None else None,
+        seed=seed,
+    )
+
+
+PAGE = LlamaConfig.tiny().page_size  # 4
+
+
+class TestPrefixCache:
+    def test_second_request_hits_prefix(self):
+        engine = make_engine()
+        prompt = list(range(50, 66))  # 4 full blocks
+        r1 = engine.add_request("r1", prompt, max_new_tokens=1)
+        assert r1.cached_len == 0
+        r2 = engine.add_request("r2", prompt, max_new_tokens=1)
+        assert r2.cached_len == len(prompt)  # full-prefix hit
+        # shares the same physical pages
+        assert r2.pages[:4] == r1.pages[:4]
+
+    def test_partial_prefix_hit(self):
+        engine = make_engine()
+        engine.add_request("r1", list(range(50, 62)), max_new_tokens=1)  # 3 blocks
+        r2 = engine.add_request("r2", list(range(50, 58)) + [99, 98, 97, 96],
+                                max_new_tokens=1)
+        assert r2.cached_len == 8  # first 2 blocks shared
+
+    def test_cache_hit_same_output(self):
+        """Prefix-cached generation must produce identical tokens."""
+        cold = make_engine()
+        prompt = list(range(30, 46))
+        out_cold = cold.generate("c", prompt, max_new_tokens=4)
+
+        warm = make_engine()
+        warm.add_request("w0", prompt, max_new_tokens=1)
+        warm.step()
+        req = warm.add_request("w1", prompt, max_new_tokens=4)
+        assert req.cached_len > 0
+        while not req.done:
+            warm.step()
+        assert req.output == out_cold
+
+    def test_generation_is_deterministic(self):
+        a = make_engine().generate("a", list(range(20, 36)), max_new_tokens=4)
+        b = make_engine().generate("b", list(range(20, 36)), max_new_tokens=4)
+        assert a == b
+
+
+class TestEvents:
+    def test_block_stored_emitted_with_tokens_and_parent(self):
+        events = []
+        engine = make_engine(events)
+        prompt = list(range(50, 62))  # 3 full blocks
+        req = engine.add_request("r1", prompt, max_new_tokens=1)
+        stored = [e for e in events if isinstance(e, BlockStoredEvent)]
+        assert len(stored) == 1
+        ev = stored[0]
+        assert ev.block_hashes == req.block_hashes
+        assert ev.tokens == prompt
+        assert ev.parent_hash == 0
+        assert ev.block_size == PAGE
+
+    def test_engine_hashes_are_canonical(self):
+        """Engine block hashes == indexer request keys (1:1 dual keys)."""
+        events = []
+        engine = make_engine(events)
+        prompt = list(range(70, 82))
+        engine.add_request("r1", prompt, max_new_tokens=1)
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=PAGE))
+        expected = processor.tokens_to_kv_block_keys(0, prompt, "tiny")
+        stored = [e for e in events if isinstance(e, BlockStoredEvent)][0]
+        assert stored.block_hashes == expected
+
+    def test_eviction_emits_block_removed(self):
+        events = []
+        # page pool too small for three distinct 3-block prompts + decode room
+        engine = make_engine(events, num_pages=10)
+        engine.generate("r1", list(range(100, 112)), max_new_tokens=1)
+        engine.generate("r2", list(range(200, 212)), max_new_tokens=1)
+        engine.generate("r3", list(range(300, 312)), max_new_tokens=1)
+        removed = [e for e in events if isinstance(e, BlockRemovedEvent)]
+        assert removed, "LRU eviction under page pressure must emit BlockRemoved"
+
+    def test_reset_emits_all_blocks_cleared(self):
+        events = []
+        engine = make_engine(events)
+        engine.generate("r1", list(range(30, 42)), max_new_tokens=1)
+        engine.reset_cache()
+        assert any(isinstance(e, AllBlocksClearedEvent) for e in events)
+        assert engine.block_manager.num_cached_blocks() == 0
+
+
+class TestPageAccounting:
+    def test_oversized_request_rejected(self):
+        engine = make_engine()
+        with pytest.raises(ValueError, match="max_pages_per_seq"):
+            engine.add_request("big", list(range(1000)), max_new_tokens=1)
+
+    def test_out_of_pages_rolls_back(self):
+        engine = make_engine(num_pages=8)  # 7 usable pages
+        free_before = engine.block_manager.num_free()
+        # needs (12+8+3)//4+1 = 6 pages < 7 → first fits
+        engine.add_request("r1", list(range(100, 112)), max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="out of KV pages"):
+            engine.add_request("r2", list(range(200, 212)), max_new_tokens=8)
+        # finish r1; its pages and prefix refs must all come back
+        while engine._running:
+            engine.step()
+        # all blocks unreferenced → evictable; free + cached pages == pool
+        cached_pages = engine.block_manager.num_cached_blocks()
+        assert engine.block_manager.num_free() + cached_pages == free_before
+        assert all(
+            info.ref_count == 0 for info in engine.block_manager.blocks.values()
+        )
+
+    def test_reset_with_inflight_requests_frees_all_pages(self):
+        engine = make_engine()
+        free_before = engine.block_manager.num_free()
+        engine.add_request("r1", list(range(100, 112)), max_new_tokens=8)
+        engine.reset_cache()  # abort mid-flight
+        assert engine.block_manager.num_free() == free_before
+        assert not engine._running
+
+    def test_finished_requests_are_dropped(self):
+        engine = make_engine()
+        engine.generate("r1", list(range(30, 42)), max_new_tokens=2)
+        assert "r1" not in engine.requests
+
+    def test_duplicate_block_commit_returns_canonical_page(self):
+        """Two engines' worth of the same content on one engine: committing
+        an already-resident block must adopt the resident page and free the
+        duplicate, with no net page loss."""
+        engine = make_engine()
+        free0 = engine.block_manager.num_free()
+        prompt = list(range(80, 92))
+        r1 = engine.add_request("a", prompt, max_new_tokens=1)
+        # capture resident pages, then force recompute by evicting nothing:
+        # a second identical request takes the cached path; instead commit
+        # manually with fresh pages to exercise the duplicate branch.
+        bm = engine.block_manager
+        dup_pages = [bm.allocate_page() for _ in range(len(r1.block_hashes))]
+        tokens_per_block = [prompt[i * PAGE:(i + 1) * PAGE]
+                            for i in range(len(r1.block_hashes))]
+        canonical = bm.commit_blocks(r1.block_hashes, dup_pages,
+                                     tokens_per_block, 0)
+        assert canonical == [bm.blocks[h].page for h in r1.block_hashes]
+        for p in dup_pages:
+            assert p in bm.free_pages  # redundant copies freed
+        bm.release(r1.block_hashes, [])  # drop the extra refs we created
+        # net: no leak (free + one page per cached block == initial free)
+        assert bm.num_free() + bm.num_cached_blocks() == free0
+
+
+class TestEngineIndexerLoop:
+    def test_events_flow_to_scores(self):
+        """The full loop: engine emits events → pool ingests → indexer
+        scores the pod for a prompt it has cached."""
+        indexer = Indexer(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size_tokens=PAGE)
+            ),
+            index=InMemoryIndex(InMemoryIndexConfig(size=10_000)),
+        )
+        pool = Pool(PoolConfig(concurrency=1), indexer.kv_block_index,
+                    indexer.token_processor)
+
+        engines = {}
+        for pod in ("pod-a", "pod-b"):
+            events = []
+            engine = make_engine(events, pod=pod)
+            engines[pod] = (engine, events)
+
+        shared_prefix = list(range(10, 26))  # 4 blocks
+        engines["pod-a"][0].generate("r1", shared_prefix + [77, 78, 79, 80],
+                                     max_new_tokens=1)
+        engines["pod-b"][0].generate("r2", shared_prefix, max_new_tokens=1)
+
+        for pod, (engine, events) in engines.items():
+            pool.process_event_batch(EventBatch(timestamp=0.0, events=events), pod, "tiny")
+
+        scores = indexer.score_tokens(shared_prefix + [77, 78, 79, 80], "tiny")
+        assert scores["pod-a"] == 5.0  # all 5 blocks
+        assert scores["pod-b"] == 4.0  # shared prefix only
+
+        # eviction/reset propagates
+        engines["pod-b"][1].clear()
+        engines["pod-b"][0].reset_cache()
+        pool.process_event_batch(
+            EventBatch(timestamp=1.0, events=engines["pod-b"][1]), "pod-b", "tiny"
+        )
+        scores = indexer.score_tokens(shared_prefix, "tiny")
+        assert "pod-b" not in scores
