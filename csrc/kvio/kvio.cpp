@@ -1,0 +1,425 @@
+// Implementation of the KV offload I/O engine + C ABI for ctypes.
+// See kvio.hpp for design notes and reference parity table.
+
+#include "kvio.hpp"
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <unistd.h>
+#include <utime.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+namespace kvio {
+
+namespace {
+
+double NowSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool MakeParentDirs(const std::string& path) {
+  std::string dir = path;
+  size_t pos = dir.find_last_of('/');
+  if (pos == std::string::npos) return true;
+  dir.resize(pos);
+  std::string partial;
+  size_t start = 0;
+  if (!dir.empty() && dir[0] == '/') {
+    partial = "/";
+    start = 1;
+  }
+  while (start <= dir.size()) {
+    size_t next = dir.find('/', start);
+    if (next == std::string::npos) next = dir.size();
+    partial.append(dir, start, next - start);
+    if (!partial.empty() && partial != "/") {
+      if (mkdir(partial.c_str(), 0777) != 0 && errno != EEXIST) return false;
+    }
+    partial.push_back('/');
+    start = next + 1;
+  }
+  return true;
+}
+
+// Atomic write: temp file + rename so readers never observe partial files
+// (the reference's FileIO discipline, file_io.cpp:44-108).
+bool WriteFileAtomic(const std::string& final_path, const std::string& tmp_path,
+                     const uint8_t* data, uint64_t len, bool skip_if_exists) {
+  if (skip_if_exists) {
+    struct stat st;
+    if (stat(final_path.c_str(), &st) == 0) {
+      // Idempotent store: refresh atime as an eviction-recency signal
+      // (storage_offload.cpp:317-320 equivalent).
+      utime(final_path.c_str(), nullptr);
+      return true;
+    }
+  }
+  if (!MakeParentDirs(final_path)) return false;
+
+  int fd = open(tmp_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  uint64_t written = 0;
+  while (written < len) {
+    ssize_t n = write(fd, data + written, len - written);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      unlink(tmp_path.c_str());
+      return false;
+    }
+    written += static_cast<uint64_t>(n);
+  }
+  if (close(fd) != 0) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  if (rename(tmp_path.c_str(), final_path.c_str()) != 0) {
+    unlink(tmp_path.c_str());
+    return false;
+  }
+  return true;
+}
+
+bool ReadFileRange(const std::string& path, uint8_t* dst, uint64_t len,
+                   uint64_t offset) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = pread(fd, dst + done, len - done,
+                      static_cast<off_t>(offset + done));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      return false;
+    }
+    if (n == 0) break;  // short file
+    done += static_cast<uint64_t>(n);
+  }
+  close(fd);
+  // Refresh atime so the evictor's recency scan sees the hit.
+  utime(path.c_str(), nullptr);
+  return done == len;
+}
+
+}  // namespace
+
+Engine::Engine(int num_threads, int read_preferring_workers,
+               double max_write_queued_seconds)
+    : num_threads_(num_threads > 0 ? num_threads : 1),
+      read_preferring_workers_(read_preferring_workers),
+      max_write_queued_seconds_(max_write_queued_seconds) {
+  workers_.reserve(num_threads_);
+  for (int i = 0; i < num_threads_; ++i) {
+    workers_.emplace_back([this, i] { WorkerLoop(i); });
+  }
+}
+
+Engine::~Engine() { Shutdown(); }
+
+void Engine::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutdown_) return;
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : workers_) {
+    if (t.joinable()) t.join();
+  }
+  std::lock_guard<std::mutex> jl(jobs_mu_);
+  for (auto& [id, job] : jobs_) delete job;
+  jobs_.clear();
+}
+
+uint64_t Engine::BeginJob() {
+  uint64_t id = next_job_id_.fetch_add(1);
+  auto* job = new JobState();
+  job->id = id;
+  std::lock_guard<std::mutex> lk(jobs_mu_);
+  jobs_[id] = job;
+  return id;
+}
+
+void Engine::SealJob(uint64_t job_id) {
+  std::lock_guard<std::mutex> lk(jobs_mu_);
+  auto it = jobs_.find(job_id);
+  if (it == jobs_.end()) return;
+  it->second->sealed.store(true);
+  JobState* job = it->second;
+  if (job->completed.load() + job->failed.load() == job->total.load()) {
+    finished_ready_.push_back(job_id);
+    jobs_cv_.notify_all();
+  }
+}
+
+int Engine::QueuedWrites() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return static_cast<int>(normal_queue_.size());
+}
+
+int Engine::SubmitWrite(uint64_t job_id, const std::string& path,
+                        const std::string& tmp_path, const void* data,
+                        uint64_t len, bool skip_if_exists) {
+  // Dynamic write-queue limit: don't queue more write-seconds than the
+  // pool can retire within max_write_queued_seconds (the reference's
+  // EMA shedding, storage_offload.cpp:80-108,283-299). Dropped writes
+  // degrade to cache misses later, never to data loss.
+  double avg = avg_write_seconds_.load();
+  if (avg > 0 && max_write_queued_seconds_ > 0) {
+    double limit = num_threads_ * max_write_queued_seconds_ / avg;
+    if (QueuedWrites() >= static_cast<int>(limit)) {
+      return 0;
+    }
+  }
+
+  Task task;
+  task.kind = TaskKind::kWrite;
+  task.job_id = job_id;
+  task.path = path;
+  task.tmp_path = tmp_path;
+  task.src = static_cast<const uint8_t*>(data);
+  task.len = len;
+  task.skip_if_exists = skip_if_exists;
+
+  {
+    std::lock_guard<std::mutex> jl(jobs_mu_);
+    auto it = jobs_.find(job_id);
+    if (it != jobs_.end()) it->second->total.fetch_add(1);
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    normal_queue_.push_back(std::move(task));
+  }
+  cv_.notify_one();
+  return 1;
+}
+
+void Engine::SubmitRead(uint64_t job_id, const std::string& path, void* dst,
+                        uint64_t len, uint64_t offset) {
+  Task task;
+  task.kind = TaskKind::kRead;
+  task.job_id = job_id;
+  task.path = path;
+  task.dst = static_cast<uint8_t*>(dst);
+  task.len = len;
+  task.offset = offset;
+
+  {
+    std::lock_guard<std::mutex> jl(jobs_mu_);
+    auto it = jobs_.find(job_id);
+    if (it != jobs_.end()) it->second->total.fetch_add(1);
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    high_queue_.push_back(std::move(task));
+  }
+  cv_.notify_one();
+}
+
+void Engine::WorkerLoop(int worker_index) {
+  // The first read_preferring_workers_ drain the high (read) queue first;
+  // the rest prefer writes but steal reads when idle (thread_pool.cpp:44-61
+  // equivalent).
+  const bool prefer_reads = worker_index < read_preferring_workers_;
+  for (;;) {
+    Task task;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] {
+        return shutdown_ || !high_queue_.empty() || !normal_queue_.empty();
+      });
+      if (shutdown_ && high_queue_.empty() && normal_queue_.empty()) return;
+      std::deque<Task>* first = prefer_reads ? &high_queue_ : &normal_queue_;
+      std::deque<Task>* second = prefer_reads ? &normal_queue_ : &high_queue_;
+      std::deque<Task>* src_q = !first->empty() ? first : second;
+      task = std::move(src_q->front());
+      src_q->pop_front();
+    }
+
+    bool cancelled = false;
+    {
+      std::lock_guard<std::mutex> jl(jobs_mu_);
+      auto it = jobs_.find(task.job_id);
+      if (it != jobs_.end() && it->second->cancelled.load()) cancelled = true;
+    }
+    bool ok = cancelled ? false : RunTask(task);
+    FinishTask(task, ok);
+  }
+}
+
+bool Engine::RunTask(Task& task) {
+  double start = NowSeconds();
+  bool ok;
+  if (task.kind == TaskKind::kWrite) {
+    ok = WriteFileAtomic(task.path, task.tmp_path, task.src, task.len,
+                         task.skip_if_exists);
+    double dur = NowSeconds() - start;
+    double prev = avg_write_seconds_.load();
+    avg_write_seconds_.store(prev == 0.0 ? dur : 0.8 * prev + 0.2 * dur);
+  } else {
+    ok = ReadFileRange(task.path, task.dst, task.len, task.offset);
+  }
+  return ok;
+}
+
+void Engine::FinishTask(const Task& task, bool ok) {
+  std::lock_guard<std::mutex> jl(jobs_mu_);
+  auto it = jobs_.find(task.job_id);
+  if (it == jobs_.end()) return;
+  JobState* job = it->second;
+  if (ok) {
+    job->completed.fetch_add(1);
+    job->bytes.fetch_add(task.len);
+  } else {
+    job->failed.fetch_add(1);
+  }
+  if (job->sealed.load() &&
+      job->completed.load() + job->failed.load() == job->total.load()) {
+    finished_ready_.push_back(job->id);
+    jobs_cv_.notify_all();
+  }
+}
+
+int Engine::PollFinished(uint64_t* ids, int* statuses, int max_items) {
+  std::lock_guard<std::mutex> jl(jobs_mu_);
+  int n = 0;
+  while (n < max_items && !finished_ready_.empty()) {
+    uint64_t id = finished_ready_.back();
+    finished_ready_.pop_back();
+    auto it = jobs_.find(id);
+    if (it == jobs_.end()) continue;
+    JobState* job = it->second;
+    ids[n] = id;
+    if (job->cancelled.load()) {
+      statuses[n] = kCancelled;
+    } else {
+      statuses[n] = job->failed.load() > 0 ? kIoError : kOk;
+    }
+    delete job;
+    jobs_.erase(it);
+    ++n;
+  }
+  return n;
+}
+
+int Engine::WaitJob(uint64_t job_id, double timeout_seconds) {
+  // Cancellation-for-preemption: mark cancelled so queued tasks are skipped,
+  // then wait for in-flight ones (storage_offload.cpp:217-236 equivalent).
+  {
+    std::lock_guard<std::mutex> jl(jobs_mu_);
+    auto it = jobs_.find(job_id);
+    if (it == jobs_.end()) return kOk;  // already finished+polled
+    JobState* job = it->second;
+    if (job->sealed.load() &&
+        job->completed.load() + job->failed.load() == job->total.load()) {
+      // Finished before the wait: report the real outcome, don't cancel.
+      int status = job->failed.load() > 0 ? kIoError : kOk;
+      delete job;
+      jobs_.erase(it);
+      for (auto fit = finished_ready_.begin(); fit != finished_ready_.end();
+           ++fit) {
+        if (*fit == job_id) {
+          finished_ready_.erase(fit);
+          break;
+        }
+      }
+      return status;
+    }
+    job->cancelled.store(true);
+    job->sealed.store(true);
+  }
+  std::unique_lock<std::mutex> jl(jobs_mu_);
+  bool done = jobs_cv_.wait_for(
+      jl, std::chrono::duration<double>(timeout_seconds), [&] {
+        auto it = jobs_.find(job_id);
+        if (it == jobs_.end()) return true;
+        JobState* job = it->second;
+        return job->completed.load() + job->failed.load() == job->total.load();
+      });
+  if (!done) return kPending;
+  auto it = jobs_.find(job_id);
+  if (it == jobs_.end()) return kOk;
+  int status = kCancelled;
+  delete it->second;
+  jobs_.erase(it);
+  // Also drop from finished_ready_ if it landed there.
+  for (auto fit = finished_ready_.begin(); fit != finished_ready_.end(); ++fit) {
+    if (*fit == job_id) {
+      finished_ready_.erase(fit);
+      break;
+    }
+  }
+  return status;
+}
+
+}  // namespace kvio
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* kvio_create(int num_threads, int read_preferring_workers,
+                  double max_write_queued_seconds) {
+  return new kvio::Engine(num_threads, read_preferring_workers,
+                          max_write_queued_seconds);
+}
+
+void kvio_destroy(void* engine) { delete static_cast<kvio::Engine*>(engine); }
+
+uint64_t kvio_begin_job(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->BeginJob();
+}
+
+void kvio_seal_job(void* engine, uint64_t job_id) {
+  static_cast<kvio::Engine*>(engine)->SealJob(job_id);
+}
+
+int kvio_submit_write(void* engine, uint64_t job_id, const char* path,
+                      const char* tmp_path, const void* data, uint64_t len,
+                      int skip_if_exists) {
+  return static_cast<kvio::Engine*>(engine)->SubmitWrite(
+      job_id, path, tmp_path, data, len, skip_if_exists != 0);
+}
+
+void kvio_submit_read(void* engine, uint64_t job_id, const char* path,
+                      void* dst, uint64_t len, uint64_t offset) {
+  static_cast<kvio::Engine*>(engine)->SubmitRead(job_id, path, dst, len,
+                                                 offset);
+}
+
+int kvio_poll_finished(void* engine, uint64_t* ids, int* statuses,
+                       int max_items) {
+  return static_cast<kvio::Engine*>(engine)->PollFinished(ids, statuses,
+                                                          max_items);
+}
+
+int kvio_wait_job(void* engine, uint64_t job_id, double timeout_seconds) {
+  return static_cast<kvio::Engine*>(engine)->WaitJob(job_id, timeout_seconds);
+}
+
+double kvio_avg_write_seconds(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->AvgWriteSeconds();
+}
+
+int kvio_queued_writes(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->QueuedWrites();
+}
+
+int kvio_file_exists(const char* path, int touch_atime) {
+  struct stat st;
+  if (stat(path, &st) != 0) return 0;
+  if (touch_atime) utime(path, nullptr);
+  return 1;
+}
+}

@@ -243,6 +243,7 @@ class MiniEngine:
         event_sink: Optional[EventSink] = None,
         params=None,
         seed: int = 0,
+        offload_spec=None,
     ):
         self.cfg = cfg or EngineConfig()
         mcfg = self.cfg.model
@@ -260,6 +261,17 @@ class MiniEngine:
         self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
         self.requests: dict[str, Request] = {}
         self._running: list[str] = []
+
+        # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
+        # write-through on commit, restore on prefix miss at admission.
+        self.offload_manager = None
+        self.offload_handlers = None
+        self._pending_store_jobs: dict[int, list[int]] = {}
+        if offload_spec is not None:
+            self.offload_manager = offload_spec.get_manager()
+            self.offload_handlers = offload_spec.get_handlers(
+                self.k_cache, self.v_cache
+            )
 
     # -- admission --
 
@@ -289,16 +301,24 @@ class MiniEngine:
         req.cached_len = len(cached_pages) * page_size
         req.computed_len = req.cached_len
 
+        # Storage tier: extend the HBM prefix hit with blocks resident on
+        # shared storage (loaded synchronously into fresh pages — the
+        # latency is one high-priority read, far below a prefill).
+        if self.offload_manager is not None:
+            self._restore_from_storage(req)
+
         # Pages for the uncached remainder (incl. partial tail + decode room)
         new_pages: list[int] = []
         while len(req.pages) + len(new_pages) < total_needed:
             page = self.block_manager.allocate_page()
             if page is None:
-                # Roll back: return popped pages and drop the prefix refs so
-                # a failed admission cannot shrink the pool or pin blocks.
+                # Roll back: return popped pages and drop the refs on every
+                # block this request holds — the HBM prefix AND any blocks
+                # just restored from storage — so a failed admission cannot
+                # shrink the pool or pin blocks against eviction.
                 self.block_manager.free_pages.extend(new_pages)
                 self.block_manager.release(
-                    req.block_hashes[: len(cached_pages)], []
+                    req.block_hashes[: req.cached_len // page_size], []
                 )
                 raise RuntimeError("out of KV pages")
             new_pages.append(page)
@@ -321,6 +341,75 @@ class MiniEngine:
             req.done = True
             self._finish(req)
         return req
+
+    def _sync_caches_to_copier(self) -> None:
+        """Hand the current (possibly donated-and-replaced) cache arrays to
+        the offload copier; forward() replaces self.k_cache/v_cache every
+        step, so the copier must never hold stale references."""
+        self.offload_handlers.copier.k_cache = self.k_cache
+        self.offload_handlers.copier.v_cache = self.v_cache
+
+    def _sync_caches_from_copier(self) -> None:
+        self.k_cache = self.offload_handlers.copier.k_cache
+        self.v_cache = self.offload_handlers.copier.v_cache
+
+    def _restore_from_storage(self, req: Request) -> None:
+        """Load storage-resident blocks that extend the HBM prefix hit."""
+        page_size = self.cfg.model.page_size
+        first_missing = req.cached_len // page_size
+        remaining = req.block_hashes[first_missing:]
+        if not remaining:
+            return
+        n_stored = self.offload_manager.lookup(remaining)
+        if n_stored == 0:
+            return
+        restore_hashes = remaining[:n_stored]
+        pages: list[int] = []
+        for _ in restore_hashes:
+            page = self.block_manager.allocate_page()
+            if page is None:
+                break
+            pages.append(page)
+        if not pages:
+            return
+        restore_hashes = restore_hashes[: len(pages)]
+
+        self._sync_caches_to_copier()
+        job = self.offload_handlers.async_load_blocks(
+            [(h, [p]) for h, p in zip(restore_hashes, pages)]
+        )
+        result = None
+        deadline = time.monotonic() + 30.0
+        while result is None and time.monotonic() < deadline:
+            result = self._drain_offload(target_job=job)
+            if result is None:
+                time.sleep(0.002)
+
+        if result is None:
+            # Timed out: cancel so a late completion can never scatter into
+            # pages we are about to recycle.
+            self.offload_handlers.wait_job(job, timeout_s=5.0)
+        if result is None or not result.success:
+            logger.warning("storage restore failed for %d blocks", len(pages))
+            self.block_manager.free_pages.extend(pages)
+            return
+
+        # Register restored blocks in the prefix cache (no re-store event:
+        # the blocks are already on the storage tier; the HBM BlockStored
+        # is emitted through commit so the index learns the HBM copy).
+        tokens_per_block = [
+            req.prompt[(first_missing + i) * page_size:(first_missing + i + 1) * page_size]
+            for i in range(len(restore_hashes))
+        ]
+        parent = (
+            req.block_hashes[first_missing - 1] if first_missing > 0 else EMPTY_BLOCK_HASH
+        )
+        canonical = self.block_manager.commit_blocks(
+            restore_hashes, pages, tokens_per_block, parent
+        )
+        req.pages.extend(canonical)
+        req.cached_len += len(canonical) * page_size
+        req.computed_len = req.cached_len
 
     def _page_table_for(self, req: Request) -> np.ndarray:
         table = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
@@ -376,6 +465,18 @@ class MiniEngine:
         # Adopt canonical pages (duplicates swapped to the resident copy).
         req.pages[first_new:n_full] = canonical
 
+        # Write-through to the storage tier (async; writes may be shed under
+        # pressure, degrading to future cache misses).
+        if self.offload_handlers is not None:
+            to_store = self.offload_manager.prepare_store(new_hashes)
+            if to_store:
+                page_of = dict(zip(new_hashes, canonical))
+                self._sync_caches_to_copier()
+                job = self.offload_handlers.async_store_blocks(
+                    [(h, [page_of[h]]) for h in to_store]
+                )
+                self._pending_store_jobs[job] = list(to_store)
+
     # -- decode --
 
     def step(self) -> dict[str, int]:
@@ -384,6 +485,7 @@ class MiniEngine:
         Returns {request_id: new_token}. Batched into a single jit call with
         padding up to max_batch.
         """
+        self.poll_offload()
         active = [self.requests[rid] for rid in self._running
                   if not self.requests[rid].done]
         emitted: dict[str, int] = {}
@@ -395,6 +497,45 @@ class MiniEngine:
             if req.done:
                 self._finish(req)
         return emitted
+
+    def _drain_offload(self, target_job: Optional[int] = None):
+        """Single dispatcher for offload completions.
+
+        Every finished job is routed here exactly once: store jobs publish
+        their storage events (minus shed blocks); an optionally-awaited
+        job's result is returned. Cache references are re-synced after the
+        drain because load scatters donate-and-replace the pools.
+        """
+        target_result = None
+        self._sync_caches_to_copier()
+        try:
+            for res in self.offload_handlers.get_finished():
+                hashes = self._pending_store_jobs.pop(res.job_id, None)
+                if hashes is not None:
+                    if res.success:
+                        stored = [h for h in hashes if h not in set(res.shed_hashes)]
+                        if stored:
+                            self.offload_manager.complete_store(stored)
+                    else:
+                        logger.warning("write-through store job %d failed", res.job_id)
+                if target_job is not None and res.job_id == target_job:
+                    target_result = res
+        finally:
+            self._sync_caches_from_copier()
+        return target_result
+
+    def poll_offload(self) -> None:
+        """Reap finished offload jobs (called each step)."""
+        if self.offload_handlers is None:
+            return
+        self._drain_offload()
+
+    def flush_offload(self, timeout_s: float = 30.0) -> None:
+        """Block until all pending store jobs complete (testing/shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        while self._pending_store_jobs and time.monotonic() < deadline:
+            self.poll_offload()
+            time.sleep(0.005)
 
     def _finish(self, req: Request) -> None:
         if req.request_id in self._running:

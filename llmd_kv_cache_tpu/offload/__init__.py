@@ -1,0 +1,21 @@
+"""KV offload data plane: TPU HBM ↔ shared storage.
+
+Counterpart of the reference's ``kv_connectors/llmd_fs_backend``: moves
+paged KV blocks between device HBM and a content-addressed file store. The
+CUDA D2H/H2D copy path is replaced by JAX/XLA device→host transfers
+(``tpu_copier``); file I/O runs on a native C++ thread pool (``csrc/kvio``).
+"""
+
+from .file_mapper import FileMapper, FileMapperConfig
+from .manager import SharedStorageOffloadManager
+from .spec import SharedStorageOffloadSpec
+from .worker import OffloadHandlers, TransferResult
+
+__all__ = [
+    "FileMapper",
+    "FileMapperConfig",
+    "SharedStorageOffloadManager",
+    "SharedStorageOffloadSpec",
+    "OffloadHandlers",
+    "TransferResult",
+]

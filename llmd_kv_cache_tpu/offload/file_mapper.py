@@ -1,0 +1,151 @@
+"""Deterministic on-disk layout for offloaded KV blocks.
+
+Counterpart of reference ``llmd_fs_backend/file_mapper.py``: content-
+addressed ``.bin`` files under a model+config-fingerprinted directory so
+cache state survives engine restarts and is shared only between
+identically-configured deployments.
+
+Layout:
+``<root>/<safe_model>_<fp12>/config.json``          (shared metadata)
+``<root>/<safe_model>_<fp12>_r<rank>/<hhh>/<hh>_g<group>/<block_hash16>.bin``
+
+The fingerprint covers the model, dtype, KV geometry, engine id and the
+**mesh axis world sizes** (tp/pp/dp/sp) — the TPU-native equivalent of the
+reference's ``tp/pp/pcp/dcp`` fields (``file_mapper.py:63-74``): blocks
+written by a TP=4 deployment must not be read by a TP=8 one.
+``parallel_agnostic`` collapses the rank dimension for single-host caches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class FileMapperConfig:
+    root: str
+    model_name: str
+    dtype: str = "bfloat16"
+    page_size: int = 16
+    kv_heads: int = 8
+    head_dim: int = 128
+    num_layers: int = 32
+    pages_per_file: int = 1
+    engine: str = "kvtpu"
+    mesh_sizes: dict[str, int] = field(
+        default_factory=lambda: {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
+    )
+    rank: int = 0
+    parallel_agnostic: bool = False
+
+
+class FileMapper:
+    """Maps block hashes to file paths."""
+
+    def __init__(self, cfg: FileMapperConfig):
+        self.cfg = cfg
+        self._fingerprint = self._compute_fingerprint()
+        safe_model = "".join(
+            c if c.isalnum() or c in "-_." else "_" for c in cfg.model_name
+        )
+        self._base = os.path.join(cfg.root, f"{safe_model}_{self._fingerprint}")
+        if cfg.parallel_agnostic:
+            self._rank_dir = self._base
+        else:
+            self._rank_dir = f"{self._base}_r{cfg.rank}"
+
+    def _compute_fingerprint(self) -> str:
+        c = self.cfg
+        payload = {
+            "model": c.model_name,
+            "dtype": c.dtype,
+            "page_size": c.page_size,
+            "kv_heads": c.kv_heads,
+            "head_dim": c.head_dim,
+            "num_layers": c.num_layers,
+            "pages_per_file": c.pages_per_file,
+            "engine": c.engine,
+            **({k: v for k, v in sorted(c.mesh_sizes.items())}
+               if not c.parallel_agnostic else {}),
+        }
+        digest = hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()
+        ).hexdigest()
+        return digest[:12]
+
+    @property
+    def fingerprint(self) -> str:
+        return self._fingerprint
+
+    @property
+    def base_dir(self) -> str:
+        return self._rank_dir
+
+    def config_path(self) -> str:
+        return os.path.join(self._base, "config.json")
+
+    def write_run_config(self) -> None:
+        """Persist the run metadata next to the store (idempotent)."""
+        os.makedirs(self._base, exist_ok=True)
+        path = self.config_path()
+        if os.path.exists(path):
+            return
+        c = self.cfg
+        with open(path + ".tmp", "w") as f:
+            json.dump(
+                {
+                    "model": c.model_name,
+                    "dtype": c.dtype,
+                    "page_size": c.page_size,
+                    "kv_heads": c.kv_heads,
+                    "head_dim": c.head_dim,
+                    "num_layers": c.num_layers,
+                    "pages_per_file": c.pages_per_file,
+                    "engine": c.engine,
+                    "mesh_sizes": c.mesh_sizes,
+                    "fingerprint": self._fingerprint,
+                },
+                f, indent=2,
+            )
+        os.replace(path + ".tmp", path)
+
+    def block_path(self, block_hash: int, group_idx: int = 0) -> str:
+        """Path of the file holding a block (hash masked to 64 bits).
+
+        Two-level hex bucketing keeps directory fanout bounded at scale
+        (reference ``file_mapper.py:112-143``).
+        """
+        h = block_hash & 0xFFFFFFFFFFFFFFFF
+        hex16 = f"{h:016x}"
+        return os.path.join(
+            self._rank_dir, hex16[:3], f"{hex16[3:5]}_g{group_idx}", f"{hex16}.bin"
+        )
+
+    def tmp_path(self, block_hash: int, group_idx: int = 0,
+                 unique_suffix: Optional[str] = None) -> str:
+        """Unique temp path beside the final file for atomic rename."""
+        suffix = unique_suffix if unique_suffix is not None else str(os.getpid())
+        return self.block_path(block_hash, group_idx) + f".tmp.{suffix}"
+
+    @staticmethod
+    def parse_block_path(path: str) -> Optional[tuple[int, int]]:
+        """Reverse mapping for the evictor: path → (block_hash, group_idx)."""
+        name = os.path.basename(path)
+        if not name.endswith(".bin"):
+            return None
+        try:
+            block_hash = int(name[:-4], 16)
+        except ValueError:
+            return None
+        parent = os.path.basename(os.path.dirname(path))
+        group_idx = 0
+        if "_g" in parent:
+            try:
+                group_idx = int(parent.split("_g")[-1])
+            except ValueError:
+                group_idx = 0
+        return block_hash, group_idx

@@ -1,0 +1,176 @@
+"""ctypes binding for the native kvio engine (csrc/kvio).
+
+Builds ``libkvio.so`` on demand with the in-image toolchain (no
+pip/pybind11 dependency) and caches it next to the sources. All file I/O
+runs on the C++ pool threads, off the GIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+logger = get_logger("offload.native")
+
+_CSRC_DIR = Path(__file__).resolve().parent.parent.parent / "csrc" / "kvio"
+_LIB_PATH = _CSRC_DIR / "libkvio.so"
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+STATUS_PENDING = -1
+STATUS_OK = 0
+STATUS_IO_ERROR = 1
+STATUS_CANCELLED = 2
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s"], cwd=str(_CSRC_DIR), check=True, capture_output=True
+    )
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if necessary) the kvio shared library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        src = _CSRC_DIR / "kvio.cpp"
+        if not _LIB_PATH.exists() or (
+            src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        ):
+            logger.info("building libkvio.so")
+            _build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+
+        lib.kvio_create.restype = ctypes.c_void_p
+        lib.kvio_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_double]
+        lib.kvio_destroy.argtypes = [ctypes.c_void_p]
+        lib.kvio_begin_job.restype = ctypes.c_uint64
+        lib.kvio_begin_job.argtypes = [ctypes.c_void_p]
+        lib.kvio_seal_job.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kvio_submit_write.restype = ctypes.c_int
+        lib.kvio_submit_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.kvio_submit_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.kvio_poll_finished.restype = ctypes.c_int
+        lib.kvio_poll_finished.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        lib.kvio_wait_job.restype = ctypes.c_int
+        lib.kvio_wait_job.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double]
+        lib.kvio_avg_write_seconds.restype = ctypes.c_double
+        lib.kvio_avg_write_seconds.argtypes = [ctypes.c_void_p]
+        lib.kvio_queued_writes.restype = ctypes.c_int
+        lib.kvio_queued_writes.argtypes = [ctypes.c_void_p]
+        lib.kvio_file_exists.restype = ctypes.c_int
+        lib.kvio_file_exists.argtypes = [ctypes.c_char_p, ctypes.c_int]
+
+        _lib = lib
+        return _lib
+
+
+class NativeIOEngine:
+    """Thin OO wrapper over the C ABI."""
+
+    def __init__(self, num_threads: int = 4, read_preferring_workers: int = 3,
+                 max_write_queued_seconds: float = 10.0):
+        self._lib = load_library()
+        self._handle = self._lib.kvio_create(
+            num_threads, read_preferring_workers, max_write_queued_seconds
+        )
+        if not self._handle:
+            raise RuntimeError("failed to create kvio engine")
+
+    def begin_job(self) -> int:
+        return self._lib.kvio_begin_job(self._handle)
+
+    def seal_job(self, job_id: int) -> None:
+        self._lib.kvio_seal_job(self._handle, job_id)
+
+    @staticmethod
+    def _buffer_address(buffer, writable: bool) -> tuple[int, int]:
+        """(address, nbytes) of a numpy array / bytes / bytearray without
+        copying. The caller must keep the object alive until completion."""
+        import numpy as np
+
+        if isinstance(buffer, np.ndarray):
+            if writable and not buffer.flags.writeable:
+                raise ValueError("read destination must be writable")
+            if not buffer.flags.c_contiguous:
+                raise ValueError("buffer must be C-contiguous")
+            return buffer.ctypes.data, buffer.nbytes
+        if isinstance(buffer, bytes):
+            if writable:
+                raise ValueError("read destination must be writable")
+            # Pointer into the caller's bytes object; valid while the caller
+            # keeps the object alive (bytes storage is never relocated).
+            return (
+                ctypes.cast(ctypes.c_char_p(buffer), ctypes.c_void_p).value,
+                len(buffer),
+            )
+        if isinstance(buffer, bytearray):
+            c_buf = ctypes.c_char.from_buffer(buffer)
+            return ctypes.addressof(c_buf), len(buffer)
+        raise TypeError(f"unsupported buffer type: {type(buffer)!r}")
+
+    def submit_write(self, job_id: int, path: str, tmp_path: str,
+                     buffer, skip_if_exists: bool = True) -> bool:
+        """Queue a write of ``buffer`` (numpy array or bytes; caller must
+        keep it alive until the job completes). Returns False when shed."""
+        address, nbytes = self._buffer_address(buffer, writable=False)
+        return bool(self._lib.kvio_submit_write(
+            self._handle, job_id, path.encode(), tmp_path.encode(),
+            address, nbytes, int(skip_if_exists),
+        ))
+
+    def submit_read(self, job_id: int, path: str, buffer, offset: int = 0) -> None:
+        address, nbytes = self._buffer_address(buffer, writable=True)
+        self._lib.kvio_submit_read(
+            self._handle, job_id, path.encode(), address, nbytes, offset,
+        )
+
+    def poll_finished(self, max_items: int = 64) -> list[tuple[int, int]]:
+        ids = (ctypes.c_uint64 * max_items)()
+        statuses = (ctypes.c_int * max_items)()
+        n = self._lib.kvio_poll_finished(self._handle, ids, statuses, max_items)
+        return [(ids[i], statuses[i]) for i in range(n)]
+
+    def wait_job(self, job_id: int, timeout_s: float = 30.0) -> int:
+        return self._lib.kvio_wait_job(self._handle, job_id, timeout_s)
+
+    def avg_write_seconds(self) -> float:
+        return self._lib.kvio_avg_write_seconds(self._handle)
+
+    def queued_writes(self) -> int:
+        return self._lib.kvio_queued_writes(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.kvio_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def file_exists(path: str, touch_atime: bool = False) -> bool:
+    return bool(load_library().kvio_file_exists(path.encode(), int(touch_atime)))

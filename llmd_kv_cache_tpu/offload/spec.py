@@ -1,0 +1,114 @@
+"""Offload spec: the engine-facing configuration plugin.
+
+Counterpart of reference ``llmd_fs_backend/spec.py``: one object that an
+engine (vLLM-TPU's OffloadingConnector, or this repo's MiniEngine) loads
+from its connector config to get (a) the scheduler-side manager and (b)
+the worker-side handlers, wired consistently from a single fingerprinted
+layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+from ..events.publisher import StorageEventPublisher
+from ..parallel.mesh import mesh_fingerprint_fields
+from ..utils.logging import get_logger
+from .file_mapper import FileMapper, FileMapperConfig
+from .manager import SharedStorageOffloadManager
+from .tpu_copier import TPUBlockCopier
+from .worker import OffloadHandlers
+
+logger = get_logger("offload.spec")
+
+
+@dataclass
+class SharedStorageOffloadSpec:
+    """Builds the manager/handlers pair for shared-storage offload."""
+
+    root: str
+    model_name: str
+    page_size: int = 16
+    num_layers: int = 32
+    kv_heads: int = 8
+    head_dim: int = 128
+    dtype: str = "bfloat16"
+    io_threads: int = 4
+    read_preferring_ratio: float = 0.75
+    max_write_queued_seconds: float = 10.0
+    rank: int = 0
+    parallel_agnostic: bool = False
+    events_endpoint: Optional[str] = None
+    mesh: Optional[object] = None  # jax.sharding.Mesh
+
+    @classmethod
+    def from_extra_config(cls, extra: dict) -> "SharedStorageOffloadSpec":
+        """Build from a connector-style extra-config dict (camelCase or
+        snake_case keys accepted)."""
+        def get(*names, default=None):
+            for n in names:
+                if n in extra:
+                    return extra[n]
+            return default
+
+        return cls(
+            root=get("root", "sharedStorageRoot", default="/tmp/kvtpu-offload"),
+            model_name=get("modelName", "model_name", default="unknown"),
+            page_size=get("pageSize", "page_size", default=16),
+            num_layers=get("numLayers", "num_layers", default=32),
+            kv_heads=get("kvHeads", "kv_heads", default=8),
+            head_dim=get("headDim", "head_dim", default=128),
+            dtype=get("dtype", default="bfloat16"),
+            io_threads=get("ioThreads", "io_threads", default=4),
+            read_preferring_ratio=get(
+                "readPreferringRatio", "read_preferring_ratio", default=0.75
+            ),
+            max_write_queued_seconds=get(
+                "maxWriteQueuedSeconds", "max_write_queued_seconds", default=10.0
+            ),
+            rank=get("rank", default=0),
+            parallel_agnostic=get(
+                "parallelAgnostic", "parallel_agnostic", default=False
+            ),
+            events_endpoint=get("eventsEndpoint", "events_endpoint"),
+        )
+
+    def build_mapper(self) -> FileMapper:
+        return FileMapper(
+            FileMapperConfig(
+                root=self.root,
+                model_name=self.model_name,
+                dtype=self.dtype,
+                page_size=self.page_size,
+                kv_heads=self.kv_heads,
+                head_dim=self.head_dim,
+                num_layers=self.num_layers,
+                mesh_sizes=mesh_fingerprint_fields(self.mesh),
+                rank=self.rank,
+                parallel_agnostic=self.parallel_agnostic,
+            )
+        )
+
+    def get_manager(self) -> SharedStorageOffloadManager:
+        """Scheduler-side (rank 0) manager with optional event publishing."""
+        publisher = None
+        if self.events_endpoint:
+            publisher = StorageEventPublisher(
+                self.events_endpoint, self.model_name, bind=False
+            )
+        return SharedStorageOffloadManager(
+            self.build_mapper(), publisher, block_size_tokens=self.page_size
+        )
+
+    def get_handlers(self, k_cache: jax.Array, v_cache: jax.Array) -> OffloadHandlers:
+        """Worker-side handlers bound to this worker's cache pools."""
+        return OffloadHandlers(
+            TPUBlockCopier(k_cache, v_cache),
+            self.build_mapper(),
+            io_threads=self.io_threads,
+            read_preferring_ratio=self.read_preferring_ratio,
+            max_write_queued_seconds=self.max_write_queued_seconds,
+        )

@@ -1,0 +1,201 @@
+"""Worker-side offload handlers: device ↔ storage transfer execution.
+
+Counterpart of reference ``llmd_fs_backend/worker.py`` + the C++
+``StorageOffloadEngine`` job lifecycle (``storage_offload.cpp``): async
+store/load jobs over groups of KV pages, completion polling, cancellation,
+per-job throughput accounting. The device↔host leg is JAX/XLA
+(``tpu_copier``); the host↔file leg is the native I/O pool (``native``).
+
+Store: gather pages → host slab (D2H DMA) → queue atomic file write.
+Load:  queue file read into a host buffer → on completion, H2D + scatter.
+Loads are processed by read-preferring workers at high priority; writes
+may be shed under sustained pressure (EMA limit), degrading to future
+cache misses rather than latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .file_mapper import FileMapper
+from .native import (
+    STATUS_IO_ERROR,
+    STATUS_OK,
+    NativeIOEngine,
+)
+from .tpu_copier import TPUBlockCopier
+
+logger = get_logger("offload.worker")
+
+
+@dataclass
+class TransferResult:
+    job_id: int
+    success: bool
+    is_store: bool
+    bytes_transferred: int = 0
+    seconds: float = 0.0
+    # Block hashes whose writes were shed by the EMA queue limit (stores
+    # only): these blocks are NOT on disk and must not be advertised.
+    shed_hashes: list = field(default_factory=list)
+
+    @property
+    def shed_blocks(self) -> int:
+        return len(self.shed_hashes)
+
+    @property
+    def throughput_gbps(self) -> float:
+        if self.seconds <= 0:
+            return 0.0
+        return self.bytes_transferred / self.seconds / 1e9
+
+
+@dataclass
+class _PendingJob:
+    job_id: int
+    is_store: bool
+    started: float
+    nbytes: int
+    shed_hashes: list = field(default_factory=list)
+    # Keep host buffers alive until the native engine is done with them.
+    buffers: list = field(default_factory=list)
+    # Loads: (buffer, page_ids) to scatter on completion.
+    scatters: list = field(default_factory=list)
+
+
+class OffloadHandlers:
+    """Bidirectional transfer engine for one worker (one device's caches)."""
+
+    def __init__(
+        self,
+        copier: TPUBlockCopier,
+        mapper: FileMapper,
+        io_threads: int = 4,
+        read_preferring_ratio: float = 0.75,
+        max_write_queued_seconds: float = 10.0,
+    ):
+        self.copier = copier
+        self.mapper = mapper
+        read_pref = max(1, int(io_threads * read_preferring_ratio))
+        self.io = NativeIOEngine(
+            num_threads=io_threads,
+            read_preferring_workers=read_pref,
+            max_write_queued_seconds=max_write_queued_seconds,
+        )
+        self._pending: dict[int, _PendingJob] = {}
+        self._lock = threading.Lock()
+
+    # -- store path --
+
+    def async_store_blocks(
+        self,
+        transfers: Sequence[tuple[int, Sequence[int]]],  # (block_hash, page_ids)
+        group_idx: int = 0,
+    ) -> int:
+        """Start an async store job; returns the job id.
+
+        Each (block_hash, page_ids) pair becomes one content-addressed
+        file. The device-side gather + D2H happens here (synchronous with
+        respect to the device stream, overlapped across files); file writes
+        are queued on the native pool.
+        """
+        job_id = self.io.begin_job()
+        job = _PendingJob(job_id=job_id, is_store=True, started=time.perf_counter(),
+                          nbytes=0)
+        suffix = uuid.uuid4().hex[:8]
+        for block_hash, page_ids in transfers:
+            slab = self.copier.gather_to_host(list(page_ids))
+            queued = self.io.submit_write(
+                job_id,
+                self.mapper.block_path(block_hash, group_idx),
+                self.mapper.tmp_path(block_hash, group_idx, unique_suffix=suffix),
+                slab,
+            )
+            if queued:
+                job.buffers.append(slab)
+                job.nbytes += slab.nbytes
+            else:
+                job.shed_hashes.append(block_hash)
+        self.io.seal_job(job_id)
+        with self._lock:
+            self._pending[job_id] = job
+        return job_id
+
+    # -- load path --
+
+    def async_load_blocks(
+        self,
+        transfers: Sequence[tuple[int, Sequence[int]]],
+        group_idx: int = 0,
+    ) -> int:
+        """Start an async load job; returns the job id.
+
+        File reads land in host buffers on the native pool (high
+        priority); the H2D scatter happens when the caller polls
+        ``get_finished`` and the job is complete.
+        """
+        job_id = self.io.begin_job()
+        job = _PendingJob(job_id=job_id, is_store=False, started=time.perf_counter(),
+                          nbytes=0)
+        for block_hash, page_ids in transfers:
+            buf = np.empty(self.copier.slab_nbytes(len(page_ids)), np.uint8)
+            self.io.submit_read(
+                job_id, self.mapper.block_path(block_hash, group_idx), buf
+            )
+            job.buffers.append(buf)
+            job.scatters.append((buf, list(page_ids)))
+            job.nbytes += buf.nbytes
+        self.io.seal_job(job_id)
+        with self._lock:
+            self._pending[job_id] = job
+        return job_id
+
+    # -- completion --
+
+    def get_finished(self) -> list[TransferResult]:
+        """Poll completed jobs; apply load scatters; release buffers."""
+        results = []
+        for job_id, status in self.io.poll_finished():
+            with self._lock:
+                job = self._pending.pop(job_id, None)
+            if job is None:
+                continue
+            success = status == STATUS_OK
+            if success and not job.is_store:
+                for buf, page_ids in job.scatters:
+                    slab = np.frombuffer(buf, dtype=self.copier.dtype).reshape(
+                        self.copier.slab_shape(len(page_ids))
+                    )
+                    self.copier.scatter_from_host(slab, page_ids)
+            elif not success and not job.is_store:
+                logger.warning("load job %d failed (status %d)", job_id, status)
+            elif not success:
+                logger.warning("store job %d failed (status %d)", job_id, status)
+            results.append(
+                TransferResult(
+                    job_id=job_id,
+                    success=success,
+                    is_store=job.is_store,
+                    bytes_transferred=job.nbytes if success else 0,
+                    seconds=time.perf_counter() - job.started,
+                    shed_hashes=job.shed_hashes,
+                )
+            )
+        return results
+
+    def wait_job(self, job_id: int, timeout_s: float = 30.0) -> int:
+        """Cancel-and-wait for preemption (request aborted mid-transfer)."""
+        status = self.io.wait_job(job_id, timeout_s)
+        with self._lock:
+            self._pending.pop(job_id, None)
+        return status
+
+    def shutdown(self) -> None:
+        self.io.close()
