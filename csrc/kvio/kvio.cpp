@@ -176,7 +176,11 @@ int Engine::SubmitWrite(uint64_t job_id, const std::string& path,
   double avg = avg_write_seconds_.load();
   if (avg > 0 && max_write_queued_seconds_ > 0) {
     double limit = num_threads_ * max_write_queued_seconds_ / avg;
-    if (QueuedWrites() >= static_cast<int>(limit)) {
+    // Never shed below one queued write: a single pathological slow write
+    // would otherwise truncate the limit to 0 and starve (and since the
+    // EMA only updates on executed writes, never recover).
+    int limit_i = limit < 1.0 ? 1 : static_cast<int>(limit);
+    if (QueuedWrites() >= limit_i) {
       return 0;
     }
   }

@@ -1,0 +1,12 @@
+"""Storage-side disk-space manager for the offload store.
+
+Counterpart of reference ``kv_connectors/pvc_evictor``: keeps the shared
+KV store below a capacity threshold by deleting the least-recently-used
+block files, publishing ``BlockRemoved`` storage events so the global
+index stays consistent.
+"""
+
+from .config import EvictorConfig
+from .evictor import Evictor
+
+__all__ = ["EvictorConfig", "Evictor"]

@@ -513,7 +513,8 @@ class MiniEngine:
                 hashes = self._pending_store_jobs.pop(res.job_id, None)
                 if hashes is not None:
                     if res.success:
-                        stored = [h for h in hashes if h not in set(res.shed_hashes)]
+                        shed = set(res.shed_hashes)
+                        stored = [h for h in hashes if h not in shed]
                         if stored:
                             self.offload_manager.complete_store(stored)
                     else:

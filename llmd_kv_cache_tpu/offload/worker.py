@@ -28,6 +28,7 @@ from .file_mapper import FileMapper
 from .native import (
     STATUS_IO_ERROR,
     STATUS_OK,
+    STATUS_PENDING,
     NativeIOEngine,
 )
 from .tpu_copier import TPUBlockCopier
@@ -193,8 +194,17 @@ class OffloadHandlers:
     def wait_job(self, job_id: int, timeout_s: float = 30.0) -> int:
         """Cancel-and-wait for preemption (request aborted mid-transfer)."""
         status = self.io.wait_job(job_id, timeout_s)
-        with self._lock:
-            self._pending.pop(job_id, None)
+        if status != STATUS_PENDING:
+            # Only release the host buffers once the native side has truly
+            # drained: a timed-out job may still have an in-flight read
+            # holding raw pointers into them.
+            with self._lock:
+                self._pending.pop(job_id, None)
+        else:
+            logger.warning(
+                "job %d still in flight after cancel timeout; parking buffers",
+                job_id,
+            )
         return status
 
     def shutdown(self) -> None:

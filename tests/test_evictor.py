@@ -1,0 +1,180 @@
+"""Evictor tests: pure-filesystem, per-stage (reference test style)."""
+
+import os
+import time
+
+import pytest
+
+from llmd_kv_cache_tpu.evictor import Evictor, EvictorConfig
+from llmd_kv_cache_tpu.evictor.evictor import (
+    clean_empty_dirs,
+    crawl_candidates,
+    crawler_buckets,
+    delete_batch,
+)
+from llmd_kv_cache_tpu.offload.file_mapper import FileMapper, FileMapperConfig
+
+
+@pytest.fixture
+def store(tmp_path):
+    """A populated store: 8 block files with staggered atimes."""
+    mapper = FileMapper(FileMapperConfig(root=str(tmp_path), model_name="m"))
+    now = time.time()
+    hashes = [(0x100000000000000 * (i + 1)) | i for i in range(8)]
+    for i, h in enumerate(hashes):
+        path = mapper.block_path(h)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"x" * 64)
+        # ages: oldest first (2h, ...), newest accessed just now
+        age = 7200 - i * 900
+        os.utime(path, (now - age, now - age))
+    return tmp_path, mapper, hashes
+
+
+class TestCrawler:
+    def test_bucket_partition_covers_all(self):
+        b0 = crawler_buckets(0, 2)
+        b1 = crawler_buckets(1, 2)
+        assert sorted(b0 + b1) == sorted("0123456789abcdef")
+        assert not set(b0) & set(b1)
+
+    def test_candidates_oldest_first_and_idle_filter(self, store):
+        tmp_path, mapper, hashes = store
+        out = list(crawl_candidates(str(tmp_path), list("0123456789abcdef"),
+                                    min_idle_seconds=3600))
+        # files idle < 1h are protected (ages 7200..900 step -900 → 5 qualify)
+        assert len(out) == 5
+        atimes = [a for a, _ in out]
+        assert atimes == sorted(atimes)
+
+    def test_missing_root_is_empty(self, tmp_path):
+        assert list(crawl_candidates(str(tmp_path / "nope"), ["0"], 0)) == []
+
+    def test_orphan_tmp_files_are_candidates(self, store):
+        """Crashed-writer temp files must be reclaimable or they leak."""
+        tmp_path, mapper, hashes = store
+        orphan = mapper.block_path(hashes[0]) + ".tmp.deadpid"
+        with open(orphan, "wb") as f:
+            f.write(b"partial")
+        old = time.time() - 7200
+        os.utime(orphan, (old, old))
+        out = list(crawl_candidates(str(tmp_path), list("0123456789abcdef"),
+                                    min_idle_seconds=3600))
+        assert any(p == orphan for _, p in out)
+        # deleting an orphan publishes no BlockRemoved (it was never stored)
+        published = []
+        delete_batch([orphan], publish=published.append)
+        assert published == []
+
+    def test_max_candidates_bound(self, store):
+        tmp_path, mapper, hashes = store
+        out = list(crawl_candidates(str(tmp_path), list("0123456789abcdef"),
+                                    min_idle_seconds=3600, max_candidates=2))
+        assert len(out) == 2
+        # still the two oldest
+        all_out = list(crawl_candidates(str(tmp_path), list("0123456789abcdef"),
+                                        min_idle_seconds=3600))
+        assert out == all_out[:2]
+
+
+class TestDeleter:
+    def test_delete_publishes_hashes(self, store):
+        tmp_path, mapper, hashes = store
+        published = []
+        path = mapper.block_path(hashes[0])
+        n = delete_batch([path], publish=published.append)
+        assert n == 1
+        assert not os.path.exists(path)
+        assert published == [[hashes[0]]]
+
+    def test_delete_missing_file_tolerated(self, tmp_path):
+        assert delete_batch([str(tmp_path / "gone.bin")]) == 0
+
+
+class TestFolderCleaner:
+    def test_removes_only_stale_empty_dirs(self, tmp_path):
+        stale = tmp_path / "model" / "abc" / "de_g0"
+        stale.mkdir(parents=True)
+        old = time.time() - 10_000
+        os.utime(stale, (old, old))
+        fresh = tmp_path / "model" / "fff" / "11_g0"
+        fresh.mkdir(parents=True)
+        removed = clean_empty_dirs(str(tmp_path), ttl_seconds=600)
+        assert removed >= 1
+        assert not stale.exists()
+        assert fresh.exists()
+
+
+class TestActivatorAndPipeline:
+    def test_hysteresis(self, tmp_path):
+        usage = {"v": 0.5}
+        ev = Evictor(EvictorConfig(store_root=str(tmp_path)),
+                     usage_fn=lambda: usage["v"])
+        assert not ev.activator_pass()
+        usage["v"] = 0.9
+        assert ev.activator_pass()
+        usage["v"] = 0.8  # between target and cleanup: stays ON
+        assert ev.activator_pass()
+        usage["v"] = 0.6
+        assert not ev.activator_pass()
+
+    def test_crawl_and_delete_pass(self, store):
+        tmp_path, mapper, hashes = store
+        published = []
+
+        class FakePub:
+            def publish_block_removed(self, hs):
+                published.extend(hs)
+
+        cfg = EvictorConfig(store_root=str(tmp_path), num_crawlers=1,
+                            min_idle_seconds=3600, delete_batch_size=2)
+        usage = {"v": 0.95}
+        ev = Evictor(cfg, publisher=FakePub(), usage_fn=lambda: usage["v"])
+        ev.activator_pass()
+        deleted = ev.crawl_and_delete_pass(0, max_batches=10)
+        assert deleted == 5  # only idle files
+        assert len(published) == 5
+        assert ev.total_deleted == 5
+
+    def test_deletion_stops_when_usage_recovers(self, store):
+        tmp_path, mapper, hashes = store
+        cfg = EvictorConfig(store_root=str(tmp_path), num_crawlers=1,
+                            min_idle_seconds=3600, delete_batch_size=1)
+        usage = {"v": 0.95}
+        ev = Evictor(cfg, usage_fn=lambda: usage["v"])
+        ev.activator_pass()
+
+        # usage drops below target after the first batch
+        calls = {"n": 0}
+
+        def usage_fn():
+            calls["n"] += 1
+            return 0.95 if calls["n"] <= 1 else 0.5
+
+        ev._usage_fn = usage_fn
+        deleted = ev.crawl_and_delete_pass(0, max_batches=10)
+        assert deleted < 5  # stopped early
+
+    def test_supervised_threads_run_and_stop(self, store):
+        tmp_path, mapper, hashes = store
+        cfg = EvictorConfig(store_root=str(tmp_path), num_crawlers=2,
+                            min_idle_seconds=3600, poll_interval_s=0.05)
+        usage = {"v": 0.95}
+        ev = Evictor(cfg, usage_fn=lambda: usage["v"])
+        ev.start()
+        deadline = time.monotonic() + 5.0
+        while ev.total_deleted < 5 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        ev.stop()
+        assert ev.total_deleted == 5
+
+    def test_config_from_env(self):
+        cfg = EvictorConfig.from_env({
+            "KVTPU_EVICTOR_STORE_ROOT": "/data",
+            "KVTPU_EVICTOR_CLEANUP_THRESHOLD": "0.9",
+            "KVTPU_EVICTOR_NUM_CRAWLERS": "4",
+        })
+        assert cfg.store_root == "/data"
+        assert cfg.cleanup_threshold == 0.9
+        assert cfg.num_crawlers == 4
