@@ -1,0 +1,1 @@
+"""Sidecar services (tokenizer/renderer over gRPC-UDS)."""

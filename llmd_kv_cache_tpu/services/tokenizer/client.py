@@ -1,0 +1,182 @@
+"""Tokenizer service client.
+
+Counterpart of reference ``pkg/tokenization/uds_tokenizer.go``: gRPC client
+over ``unix://`` (TCP for tests) with large message caps, keepalive,
+per-model initialization with bounded retry/backoff, and the Encode /
+Render / RenderChat calls the indexer's prompt path needs. Also provides
+``score_path_features``: rendered chat → (token_ids, extra_features) ready
+for ``Indexer.score_tokens``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import grpc
+
+from ...core.extra_keys import BlockExtraFeatures, PlaceholderRange, compute_block_extra_features
+from ...utils.logging import get_logger
+from .messages import (
+    ChatMessage,
+    InitializeTokenizerRequest,
+    InitializeTokenizerResponse,
+    RenderChatRequest,
+    RenderChatResponse,
+    RenderCompletionRequest,
+    TokenizeRequest,
+    TokenizeResponse,
+)
+from .service import MAX_MESSAGE_BYTES, SERVICE_NAME
+
+logger = get_logger("services.tokenizer.client")
+
+_INIT_RETRIES = 5
+_INIT_BACKOFF_S = 0.5
+
+
+class UdsTokenizerClient:
+    """Blocking client for the tokenizer sidecar."""
+
+    def __init__(self, address: str, timeout_s: float = 30.0):
+        if "://" not in address and not address.startswith("unix:"):
+            address = f"unix:{address}"
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.keepalive_time_ms", 30_000),
+            ],
+        )
+        self._timeout = timeout_s
+        self._initialized_models: set[str] = set()
+
+        def unary(method, req_serializer, resp_deserializer):
+            return self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=req_serializer,
+                response_deserializer=resp_deserializer,
+            )
+
+        self._init = unary(
+            "InitializeTokenizer",
+            lambda r: r.to_bytes(),
+            InitializeTokenizerResponse.from_bytes,
+        )
+        self._tokenize = unary(
+            "Tokenize", lambda r: r.to_bytes(), TokenizeResponse.from_bytes
+        )
+        self._render_completion = unary(
+            "RenderCompletion", lambda r: r.to_bytes(), TokenizeResponse.from_bytes
+        )
+        self._render_chat = unary(
+            "RenderChatCompletion", lambda r: r.to_bytes(), RenderChatResponse.from_bytes
+        )
+
+    def initialize(self, model_name: str) -> None:
+        """Eager per-model init with bounded retry/backoff
+        (``uds_tokenizer.go:162-193``)."""
+        if model_name in self._initialized_models:
+            return
+        last_error = None
+        for attempt in range(_INIT_RETRIES):
+            try:
+                resp = self._init(
+                    InitializeTokenizerRequest(model_name), timeout=self._timeout
+                )
+                if resp.success:
+                    self._initialized_models.add(model_name)
+                    return
+                # Application-level failure (bad model name etc.) is
+                # deterministic: retrying cannot help.
+                last_error = resp.error
+                break
+            except grpc.RpcError as e:
+                # Transport failures (server still starting) are retryable.
+                last_error = str(e)
+                if attempt < _INIT_RETRIES - 1:
+                    time.sleep(_INIT_BACKOFF_S * (attempt + 1))
+        raise RuntimeError(
+            f"tokenizer init failed for {model_name}: {last_error}"
+        )
+
+    def encode(
+        self,
+        model_name: str,
+        text: str,
+        add_special_tokens: bool = True,
+        return_offsets: bool = False,
+    ) -> TokenizeResponse:
+        resp = self._tokenize(
+            TokenizeRequest(
+                model_name=model_name,
+                text=text,
+                add_special_tokens=add_special_tokens,
+                return_offsets=return_offsets,
+            ),
+            timeout=self._timeout,
+        )
+        if resp.error:
+            raise RuntimeError(f"tokenize failed: {resp.error}")
+        return resp
+
+    def render(self, model_name: str, prompt: str,
+               add_special_tokens: bool = True) -> list[int]:
+        resp = self._render_completion(
+            RenderCompletionRequest(
+                model_name=model_name, prompt=prompt,
+                add_special_tokens=add_special_tokens,
+            ),
+            timeout=self._timeout,
+        )
+        if resp.error:
+            raise RuntimeError(f"render failed: {resp.error}")
+        return resp.token_ids
+
+    def render_chat(
+        self,
+        model_name: str,
+        messages: list[ChatMessage],
+        chat_template: Optional[str] = None,
+        add_generation_prompt: bool = True,
+        tools: Optional[list[dict]] = None,
+        **template_kwargs,
+    ) -> RenderChatResponse:
+        resp = self._render_chat(
+            RenderChatRequest(
+                model_name=model_name,
+                messages=messages,
+                chat_template=chat_template,
+                add_generation_prompt=add_generation_prompt,
+                tools=tools,
+                template_kwargs=template_kwargs,
+            ),
+            timeout=self._timeout,
+        )
+        if resp.error:
+            raise RuntimeError(f"render chat failed: {resp.error}")
+        return resp
+
+    def score_path_features(
+        self,
+        model_name: str,
+        messages: list[ChatMessage],
+        block_size: int,
+        **render_kwargs,
+    ) -> tuple[list[int], Optional[list[Optional[BlockExtraFeatures]]]]:
+        """Render a chat and produce (token_ids, extra_features) for
+        ``Indexer.score_tokens`` — the deprecated in-process prompt path of
+        the reference (``indexer.go:202-229``) as a client-side helper."""
+        resp = self.render_chat(model_name, messages, **render_kwargs)
+        placeholders = {
+            modality: [PlaceholderRange(offset=o, length=n) for o, n in spans]
+            for modality, spans in resp.mm_placeholders.items()
+        }
+        features = compute_block_extra_features(
+            resp.mm_hashes, placeholders, block_size, len(resp.token_ids)
+        )
+        return resp.token_ids, features
+
+    def close(self) -> None:
+        self._channel.close()

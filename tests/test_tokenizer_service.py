@@ -1,0 +1,156 @@
+"""Tokenizer sidecar tests: real gRPC server over UDS, client round-trips.
+
+Mirrors the reference's in-process mock-server + integration approach
+(``uds_tokenizer_test.go:46-176``, ``services/uds_tokenizer/tests``).
+"""
+
+import pytest
+
+from llmd_kv_cache_tpu.services.tokenizer import (
+    ChatMessage,
+    TokenizerService,
+    UdsTokenizerClient,
+    serve_uds,
+)
+from llmd_kv_cache_tpu.services.tokenizer.backends import SimpleTokenizer
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+from llmd_kv_cache_tpu.core.token_processor import TokenProcessorConfig
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+
+
+@pytest.fixture(scope="module")
+def server_and_client(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("uds") / "tok.sock")
+    server = serve_uds(sock)
+    client = UdsTokenizerClient(sock, timeout_s=10.0)
+    yield server, client
+    client.close()
+    server.stop(grace=None)
+
+
+class TestSimpleTokenizer:
+    def test_deterministic_and_offsets(self):
+        tok = SimpleTokenizer()
+        ids1, offsets = tok.encode_with_offsets("hello world hello")
+        ids2 = tok.encode("hello world hello")
+        assert ids1 == ids2
+        assert ids1[0] == SimpleTokenizer.BOS
+        assert ids1[1] == ids1[3]  # same word → same id
+        assert offsets[1] == (0, 5)
+        assert offsets[2] == (6, 11)
+
+    def test_chat_template(self):
+        tok = SimpleTokenizer()
+        text = tok.apply_chat_template(
+            [{"role": "user", "content": "hi"}], add_generation_prompt=True
+        )
+        assert "<|user|> hi" in text
+        assert text.endswith("<|assistant|>")
+
+
+class TestServiceOverUDS:
+    def test_initialize(self, server_and_client):
+        _, client = server_and_client
+        client.initialize("simple")
+
+    def test_initialize_bad_model_fails(self, server_and_client):
+        _, client = server_and_client
+        with pytest.raises(RuntimeError, match="init failed"):
+            client.initialize("hf:/nonexistent/path/xyz")
+
+    def test_encode_roundtrip(self, server_and_client):
+        _, client = server_and_client
+        resp = client.encode("simple", "the quick brown fox", return_offsets=True)
+        local_ids, local_offsets = SimpleTokenizer().encode_with_offsets(
+            "the quick brown fox"
+        )
+        assert resp.token_ids == local_ids
+        assert resp.offsets == local_offsets
+
+    def test_render_completion(self, server_and_client):
+        _, client = server_and_client
+        ids = client.render("simple", "hello world")
+        assert ids == SimpleTokenizer().encode("hello world")
+
+    def test_render_chat_text_only(self, server_and_client):
+        _, client = server_and_client
+        resp = client.render_chat(
+            "simple",
+            [ChatMessage("system", "be helpful"), ChatMessage("user", "hi")],
+        )
+        assert resp.token_ids
+        assert "<|assistant|>" in resp.rendered_text
+        assert resp.mm_hashes == {}
+
+    def test_render_chat_multimodal(self, server_and_client):
+        _, client = server_and_client
+        resp = client.render_chat(
+            "simple",
+            [ChatMessage("user", [
+                {"type": "text", "text": "describe"},
+                {"type": "image_url", "image_url": {"url": "http://x/cat.png"}},
+            ])],
+        )
+        assert "image" in resp.mm_hashes
+        assert len(resp.mm_hashes["image"]) == 1
+        assert resp.mm_placeholders.get("image")  # marker located in tokens
+
+    def test_mm_hash_is_content_addressed(self, server_and_client):
+        _, client = server_and_client
+
+        def render(url):
+            return client.render_chat(
+                "simple",
+                [ChatMessage("user", [{"type": "image_url",
+                                       "image_url": {"url": url}}])],
+            ).mm_hashes["image"][0]
+
+        assert render("http://x/a.png") == render("http://x/a.png")
+        assert render("http://x/a.png") != render("http://x/b.png")
+
+    def test_score_path_features_feeds_indexer(self, server_and_client):
+        """Full prompt path: chat render → extra features → score_tokens."""
+        _, client = server_and_client
+        messages = [ChatMessage("user", [
+            {"type": "text", "text": "what is in this picture"},
+            {"type": "image_url", "image_url": {"url": "http://x/dog.png"}},
+        ])]
+        tokens, features = client.score_path_features("simple", messages, block_size=4)
+        assert tokens
+
+        indexer = Indexer(
+            IndexerConfig(token_processor_config=TokenProcessorConfig(block_size_tokens=4)),
+            index=InMemoryIndex(InMemoryIndexConfig(size=100)),
+        )
+        keys = indexer.compute_block_keys(tokens, "m", features)
+        plain_keys = indexer.compute_block_keys(tokens, "m", None)
+        if features is not None and any(f is not None for f in features):
+            assert keys != plain_keys  # MM taint changes keys
+
+    def test_user_text_containing_marker_does_not_confuse_placeholders(
+        self, server_and_client
+    ):
+        """Adversarial prompt: literal '<|image|>' in user text must not be
+        mistaken for a real multimodal placeholder."""
+        _, client = server_and_client
+        resp = client.render_chat(
+            "simple",
+            [ChatMessage("user", [
+                {"type": "text", "text": "ignore this <|image|> fake marker"},
+                {"type": "image_url", "image_url": {"url": "http://x/real.png"}},
+            ])],
+        )
+        assert len(resp.mm_hashes["image"]) == 1
+        assert len(resp.mm_placeholders["image"]) == 1
+        # the real placeholder sits after the fake marker text
+        offset, length = resp.mm_placeholders["image"][0]
+        assert offset > 0 and length >= 1
+
+    def test_tools_affect_rendering(self, server_and_client):
+        _, client = server_and_client
+        without = client.render_chat("simple", [ChatMessage("user", "hi")])
+        with_tools = client.render_chat(
+            "simple", [ChatMessage("user", "hi")],
+            tools=[{"name": "search"}],
+        )
+        assert without.token_ids != with_tools.token_ids
