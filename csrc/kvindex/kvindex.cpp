@@ -1,0 +1,433 @@
+// Native KV-block index + block-hash engine.
+//
+// Perf-critical counterpart of the Python in-memory index and token hash
+// chain (the two hot loops of the scheduler path, SURVEY.md §3.1). Role
+// parity with the reference's Go implementations:
+//   pkg/kvcache/kvblock/in_memory.go  -> Index (two-level LRU, dual keys)
+//   pkg/kvcache/kvblock/token_processor.go -> kvhash_* (FNV-64a over
+//       canonical CBOR [parent, chunk, extra]), text-only fast path
+//
+// Exposed via a C ABI consumed by ctypes (llmd_kv_cache_tpu/index/native.py
+// and core/token_processor.py). Strings are interned: Python passes pod and
+// tier strings once, then everything crosses the boundary as integer ids.
+//
+// Concurrency: one engine-wide mutex. Calls arrive with the GIL released;
+// operations are short (µs) so a single lock outperforms the reference's
+// fine-grained locking at this scale while preserving its semantics
+// (including Evict's all-empty mapping prune and empty-key removal).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// FNV-64a + canonical CBOR hash chain
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kFnvOffset = 0xcbf29ce484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001b3ULL;
+
+inline uint64_t FnvUpdate(uint64_t h, const uint8_t* data, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+// Append a canonical-CBOR unsigned-int head for `value` with major type.
+inline void CborHead(std::vector<uint8_t>& out, uint8_t major, uint64_t value) {
+  uint8_t mt = major << 5;
+  if (value < 24) {
+    out.push_back(mt | static_cast<uint8_t>(value));
+  } else if (value <= 0xff) {
+    out.push_back(mt | 24);
+    out.push_back(static_cast<uint8_t>(value));
+  } else if (value <= 0xffff) {
+    out.push_back(mt | 25);
+    for (int s = 8; s >= 0; s -= 8) out.push_back((value >> s) & 0xff);
+  } else if (value <= 0xffffffffULL) {
+    out.push_back(mt | 26);
+    for (int s = 24; s >= 0; s -= 8) out.push_back((value >> s) & 0xff);
+  } else {
+    out.push_back(mt | 27);
+    for (int s = 56; s >= 0; s -= 8) out.push_back((value >> s) & 0xff);
+  }
+}
+
+// Hash one block: FNV-64a(CBOR([parent, [tokens...], null])).
+uint64_t HashBlock(uint64_t parent, const uint32_t* tokens, int n,
+                   std::vector<uint8_t>& scratch) {
+  scratch.clear();
+  scratch.push_back(0x83);  // array(3)
+  CborHead(scratch, 0, parent);
+  CborHead(scratch, 4, static_cast<uint64_t>(n));  // array(n)
+  for (int i = 0; i < n; ++i) CborHead(scratch, 0, tokens[i]);
+  scratch.push_back(0xf6);  // null extra (text-only fast path)
+  return FnvUpdate(kFnvOffset, scratch.data(), scratch.size());
+}
+
+// ---------------------------------------------------------------------------
+// Index
+// ---------------------------------------------------------------------------
+
+struct Entry {
+  int32_t pod;
+  int32_t tier;
+  uint8_t flags;  // bit0 speculative, bit1 has_group
+  int32_t group;
+
+  bool operator==(const Entry& o) const {
+    return pod == o.pod && tier == o.tier && flags == o.flags && group == o.group;
+  }
+};
+
+struct PodSlot {
+  // MRU-first, capacity-bounded (pods_per_key, default 10): linear ops on
+  // a tiny vector beat any pointer structure.
+  std::vector<Entry> entries;
+  std::list<uint64_t>::iterator lru_it;
+};
+
+struct MapSlot {
+  std::vector<uint64_t> request_keys;
+  std::list<uint64_t>::iterator lru_it;
+};
+
+class Index {
+ public:
+  Index(uint64_t capacity, int pods_per_key, uint64_t mapping_capacity)
+      : capacity_(capacity ? capacity : 1),
+        pods_per_key_(pods_per_key > 0 ? pods_per_key : 10),
+        mapping_capacity_(mapping_capacity ? mapping_capacity : 1) {}
+
+  int32_t Intern(const std::string& s) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = intern_.find(s);
+    if (it != intern_.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strings_.size());
+    strings_.push_back(s);
+    intern_.emplace(s, id);
+    return id;
+  }
+
+  int GetString(int32_t id, char* buf, int buf_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (id < 0 || static_cast<size_t>(id) >= strings_.size()) return -1;
+    const std::string& s = strings_[id];
+    int n = static_cast<int>(s.size());
+    if (n >= buf_len) return -1;
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+    return n;
+  }
+
+  void Add(const uint64_t* engine_keys, int n_ek, const uint64_t* request_keys,
+           int n_rk, const Entry* entries, int n_entries) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (n_ek > 0 && n_rk > 0) {
+      int n = n_ek > n_rk ? n_ek : n_rk;
+      uint64_t prev_ek = 0;
+      bool first = true;
+      for (int i = 0; i < n; ++i) {
+        uint64_t ek = engine_keys[static_cast<int64_t>(i) * n_ek / n];
+        uint64_t rk = request_keys[static_cast<int64_t>(i) * n_rk / n];
+        MapSlot& slot = TouchMapping(ek, first || ek != prev_ek);
+        slot.request_keys.push_back(rk);
+        prev_ek = ek;
+        first = false;
+      }
+    }
+    for (int k = 0; k < n_rk; ++k) {
+      PodSlot& slot = TouchKey(request_keys[k]);
+      for (int e = 0; e < n_entries; ++e) InsertEntry(slot, entries[e]);
+    }
+  }
+
+  // Lookup with early stop on a known-but-empty key. Results packed as
+  // 4 ints (pod, tier, flags, group) per entry. Returns total entries, or
+  // -1 if out_cap is too small.
+  int Lookup(const uint64_t* keys, int n_keys, const int32_t* filter_pods,
+             int n_filter, int32_t* out_counts, int32_t* out_entries,
+             int out_cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int total = 0;
+    for (int k = 0; k < n_keys; ++k) {
+      out_counts[k] = 0;
+      auto it = data_.find(keys[k]);
+      if (it == data_.end()) continue;  // absent key does not break the scan
+      PodSlot& slot = it->second;
+      if (slot.entries.empty()) break;  // chain broken at a known key
+      key_lru_.splice(key_lru_.begin(), key_lru_, slot.lru_it);
+      for (const Entry& e : slot.entries) {
+        if (n_filter > 0) {
+          bool match = false;
+          for (int f = 0; f < n_filter; ++f) {
+            if (filter_pods[f] == e.pod) { match = true; break; }
+          }
+          if (!match) continue;
+        }
+        if ((total + 1) * 4 > out_cap) return -1;
+        int32_t* dst = out_entries + total * 4;
+        dst[0] = e.pod;
+        dst[1] = e.tier;
+        dst[2] = e.flags;
+        dst[3] = e.group;
+        ++total;
+        ++out_counts[k];
+      }
+    }
+    return total;
+  }
+
+  void Evict(uint64_t key, int is_engine_key, const Entry* entries, int n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (is_engine_key) {
+      auto mit = mappings_.find(key);
+      if (mit == mappings_.end()) return;
+      // Copy: EvictFromRequestKey may erase request keys.
+      std::vector<uint64_t> rks = mit->second.request_keys;
+      for (uint64_t rk : rks) EvictFromRequestKey(rk, entries, n);
+      bool all_empty = true;
+      for (uint64_t rk : rks) {
+        auto dit = data_.find(rk);
+        if (dit != data_.end() && !dit->second.entries.empty()) {
+          all_empty = false;
+          break;
+        }
+      }
+      if (all_empty) {
+        mit = mappings_.find(key);
+        if (mit != mappings_.end()) {
+          map_lru_.erase(mit->second.lru_it);
+          mappings_.erase(mit);
+        }
+      }
+    } else {
+      EvictFromRequestKey(key, entries, n);
+    }
+  }
+
+  uint64_t GetRequestKey(uint64_t engine_key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = mappings_.find(engine_key);
+    if (it == mappings_.end() || it->second.request_keys.empty()) return 0;
+    map_lru_.splice(map_lru_.begin(), map_lru_, it->second.lru_it);
+    return it->second.request_keys.back();
+  }
+
+  void Clear(int32_t pod) {
+    std::lock_guard<std::mutex> lk(mu_);
+    // Collect first: erasing mutates the LRU list we'd be iterating.
+    std::vector<uint64_t> touched;
+    for (auto& [key, slot] : data_) {
+      for (const Entry& e : slot.entries) {
+        if (e.pod == pod) { touched.push_back(key); break; }
+      }
+    }
+    for (uint64_t key : touched) {
+      auto it = data_.find(key);
+      if (it == data_.end()) continue;
+      auto& entries = it->second.entries;
+      entries.erase(
+          std::remove_if(entries.begin(), entries.end(),
+                         [pod](const Entry& e) { return e.pod == pod; }),
+          entries.end());
+      if (entries.empty()) {
+        key_lru_.erase(it->second.lru_it);
+        data_.erase(it);
+      }
+    }
+  }
+
+  uint64_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return data_.size();
+  }
+
+ private:
+  PodSlot& TouchKey(uint64_t key) {
+    auto it = data_.find(key);
+    if (it != data_.end()) {
+      key_lru_.splice(key_lru_.begin(), key_lru_, it->second.lru_it);
+      return it->second;
+    }
+    if (data_.size() >= capacity_) {
+      uint64_t victim = key_lru_.back();
+      key_lru_.pop_back();
+      data_.erase(victim);
+    }
+    key_lru_.push_front(key);
+    PodSlot& slot = data_[key];
+    slot.lru_it = key_lru_.begin();
+    return slot;
+  }
+
+  // reset=true replaces the mapping (new Add supersedes), matching the
+  // reference where Add overwrites the engine key's request list.
+  MapSlot& TouchMapping(uint64_t key, bool reset) {
+    auto it = mappings_.find(key);
+    if (it != mappings_.end()) {
+      map_lru_.splice(map_lru_.begin(), map_lru_, it->second.lru_it);
+      if (reset) it->second.request_keys.clear();
+      return it->second;
+    }
+    if (mappings_.size() >= mapping_capacity_) {
+      uint64_t victim = map_lru_.back();
+      map_lru_.pop_back();
+      mappings_.erase(victim);
+    }
+    map_lru_.push_front(key);
+    MapSlot& slot = mappings_[key];
+    slot.lru_it = map_lru_.begin();
+    return slot;
+  }
+
+  void InsertEntry(PodSlot& slot, const Entry& entry) {
+    auto& v = slot.entries;
+    for (size_t i = 0; i < v.size(); ++i) {
+      if (v[i] == entry) {
+        // promote to MRU (front)
+        Entry tmp = v[i];
+        v.erase(v.begin() + i);
+        v.insert(v.begin(), tmp);
+        return;
+      }
+    }
+    if (static_cast<int>(v.size()) >= pods_per_key_) v.pop_back();
+    v.insert(v.begin(), entry);
+  }
+
+  void EvictFromRequestKey(uint64_t key, const Entry* entries, int n) {
+    auto it = data_.find(key);
+    if (it == data_.end()) return;
+    auto& v = it->second.entries;
+    for (int e = 0; e < n; ++e) {
+      for (size_t i = 0; i < v.size(); ++i) {
+        if (v[i] == entries[e]) {
+          v.erase(v.begin() + i);
+          break;
+        }
+      }
+    }
+    if (v.empty()) {
+      key_lru_.erase(it->second.lru_it);
+      data_.erase(it);
+    }
+  }
+
+  uint64_t capacity_;
+  int pods_per_key_;
+  uint64_t mapping_capacity_;
+  std::mutex mu_;
+  std::unordered_map<uint64_t, PodSlot> data_;
+  std::unordered_map<uint64_t, MapSlot> mappings_;
+  std::list<uint64_t> key_lru_;  // MRU at front
+  std::list<uint64_t> map_lru_;
+  std::unordered_map<std::string, int32_t> intern_;
+  std::deque<std::string> strings_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// -- hash chain --
+
+// Initial chain hash: FNV64a(CBOR([FNV64a(seed), null, model])).
+uint64_t kvhash_init(const char* seed, const char* model) {
+  uint64_t seed_hash = FnvUpdate(
+      kFnvOffset, reinterpret_cast<const uint8_t*>(seed), std::strlen(seed));
+  std::vector<uint8_t> buf;
+  buf.push_back(0x83);
+  CborHead(buf, 0, seed_hash);
+  buf.push_back(0xf6);  // null tokens
+  size_t model_len = std::strlen(model);
+  CborHead(buf, 3, model_len);  // text string head
+  buf.insert(buf.end(), model, model + model_len);
+  return FnvUpdate(kFnvOffset, buf.data(), buf.size());
+}
+
+// Chain-hash full blocks of `block_size` tokens (text-only path).
+// Returns the number of block hashes written to out (= n_tokens/block_size).
+int kvhash_chain(uint64_t parent, const uint32_t* tokens, int n_tokens,
+                 int block_size, uint64_t* out) {
+  if (block_size <= 0) return 0;
+  int n_blocks = n_tokens / block_size;
+  std::vector<uint8_t> scratch;
+  scratch.reserve(16 + 5 * block_size);
+  uint64_t prefix = parent;
+  for (int b = 0; b < n_blocks; ++b) {
+    prefix = HashBlock(prefix, tokens + b * block_size, block_size, scratch);
+    out[b] = prefix;
+  }
+  return n_blocks;
+}
+
+// -- index --
+
+void* kvidx_create(uint64_t capacity, int pods_per_key, uint64_t mapping_capacity) {
+  return new Index(capacity, pods_per_key, mapping_capacity);
+}
+
+void kvidx_destroy(void* idx) { delete static_cast<Index*>(idx); }
+
+int32_t kvidx_intern(void* idx, const char* s) {
+  return static_cast<Index*>(idx)->Intern(s);
+}
+
+int kvidx_get_string(void* idx, int32_t id, char* buf, int buf_len) {
+  return static_cast<Index*>(idx)->GetString(id, buf, buf_len);
+}
+
+void kvidx_add(void* idx, const uint64_t* engine_keys, int n_ek,
+               const uint64_t* request_keys, int n_rk, const int32_t* pods,
+               const int32_t* tiers, const uint8_t* flags,
+               const int32_t* groups, int n_entries) {
+  std::vector<Entry> entries(n_entries);
+  for (int i = 0; i < n_entries; ++i) {
+    entries[i] = Entry{pods[i], tiers[i], flags[i], groups[i]};
+  }
+  static_cast<Index*>(idx)->Add(engine_keys, n_ek, request_keys, n_rk,
+                                entries.data(), n_entries);
+}
+
+int kvidx_lookup(void* idx, const uint64_t* keys, int n_keys,
+                 const int32_t* filter_pods, int n_filter,
+                 int32_t* out_counts, int32_t* out_entries, int out_cap) {
+  return static_cast<Index*>(idx)->Lookup(keys, n_keys, filter_pods, n_filter,
+                                          out_counts, out_entries, out_cap);
+}
+
+void kvidx_evict(void* idx, uint64_t key, int is_engine_key,
+                 const int32_t* pods, const int32_t* tiers,
+                 const uint8_t* flags, const int32_t* groups, int n) {
+  std::vector<Entry> entries(n);
+  for (int i = 0; i < n; ++i) {
+    entries[i] = Entry{pods[i], tiers[i], flags[i], groups[i]};
+  }
+  static_cast<Index*>(idx)->Evict(key, is_engine_key, entries.data(), n);
+}
+
+uint64_t kvidx_get_request_key(void* idx, uint64_t engine_key) {
+  return static_cast<Index*>(idx)->GetRequestKey(engine_key);
+}
+
+void kvidx_clear(void* idx, int32_t pod) {
+  static_cast<Index*>(idx)->Clear(pod);
+}
+
+uint64_t kvidx_len(void* idx) { return static_cast<Index*>(idx)->Size(); }
+}

@@ -60,9 +60,15 @@ class TokenProcessorConfig:
 
 
 class ChunkedTokenDatabase:
-    """Concrete token processor implementing the chained block-hash scheme."""
+    """Concrete token processor implementing the chained block-hash scheme.
 
-    def __init__(self, config: Optional[TokenProcessorConfig] = None):
+    Text-only blocks take a native (C++) fast path when ``csrc/kvindex``
+    builds; multimodal-tainted blocks always use the Python encoder. Both
+    produce identical hashes (covered by equivalence tests).
+    """
+
+    def __init__(self, config: Optional[TokenProcessorConfig] = None,
+                 use_native: bool = True):
         cfg = config or TokenProcessorConfig()
         block_size = cfg.block_size_tokens or DEFAULT_BLOCK_SIZE
         if block_size <= 0:
@@ -75,6 +81,15 @@ class ChunkedTokenDatabase:
         # Per-model seed cache: the init step hashes the model name into the
         # chain once; memoize since model cardinality is tiny.
         self._model_seed_cache: dict[str, int] = {}
+        self._native = None
+        if use_native:
+            try:
+                from ..index import native as _native_mod
+
+                if _native_mod.native_available():
+                    self._native = _native_mod
+            except Exception:  # pragma: no cover - toolchain-less envs
+                self._native = None
 
     @property
     def block_size(self) -> int:
@@ -110,6 +125,22 @@ class ChunkedTokenDatabase:
         given, must have exactly one entry per full token chunk.
         """
         parent = parent_key if parent_key != EMPTY_BLOCK_HASH else self._get_init_hash(model_name)
+
+        n_chunks = len(tokens) // self._block_size
+        if n_chunks == 0:
+            return []
+
+        # Native fast path: text-only chains hash in C++ (GIL-free).
+        if self._native is not None and (
+            extra_features is None or all(f is None for f in extra_features)
+        ):
+            if extra_features is not None and len(extra_features) != n_chunks:
+                raise ValueError(
+                    f"extra_features length {len(extra_features)} does not match "
+                    f"token chunk count {n_chunks} (block_size_tokens="
+                    f"{self._block_size}, tokens={len(tokens)})"
+                )
+            return self._native.hash_chain(parent, tokens, self._block_size)
 
         chunks = self._chunk_tokens(tokens)
         if not chunks:

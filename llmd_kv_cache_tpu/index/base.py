@@ -105,13 +105,16 @@ def infer_engine_mappings(
 class IndexConfig:
     """Backend selection config (reference ``index.go:29-57``).
 
-    Priority when several are set: cost-aware > redis > in-memory
+    Priority when several are set: cost-aware > native > redis > in-memory
     (the reference also supports Valkey, same wire as Redis).
     """
 
     in_memory_config: Optional["InMemoryIndexConfig"] = None  # noqa: F821
     cost_aware_memory_config: Optional["CostAwareMemoryIndexConfig"] = None  # noqa: F821
     redis_config: Optional[dict] = None
+    # Native C++ index (csrc/kvindex): the high-throughput in-process
+    # backend; same contract, GIL-free hot paths.
+    native_config: Optional["NativeIndexConfig"] = None  # noqa: F821
     enable_metrics: bool = False
     # Wrap the backend with OTel spans per operation (child spans under
     # score_tokens). Off by default: even no-op span managers cost on the
@@ -138,6 +141,10 @@ def create_index(cfg: Optional[IndexConfig] = None) -> Index:
         from .cost_aware import CostAwareMemoryIndex
 
         idx = CostAwareMemoryIndex(cfg.cost_aware_memory_config)
+    elif cfg.native_config is not None:
+        from .native import NativeIndex
+
+        idx = NativeIndex(cfg.native_config)
     elif cfg.redis_config is not None:
         from .redis_index import RedisIndex
 

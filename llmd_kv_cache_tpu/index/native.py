@@ -1,0 +1,315 @@
+"""Native (C++) index backend and hash-chain fast path.
+
+ctypes bindings for ``csrc/kvindex``: a two-level-LRU index and the
+FNV-64a/canonical-CBOR block-hash chain, both GIL-free. The NativeIndex
+implements the same Index contract as the Python backends (shared contract
+tests run over it); the hash fast path is used by ``ChunkedTokenDatabase``
+for text-only blocks (multimodal-tainted blocks take the Python path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.keys import BlockHash, KeyType, PodEntry
+from ..utils.logging import get_logger
+from .base import Index
+
+logger = get_logger("index.native")
+
+_CSRC_DIR = Path(__file__).resolve().parent.parent.parent / "csrc" / "kvindex"
+_LIB_PATH = _CSRC_DIR / "libkvindex.so"
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+_FLAG_SPECULATIVE = 1
+_FLAG_HAS_GROUP = 2
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        src = _CSRC_DIR / "kvindex.cpp"
+        if not _LIB_PATH.exists() or (
+            src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        ):
+            logger.info("building libkvindex.so")
+            subprocess.run(["make", "-s"], cwd=str(_CSRC_DIR), check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(str(_LIB_PATH))
+
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+
+        lib.kvhash_init.restype = ctypes.c_uint64
+        lib.kvhash_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.kvhash_chain.restype = ctypes.c_int
+        lib.kvhash_chain.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+            ctypes.c_int, u64p,
+        ]
+        lib.kvidx_create.restype = ctypes.c_void_p
+        lib.kvidx_create.argtypes = [ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
+        lib.kvidx_destroy.argtypes = [ctypes.c_void_p]
+        lib.kvidx_intern.restype = ctypes.c_int32
+        lib.kvidx_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kvidx_get_string.restype = ctypes.c_int
+        lib.kvidx_get_string.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.kvidx_add.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_int, u64p, ctypes.c_int,
+            i32p, i32p, u8p, i32p, ctypes.c_int,
+        ]
+        lib.kvidx_lookup.restype = ctypes.c_int
+        lib.kvidx_lookup.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_int, i32p, ctypes.c_int,
+            i32p, i32p, ctypes.c_int,
+        ]
+        lib.kvidx_evict.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            i32p, i32p, u8p, i32p, ctypes.c_int,
+        ]
+        lib.kvidx_get_request_key.restype = ctypes.c_uint64
+        lib.kvidx_get_request_key.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kvidx_clear.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.kvidx_len.restype = ctypes.c_uint64
+        lib.kvidx_len.argtypes = [ctypes.c_void_p]
+
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+# -- hash-chain fast path ---------------------------------------------------
+
+
+def hash_init(seed: str, model: str) -> int:
+    return load_library().kvhash_init(seed.encode(), model.encode())
+
+
+def hash_chain(parent: int, tokens: Sequence[int], block_size: int) -> list[int]:
+    """Chain-hash full text-only blocks natively."""
+    lib = load_library()
+    arr = np.asarray(tokens, np.uint32)
+    n_blocks = len(arr) // block_size
+    if n_blocks == 0:
+        return []
+    out = np.empty(n_blocks, np.uint64)
+    n = lib.kvhash_chain(
+        ctypes.c_uint64(parent & 0xFFFFFFFFFFFFFFFF),
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(arr), block_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return [int(h) for h in out[:n]]
+
+
+# -- native index -----------------------------------------------------------
+
+
+@dataclass
+class NativeIndexConfig:
+    size: int = 10**8
+    pod_cache_size: int = 10
+    mapping_size: int = 10**8
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "NativeIndexConfig":
+        if not d:
+            return cls()
+        return cls(
+            size=d.get("size", 10**8) or 10**8,
+            pod_cache_size=d.get("podCacheSize", d.get("pod_cache_size", 10)) or 10,
+            mapping_size=d.get("mappingSize", d.get("mapping_size", 10**8)) or 10**8,
+        )
+
+
+class NativeIndex(Index):
+    """C++-backed Index implementation."""
+
+    def __init__(self, cfg: Optional[NativeIndexConfig] = None):
+        cfg = cfg or NativeIndexConfig()
+        self._lib = load_library()
+        self._handle = self._lib.kvidx_create(cfg.size, cfg.pod_cache_size,
+                                              cfg.mapping_size)
+        if not self._handle:
+            raise RuntimeError("failed to create native index")
+        # Mirror of the native intern table (id → string), filled lazily.
+        self._interned: dict[str, int] = {}
+        self._strings: dict[int, str] = {}
+        self._intern_lock = threading.Lock()
+        self._lookup_cap = 4096  # entries; grown on demand
+        # PodEntry is frozen/immutable: memoize by packed tuple so lookups
+        # reuse objects instead of re-materializing identical entries.
+        self._entry_cache: dict[tuple[int, int, int, int], PodEntry] = {}
+
+    def _intern(self, s: str) -> int:
+        with self._intern_lock:
+            sid = self._interned.get(s)
+            if sid is None:
+                sid = self._lib.kvidx_intern(self._handle, s.encode())
+                self._interned[s] = sid
+                self._strings[sid] = s
+            return sid
+
+    def _resolve(self, sid: int) -> str:
+        s = self._strings.get(sid)
+        if s is not None:
+            return s
+        buf = ctypes.create_string_buffer(512)
+        n = self._lib.kvidx_get_string(self._handle, sid, buf, 512)
+        s = buf.value.decode() if n >= 0 else ""
+        with self._intern_lock:
+            self._strings[sid] = s
+        return s
+
+    def _pack_entries(self, entries: Sequence[PodEntry]):
+        n = len(entries)
+        pods = np.empty(n, np.int32)
+        tiers = np.empty(n, np.int32)
+        flags = np.empty(n, np.uint8)
+        groups = np.empty(n, np.int32)
+        for i, e in enumerate(entries):
+            pods[i] = self._intern(e.pod_identifier)
+            tiers[i] = self._intern(e.device_tier)
+            flags[i] = (_FLAG_SPECULATIVE if e.speculative else 0) | (
+                _FLAG_HAS_GROUP if e.has_group else 0
+            )
+            groups[i] = e.group_idx
+        return pods, tiers, flags, groups
+
+    @staticmethod
+    def _keys_array(keys: Sequence[BlockHash]) -> np.ndarray:
+        try:
+            return np.asarray(keys, np.uint64)
+        except (OverflowError, TypeError, ValueError):
+            return np.asarray([k & 0xFFFFFFFFFFFFFFFF for k in keys], np.uint64)
+
+    def add(self, engine_keys, request_keys, entries) -> None:
+        if not request_keys or not entries:
+            raise ValueError("no keys or entries provided for adding to index")
+        rk = self._keys_array(request_keys)
+        ek = self._keys_array(engine_keys) if engine_keys else np.empty(0, np.uint64)
+        pods, tiers, flags, groups = self._pack_entries(entries)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._lib.kvidx_add(
+            self._handle,
+            ek.ctypes.data_as(u64p), len(ek),
+            rk.ctypes.data_as(u64p), len(rk),
+            pods.ctypes.data_as(i32p), tiers.ctypes.data_as(i32p),
+            flags.ctypes.data_as(u8p), groups.ctypes.data_as(i32p),
+            len(entries),
+        )
+
+    def lookup(self, request_keys, pod_identifier_set=None):
+        if not request_keys:
+            raise ValueError("no request_keys provided for lookup")
+        keys = self._keys_array(request_keys)
+        if pod_identifier_set:
+            filt = np.asarray(
+                [self._intern(p) for p in pod_identifier_set], np.int32
+            )
+        else:
+            filt = np.empty(0, np.int32)
+        counts = np.zeros(len(keys), np.int32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        while True:
+            out = np.empty(self._lookup_cap * 4, np.int32)
+            total = self._lib.kvidx_lookup(
+                self._handle,
+                keys.ctypes.data_as(u64p), len(keys),
+                filt.ctypes.data_as(i32p), len(filt),
+                counts.ctypes.data_as(i32p),
+                out.ctypes.data_as(i32p), len(out),
+            )
+            if total >= 0:
+                break
+            self._lookup_cap *= 2
+
+        result: dict[BlockHash, list[PodEntry]] = {}
+        flat = out[: total * 4].tolist()
+        entry_cache = self._entry_cache
+        pos = 0
+        for i, key in enumerate(request_keys):
+            c = int(counts[i])
+            if c == 0:
+                continue
+            entries = []
+            for j in range(pos, pos + c):
+                packed = tuple(flat[j * 4:j * 4 + 4])
+                entry = entry_cache.get(packed)
+                if entry is None:
+                    pod, tier, fl, group = packed
+                    entry = PodEntry(
+                        pod_identifier=self._resolve(pod),
+                        device_tier=self._resolve(tier),
+                        speculative=bool(fl & _FLAG_SPECULATIVE),
+                        has_group=bool(fl & _FLAG_HAS_GROUP),
+                        group_idx=group,
+                    )
+                    entry_cache[packed] = entry
+                entries.append(entry)
+            result[key] = entries
+            pos += c
+        return result
+
+    def evict(self, key, key_type, entries) -> None:
+        if not entries:
+            raise ValueError("no entries provided for eviction from index")
+        pods, tiers, flags, groups = self._pack_entries(entries)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._lib.kvidx_evict(
+            self._handle,
+            ctypes.c_uint64(key & 0xFFFFFFFFFFFFFFFF),
+            1 if key_type is KeyType.ENGINE else 0,
+            pods.ctypes.data_as(i32p), tiers.ctypes.data_as(i32p),
+            flags.ctypes.data_as(u8p), groups.ctypes.data_as(i32p),
+            len(entries),
+        )
+
+    def get_request_key(self, engine_key):
+        rk = self._lib.kvidx_get_request_key(
+            self._handle, ctypes.c_uint64(engine_key & 0xFFFFFFFFFFFFFFFF)
+        )
+        return int(rk) if rk != 0 else None
+
+    def clear(self, pod_identifier: str) -> None:
+        self._lib.kvidx_clear(self._handle, self._intern(pod_identifier))
+
+    def __len__(self) -> int:
+        return int(self._lib.kvidx_len(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.kvidx_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -39,8 +39,11 @@ class UdsTokenizerClient:
     """Blocking client for the tokenizer sidecar."""
 
     def __init__(self, address: str, timeout_s: float = 30.0):
+        # Bare filesystem paths become unix: targets; host:port strings are
+        # dialed as TCP (test servers); explicit schemes pass through.
         if "://" not in address and not address.startswith("unix:"):
-            address = f"unix:{address}"
+            if ":" not in address or address.startswith("/"):
+                address = f"unix:{address}"
         self._channel = grpc.insecure_channel(
             address,
             options=[

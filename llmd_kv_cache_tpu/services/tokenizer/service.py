@@ -132,7 +132,7 @@ class TokenizerService:
             mm_placeholders: dict[str, list[tuple[int, int]]] = {}
             rest = rendered
             display_text = rendered
-            for i, (sentinel, modality, identifier) in enumerate(mm_items):
+            for sentinel, modality, identifier in mm_items:
                 before, sep, rest = rest.partition(sentinel)
                 display_text = display_text.replace(sentinel, f"<|{modality}|>", 1)
                 if not sep:
@@ -142,7 +142,9 @@ class TokenizerService:
                     # the unconsumed text for the remaining sentinels.
                     rest = before
                     continue
-                seg_ids = tok.encode(before, add_special_tokens=(i == 0))
+                # Specials (BOS) go on the first *encoded* segment, wherever
+                # that falls — templates may drop earlier items.
+                seg_ids = tok.encode(before, add_special_tokens=not ids)
                 ids.extend(seg_ids)
                 marker_ids = tok.encode(f"<|{modality}|>", add_special_tokens=False)
                 mm_hashes.setdefault(modality, []).append(identifier)

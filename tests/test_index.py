@@ -31,7 +31,7 @@ def pod(name, tier="tpu-hbm", **kw):
 
 
 @pytest.fixture(
-    params=["in_memory", "cost_aware", "redis", "instrumented", "traced"]
+    params=["in_memory", "cost_aware", "redis", "instrumented", "traced", "native"]
 )
 def index(request):
     if request.param == "in_memory":
@@ -42,6 +42,12 @@ def index(request):
         return RedisIndex(RedisIndexConfig(), client=FakeRedis())
     if request.param == "instrumented":
         return InstrumentedIndex(InMemoryIndex(InMemoryIndexConfig(size=1000)))
+    if request.param == "native":
+        from llmd_kv_cache_tpu.index import native
+
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        return native.NativeIndex(native.NativeIndexConfig(size=10_000, pod_cache_size=4))
     return TracedIndex(InMemoryIndex(InMemoryIndexConfig(size=1000)))
 
 

@@ -1,0 +1,114 @@
+"""Native hash-chain equivalence and native-index specifics."""
+
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.core import (
+    BlockExtraFeatures,
+    ChunkedTokenDatabase,
+    TokenProcessorConfig,
+)
+from llmd_kv_cache_tpu.index import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+
+
+class TestHashEquivalence:
+    @pytest.mark.parametrize("seed", ["", "42", "some-seed"])
+    @pytest.mark.parametrize("model", ["m", "meta-llama/Llama-3.1-8B"])
+    def test_init_hash_matches_python(self, seed, model):
+        py = ChunkedTokenDatabase(
+            TokenProcessorConfig(hash_seed=seed), use_native=False
+        )
+        assert native.hash_init(seed, model) == py._get_init_hash(model)
+
+    def test_chain_matches_python(self):
+        rng = np.random.default_rng(7)
+        for block_size in (1, 4, 16, 64):
+            tokens = rng.integers(0, 2**32 - 1, 256).tolist()
+            py = ChunkedTokenDatabase(
+                TokenProcessorConfig(block_size_tokens=block_size, hash_seed="s"),
+                use_native=False,
+            )
+            nat = ChunkedTokenDatabase(
+                TokenProcessorConfig(block_size_tokens=block_size, hash_seed="s"),
+                use_native=True,
+            )
+            assert nat._native is not None
+            assert py.tokens_to_kv_block_keys(0, tokens, "m") == \
+                nat.tokens_to_kv_block_keys(0, tokens, "m")
+            # explicit parent continuation
+            assert py.tokens_to_kv_block_keys(12345, tokens, "m") == \
+                nat.tokens_to_kv_block_keys(12345, tokens, "m")
+
+    def test_boundary_token_values(self):
+        """CBOR head width changes at 24, 2^8, 2^16, 2^32 boundaries."""
+        tokens = [0, 23, 24, 255, 256, 65535, 65536, 2**32 - 1]
+        py = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size_tokens=8), use_native=False
+        )
+        nat = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size_tokens=8), use_native=True
+        )
+        assert py.tokens_to_kv_block_keys(0, tokens, "m") == \
+            nat.tokens_to_kv_block_keys(0, tokens, "m")
+
+    def test_mm_taint_falls_back_to_python(self):
+        nat = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size_tokens=4), use_native=True
+        )
+        py = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size_tokens=4), use_native=False
+        )
+        features = [BlockExtraFeatures(mm_hashes=["h"])]
+        assert nat.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m", features) == \
+            py.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m", features)
+
+    def test_partial_tail_dropped(self):
+        nat = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size_tokens=4), use_native=True
+        )
+        assert len(nat.tokens_to_kv_block_keys(0, list(range(7)), "m")) == 1
+
+    def test_extra_features_length_mismatch_raises_on_fast_path(self):
+        nat = ChunkedTokenDatabase(
+            TokenProcessorConfig(block_size_tokens=4), use_native=True
+        )
+        with pytest.raises(ValueError, match="does not match"):
+            nat.tokens_to_kv_block_keys(0, list(range(8)), "m", [None])
+
+
+class TestNativeIndexSpecifics:
+    def test_pod_cache_bound(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=100, pod_cache_size=2))
+        idx.add([1], [1], [PodEntry(f"p{i}", "tpu-hbm") for i in range(3)])
+        assert len(idx.lookup([1])[1]) == 2
+
+    def test_outer_lru_eviction(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=4, pod_cache_size=2))
+        for i in range(10):
+            idx.add([i], [i], [PodEntry("p", "tpu-hbm")])
+        assert len(idx) == 4
+        # most recent keys survive
+        assert idx.lookup([9])[9]
+
+    def test_large_lookup_grows_buffer(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=100_000, pod_cache_size=10))
+        idx._lookup_cap = 2  # force growth
+        keys = list(range(1, 200))
+        idx.add(keys, keys, [PodEntry("p", "tpu-hbm")])
+        result = idx.lookup(keys)
+        assert len(result) == len(keys)
