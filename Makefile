@@ -1,0 +1,38 @@
+# Developer entry points. Tests force the CPU backend (tests/conftest.py);
+# `make bench` intentionally runs on whatever accelerator JAX selects (the
+# real TPU chip in the benchmark environment).
+
+PY := python
+CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test unit-test-race native bench graft-check lint clean
+
+test: native
+	$(PY) -m pytest tests/ -q
+
+# Concurrency-focused pass (the reference runs `go test -race` nightly;
+# Python has no race detector, so the thread-heavy suites are repeated —
+# any single failure fails the target, surfacing flaky races instead of
+# hiding them).
+unit-test-race: native
+	for i in 1 2 3; do \
+	  $(PY) -m pytest tests/test_pool.py tests/test_index.py \
+	    tests/test_zmq_integration.py tests/test_evictor.py -q || exit 1; \
+	done
+
+native:
+	$(MAKE) -s -C csrc/kvio
+	$(MAKE) -s -C csrc/kvindex
+
+bench: native
+	$(PY) bench.py
+
+graft-check:
+	$(PY) -c "import __graft_entry__, jax; fn, a = __graft_entry__.entry(); \
+	  print(jax.jit(fn)(*a).shape)"
+	$(CPU_ENV) $(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C csrc/kvio clean
+	$(MAKE) -C csrc/kvindex clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

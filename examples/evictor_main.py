@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Deployment entry point: storage-lifecycle evictor (env-var configured)."""
+
+import signal
+import threading
+
+from llmd_kv_cache_tpu.evictor import Evictor, EvictorConfig
+from llmd_kv_cache_tpu.utils.logging import configure_from_env
+
+
+def main() -> None:
+    configure_from_env()
+    evictor = Evictor(EvictorConfig.from_env())
+    evictor.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    evictor.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Deployment entry point: indexer service (event plane + scoring RPC).
+
+Counterpart of the reference's ``examples/kv_cache_index_service``. Runs the
+sharded event pool with either a centralized bound subscriber or pod
+discovery, and serves ``GetPodScores`` over gRPC.
+
+Usage:
+  python examples/indexer_service_main.py \
+      --zmq-endpoint tcp://0.0.0.0:5557 --grpc-address 0.0.0.0:50051 \
+      --block-size 16 --hash-seed 42 [--discover-pods-file pods.json]
+"""
+
+import argparse
+
+from llmd_kv_cache_tpu.core.token_processor import TokenProcessorConfig
+from llmd_kv_cache_tpu.events.pool import PoolConfig
+from llmd_kv_cache_tpu.events.reconciler import FileDiscovery, PodReconciler
+from llmd_kv_cache_tpu.scoring import IndexerConfig
+from llmd_kv_cache_tpu.services.indexer_service import IndexerService, serve
+from llmd_kv_cache_tpu.utils.logging import configure_from_env
+
+
+def main() -> None:
+    configure_from_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--zmq-endpoint", default="tcp://0.0.0.0:5557")
+    parser.add_argument("--grpc-address", default="0.0.0.0:50051")
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--hash-seed", default="")
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--engine-type", default="vllm")
+    parser.add_argument(
+        "--discover-pods-file", default=None,
+        help="JSON pod map file; enables per-pod subscribers instead of the "
+             "centralized bound endpoint",
+    )
+    args = parser.parse_args()
+
+    discover = args.discover_pods_file is not None
+    service = IndexerService(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=args.block_size, hash_seed=args.hash_seed
+            )
+        ),
+        PoolConfig(
+            zmq_endpoint="" if discover else args.zmq_endpoint,
+            concurrency=args.concurrency,
+            engine_type=args.engine_type,
+        ),
+    )
+    service.start()
+
+    reconciler = None
+    if discover:
+        reconciler = PodReconciler(
+            FileDiscovery(args.discover_pods_file), service.subscriber_manager
+        )
+        reconciler.start()
+
+    server = serve(args.grpc_address, service)
+    try:
+        server.wait_for_termination()
+    finally:
+        if reconciler is not None:
+            reconciler.stop()
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Standalone indexer service: scoring over gRPC + event-plane wiring.
+
+Counterpart of reference ``examples/kv_cache_index_service`` (gRPC
+``IndexerService.GetPodScores``, ``api/indexerpb/indexer.proto:24-43``) and
+the assembled indexer deployment: one process that runs the event pool,
+ZMQ subscribers, and serves scoring RPCs to schedulers that aren't
+in-process (the embedded-library path remains ``scoring.Indexer``).
+
+Wire: msgpack-over-gRPC generic handlers (same convention as the tokenizer
+sidecar).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+import msgpack
+
+from ..events.pool import Pool, PoolConfig
+from ..events.subscriber_manager import SubscriberManager
+from ..events.zmq_subscriber import ZMQSubscriber
+from ..scoring.indexer import Indexer, IndexerConfig
+from ..utils.logging import get_logger
+from ..utils.net import grpc_target
+
+logger = get_logger("services.indexer")
+
+SERVICE_NAME = "kvtpu.indexer.IndexerService"
+
+
+@dataclass
+class ScoreRequest:
+    tokens: list[int]
+    model_name: str
+    pod_identifiers: list[str] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb(
+            {
+                "tokens": self.tokens,
+                "model_name": self.model_name,
+                "pod_identifiers": self.pod_identifiers,
+            },
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "ScoreRequest":
+        d = msgpack.unpackb(b, raw=False)
+        return cls(
+            tokens=list(d.get("tokens", [])),
+            model_name=d.get("model_name", ""),
+            pod_identifiers=list(d.get("pod_identifiers", [])),
+        )
+
+
+@dataclass
+class ScoreResponse:
+    scores: dict[str, float] = field(default_factory=dict)
+    error: str = ""
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb({"scores": self.scores, "error": self.error},
+                             use_bin_type=True)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "ScoreResponse":
+        d = msgpack.unpackb(b, raw=False)
+        return cls(scores=dict(d.get("scores", {})), error=d.get("error", ""))
+
+
+class IndexerService:
+    """Assembles indexer + event pool + subscribers; serves GetPodScores."""
+
+    def __init__(
+        self,
+        indexer_config: Optional[IndexerConfig] = None,
+        pool_config: Optional[PoolConfig] = None,
+    ):
+        self.indexer = Indexer(indexer_config)
+        self.pool_config = pool_config or PoolConfig()
+        self.pool = Pool(
+            self.pool_config, self.indexer.kv_block_index, self.indexer.token_processor
+        )
+        self.subscriber_manager = SubscriberManager(
+            self.pool.add_task, topic_filter=self.pool_config.topic_filter
+        )
+        self._central_subscriber: Optional[ZMQSubscriber] = None
+
+    def start(self) -> None:
+        """Start the event plane: workers plus, in centralized mode, a
+        bound subscriber every engine connects to."""
+        self.pool.start()
+        if self.pool_config.zmq_endpoint:
+            self._central_subscriber = ZMQSubscriber(
+                self.pool_config.zmq_endpoint,
+                self.pool_config.topic_filter,
+                self.pool.add_task,
+                bind=True,
+            )
+            self._central_subscriber.start()
+
+    def stop(self) -> None:
+        if self._central_subscriber is not None:
+            self._central_subscriber.stop()
+        self.subscriber_manager.shutdown()
+        self.pool.shutdown()
+
+    # -- RPC --
+
+    def get_pod_scores(self, req: ScoreRequest) -> ScoreResponse:
+        try:
+            scores = self.indexer.score_tokens(
+                req.tokens,
+                req.model_name,
+                set(req.pod_identifiers) if req.pod_identifiers else None,
+            )
+            return ScoreResponse(scores=scores)
+        except Exception as e:
+            logger.exception("GetPodScores failed")
+            return ScoreResponse(error=str(e))
+
+
+def serve(
+    address: str,
+    service: IndexerService,
+    max_workers: int = 16,
+) -> grpc.Server:
+    """Serve GetPodScores on ``address`` (host:port or unix:path)."""
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "GetPodScores": grpc.unary_unary_rpc_method_handler(
+                lambda req, _ctx: service.get_pod_scores(req),
+                request_deserializer=ScoreRequest.from_bytes,
+                response_serializer=lambda r: r.to_bytes(),
+            )
+        },
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(grpc_target(address))
+    server.start()
+    logger.info("indexer service on %s", address)
+    return server
+
+
+class IndexerServiceClient:
+    """Scheduler-side client for GetPodScores."""
+
+    def __init__(self, address: str, timeout_s: float = 5.0):
+        self._channel = grpc.insecure_channel(grpc_target(address))
+        self._timeout = timeout_s
+        self._get_pod_scores = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/GetPodScores",
+            request_serializer=lambda r: r.to_bytes(),
+            response_deserializer=ScoreResponse.from_bytes,
+        )
+
+    def get_pod_scores(
+        self,
+        tokens: list[int],
+        model_name: str,
+        pod_identifiers: Optional[list[str]] = None,
+    ) -> dict[str, float]:
+        resp = self._get_pod_scores(
+            ScoreRequest(
+                tokens=list(tokens),
+                model_name=model_name,
+                pod_identifiers=list(pod_identifiers or []),
+            ),
+            timeout=self._timeout,
+        )
+        if resp.error:
+            raise RuntimeError(f"GetPodScores failed: {resp.error}")
+        return resp.scores
+
+    def close(self) -> None:
+        self._channel.close()

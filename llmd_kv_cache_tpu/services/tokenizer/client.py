@@ -17,6 +17,7 @@ import grpc
 
 from ...core.extra_keys import BlockExtraFeatures, PlaceholderRange, compute_block_extra_features
 from ...utils.logging import get_logger
+from ...utils.net import grpc_target
 from .messages import (
     ChatMessage,
     InitializeTokenizerRequest,
@@ -39,13 +40,8 @@ class UdsTokenizerClient:
     """Blocking client for the tokenizer sidecar."""
 
     def __init__(self, address: str, timeout_s: float = 30.0):
-        # Bare filesystem paths become unix: targets; host:port strings are
-        # dialed as TCP (test servers); explicit schemes pass through.
-        if "://" not in address and not address.startswith("unix:"):
-            if ":" not in address or address.startswith("/"):
-                address = f"unix:{address}"
         self._channel = grpc.insecure_channel(
-            address,
+            grpc_target(address),
             options=[
                 ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
                 ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),

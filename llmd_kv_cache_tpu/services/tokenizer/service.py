@@ -25,6 +25,7 @@ from typing import Optional
 import grpc
 
 from ...utils.logging import get_logger
+from ...utils.net import grpc_target
 from .backends import TokenizerRegistry
 from .messages import (
     InitializeTokenizerRequest,
@@ -247,10 +248,7 @@ def serve_uds(
         ],
     )
     server.add_generic_rpc_handlers((_make_grpc_handler(service),))
-    address = socket_path if socket_path.startswith("unix:") or ":" in socket_path \
-        else f"unix:{socket_path}"
-    if address.startswith("/"):
-        address = f"unix:{address}"
+    address = grpc_target(socket_path)
     server.add_insecure_port(address)
     server.start()
     logger.info("tokenizer service on %s", address)

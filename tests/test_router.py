@@ -1,0 +1,83 @@
+"""KV-aware router tests: speculative convergence, TTL expiry, fallback."""
+
+from llmd_kv_cache_tpu.core import PodEntry, TokenProcessorConfig
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+from llmd_kv_cache_tpu.scoring.router import KVAwareRouter, RouterConfig
+
+BLOCK = 4
+
+
+def make_router(pods=("pod-a", "pod-b"), **cfg):
+    indexer = Indexer(
+        IndexerConfig(token_processor_config=TokenProcessorConfig(block_size_tokens=BLOCK)),
+        index=InMemoryIndex(InMemoryIndexConfig(size=1000)),
+    )
+    return KVAwareRouter(indexer, list(pods), RouterConfig(**cfg))
+
+
+class TestRouting:
+    def test_round_robin_when_cold(self):
+        router = make_router()
+        tokens_a, tokens_b = list(range(100, 108)), list(range(200, 208))
+        assert router.route(tokens_a, "m") == "pod-a"
+        assert router.route(tokens_b, "m") == "pod-b"
+
+    def test_speculative_convergence(self):
+        """Identical prompts route to the same pod before any KV event."""
+        router = make_router()
+        tokens = list(range(8))
+        first = router.route(tokens, "m")
+        for _ in range(3):
+            assert router.route(tokens, "m") == first
+
+    def test_confirmed_residency_wins(self):
+        router = make_router()
+        tokens = list(range(8))
+        keys = router.indexer.compute_block_keys(tokens, "m")
+        router.indexer.kv_block_index.add(keys, keys, [PodEntry("pod-b", "tpu-hbm")])
+        assert router.route(tokens, "m") == "pod-b"
+
+    def test_speculative_ttl_expiry(self):
+        router = make_router(speculative_ttl_s=0.0)  # expire immediately
+        tokens = list(range(8))
+        router.route(tokens, "m")
+        router._expire_speculative()
+        assert router.indexer.score_tokens(tokens, "m") == {}
+
+    def test_weighted_scores(self):
+        router = make_router(kv_score_weight=3.0)
+        tokens = list(range(8))
+        keys = router.indexer.compute_block_keys(tokens, "m")
+        router.indexer.kv_block_index.add(keys, keys, [PodEntry("pod-a", "tpu-hbm")])
+        assert router.scores(tokens, "m") == {"pod-a": 6.0}
+
+    def test_set_pods(self):
+        router = make_router(pods=("pod-a",))
+        router.set_pods(["pod-c"])
+        assert router.route(list(range(300, 308)), "m") == "pod-c"
+
+    def test_empty_pod_list_raises(self):
+        import pytest
+
+        router = make_router()
+        # stale residency for a drained pod must not be routable
+        tokens = list(range(8))
+        keys = router.indexer.compute_block_keys(tokens, "m")
+        router.indexer.kv_block_index.add(keys, keys, [PodEntry("stale", "tpu-hbm")])
+        router.set_pods([])
+        with pytest.raises(RuntimeError, match="no candidate pods"):
+            router.route(tokens, "m")
+
+    def test_speculative_refresh_extends_ttl(self):
+        """A re-route of the same prompt must refresh the TTL, not leave a
+        stale record that evicts the refreshed residency early."""
+        import time as _time
+
+        router = make_router(speculative_ttl_s=0.2)
+        tokens = list(range(8))
+        first = router.route(tokens, "m")
+        _time.sleep(0.15)
+        assert router.route(tokens, "m") == first  # refresh at t=0.15
+        _time.sleep(0.1)  # t=0.25: original TTL passed, refreshed one hasn't
+        assert router.route(tokens, "m") == first

@@ -1,0 +1,141 @@
+"""Indexer gRPC service + pod reconciler tests."""
+
+import json
+import time
+
+import pytest
+
+from llmd_kv_cache_tpu.core import TokenProcessorConfig
+from llmd_kv_cache_tpu.core.token_processor import ChunkedTokenDatabase
+from llmd_kv_cache_tpu.events.model import BlockStoredEvent, EventBatch
+from llmd_kv_cache_tpu.events.pool import PoolConfig
+from llmd_kv_cache_tpu.events.reconciler import (
+    FileDiscovery,
+    PodReconciler,
+    StaticDiscovery,
+)
+from llmd_kv_cache_tpu.events.subscriber_manager import SubscriberManager
+from llmd_kv_cache_tpu.scoring import IndexerConfig
+from llmd_kv_cache_tpu.services.indexer_service import (
+    IndexerService,
+    IndexerServiceClient,
+    serve,
+)
+
+BLOCK = 4
+
+
+class TestIndexerService:
+    @pytest.fixture
+    def service_stack(self, tmp_path):
+        svc = IndexerService(
+            IndexerConfig(
+                token_processor_config=TokenProcessorConfig(block_size_tokens=BLOCK)
+            ),
+            PoolConfig(concurrency=1),
+        )
+        svc.start()
+        sock = str(tmp_path / "indexer.sock")
+        server = serve(sock, svc)
+        client = IndexerServiceClient(sock)
+        yield svc, client
+        client.close()
+        server.stop(grace=None)
+        svc.stop()
+
+    def test_get_pod_scores_rpc(self, service_stack):
+        svc, client = service_stack
+        tokens = list(range(8))
+        # feed events through the pool (as the ZMQ wire would)
+        svc.pool.process_event_batch(
+            EventBatch(timestamp=0.0, events=[
+                BlockStoredEvent(block_hashes=[1, 2], tokens=tokens,
+                                 parent_hash=0, block_size=BLOCK)
+            ]),
+            "pod-a", "m",
+        )
+        scores = client.get_pod_scores(tokens, "m")
+        assert scores == {"pod-a": 2.0}
+
+    def test_pod_filter(self, service_stack):
+        svc, client = service_stack
+        tokens = list(range(8))
+        for pod in ("pod-a", "pod-b"):
+            svc.pool.process_event_batch(
+                EventBatch(timestamp=0.0, events=[
+                    BlockStoredEvent(block_hashes=[1, 2], tokens=tokens,
+                                     parent_hash=0, block_size=BLOCK)
+                ]),
+                pod, "m",
+            )
+        scores = client.get_pod_scores(tokens, "m", pod_identifiers=["pod-b"])
+        assert set(scores) == {"pod-b"}
+
+    def test_cold_scores_empty(self, service_stack):
+        _, client = service_stack
+        assert client.get_pod_scores(list(range(8)), "m") == {}
+
+
+class TestPodReconciler:
+    def test_static_reconcile(self):
+        mgr = SubscriberManager(lambda msg: None)
+        try:
+            source = StaticDiscovery({"pod-a": "tcp://127.0.0.1:15901"})
+            rec = PodReconciler(source, mgr)
+            added, removed = rec.reconcile_once()
+            assert (added, removed) == (1, 0)
+            assert mgr.pods() == ["pod-a"]
+
+            # pod replaced
+            source.set({"pod-b": "tcp://127.0.0.1:15902"})
+            added, removed = rec.reconcile_once()
+            assert (added, removed) == (1, 1)
+            assert mgr.pods() == ["pod-b"]
+
+            # idempotent
+            assert rec.reconcile_once() == (0, 0)
+        finally:
+            mgr.shutdown()
+
+    def test_file_discovery(self, tmp_path):
+        path = tmp_path / "pods.json"
+        disc = FileDiscovery(str(path))
+        assert disc.discover() == {}
+        path.write_text(json.dumps({"pod-x": "tcp://10.0.0.1:5557"}))
+        assert disc.discover() == {"pod-x": "tcp://10.0.0.1:5557"}
+        path.write_text("not json")
+        assert disc.discover() == {}
+
+    def test_reconciler_loop(self, tmp_path):
+        path = tmp_path / "pods.json"
+        path.write_text(json.dumps({"pod-a": "tcp://127.0.0.1:15903"}))
+        mgr = SubscriberManager(lambda msg: None)
+        rec = PodReconciler(FileDiscovery(str(path)), mgr, interval_s=0.05)
+        try:
+            rec.start()
+            deadline = time.monotonic() + 3
+            while "pod-a" not in mgr.pods() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert "pod-a" in mgr.pods()
+            path.write_text("{}")
+            deadline = time.monotonic() + 3
+            while mgr.pods() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert mgr.pods() == []
+        finally:
+            rec.stop()
+            mgr.shutdown()
+
+    def test_discovery_failure_keeps_subscribers(self):
+        class FailingSource:
+            def discover(self):
+                raise RuntimeError("api down")
+
+        mgr = SubscriberManager(lambda msg: None)
+        try:
+            mgr.ensure_subscriber("pod-a", "tcp://127.0.0.1:15904")
+            rec = PodReconciler(FailingSource(), mgr)
+            assert rec.reconcile_once() == (0, 0)
+            assert mgr.pods() == ["pod-a"]  # not wiped on discovery outage
+        finally:
+            mgr.shutdown()
