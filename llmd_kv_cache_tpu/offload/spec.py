@@ -43,6 +43,12 @@ class SharedStorageOffloadSpec:
     parallel_agnostic: bool = False
     events_endpoint: Optional[str] = None
     mesh: Optional[object] = None  # jax.sharding.Mesh
+    # Backend selection: "posix" (native kvio file engine) or "object"
+    # (S3-style store via offload.object_store — the reference's NIXL OBJ
+    # equivalent). For "object", ``object_store_client`` may inject any
+    # ObjectStoreClient; default is the directory-backed client at ``root``.
+    backend: str = "posix"
+    object_store_client: Optional[object] = None
 
     @classmethod
     def from_extra_config(cls, extra: dict) -> "SharedStorageOffloadSpec":
@@ -74,6 +80,7 @@ class SharedStorageOffloadSpec:
                 "parallelAgnostic", "parallel_agnostic", default=False
             ),
             events_endpoint=get("eventsEndpoint", "events_endpoint"),
+            backend=get("backend", default="posix"),
         )
 
     def build_mapper(self) -> FileMapper:
@@ -92,21 +99,57 @@ class SharedStorageOffloadSpec:
             )
         )
 
-    def get_manager(self) -> SharedStorageOffloadManager:
-        """Scheduler-side (rank 0) manager with optional event publishing."""
-        publisher = None
-        if self.events_endpoint:
-            publisher = StorageEventPublisher(
-                self.events_endpoint, self.model_name, bind=False
-            )
-        return SharedStorageOffloadManager(
-            self.build_mapper(), publisher, block_size_tokens=self.page_size
+    def _object_pieces(self):
+        from .object_store import FSObjectStoreClient, ObjectKeyMapper
+
+        client = self.object_store_client or FSObjectStoreClient(self.root)
+        mapper = ObjectKeyMapper(
+            prefix="kv",
+            fingerprint=self.build_mapper().fingerprint,
+            rank=self.rank,
+            parallel_agnostic=self.parallel_agnostic,
+        )
+        return client, mapper
+
+    def _publisher(self, medium: str) -> Optional[StorageEventPublisher]:
+        if not self.events_endpoint:
+            return None
+        return StorageEventPublisher(
+            self.events_endpoint, self.model_name, medium=medium, bind=False
         )
 
-    def get_handlers(self, k_cache: jax.Array, v_cache: jax.Array) -> OffloadHandlers:
+    def get_manager(self):
+        """Scheduler-side (rank 0) manager with optional event publishing."""
+        if self.backend == "object":
+            from ..events.publisher import MEDIUM_OBJECT_STORE
+            from .object_store import ObjectStoreOffloadManager
+
+            client, mapper = self._object_pieces()
+            return ObjectStoreOffloadManager(
+                client, mapper,
+                event_publisher=self._publisher(MEDIUM_OBJECT_STORE),
+                block_size_tokens=self.page_size,
+            )
+        from ..events.publisher import MEDIUM_SHARED_STORAGE
+
+        return SharedStorageOffloadManager(
+            self.build_mapper(),
+            self._publisher(MEDIUM_SHARED_STORAGE),
+            block_size_tokens=self.page_size,
+        )
+
+    def get_handlers(self, k_cache: jax.Array, v_cache: jax.Array):
         """Worker-side handlers bound to this worker's cache pools."""
+        copier = TPUBlockCopier(k_cache, v_cache)
+        if self.backend == "object":
+            from .object_store import ObjectStoreOffloadHandlers
+
+            client, mapper = self._object_pieces()
+            return ObjectStoreOffloadHandlers(
+                copier, client, mapper, io_threads=self.io_threads
+            )
         return OffloadHandlers(
-            TPUBlockCopier(k_cache, v_cache),
+            copier,
             self.build_mapper(),
             io_threads=self.io_threads,
             read_preferring_ratio=self.read_preferring_ratio,

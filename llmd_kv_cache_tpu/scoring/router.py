@@ -46,11 +46,11 @@ class KVAwareRouter:
         self.config = config or RouterConfig()
         self._rr_counter = 0
         self._lock = threading.Lock()
-        # (pod, key-chain) → expiry of outstanding speculative inserts;
-        # keyed (not a list) so a refresh for the same prompt extends the
-        # TTL instead of leaving a stale earlier record that would evict
-        # the refreshed entry prematurely.
-        self._speculative: dict[tuple[str, tuple[int, ...]], float] = {}
+        # (pod, block-key) → expiry of outstanding speculative inserts;
+        # keyed per block (not per chain) so overlapping prompts sharing a
+        # prefix refresh the shared keys' TTLs — a shorter prompt's expiry
+        # must never evict keys still covered by a longer prompt's record.
+        self._speculative: dict[tuple[str, int], float] = {}
 
     def set_pods(self, pods: Sequence[str]) -> None:
         with self._lock:
@@ -101,10 +101,10 @@ class KVAwareRouter:
         except Exception:
             logger.exception("speculative add failed")
             return
+        expiry = time.monotonic() + self.config.speculative_ttl_s
         with self._lock:
-            self._speculative[(pod, tuple(keys))] = (
-                time.monotonic() + self.config.speculative_ttl_s
-            )
+            for key in keys:
+                self._speculative[(pod, key)] = expiry
 
     def _expire_speculative(self) -> None:
         now = time.monotonic()
@@ -112,13 +112,10 @@ class KVAwareRouter:
             expired = [k for k, expiry in self._speculative.items() if expiry <= now]
             for k in expired:
                 del self._speculative[k]
-        for pod, keys in expired:
+        for pod, key in expired:
             entry = PodEntry(pod_identifier=pod, device_tier=TIER_TPU_HBM,
                              speculative=True)
-            for key in keys:
-                try:
-                    self.indexer.kv_block_index.evict(
-                        key, KeyType.REQUEST, [entry]
-                    )
-                except Exception:
-                    logger.debug("speculative evict failed for key %d", key)
+            try:
+                self.indexer.kv_block_index.evict(key, KeyType.REQUEST, [entry])
+            except Exception:
+                logger.debug("speculative evict failed for key %d", key)

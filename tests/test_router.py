@@ -69,6 +69,19 @@ class TestRouting:
         with pytest.raises(RuntimeError, match="no candidate pods"):
             router.route(tokens, "m")
 
+    def test_prefix_sharing_prompts_keep_shared_keys(self):
+        """A shorter prompt's TTL expiry must not evict speculative keys
+        still covered by a longer overlapping prompt."""
+        import time as _time
+
+        router = make_router(speculative_ttl_s=0.15)
+        short, long_ = list(range(8)), list(range(12))
+        first = router.route(short, "m")
+        _time.sleep(0.1)
+        assert router.route(long_, "m") == first  # shares the 2-block prefix
+        _time.sleep(0.1)  # short's record expired; long's refresh is live
+        assert router.route(long_, "m") == first
+
     def test_speculative_refresh_extends_ttl(self):
         """A re-route of the same prompt must refresh the TTL, not leave a
         stale record that evicts the refreshed residency early."""
