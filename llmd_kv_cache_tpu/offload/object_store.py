@@ -236,8 +236,9 @@ class ObjectStoreOffloadHandlers:
                 continue
             slab = self.copier.gather_to_host(list(page_ids))
             key = self.mapper.block_key(block_hash, group_idx)
-            # ndarrays satisfy the buffer protocol: no tobytes() copy.
-            data = memoryview(slab).cast("B")
+            # Zero-copy byte view (bfloat16 etc. lack the buffer protocol,
+            # so reinterpret as uint8 first).
+            data = memoryview(np.ascontiguousarray(slab).view(np.uint8).reshape(-1))
             job.nbytes += len(data)
             fut = self._executor.submit(self.client.put, key, data)
             fut.add_done_callback(self._put_released)
