@@ -34,7 +34,13 @@ from ..events.model import (
     GenericEvent,
 )
 from ..utils.logging import get_logger
-from .llama import LlamaConfig, forward, init_kv_cache, init_params
+from .llama import (
+    LlamaConfig,
+    forward,
+    forward_decode_pallas,
+    init_kv_cache,
+    init_params,
+)
 
 logger = get_logger("models.engine")
 
@@ -50,6 +56,10 @@ class EngineConfig:
     hash_seed: str = ""
     model_name: str = "tiny-llama"
     pod_identifier: str = "pod-0"
+    # Decode attention backend: None = auto (Pallas flash-decode on TPU,
+    # XLA reference elsewhere); True forces Pallas (interpreted on CPU);
+    # False forces the XLA path.
+    use_pallas_decode: Optional[bool] = None
 
 
 @dataclass
@@ -261,6 +271,21 @@ class MiniEngine:
         self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
         self.requests: dict[str, Request] = {}
         self._running: list[str] = []
+
+        # Resolve the decode attention backend once (the platform cannot
+        # change over the engine's lifetime).
+        use_pallas = self.cfg.use_pallas_decode
+        on_tpu = jax.devices()[0].platform == "tpu"
+        if use_pallas is None:
+            use_pallas = on_tpu
+        if use_pallas:
+            import functools
+
+            self._decode_forward = functools.partial(
+                forward_decode_pallas, interpret=not on_tpu
+            )
+        else:
+            self._decode_forward = forward
 
         # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
         # write-through on commit, restore on prefix miss at admission.
@@ -565,7 +590,7 @@ class MiniEngine:
             new_lens[i] = 1
             tables[i] = self._page_table_for(req)
 
-        logits, self.k_cache, self.v_cache = forward(
+        logits, self.k_cache, self.v_cache = self._decode_forward(
             self.params, self.cfg.model,
             jnp.asarray(tokens), self.k_cache, self.v_cache,
             jnp.asarray(tables),

@@ -110,24 +110,10 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     ).astype(x.dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
-def forward(
-    params: Params,
-    cfg: LlamaConfig,
-    tokens: jax.Array,  # [batch, seq] int32 (padded)
-    k_cache: jax.Array,  # [layers, pages, page_size, kvh, hd] (donated)
-    v_cache: jax.Array,  # same (donated)
-    page_table: jax.Array,  # [batch, pages_per_seq] int32
-    ctx_lens: jax.Array,  # [batch] tokens already cached before this call
-    new_lens: jax.Array,  # [batch] valid new tokens in `tokens`
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One model step (prefill or decode).
-
-    Returns ``(logits [b, seq, vocab], k_cache, v_cache)``. Query i of
-    sequence b sits at logical position ``ctx_lens[b] + i``; padded
-    positions (``i >= new_lens[b]``) are masked and scatter to the garbage
-    page.
-    """
+def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
+                  ctx_lens, new_lens, attention_fn):
+    """Shared transformer body; ``attention_fn(q, k_l, v_l, page_table,
+    positions, total_lens) -> [b, seq, heads, hd]`` picks the backend."""
     batch, seq = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
     valid = jnp.arange(seq)[None, :] < new_lens[:, None]
@@ -153,7 +139,7 @@ def forward(
             scatter_kv_pages(v_cache[li], v, page_table, positions, valid)
         )
 
-        attn = paged_attention(
+        attn = attention_fn(
             q, k_cache[li], v_cache[li], page_table, positions, total_lens
         )
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
@@ -166,3 +152,63 @@ def forward(
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, k_cache, v_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [batch, seq] int32 (padded)
+    k_cache: jax.Array,  # [layers, pages, page_size, kvh, hd] (donated)
+    v_cache: jax.Array,  # same (donated)
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+    ctx_lens: jax.Array,  # [batch] tokens already cached before this call
+    new_lens: jax.Array,  # [batch] valid new tokens in `tokens`
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One model step (prefill or decode), XLA attention backend.
+
+    Returns ``(logits [b, seq, vocab], k_cache, v_cache)``. Query i of
+    sequence b sits at logical position ``ctx_lens[b] + i``; padded
+    positions (``i >= new_lens[b]``) are masked and scatter to the garbage
+    page.
+    """
+    return _forward_impl(
+        params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
+        paged_attention,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "interpret"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def forward_decode_pallas(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [batch, 1] int32
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, pages_per_seq]
+    ctx_lens: jax.Array,  # [batch]
+    new_lens: jax.Array,  # [batch] 1 for live rows, 0 for padding
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Decode step (seq == 1) using the Pallas flash-decode kernel.
+
+    Same semantics as ``forward``; streaming pages HBM→VMEM in-kernel
+    avoids materializing the gathered KV — the long-context win over the
+    XLA reference path.
+    """
+    from ..ops.pallas_paged_attention import pallas_paged_decode_attention
+
+    def pallas_attention(q, k_l, v_l, table, _positions, total_lens):
+        out = pallas_paged_decode_attention(
+            q[:, 0], k_l, v_l, table, total_lens, interpret=interpret
+        )
+        return out[:, None]  # restore the seq axis
+
+    return _forward_impl(
+        params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
+        pallas_attention,
+    )

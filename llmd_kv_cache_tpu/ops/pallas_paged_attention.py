@@ -1,0 +1,178 @@
+"""Pallas TPU kernel: flash-decode attention over a paged KV cache.
+
+The decode-step fast path (seq == 1): instead of materializing each
+sequence's gathered KV ([batch, pages*page_size, heads, dim] in HBM, which
+``ops.paged_attention`` does and which wastes HBM bandwidth on long
+contexts), each (batch, kv_head) program streams the sequence's pages
+HBM→VMEM with double-buffered async DMA and folds them into an online
+softmax — the ragged-paged-attention recipe specialized to decode.
+
+Grid: ``(batch, kv_heads)``. Scalar-prefetched page table + context lengths
+drive the DMA indices (``PrefetchScalarGridSpec``). GQA: each program
+serves its kv head's whole query group.
+
+The jnp reference path remains the fallback (CPU tests run this kernel in
+interpreter mode against it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [batch, pages_per_seq] int32 (SMEM)
+    ctx_lens_ref,  # [batch] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, 1, group, head_dim] VMEM block for (b, h)
+    k_hbm,  # [num_pages, page_size, kv_heads, head_dim] (ANY/HBM)
+    v_hbm,  # same
+    # output
+    o_ref,  # [1, 1, group, head_dim] VMEM block
+    # scratch
+    k_scratch,  # [2, page_size, head_dim] VMEM
+    v_scratch,  # [2, page_size, head_dim] VMEM
+    sem,  # DMA semaphores [2, 2]
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    group, head_dim = q_ref.shape[2], q_ref.shape[3]
+
+    ctx_len = ctx_lens_ref[b]
+    num_pages = (ctx_len + page_size - 1) // page_size
+
+    def page_dma(slot, page_idx):
+        page = page_table_ref[b, page_idx]
+        k_copy = pltpu.make_async_copy(
+            k_hbm.at[page, :, h, :], k_scratch.at[slot], sem.at[slot, 0]
+        )
+        v_copy = pltpu.make_async_copy(
+            v_hbm.at[page, :, h, :], v_scratch.at[slot], sem.at[slot, 1]
+        )
+        return k_copy, v_copy
+
+    @pl.when(num_pages > 0)
+    def _():
+        for c in page_dma(0, 0):
+            c.start()
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, head_dim]
+
+    def body(i, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = i % 2
+        next_slot = (i + 1) % 2
+
+        @pl.when(i + 1 < num_pages)
+        def _():
+            for c in page_dma(next_slot, i + 1):
+                c.start()
+
+        for c in page_dma(slot, i):
+            c.wait()
+
+        k = k_scratch[slot].astype(jnp.float32)  # [page_size, head_dim]
+        v = v_scratch[slot].astype(jnp.float32)
+
+        scores = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [group, page_size]
+
+        # mask slots beyond the context length on the last page
+        positions = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        scores = jnp.where(positions < ctx_len, scores, _NEG_INF)
+
+        m_cur = jnp.max(scores, axis=1, keepdims=True)  # [group, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)  # [group, page_size]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((group, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, 1), jnp.float32)
+    acc0 = jnp.zeros((group, head_dim), jnp.float32)
+    _m, l_fin, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l_fin, 1e-30)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_paged_decode_attention(
+    q: jax.Array,  # [batch, q_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    v_cache: jax.Array,  # same
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+    ctx_lens: jax.Array,  # [batch] int32 (keys to attend per sequence)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
+
+    The page size is the cache's native page dimension — the DMA tiles and
+    mask arithmetic are derived from it, so no override is offered.
+    """
+    batch, q_heads, head_dim = q.shape
+    num_pages_total, page_size, kv_heads, _ = k_cache.shape
+    group = q_heads // kv_heads
+
+    q_blocked = q.reshape(batch, kv_heads, group, head_dim)
+
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, scale=head_dim ** -0.5
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, kv_heads),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group, head_dim),
+                # scalar-prefetch refs are appended to index_map args
+                lambda b, h, *_prefetch: (b, h, 0, 0),
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, head_dim),
+            lambda b, h, *_prefetch: (b, h, 0, 0),
+        ),
+        scratch_shapes=[
+            # DMA staging must match the cache dtype; upcast after load.
+            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
+            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, kv_heads, group, head_dim), q.dtype
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q_blocked, k_cache, v_cache)
+
+    return out.reshape(batch, q_heads, head_dim)

@@ -1,0 +1,20 @@
+"""Engine decode equivalence: Pallas flash-decode vs XLA reference path."""
+
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+
+def test_pallas_decode_matches_xla_path():
+    prompt = list(range(40, 52))
+    outs = {}
+    for use_pallas in (False, True):
+        engine = MiniEngine(
+            EngineConfig(
+                model=LlamaConfig.tiny(), num_pages=64, max_pages_per_seq=16,
+                model_name="tiny", pod_identifier="p",
+                use_pallas_decode=use_pallas,
+            ),
+            seed=0,
+        )
+        outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=4)
+    assert outs[False] == outs[True]
