@@ -17,6 +17,7 @@ from. Evictions are LRU over unreferenced pages and emit BlockRemoved.
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
@@ -25,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.hma import SPEC_FULL_ATTENTION, SPEC_SLIDING_WINDOW
 from ..core.keys import EMPTY_BLOCK_HASH
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from ..events.model import (
@@ -106,6 +108,20 @@ class BlockManager:
         self.free_pages: list[int] = list(range(1, cfg.num_pages))  # 0 reserved
         self.blocks: dict[int, _BlockInfo] = {}  # block_hash → info
         self.page_to_hash: dict[int, int] = {}
+        # KV-cache spec advertised in events (HMA group 0). The pool is
+        # unified across layers, so the spec is sliding_window only when
+        # every layer is SWA; any full-attention layer makes full retention
+        # the controlling constraint.
+        mcfg = cfg.model
+        if (
+            mcfg.sliding_window is not None
+            and set(mcfg.swa_layers) >= set(range(mcfg.num_layers))
+        ):
+            self.spec_kind = SPEC_SLIDING_WINDOW
+            self.spec_window: Optional[int] = mcfg.sliding_window
+        else:
+            self.spec_kind = SPEC_FULL_ATTENTION
+            self.spec_window = None
 
     # -- accounting --
 
@@ -197,6 +213,9 @@ class BlockManager:
                         tokens=list(run_tokens),
                         parent_hash=run_parent,
                         block_size=self.processor.block_size,
+                        group_idx=0,
+                        kv_cache_spec_kind=self.spec_kind,
+                        kv_cache_spec_sliding_window=self.spec_window,
                     )
                 )
             run_hashes, run_tokens = [], []
@@ -279,8 +298,6 @@ class MiniEngine:
         if use_pallas is None:
             use_pallas = on_tpu
         if use_pallas:
-            import functools
-
             self._decode_forward = functools.partial(
                 forward_decode_pallas, interpret=not on_tpu
             )

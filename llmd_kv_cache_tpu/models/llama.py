@@ -39,6 +39,16 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     page_size: int = 16
     dtype: Any = jnp.bfloat16
+    # Hybrid attention: layers listed in ``swa_layers`` use a sliding
+    # window of ``sliding_window`` keys (Mistral/Gemma-style); the rest are
+    # full attention. Both unset → pure full attention.
+    sliding_window: Any = None  # Optional[int]
+    swa_layers: tuple = ()
+
+    def layer_window(self, layer_idx: int):
+        if self.sliding_window is not None and layer_idx in self.swa_layers:
+            return self.sliding_window
+        return None
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -140,7 +150,8 @@ def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
         )
 
         attn = attention_fn(
-            q, k_cache[li], v_cache[li], page_table, positions, total_lens
+            q, k_cache[li], v_cache[li], page_table, positions, total_lens,
+            cfg.layer_window(li),
         )
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
 
@@ -172,9 +183,14 @@ def forward(
     positions (``i >= new_lens[b]``) are masked and scatter to the garbage
     page.
     """
+    def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
+        return paged_attention(
+            q, k_l, v_l, table, positions, total_lens, sliding_window=window
+        )
+
     return _forward_impl(
         params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
-        paged_attention,
+        xla_attention,
     )
 
 
@@ -202,9 +218,10 @@ def forward_decode_pallas(
     """
     from ..ops.pallas_paged_attention import pallas_paged_decode_attention
 
-    def pallas_attention(q, k_l, v_l, table, _positions, total_lens):
+    def pallas_attention(q, k_l, v_l, table, _positions, total_lens, window):
         out = pallas_paged_decode_attention(
-            q[:, 0], k_l, v_l, table, total_lens, interpret=interpret
+            q[:, 0], k_l, v_l, table, total_lens,
+            sliding_window=window, interpret=interpret,
         )
         return out[:, None]  # restore the seq axis
 

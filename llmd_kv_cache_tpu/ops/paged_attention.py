@@ -29,11 +29,14 @@ def paged_attention(
     q_positions: jax.Array,  # [batch, q_seq] logical position of each query
     total_lens: jax.Array,  # [batch] total tokens (context + new) per sequence
     scale: float | None = None,
+    sliding_window: int | None = None,
 ) -> jax.Array:
     """Causal attention of new queries against paged KV (cached + new).
 
     The KV for the new tokens must already be scattered into the cache.
-    Returns ``[batch, q_seq, q_heads, head_dim]`` in the query dtype.
+    ``sliding_window=W`` restricts each query to the last W keys (SWA
+    layers of hybrid-attention models). Returns
+    ``[batch, q_seq, q_heads, head_dim]`` in the query dtype.
     """
     batch, q_seq, q_heads, head_dim = q.shape
     _, page_size, kv_heads, _ = k_cache.shape
@@ -59,9 +62,10 @@ def paged_attention(
 
     k_pos = jnp.arange(kv_len)[None, None, None, :]  # logical key positions
     q_pos = q_positions[:, None, :, None]
-    causal = k_pos <= q_pos
-    in_bounds = k_pos < total_lens[:, None, None, None]
-    logits = jnp.where(causal & in_bounds, logits, _NEG_INF)
+    mask = (k_pos <= q_pos) & (k_pos < total_lens[:, None, None, None])
+    if sliding_window is not None:
+        mask = mask & (q_pos - k_pos < sliding_window)
+    logits = jnp.where(mask, logits, _NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)

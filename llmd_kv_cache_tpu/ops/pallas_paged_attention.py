@@ -44,6 +44,7 @@ def _decode_kernel(
     *,
     page_size: int,
     scale: float,
+    sliding_window: int | None,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -51,6 +52,13 @@ def _decode_kernel(
 
     ctx_len = ctx_lens_ref[b]
     num_pages = (ctx_len + page_size - 1) // page_size
+    # SWA: pages entirely outside the window are skipped, so long contexts
+    # stream only ~window/page_size pages.
+    if sliding_window is not None:
+        first_pos = jnp.maximum(ctx_len - sliding_window, 0)
+        first_page = first_pos // page_size
+    else:
+        first_page = 0
 
     def page_dma(slot, page_idx):
         page = page_table_ref[b, page_idx]
@@ -62,17 +70,17 @@ def _decode_kernel(
         )
         return k_copy, v_copy
 
-    @pl.when(num_pages > 0)
+    @pl.when(num_pages > first_page)
     def _():
-        for c in page_dma(0, 0):
+        for c in page_dma(0, first_page):
             c.start()
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, head_dim]
 
     def body(i, carry):
         m_prev, l_prev, acc_prev = carry
-        slot = i % 2
-        next_slot = (i + 1) % 2
+        slot = (i - first_page) % 2
+        next_slot = (i - first_page + 1) % 2
 
         @pl.when(i + 1 < num_pages)
         def _():
@@ -90,11 +98,15 @@ def _decode_kernel(
             preferred_element_type=jnp.float32,
         )  # [group, page_size]
 
-        # mask slots beyond the context length on the last page
+        # mask slots beyond the context length on the last page (and, for
+        # SWA, positions that fell out of the window)
         positions = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1
         )
-        scores = jnp.where(positions < ctx_len, scores, _NEG_INF)
+        in_bounds = positions < ctx_len
+        if sliding_window is not None:
+            in_bounds = in_bounds & (positions >= ctx_len - sliding_window)
+        scores = jnp.where(in_bounds, scores, _NEG_INF)
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # [group, 1]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -110,13 +122,13 @@ def _decode_kernel(
     m0 = jnp.full((group, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, 1), jnp.float32)
     acc0 = jnp.zeros((group, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    _m, l_fin, acc = jax.lax.fori_loop(first_page, num_pages, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l_fin, 1e-30)
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
@@ -124,6 +136,7 @@ def pallas_paged_decode_attention(
     page_table: jax.Array,  # [batch, pages_per_seq] int32
     ctx_lens: jax.Array,  # [batch] int32 (keys to attend per sequence)
     *,
+    sliding_window: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
@@ -138,7 +151,8 @@ def pallas_paged_decode_attention(
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=head_dim ** -0.5
+        _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
+        sliding_window=sliding_window,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

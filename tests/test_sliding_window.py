@@ -1,0 +1,118 @@
+"""Sliding-window attention (HMA) tests: ops, kernel, engine, event plane."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.events.model import BlockStoredEvent, EventBatch
+from llmd_kv_cache_tpu.events.pool import Pool, PoolConfig
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
+from llmd_kv_cache_tpu.ops.pallas_paged_attention import (
+    pallas_paged_decode_attention,
+)
+from test_pallas_attention import build_case
+
+
+class TestOpsWindow:
+    def test_window_restricts_keys(self):
+        q, k_cache, v_cache, table, ctx_lens = build_case(ctx=13)
+        # decode query at the last position with a window of 4
+        out_w = paged_attention(
+            q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+            ctx_lens, sliding_window=4,
+        )[:, 0]
+        out_full = paged_attention(
+            q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+            ctx_lens,
+        )[:, 0]
+        assert not np.allclose(np.asarray(out_w), np.asarray(out_full))
+
+    def test_window_larger_than_ctx_equals_full(self):
+        q, k_cache, v_cache, table, ctx_lens = build_case(ctx=10)
+        out_w = paged_attention(
+            q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+            ctx_lens, sliding_window=1000,
+        )
+        out_full = paged_attention(
+            q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+            ctx_lens,
+        )
+        np.testing.assert_allclose(np.asarray(out_w), np.asarray(out_full))
+
+    @pytest.mark.parametrize("window", [2, 4, 7])
+    def test_pallas_window_matches_reference(self, window):
+        q, k_cache, v_cache, table, ctx_lens = build_case(ctx=14)
+        out = pallas_paged_decode_attention(
+            q, k_cache, v_cache, table, ctx_lens,
+            sliding_window=window, interpret=True,
+        )
+        ref = paged_attention(
+            q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+            ctx_lens, sliding_window=window,
+        )[:, 0]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def swa_config():
+    tiny = LlamaConfig.tiny()
+    return LlamaConfig(
+        vocab_size=tiny.vocab_size, hidden_size=tiny.hidden_size,
+        num_layers=tiny.num_layers, num_heads=tiny.num_heads,
+        num_kv_heads=tiny.num_kv_heads, head_dim=tiny.head_dim,
+        intermediate_size=tiny.intermediate_size, page_size=tiny.page_size,
+        sliding_window=8, swa_layers=tuple(range(tiny.num_layers)),
+    )
+
+
+class TestEngineSWA:
+    def test_swa_engine_generates(self):
+        engine = MiniEngine(
+            EngineConfig(model=swa_config(), num_pages=64, max_pages_per_seq=16,
+                         model_name="swa", pod_identifier="p"),
+        )
+        out = engine.generate("r", list(range(30, 50)), max_new_tokens=4)
+        assert len(out) == 4
+
+    def test_swa_differs_from_full_attention(self):
+        full = MiniEngine(
+            EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="m",
+                         pod_identifier="p"),
+            seed=0,
+        )
+        swa = MiniEngine(
+            EngineConfig(model=swa_config(), num_pages=64, max_pages_per_seq=16,
+                         model_name="m", pod_identifier="p"),
+            seed=0,
+        )
+        prompt = list(range(30, 58))  # 28 tokens >> window 8
+        assert full.generate("a", prompt, 6) != swa.generate("b", prompt, 6)
+
+    def test_group_metadata_flows_to_catalog(self):
+        """Engine events carry the cache spec; the pool learns it."""
+        events = []
+        engine = MiniEngine(
+            EngineConfig(model=swa_config(), num_pages=64, max_pages_per_seq=16,
+                         model_name="swa", pod_identifier="pod-x"),
+            event_sink=events.extend,
+        )
+        engine.add_request("r", list(range(40, 52)), max_new_tokens=1)
+        stored = [e for e in events if isinstance(e, BlockStoredEvent)]
+        assert stored and stored[0].kv_cache_spec_kind == "sliding_window"
+        assert stored[0].kv_cache_spec_sliding_window == 8
+
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=4))
+        index = InMemoryIndex(InMemoryIndexConfig(size=100))
+        pool = Pool(PoolConfig(concurrency=1), index, processor)
+        pool.process_event_batch(
+            EventBatch(timestamp=0.0, events=events), "pod-x", "swa"
+        )
+        meta = pool.group_catalog.get("pod-x", 0)
+        assert meta is not None
+        assert meta.kind == "sliding_window"
+        assert meta.sliding_window_size == 8
