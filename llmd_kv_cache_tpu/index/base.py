@@ -124,6 +124,16 @@ class IndexConfig:
 
     @classmethod
     def default(cls) -> "IndexConfig":
+        """Default backend: the native C++ index when its library builds
+        (same contract, GIL-free hot paths), else the Python in-memory
+        index. Both mirror the reference's default in-memory semantics."""
+        try:
+            from . import native
+
+            if native.native_available():
+                return cls(native_config=native.NativeIndexConfig())
+        except Exception:  # pragma: no cover - toolchain-less envs
+            pass
         from .in_memory import InMemoryIndexConfig
 
         return cls(in_memory_config=InMemoryIndexConfig())

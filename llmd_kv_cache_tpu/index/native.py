@@ -92,11 +92,21 @@ def load_library() -> ctypes.CDLL:
         return _lib
 
 
+_load_failed = False
+
+
 def native_available() -> bool:
+    """True when the native library loads; a failed build is cached so
+    callers (e.g. IndexConfig.default on every create_index) don't re-spawn
+    the compiler per call."""
+    global _load_failed
+    if _load_failed:
+        return False
     try:
         load_library()
         return True
     except Exception:
+        _load_failed = True
         return False
 
 

@@ -1,0 +1,78 @@
+"""Engine checkpoint/resume via Orbax.
+
+The reference's checkpoint story is the persistent offload store (cache
+state survives restarts — SURVEY.md §5); this module adds the engine-side
+half for the in-tree serving engine: save/restore model parameters and the
+engine identity so a restarted pod resumes with identical weights and
+cache fingerprints (identical fingerprints → the restarted pod re-attaches
+to its offload store and the indexer's entries stay valid).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, fields
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..utils.logging import get_logger
+from .llama import LlamaConfig, Params, init_params
+
+logger = get_logger("models.checkpoint")
+
+_META_FILE = "engine_meta.json"
+
+
+def save_engine_checkpoint(path: str, params: Params, model_cfg: LlamaConfig,
+                           model_name: str, hash_seed: str = "") -> None:
+    """Save params + engine identity to ``path`` (a directory)."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        # force=True: periodic re-checkpointing to a fixed path overwrites.
+        ckptr.save(os.path.join(path, "params"), params, force=True)
+    meta = {
+        "model_name": model_name,
+        "hash_seed": hash_seed,
+        "model_config": {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in asdict(model_cfg).items()
+            if k != "dtype"
+        },
+        "dtype": str(model_cfg.dtype.__name__ if hasattr(model_cfg.dtype, "__name__")
+                     else model_cfg.dtype),
+    }
+    tmp = os.path.join(path, _META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, os.path.join(path, _META_FILE))
+    logger.info("engine checkpoint saved to %s", path)
+
+
+def load_engine_checkpoint(
+    path: str,
+) -> tuple[Params, LlamaConfig, str, str]:
+    """Load ``(params, model_cfg, model_name, hash_seed)`` from ``path``."""
+    import jax.numpy as jnp
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _META_FILE)) as f:
+        meta = json.load(f)
+
+    cfg_dict = dict(meta["model_config"])
+    # Restore tuple-typed fields generically (JSON stores them as lists).
+    for f in fields(LlamaConfig):
+        if f.name in cfg_dict and isinstance(cfg_dict[f.name], list):
+            cfg_dict[f.name] = tuple(cfg_dict[f.name])
+    dtype = getattr(jnp, meta.get("dtype", "bfloat16"))
+    model_cfg = LlamaConfig(dtype=dtype, **cfg_dict)
+
+    # Restore into the abstract structure of a freshly-initialized tree so
+    # shapes/dtypes are validated against the config.
+    abstract = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), model_cfg)
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(os.path.join(path, "params"), abstract)
+    return params, model_cfg, meta["model_name"], meta.get("hash_seed", "")

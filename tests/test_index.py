@@ -237,9 +237,14 @@ class TestMappingInference:
 
 
 class TestFactory:
-    def test_default_is_in_memory(self):
+    def test_default_backend(self):
+        from llmd_kv_cache_tpu.index import native
+
         idx = create_index(None)
-        assert isinstance(idx, InMemoryIndex)
+        if native.native_available():
+            assert isinstance(idx, native.NativeIndex)
+        else:
+            assert isinstance(idx, InMemoryIndex)
 
     def test_cost_aware_priority(self):
         cfg = IndexConfig(

@@ -142,12 +142,24 @@ class TestZMQPipeline:
         )
         try:
             rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
-            # pod-a caches the whole prompt; pod-b only the first block
-            pub_a.publish([BlockStoredEvent(
-                block_hashes=[1, 2, 3, 4], tokens=tokens, parent_hash=0, block_size=BLOCK)])
-            pub_b.publish([BlockStoredEvent(
-                block_hashes=[1], tokens=tokens[:4], parent_hash=0, block_size=BLOCK)])
-            assert wait_until(lambda: len(index.lookup(rks)) == 4)
+
+            # pod-a caches the whole prompt; pod-b only the first block.
+            # PUB/SUB joins are asynchronous: republish (stores are
+            # idempotent) until the events land instead of trusting one
+            # fixed slow-joiner sleep under a loaded machine.
+            def publish_both():
+                pub_a.publish([BlockStoredEvent(
+                    block_hashes=[1, 2, 3, 4], tokens=tokens, parent_hash=0,
+                    block_size=BLOCK)])
+                pub_b.publish([BlockStoredEvent(
+                    block_hashes=[1], tokens=tokens[:4], parent_hash=0,
+                    block_size=BLOCK)])
+
+            for _ in range(10):
+                publish_both()
+                if wait_until(lambda: len(index.lookup(rks)) == 4, timeout=1.0):
+                    break
+            assert len(index.lookup(rks)) == 4
 
             scores = indexer.score_tokens(tokens, MODEL)
             assert scores == {"pod-a": 4.0, "pod-b": 1.0}
