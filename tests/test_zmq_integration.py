@@ -155,11 +155,18 @@ class TestZMQPipeline:
                     block_hashes=[1], tokens=tokens[:4], parent_hash=0,
                     block_size=BLOCK)])
 
+            def both_pods_indexed():
+                result = index.lookup(rks)
+                if len(result) != 4:
+                    return False
+                pods_on_first = {e.pod_identifier for e in result.get(rks[0], [])}
+                return pods_on_first == {"pod-a", "pod-b"}
+
             for _ in range(10):
                 publish_both()
-                if wait_until(lambda: len(index.lookup(rks)) == 4, timeout=1.0):
+                if wait_until(both_pods_indexed, timeout=1.0):
                     break
-            assert len(index.lookup(rks)) == 4
+            assert both_pods_indexed()
 
             scores = indexer.score_tokens(tokens, MODEL)
             assert scores == {"pod-a": 4.0, "pod-b": 1.0}
