@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Fleet demo: every subsystem in one run.
+
+Three engine pods with shared storage, KV events over real ZMQ into the
+indexer, KV-aware routing with speculative convergence, storage-tier
+restore on a cold pod, and the evictor keeping the store under budget —
+the whole framework end to end in one process.
+
+Usage: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/fleet_demo.py
+"""
+
+import os
+import shutil
+import tempfile
+import time
+
+from llmd_kv_cache_tpu.core import TokenProcessorConfig
+from llmd_kv_cache_tpu.events import Pool, PoolConfig, ZMQSubscriber
+from llmd_kv_cache_tpu.events.publisher import KVEventPublisher
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+from llmd_kv_cache_tpu.evictor import Evictor, EvictorConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+from llmd_kv_cache_tpu.scoring.router import KVAwareRouter
+
+ENDPOINT = "tcp://127.0.0.1:15990"
+MODEL = "fleet-demo"
+
+
+def main() -> None:
+    store = tempfile.mkdtemp(prefix="kvtpu-fleet-")
+    tiny = LlamaConfig.tiny()
+
+    # Indexer side: centralized subscriber + sharded pool.
+    indexer = Indexer(IndexerConfig(
+        token_processor_config=TokenProcessorConfig(block_size_tokens=tiny.page_size)
+    ))
+    pool = Pool(PoolConfig(concurrency=2), indexer.kv_block_index,
+                indexer.token_processor)
+    pool.start()
+    sub = ZMQSubscriber(ENDPOINT, "kv@", pool.add_task, bind=True)
+    sub.start()
+    time.sleep(0.2)
+
+    # Three pods sharing one offload store, publishing real events.
+    spec = SharedStorageOffloadSpec(
+        root=store, model_name=MODEL, page_size=tiny.page_size,
+        num_layers=tiny.num_layers, kv_heads=tiny.num_kv_heads,
+        head_dim=tiny.head_dim, parallel_agnostic=True,
+        events_endpoint=ENDPOINT,
+    )
+    pods = {}
+    pubs = {}
+    for name in ("pod-0", "pod-1", "pod-2"):
+        pub = KVEventPublisher(ENDPOINT, name, MODEL, bind=False)
+        pubs[name] = pub
+
+        def sink(events, pub=pub):
+            pub.publish(events)
+
+        pods[name] = MiniEngine(
+            EngineConfig(model=tiny, num_pages=96, max_pages_per_seq=16,
+                         model_name=MODEL, pod_identifier=name),
+            event_sink=sink,
+            offload_spec=spec,
+        )
+    time.sleep(0.3)  # PUB slow-joiner settle
+
+    router = KVAwareRouter(indexer, list(pods))
+
+    system_prompt = list(range(1000, 1032))  # 8 shared blocks
+
+    print("=== phase 1: routed traffic (speculative + confirmed residency)")
+    for i in range(6):
+        prompt = system_prompt + [2000 + i * 7, 2001 + i * 7, 2002 + i, 2003]
+        pod = router.route(prompt, MODEL)
+        req = pods[pod].add_request(f"r{i}", prompt, max_new_tokens=2)
+        while not req.done:
+            pods[pod].step()
+        print(f"  request {i} → {pod} (prefix cached: {req.cached_len} tokens)")
+
+    time.sleep(0.5)
+    scores = indexer.score_tokens(system_prompt, MODEL)
+    print(f"  confirmed residency scores: {scores}")
+
+    print("=== phase 2: cold pod restores the shared prefix from storage")
+    for p in pods.values():
+        p.flush_offload()
+    cold = MiniEngine(
+        EngineConfig(model=tiny, num_pages=96, max_pages_per_seq=16,
+                     model_name=MODEL, pod_identifier="pod-cold"),
+        offload_spec=spec,
+    )
+    req = cold.add_request("cold", system_prompt + [42, 43, 44, 45],
+                           max_new_tokens=2)
+    print(f"  pod-cold admission: {req.cached_len} tokens restored from storage")
+
+    print("=== phase 3: evictor reclaims the store")
+    n_files = sum(len(fs) for _, _, fs in os.walk(store))
+    ev = Evictor(
+        EvictorConfig(store_root=store, num_crawlers=1, min_idle_seconds=0,
+                      storage_events_endpoint=ENDPOINT, model_name=MODEL),
+        usage_fn=lambda: 0.95,
+    )
+    time.sleep(0.3)
+    ev.activator_pass()
+    deleted = ev.crawl_and_delete_pass(0, max_batches=10)
+    print(f"  store had {n_files} files; evictor deleted {deleted}, "
+          f"BlockRemoved events published")
+    time.sleep(0.5)
+    pool.join()
+
+    print("=== done")
+    sub.stop()
+    pool.shutdown()
+    for pub in pubs.values():
+        pub.close()
+    shutil.rmtree(store, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

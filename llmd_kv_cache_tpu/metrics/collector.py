@@ -42,6 +42,41 @@ TOKENIZATION_LATENCY = Histogram(
     buckets=(1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0),
 )
 
+# Offload data-plane metrics, labelled by medium and direction — the
+# counterpart of the reference's vllm:kv_offload_{total_bytes,total_time}
+# per-medium families (llmd_fs_backend/README.md:204-218, metrics.py).
+OFFLOAD_BYTES = Counter(
+    "kv_offload_total_bytes",
+    "Bytes moved by offload transfers",
+    ["medium", "direction"],
+)
+OFFLOAD_SECONDS = Counter(
+    "kv_offload_total_time_seconds",
+    "Wall time of completed offload jobs",
+    ["medium", "direction"],
+)
+OFFLOAD_JOBS = Counter(
+    "kv_offload_jobs_total",
+    "Completed offload jobs",
+    ["medium", "direction", "outcome"],  # outcome: success|failure
+)
+OFFLOAD_SHED_BLOCKS = Counter(
+    "kv_offload_shed_blocks_total",
+    "Store blocks dropped by write shedding",
+    ["medium"],
+)
+
+
+def record_offload_result(medium: str, result) -> None:
+    """Record a TransferResult into the offload metric families."""
+    direction = "store" if result.is_store else "load"
+    outcome = "success" if result.success else "failure"
+    OFFLOAD_JOBS.labels(medium, direction, outcome).inc()
+    OFFLOAD_BYTES.labels(medium, direction).inc(result.bytes_transferred)
+    OFFLOAD_SECONDS.labels(medium, direction).inc(max(result.seconds, 0.0))
+    if result.shed_hashes:
+        OFFLOAD_SHED_BLOCKS.labels(medium).inc(len(result.shed_hashes))
+
 _beat_thread: Optional[threading.Thread] = None
 _beat_stop = threading.Event()
 

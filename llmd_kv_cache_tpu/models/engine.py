@@ -309,11 +309,14 @@ class MiniEngine:
         self.offload_manager = None
         self.offload_handlers = None
         self._pending_store_jobs: dict[int, list[int]] = {}
+        self._offload_medium = ""
         if offload_spec is not None:
             self.offload_manager = offload_spec.get_manager()
             self.offload_handlers = offload_spec.get_handlers(
                 self.k_cache, self.v_cache
             )
+            # Canonical medium label (matches KV-event medium strings).
+            self._offload_medium = offload_spec.medium
 
     # -- admission --
 
@@ -548,10 +551,13 @@ class MiniEngine:
         job's result is returned. Cache references are re-synced after the
         drain because load scatters donate-and-replace the pools.
         """
+        from ..metrics.collector import record_offload_result
+
         target_result = None
         self._sync_caches_to_copier()
         try:
             for res in self.offload_handlers.get_finished():
+                record_offload_result(self._offload_medium, res)
                 hashes = self._pending_store_jobs.pop(res.job_id, None)
                 if hashes is not None:
                     if res.success:

@@ -50,6 +50,13 @@ class SharedStorageOffloadSpec:
     backend: str = "posix"
     object_store_client: Optional[object] = None
 
+    @property
+    def medium(self) -> str:
+        """Canonical medium name for events and metrics."""
+        from ..events.publisher import MEDIUM_OBJECT_STORE, MEDIUM_SHARED_STORAGE
+
+        return MEDIUM_OBJECT_STORE if self.backend == "object" else MEDIUM_SHARED_STORAGE
+
     @classmethod
     def from_extra_config(cls, extra: dict) -> "SharedStorageOffloadSpec":
         """Build from a connector-style extra-config dict (camelCase or
@@ -121,20 +128,17 @@ class SharedStorageOffloadSpec:
     def get_manager(self):
         """Scheduler-side (rank 0) manager with optional event publishing."""
         if self.backend == "object":
-            from ..events.publisher import MEDIUM_OBJECT_STORE
             from .object_store import ObjectStoreOffloadManager
 
             client, mapper = self._object_pieces()
             return ObjectStoreOffloadManager(
                 client, mapper,
-                event_publisher=self._publisher(MEDIUM_OBJECT_STORE),
+                event_publisher=self._publisher(self.medium),
                 block_size_tokens=self.page_size,
             )
-        from ..events.publisher import MEDIUM_SHARED_STORAGE
-
         return SharedStorageOffloadManager(
             self.build_mapper(),
-            self._publisher(MEDIUM_SHARED_STORAGE),
+            self._publisher(self.medium),
             block_size_tokens=self.page_size,
         )
 

@@ -1,0 +1,91 @@
+"""Tokenization pool + prompt-scoring path tests."""
+
+import pytest
+
+from llmd_kv_cache_tpu.core import PodEntry, TokenProcessorConfig
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+from llmd_kv_cache_tpu.services.tokenizer import (
+    ChatMessage,
+    UdsTokenizerClient,
+    serve_uds,
+)
+from llmd_kv_cache_tpu.services.tokenizer.pool import (
+    PromptScorer,
+    TokenizationPool,
+    TokenizationPoolConfig,
+)
+
+BLOCK = 4
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("uds") / "tok.sock")
+    server = serve_uds(sock)
+    client = UdsTokenizerClient(sock, timeout_s=10.0)
+    client.initialize("simple")
+    pool = TokenizationPool(
+        client, TokenizationPoolConfig(workers=2, request_timeout_s=10.0),
+        block_size=BLOCK,
+    )
+    pool.start()
+    indexer = Indexer(
+        IndexerConfig(token_processor_config=TokenProcessorConfig(block_size_tokens=BLOCK)),
+        index=InMemoryIndex(InMemoryIndexConfig(size=1000)),
+    )
+    yield pool, indexer, client
+    pool.shutdown()
+    client.close()
+    server.stop(grace=None)
+
+
+class TestTokenizationPool:
+    def test_prompt_tokenize(self, stack):
+        pool, _, client = stack
+        tokens, features = pool.tokenize("simple", prompt="hello world")
+        assert tokens == client.encode("simple", "hello world").token_ids
+        assert features is None
+
+    def test_chat_tokenize(self, stack):
+        pool, _, _ = stack
+        tokens, _ = pool.tokenize(
+            "simple", messages=[ChatMessage("user", "hi there")]
+        )
+        assert tokens
+
+    def test_requires_exactly_one_input(self, stack):
+        pool, _, _ = stack
+        with pytest.raises(ValueError):
+            pool.tokenize("simple")
+        with pytest.raises(ValueError):
+            pool.tokenize("simple", prompt="x", messages=[ChatMessage("user", "y")])
+
+    def test_bad_model_raises_after_retries(self, stack):
+        pool, _, _ = stack
+        with pytest.raises(RuntimeError, match="tokenization failed"):
+            pool.tokenize("hf:/nonexistent", prompt="x")
+
+    def test_concurrent_requests(self, stack):
+        import concurrent.futures as cf
+
+        pool, _, _ = stack
+        with cf.ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(pool.tokenize, "simple", f"word{i} hello")
+                    for i in range(16)]
+            results = [f.result() for f in futs]
+        assert all(tokens for tokens, _ in results)
+
+
+class TestPromptScorer:
+    def test_prompt_scoring_end_to_end(self, stack):
+        pool, indexer, _ = stack
+        prompt = "the quick brown fox jumps over the lazy dog again and again"
+        tokens, _ = pool.tokenize("simple", prompt=prompt)
+        keys = indexer.compute_block_keys(tokens, "simple")
+        assert keys
+        indexer.kv_block_index.add(keys, keys, [PodEntry("pod-a", "tpu-hbm")])
+
+        scorer = PromptScorer(indexer, pool)
+        scores = scorer.get_pod_scores("simple", prompt=prompt)
+        assert scores == {"pod-a": float(len(keys))}
