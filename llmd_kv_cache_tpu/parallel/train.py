@@ -28,11 +28,15 @@ def forward_train(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [batch, seq]
     mesh_axes: tuple[Optional[str], Optional[str]] = (None, None),
+    attention_fn=None,
 ) -> jax.Array:
     """Causal-LM forward without KV cache (training path).
 
     ``mesh_axes = (dp_axis, sp_axis)`` adds sharding constraints on the
     activations; pass ``(None, None)`` for single-device runs.
+    ``attention_fn(q, k, v) -> out`` overrides the attention backend — pass
+    a ``ring_attention.make_ring_attention(mesh)`` fn for true sequence
+    parallelism on long contexts (K/V rotate over ICI; no all-gather).
     """
     dp, sp = mesh_axes
     batch, seq = tokens.shape
@@ -45,7 +49,9 @@ def forward_train(
 
     x = constrain(params["embed"][tokens])
 
-    causal = jnp.tril(jnp.ones((seq, seq), bool))
+    # Dense-path causal mask; the ring path masks per-block internally, so
+    # don't trace an O(S^2) op in exactly the long-context regime.
+    causal = None if attention_fn is not None else jnp.tril(jnp.ones((seq, seq), bool))
 
     for layer in params["layers"]:
         attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -59,12 +65,17 @@ def forward_train(
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        logits = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-        ) * (cfg.head_dim ** -0.5)
-        logits = jnp.where(causal[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(x.dtype)
+        if attention_fn is not None:
+            attn = attention_fn(q, k, v)
+        else:
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+            ) * (cfg.head_dim ** -0.5)
+            logits = jnp.where(causal[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)
+            ).astype(x.dtype)
         x = constrain(x + attn.reshape(batch, seq, -1) @ layer["wo"])
 
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -76,9 +87,10 @@ def forward_train(
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
-def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array, mesh_axes) -> jax.Array:
+def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array, mesh_axes,
+            attention_fn=None) -> jax.Array:
     """Next-token cross-entropy over shifted tokens."""
-    logits = forward_train(params, cfg, tokens, mesh_axes)
+    logits = forward_train(params, cfg, tokens, mesh_axes, attention_fn)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
@@ -92,7 +104,7 @@ def make_train_state(
     return opt, opt.init(params)
 
 
-@partial(jax.jit, static_argnames=("cfg", "opt", "mesh_axes"))
+@partial(jax.jit, static_argnames=("cfg", "opt", "mesh_axes", "attention_fn"))
 def train_step(
     params: Params,
     opt_state: Any,
@@ -100,19 +112,25 @@ def train_step(
     opt: optax.GradientTransformation,
     tokens: jax.Array,
     mesh_axes: tuple[Optional[str], Optional[str]] = (None, None),
+    attention_fn=None,
 ):
     """One full training step: loss, grads, AdamW update.
 
     Under a mesh, gradient reduction across ``dp`` falls out of the
-    sharding annotations (XLA emits the reduce-scatter/all-reduce over ICI).
+    sharding annotations (XLA emits the reduce-scatter/all-reduce over
+    ICI); with ``attention_fn`` = ring attention, the sequence axis scales
+    by neighbor exchanges instead of gathers.
     """
-    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens, mesh_axes)
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, cfg, tokens, mesh_axes, attention_fn
+    )
     updates, opt_state = opt.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, loss
 
 
-def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt):
+def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt,
+                            use_ring_attention: bool = False):
     """Prepare a mesh-sharded training setup.
 
     Returns ``(step_fn, sharded_params, opt_state, data_sharding)``. The
@@ -120,6 +138,9 @@ def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt):
     inherits their shardings (``zeros_like`` preserves placement); jit then
     propagates shardings from the inputs — the idiomatic
     annotate-and-let-XLA-insert-collectives flow.
+
+    ``use_ring_attention=True`` (requires an ``sp`` axis) replaces the
+    attention gather with ring K/V rotation for long sequences.
     """
     dp = "dp" if "dp" in mesh.axis_names else None
     sp = "sp" if "sp" in mesh.axis_names else None
@@ -127,7 +148,18 @@ def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt):
     opt_state = opt.init(sharded_params)
     data_sharding = NamedSharding(mesh, P(dp, sp))
 
+    attention_fn = None
+    if use_ring_attention:
+        if sp is None:
+            raise ValueError("ring attention requires an 'sp' mesh axis")
+        from .ring_attention import make_ring_attention
+
+        tp = "tp" if "tp" in mesh.axis_names else None
+        attention_fn = make_ring_attention(
+            mesh, sp, batch_axis=dp, head_axis=tp
+        )
+
     def step(p, s, tokens):
-        return train_step(p, s, cfg, opt, tokens, (dp, sp))
+        return train_step(p, s, cfg, opt, tokens, (dp, sp), attention_fn)
 
     return jax.jit(step), sharded_params, opt_state, data_sharding

@@ -1,0 +1,118 @@
+"""Ring attention vs dense causal reference on an 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+from llmd_kv_cache_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    ring_attention_reference,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    return make_mesh({"sp": 8})
+
+
+def make_qkv(b=2, s=64, h=4, d=16, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, s, h, d)), dtype) for _ in range(3)
+    )
+
+
+class TestRingAttention:
+    def test_matches_dense_reference(self, mesh):
+        q, k, v = make_qkv()
+        ring = make_ring_attention(mesh)
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+        out = ring(qs, ks, vs)
+        ref = ring_attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_output_stays_sequence_sharded(self, mesh):
+        q, k, v = make_qkv()
+        ring = make_ring_attention(mesh)
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        out = ring(*(jax.device_put(x, spec) for x in (q, k, v)))
+        assert out.sharding.spec == P(None, "sp", None, None)
+
+    def test_long_sequence(self, mesh):
+        # 512 tokens over 8 devices: 64 per shard
+        q, k, v = make_qkv(b=1, s=512, h=2, d=8, seed=1)
+        ring = make_ring_attention(mesh)
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        out = ring(*(jax.device_put(x, spec) for x in (q, k, v)))
+        ref = ring_attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_train_step_with_ring_attention(self):
+        """Full sharded train step on dp×tp×sp with ring attention."""
+        import numpy as np
+
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
+        from llmd_kv_cache_tpu.parallel.train import (
+            make_sharded_train_step,
+            make_train_state,
+        )
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        mesh3 = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=8, intermediate_size=64, page_size=4,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, _ = make_train_state(params)
+        with mesh3:
+            step, sp_params, opt_state, data_sharding = make_sharded_train_step(
+                mesh3, cfg, params, opt, use_ring_attention=True
+            )
+            tokens = jax.device_put(
+                jnp.asarray(
+                    np.random.default_rng(0).integers(0, 64, (4, 16)), jnp.int32
+                ),
+                data_sharding,
+            )
+            _p, _s, loss = step(sp_params, opt_state, tokens)
+            assert np.isfinite(float(loss))
+
+    def test_ring_requires_sp_axis(self):
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
+        from llmd_kv_cache_tpu.parallel.train import (
+            make_sharded_train_step,
+            make_train_state,
+        )
+
+        mesh2 = make_mesh({"dp": len(jax.devices())})
+        cfg = LlamaConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, _ = make_train_state(params)
+        with pytest.raises(ValueError, match="sp"):
+            make_sharded_train_step(mesh2, cfg, params, opt,
+                                    use_ring_attention=True)
+
+    def test_grad_flows(self, mesh):
+        """Ring attention is differentiable end-to-end (training path)."""
+        q, k, v = make_qkv(b=1, s=32, h=2, d=8)
+        ring = make_ring_attention(mesh)
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+
+        def loss(q, k, v):
+            return jnp.sum(ring(q, k, v).astype(jnp.float32) ** 2)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(qs, ks, vs)
+        for g in grads:
+            assert np.isfinite(np.asarray(g, np.float32)).all()
+            assert float(jnp.abs(g.astype(jnp.float32)).sum()) > 0
