@@ -16,8 +16,9 @@ test: native
 # hiding them).
 unit-test-race: native
 	for i in 1 2 3; do \
-	  $(PY) -m pytest tests/test_pool.py tests/test_index.py \
-	    tests/test_zmq_integration.py tests/test_evictor.py -q || exit 1; \
+	  $(PY) -m pytest tests/test_stress.py tests/test_pool.py \
+	    tests/test_index.py tests/test_zmq_integration.py \
+	    tests/test_evictor.py -q || exit 1; \
 	done
 
 native:

@@ -1,0 +1,162 @@
+"""Concurrency stress tests: event storms against the full indexer stack.
+
+The reference runs ``go test -race`` nightly over concurrency-heavy code
+(SURVEY.md §4). Python has no race detector, so these tests drive the same
+interleavings hard — many publishers, shards, scorers, and clears running
+simultaneously — and assert convergence invariants at quiescence. Run
+repeatedly via ``make unit-test-race``.
+"""
+
+import threading
+
+import msgpack
+import pytest
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, PodEntry, TokenProcessorConfig
+from llmd_kv_cache_tpu.events import Pool, PoolConfig, RawMessage
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig, native_available
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+
+BLOCK = 4
+MODEL = "m"
+
+
+def stored_msg(pod, hashes, tokens, seq=0, parent=0):
+    ev = ["BlockStored", hashes, parent if parent else None, tokens, BLOCK]
+    return RawMessage(
+        topic=f"kv@{pod}@{MODEL}", sequence=seq,
+        payload=msgpack.packb([1.0, [ev]], use_bin_type=True),
+    )
+
+
+def removed_msg(pod, hashes, seq=0):
+    ev = ["BlockRemoved", hashes]
+    return RawMessage(
+        topic=f"kv@{pod}@{MODEL}", sequence=seq,
+        payload=msgpack.packb([1.0, [ev]], use_bin_type=True),
+    )
+
+
+def cleared_msg(pod, seq=0):
+    return RawMessage(
+        topic=f"kv@{pod}@{MODEL}", sequence=seq,
+        payload=msgpack.packb([1.0, [["AllBlocksCleared"]]], use_bin_type=True),
+    )
+
+
+@pytest.fixture(params=["python", "native"])
+def index(request):
+    if request.param == "native":
+        if not native_available():
+            pytest.skip("native library unavailable")
+        return NativeIndex(NativeIndexConfig(size=100_000))
+    return InMemoryIndex(InMemoryIndexConfig(size=100_000))
+
+
+def test_event_storm_converges(index):
+    """8 pods × interleaved store/remove/clear storms; at quiescence the
+    surviving pods' full chains must be scored exactly."""
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+    indexer = Indexer(
+        IndexerConfig(token_processor_config=TokenProcessorConfig(block_size_tokens=BLOCK)),
+        index=index,
+    )
+    pool = Pool(PoolConfig(concurrency=4), index, processor)
+    pool.start()
+
+    pods = [f"pod-{i}" for i in range(8)]
+    shared = list(range(1000, 1016))  # 4 shared blocks
+    n_rounds = 60
+    errors: list[Exception] = []
+
+    def publisher(pod_idx):
+        pod = pods[pod_idx]
+        try:
+            seq = 0
+            for r in range(n_rounds):
+                # store the shared prefix + a private continuation
+                private = [5000 + pod_idx * 100 + r, 1, 2, 3]
+                hashes = [10 + i for i in range(4)] + [900 + pod_idx]
+                pool.add_task(stored_msg(pod, hashes[:4], shared, seq))
+                seq += 1
+                # churn: remove/clear on some rounds
+                if r % 7 == 3:
+                    pool.add_task(removed_msg(pod, [10], seq))
+                    seq += 1
+                if r % 13 == 5 and pod_idx % 2 == 1:
+                    pool.add_task(cleared_msg(pod, seq))
+                    seq += 1
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def scorer_loop():
+        try:
+            for _ in range(100):
+                indexer.score_tokens(shared, MODEL)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=publisher, args=(i,)) for i in range(8)]
+    threads += [threading.Thread(target=scorer_loop) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    pool.join()
+
+    assert not errors
+
+    # Final convergence: replay one clean store for every pod and verify
+    # exact scoring (the storm must not have corrupted index structure).
+    for i, pod in enumerate(pods):
+        pool.add_task(stored_msg(pod, [10, 11, 12, 13], shared, seq=10_000 + i))
+    pool.join()
+    scores = indexer.score_tokens(shared, MODEL)
+    assert scores == {pod: 4.0 for pod in pods}
+    pool.shutdown()
+
+
+def test_concurrent_index_users_with_clears(index):
+    """Direct index hammering: adders, evictors, clearers, lookers."""
+    errors: list[Exception] = []
+    stop = threading.Event()
+
+    def adder(n):
+        try:
+            for i in range(400):
+                index.add([i % 50], [i % 50], [PodEntry(f"p{n}", "tpu-hbm")])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def clearer():
+        try:
+            for _ in range(60):
+                index.clear("p0")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def looker():
+        try:
+            while not stop.is_set():
+                index.lookup(list(range(50)))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=adder, args=(n,)) for n in range(4)]
+    threads.append(threading.Thread(target=clearer))
+    lookers = [threading.Thread(target=looker) for _ in range(2)]
+    for t in threads + lookers:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in lookers:
+        t.join()
+
+    assert not errors
+    # p1..p3 fully present on every key they added
+    result = index.lookup(list(range(50)))
+    for key, entries in result.items():
+        pods = {e.pod_identifier for e in entries}
+        assert pods <= {"p0", "p1", "p2", "p3"}
