@@ -253,6 +253,89 @@ class Index {
     return data_.size();
   }
 
+  // Fused lookup + longest-prefix tier-weighted scoring (the whole
+  // scheduler hot path in one native call; mirrors scoring/scorer.py's
+  // LongestPrefixScorer semantics exactly, including the absent-key
+  // continue / known-empty break distinction of Lookup).
+  // tier_weights: tier string-id → weight (missing tiers weigh 1.0).
+  // Returns the number of (pod, score) pairs written.
+  int Score(const uint64_t* keys, int n_keys, const int32_t* filter_pods,
+            int n_filter, const int32_t* weight_tiers,
+            const double* weight_values, int n_weights, int32_t* out_pods,
+            double* out_scores, int out_cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+
+    auto tier_weight = [&](int32_t tier) {
+      for (int i = 0; i < n_weights; ++i) {
+        if (weight_tiers[i] == tier) return weight_values[i];
+      }
+      return 1.0;
+    };
+    auto pod_allowed = [&](int32_t pod) {
+      if (n_filter == 0) return true;
+      for (int i = 0; i < n_filter; ++i) {
+        if (filter_pods[i] == pod) return true;
+      }
+      return false;
+    };
+
+    std::unordered_map<int32_t, double> scores;   // accumulated
+    std::unordered_map<int32_t, double> current;  // this key's max weights
+    std::unordered_map<int32_t, bool> active;     // in the prefix chain
+
+    bool first = true;
+    for (int ki = 0; ki < n_keys; ++ki) {
+      auto it = data_.find(keys[ki]);
+      // An absent (or known-but-empty) key contributes no pods, which
+      // empties the active prefix set — scoring stops here either way
+      // (matches LongestPrefixScorer over Lookup's result map).
+      if (it == data_.end()) break;
+      PodSlot& slot = it->second;
+      if (slot.entries.empty()) break;
+      key_lru_.splice(key_lru_.begin(), key_lru_, slot.lru_it);
+
+      current.clear();
+      for (const Entry& e : slot.entries) {
+        if (!pod_allowed(e.pod)) continue;
+        double w = tier_weight(e.tier);
+        auto [cit, inserted] = current.emplace(e.pod, w);
+        if (!inserted && w > cit->second) cit->second = w;
+      }
+
+      if (first) {
+        for (auto& [pod, w] : current) {
+          scores[pod] = w;
+          active[pod] = true;
+        }
+        first = false;
+      } else {
+        for (auto& [pod, is_active] : active) {
+          if (!is_active) continue;
+          auto cit = current.find(pod);
+          if (cit != current.end()) {
+            scores[pod] += cit->second;
+          } else {
+            is_active = false;
+          }
+        }
+        bool any = false;
+        for (auto& [pod, is_active] : active) {
+          if (is_active) { any = true; break; }
+        }
+        if (!any) break;
+      }
+    }
+
+    int n = 0;
+    for (auto& [pod, score] : scores) {
+      if (n >= out_cap) break;
+      out_pods[n] = pod;
+      out_scores[n] = score;
+      ++n;
+    }
+    return n;
+  }
+
  private:
   PodSlot& TouchKey(uint64_t key) {
     auto it = data_.find(key);
@@ -430,4 +513,15 @@ void kvidx_clear(void* idx, int32_t pod) {
 }
 
 uint64_t kvidx_len(void* idx) { return static_cast<Index*>(idx)->Size(); }
+
+int kvidx_score(void* idx, const uint64_t* keys, int n_keys,
+                const int32_t* filter_pods, int n_filter,
+                const int32_t* weight_tiers, const double* weight_values,
+                int n_weights, int32_t* out_pods, double* out_scores,
+                int out_cap) {
+  return static_cast<Index*>(idx)->Score(keys, n_keys, filter_pods, n_filter,
+                                         weight_tiers, weight_values,
+                                         n_weights, out_pods, out_scores,
+                                         out_cap);
+}
 }

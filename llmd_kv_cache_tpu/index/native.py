@@ -87,6 +87,12 @@ def load_library() -> ctypes.CDLL:
         lib.kvidx_clear.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.kvidx_len.restype = ctypes.c_uint64
         lib.kvidx_len.argtypes = [ctypes.c_void_p]
+        lib.kvidx_score.restype = ctypes.c_int
+        lib.kvidx_score.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_int, i32p, ctypes.c_int,
+            i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+            i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
 
         _lib = lib
         return _lib
@@ -300,6 +306,46 @@ class NativeIndex(Index):
             flags.ctypes.data_as(u8p), groups.ctypes.data_as(i32p),
             len(entries),
         )
+
+    def score(
+        self,
+        request_keys: Sequence[BlockHash],
+        medium_weights: dict[str, float],
+        pod_identifier_set=None,
+        max_pods: int = 1024,
+    ) -> dict[str, float]:
+        """Fused lookup + longest-prefix tier-weighted scoring in C++.
+
+        Exactly equivalent to ``LongestPrefixScorer.score`` over
+        ``lookup`` (shared equivalence tests), without materializing any
+        PodEntry objects.
+        """
+        if not request_keys:
+            return {}
+        keys = self._keys_array(request_keys)
+        if pod_identifier_set:
+            filt = np.asarray([self._intern(p) for p in pod_identifier_set], np.int32)
+        else:
+            filt = np.empty(0, np.int32)
+        wt = np.asarray([self._intern(t) for t in medium_weights], np.int32)
+        wv = np.asarray(list(medium_weights.values()), np.float64)
+        out_pods = np.empty(max_pods, np.int32)
+        out_scores = np.empty(max_pods, np.float64)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        n = self._lib.kvidx_score(
+            self._handle,
+            keys.ctypes.data_as(u64p), len(keys),
+            filt.ctypes.data_as(i32p), len(filt),
+            wt.ctypes.data_as(i32p), wv.ctypes.data_as(f64p), len(wt),
+            out_pods.ctypes.data_as(i32p), out_scores.ctypes.data_as(f64p),
+            max_pods,
+        )
+        return {
+            self._resolve(int(out_pods[i])): float(out_scores[i])
+            for i in range(n)
+        }
 
     def get_request_key(self, engine_key):
         rk = self._lib.kvidx_get_request_key(

@@ -79,6 +79,9 @@ class Indexer:
         )
         self.scorer: LongestPrefixScorer = create_scorer(self.config.scorer_config)
         self._tracer = tracer()
+        # Fused native lookup+score fast path (NativeIndex only): the whole
+        # scheduler hot loop stays in C++.
+        self._native_score = getattr(self.kv_block_index, "score", None)
 
     def compute_block_keys(
         self,
@@ -115,6 +118,11 @@ class Indexer:
             span.set_attribute("block_count", len(block_keys))
             if not block_keys:
                 return {}
+
+            if self._native_score is not None:
+                return self._native_score(
+                    block_keys, self.scorer.medium_weights, pod_identifiers
+                )
 
             key_to_pods = self.kv_block_index.lookup(block_keys, pod_identifiers)
             span.set_attribute("block_hit_count", len(key_to_pods))

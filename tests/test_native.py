@@ -102,6 +102,38 @@ class TestNativeIndexSpecifics:
         # most recent keys survive
         assert idx.lookup([9])[9]
 
+    def test_fused_score_matches_python_scorer(self):
+        """kvidx_score == LongestPrefixScorer over lookup, across random
+        residency patterns, filters, and tier weights."""
+        import numpy as np
+
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+        from llmd_kv_cache_tpu.scoring.scorer import LongestPrefixScorer
+
+        rng = np.random.default_rng(3)
+        idx = NativeIndex(NativeIndexConfig(size=10_000))
+        weights = {"tpu-hbm": 1.0, "cpu": 0.8, "shared_storage": 0.5}
+        scorer = LongestPrefixScorer(weights)
+
+        keys = list(range(1, 33))
+        pods = [f"pod-{i}" for i in range(6)]
+        tiers = list(weights) + ["weird-tier"]
+        for pod in pods:
+            prefix_len = int(rng.integers(0, len(keys) + 1))
+            for k in keys[:prefix_len]:
+                tier = tiers[int(rng.integers(0, len(tiers)))]
+                idx.add([k], [k], [PodEntry(pod, tier)])
+        # punch a hole for one pod to exercise the chain break
+        from llmd_kv_cache_tpu.core import KeyType
+
+        idx.evict(7, KeyType.ENGINE, [PodEntry("pod-0", "tpu-hbm")])
+
+        for filt in (None, {"pod-1", "pod-3"}, {"nope"}):
+            fused = idx.score(keys, weights, filt)
+            ref = scorer.score(keys, idx.lookup(keys, filt))
+            assert fused == ref, (filt, fused, ref)
+
     def test_large_lookup_grows_buffer(self):
         from llmd_kv_cache_tpu.core import PodEntry
         from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
