@@ -255,14 +255,17 @@ class Index {
 
   // Fused lookup + longest-prefix tier-weighted scoring (the whole
   // scheduler hot path in one native call; mirrors scoring/scorer.py's
-  // LongestPrefixScorer semantics exactly, including the absent-key
-  // continue / known-empty break distinction of Lookup).
+  // LongestPrefixScorer semantics exactly).
   // tier_weights: tier string-id → weight (missing tiers weigh 1.0).
-  // Returns the number of (pod, score) pairs written.
+  // out_hits receives the Lookup-equivalent hit count (keys with entries,
+  // scan stopping only at a known-but-empty key), preserving both the
+  // telemetry semantics and the LRU recency refresh of the lookup path.
+  // Returns the number of (pod, score) pairs written, or -needed when
+  // out_cap is too small (caller retries with a bigger buffer).
   int Score(const uint64_t* keys, int n_keys, const int32_t* filter_pods,
             int n_filter, const int32_t* weight_tiers,
             const double* weight_values, int n_weights, int32_t* out_pods,
-            double* out_scores, int out_cap) {
+            double* out_scores, int out_cap, int32_t* out_hits) {
     std::lock_guard<std::mutex> lk(mu_);
 
     auto tier_weight = [&](int32_t tier) {
@@ -283,16 +286,23 @@ class Index {
     std::unordered_map<int32_t, double> current;  // this key's max weights
     std::unordered_map<int32_t, bool> active;     // in the prefix chain
 
+    int hits = 0;
+    bool scoring = true;  // false once the prefix chain broke
     bool first = true;
     for (int ki = 0; ki < n_keys; ++ki) {
       auto it = data_.find(keys[ki]);
-      // An absent (or known-but-empty) key contributes no pods, which
-      // empties the active prefix set — scoring stops here either way
-      // (matches LongestPrefixScorer over Lookup's result map).
-      if (it == data_.end()) break;
+      if (it == data_.end()) {
+        // Absent key: the active prefix set empties (scoring over), but —
+        // like Lookup — the scan continues so later resident blocks still
+        // get counted and LRU-refreshed.
+        scoring = false;
+        continue;
+      }
       PodSlot& slot = it->second;
-      if (slot.entries.empty()) break;
+      if (slot.entries.empty()) break;  // known-but-empty: Lookup stops too
+      ++hits;
       key_lru_.splice(key_lru_.begin(), key_lru_, slot.lru_it);
+      if (!scoring) continue;
 
       current.clear();
       for (const Entry& e : slot.entries) {
@@ -322,13 +332,16 @@ class Index {
         for (auto& [pod, is_active] : active) {
           if (is_active) { any = true; break; }
         }
-        if (!any) break;
+        if (!any) scoring = false;  // keep scanning for hits/LRU only
       }
     }
 
+    *out_hits = hits;
+    if (static_cast<int>(scores.size()) > out_cap) {
+      return -static_cast<int>(scores.size());
+    }
     int n = 0;
     for (auto& [pod, score] : scores) {
-      if (n >= out_cap) break;
       out_pods[n] = pod;
       out_scores[n] = score;
       ++n;
@@ -518,10 +531,10 @@ int kvidx_score(void* idx, const uint64_t* keys, int n_keys,
                 const int32_t* filter_pods, int n_filter,
                 const int32_t* weight_tiers, const double* weight_values,
                 int n_weights, int32_t* out_pods, double* out_scores,
-                int out_cap) {
+                int out_cap, int32_t* out_hits) {
   return static_cast<Index*>(idx)->Score(keys, n_keys, filter_pods, n_filter,
                                          weight_tiers, weight_values,
                                          n_weights, out_pods, out_scores,
-                                         out_cap);
+                                         out_cap, out_hits);
 }
 }

@@ -91,7 +91,7 @@ def load_library() -> ctypes.CDLL:
         lib.kvidx_score.argtypes = [
             ctypes.c_void_p, u64p, ctypes.c_int, i32p, ctypes.c_int,
             i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
-            i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+            i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int, i32p,
         ]
 
         _lib = lib
@@ -312,16 +312,17 @@ class NativeIndex(Index):
         request_keys: Sequence[BlockHash],
         medium_weights: dict[str, float],
         pod_identifier_set=None,
-        max_pods: int = 1024,
-    ) -> dict[str, float]:
+    ) -> tuple[dict[str, float], int]:
         """Fused lookup + longest-prefix tier-weighted scoring in C++.
 
         Exactly equivalent to ``LongestPrefixScorer.score`` over
         ``lookup`` (shared equivalence tests), without materializing any
-        PodEntry objects.
+        PodEntry objects. Returns ``(scores, hit_count)`` where hit_count
+        is the Lookup-equivalent number of resident keys (telemetry).
+        The scan also refreshes LRU recency like a lookup would.
         """
         if not request_keys:
-            return {}
+            return {}, 0
         keys = self._keys_array(request_keys)
         if pod_identifier_set:
             filt = np.asarray([self._intern(p) for p in pod_identifier_set], np.int32)
@@ -329,23 +330,32 @@ class NativeIndex(Index):
             filt = np.empty(0, np.int32)
         wt = np.asarray([self._intern(t) for t in medium_weights], np.int32)
         wv = np.asarray(list(medium_weights.values()), np.float64)
-        out_pods = np.empty(max_pods, np.int32)
-        out_scores = np.empty(max_pods, np.float64)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         i32p = ctypes.POINTER(ctypes.c_int32)
         f64p = ctypes.POINTER(ctypes.c_double)
-        n = self._lib.kvidx_score(
-            self._handle,
-            keys.ctypes.data_as(u64p), len(keys),
-            filt.ctypes.data_as(i32p), len(filt),
-            wt.ctypes.data_as(i32p), wv.ctypes.data_as(f64p), len(wt),
-            out_pods.ctypes.data_as(i32p), out_scores.ctypes.data_as(f64p),
-            max_pods,
+        hits = np.zeros(1, np.int32)
+        cap = 1024
+        while True:
+            out_pods = np.empty(cap, np.int32)
+            out_scores = np.empty(cap, np.float64)
+            n = self._lib.kvidx_score(
+                self._handle,
+                keys.ctypes.data_as(u64p), len(keys),
+                filt.ctypes.data_as(i32p), len(filt),
+                wt.ctypes.data_as(i32p), wv.ctypes.data_as(f64p), len(wt),
+                out_pods.ctypes.data_as(i32p), out_scores.ctypes.data_as(f64p),
+                cap, hits.ctypes.data_as(i32p),
+            )
+            if n >= 0:
+                break
+            cap = -n  # buffer too small: exact needed size reported
+        return (
+            {
+                self._resolve(int(out_pods[i])): float(out_scores[i])
+                for i in range(n)
+            },
+            int(hits[0]),
         )
-        return {
-            self._resolve(int(out_pods[i])): float(out_scores[i])
-            for i in range(n)
-        }
 
     def get_request_key(self, engine_key):
         rk = self._lib.kvidx_get_request_key(

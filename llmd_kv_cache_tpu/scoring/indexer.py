@@ -120,9 +120,12 @@ class Indexer:
                 return {}
 
             if self._native_score is not None:
-                return self._native_score(
+                scores, hit_count = self._native_score(
                     block_keys, self.scorer.medium_weights, pod_identifiers
                 )
+                span.set_attribute("block_hit_count", hit_count)
+                span.set_attribute("block_hit_ratio", hit_count / len(block_keys))
+                return scores
 
             key_to_pods = self.kv_block_index.lookup(block_keys, pod_identifiers)
             span.set_attribute("block_hit_count", len(key_to_pods))

@@ -130,9 +130,23 @@ class TestNativeIndexSpecifics:
         idx.evict(7, KeyType.ENGINE, [PodEntry("pod-0", "tpu-hbm")])
 
         for filt in (None, {"pod-1", "pod-3"}, {"nope"}):
-            fused = idx.score(keys, weights, filt)
+            fused, hits = idx.score(keys, weights, filt)
             ref = scorer.score(keys, idx.lookup(keys, filt))
             assert fused == ref, (filt, fused, ref)
+            assert hits == len(idx.lookup(keys))  # Lookup-equivalent count
+
+    def test_fused_score_overflow_retries(self):
+        """More pods than the initial result buffer: exact scores still."""
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=100_000, pod_cache_size=3000))
+        entries = [PodEntry(f"pod-{i}", "tpu-hbm") for i in range(2000)]
+        idx.add([1], [1], entries)
+        scores, hits = idx.score([1], {"tpu-hbm": 1.0})
+        assert len(scores) == 2000
+        assert hits == 1
+        assert all(v == 1.0 for v in scores.values())
 
     def test_large_lookup_grows_buffer(self):
         from llmd_kv_cache_tpu.core import PodEntry
