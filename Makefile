@@ -28,6 +28,12 @@ native:
 bench: native
 	$(PY) bench.py
 
+# Run every runnable example headlessly (the reference's
+# hack/verify-examples.sh equivalent).
+verify-examples: native
+	$(CPU_ENV) PYTHONPATH=. $(PY) examples/offline_events.py
+	$(CPU_ENV) PYTHONPATH=. $(PY) examples/fleet_demo.py
+
 graft-check:
 	$(PY) -c "import __graft_entry__, jax; fn, a = __graft_entry__.entry(); \
 	  print(jax.jit(fn)(*a).shape)"

@@ -129,6 +129,69 @@ def bench_index_add(native: bool = True) -> dict:
     }
 
 
+def bench_offload_throughput() -> dict:
+    """Secondary metric: offload store+load throughput through the full
+    stack (device page gather → host slab → native file write, and back).
+    Printed by ``--offload``; informational (the reference publishes no
+    comparable figure)."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax.numpy as jnp
+
+    from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+
+    root = tempfile.mkdtemp(prefix="kvtpu-bench-offload-")
+    try:
+        layers, pages, page_size, kvh, hd = 16, 256, 16, 8, 128
+        spec = SharedStorageOffloadSpec(
+            root=root, model_name="bench", page_size=page_size,
+            num_layers=layers, kv_heads=kvh, head_dim=hd, io_threads=4,
+            parallel_agnostic=True,
+        )
+        rng = np.random.default_rng(0)
+        shape = (layers, pages, page_size, kvh, hd)
+        k = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+        handlers = spec.get_handlers(k, v)
+
+        # 64 blocks of 2 pages each
+        transfers = [(0x1000 + i, [1 + 2 * i, 2 + 2 * i]) for i in range(64)]
+        start = time.perf_counter()
+        job = handlers.async_store_blocks(transfers)
+        result = None
+        while result is None:
+            for res in handlers.get_finished():
+                if res.job_id == job:
+                    result = res
+            time.sleep(0.001)
+        store_s = time.perf_counter() - start
+        nbytes = result.bytes_transferred
+
+        start = time.perf_counter()
+        job = handlers.async_load_blocks(transfers)
+        result = None
+        while result is None:
+            for res in handlers.get_finished():
+                if res.job_id == job:
+                    result = res
+            time.sleep(0.001)
+        load_s = time.perf_counter() - start
+        handlers.shutdown()
+
+        return {
+            "metric": "offload store/load throughput (64 blocks, "
+                      f"{nbytes / 1e6:.0f} MB, device↔host↔disk)",
+            "value": round(nbytes / store_s / 1e9, 3),
+            "unit": "GB/s store "
+                    f"({nbytes / load_s / 1e9:.2f} GB/s load)",
+            "vs_baseline": 1.0,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> None:
     import jax
 
@@ -237,5 +300,7 @@ if __name__ == "__main__":
         main()
     elif "--index" in sys.argv:
         print(json.dumps(bench_index_add()))
+    elif "--offload" in sys.argv:
+        print(json.dumps(bench_offload_throughput()))
     else:
         guarded_main()

@@ -230,11 +230,13 @@ class ObjectStoreOffloadHandlers:
         self, transfers: Sequence[tuple[int, Sequence[int]]], group_idx: int = 0
     ) -> int:
         job = self._make_job(is_store=True)
-        for block_hash, page_ids in transfers:
+        slabs = self.copier.gather_many_to_host(
+            [list(page_ids) for _, page_ids in transfers]
+        )
+        for (block_hash, page_ids), slab in zip(transfers, slabs):
             if not self._put_slots.acquire(blocking=False):
                 job.shed_hashes.append(block_hash)
                 continue
-            slab = self.copier.gather_to_host(list(page_ids))
             key = self.mapper.block_key(block_hash, group_idx)
             # Zero-copy byte view (bfloat16 etc. lack the buffer protocol,
             # so reinterpret as uint8 first).
@@ -273,13 +275,17 @@ class ObjectStoreOffloadHandlers:
                 elif not job.is_store and f.result() is None:
                     success = False  # missing object
             if success and not job.is_store:
+                batch = []
                 for fut, page_ids in job.scatters:
                     data = fut.result()
-                    slab = np.frombuffer(data, dtype=self.copier.dtype).reshape(
-                        self.copier.slab_shape(len(page_ids))
-                    )
-                    self.copier.scatter_from_host(slab, page_ids)
+                    batch.append((
+                        np.frombuffer(data, dtype=self.copier.dtype).reshape(
+                            self.copier.slab_shape(len(page_ids))
+                        ),
+                        page_ids,
+                    ))
                     job.nbytes += len(data)
+                self.copier.scatter_many_from_host(batch)
             results.append(
                 TransferResult(
                     job_id=job.job_id,

@@ -111,8 +111,11 @@ class OffloadHandlers:
         job = _PendingJob(job_id=job_id, is_store=True, started=time.perf_counter(),
                           nbytes=0)
         suffix = uuid.uuid4().hex[:8]
-        for block_hash, page_ids in transfers:
-            slab = self.copier.gather_to_host(list(page_ids))
+        # One device program + one D2H transfer for the whole job.
+        slabs = self.copier.gather_many_to_host(
+            [list(page_ids) for _, page_ids in transfers]
+        )
+        for (block_hash, _page_ids), slab in zip(transfers, slabs):
             queued = self.io.submit_write(
                 job_id,
                 self.mapper.block_path(block_hash, group_idx),
@@ -170,11 +173,15 @@ class OffloadHandlers:
                 continue
             success = status == STATUS_OK
             if success and not job.is_store:
-                for buf, page_ids in job.scatters:
-                    slab = np.frombuffer(buf, dtype=self.copier.dtype).reshape(
-                        self.copier.slab_shape(len(page_ids))
+                self.copier.scatter_many_from_host([
+                    (
+                        np.frombuffer(buf, dtype=self.copier.dtype).reshape(
+                            self.copier.slab_shape(len(page_ids))
+                        ),
+                        page_ids,
                     )
-                    self.copier.scatter_from_host(slab, page_ids)
+                    for buf, page_ids in job.scatters
+                ])
             elif not success and not job.is_store:
                 logger.warning("load job %d failed (status %d)", job_id, status)
             elif not success:
