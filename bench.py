@@ -167,7 +167,12 @@ def bench_offload_throughput() -> dict:
                     result = res
             time.sleep(0.001)
         store_s = time.perf_counter() - start
-        nbytes = result.bytes_transferred
+        if not result.success or result.shed_hashes:
+            raise RuntimeError(
+                f"store leg degraded (success={result.success}, "
+                f"shed={len(result.shed_hashes)}): throughput not measurable"
+            )
+        store_bytes = result.bytes_transferred
 
         start = time.perf_counter()
         job = handlers.async_load_blocks(transfers)
@@ -178,14 +183,17 @@ def bench_offload_throughput() -> dict:
                     result = res
             time.sleep(0.001)
         load_s = time.perf_counter() - start
+        if not result.success:
+            raise RuntimeError("load leg failed: throughput not measurable")
+        load_bytes = result.bytes_transferred
         handlers.shutdown()
 
         return {
             "metric": "offload store/load throughput (64 blocks, "
-                      f"{nbytes / 1e6:.0f} MB, device↔host↔disk)",
-            "value": round(nbytes / store_s / 1e9, 3),
+                      f"{store_bytes / 1e6:.0f} MB, device↔host↔disk)",
+            "value": round(store_bytes / store_s / 1e9, 3),
             "unit": "GB/s store "
-                    f"({nbytes / load_s / 1e9:.2f} GB/s load)",
+                    f"({load_bytes / load_s / 1e9:.2f} GB/s load)",
             "vs_baseline": 1.0,
         }
     finally:

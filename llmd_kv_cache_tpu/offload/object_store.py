@@ -230,13 +230,16 @@ class ObjectStoreOffloadHandlers:
         self, transfers: Sequence[tuple[int, Sequence[int]]], group_idx: int = 0
     ) -> int:
         job = self._make_job(is_store=True)
-        slabs = self.copier.gather_many_to_host(
-            [list(page_ids) for _, page_ids in transfers]
-        )
-        for (block_hash, page_ids), slab in zip(transfers, slabs):
-            if not self._put_slots.acquire(blocking=False):
+        # Acquire put slots BEFORE gathering: a saturated store must shed
+        # without paying device gathers/DMAs for data it will discard.
+        admitted: list[tuple[int, list[int]]] = []
+        for block_hash, page_ids in transfers:
+            if self._put_slots.acquire(blocking=False):
+                admitted.append((block_hash, list(page_ids)))
+            else:
                 job.shed_hashes.append(block_hash)
-                continue
+        slabs = self.copier.gather_many_to_host([p for _, p in admitted])
+        for (block_hash, _page_ids), slab in zip(admitted, slabs):
             key = self.mapper.block_key(block_hash, group_idx)
             # Zero-copy byte view (bfloat16 etc. lack the buffer protocol,
             # so reinterpret as uint8 first).

@@ -68,24 +68,49 @@ class TPUBlockCopier:
         slab = _gather_slab(self.k_cache, self.v_cache, ids)
         return np.asarray(jax.device_get(slab))
 
+    # Cap on pages merged into one device transfer: bounds the transient
+    # HBM slab (batching win saturates long before this; a job of hundreds
+    # of blocks must not materialize job-sized scratch in already-pressured
+    # HBM — offload runs exactly when HBM is tight).
+    MAX_BATCH_PAGES = 128
+
     def gather_many_to_host(
         self, page_id_groups: list[list[int]]
     ) -> list[np.ndarray]:
-        """Gather several page groups in ONE device program + ONE D2H
-        transfer, returning per-group host slabs (views into the merged
-        transfer — valid as long as the caller keeps them alive)."""
-        if not page_id_groups:
-            return []
-        all_ids = [p for group in page_id_groups for p in group]
-        ids = jnp.asarray(all_ids, jnp.int32)
-        merged = np.asarray(
-            jax.device_get(_gather_slab(self.k_cache, self.v_cache, ids))
-        )
-        out = []
-        pos = 0
+        """Gather several page groups with few device programs/DMAs.
+
+        Groups are merged into transfers of at most ``MAX_BATCH_PAGES``
+        pages. Returns one independent contiguous host array per group
+        (copies, not views — safe to hand to the I/O engine)."""
+        out: list[np.ndarray] = []
+        chunk: list[list[int]] = []
+        chunk_pages = 0
+
+        def flush():
+            nonlocal chunk, chunk_pages
+            if not chunk:
+                return
+            all_ids = [p for group in chunk for p in group]
+            merged = np.asarray(
+                jax.device_get(
+                    _gather_slab(self.k_cache, self.v_cache,
+                                 jnp.asarray(all_ids, jnp.int32))
+                )
+            )
+            pos = 0
+            for group in chunk:
+                out.append(
+                    np.ascontiguousarray(merged[:, :, pos:pos + len(group)])
+                )
+                pos += len(group)
+            chunk, chunk_pages = [], 0
+
         for group in page_id_groups:
-            out.append(np.ascontiguousarray(merged[:, :, pos:pos + len(group)]))
-            pos += len(group)
+            if chunk and chunk_pages + len(group) > self.MAX_BATCH_PAGES:
+                flush()
+            chunk.append(group)
+            chunk_pages += len(group)
+        flush()
         return out
 
     def scatter_from_host(self, slab: np.ndarray, page_ids: list[int]) -> None:
@@ -95,24 +120,38 @@ class TPUBlockCopier:
     def scatter_many_from_host(
         self, slabs: list[tuple[np.ndarray, list[int]]]
     ) -> None:
-        """Scatter several host slabs in ONE device program.
+        """Scatter several host slabs with few device programs.
 
-        Per-slab scatters each rewrite the cache arrays; batching a whole
-        job's loads into one concatenated scatter turns N cache updates
-        into one (measured ~30× on the load path).
+        Per-slab scatters each rewrite the cache arrays; batching turns N
+        cache updates into ~1 (measured ~30× on the load path). Merged
+        transfers are capped at ``MAX_BATCH_PAGES`` pages to bound the
+        transient HBM slab.
         """
-        if not slabs:
-            return
-        all_ids: list[int] = []
-        parts = []
-        for slab, page_ids in slabs:
-            parts.append(
-                np.asarray(slab).reshape(self.slab_shape(len(page_ids)))
+        chunk: list[tuple[np.ndarray, list[int]]] = []
+        chunk_pages = 0
+
+        def flush():
+            nonlocal chunk, chunk_pages
+            if not chunk:
+                return
+            all_ids: list[int] = []
+            parts = []
+            for slab, page_ids in chunk:
+                parts.append(
+                    np.asarray(slab).reshape(self.slab_shape(len(page_ids)))
+                )
+                all_ids.extend(page_ids)
+            merged = np.concatenate(parts, axis=2)  # page axis
+            device_slab = jax.device_put(merged)
+            self.k_cache, self.v_cache = _scatter_slab(
+                self.k_cache, self.v_cache, device_slab.astype(self.dtype),
+                jnp.asarray(all_ids, jnp.int32),
             )
-            all_ids.extend(page_ids)
-        merged = np.concatenate(parts, axis=2)  # page axis
-        ids = jnp.asarray(all_ids, jnp.int32)
-        device_slab = jax.device_put(merged)
-        self.k_cache, self.v_cache = _scatter_slab(
-            self.k_cache, self.v_cache, device_slab.astype(self.dtype), ids
-        )
+            chunk, chunk_pages = [], 0
+
+        for slab, page_ids in slabs:
+            if chunk and chunk_pages + len(page_ids) > self.MAX_BATCH_PAGES:
+                flush()
+            chunk.append((slab, page_ids))
+            chunk_pages += len(page_ids)
+        flush()
