@@ -175,7 +175,11 @@ class BlockManager:
         info = self.blocks.pop(victim_hash)
         self.page_to_hash.pop(info.page, None)
         self.free_pages.append(info.page)
-        self._emit([BlockRemovedEvent(block_hashes=[victim_hash])])
+        # Must carry the same group tag as the BlockStored that created the
+        # entry, or the index's entry-match eviction is a silent no-op.
+        self._emit([
+            BlockRemovedEvent(block_hashes=[victim_hash], group_idx=0)
+        ])
         return True
 
     def commit_blocks(

@@ -1,0 +1,119 @@
+"""Dual-granularity e2e: engine block size ≠ canonical indexer block size.
+
+The reference's dual-key design exists exactly for this (``index.go:130-142``
+many:1 / 1:many inference; ``pool.go`` realignment): engines hash at their
+own page size while the indexer content-addresses at a canonical size. Here
+a real MiniEngine (4-token pages) feeds a pool/indexer running at an
+8-token canonical block — every mapping and scoring path crosses the
+granularity boundary.
+"""
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.events.model import BlockStoredEvent, EventBatch
+from llmd_kv_cache_tpu.events.pool import Pool, PoolConfig
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+
+ENGINE_BLOCK = 4  # tiny model page size
+CANONICAL_BLOCK = 8  # indexer granularity: 2 engine blocks per canonical
+
+
+def make_stack():
+    indexer = Indexer(
+        IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=CANONICAL_BLOCK
+            )
+        ),
+        index=InMemoryIndex(InMemoryIndexConfig(size=10_000)),
+    )
+    pool = Pool(PoolConfig(concurrency=1), indexer.kv_block_index,
+                indexer.token_processor)
+    return indexer, pool
+
+
+def run_engine(events, pod, prompt):
+    engine = MiniEngine(
+        EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                     max_pages_per_seq=16, model_name="m",
+                     pod_identifier=pod),
+        event_sink=events.extend,
+    )
+    engine.add_request("r", prompt, max_new_tokens=1)
+    return engine
+
+
+class TestDualGranularity:
+    def test_many_to_one_mapping_end_to_end(self):
+        indexer, pool = make_stack()
+        events = []
+        prompt = list(range(100, 116))  # 16 tokens: 4 engine / 2 canonical
+        run_engine(events, "pod-a", prompt)
+
+        stored = [e for e in events if isinstance(e, BlockStoredEvent)]
+        assert stored[0].block_size == ENGINE_BLOCK
+        assert len(stored[0].block_hashes) == 4
+
+        pool.process_event_batch(
+            EventBatch(timestamp=0.0, events=events), "pod-a", "m"
+        )
+
+        # Canonical-granularity scoring sees 2 blocks.
+        scores = indexer.score_tokens(prompt, "m")
+        assert scores == {"pod-a": 2.0}
+
+        # Engine→request mapping is many:1: consecutive engine keys resolve
+        # to the same canonical key.
+        canonical = indexer.compute_block_keys(prompt, "m")
+        idx = indexer.kv_block_index
+        assert idx.get_request_key(stored[0].block_hashes[0]) == canonical[0]
+        assert idx.get_request_key(stored[0].block_hashes[1]) == canonical[0]
+        assert idx.get_request_key(stored[0].block_hashes[2]) == canonical[1]
+        assert idx.get_request_key(stored[0].block_hashes[3]) == canonical[1]
+
+    def test_eviction_across_granularity(self):
+        """Removing one engine block evicts its canonical key's entry."""
+        from llmd_kv_cache_tpu.events.model import BlockRemovedEvent
+
+        indexer, pool = make_stack()
+        events = []
+        prompt = list(range(200, 216))
+        run_engine(events, "pod-a", prompt)
+        pool.process_event_batch(
+            EventBatch(timestamp=0.0, events=events), "pod-a", "m"
+        )
+        stored = [e for e in events if isinstance(e, BlockStoredEvent)][0]
+
+        # evict the 3rd engine block → second canonical block drops (group
+        # tag must match the stored entries')
+        pool.process_event_batch(
+            EventBatch(timestamp=1.0, events=[
+                BlockRemovedEvent(block_hashes=[stored.block_hashes[2]],
+                                  group_idx=stored.group_idx)
+            ]),
+            "pod-a", "m",
+        )
+        scores = indexer.score_tokens(prompt, "m")
+        assert scores == {"pod-a": 1.0}  # prefix now breaks at block 2
+
+    def test_cross_pod_scoring_with_partial_engine_prefix(self):
+        """Second pod serves only the first half of the prompt."""
+        indexer, pool = make_stack()
+        prompt = list(range(300, 316))
+
+        events_a = []
+        run_engine(events_a, "pod-a", prompt)
+        pool.process_event_batch(
+            EventBatch(timestamp=0.0, events=events_a), "pod-a", "m"
+        )
+
+        events_b = []
+        run_engine(events_b, "pod-b", prompt[:8])  # one canonical block
+        pool.process_event_batch(
+            EventBatch(timestamp=0.0, events=events_b), "pod-b", "m"
+        )
+
+        scores = indexer.score_tokens(prompt, "m")
+        assert scores == {"pod-a": 2.0, "pod-b": 1.0}
