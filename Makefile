@@ -34,8 +34,10 @@ verify-examples: native
 	$(CPU_ENV) PYTHONPATH=. $(PY) examples/offline_events.py
 	$(CPU_ENV) PYTHONPATH=. $(PY) examples/fleet_demo.py
 
+# Developer check on the CPU backend (the driver separately compile-checks
+# entry() on the real chip).
 graft-check:
-	$(PY) -c "import __graft_entry__, jax; fn, a = __graft_entry__.entry(); \
+	$(CPU_ENV) $(PY) -c "import __graft_entry__, jax; fn, a = __graft_entry__.entry(); \
 	  print(jax.jit(fn)(*a).shape)"
 	$(CPU_ENV) $(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
