@@ -128,6 +128,174 @@ def _decode_kernel(
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _prefill_kernel(
+    # scalar prefetch
+    page_table_ref,  # [batch, pages_per_seq] int32
+    ctx_lens_ref,  # [batch] int32 (tokens already cached BEFORE the new ones)
+    total_lens_ref,  # [batch] int32 (ctx + new)
+    # inputs
+    q_ref,  # [1, q_tile, heads_group, head_dim] block for (b, h, qt)
+    k_hbm,
+    v_hbm,
+    # output
+    o_ref,
+    # scratch
+    k_scratch,
+    v_scratch,
+    sem,
+    *,
+    page_size: int,
+    q_tile: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    qt = pl.program_id(2)
+    # q_ref block: [1, 1, q_tile, 1, group, head_dim]
+    group, head_dim = q_ref.shape[4], q_ref.shape[5]
+
+    ctx_len = ctx_lens_ref[b]
+    total_len = total_lens_ref[b]
+    # Query rows in this tile sit at logical positions ctx_len + qt*q_tile + i.
+    q_start = ctx_len + qt * q_tile
+    # Causality: this tile needs keys up to position q_start + q_tile - 1.
+    max_key = jnp.minimum(q_start + q_tile, total_len)
+    num_pages = (max_key + page_size - 1) // page_size
+
+    def page_dma(slot, page_idx):
+        page = page_table_ref[b, page_idx]
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[page, :, h, :], k_scratch.at[slot], sem.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[page, :, h, :], v_scratch.at[slot], sem.at[slot, 1]
+            ),
+        )
+
+    @pl.when(num_pages > 0)
+    def _():
+        for c in page_dma(0, 0):
+            c.start()
+
+    q = q_ref[0, 0, :, 0].astype(jnp.float32) * scale  # [q_tile, group, hd]
+    q2d = q.transpose(1, 0, 2)  # [group, q_tile, head_dim]
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_tile, 1), 0)
+
+    def body(i, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = i % 2
+        next_slot = (i + 1) % 2
+
+        @pl.when(i + 1 < num_pages)
+        def _():
+            for c in page_dma(next_slot, i + 1):
+                c.start()
+
+        for c in page_dma(slot, i):
+            c.wait()
+
+        k = k_scratch[slot].astype(jnp.float32)  # [page_size, head_dim]
+        v = v_scratch[slot].astype(jnp.float32)
+
+        # [group, q_tile, page_size]
+        scores = jax.lax.dot_general(
+            q2d, k, dimension_numbers=(((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        mask = (k_pos <= q_pos) & (k_pos < total_len)  # [q_tile, page_size]
+        scores = jnp.where(mask[None], scores, _NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p, v, dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((group, q_tile, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, q_tile, 1), jnp.float32)
+    acc0 = jnp.zeros((group, q_tile, head_dim), jnp.float32)
+    _m, l_fin, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l_fin, 1e-30)  # [group, q_tile, head_dim]
+    o_ref[0, 0, :, 0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_tile", "interpret"))
+def pallas_paged_prefill_attention(
+    q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
+    k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+    ctx_lens: jax.Array,  # [batch] cached tokens before the new ones
+    total_lens: jax.Array,  # [batch] ctx + valid new tokens
+    *,
+    q_tile: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash prefill over paged KV (new tokens' KV already scattered).
+
+    Queries attend causally over cached prefix + themselves, streaming
+    pages HBM→VMEM per (batch, kv_head, q_tile) program. Returns
+    ``[batch, q_seq, q_heads, head_dim]``. ``q_seq`` must divide by
+    ``q_tile`` (callers pad; padded rows are masked out by total_lens).
+    """
+    batch, q_seq, q_heads, head_dim = q.shape
+    _, page_size, kv_heads, _ = k_cache.shape
+    group = q_heads // kv_heads
+    assert q_seq % q_tile == 0, "pad q_seq to a q_tile multiple"
+
+    # [batch, q_blocks, q_tile, kv_heads, group, head_dim] view via reshape:
+    q_blocked = q.reshape(batch, q_seq // q_tile, q_tile, kv_heads, group, head_dim)
+
+    kernel = functools.partial(
+        _prefill_kernel, page_size=page_size, q_tile=q_tile,
+        scale=head_dim ** -0.5,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(batch, kv_heads, q_seq // q_tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, q_tile, 1, group, head_dim),
+                lambda b, h, qt, *_p: (b, qt, 0, h, 0, 0),
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, q_tile, 1, group, head_dim),
+            lambda b, h, qt, *_p: (b, qt, 0, h, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
+            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, q_seq // q_tile, q_tile, kv_heads, group, head_dim), q.dtype
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      total_lens.astype(jnp.int32), q_blocked, k_cache, v_cache)
+
+    return out.reshape(batch, q_seq, q_heads, head_dim)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
