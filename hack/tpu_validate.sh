@@ -1,0 +1,95 @@
+#!/bin/bash
+# Opportunistic real-TPU validation: waits for the axon tunnel to be
+# healthy, then runs staged checks (each independently time-boxed so a
+# mid-run tunnel drop still leaves partial results). Results append to
+# $OUT (default /tmp/tpu_validation.log).
+OUT=${OUT:-/tmp/tpu_validation.log}
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python -c "import jax, jax.numpy as jnp; (jnp.ones((64,64))@jnp.ones((64,64))).block_until_ready(); print('ok')" 2>/dev/null | grep -q ok
+}
+
+stage() {  # stage <name> <timeout_s> <python-code>
+  local name=$1 tmo=$2 code=$3
+  if grep -q "^PASS $name" "$OUT" 2>/dev/null; then return 0; fi
+  echo "RUN  $name $(date +%T)" >> "$OUT"
+  if timeout "$tmo" python -c "$code" >> "$OUT" 2>&1; then
+    echo "PASS $name $(date +%T)" >> "$OUT"
+  else
+    echo "FAIL $name (or tunnel drop) $(date +%T)" >> "$OUT"
+    return 1
+  fi
+}
+
+attempts=0
+while [ $attempts -lt 120 ]; do
+  attempts=$((attempts+1))
+  if ! probe; then
+    sleep 120
+    continue
+  fi
+  echo "=== tunnel healthy at $(date +%T), attempt $attempts ===" >> "$OUT"
+
+  stage entry_compile 600 "
+import __graft_entry__, jax, time
+t=time.time(); fn, a = __graft_entry__.entry()
+out = jax.jit(fn)(*a); out.block_until_ready()
+print('entry compiled+ran on', jax.devices()[0].platform, out.shape, round(time.time()-t,1),'s')
+" || continue
+
+  stage pallas_decode 600 "
+import jax, jax.numpy as jnp, numpy as np, time
+from llmd_kv_cache_tpu.ops.pallas_paged_attention import pallas_paged_decode_attention
+from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
+rng = np.random.default_rng(0)
+b,qh,kvh,hd,ps,npg,pps = 4, 8, 4, 128, 16, 256, 16
+q = jnp.asarray(rng.normal(size=(b,qh,hd)), jnp.bfloat16)
+k = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
+v = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
+table = jnp.asarray(1+np.arange(b*pps).reshape(b,pps), jnp.int32)
+lens = jnp.asarray([250, 100, 37, 16], jnp.int32)
+t=time.time(); out = pallas_paged_decode_attention(q,k,v,table,lens); out.block_until_ready()
+print('pallas decode compiled', round(time.time()-t,1),'s')
+ref = paged_attention(q[:,None],k,v,table,(lens-1)[:,None],lens)[:,0]
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
+print('max abs err vs XLA ref:', err); assert err < 0.1
+import timeit
+n=50; dt = timeit.timeit(lambda: pallas_paged_decode_attention(q,k,v,table,lens).block_until_ready(), number=n)/n
+dt2 = timeit.timeit(lambda: paged_attention(q[:,None],k,v,table,(lens-1)[:,None],lens).block_until_ready(), number=n)/n
+print(f'decode: pallas {dt*1e6:.0f}us vs xla-gather {dt2*1e6:.0f}us')
+" || continue
+
+  stage pallas_prefill 600 "
+import jax, jax.numpy as jnp, numpy as np, time
+from llmd_kv_cache_tpu.ops.pallas_paged_attention import pallas_paged_prefill_attention
+from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
+rng = np.random.default_rng(0)
+b,qh,kvh,hd,ps,npg,pps,qs = 2, 8, 4, 128, 16, 256, 16, 128
+q = jnp.asarray(rng.normal(size=(b,qs,qh,hd)), jnp.bfloat16)
+k = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
+v = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
+table = jnp.asarray(1+np.arange(b*pps).reshape(b,pps), jnp.int32)
+ctx = jnp.asarray([64, 0], jnp.int32); total = ctx + qs
+t=time.time(); out = pallas_paged_prefill_attention(q,k,v,table,ctx,total,q_tile=16); out.block_until_ready()
+print('pallas prefill compiled', round(time.time()-t,1),'s')
+qpos = ctx[:,None]+jnp.arange(qs)[None,:]
+ref = paged_attention(q,k,v,table,qpos,total)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
+print('max abs err vs XLA ref:', err); assert err < 0.1
+" || continue
+
+  stage offload_throughput 600 "
+import sys; sys.argv=['bench','--offload']
+exec(open('bench.py').read())
+" || continue
+
+  stage ttft_bench 1200 "
+import sys; sys.argv=['bench','--ttft']
+exec(open('bench.py').read())
+" || continue
+
+  echo "=== ALL STAGES PASSED $(date +%T) ===" >> "$OUT"
+  exit 0
+done
+echo "gave up after $attempts attempts" >> "$OUT"

@@ -1,0 +1,83 @@
+"""Adapter fuzzing: arbitrary/malformed wire bytes must never crash the
+pool's workers — every failure is a raised ValueError/decode error that the
+pool logs and drops (crash-only ingestion, reference zmq/pool behavior)."""
+
+import msgpack
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.events import Pool, PoolConfig, RawMessage
+from llmd_kv_cache_tpu.events.adapters import SGLangAdapter, VLLMAdapter
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+
+
+@pytest.mark.parametrize("adapter_cls", [VLLMAdapter, SGLangAdapter])
+def test_random_bytes_never_crash_adapter(adapter_cls):
+    adapter = adapter_cls()
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        payload = bytes(rng.integers(0, 256, rng.integers(0, 64), dtype=np.uint8))
+        msg = RawMessage(topic="kv@p@m", sequence=i, payload=payload)
+        try:
+            adapter.parse_message(msg)
+        except Exception:
+            pass  # any exception type is fine; no hang, no segfault
+
+
+@pytest.mark.parametrize("adapter_cls", [VLLMAdapter, SGLangAdapter])
+def test_structurally_plausible_garbage(adapter_cls):
+    """msgpack-valid but semantically wrong payloads."""
+    adapter = adapter_cls()
+    rng = np.random.default_rng(1)
+    cases = [
+        [],  # empty batch
+        [1.0],  # no events list
+        [1.0, None],
+        ["ts", []],
+        [1.0, [None]],
+        [1.0, [[]]],
+        [1.0, [[123]]],
+        [1.0, [["BlockStored"]]],
+        [1.0, [["BlockStored", None, None, None, None]]],
+        [1.0, [["BlockStored", [None], None, [1], 4]]],
+        [1.0, [["BlockStored", [1], "parent", [1], 4]]],
+        [1.0, [["BlockStored", [1], None, ["tok"], 4]]],
+        [1.0, [["BlockRemoved"]]],
+        [1.0, [["BlockRemoved", {"a": 1}]]],
+        [1.0, [["AllBlocksCleared", "extra", 42]]],
+        [1.0, [], "dp-rank-as-string"],
+        {"not": "a list"},
+    ]
+    for case in cases:
+        payload = msgpack.packb(case, use_bin_type=True)
+        try:
+            adapter.parse_message(RawMessage(topic="kv@p@m", sequence=0,
+                                             payload=payload))
+        except Exception:
+            pass
+
+
+def test_pool_survives_sustained_garbage():
+    """A hostile publisher cannot take down the ingestion workers."""
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=4))
+    index = InMemoryIndex(InMemoryIndexConfig(size=100))
+    pool = Pool(PoolConfig(concurrency=2), index, processor)
+    pool.start()
+    rng = np.random.default_rng(2)
+    try:
+        for i in range(300):
+            payload = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            pool.add_task(RawMessage(topic=f"kv@p{i % 4}@m", sequence=i,
+                                     payload=payload))
+        # valid message still lands after the storm
+        good = msgpack.packb(
+            [1.0, [["BlockStored", [9], None, [1, 2, 3, 4], 4]]],
+            use_bin_type=True,
+        )
+        pool.add_task(RawMessage(topic="kv@p0@m", sequence=999, payload=good))
+        pool.join()
+        keys = processor.tokens_to_kv_block_keys(0, [1, 2, 3, 4], "m")
+        assert index.lookup(keys)
+    finally:
+        pool.shutdown()
