@@ -58,9 +58,27 @@ class TPUBlockCopier:
         layers, _, page_size, kv_heads, head_dim = k_cache.shape
         self.slab_shape = lambda n: (layers, 2, n, page_size, kv_heads, head_dim)
         self.dtype = k_cache.dtype
+        try:
+            self._pinned_sharding = jax.sharding.SingleDeviceSharding(
+                list(k_cache.devices())[0], memory_kind="pinned_host"
+            )
+        except Exception:  # pragma: no cover - runtime without memory kinds
+            self._pinned_sharding = None
 
     def slab_nbytes(self, n_pages: int) -> int:
         return int(np.prod(self.slab_shape(n_pages))) * self.dtype.itemsize
+
+    def _to_pinned_host(self, x: jax.Array) -> jax.Array:
+        """Route the device→host leg through pinned host memory when the
+        runtime supports memory kinds (true DMA staging, the role the
+        reference's cudaHostAlloc buffers play); plain transfer otherwise."""
+        if self._pinned_sharding is None:
+            return x
+        try:
+            return jax.device_put(x, self._pinned_sharding)
+        except Exception:  # pragma: no cover - runtime without the kind
+            self._pinned_sharding = None
+            return x
 
     def gather_to_host(self, page_ids: list[int]) -> np.ndarray:
         """Device-side page gather + one D2H transfer; returns the host slab."""
@@ -91,12 +109,9 @@ class TPUBlockCopier:
             if not chunk:
                 return
             all_ids = [p for group in chunk for p in group]
-            merged = np.asarray(
-                jax.device_get(
-                    _gather_slab(self.k_cache, self.v_cache,
-                                 jnp.asarray(all_ids, jnp.int32))
-                )
-            )
+            slab = _gather_slab(self.k_cache, self.v_cache,
+                                jnp.asarray(all_ids, jnp.int32))
+            merged = np.asarray(jax.device_get(self._to_pinned_host(slab)))
             pos = 0
             for group in chunk:
                 out.append(
