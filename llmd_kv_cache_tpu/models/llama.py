@@ -44,6 +44,17 @@ class LlamaConfig:
     # full attention. Both unset → pure full attention.
     sliding_window: Any = None  # Optional[int]
     swa_layers: tuple = ()
+    # Mixture-of-experts MLP (Mixtral-style): 0 → dense. Experts shard over
+    # the ``ep`` mesh axis.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+
+    def __post_init__(self):
+        if self.num_experts > 0 and self.num_experts_per_token > self.num_experts:
+            raise ValueError(
+                f"num_experts_per_token ({self.num_experts_per_token}) exceeds "
+                f"num_experts ({self.num_experts})"
+            )
 
     def layer_window(self, layer_idx: int):
         if self.sliding_window is not None and layer_idx in self.swa_layers:
@@ -71,20 +82,30 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 
     layers = []
     for i in range(cfg.num_layers):
-        lk = jax.random.split(keys[2 + i], 7)
-        layers.append(
-            {
-                "attn_norm": jnp.ones((h,), jnp.float32),
-                "wq": dense(lk[0], (h, cfg.num_heads * hd)),
-                "wk": dense(lk[1], (h, cfg.num_kv_heads * hd)),
-                "wv": dense(lk[2], (h, cfg.num_kv_heads * hd)),
-                "wo": dense(lk[3], (cfg.num_heads * hd, h)),
-                "mlp_norm": jnp.ones((h,), jnp.float32),
+        lk = jax.random.split(keys[2 + i], 8)
+        layer = {
+            "attn_norm": jnp.ones((h,), jnp.float32),
+            "wq": dense(lk[0], (h, cfg.num_heads * hd)),
+            "wk": dense(lk[1], (h, cfg.num_kv_heads * hd)),
+            "wv": dense(lk[2], (h, cfg.num_kv_heads * hd)),
+            "wo": dense(lk[3], (cfg.num_heads * hd, h)),
+            "mlp_norm": jnp.ones((h,), jnp.float32),
+        }
+        if cfg.num_experts > 0:
+            e, inter = cfg.num_experts, cfg.intermediate_size
+            layer.update({
+                "router": dense(lk[7], (h, e)),
+                "w_gate": dense(lk[4], (e, h, inter)),
+                "w_up": dense(lk[5], (e, h, inter)),
+                "w_down": dense(lk[6], (e, inter, h)),
+            })
+        else:
+            layer.update({
                 "w_gate": dense(lk[4], (h, cfg.intermediate_size)),
                 "w_up": dense(lk[5], (h, cfg.intermediate_size)),
                 "w_down": dense(lk[6], (cfg.intermediate_size, h)),
-            }
-        )
+            })
+        layers.append(layer)
 
     return {
         "embed": dense(keys[0], (cfg.vocab_size, h), scale=0.02),
@@ -104,6 +125,52 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+
+
+def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
+         aux_out: Any = None) -> jax.Array:
+    """MLP block: dense SwiGLU or Mixtral-style top-k MoE.
+
+    The MoE path computes all experts densely and mixes with a top-k
+    one-hot — the XLA-friendly reference formulation (static shapes, no
+    ragged dispatch); a capacity-based dispatch kernel is the later
+    optimization. Expert matmuls stay in the model dtype (bf16 MXU path,
+    like the dense branch); only router/softmax/mix math runs in f32.
+    Experts shard over the ``ep`` mesh axis.
+
+    ``aux_out``: a list to which the Switch-style load-balancing term
+    ``E·Σ_e f_e·P_e`` is appended (training); None skips it.
+    """
+    if cfg.num_experts > 0:
+        e = cfg.num_experts
+        k = cfg.num_experts_per_token
+        router_logits = (
+            mlp_in @ layer["router"].astype(mlp_in.dtype)
+        ).astype(jnp.float32)  # [b,s,E]
+        top_w, top_idx = jax.lax.top_k(router_logits, k)  # [b,s,k]
+        weights = jax.nn.softmax(top_w, axis=-1)
+        onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [b,s,k,E]
+
+        if aux_out is not None:
+            probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
+            f = jnp.mean(jnp.sum(onehot, axis=2) / k, axis=(0, 1))  # [E]
+            p = jnp.mean(probs, axis=(0, 1))  # [E]
+            aux_out.append(e * jnp.sum(f * p))
+
+        # bf16 matmuls, f32 activation math (mirrors the dense branch).
+        gate = jax.nn.silu(jnp.einsum(
+            "bsh,ehi->bsei", mlp_in, layer["w_gate"]
+        ).astype(jnp.float32))
+        up = jnp.einsum("bsh,ehi->bsei", mlp_in, layer["w_up"]).astype(jnp.float32)
+        expert_out = jnp.einsum(
+            "bsei,eih->bseh", (gate * up).astype(mlp_in.dtype), layer["w_down"]
+        ).astype(jnp.float32)
+        mix = jnp.einsum("bsk,bske,bseh->bsh", weights, onehot, expert_out)
+        return mix.astype(mlp_in.dtype)
+
+    gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
+    up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
+    return (gate * up).astype(mlp_in.dtype) @ layer["w_down"]
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -156,9 +223,7 @@ def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
 
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
-        up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
-        x = x + ((gate * up).astype(x.dtype) @ layer["w_down"])
+        x = x + _mlp(mlp_in, layer, cfg)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)

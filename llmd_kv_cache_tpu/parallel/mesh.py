@@ -43,15 +43,19 @@ def make_mesh(
     return Mesh(dev_array, tuple(axes.keys()))
 
 
-def param_pspecs(has_tp: bool = True) -> dict:
-    """PartitionSpecs for the Llama parameter tree.
+def param_pspecs(has_tp: bool = True, has_ep: bool = False,
+                 moe_layer: bool = False) -> dict:
+    """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
     row-parallel wo/down (input features over ``tp``), vocab-sharded
     embed/lm_head — the standard Megatron-style layout that keeps matmuls
-    large on the MXU and puts one all-reduce per block on ICI.
+    large on the MXU and puts one all-reduce per block on ICI. MoE expert
+    tensors additionally shard their leading expert dim over ``ep``.
     """
     tp = "tp" if has_tp else None
+    ep = "ep" if has_ep else None
+    # Shared attention/norm layout; only the MLP family differs.
     layer = {
         "attn_norm": P(),
         "wq": P(None, tp),
@@ -59,10 +63,20 @@ def param_pspecs(has_tp: bool = True) -> dict:
         "wv": P(None, tp),
         "wo": P(tp, None),
         "mlp_norm": P(),
-        "w_gate": P(None, tp),
-        "w_up": P(None, tp),
-        "w_down": P(tp, None),
     }
+    if moe_layer:
+        layer.update({
+            "router": P(),
+            "w_gate": P(ep, None, tp),
+            "w_up": P(ep, None, tp),
+            "w_down": P(ep, tp, None),
+        })
+    else:
+        layer.update({
+            "w_gate": P(None, tp),
+            "w_up": P(None, tp),
+            "w_down": P(tp, None),
+        })
     return {
         "embed": P(tp, None),
         "layers": layer,  # broadcast over the list of layers
@@ -80,7 +94,11 @@ def _tree_with_layers(spec_tree: dict, num_layers: int) -> dict:
 def param_shardings(mesh: Mesh, params: Params) -> dict:
     """NamedShardings matching the parameter tree structure."""
     has_tp = "tp" in mesh.axis_names
-    specs = _tree_with_layers(param_pspecs(has_tp), len(params["layers"]))
+    has_ep = "ep" in mesh.axis_names
+    moe = "router" in params["layers"][0]
+    specs = _tree_with_layers(
+        param_pspecs(has_tp, has_ep, moe_layer=moe), len(params["layers"])
+    )
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
         specs,

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.llama import LlamaConfig, Params, _rms_norm, _rope
+from ..models.llama import LlamaConfig, Params, _mlp, _rms_norm, _rope
 from .mesh import param_shardings
 
 
@@ -29,6 +29,7 @@ def forward_train(
     tokens: jax.Array,  # [batch, seq]
     mesh_axes: tuple[Optional[str], Optional[str]] = (None, None),
     attention_fn=None,
+    aux_out=None,
 ) -> jax.Array:
     """Causal-LM forward without KV cache (training path).
 
@@ -79,22 +80,31 @@ def forward_train(
         x = constrain(x + attn.reshape(batch, seq, -1) @ layer["wo"])
 
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
-        up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
-        x = constrain(x + (gate * up).astype(x.dtype) @ layer["w_down"])
+        x = constrain(x + _mlp(mlp_in, layer, cfg, aux_out=aux_out))
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
+MOE_AUX_LOSS_WEIGHT = 0.01  # Switch-Transformer convention
+
+
 def loss_fn(params: Params, cfg: LlamaConfig, tokens: jax.Array, mesh_axes,
             attention_fn=None) -> jax.Array:
-    """Next-token cross-entropy over shifted tokens."""
-    logits = forward_train(params, cfg, tokens, mesh_axes, attention_fn)
+    """Next-token cross-entropy over shifted tokens.
+
+    MoE configs add the Switch load-balancing auxiliary term so the router
+    cannot collapse onto a few experts (dead-expert failure mode)."""
+    aux: list = [] if cfg.num_experts > 0 else None
+    logits = forward_train(params, cfg, tokens, mesh_axes, attention_fn,
+                           aux_out=aux)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    loss = nll.mean()
+    if aux:
+        loss = loss + MOE_AUX_LOSS_WEIGHT * sum(aux) / len(aux)
+    return loss
 
 
 def make_train_state(
