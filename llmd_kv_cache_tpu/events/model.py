@@ -7,7 +7,7 @@ plus the raw transport envelope. Parsing is deferred to per-engine adapters.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional, Protocol, Sequence
+from typing import Any, Optional, Protocol
 
 EVENT_TYPE_BLOCK_STORED = "BlockStored"
 EVENT_TYPE_BLOCK_REMOVED = "BlockRemoved"

@@ -15,7 +15,7 @@ count, so they divide evenly): 1:1, many:1 or 1:many.
 from __future__ import annotations
 
 import abc
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from ..core.keys import BlockHash, KeyType, PodEntry

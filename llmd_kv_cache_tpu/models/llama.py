@@ -13,7 +13,7 @@ token step is one XLA program; donate the caches for in-place updates.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 from typing import Any
 

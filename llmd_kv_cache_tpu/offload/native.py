@@ -8,7 +8,6 @@ runs on the C++ pool threads, off the GIL.
 from __future__ import annotations
 
 import ctypes
-import os
 import subprocess
 import threading
 from pathlib import Path

@@ -19,18 +19,13 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 
 from ..utils.logging import get_logger
 from .file_mapper import FileMapper
-from .native import (
-    STATUS_IO_ERROR,
-    STATUS_OK,
-    STATUS_PENDING,
-    NativeIOEngine,
-)
+from .native import STATUS_OK, STATUS_PENDING, NativeIOEngine
 from .tpu_copier import TPUBlockCopier
 
 logger = get_logger("offload.worker")

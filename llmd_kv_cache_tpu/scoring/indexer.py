@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..core.extra_keys import BlockExtraFeatures
-from ..core.keys import BlockHash, PodEntry
+from ..core.keys import BlockHash
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from ..index.base import Index, IndexConfig, create_index
 from ..telemetry import tracer
