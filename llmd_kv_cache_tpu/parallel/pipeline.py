@@ -1,0 +1,152 @@
+"""Pipeline parallelism: layer-sharded training over a ``pp`` mesh axis.
+
+The dense transformer's layers are stacked into leading-axis arrays and
+scanned; sharding that leading axis over ``pp`` distributes the parameters
+(and their optimizer state) across pipeline stages — the memory-scaling
+half of pipeline parallelism, with XLA moving activations between stages
+at the scan steps. The schedule is sequential (GPipe-style microbatch
+interleaving / 1F1B is the round-2 follow-up); composes with dp/tp on the
+other axes.
+
+Dense layers only (MoE layers scale across ``ep`` instead).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, Params, _mlp, _rms_norm
+
+
+def stack_layer_params(params: Params) -> dict:
+    """Convert the per-layer list tree into stacked [L, ...] arrays."""
+    layers = params["layers"]
+    stacked = {
+        key: jnp.stack([layer[key] for layer in layers])
+        for key in layers[0]
+    }
+    return {
+        "embed": params["embed"],
+        "layers_stacked": stacked,
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def unstack_layer_params(stacked_params: dict) -> Params:
+    """Inverse of ``stack_layer_params`` (checkpoint interop)."""
+    stacked = stacked_params["layers_stacked"]
+    num_layers = next(iter(stacked.values())).shape[0]
+    layers = [
+        {key: stacked[key][i] for key in stacked} for i in range(num_layers)
+    ]
+    return {
+        "embed": stacked_params["embed"],
+        "layers": layers,
+        "final_norm": stacked_params["final_norm"],
+        "lm_head": stacked_params["lm_head"],
+    }
+
+
+def stacked_param_pspecs(has_tp: bool, pp_axis: Optional[str]) -> dict:
+    """PartitionSpecs for the stacked tree: layer axis over ``pp``, the
+    Megatron tp layout within each layer."""
+    tp = "tp" if has_tp else None
+    return {
+        "embed": P(tp, None),
+        "layers_stacked": {
+            "attn_norm": P(pp_axis, None),
+            "wq": P(pp_axis, None, tp),
+            "wk": P(pp_axis, None, tp),
+            "wv": P(pp_axis, None, tp),
+            "wo": P(pp_axis, tp, None),
+            "mlp_norm": P(pp_axis, None),
+            "w_gate": P(pp_axis, None, tp),
+            "w_up": P(pp_axis, None, tp),
+            "w_down": P(pp_axis, tp, None),
+        },
+        "final_norm": P(),
+        "lm_head": P(None, tp),
+    }
+
+
+def forward_train_pp(stacked_params: dict, cfg: LlamaConfig,
+                     tokens: jax.Array) -> jax.Array:
+    """Causal-LM forward scanning stacked (pipeline-sharded) layers.
+
+    The per-layer body is ``train.attention_block`` + ``_mlp`` — shared
+    with the python-loop formulation so the two paths cannot drift.
+    """
+    from .train import attention_block
+
+    batch, seq = tokens.shape
+    positions = jnp.arange(seq)[None, :].repeat(batch, axis=0)
+
+    x = stacked_params["embed"][tokens]
+
+    def layer_step(x, layer):
+        x = x + attention_block(x, layer, cfg, positions)
+        mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(mlp_in, layer, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, stacked_params["layers_stacked"])
+    x = _rms_norm(x, stacked_params["final_norm"], cfg.norm_eps)
+    return (x @ stacked_params["lm_head"]).astype(jnp.float32)
+
+
+def pp_loss_fn(stacked_params, cfg, tokens):
+    logits = forward_train_pp(stacked_params, cfg, tokens)
+    targets = tokens[:, 1:]
+    logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+@partial(jax.jit, static_argnames=("cfg", "opt"))
+def pp_train_step(stacked_params, opt_state, cfg: LlamaConfig,
+                  opt: optax.GradientTransformation, tokens: jax.Array):
+    loss, grads = jax.value_and_grad(pp_loss_fn)(stacked_params, cfg, tokens)
+    updates, opt_state = opt.update(grads, opt_state, stacked_params)
+    stacked_params = optax.apply_updates(stacked_params, updates)
+    return stacked_params, opt_state, loss
+
+
+def make_pp_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt):
+    """Prepare pipeline-sharded training over ``mesh``'s ``pp`` axis.
+
+    Returns ``(step_fn, stacked_params, opt_state, data_sharding)``.
+    ``num_layers`` must divide evenly by the pp axis size.
+    """
+    if "pp" not in mesh.axis_names:
+        raise ValueError("pipeline training requires a 'pp' mesh axis")
+    if cfg.num_experts > 0:
+        raise ValueError("pipeline path supports dense layers (MoE uses ep)")
+    pp_size = dict(zip(mesh.axis_names, mesh.devices.shape))["pp"]
+    if cfg.num_layers % pp_size != 0:
+        raise ValueError(
+            f"num_layers ({cfg.num_layers}) must divide by pp size ({pp_size})"
+        )
+    dp = "dp" if "dp" in mesh.axis_names else None
+    has_tp = "tp" in mesh.axis_names
+
+    stacked = stack_layer_params(params)
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        stacked_param_pspecs(has_tp, "pp"),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    stacked = jax.device_put(stacked, shardings)
+    opt_state = opt.init(stacked)
+    data_sharding = NamedSharding(mesh, P(dp, None))
+
+    def step(p, s, tokens):
+        return pp_train_step(p, s, cfg, opt, tokens)
+
+    return jax.jit(step), stacked, opt_state, data_sharding

@@ -50,40 +50,48 @@ def forward_train(
 
     x = constrain(params["embed"][tokens])
 
-    # Dense-path causal mask; the ring path masks per-block internally, so
-    # don't trace an O(S^2) op in exactly the long-context regime.
-    causal = None if attention_fn is not None else jnp.tril(jnp.ones((seq, seq), bool))
-
     for layer in params["layers"]:
-        attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (attn_in @ layer["wq"]).reshape(batch, seq, cfg.num_heads, cfg.head_dim)
-        k = (attn_in @ layer["wk"]).reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
-        v = (attn_in @ layer["wv"]).reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        if cfg.num_heads != cfg.num_kv_heads:
-            rep = cfg.num_heads // cfg.num_kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
-        if attention_fn is not None:
-            attn = attention_fn(q, k, v)
-        else:
-            logits = jnp.einsum(
-                "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-            ) * (cfg.head_dim ** -0.5)
-            logits = jnp.where(causal[None, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            attn = jnp.einsum(
-                "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)
-            ).astype(x.dtype)
-        x = constrain(x + attn.reshape(batch, seq, -1) @ layer["wo"])
-
+        x = constrain(x + attention_block(x, layer, cfg, positions, attention_fn))
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         x = constrain(x + _mlp(mlp_in, layer, cfg, aux_out=aux_out))
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def attention_block(x, layer, cfg, positions, attention_fn=None):
+    """One training-path attention block (shared by the python-loop and
+    pipeline-scan formulations so they cannot drift).
+
+    ``attention_fn(q, k, v) -> out`` overrides the dense causal backend
+    (e.g. ring attention); the dense path builds its causal mask here (the
+    override path never traces the O(S^2) mask).
+    """
+    batch, seq = x.shape[0], x.shape[1]
+    attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (attn_in @ layer["wq"]).reshape(batch, seq, cfg.num_heads, cfg.head_dim)
+    k = (attn_in @ layer["wk"]).reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+    v = (attn_in @ layer["wv"]).reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.num_heads != cfg.num_kv_heads:
+        rep = cfg.num_heads // cfg.num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    if attention_fn is not None:
+        attn = attention_fn(q, k, v)
+    else:
+        causal = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * (cfg.head_dim ** -0.5)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
+            v.astype(jnp.float32),
+        ).astype(x.dtype)
+    return attn.reshape(batch, seq, -1) @ layer["wo"]
 
 
 MOE_AUX_LOSS_WEIGHT = 0.01  # Switch-Transformer convention

@@ -1,0 +1,91 @@
+"""Pipeline-parallel training tests (pp axis, 8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
+from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+from llmd_kv_cache_tpu.parallel.pipeline import (
+    forward_train_pp,
+    make_pp_train_step,
+    stack_layer_params,
+    unstack_layer_params,
+)
+from llmd_kv_cache_tpu.parallel.train import forward_train, make_train_state
+
+
+def small_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                num_kv_heads=2, head_dim=8, intermediate_size=64, page_size=4)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+class TestStacking:
+    def test_roundtrip(self):
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        stacked = stack_layer_params(params)
+        assert stacked["layers_stacked"]["wq"].shape[0] == cfg.num_layers
+        back = unstack_layer_params(stacked)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+    def test_scan_forward_matches_loop_forward(self):
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 8)), jnp.int32
+        )
+        ref = forward_train(params, cfg, tokens)
+        pp = forward_train_pp(stack_layer_params(params), cfg, tokens)
+        # bf16 model: scan vs unrolled layers fuse differently; compare at
+        # bf16-resolution absolute tolerance.
+        np.testing.assert_allclose(np.asarray(pp), np.asarray(ref), atol=1e-2)
+
+
+class TestPPTrainStep:
+    def test_pp_sharded_training(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        mesh = make_mesh({"dp": 2, "pp": 2, "tp": 2})
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, _ = make_train_state(params)
+        with mesh:
+            step, stacked, opt_state, data_sharding = make_pp_train_step(
+                mesh, cfg, params, opt
+            )
+            # layer axis genuinely sharded over pp
+            assert stacked["layers_stacked"]["wq"].sharding.spec[0] == "pp"
+            tokens = jax.device_put(
+                jnp.asarray(
+                    np.random.default_rng(0).integers(0, 64, (4, 8)), jnp.int32
+                ),
+                data_sharding,
+            )
+            losses = []
+            p, s = stacked, opt_state
+            for _ in range(3):
+                p, s, loss = step(p, s, tokens)
+                losses.append(float(loss))
+            assert all(np.isfinite(losses))
+            assert losses[2] < losses[0]
+
+    def test_validation_errors(self):
+        mesh = make_mesh({"dp": len(jax.devices())})
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, _ = make_train_state(params)
+        with pytest.raises(ValueError, match="pp"):
+            make_pp_train_step(mesh, cfg, params, opt)
+
+        if len(jax.devices()) >= 8:
+            mesh3 = make_mesh({"dp": 2, "pp": 2, "tp": 2})
+            cfg3 = small_cfg(num_layers=3)
+            params3 = init_params(jax.random.PRNGKey(0), cfg3)
+            with pytest.raises(ValueError, match="divide"):
+                make_pp_train_step(mesh3, cfg3, params3, opt)
