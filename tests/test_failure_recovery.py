@@ -1,0 +1,121 @@
+"""Failure-model tests: crash-only recovery and active-active convergence.
+
+Backs SURVEY §5's failure-detection claims with live sockets: engines die
+and return, subscribers just keep working; multiple indexer replicas
+ingesting the same stream converge to identical scores.
+"""
+
+import time
+
+import pytest
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.events import Pool, PoolConfig, ZMQSubscriber
+from llmd_kv_cache_tpu.events.model import BlockStoredEvent
+from llmd_kv_cache_tpu.events.publisher import KVEventPublisher
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+
+BLOCK = 4
+MODEL = "m"
+
+
+def wait_until(cond, timeout=6.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def make_stack(concurrency=1):
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+    index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    pool = Pool(PoolConfig(concurrency=concurrency), index, processor)
+    pool.start()
+    return processor, index, pool
+
+
+class TestEngineRestart:
+    def test_publisher_death_and_rebirth(self):
+        """A pod crashes (socket gone) and comes back on the same endpoint:
+        the connect-mode subscriber resumes without intervention."""
+        processor, index, pool = make_stack()
+        endpoint = "tcp://127.0.0.1:16100"
+        sub = ZMQSubscriber(endpoint, "kv@", pool.add_task, bind=False)
+        sub.start()
+        t1, t2 = list(range(8)), list(range(100, 108))
+        rk1 = processor.tokens_to_kv_block_keys(0, t1, MODEL)
+        rk2 = processor.tokens_to_kv_block_keys(0, t2, MODEL)
+        try:
+            pub = KVEventPublisher(endpoint, "pod-a", MODEL, bind=True)
+            time.sleep(0.3)
+
+            def pub_until(publisher, hashes, tokens, rks):
+                for _ in range(20):
+                    publisher.publish([BlockStoredEvent(
+                        block_hashes=hashes, tokens=tokens, parent_hash=0,
+                        block_size=BLOCK)])
+                    if wait_until(lambda: index.lookup(rks) != {}, timeout=0.5):
+                        return True
+                return False
+
+            assert pub_until(pub, [1, 2], t1, rk1)
+
+            # pod dies
+            pub.close()
+            time.sleep(0.2)
+
+            # pod restarts on the same endpoint; after its prefix-cache
+            # reset it stores a different prompt
+            pub2 = KVEventPublisher(endpoint, "pod-a", MODEL, bind=True)
+            assert pub_until(pub2, [3, 4], t2, rk2)
+            pub2.close()
+        finally:
+            sub.stop()
+            pool.shutdown()
+
+
+class TestActiveActiveReplicas:
+    def test_two_replicas_converge(self):
+        """Two independent indexer replicas ingest one engine stream and
+        return identical scores."""
+        endpoint = "tcp://127.0.0.1:16101"
+        stacks = [make_stack() for _ in range(2)]
+        subs = []
+        for _, _, pool in stacks:
+            sub = ZMQSubscriber(endpoint, "kv@", pool.add_task, bind=False)
+            sub.start()
+            subs.append(sub)
+        tokens = list(range(16))
+        try:
+            pub = KVEventPublisher(endpoint, "pod-a", MODEL, bind=True)
+            time.sleep(0.4)
+            rks = stacks[0][0].tokens_to_kv_block_keys(0, tokens, MODEL)
+            for _ in range(20):
+                pub.publish([BlockStoredEvent(
+                    block_hashes=[1, 2, 3, 4], tokens=tokens, parent_hash=0,
+                    block_size=BLOCK)])
+                if all(
+                    wait_until(lambda idx=idx: len(idx.lookup(rks)) == 4,
+                               timeout=0.5)
+                    for _, idx, _ in stacks
+                ):
+                    break
+
+            scores = []
+            for processor, index, _pool in stacks:
+                indexer = Indexer(
+                    IndexerConfig(token_processor_config=TokenProcessorConfig(
+                        block_size_tokens=BLOCK)),
+                    index=index,
+                )
+                scores.append(indexer.score_tokens(tokens, MODEL))
+            assert scores[0] == scores[1] == {"pod-a": 4.0}
+            pub.close()
+        finally:
+            for sub in subs:
+                sub.stop()
+            for _, _, pool in stacks:
+                pool.shutdown()
