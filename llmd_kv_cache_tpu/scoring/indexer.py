@@ -48,6 +48,22 @@ class IndexerConfig:
             from ..index.cost_aware import CostAwareMemoryIndexConfig
             from ..index.in_memory import InMemoryIndexConfig
 
+            # Valkey is wire-compatible with Redis (reference index.go:74-79
+            # keeps a distinct config slot); fold it into the redis backend
+            # with the valkey backend type.
+            redis_cfg = index_dict.get("redisConfig")
+            valkey_cfg = index_dict.get("valkeyConfig")
+            if redis_cfg is None and valkey_cfg is not None:
+                redis_cfg = dict(valkey_cfg)
+                redis_cfg.setdefault("backendType", "valkey")
+
+            native_dict = index_dict.get("nativeConfig")
+            native_cfg = None
+            if native_dict is not None:
+                from ..index.native import NativeIndexConfig
+
+                native_cfg = NativeIndexConfig.from_dict(native_dict)
+
             cfg.index_config = IndexConfig(
                 in_memory_config=InMemoryIndexConfig.from_dict(index_dict.get("inMemoryConfig"))
                 if index_dict.get("inMemoryConfig") is not None
@@ -57,8 +73,10 @@ class IndexerConfig:
                 )
                 if index_dict.get("costAwareMemoryConfig") is not None
                 else None,
-                redis_config=index_dict.get("redisConfig"),
+                redis_config=redis_cfg,
+                native_config=native_cfg,
                 enable_metrics=index_dict.get("enableMetrics", False),
+                enable_tracing=index_dict.get("enableTracing", False),
                 metrics_logging_interval_s=index_dict.get("metricsLoggingInterval", 0.0),
             )
         return cfg

@@ -106,6 +106,19 @@ class TestIndexer:
         indexer = self.make_indexer()
         assert indexer.score_tokens(list(range(16)), "m") == {}
 
+    def test_config_from_dict_valkey_and_native(self):
+        from llmd_kv_cache_tpu.index.native import NativeIndexConfig
+
+        cfg = IndexerConfig.from_dict(
+            {"kvBlockIndexConfig": {"valkeyConfig": {"address": "valkey://h:6379"}}}
+        )
+        assert cfg.index_config.redis_config["backendType"] == "valkey"
+        cfg2 = IndexerConfig.from_dict(
+            {"kvBlockIndexConfig": {"nativeConfig": {"size": 123}}}
+        )
+        assert isinstance(cfg2.index_config.native_config, NativeIndexConfig)
+        assert cfg2.index_config.native_config.size == 123
+
     def test_config_from_dict(self):
         cfg = IndexerConfig.from_dict(
             {
