@@ -3,12 +3,16 @@
 # real TPU chip in the benchmark environment).
 
 PY := python
-CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+# PYTHONPATH pinned to the repo root: test/dev targets must not inherit
+# site customizations that pull in accelerator tunnels (a dead tunnel
+# would hang even CPU-backend jax initialization).
+CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race native bench graft-check lint clean
+.PHONY: test unit-test-race native bench graft-check verify-examples lint clean
 
 test: native
-	$(PY) -m pytest tests/ -q
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # Concurrency-focused pass (the reference runs `go test -race` nightly;
 # Python has no race detector, so the thread-heavy suites are repeated —
@@ -16,7 +20,7 @@ test: native
 # hiding them).
 unit-test-race: native
 	for i in 1 2 3; do \
-	  $(PY) -m pytest tests/test_stress.py tests/test_pool.py \
+	  $(CPU_ENV) $(PY) -m pytest tests/test_stress.py tests/test_pool.py \
 	    tests/test_index.py tests/test_zmq_integration.py \
 	    tests/test_evictor.py -q || exit 1; \
 	done
@@ -31,8 +35,8 @@ bench: native
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).
 verify-examples: native
-	$(CPU_ENV) PYTHONPATH=. $(PY) examples/offline_events.py
-	$(CPU_ENV) PYTHONPATH=. $(PY) examples/fleet_demo.py
+	$(CPU_ENV) $(PY) examples/offline_events.py
+	$(CPU_ENV) $(PY) examples/fleet_demo.py
 
 # Developer check on the CPU backend (the driver separately compile-checks
 # entry() on the real chip).
