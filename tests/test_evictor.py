@@ -169,6 +169,30 @@ class TestActivatorAndPipeline:
         ev.stop()
         assert ev.total_deleted == 5
 
+    def test_supervisor_restarts_crashed_worker(self, store):
+        """A crashing stage must be restarted, not silently die
+        (reference evictor.py supervisor semantics)."""
+        tmp_path, mapper, hashes = store
+        calls = {"n": 0}
+
+        def flaky_usage():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("transient disk-stat failure")
+            return 0.95
+
+        cfg = EvictorConfig(store_root=str(tmp_path), num_crawlers=1,
+                            min_idle_seconds=3600, poll_interval_s=0.05)
+        ev = Evictor(cfg, usage_fn=flaky_usage)
+        ev.start()
+        try:
+            deadline = time.time() + 5
+            while ev.total_deleted < 5 and time.time() < deadline:
+                time.sleep(0.02)
+            assert ev.total_deleted == 5  # survived the crashes and worked
+        finally:
+            ev.stop()
+
     def test_config_from_env(self):
         cfg = EvictorConfig.from_env({
             "KVTPU_EVICTOR_STORE_ROOT": "/data",
