@@ -62,6 +62,10 @@ class EngineConfig:
     # XLA reference elsewhere); True forces Pallas (interpreted on CPU);
     # False forces the XLA path.
     use_pallas_decode: Optional[bool] = None
+    # Chunked prefill: the uncached suffix is processed in chunks of at
+    # most this many tokens (vLLM-style), bounding per-step activation
+    # memory for long prompts. Must be a multiple of the page size.
+    max_prefill_tokens: int = 512
 
 
 @dataclass
@@ -466,31 +470,44 @@ class MiniEngine:
         return table
 
     def _prefill(self, req: Request) -> None:
-        """Run the model over the uncached prompt suffix in one step."""
+        """Run the model over the uncached prompt suffix, chunked.
+
+        Chunks of at most ``max_prefill_tokens`` bound activation memory on
+        long prompts (vLLM-style chunked prefill); each chunk's KV lands in
+        the paged cache so the next chunk attends over it.
+        """
         page_size = self.cfg.model.page_size
         start = min(req.cached_len, len(req.prompt) - 1)
-        suffix = req.prompt[start:]
-        # Bucket the padded length to powers of two (in pages) so the jit
-        # cache holds O(log max_seq) prefill shapes instead of one per
-        # suffix length — compiles are 20-40 s each on TPU.
-        pages_needed = max(1, (len(suffix) + page_size - 1) // page_size)
-        bucket = 1
-        while bucket < pages_needed:
-            bucket *= 2
-        seq = bucket * page_size
-        tokens = np.zeros((1, seq), np.int32)
-        tokens[0, : len(suffix)] = suffix
+        chunk_cap = max(page_size, self.cfg.max_prefill_tokens
+                        // page_size * page_size)
+        table = jnp.asarray(self._page_table_for(req))[None, :]
 
-        logits, self.k_cache, self.v_cache = forward(
-            self.params, self.cfg.model,
-            jnp.asarray(tokens),
-            self.k_cache, self.v_cache,
-            jnp.asarray(self._page_table_for(req))[None, :],
-            jnp.asarray([start], jnp.int32),
-            jnp.asarray([len(suffix)], jnp.int32),
-        )
+        logits = None
+        pos = start
+        while pos < len(req.prompt):
+            chunk = req.prompt[pos:pos + chunk_cap]
+            # Bucket the padded length to powers of two (in pages) so the
+            # jit cache holds O(log max_prefill) shapes instead of one per
+            # suffix length — compiles are 20-40 s each on TPU.
+            pages_needed = max(1, (len(chunk) + page_size - 1) // page_size)
+            bucket = 1
+            while bucket < pages_needed:
+                bucket *= 2
+            seq = bucket * page_size
+            tokens = np.zeros((1, seq), np.int32)
+            tokens[0, : len(chunk)] = chunk
+
+            logits, self.k_cache, self.v_cache = forward(
+                self.params, self.cfg.model,
+                jnp.asarray(tokens),
+                self.k_cache, self.v_cache,
+                table,
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([len(chunk)], jnp.int32),
+            )
+            req.last_logits = np.asarray(logits[0, len(chunk) - 1])
+            pos += len(chunk)
         req.computed_len = len(req.prompt)
-        req.last_logits = np.asarray(logits[0, len(suffix) - 1])
 
     def _commit_full_blocks(self, req: Request) -> None:
         """Register newly computed full prompt blocks in the prefix cache."""

@@ -122,6 +122,38 @@ class TestEvents:
         assert engine.block_manager.num_cached_blocks() == 0
 
 
+class TestChunkedPrefill:
+    def test_chunked_equals_single_shot(self):
+        """Chunked prefill must produce identical generations."""
+        prompt = list(range(100, 124))  # 24 tokens
+        outs = {}
+        for cap in (1024, 8):  # single-shot vs 2-page chunks
+            engine = MiniEngine(
+                EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                             max_pages_per_seq=16, model_name="tiny",
+                             pod_identifier="p", max_prefill_tokens=cap),
+                seed=0,
+            )
+            outs[cap] = engine.generate("r", prompt, max_new_tokens=4)
+        assert outs[1024] == outs[8]
+
+    def test_chunked_prefill_commits_blocks(self):
+        events = []
+        engine = MiniEngine(
+            EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="tiny",
+                         pod_identifier="p", max_prefill_tokens=8),
+            event_sink=events.extend,
+        )
+        prompt = list(range(200, 216))
+        req = engine.add_request("r", prompt, max_new_tokens=1)
+        stored = [e for e in events if isinstance(e, BlockStoredEvent)]
+        assert stored and stored[0].tokens == prompt
+        # prefix cache warm for the next identical request
+        req2 = engine.add_request("r2", prompt, max_new_tokens=1)
+        assert req2.cached_len == len(prompt)
+
+
 class TestPageAccounting:
     def test_oversized_request_rejected(self):
         engine = make_engine()
