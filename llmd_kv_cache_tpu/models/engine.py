@@ -661,6 +661,22 @@ class MiniEngine:
 
     # -- lifecycle --
 
+    def abort_request(self, request_id: str) -> bool:
+        """Preempt a running request: release its pages and references.
+
+        The offload analogue of the reference's wait_job cancellation path
+        (request aborted mid-transfer): pending write-through stores for
+        its blocks are harmless (content-addressed, idempotent) and are
+        left to complete; restores are synchronous so none are in flight.
+        Returns False for unknown/finished requests.
+        """
+        req = self.requests.get(request_id)
+        if req is None or req.done:
+            return False
+        req.done = True
+        self._finish(req)
+        return True
+
     def reset_cache(self) -> None:
         """Drop all KV state (e.g. after a weight update).
 

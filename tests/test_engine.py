@@ -185,6 +185,19 @@ class TestPageAccounting:
         assert engine.block_manager.num_free() == free_before
         assert not engine._running
 
+    def test_abort_request_releases_pages(self):
+        engine = make_engine()
+        free0 = engine.block_manager.num_free()
+        engine.add_request("r1", list(range(100, 112)), max_new_tokens=8)
+        assert engine.abort_request("r1")
+        assert not engine.abort_request("r1")  # already gone
+        assert not engine._running
+        # committed blocks stay cached (unreferenced); page accounting holds
+        cached = engine.block_manager.num_cached_blocks()
+        assert engine.block_manager.num_free() + cached == free0
+        # decode after abort is a no-op, not a crash
+        assert engine.step() == {}
+
     def test_finished_requests_are_dropped(self):
         engine = make_engine()
         engine.generate("r1", list(range(30, 42)), max_new_tokens=2)
