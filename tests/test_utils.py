@@ -80,3 +80,15 @@ class TestHumanize:
     def test_bad_unit(self):
         with pytest.raises(ValueError):
             parse_bytes("5 parsecs")
+
+
+class TestGrpcTarget:
+    def test_normalization(self):
+        from llmd_kv_cache_tpu.utils.net import grpc_target
+
+        assert grpc_target("/tmp/sock") == "unix:/tmp/sock"
+        assert grpc_target("relative.sock") == "unix:relative.sock"
+        assert grpc_target("unix:/tmp/x") == "unix:/tmp/x"
+        assert grpc_target("127.0.0.1:50051") == "127.0.0.1:50051"
+        assert grpc_target("dns:///svc:443") == "dns:///svc:443"
+        assert grpc_target("/path/with:colon") == "unix:/path/with:colon"
