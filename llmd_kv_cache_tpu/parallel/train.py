@@ -147,8 +147,53 @@ def train_step(
     return params, opt_state, loss
 
 
+@partial(jax.jit, static_argnames=("cfg", "opt", "mesh_axes", "attention_fn",
+                                   "accum_steps"))
+def train_step_accum(
+    params: Params,
+    opt_state: Any,
+    cfg: LlamaConfig,
+    opt: optax.GradientTransformation,
+    tokens: jax.Array,  # [accum_steps * micro_batch, seq]
+    mesh_axes: tuple[Optional[str], Optional[str]] = (None, None),
+    attention_fn=None,
+    accum_steps: int = 1,
+):
+    """Training step with microbatch gradient accumulation.
+
+    The global batch splits into ``accum_steps`` equal microbatches scanned
+    sequentially (bounding activation memory); gradients average before a
+    single optimizer update — numerically the full-batch step.
+    """
+    batch = tokens.shape[0]
+    micro = batch // accum_steps
+    micro_tokens = tokens[: micro * accum_steps].reshape(
+        accum_steps, micro, tokens.shape[1]
+    )
+
+    def micro_step(carry, mb):
+        loss_sum, grad_sum = carry
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, cfg, mb, mesh_axes, attention_fn
+        )
+        grad_sum = jax.tree.map(jnp.add, grad_sum, grads)
+        return (loss_sum + loss, grad_sum), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    (loss_sum, grad_sum), _ = jax.lax.scan(
+        micro_step, (jnp.zeros((), jnp.float32), zero_grads), micro_tokens
+    )
+    grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
+    loss = loss_sum / accum_steps
+
+    updates, opt_state = opt.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
 def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt,
-                            use_ring_attention: bool = False):
+                            use_ring_attention: bool = False,
+                            accum_steps: int = 1):
     """Prepare a mesh-sharded training setup.
 
     Returns ``(step_fn, sharded_params, opt_state, data_sharding)``. The
@@ -178,6 +223,9 @@ def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt,
         )
 
     def step(p, s, tokens):
+        if accum_steps > 1:
+            return train_step_accum(p, s, cfg, opt, tokens, (dp, sp),
+                                    attention_fn, accum_steps)
         return train_step(p, s, cfg, opt, tokens, (dp, sp), attention_fn)
 
     return jax.jit(step), sharded_params, opt_state, data_sharding

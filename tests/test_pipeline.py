@@ -89,3 +89,51 @@ class TestPPTrainStep:
             params3 = init_params(jax.random.PRNGKey(0), cfg3)
             with pytest.raises(ValueError, match="divide"):
                 make_pp_train_step(mesh3, cfg3, params3, opt)
+
+
+class TestGradAccumulation:
+    def test_accum_matches_full_batch(self):
+        """accum_steps=2 reproduces the full-batch step (same data)."""
+        from llmd_kv_cache_tpu.parallel.train import (
+            make_train_state, train_step, train_step_accum,
+        )
+
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, opt_state = make_train_state(params)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (4, 8)), jnp.int32
+        )
+        p1, _, loss1 = train_step(params, opt_state, cfg, opt, tokens)
+        p2, _, loss2 = train_step_accum(params, opt_state, cfg, opt, tokens,
+                                        accum_steps=2)
+        assert abs(float(loss1) - float(loss2)) < 5e-2
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=2e-2,
+            )
+
+    def test_sharded_accum_step(self):
+        from llmd_kv_cache_tpu.parallel.train import (
+            make_sharded_train_step, make_train_state,
+        )
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, _ = make_train_state(params)
+        with mesh:
+            step, sp, st, ds = make_sharded_train_step(
+                mesh, cfg, params, opt, accum_steps=2
+            )
+            tokens = jax.device_put(
+                jnp.asarray(
+                    np.random.default_rng(0).integers(0, 64, (8, 8)), jnp.int32
+                ),
+                ds,
+            )
+            _p, _s, loss = step(sp, st, tokens)
+            assert np.isfinite(float(loss))
