@@ -122,7 +122,6 @@ def make_train_state(
     return opt, opt.init(params)
 
 
-@partial(jax.jit, static_argnames=("cfg", "opt", "mesh_axes", "attention_fn"))
 def train_step(
     params: Params,
     opt_state: Any,
@@ -138,13 +137,12 @@ def train_step(
     sharding annotations (XLA emits the reduce-scatter/all-reduce over
     ICI); with ``attention_fn`` = ring attention, the sequence axis scales
     by neighbor exchanges instead of gathers.
+
+    (The ``accum_steps=1`` case of ``train_step_accum`` — one grad/update
+    implementation, no drift.)
     """
-    loss, grads = jax.value_and_grad(loss_fn)(
-        params, cfg, tokens, mesh_axes, attention_fn
-    )
-    updates, opt_state = opt.update(grads, opt_state, params)
-    params = optax.apply_updates(params, updates)
-    return params, opt_state, loss
+    return train_step_accum(params, opt_state, cfg, opt, tokens, mesh_axes,
+                            attention_fn, 1)
 
 
 @partial(jax.jit, static_argnames=("cfg", "opt", "mesh_axes", "attention_fn",
@@ -162,29 +160,49 @@ def train_step_accum(
     """Training step with microbatch gradient accumulation.
 
     The global batch splits into ``accum_steps`` equal microbatches scanned
-    sequentially (bounding activation memory); gradients average before a
-    single optimizer update — numerically the full-batch step.
+    sequentially (bounding activation memory); gradients accumulate in
+    float32 and average before a single optimizer update — numerically the
+    full-batch step. Microbatches are strided (row ``m`` of microbatch j is
+    global row ``m*accum_steps + j``) so each microbatch stays balanced
+    across a dp-sharded batch dimension instead of clustering on a shard
+    subset.
     """
-    batch = tokens.shape[0]
-    micro = batch // accum_steps
-    micro_tokens = tokens[: micro * accum_steps].reshape(
-        accum_steps, micro, tokens.shape[1]
-    )
-
-    def micro_step(carry, mb):
-        loss_sum, grad_sum = carry
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, cfg, mb, mesh_axes, attention_fn
+    batch, seq = tokens.shape
+    if accum_steps < 1 or batch % accum_steps != 0:
+        raise ValueError(
+            f"batch size ({batch}) must divide by accum_steps ({accum_steps})"
         )
-        grad_sum = jax.tree.map(jnp.add, grad_sum, grads)
-        return (loss_sum + loss, grad_sum), None
+    micro = batch // accum_steps
 
-    zero_grads = jax.tree.map(jnp.zeros_like, params)
-    (loss_sum, grad_sum), _ = jax.lax.scan(
-        micro_step, (jnp.zeros((), jnp.float32), zero_grads), micro_tokens
-    )
-    grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
-    loss = loss_sum / accum_steps
+    if accum_steps == 1:
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, cfg, tokens, mesh_axes, attention_fn
+        )
+    else:
+        micro_tokens = tokens.reshape(micro, accum_steps, seq).transpose(1, 0, 2)
+
+        def micro_step(carry, mb):
+            loss_sum, grad_sum = carry
+            mloss, mgrads = jax.value_and_grad(loss_fn)(
+                params, cfg, mb, mesh_axes, attention_fn
+            )
+            grad_sum = jax.tree.map(
+                lambda acc, g: acc + g.astype(jnp.float32), grad_sum, mgrads
+            )
+            return (loss_sum + mloss, grad_sum), None
+
+        # f32 accumulators: bf16 sums round away microbatch contributions
+        # exactly when accumulation is most needed.
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            micro_step, (jnp.zeros((), jnp.float32), zero_grads), micro_tokens
+        )
+        grads = jax.tree.map(
+            lambda g, p: (g / accum_steps).astype(p.dtype), grad_sum, params
+        )
+        loss = loss_sum / accum_steps
 
     updates, opt_state = opt.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
@@ -223,9 +241,7 @@ def make_sharded_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt,
         )
 
     def step(p, s, tokens):
-        if accum_steps > 1:
-            return train_step_accum(p, s, cfg, opt, tokens, (dp, sp),
-                                    attention_fn, accum_steps)
-        return train_step(p, s, cfg, opt, tokens, (dp, sp), attention_fn)
+        return train_step_accum(p, s, cfg, opt, tokens, (dp, sp),
+                                attention_fn, accum_steps)
 
     return jax.jit(step), sharded_params, opt_state, data_sharding

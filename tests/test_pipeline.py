@@ -137,3 +137,19 @@ class TestGradAccumulation:
             )
             _p, _s, loss = step(sp, st, tokens)
             assert np.isfinite(float(loss))
+
+    def test_accum_validation(self):
+        from llmd_kv_cache_tpu.parallel.train import (
+            make_train_state, train_step_accum,
+        )
+
+        cfg = small_cfg()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        opt, opt_state = make_train_state(params)
+        tokens = jnp.zeros((4, 8), jnp.int32)
+        with pytest.raises(ValueError, match="divide"):
+            train_step_accum(params, opt_state, cfg, opt, tokens,
+                             accum_steps=8)
+        with pytest.raises(ValueError, match="divide"):
+            train_step_accum(params, opt_state, cfg, opt, tokens,
+                             accum_steps=3)
