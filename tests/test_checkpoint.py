@@ -52,3 +52,23 @@ def test_restarted_engine_resumes_identically(tmp_path):
     assert req.block_hashes == engine.processor.tokens_to_kv_block_keys(
         0, prompt, "tiny"
     )
+
+
+def test_moe_and_swa_config_roundtrip(tmp_path):
+    """Checkpoints preserve expert tensors and tuple config fields."""
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=8, intermediate_size=64, page_size=4,
+        num_experts=4, num_experts_per_token=2,
+        sliding_window=8, swa_layers=(0, 1),
+    )
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    save_engine_checkpoint(str(tmp_path / "moe"), params, cfg, "moe-model")
+    params2, cfg2, name, _ = load_engine_checkpoint(str(tmp_path / "moe"))
+    assert cfg2 == cfg
+    assert cfg2.swa_layers == (0, 1)
+    assert params2["layers"][0]["router"].shape == (32, 4)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][1]["w_down"], np.float32),
+        np.asarray(params2["layers"][1]["w_down"], np.float32),
+    )
