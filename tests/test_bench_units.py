@@ -1,0 +1,51 @@
+"""Cheap regression cover for bench.py helpers (the slow arms run under
+the driver; these keep the harness itself from rotting)."""
+
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import bench
+
+
+class TestWorkload:
+    def test_deterministic(self):
+        import numpy as np
+
+        a = bench.build_workload(np.random.default_rng(42), n_requests=8)
+        b = bench.build_workload(np.random.default_rng(42), n_requests=8)
+        assert a == b
+
+    def test_shared_prefixes(self):
+        import numpy as np
+
+        wl = bench.build_workload(np.random.default_rng(0), n_requests=32,
+                                  n_prefixes=4, prefix_len=16, suffix_len=4)
+        prefixes = {tuple(p[:16]) for p in wl}
+        assert len(prefixes) <= 4  # requests reuse the prefix pool
+        assert all(len(p) == 20 for p in wl)
+
+
+class TestBenchModes:
+    def test_index_bench_emits_valid_json(self):
+        result = bench.bench_index_add()
+        assert result["unit"] == "ns/op"
+        assert result["value"] > 0
+        assert result["vs_baseline"] > 0
+        json.dumps(result)
+
+    def test_python_fallback_mode(self):
+        result = bench.bench_index_add(native=False)
+        assert "python" in result["metric"]
+
+    def test_cli_index_mode(self):
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--index"],
+            capture_output=True, text=True, timeout=300, cwd="/root/repo",
+            env={"PATH": "/usr/bin:/bin:/opt/venv/bin"},
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        parsed = json.loads(line)
+        assert set(parsed) == {"metric", "value", "unit", "vs_baseline"}
