@@ -18,3 +18,27 @@ def test_pallas_decode_matches_xla_path():
         )
         outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=4)
     assert outs[False] == outs[True]
+
+
+def test_pallas_decode_matches_xla_with_sliding_window():
+    """Backend equivalence holds for SWA models too (window masking + page
+    skipping in the kernel)."""
+    tiny = LlamaConfig.tiny()
+    swa = LlamaConfig(
+        vocab_size=tiny.vocab_size, hidden_size=tiny.hidden_size,
+        num_layers=tiny.num_layers, num_heads=tiny.num_heads,
+        num_kv_heads=tiny.num_kv_heads, head_dim=tiny.head_dim,
+        intermediate_size=tiny.intermediate_size, page_size=tiny.page_size,
+        sliding_window=8, swa_layers=tuple(range(tiny.num_layers)),
+    )
+    prompt = list(range(60, 84))  # 24-token context >> window 8
+    outs = {}
+    for use_pallas in (False, True):
+        engine = MiniEngine(
+            EngineConfig(model=swa, num_pages=64, max_pages_per_seq=16,
+                         model_name="swa", pod_identifier="p",
+                         use_pallas_decode=use_pallas),
+            seed=0,
+        )
+        outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=5)
+    assert outs[False] == outs[True]
