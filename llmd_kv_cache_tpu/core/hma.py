@@ -56,3 +56,8 @@ class GroupCatalog:
     def pods(self) -> list[str]:
         with self._lock:
             return list(self._entries.keys())
+
+    def groups(self, pod_id: str) -> dict[int, GroupMetadata]:
+        """All known groups for a pod (empty dict if none learned)."""
+        with self._lock:
+            return dict(self._entries.get(pod_id, {}))

@@ -95,11 +95,27 @@ class Indexer:
         self.kv_block_index: Index = (
             index if index is not None else create_index(self.config.index_config)
         )
-        self.scorer: LongestPrefixScorer = create_scorer(self.config.scorer_config)
+        self.scorer: LongestPrefixScorer = create_scorer(
+            self.config.scorer_config,
+            block_size_tokens=self.token_processor.block_size,
+        )
         self._tracer = tracer()
         # Fused native lookup+score fast path (NativeIndex only): the whole
-        # scheduler hot loop stays in C++.
-        self._native_score = getattr(self.kv_block_index, "score", None)
+        # scheduler hot loop stays in C++. Only the LongestPrefix strategy
+        # has a native twin; other strategies take the Python path.
+        from .scorer import LONGEST_PREFIX_MATCH
+
+        self._native_score = (
+            getattr(self.kv_block_index, "score", None)
+            if self.scorer.strategy == LONGEST_PREFIX_MATCH
+            else None
+        )
+
+    def attach_group_catalog(self, group_catalog) -> None:
+        """Wire the event pool's GroupCatalog into hybrid-aware scoring
+        (no-op for the default strategy)."""
+        if hasattr(self.scorer, "group_catalog"):
+            self.scorer.group_catalog = group_catalog
 
     def compute_block_keys(
         self,

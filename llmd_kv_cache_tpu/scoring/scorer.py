@@ -22,6 +22,7 @@ from ..core.keys import (
 )
 
 LONGEST_PREFIX_MATCH = "LongestPrefix"
+HYBRID_AWARE = "HybridAware"
 
 
 @dataclass
@@ -121,8 +122,124 @@ class LongestPrefixScorer:
         return pod_scores
 
 
-def create_scorer(config: Optional[KVBlockScorerConfig] = None) -> LongestPrefixScorer:
+class HybridAwareScorer(LongestPrefixScorer):
+    """Sliding-window-aware scoring (the reference's documented WIP,
+    ``docs/architecture.md`` "Hybrid attention").
+
+    For a full-attention pod, a cached prefix of L blocks saves L blocks of
+    prefill — the longest-prefix rule. For a pod whose cache group is
+    ``sliding_window`` with window W, resuming at length L only requires
+    the blocks covering the last W tokens of L: **early blocks falling out
+    of the window don't matter**, so the usable prefix is the deepest L
+    whose trailing window of blocks is fully present, and the saving is
+    capped at the window itself.
+
+    Per pod: score = tier-weighted count of present blocks inside the best
+    usable trailing window (full-attention pods fall back to the exact
+    longest-prefix accumulation). Requires the pool's ``GroupCatalog`` to
+    know the pod's group spec; unknown pods score as full attention.
+    """
+
+    def __init__(self, medium_weights=None, group_catalog=None,
+                 block_size_tokens: int = 16):
+        super().__init__(medium_weights)
+        self.group_catalog = group_catalog
+        self.block_size_tokens = block_size_tokens
+
+    def _window_blocks(self, pod: str, group_idx) -> Optional[int]:
+        """A group's sliding window in blocks; None = full attention."""
+        if group_idx is None or self.group_catalog is None:
+            return None
+        meta = self.group_catalog.get(pod, group_idx)
+        if meta is not None and meta.sliding_window_size:
+            return max(1, -(-meta.sliding_window_size // self.block_size_tokens))
+        return None
+
+    @staticmethod
+    def _prefix_value(blocks: dict[int, float]) -> float:
+        """Longest-consecutive-from-0 weighted value."""
+        total = 0.0
+        i = 0
+        while i in blocks:
+            total += blocks[i]
+            i += 1
+        return total
+
+    def _window_value(self, blocks: dict[int, float], n_keys: int,
+                      wb: int) -> float:
+        """Deepest resume length whose trailing min(wb, L) blocks are all
+        present; value = their weights (capped at the window)."""
+        for end in range(n_keys, 0, -1):
+            start = max(0, end - wb)
+            if all(i in blocks for i in range(start, end)):
+                return sum(blocks[i] for i in range(start, end))
+        return 0.0
+
+    def score(self, keys, key_to_pods):
+        if not keys:
+            return {}
+        if self.group_catalog is None:
+            return super().score(keys, key_to_pods)
+
+        # One pass: per-(pod, group) presence maps for tagged entries, plus
+        # a per-pod map for untagged entries (tokenless tier updates carry
+        # no group; they assert residency for every group).
+        tagged: dict[tuple[str, int], dict[int, float]] = {}
+        untagged: dict[str, dict[int, float]] = {}
+        for i, key in enumerate(keys):
+            for e in key_to_pods.get(key, []):
+                w = self.medium_weights.get(e.device_tier, 1.0)
+                slot = (
+                    tagged.setdefault((e.pod_identifier, e.group_idx), {})
+                    if e.has_group
+                    else untagged.setdefault(e.pod_identifier, {})
+                )
+                if w > slot.get(i, 0.0):
+                    slot[i] = w
+
+        # A resume needs EVERY group of the pod to supply its share: score
+        # = min across all cataloged groups (full-attention: longest
+        # prefix; SWA: trailing window) — conservative for hybrid pods. A
+        # cataloged group with no residency zeroes the pod. Pods with no
+        # cataloged groups score by the plain longest-prefix rule.
+        pods = {pod for pod, _g in tagged} | set(untagged)
+        scores: dict[str, float] = {}
+        for pod in pods:
+            extra = untagged.get(pod, {})
+            cataloged = (
+                self.group_catalog.groups(pod) if self.group_catalog else {}
+            )
+            if not cataloged:
+                scores[pod] = self._prefix_value(extra) if extra else 0.0
+                continue
+            value = None
+            for g in cataloged:
+                blocks = dict(extra)
+                for i, w in tagged.get((pod, g), {}).items():
+                    if w > blocks.get(i, 0.0):
+                        blocks[i] = w
+                wb = self._window_blocks(pod, g)
+                if wb is None:
+                    gv = self._prefix_value(blocks)
+                else:
+                    gv = self._window_value(blocks, len(keys), wb)
+                value = gv if value is None else min(value, gv)
+            scores[pod] = value or 0.0
+        return {p: v for p, v in scores.items() if v > 0.0}
+
+    @property
+    def strategy(self) -> str:
+        return HYBRID_AWARE
+
+
+def create_scorer(config: Optional[KVBlockScorerConfig] = None,
+                  block_size_tokens: int = 16):
     config = config or KVBlockScorerConfig()
-    if config.scoring_strategy != LONGEST_PREFIX_MATCH:
-        raise ValueError(f"unsupported scoring strategy: {config.scoring_strategy}")
-    return LongestPrefixScorer({b.name: b.weight for b in config.backend_configs})
+    weights = {b.name: b.weight for b in config.backend_configs}
+    if config.scoring_strategy == LONGEST_PREFIX_MATCH:
+        return LongestPrefixScorer(weights)
+    if config.scoring_strategy == HYBRID_AWARE:
+        # The GroupCatalog is wired post-construction by the host
+        # (Indexer.attach_group_catalog), since it lives on the event pool.
+        return HybridAwareScorer(weights, None, block_size_tokens)
+    raise ValueError(f"unsupported scoring strategy: {config.scoring_strategy}")
