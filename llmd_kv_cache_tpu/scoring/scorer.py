@@ -156,6 +156,13 @@ class HybridAwareScorer(LongestPrefixScorer):
         return None
 
     @staticmethod
+    def _merge_max(dst: dict[int, float], src: dict[int, float]) -> None:
+        """Fold ``src`` into ``dst`` keeping the per-index max weight."""
+        for i, w in src.items():
+            if w > dst.get(i, 0.0):
+                dst[i] = w
+
+    @staticmethod
     def _prefix_value(blocks: dict[int, float]) -> float:
         """Longest-consecutive-from-0 weighted value."""
         total = 0.0
@@ -168,12 +175,25 @@ class HybridAwareScorer(LongestPrefixScorer):
     def _window_value(self, blocks: dict[int, float], n_keys: int,
                       wb: int) -> float:
         """Deepest resume length whose trailing min(wb, L) blocks are all
-        present; value = their weights (capped at the window)."""
-        for end in range(n_keys, 0, -1):
-            start = max(0, end - wb)
-            if all(i in blocks for i in range(start, end)):
-                return sum(blocks[i] for i in range(start, end))
-        return 0.0
+        present; value = their weights (capped at the window).
+
+        Single forward pass (O(n_keys)): track the consecutive-present run
+        ending at each position plus a weight prefix sum; end L is usable
+        iff the run covers min(wb, L) blocks.
+        """
+        run = 0
+        best_end = 0
+        prefix = [0.0] * (n_keys + 1)
+        for i in range(n_keys):
+            w = blocks.get(i)
+            prefix[i + 1] = prefix[i] + (w or 0.0)
+            run = run + 1 if w is not None else 0
+            if run >= min(wb, i + 1):
+                best_end = i + 1
+        if best_end == 0:
+            return 0.0
+        start = max(0, best_end - wb)
+        return prefix[best_end] - prefix[start]
 
     def score(self, keys, key_to_pods):
         if not keys:
@@ -181,16 +201,16 @@ class HybridAwareScorer(LongestPrefixScorer):
         if self.group_catalog is None:
             return super().score(keys, key_to_pods)
 
-        # One pass: per-(pod, group) presence maps for tagged entries, plus
+        # One pass: per-pod {group: presence map} for tagged entries, plus
         # a per-pod map for untagged entries (tokenless tier updates carry
         # no group; they assert residency for every group).
-        tagged: dict[tuple[str, int], dict[int, float]] = {}
+        tagged: dict[str, dict[int, dict[int, float]]] = {}
         untagged: dict[str, dict[int, float]] = {}
         for i, key in enumerate(keys):
             for e in key_to_pods.get(key, []):
                 w = self.medium_weights.get(e.device_tier, 1.0)
                 slot = (
-                    tagged.setdefault((e.pod_identifier, e.group_idx), {})
+                    tagged.setdefault(e.pod_identifier, {}).setdefault(e.group_idx, {})
                     if e.has_group
                     else untagged.setdefault(e.pod_identifier, {})
                 )
@@ -201,23 +221,27 @@ class HybridAwareScorer(LongestPrefixScorer):
         # = min across all cataloged groups (full-attention: longest
         # prefix; SWA: trailing window) — conservative for hybrid pods. A
         # cataloged group with no residency zeroes the pod. Pods with no
-        # cataloged groups score by the plain longest-prefix rule.
-        pods = {pod for pod, _g in tagged} | set(untagged)
+        # cataloged groups score by the plain longest-prefix rule; tagged
+        # entries whose group the catalog doesn't know (e.g. a persistent
+        # index surviving an indexer restart, before a new BlockStored
+        # re-teaches the spec) still assert residency and fold into that
+        # full-attention fallback instead of being dropped.
+        pods = set(tagged) | set(untagged)
         scores: dict[str, float] = {}
         for pod in pods:
-            extra = untagged.get(pod, {})
-            cataloged = (
-                self.group_catalog.groups(pod) if self.group_catalog else {}
-            )
+            pod_groups = tagged.get(pod, {})
+            cataloged = self.group_catalog.groups(pod)
+            extra = dict(untagged.get(pod, {}))
+            for g, presence in pod_groups.items():
+                if g not in cataloged:
+                    self._merge_max(extra, presence)
             if not cataloged:
                 scores[pod] = self._prefix_value(extra) if extra else 0.0
                 continue
             value = None
             for g in cataloged:
                 blocks = dict(extra)
-                for i, w in tagged.get((pod, g), {}).items():
-                    if w > blocks.get(i, 0.0):
-                        blocks[i] = w
+                self._merge_max(blocks, pod_groups.get(g, {}))
                 wb = self._window_blocks(pod, g)
                 if wb is None:
                     gv = self._prefix_value(blocks)

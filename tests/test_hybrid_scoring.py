@@ -165,3 +165,43 @@ class TestHybridEndToEnd:
             3: [swa_pod("h", group=1)], 4: [swa_pod("h", group=1)],
         }
         assert s.score([1, 2, 3, 4], key_to_pods) == {}
+
+    def test_uncataloged_pod_keeps_tagged_residency(self):
+        """A persistent index can hold group-tagged entries for a pod the
+        (restarted) indexer hasn't re-learned yet: they must score by the
+        full-attention rule, not drop to zero."""
+        catalog = GroupCatalog()  # empty: nothing learned for "s"
+        s = make_scorer(catalog)
+        key_to_pods = {1: [swa_pod("s")], 2: [swa_pod("s")]}
+        assert s.score([1, 2, 3], key_to_pods) == {"s": 2.0}
+
+    def test_orphan_group_tag_merges_into_fallback(self):
+        """Tagged entries whose group is absent from the pod's catalog
+        still assert residency (merged with untagged/full groups)."""
+        catalog = GroupCatalog()
+        catalog.learn("h", 0, GroupMetadata("full_attention", BLOCK, None))
+        s = make_scorer(catalog)
+        # group 0 holds blocks 0,1; an orphan group-7 tag holds block 2.
+        key_to_pods = {
+            1: [swa_pod("h", group=0)],
+            2: [swa_pod("h", group=0)],
+            3: [swa_pod("h", group=7)],
+        }
+        assert s.score([1, 2, 3], key_to_pods) == {"h": 3.0}
+
+    def test_window_value_linear_scan_equivalence(self):
+        """The O(n) run-length _window_value matches a brute-force scan."""
+        import itertools
+        s = make_scorer(GroupCatalog())
+        for n in (1, 3, 5):
+            for wb in (1, 2, 4):
+                for mask in itertools.product([0, 1], repeat=n):
+                    blocks = {i: 1.0 + 0.1 * i for i, m in enumerate(mask) if m}
+                    brute = 0.0
+                    for end in range(n, 0, -1):
+                        start = max(0, end - wb)
+                        if all(i in blocks for i in range(start, end)):
+                            brute = sum(blocks[i] for i in range(start, end))
+                            break
+                    assert s._window_value(blocks, n, wb) == pytest.approx(brute), (
+                        n, wb, mask)
