@@ -35,7 +35,31 @@ def main() -> None:
         help="JSON pod map file; enables per-pod subscribers instead of the "
              "centralized bound endpoint",
     )
+    parser.add_argument(
+        "--tokenizer-socket", default=None,
+        help="UDS tokenizer sidecar socket for the protobuf prompt-scoring "
+             "surface; without it prompts are tokenized in-process "
+             "(HF registry)",
+    )
     args = parser.parse_args()
+
+    # Prompt tokenization for /indexer.v1.IndexerService/GetPodScores:
+    # through the sidecar when configured (the reference's UDS path),
+    # else in-process via the tokenizer registry.
+    if args.tokenizer_socket:
+        from llmd_kv_cache_tpu.services.tokenizer.client import UdsTokenizerClient
+
+        uds_client = UdsTokenizerClient(args.tokenizer_socket)
+
+        def tokenize(prompt: str, model_name: str) -> list[int]:
+            return uds_client.encode(model_name, prompt).token_ids
+    else:
+        from llmd_kv_cache_tpu.services.tokenizer.backends import TokenizerRegistry
+
+        registry = TokenizerRegistry()
+
+        def tokenize(prompt: str, model_name: str) -> list[int]:
+            return registry.get(model_name).encode(prompt, add_special_tokens=True)
 
     discover = args.discover_pods_file is not None
     service = IndexerService(
@@ -49,6 +73,7 @@ def main() -> None:
             concurrency=args.concurrency,
             engine_type=args.engine_type,
         ),
+        tokenize=tokenize,
     )
     service.start()
 

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Regenerate committed protobuf stubs from api/*.proto.
+#
+# The .proto files are the reference's wire contracts carried verbatim
+# (interop requires byte-identical descriptors); the generated *_pb2.py
+# modules are committed so protoc is not a runtime dependency.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+protoc -I api/indexerpb \
+  --python_out=llmd_kv_cache_tpu/services/indexerpb \
+  api/indexerpb/indexer.proto
+
+protoc -I api/tokenizerpb \
+  --python_out=llmd_kv_cache_tpu/services/tokenizerpb \
+  api/tokenizerpb/tokenizer.proto
+
+echo "generated: llmd_kv_cache_tpu/services/{indexerpb/indexer_pb2.py,tokenizerpb/tokenizer_pb2.py}"

@@ -6,18 +6,28 @@ the assembled indexer deployment: one process that runs the event pool,
 ZMQ subscribers, and serves scoring RPCs to schedulers that aren't
 in-process (the embedded-library path remains ``scoring.Indexer``).
 
-Wire: msgpack-over-gRPC generic handlers (same convention as the tokenizer
-sidecar).
+Two wire surfaces on one server:
+
+- ``indexer.v1.IndexerService/GetPodScores`` — the reference's protobuf
+  contract, byte-compatible with llm-d's Go EPP (prompt in, tokenized
+  server-side; ``api/indexerpb/indexer.proto:24-43``).
+- ``kvtpu.indexer.IndexerService/GetPodScores`` — the native
+  msgpack-over-gRPC convention (token IDs in, no tokenizer needed; same
+  convention as the tokenizer sidecar).
 """
 
 from __future__ import annotations
 
 from concurrent import futures
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional, Sequence
 
 import grpc
 import msgpack
+
+# The protobuf stubs (and their google.protobuf dependency) are imported
+# lazily by the pb surface only, so msgpack-only consumers keep the
+# grpc+msgpack dependency set.
 
 from ..events.pool import Pool, PoolConfig
 from ..events.subscriber_manager import SubscriberManager
@@ -29,6 +39,7 @@ from ..utils.net import grpc_target
 logger = get_logger("services.indexer")
 
 SERVICE_NAME = "kvtpu.indexer.IndexerService"
+PROTO_SERVICE_NAME = "indexer.v1.IndexerService"
 
 
 @dataclass
@@ -79,8 +90,13 @@ class IndexerService:
         self,
         indexer_config: Optional[IndexerConfig] = None,
         pool_config: Optional[PoolConfig] = None,
+        tokenize: Optional[Callable[[str, str], Sequence[int]]] = None,
     ):
+        """``tokenize(prompt, model_name) -> token_ids`` backs the protobuf
+        prompt-scoring surface (the reference tokenizes via its UDS
+        tokenizer pool; wire ``TokenizationPool.tokenize`` here)."""
         self.indexer = Indexer(indexer_config)
+        self.tokenize = tokenize
         self.pool_config = pool_config or PoolConfig()
         self.pool = Pool(
             self.pool_config, self.indexer.kv_block_index, self.indexer.token_processor
@@ -123,13 +139,45 @@ class IndexerService:
             logger.exception("GetPodScores failed")
             return ScoreResponse(error=str(e))
 
+    def get_pod_scores_pb(self, req, ctx):
+        """Protobuf surface: prompt in, tokenize server-side, score.
+
+        Mirrors the reference's service wrapper
+        (``examples/kv_cache_index_service/server/server.go:42-65``): errors
+        surface as gRPC status codes — the proto response has no error
+        field. Scores are emitted highest-first for deterministic wires.
+        """
+        from .indexerpb import indexer_pb2
+        if self.tokenize is None:
+            ctx.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "prompt scoring needs a tokenizer; configure "
+                "IndexerService(tokenize=...) or use the token-ID surface "
+                f"({SERVICE_NAME})",
+            )
+        try:
+            tokens = list(self.tokenize(req.prompt, req.model_name))
+            scores = self.indexer.score_tokens(
+                tokens,
+                req.model_name,
+                set(req.pod_identifiers) if req.pod_identifiers else None,
+            )
+        except Exception as e:
+            logger.exception("GetPodScores (pb) failed")
+            ctx.abort(grpc.StatusCode.INTERNAL, str(e))
+        resp = indexer_pb2.GetPodScoresResponse()
+        for pod, score in sorted(scores.items(), key=lambda kv: (-kv[1], kv[0])):
+            resp.scores.add(pod=pod, score=score)
+        return resp
+
 
 def serve(
     address: str,
     service: IndexerService,
     max_workers: int = 16,
 ) -> grpc.Server:
-    """Serve GetPodScores on ``address`` (host:port or unix:path)."""
+    """Serve GetPodScores on ``address`` (host:port or unix:path), on both
+    the msgpack (token IDs) and protobuf (prompt) wires."""
     handler = grpc.method_handlers_generic_handler(
         SERVICE_NAME,
         {
@@ -140,8 +188,20 @@ def serve(
             )
         },
     )
+    from .indexerpb import indexer_pb2
+
+    pb_handler = grpc.method_handlers_generic_handler(
+        PROTO_SERVICE_NAME,
+        {
+            "GetPodScores": grpc.unary_unary_rpc_method_handler(
+                service.get_pod_scores_pb,
+                request_deserializer=indexer_pb2.GetPodScoresRequest.FromString,
+                response_serializer=indexer_pb2.GetPodScoresResponse.SerializeToString,
+            )
+        },
+    )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((handler,))
+    server.add_generic_rpc_handlers((handler, pb_handler))
     server.add_insecure_port(grpc_target(address))
     server.start()
     logger.info("indexer service on %s", address)
@@ -177,6 +237,47 @@ class IndexerServiceClient:
         if resp.error:
             raise RuntimeError(f"GetPodScores failed: {resp.error}")
         return resp.scores
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class IndexerPbClient:
+    """Client for the reference protobuf wire (what a Go EPP speaks).
+
+    Exercises the exact method path ``/indexer.v1.IndexerService/
+    GetPodScores`` with protobuf-serialized messages, so a round trip here
+    proves wire compatibility with clients generated from
+    ``api/indexerpb/indexer.proto``.
+    """
+
+    def __init__(self, address: str, timeout_s: float = 5.0):
+        from .indexerpb import indexer_pb2
+
+        self._pb = indexer_pb2
+        self._channel = grpc.insecure_channel(grpc_target(address))
+        self._timeout = timeout_s
+        self._get_pod_scores = self._channel.unary_unary(
+            f"/{PROTO_SERVICE_NAME}/GetPodScores",
+            request_serializer=indexer_pb2.GetPodScoresRequest.SerializeToString,
+            response_deserializer=indexer_pb2.GetPodScoresResponse.FromString,
+        )
+
+    def get_pod_scores(
+        self,
+        prompt: str,
+        model_name: str,
+        pod_identifiers: Optional[list[str]] = None,
+    ) -> dict[str, float]:
+        resp = self._get_pod_scores(
+            self._pb.GetPodScoresRequest(
+                prompt=prompt,
+                model_name=model_name,
+                pod_identifiers=list(pod_identifiers or []),
+            ),
+            timeout=self._timeout,
+        )
+        return {s.pod: s.score for s in resp.scores}
 
     def close(self) -> None:
         self._channel.close()

@@ -1,0 +1,11 @@
+"""Protobuf wire surface for the indexer service.
+
+``indexer_pb2`` is generated (``hack/gen_protos.sh``) from
+``api/indexerpb/indexer.proto``, which is carried verbatim from the
+reference (``api/indexerpb/indexer.proto:24-43``) because the wire
+contract must be byte-compatible with llm-d's Go EPP client.
+"""
+
+from . import indexer_pb2
+
+__all__ = ["indexer_pb2"]

@@ -72,9 +72,19 @@ class SimpleTokenizer:
                 content = " ".join(
                     p.get("text", "") for p in content if isinstance(p, dict)
                 )
-            parts.append(f"<|{m['role']}|> {content}")
+            line = f"<|{m['role']}|> {content}"
+            if m.get("tool_calls"):
+                names = ",".join(
+                    str(tc.get("function", {}).get("name", tc.get("name", "?")))
+                    if isinstance(tc, dict) else str(tc)
+                    for tc in m["tool_calls"]
+                )
+                line += f" <|tool_calls|> {names}"
+            parts.append(line)
         if tools:
             parts.insert(0, f"<|tools|> {len(tools)}")
+        if kwargs.get("documents"):
+            parts.insert(0, f"<|documents|> {len(kwargs['documents'])}")
         if add_generation_prompt:
             parts.append("<|assistant|>")
         return "\n".join(parts)

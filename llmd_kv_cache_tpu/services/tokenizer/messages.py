@@ -105,6 +105,9 @@ class TokenizeResponse:
 class ChatMessage:
     role: str
     content: Any  # str or structured content parts (list of dicts)
+    # Assistant tool calls (list of dicts), passed through to the chat
+    # template when present.
+    tool_calls: Optional[list] = None
 
 
 @dataclass
@@ -121,7 +124,9 @@ class RenderChatRequest:
             {
                 "model_name": self.model_name,
                 "messages": [
-                    {"role": m.role, "content": m.content} for m in self.messages
+                    {"role": m.role, "content": m.content,
+                     "tool_calls": m.tool_calls}
+                    for m in self.messages
                 ],
                 "chat_template": self.chat_template,
                 "add_generation_prompt": self.add_generation_prompt,
@@ -136,7 +141,8 @@ class RenderChatRequest:
         return cls(
             model_name=d.get("model_name", ""),
             messages=[
-                ChatMessage(role=m.get("role", ""), content=m.get("content"))
+                ChatMessage(role=m.get("role", ""), content=m.get("content"),
+                            tool_calls=m.get("tool_calls"))
                 for m in d.get("messages", [])
             ],
             chat_template=d.get("chat_template"),

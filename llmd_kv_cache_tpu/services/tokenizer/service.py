@@ -87,7 +87,12 @@ class TokenizerService:
     def render_chat_completion(self, req: RenderChatRequest) -> RenderChatResponse:
         try:
             tok = self.registry.get(req.model_name)
-            messages = [{"role": m.role, "content": m.content} for m in req.messages]
+            messages = []
+            for m in req.messages:
+                d = {"role": m.role, "content": m.content}
+                if m.tool_calls:
+                    d["tool_calls"] = m.tool_calls
+                messages.append(d)
 
             # Multimodal parts are replaced by per-item UNIQUE sentinels
             # before template rendering. Uniqueness (uuid per item) makes
@@ -239,6 +244,8 @@ def serve_uds(
     path (``unix:`` is prepended) or a full gRPC address like
     ``127.0.0.1:0`` for TCP tests.
     """
+    from .pb_service import make_pb_handler
+
     service = service or TokenizerService()
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -247,7 +254,11 @@ def serve_uds(
             ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
         ],
     )
-    server.add_generic_rpc_handlers((_make_grpc_handler(service),))
+    # Two wires, one server: the native msgpack convention and the
+    # reference's protobuf contract (what llm-d's Go client speaks).
+    server.add_generic_rpc_handlers(
+        (_make_grpc_handler(service), make_pb_handler(service))
+    )
     address = grpc_target(socket_path)
     server.add_insecure_port(address)
     server.start()

@@ -1,0 +1,12 @@
+"""Protobuf wire surface for the tokenizer sidecar.
+
+``tokenizer_pb2`` is generated (``hack/gen_protos.sh``) from
+``api/tokenizerpb/tokenizer.proto``, carried verbatim from the reference
+(``api/tokenizerpb/tokenizer.proto:188-210``): the Go EPP's UDS
+tokenization client is generated from this exact file, so interop
+requires a byte-identical descriptor.
+"""
+
+from . import tokenizer_pb2
+
+__all__ = ["tokenizer_pb2"]

@@ -1,0 +1,174 @@
+"""Protobuf wire interop for the tokenizer sidecar.
+
+The Go EPP's ``uds_tokenizer.go`` client is generated from
+``api/tokenizerpb/tokenizer.proto``; these tests speak that exact wire
+(generated stubs over the verbatim proto) against ``serve_uds``.
+"""
+
+import pathlib
+
+import grpc
+import pytest
+
+from llmd_kv_cache_tpu.services.tokenizer import TokenizerService, serve_uds
+from llmd_kv_cache_tpu.services.tokenizer.backends import SimpleTokenizer
+from llmd_kv_cache_tpu.services.tokenizerpb import tokenizer_pb2 as pb
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+REFERENCE_PROTO = pathlib.Path("/root/reference/api/tokenizerpb/tokenizer.proto")
+
+
+@pytest.fixture(scope="module")
+def pb_stack(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("udspb") / "tok.sock")
+    server = serve_uds(sock)
+    channel = grpc.insecure_channel(f"unix:{sock}")
+
+    def rpc(method, req_cls, resp_cls):
+        return channel.unary_unary(
+            f"/tokenization.TokenizationService/{method}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+
+    yield rpc
+    channel.close()
+    server.stop(grace=None)
+
+
+@pytest.mark.skipif(not REFERENCE_PROTO.exists(),
+                    reason="reference checkout unavailable")
+def test_proto_file_verbatim():
+    ours = (REPO_ROOT / "api" / "tokenizerpb" / "tokenizer.proto").read_bytes()
+    assert ours == REFERENCE_PROTO.read_bytes()
+
+
+def test_descriptor_contract():
+    sd = pb.DESCRIPTOR.services_by_name["TokenizationService"]
+    assert sd.full_name == "tokenization.TokenizationService"
+    assert set(sd.methods_by_name) == {
+        "Tokenize", "RenderChatTemplate", "InitializeTokenizer",
+        "RenderChatCompletion", "RenderCompletion",
+    }
+
+
+def test_initialize_and_tokenize(pb_stack):
+    init = pb_stack("InitializeTokenizer",
+                    pb.InitializeTokenizerRequest, pb.InitializeTokenizerResponse)
+    resp = init(pb.InitializeTokenizerRequest(model_name="simple"), timeout=10)
+    assert resp.success
+
+    tok = pb_stack("Tokenize", pb.TokenizeRequest, pb.TokenizeResponse)
+    resp = tok(pb.TokenizeRequest(input="hello world", model_name="simple",
+                                  add_special_tokens=True), timeout=10)
+    assert resp.success
+    expected_ids, expected_offsets = SimpleTokenizer().encode_with_offsets(
+        "hello world", add_special_tokens=True)
+    assert list(resp.input_ids) == expected_ids
+    assert list(resp.offset_pairs) == [x for pair in expected_offsets for x in pair]
+
+
+def test_tokenize_bad_model_reports_error(pb_stack):
+    tok = pb_stack("Tokenize", pb.TokenizeRequest, pb.TokenizeResponse)
+    resp = tok(pb.TokenizeRequest(input="x", model_name="hf:/nope/nope"),
+               timeout=30)
+    assert not resp.success
+    assert resp.error_message
+
+
+def test_render_completion(pb_stack):
+    rc = pb_stack("RenderCompletion",
+                  pb.RenderCompletionRequest, pb.RenderCompletionResponse)
+    resp = rc(pb.RenderCompletionRequest(model_name="simple", prompt="a b c"),
+              timeout=10)
+    assert resp.success and resp.request_id
+    assert list(resp.token_ids) == SimpleTokenizer().encode("a b c")
+
+
+def test_render_chat_completion_text(pb_stack):
+    rcc = pb_stack("RenderChatCompletion",
+                   pb.RenderChatCompletionRequest, pb.RenderChatCompletionResponse)
+    resp = rcc(pb.RenderChatCompletionRequest(
+        model_name="simple",
+        messages=[pb.ChatMessage(role="user", content="hi there")],
+    ), timeout=10)
+    assert resp.success and resp.request_id
+    assert len(resp.token_ids) > 0
+    assert not resp.features.mm_hashes
+
+
+def test_render_chat_completion_multimodal(pb_stack):
+    rcc = pb_stack("RenderChatCompletion",
+                   pb.RenderChatCompletionRequest, pb.RenderChatCompletionResponse)
+    req = pb.RenderChatCompletionRequest(
+        model_name="simple",
+        messages=[pb.ChatMessage(
+            role="user",
+            content_parts=[
+                pb.ContentPart(type="text", text="look at"),
+                pb.ContentPart(type="image_url",
+                               image_url=pb.ImageUrl(url="data:image/png;base64,AAA")),
+            ],
+        )],
+    )
+    resp = rcc(req, timeout=10)
+    assert resp.success
+    assert "image" in resp.features.mm_hashes
+    assert len(resp.features.mm_hashes["image"].values) == 1
+    ranges = resp.features.mm_placeholders["image"].ranges
+    assert len(ranges) == 1 and ranges[0].length > 0
+    # content-addressed: same image again -> same hash
+    resp2 = rcc(req, timeout=10)
+    assert (resp2.features.mm_hashes["image"].values
+            == resp.features.mm_hashes["image"].values)
+
+
+def test_render_chat_template_tool_calls_and_documents(pb_stack):
+    """tool_calls_json and documents must reach the template, not vanish."""
+    rct = pb_stack("RenderChatTemplate",
+                   pb.ChatTemplateRequest, pb.ChatTemplateResponse)
+    req = pb.ChatTemplateRequest(
+        model_name="simple",
+        conversation_turns=[pb.ConversationTurn(messages=[
+            pb.ChatMessage(role="user", content="weather?"),
+            pb.ChatMessage(
+                role="assistant",
+                tool_calls_json='[{"function": {"name": "get_weather"}}]',
+            ),
+        ])],
+        documents=[pb.Document(document={
+            "title": pb.Value(string_value="doc1")})],
+    )
+    resp = rct(req, timeout=10)
+    assert resp.success, resp.error_message
+    assert "<|tool_calls|> get_weather" in resp.rendered_prompt
+    assert "<|documents|> 1" in resp.rendered_prompt
+
+
+def test_msgpack_wire_preserves_tool_calls():
+    """The native msgpack wire must carry ChatMessage.tool_calls."""
+    from llmd_kv_cache_tpu.services.tokenizer.messages import (
+        ChatMessage as IntMsg, RenderChatRequest,
+    )
+    req = RenderChatRequest(
+        model_name="simple",
+        messages=[IntMsg(role="assistant", content="",
+                         tool_calls=[{"function": {"name": "f"}}])],
+    )
+    back = RenderChatRequest.from_bytes(req.to_bytes())
+    assert back.messages[0].tool_calls == [{"function": {"name": "f"}}]
+
+
+def test_render_chat_template_deprecated(pb_stack):
+    rct = pb_stack("RenderChatTemplate",
+                   pb.ChatTemplateRequest, pb.ChatTemplateResponse)
+    resp = rct(pb.ChatTemplateRequest(
+        model_name="simple",
+        conversation_turns=[pb.ConversationTurn(
+            messages=[pb.ChatMessage(role="user", content="hi")]
+        )],
+        add_generation_prompt=True,
+    ), timeout=10)
+    assert resp.success
+    assert "<|user|> hi" in resp.rendered_prompt
+    assert resp.rendered_prompt.endswith("<|assistant|>")
