@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Offload data-plane throughput: D2H/H2D GB/s and file I/O GB/s.
+
+Measures the two legs of the offload path separately:
+
+1. device->host gather (TPUBlockCopier.gather_many_to_host) and
+   host->device scatter — the TPU-side analog of the reference's
+   TensorCopier D2H/H2D (tensor_copier.cu:222-249); reports whether the
+   pinned_host memory kind was active.
+2. kvio file writes/reads (buffered vs O_DIRECT staged), the FileIO leg.
+
+Prints one JSON object with all figures; run on a TPU host for the real
+numbers (CPU backend figures are host-memcpy baselines, labeled as such).
+
+Usage: python benchmarking/offload_throughput.py [--pages 64] [--iters 5]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def bench_copier(pages: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from llmd_kv_cache_tpu.offload.tpu_copier import TPUBlockCopier
+
+    layers, num_pages, page_size, kv_heads, head_dim = 4, pages + 1, 16, 8, 128
+    shape = (layers, num_pages, page_size, kv_heads, head_dim)
+    k = jnp.zeros(shape, jnp.bfloat16)
+    v = jnp.zeros(shape, jnp.bfloat16)
+    copier = TPUBlockCopier(k, v)
+    page_ids = list(range(1, pages + 1))
+    nbytes = copier.slab_nbytes(pages)
+
+    # Warmup (compile + cache)
+    slabs = copier.gather_many_to_host([page_ids])
+    copier.scatter_many_from_host(list(zip(slabs, [page_ids])))
+
+    d2h_times, h2d_times = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        slabs = copier.gather_many_to_host([page_ids])
+        d2h_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        copier.scatter_many_from_host(list(zip(slabs, [page_ids])))
+        h2d_times.append(time.perf_counter() - t0)
+
+    return {
+        "platform": jax.devices()[0].platform,
+        "pinned_host_active": copier.pinned_host_active,
+        "slab_mb": round(nbytes / 2**20, 2),
+        "d2h_gbps": round(nbytes / min(d2h_times) / 1e9, 3),
+        "h2d_gbps": round(nbytes / min(h2d_times) / 1e9, 3),
+    }
+
+
+def _wait(engine, job_id, timeout=60.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        for jid, status in engine.poll_finished():
+            if jid == job_id:
+                return status
+        time.sleep(0.0005)
+    raise TimeoutError("job did not finish")
+
+
+def bench_fileio(iters: int, direct_io: bool) -> dict:
+    from llmd_kv_cache_tpu.offload.native import STATUS_OK, NativeIOEngine
+
+    nbytes = 64 << 20
+    data = np.random.default_rng(0).integers(0, 255, nbytes, dtype=np.uint8)
+    out = np.zeros_like(data)
+    with tempfile.TemporaryDirectory() as root:
+        engine = NativeIOEngine(num_threads=4, staging_bytes=8 << 20,
+                                direct_io=direct_io)
+        try:
+            write_times, read_times = [], []
+            for i in range(iters):
+                path = os.path.join(root, f"blk{i}.bin")
+                t0 = time.perf_counter()
+                job = engine.begin_job()
+                assert engine.submit_write(job, path, path + ".tmp", data,
+                                           skip_if_exists=False)
+                engine.seal_job(job)
+                assert _wait(engine, job) == STATUS_OK
+                write_times.append(time.perf_counter() - t0)
+
+                t0 = time.perf_counter()
+                job = engine.begin_job()
+                engine.submit_read(job, path, out)
+                engine.seal_job(job)
+                assert _wait(engine, job) == STATUS_OK
+                read_times.append(time.perf_counter() - t0)
+            np.testing.assert_array_equal(out, data)
+            return {
+                "file_mb": nbytes >> 20,
+                "numa_node": engine.numa_node(),
+                "pinned_staging_workers": engine.pinned_staging_workers(),
+                "write_gbps": round(nbytes / min(write_times) / 1e9, 3),
+                "read_gbps": round(nbytes / min(read_times) / 1e9, 3),
+            }
+        finally:
+            engine.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pages", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument("--skip-copier", action="store_true",
+                        help="file I/O only (no jax import)")
+    args = parser.parse_args()
+
+    result = {}
+    if not args.skip_copier:
+        result["copier"] = bench_copier(args.pages, args.iters)
+    result["fileio_buffered"] = bench_fileio(args.iters, direct_io=False)
+    result["fileio_direct"] = bench_fileio(args.iters, direct_io=True)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,24 @@
 // Implementation of the KV offload I/O engine + C ABI for ctypes.
 // See kvio.hpp for design notes and reference parity table.
 
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE  // O_DIRECT
+#endif
+
 #include "kvio.hpp"
+#include "kvio_numa.hpp"
 
 #include <fcntl.h>
 #include <sched.h>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/time.h>
 #include <unistd.h>
 #include <utime.h>
 
+#include <cstdlib>
+
+#include <algorithm>
 #include <cerrno>
 #include <chrono>
 #include <cstdio>
@@ -49,34 +58,22 @@ bool MakeParentDirs(const std::string& path) {
   return true;
 }
 
-// Atomic write: temp file + rename so readers never observe partial files
-// (the reference's FileIO discipline, file_io.cpp:44-108).
-bool WriteFileAtomic(const std::string& final_path, const std::string& tmp_path,
-                     const uint8_t* data, uint64_t len, bool skip_if_exists) {
-  if (skip_if_exists) {
-    struct stat st;
-    if (stat(final_path.c_str(), &st) == 0) {
-      // Idempotent store: refresh atime as an eviction-recency signal
-      // (storage_offload.cpp:317-320 equivalent).
-      utime(final_path.c_str(), nullptr);
-      return true;
-    }
-  }
-  if (!MakeParentDirs(final_path)) return false;
+// --- Atomic-write discipline, shared by the buffered and O_DIRECT paths
+// (the reference's FileIO discipline, file_io.cpp:44-108): dedup+atime,
+// parent dirs, write to temp, publish via rename, unlink temp on error. ---
 
-  int fd = open(tmp_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0) return false;
-  uint64_t written = 0;
-  while (written < len) {
-    ssize_t n = write(fd, data + written, len - written);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      close(fd);
-      unlink(tmp_path.c_str());
-      return false;
-    }
-    written += static_cast<uint64_t>(n);
-  }
+// Idempotent-store dedup: true if the final file already exists (atime
+// refreshed as an eviction-recency signal, storage_offload.cpp:317-320).
+bool ExistingFileReused(const std::string& final_path) {
+  struct stat st;
+  if (stat(final_path.c_str(), &st) != 0) return false;
+  utime(final_path.c_str(), nullptr);
+  return true;
+}
+
+// close + rename-to-publish; unlinks the temp on any failure.
+bool PublishTmpFile(int fd, const std::string& final_path,
+                    const std::string& tmp_path) {
   if (close(fd) != 0) {
     unlink(tmp_path.c_str());
     return false;
@@ -86,6 +83,32 @@ bool WriteFileAtomic(const std::string& final_path, const std::string& tmp_path,
     return false;
   }
   return true;
+}
+
+// Abort a half-written temp file.
+bool AbortTmpFile(int fd, const std::string& tmp_path) {
+  close(fd);
+  unlink(tmp_path.c_str());
+  return false;
+}
+
+bool WriteFileAtomic(const std::string& final_path, const std::string& tmp_path,
+                     const uint8_t* data, uint64_t len, bool skip_if_exists) {
+  if (skip_if_exists && ExistingFileReused(final_path)) return true;
+  if (!MakeParentDirs(final_path)) return false;
+
+  int fd = open(tmp_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  uint64_t written = 0;
+  while (written < len) {
+    ssize_t n = write(fd, data + written, len - written);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return AbortTmpFile(fd, tmp_path);
+    }
+    written += static_cast<uint64_t>(n);
+  }
+  return PublishTmpFile(fd, final_path, tmp_path);
 }
 
 bool ReadFileRange(const std::string& path, uint8_t* dst, uint64_t len,
@@ -113,10 +136,30 @@ bool ReadFileRange(const std::string& path, uint8_t* dst, uint64_t len,
 }  // namespace
 
 Engine::Engine(int num_threads, int read_preferring_workers,
-               double max_write_queued_seconds)
+               double max_write_queued_seconds, int numa_node,
+               uint64_t staging_bytes, bool direct_io)
     : num_threads_(num_threads > 0 ? num_threads : 1),
       read_preferring_workers_(read_preferring_workers),
-      max_write_queued_seconds_(max_write_queued_seconds) {
+      max_write_queued_seconds_(max_write_queued_seconds),
+      staging_bytes_(staging_bytes),
+      direct_io_(direct_io) {
+  // Resolve placement: explicit node, auto-discovered accelerator host
+  // node, or disabled (-2). Round-robin workers over the node's CPUs
+  // (thread_pool.cpp:110-127 semantics). When no node resolves (non-NUMA
+  // VM, no accelerator visible) workers stay UNPINNED — pinning to an
+  // arbitrary all-CPU fallback would stack every engine instance onto the
+  // same first N cores.
+  std::vector<int> cpus;
+  if (numa_node != -2) {
+    numa_node_ = numa_node >= 0 ? numa_node : DiscoverAcceleratorNumaNode();
+    if (numa_node_ >= 0) cpus = CpusInNumaNode(numa_node_);
+  }
+  worker_cpus_.assign(num_threads_, -1);
+  if (!cpus.empty()) {
+    for (int i = 0; i < num_threads_; ++i) {
+      worker_cpus_[i] = cpus[i % cpus.size()];
+    }
+  }
   workers_.reserve(num_threads_);
   for (int i = 0; i < num_threads_; ++i) {
     workers_.emplace_back([this, i] { WorkerLoop(i); });
@@ -230,6 +273,26 @@ void Engine::SubmitRead(uint64_t job_id, const std::string& path, void* dst,
 }
 
 void Engine::WorkerLoop(int worker_index) {
+  // On-thread placement, in order: bind the CPU, then prefer the node for
+  // allocations, then first-touch the staging buffer so its pages land on
+  // the accelerator's host node (matches the reference worker prologue,
+  // thread_pool.cpp:110-144; "pinned" = mlock instead of cudaHostAlloc).
+  PinThreadToCpu(worker_cpus_[worker_index]);
+  if (numa_node_ >= 0) SetPreferredNode(numa_node_);
+  StagingBuffer staging;
+  if (direct_io_ && staging_bytes_ > 0) {  // staging only backs O_DIRECT
+    uint64_t size = (staging_bytes_ + 4095) & ~uint64_t{4095};
+    void* p = std::aligned_alloc(4096, size);
+    if (p != nullptr) {
+      std::memset(p, 0, size);  // first-touch on this thread
+      staging.data = static_cast<uint8_t*>(p);
+      staging.size = size;
+      staging.locked = mlock(p, size) == 0;
+      if (staging.locked) pinned_staging_.fetch_add(1);
+    }
+  }
+  workers_ready_.fetch_add(1);
+
   // The first read_preferring_workers_ drain the high (read) queue first;
   // the rest prefer writes but steal reads when idle (thread_pool.cpp:44-61
   // equivalent).
@@ -241,7 +304,7 @@ void Engine::WorkerLoop(int worker_index) {
       cv_.wait(lk, [this] {
         return shutdown_ || !high_queue_.empty() || !normal_queue_.empty();
       });
-      if (shutdown_ && high_queue_.empty() && normal_queue_.empty()) return;
+      if (shutdown_ && high_queue_.empty() && normal_queue_.empty()) break;
       std::deque<Task>* first = prefer_reads ? &high_queue_ : &normal_queue_;
       std::deque<Task>* second = prefer_reads ? &normal_queue_ : &high_queue_;
       std::deque<Task>* src_q = !first->empty() ? first : second;
@@ -255,24 +318,130 @@ void Engine::WorkerLoop(int worker_index) {
       auto it = jobs_.find(task.job_id);
       if (it != jobs_.end() && it->second->cancelled.load()) cancelled = true;
     }
-    bool ok = cancelled ? false : RunTask(task);
+    bool ok = cancelled ? false : RunTask(task, staging);
     FinishTask(task, ok);
+  }
+
+  if (staging.data != nullptr) {
+    if (staging.locked) munlock(staging.data, staging.size);
+    std::free(staging.data);
   }
 }
 
-bool Engine::RunTask(Task& task) {
+bool Engine::RunTask(Task& task, StagingBuffer& staging) {
+  const bool use_staged =
+      direct_io_ && staging.data != nullptr && task.len >= 4096;
   double start = NowSeconds();
   bool ok;
   if (task.kind == TaskKind::kWrite) {
-    ok = WriteFileAtomic(task.path, task.tmp_path, task.src, task.len,
-                         task.skip_if_exists);
+    ok = use_staged ? WriteStaged(task, staging)
+                    : WriteFileAtomic(task.path, task.tmp_path, task.src,
+                                      task.len, task.skip_if_exists);
     double dur = NowSeconds() - start;
     double prev = avg_write_seconds_.load();
     avg_write_seconds_.store(prev == 0.0 ? dur : 0.8 * prev + 0.2 * dur);
   } else {
-    ok = ReadFileRange(task.path, task.dst, task.len, task.offset);
+    ok = use_staged ? ReadStaged(task, staging)
+                    : ReadFileRange(task.path, task.dst, task.len, task.offset);
   }
   return ok;
+}
+
+// O_DIRECT atomic write: stream src through the page-aligned staging buffer
+// into the temp file (page-cache bypass — KV files are written once and
+// rarely re-read on the same host), unaligned tail via buffered I/O after
+// clearing O_DIRECT, then rename. Falls back to the buffered path when the
+// filesystem rejects O_DIRECT (e.g. tmpfs).
+bool Engine::WriteStaged(const Task& task, StagingBuffer& staging) {
+  if (task.skip_if_exists && ExistingFileReused(task.path)) return true;
+  if (!MakeParentDirs(task.path)) return false;
+  int fd = open(task.tmp_path.c_str(),
+                O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
+  if (fd < 0) {
+    // Filesystem refuses O_DIRECT (e.g. tmpfs): buffered path.
+    return WriteFileAtomic(task.path, task.tmp_path, task.src, task.len,
+                           task.skip_if_exists);
+  }
+  direct_transfers_.fetch_add(1);
+  const uint64_t aligned_len = task.len & ~uint64_t{4095};
+  uint64_t done = 0;
+  while (done < aligned_len) {
+    uint64_t chunk = std::min(staging.size, aligned_len - done);
+    std::memcpy(staging.data, task.src + done, chunk);
+    uint64_t off = 0;
+    while (off < chunk) {
+      ssize_t n = write(fd, staging.data + off, chunk - off);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return AbortTmpFile(fd, task.tmp_path);
+      }
+      // O_DIRECT writes stay 4096-multiples as long as the kernel doesn't
+      // short-write mid-chunk; a misaligned residue would fail the next
+      // write() and funnel into the error path above.
+      off += static_cast<uint64_t>(n);
+    }
+    done += chunk;
+  }
+  if (task.len > aligned_len) {
+    // Unaligned tail: drop O_DIRECT for the final partial page.
+    int flags = fcntl(fd, F_GETFL);
+    if (flags < 0 || fcntl(fd, F_SETFL, flags & ~O_DIRECT) != 0) {
+      return AbortTmpFile(fd, task.tmp_path);
+    }
+    uint64_t tail = task.len - aligned_len;
+    uint64_t off = 0;
+    while (off < tail) {
+      ssize_t n = pwrite(fd, task.src + aligned_len + off, tail - off,
+                         static_cast<off_t>(aligned_len + off));
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return AbortTmpFile(fd, task.tmp_path);
+      }
+      off += static_cast<uint64_t>(n);
+    }
+  }
+  return PublishTmpFile(fd, task.path, task.tmp_path);
+}
+
+// O_DIRECT read: page-aligned reads into staging, memcpy the requested
+// window out (handles arbitrary task.offset). Buffered fallback as above.
+bool Engine::ReadStaged(const Task& task, StagingBuffer& staging) {
+  int fd = open(task.path.c_str(), O_RDONLY | O_DIRECT);
+  if (fd < 0) {
+    return ReadFileRange(task.path, task.dst, task.len, task.offset);
+  }
+  direct_transfers_.fetch_add(1);
+  uint64_t done = 0;
+  bool ok = true;
+  while (done < task.len) {
+    uint64_t want_off = task.offset + done;
+    uint64_t aligned_off = want_off & ~uint64_t{4095};
+    uint64_t skip = want_off - aligned_off;
+    uint64_t want = std::min(task.len - done, staging.size - skip);
+    // Read enough aligned bytes to cover [want_off, want_off+want).
+    uint64_t need = (skip + want + 4095) & ~uint64_t{4095};
+    uint64_t got = 0;
+    while (got < need) {
+      ssize_t n = pread(fd, staging.data + got, need - got,
+                        static_cast<off_t>(aligned_off + got));
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        ok = false;
+        break;
+      }
+      if (n == 0) break;  // EOF
+      got += static_cast<uint64_t>(n);
+    }
+    if (!ok) break;
+    uint64_t avail = got > skip ? std::min(want, got - skip) : 0;
+    if (avail == 0) break;  // EOF before the requested window
+    std::memcpy(task.dst + done, staging.data + skip, avail);
+    done += avail;
+    if (avail < want) break;  // short file
+  }
+  close(fd);
+  utime(task.path.c_str(), nullptr);
+  return ok && done == task.len;
 }
 
 void Engine::FinishTask(const Task& task, bool ok) {
@@ -374,9 +543,11 @@ int Engine::WaitJob(uint64_t job_id, double timeout_seconds) {
 extern "C" {
 
 void* kvio_create(int num_threads, int read_preferring_workers,
-                  double max_write_queued_seconds) {
+                  double max_write_queued_seconds, int numa_node,
+                  uint64_t staging_bytes, int direct_io) {
   return new kvio::Engine(num_threads, read_preferring_workers,
-                          max_write_queued_seconds);
+                          max_write_queued_seconds, numa_node, staging_bytes,
+                          direct_io != 0);
 }
 
 void kvio_destroy(void* engine) { delete static_cast<kvio::Engine*>(engine); }
@@ -425,5 +596,45 @@ int kvio_file_exists(const char* path, int touch_atime) {
   if (stat(path, &st) != 0) return 0;
   if (touch_atime) utime(path, nullptr);
   return 1;
+}
+
+// -- placement visibility --
+
+int kvio_numa_node(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->NumaNode();
+}
+
+int kvio_worker_cpu(void* engine, int worker) {
+  return static_cast<kvio::Engine*>(engine)->WorkerCpu(worker);
+}
+
+int kvio_workers_ready(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->WorkersReady() ? 1 : 0;
+}
+
+int kvio_pinned_staging_workers(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->PinnedStagingWorkers();
+}
+
+uint64_t kvio_direct_transfers(void* engine) {
+  return static_cast<kvio::Engine*>(engine)->DirectTransfers();
+}
+
+// -- topology helpers (standalone, for tests and Python-side sizing) --
+
+int kvio_discover_numa_node() { return kvio::DiscoverAcceleratorNumaNode(); }
+
+int kvio_cpus_in_node(int node, int* out, int max_items) {
+  auto cpus = kvio::CpusInNumaNode(node);
+  int n = std::min<int>(max_items, static_cast<int>(cpus.size()));
+  for (int i = 0; i < n; ++i) out[i] = cpus[i];
+  return static_cast<int>(cpus.size());
+}
+
+int kvio_parse_cpulist(const char* s, int* out, int max_items) {
+  auto cpus = kvio::ParseCpuList(s ? s : "");
+  int n = std::min<int>(max_items, static_cast<int>(cpus.size()));
+  for (int i = 0; i < n; ++i) out[i] = cpus[i];
+  return static_cast<int>(cpus.size());
 }
 }

@@ -64,10 +64,27 @@ struct JobState {
   std::atomic<uint64_t> bytes{0};
 };
 
+// Per-worker pinned staging buffer: page-aligned, mlock'd (best effort),
+// first-touched after the worker binds its CPU + memory policy so pages
+// land on the accelerator's host NUMA node. Backs O_DIRECT transfers
+// (page-cache bypass for write-once/read-rarely KV files — the TPU-side
+// answer to the reference's GDS bounce buffers).
+struct StagingBuffer {
+  uint8_t* data = nullptr;
+  uint64_t size = 0;
+  bool locked = false;  // mlock succeeded ("pinned")
+};
+
 class Engine {
  public:
+  // numa_node: >=0 pins workers to that node's CPUs; -1 auto-discovers the
+  // accelerator's host node (kvio_numa.hpp); -2 disables placement.
+  // staging_bytes: per-worker staging size (0 disables staging+direct I/O).
+  // direct_io: stage transfers through O_DIRECT when the filesystem
+  // supports it (falls back to buffered I/O per file otherwise).
   Engine(int num_threads, int read_preferring_workers,
-         double max_write_queued_seconds);
+         double max_write_queued_seconds, int numa_node = -1,
+         uint64_t staging_bytes = 0, bool direct_io = false);
   ~Engine();
 
   uint64_t BeginJob();
@@ -93,16 +110,42 @@ class Engine {
   double AvgWriteSeconds() const { return avg_write_seconds_.load(); }
   int QueuedWrites() const;
 
+  // Placement visibility (tests + metrics).
+  int NumaNode() const { return numa_node_; }
+  int WorkerCpu(int worker) const {
+    return (worker >= 0 && worker < static_cast<int>(worker_cpus_.size()))
+               ? worker_cpus_[worker]
+               : -1;
+  }
+  // True once every worker finished CPU/mempolicy/staging setup.
+  bool WorkersReady() const {
+    return workers_ready_.load() == num_threads_;
+  }
+  // Count of workers whose staging buffer is mlock'd.
+  int PinnedStagingWorkers() const { return pinned_staging_.load(); }
+  // Transfers that actually took the O_DIRECT staged path (not the
+  // buffered fallback) — lets callers/tests verify direct I/O engaged.
+  uint64_t DirectTransfers() const { return direct_transfers_.load(); }
+
   void Shutdown();
 
  private:
   void WorkerLoop(int worker_index);
-  bool RunTask(Task& task);
+  bool RunTask(Task& task, StagingBuffer& staging);
   void FinishTask(const Task& task, bool ok);
+  bool WriteStaged(const Task& task, StagingBuffer& staging);
+  bool ReadStaged(const Task& task, StagingBuffer& staging);
 
   int num_threads_;
   int read_preferring_workers_;
   double max_write_queued_seconds_;
+  int numa_node_ = -1;                 // resolved node (-1 unknown/disabled)
+  uint64_t staging_bytes_ = 0;
+  bool direct_io_ = false;
+  std::vector<int> worker_cpus_;       // assigned CPU per worker (-1 none)
+  std::atomic<int> workers_ready_{0};
+  std::atomic<int> pinned_staging_{0};
+  std::atomic<uint64_t> direct_transfers_{0};
 
   mutable std::mutex mu_;
   std::condition_variable cv_;

@@ -1,0 +1,131 @@
+#include "kvio_numa.hpp"
+
+#include <dirent.h>
+#include <pthread.h>
+#include <sched.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+
+namespace kvio {
+
+namespace {
+
+// Read a small sysfs attribute; empty string on failure.
+std::string ReadSysfs(const std::string& path) {
+  std::ifstream f(path);
+  if (!f.is_open()) return "";
+  std::string line;
+  std::getline(f, line);
+  return line;
+}
+
+int ParseIntOr(const std::string& s, int fallback) {
+  if (s.empty()) return fallback;
+  char* end = nullptr;
+  long v = std::strtol(s.c_str(), &end, 0);  // sysfs vendor ids are 0x-prefixed
+  if (end == s.c_str()) return fallback;
+  return static_cast<int>(v);
+}
+
+constexpr int kGoogleVendorId = 0x1ae0;
+
+}  // namespace
+
+int DiscoverAcceleratorNumaNode() {
+  if (const char* env = std::getenv("KVIO_NUMA_NODE")) {
+    return ParseIntOr(env, -1);
+  }
+  DIR* dir = opendir("/sys/bus/pci/devices");
+  if (dir == nullptr) return -1;
+  int found = -1;
+  while (struct dirent* ent = readdir(dir)) {
+    if (ent->d_name[0] == '.') continue;
+    std::string base = std::string("/sys/bus/pci/devices/") + ent->d_name;
+    if (ParseIntOr(ReadSysfs(base + "/vendor"), -1) != kGoogleVendorId) {
+      continue;
+    }
+    int node = ParseIntOr(ReadSysfs(base + "/numa_node"), -1);
+    if (node >= 0) {
+      found = node;
+      break;
+    }
+  }
+  closedir(dir);
+  return found;
+}
+
+std::vector<int> ParseCpuList(const std::string& line) {
+  std::vector<int> cpus;
+  size_t start = 0;
+  while (start < line.size()) {
+    size_t comma = line.find(',', start);
+    size_t len = (comma == std::string::npos) ? std::string::npos
+                                              : comma - start;
+    std::string token = line.substr(start, len);
+    // Trim whitespace/newline
+    while (!token.empty() && std::isspace(static_cast<unsigned char>(token.back()))) {
+      token.pop_back();
+    }
+    if (!token.empty()) {
+      size_t dash = token.find('-');
+      char* end = nullptr;
+      if (dash != std::string::npos) {
+        long a = std::strtol(token.c_str(), &end, 10);
+        bool a_ok = end != token.c_str();
+        const char* bstart = token.c_str() + dash + 1;
+        long b = std::strtol(bstart, &end, 10);
+        bool b_ok = end != bstart;
+        if (a_ok && b_ok && a >= 0 && a <= b) {
+          for (long c = a; c <= b; ++c) cpus.push_back(static_cast<int>(c));
+        }
+      } else {
+        long a = std::strtol(token.c_str(), &end, 10);
+        if (end != token.c_str() && a >= 0) cpus.push_back(static_cast<int>(a));
+      }
+    }
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  return cpus;
+}
+
+std::vector<int> CpusInNumaNode(int node) {
+  if (node < 0) return {};
+  std::string path = "/sys/devices/system/node/node" + std::to_string(node) +
+                     "/cpulist";
+  std::string line = ReadSysfs(path);
+  if (line.empty()) return {};
+  return ParseCpuList(line);
+}
+
+bool SetPreferredNode(int node) {
+#ifdef __NR_set_mempolicy
+  if (node < 0) return false;
+  // MPOL_PREFERRED = 1; nodemask is a bitmask of nodes.
+  constexpr int kMpolPreferred = 1;
+  unsigned long mask[16] = {0};
+  if (node >= static_cast<int>(sizeof(mask) * 8)) return false;
+  mask[node / (8 * sizeof(unsigned long))] |=
+      1UL << (node % (8 * sizeof(unsigned long)));
+  long rc = syscall(__NR_set_mempolicy, kMpolPreferred, mask,
+                    sizeof(mask) * 8);
+  return rc == 0;
+#else
+  (void)node;
+  return false;
+#endif
+}
+
+bool PinThreadToCpu(int cpu) {
+  if (cpu < 0) return false;
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  CPU_SET(cpu, &set);
+  return pthread_setaffinity_np(pthread_self(), sizeof(set), &set) == 0;
+}
+
+}  // namespace kvio

@@ -1,0 +1,43 @@
+// NUMA topology discovery + thread placement for the kvio pool.
+//
+// TPU-host analog of the reference's GPU NUMA plumbing
+// (numa_utils.cpp:33-117, thread_pool.cpp:71-127): where the reference asks
+// CUDA for the GPU's host NUMA node, a TPU host exposes its accelerator
+// complex only through sysfs — we scan PCI devices for Google (0x1ae0)
+// accelerators and read their numa_node attribute. CPU sets come from the
+// kernel's per-node cpulist. Memory policy uses the raw set_mempolicy
+// syscall so no libnuma link dependency is needed.
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace kvio {
+
+// Host NUMA node of the accelerator complex.
+// Resolution order:
+//   1. KVIO_NUMA_NODE env var (explicit operator override; also the only
+//      option in VMs that hide PCI topology),
+//   2. sysfs scan: first PCI device with vendor 0x1ae0 (Google, i.e. a TPU)
+//      that reports numa_node >= 0,
+//   3. -1 (unknown; callers fall back to all CPUs, no memory policy).
+int DiscoverAcceleratorNumaNode();
+
+// CPUs belonging to a NUMA node, from
+// /sys/devices/system/node/node<N>/cpulist. Empty on failure.
+std::vector<int> CpusInNumaNode(int node);
+
+// Parse a kernel cpulist string ("0-13,84-97"); malformed tokens are
+// skipped. Exposed separately for unit tests.
+std::vector<int> ParseCpuList(const std::string& line);
+
+// Best-effort MPOL_PREFERRED for the calling thread's future allocations
+// (first-touch pages land on `node`). Returns false if the syscall is
+// unavailable or rejected.
+bool SetPreferredNode(int node);
+
+// Pin the calling thread to a single CPU.
+bool PinThreadToCpu(int cpu);
+
+}  // namespace kvio

@@ -43,16 +43,21 @@ def load_library() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        src = _CSRC_DIR / "kvio.cpp"
-        if not _LIB_PATH.exists() or (
-            src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        sources = [_CSRC_DIR / n for n in
+                   ("kvio.cpp", "kvio.hpp", "kvio_numa.cpp", "kvio_numa.hpp")]
+        if not _LIB_PATH.exists() or any(
+            s.exists() and s.stat().st_mtime > _LIB_PATH.stat().st_mtime
+            for s in sources
         ):
             logger.info("building libkvio.so")
             _build()
         lib = ctypes.CDLL(str(_LIB_PATH))
 
         lib.kvio_create.restype = ctypes.c_void_p
-        lib.kvio_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_double]
+        lib.kvio_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int,
+        ]
         lib.kvio_destroy.argtypes = [ctypes.c_void_p]
         lib.kvio_begin_job.restype = ctypes.c_uint64
         lib.kvio_begin_job.argtypes = [ctypes.c_void_p]
@@ -79,22 +84,53 @@ def load_library() -> ctypes.CDLL:
         lib.kvio_queued_writes.argtypes = [ctypes.c_void_p]
         lib.kvio_file_exists.restype = ctypes.c_int
         lib.kvio_file_exists.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.kvio_numa_node.restype = ctypes.c_int
+        lib.kvio_numa_node.argtypes = [ctypes.c_void_p]
+        lib.kvio_worker_cpu.restype = ctypes.c_int
+        lib.kvio_worker_cpu.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kvio_workers_ready.restype = ctypes.c_int
+        lib.kvio_workers_ready.argtypes = [ctypes.c_void_p]
+        lib.kvio_pinned_staging_workers.restype = ctypes.c_int
+        lib.kvio_pinned_staging_workers.argtypes = [ctypes.c_void_p]
+        lib.kvio_direct_transfers.restype = ctypes.c_uint64
+        lib.kvio_direct_transfers.argtypes = [ctypes.c_void_p]
+        lib.kvio_discover_numa_node.restype = ctypes.c_int
+        lib.kvio_discover_numa_node.argtypes = []
+        lib.kvio_cpus_in_node.restype = ctypes.c_int
+        lib.kvio_cpus_in_node.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        lib.kvio_parse_cpulist.restype = ctypes.c_int
+        lib.kvio_parse_cpulist.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
 
         _lib = lib
         return _lib
 
 
 class NativeIOEngine:
-    """Thin OO wrapper over the C ABI."""
+    """Thin OO wrapper over the C ABI.
+
+    Workers are pinned round-robin to the CPUs of ``numa_node`` (-1
+    auto-discovers the TPU's host node from PCI sysfs, -2 disables
+    placement), prefer that node for allocations, and hold a page-aligned
+    mlock'd staging buffer each. ``direct_io`` routes transfers >= 4 KiB
+    through O_DIRECT via the staging buffer (page-cache bypass; buffered
+    fallback per file when the filesystem refuses).
+    """
 
     def __init__(self, num_threads: int = 4, read_preferring_workers: int = 3,
-                 max_write_queued_seconds: float = 10.0):
+                 max_write_queued_seconds: float = 10.0, numa_node: int = -1,
+                 staging_bytes: int = 4 << 20, direct_io: bool = False):
         self._lib = load_library()
         self._handle = self._lib.kvio_create(
-            num_threads, read_preferring_workers, max_write_queued_seconds
+            num_threads, read_preferring_workers, max_write_queued_seconds,
+            numa_node, staging_bytes, int(direct_io),
         )
         if not self._handle:
             raise RuntimeError("failed to create kvio engine")
+        self.num_threads = num_threads
 
     def begin_job(self) -> int:
         return self._lib.kvio_begin_job(self._handle)
@@ -159,6 +195,28 @@ class NativeIOEngine:
     def queued_writes(self) -> int:
         return self._lib.kvio_queued_writes(self._handle)
 
+    # -- placement visibility --
+
+    def numa_node(self) -> int:
+        """Resolved NUMA node (-1 when unknown or placement disabled)."""
+        return self._lib.kvio_numa_node(self._handle)
+
+    def worker_cpus(self) -> list[int]:
+        return [self._lib.kvio_worker_cpu(self._handle, i)
+                for i in range(self.num_threads)]
+
+    def workers_ready(self) -> bool:
+        return bool(self._lib.kvio_workers_ready(self._handle))
+
+    def pinned_staging_workers(self) -> int:
+        """Workers whose staging buffer mlock succeeded."""
+        return self._lib.kvio_pinned_staging_workers(self._handle)
+
+    def direct_transfers(self) -> int:
+        """Transfers that took the O_DIRECT staged path (vs buffered
+        fallback)."""
+        return self._lib.kvio_direct_transfers(self._handle)
+
     def close(self) -> None:
         if self._handle:
             self._lib.kvio_destroy(self._handle)
@@ -173,3 +231,25 @@ class NativeIOEngine:
 
 def file_exists(path: str, touch_atime: bool = False) -> bool:
     return bool(load_library().kvio_file_exists(path.encode(), int(touch_atime)))
+
+
+def discover_numa_node() -> int:
+    """Accelerator host NUMA node (KVIO_NUMA_NODE override, PCI sysfs scan,
+    -1 unknown)."""
+    return load_library().kvio_discover_numa_node()
+
+
+def cpus_in_node(node: int, max_items: int = 1024) -> list[int]:
+    lib = load_library()
+    out = (ctypes.c_int * max_items)()
+    n = lib.kvio_cpus_in_node(node, out, max_items)
+    return [out[i] for i in range(min(n, max_items))]
+
+
+def parse_cpulist(s: str, max_items: int = 1024) -> list[int]:
+    """Parse a kernel cpulist string like ``0-13,84-97`` (test hook for the
+    native parser)."""
+    lib = load_library()
+    out = (ctypes.c_int * max_items)()
+    n = lib.kvio_parse_cpulist(s.encode(), out, max_items)
+    return [out[i] for i in range(min(n, max_items))]

@@ -24,6 +24,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.logging import get_logger
+
+logger = get_logger("offload.copier")
+
 
 @partial(jax.jit, static_argnames=())
 def _gather_slab(k_cache: jax.Array, v_cache: jax.Array,
@@ -68,6 +72,13 @@ class TPUBlockCopier:
     def slab_nbytes(self, n_pages: int) -> int:
         return int(np.prod(self.slab_shape(n_pages))) * self.dtype.itemsize
 
+    @property
+    def pinned_host_active(self) -> bool:
+        """True while the D2H leg routes through ``pinned_host`` memory.
+        Surfaced (not just best-effort) so deployments can assert the true
+        DMA path instead of silently degrading."""
+        return self._pinned_sharding is not None
+
     def _to_pinned_host(self, x: jax.Array) -> jax.Array:
         """Route the device→host leg through pinned host memory when the
         runtime supports memory kinds (true DMA staging, the role the
@@ -77,6 +88,9 @@ class TPUBlockCopier:
         try:
             return jax.device_put(x, self._pinned_sharding)
         except Exception:  # pragma: no cover - runtime without the kind
+            logger.warning(
+                "pinned_host memory kind unavailable on %s; D2H falls back "
+                "to unpinned transfers", x.devices())
             self._pinned_sharding = None
             return x
 

@@ -19,7 +19,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -76,14 +76,26 @@ class OffloadHandlers:
         io_threads: int = 4,
         read_preferring_ratio: float = 0.75,
         max_write_queued_seconds: float = 10.0,
+        numa_node: int = -1,
+        staging_bytes: Optional[int] = None,
+        direct_io: bool = False,
     ):
         self.copier = copier
         self.mapper = mapper
         read_pref = max(1, int(io_threads * read_preferring_ratio))
+        if staging_bytes is None:
+            # Size each worker's pinned staging to one single-page slab,
+            # floored at 1 MiB (the reference sizes per-thread staging to
+            # the largest-group file, thread_pool.cpp:134-144; our files
+            # hold one canonical block each).
+            staging_bytes = max(copier.slab_nbytes(1), 1 << 20)
         self.io = NativeIOEngine(
             num_threads=io_threads,
             read_preferring_workers=read_pref,
             max_write_queued_seconds=max_write_queued_seconds,
+            numa_node=numa_node,
+            staging_bytes=staging_bytes,
+            direct_io=direct_io,
         )
         self._pending: dict[int, _PendingJob] = {}
         self._lock = threading.Lock()
