@@ -111,6 +111,42 @@ bool WriteFileAtomic(const std::string& final_path, const std::string& tmp_path,
   return PublishTmpFile(fd, final_path, tmp_path);
 }
 
+// In-place partial write for multi-block files: provision the file to its
+// full size (sparse beyond written slots), then pwrite the slot bytes.
+// Deliberately not atomic — the tmp+rename discipline only fits whole-file
+// publishes; slot updates mirror the reference's in-place partial-file
+// writes (worker.py head_offsets + file_io write path).
+bool WriteFileRangeAt(const std::string& path, const uint8_t* data,
+                      uint64_t len, uint64_t offset, uint64_t file_size) {
+  if (!MakeParentDirs(path)) return false;
+  int fd = open(path.c_str(), O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return false;
+  }
+  if (static_cast<uint64_t>(st.st_size) < file_size &&
+      ftruncate(fd, static_cast<off_t>(file_size)) != 0) {
+    close(fd);
+    return false;
+  }
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = pwrite(fd, data + done, len - done,
+                       static_cast<off_t>(offset + done));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      return false;
+    }
+    done += static_cast<uint64_t>(n);
+  }
+  bool ok = close(fd) == 0;
+  if (ok) utime(path.c_str(), nullptr);
+  return ok;
+}
+
 bool ReadFileRange(const std::string& path, uint8_t* dst, uint64_t len,
                    uint64_t offset) {
   int fd = open(path.c_str(), O_RDONLY);
@@ -209,9 +245,7 @@ int Engine::QueuedWrites() const {
   return static_cast<int>(normal_queue_.size());
 }
 
-int Engine::SubmitWrite(uint64_t job_id, const std::string& path,
-                        const std::string& tmp_path, const void* data,
-                        uint64_t len, bool skip_if_exists) {
+bool Engine::ShouldShedWrite() {
   // Dynamic write-queue limit: don't queue more write-seconds than the
   // pool can retire within max_write_queued_seconds (the reference's
   // EMA shedding, storage_offload.cpp:80-108,283-299). Dropped writes
@@ -223,10 +257,28 @@ int Engine::SubmitWrite(uint64_t job_id, const std::string& path,
     // would otherwise truncate the limit to 0 and starve (and since the
     // EMA only updates on executed writes, never recover).
     int limit_i = limit < 1.0 ? 1 : static_cast<int>(limit);
-    if (QueuedWrites() >= limit_i) {
-      return 0;
-    }
+    if (QueuedWrites() >= limit_i) return true;
   }
+  return false;
+}
+
+void Engine::EnqueueWrite(Task&& task) {
+  {
+    std::lock_guard<std::mutex> jl(jobs_mu_);
+    auto it = jobs_.find(task.job_id);
+    if (it != jobs_.end()) it->second->total.fetch_add(1);
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    normal_queue_.push_back(std::move(task));
+  }
+  cv_.notify_one();
+}
+
+int Engine::SubmitWrite(uint64_t job_id, const std::string& path,
+                        const std::string& tmp_path, const void* data,
+                        uint64_t len, bool skip_if_exists) {
+  if (ShouldShedWrite()) return 0;
 
   Task task;
   task.kind = TaskKind::kWrite;
@@ -236,17 +288,24 @@ int Engine::SubmitWrite(uint64_t job_id, const std::string& path,
   task.src = static_cast<const uint8_t*>(data);
   task.len = len;
   task.skip_if_exists = skip_if_exists;
+  EnqueueWrite(std::move(task));
+  return 1;
+}
 
-  {
-    std::lock_guard<std::mutex> jl(jobs_mu_);
-    auto it = jobs_.find(job_id);
-    if (it != jobs_.end()) it->second->total.fetch_add(1);
-  }
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    normal_queue_.push_back(std::move(task));
-  }
-  cv_.notify_one();
+int Engine::SubmitWriteAt(uint64_t job_id, const std::string& path,
+                          const void* data, uint64_t len, uint64_t offset,
+                          uint64_t file_size) {
+  if (ShouldShedWrite()) return 0;
+
+  Task task;
+  task.kind = TaskKind::kWriteAt;
+  task.job_id = job_id;
+  task.path = path;
+  task.src = static_cast<const uint8_t*>(data);
+  task.len = len;
+  task.offset = offset;
+  task.file_size = file_size;
+  EnqueueWrite(std::move(task));
   return 1;
 }
 
@@ -333,10 +392,15 @@ bool Engine::RunTask(Task& task, StagingBuffer& staging) {
       direct_io_ && staging.data != nullptr && task.len >= 4096;
   double start = NowSeconds();
   bool ok;
-  if (task.kind == TaskKind::kWrite) {
-    ok = use_staged ? WriteStaged(task, staging)
-                    : WriteFileAtomic(task.path, task.tmp_path, task.src,
-                                      task.len, task.skip_if_exists);
+  if (task.kind == TaskKind::kWrite || task.kind == TaskKind::kWriteAt) {
+    if (task.kind == TaskKind::kWriteAt) {
+      ok = WriteFileRangeAt(task.path, task.src, task.len, task.offset,
+                            task.file_size);
+    } else {
+      ok = use_staged ? WriteStaged(task, staging)
+                      : WriteFileAtomic(task.path, task.tmp_path, task.src,
+                                        task.len, task.skip_if_exists);
+    }
     double dur = NowSeconds() - start;
     double prev = avg_write_seconds_.load();
     avg_write_seconds_.store(prev == 0.0 ? dur : 0.8 * prev + 0.2 * dur);
@@ -565,6 +629,13 @@ int kvio_submit_write(void* engine, uint64_t job_id, const char* path,
                       int skip_if_exists) {
   return static_cast<kvio::Engine*>(engine)->SubmitWrite(
       job_id, path, tmp_path, data, len, skip_if_exists != 0);
+}
+
+int kvio_submit_write_at(void* engine, uint64_t job_id, const char* path,
+                         const void* data, uint64_t len, uint64_t offset,
+                         uint64_t file_size) {
+  return static_cast<kvio::Engine*>(engine)->SubmitWriteAt(
+      job_id, path, data, len, offset, file_size);
 }
 
 void kvio_submit_read(void* engine, uint64_t job_id, const char* path,

@@ -31,7 +31,7 @@
 
 namespace kvio {
 
-enum class TaskKind { kWrite, kRead };
+enum class TaskKind { kWrite, kRead, kWriteAt };
 
 // Completion status codes surfaced to Python.
 enum Status : int {
@@ -50,7 +50,8 @@ struct Task {
   const uint8_t* src = nullptr;  // writes: caller-owned buffer
   uint8_t* dst = nullptr;        // reads: caller-owned buffer
   uint64_t len = 0;
-  uint64_t offset = 0;           // reads: byte offset into the file
+  uint64_t offset = 0;           // reads/kWriteAt: byte offset into the file
+  uint64_t file_size = 0;        // kWriteAt: full file size to provision
   bool skip_if_exists = true;    // writes: dedup against existing files
 };
 
@@ -95,6 +96,13 @@ class Engine {
   int SubmitWrite(uint64_t job_id, const std::string& path,
                   const std::string& tmp_path, const void* data, uint64_t len,
                   bool skip_if_exists);
+  // Partial in-place write at a byte offset into a (possibly pre-existing)
+  // multi-block file provisioned to file_size. NOT atomic — used for
+  // head/tail-partial slots of multi-block files, where the enclosing
+  // file already exists or is being filled slot-by-slot. Same shedding as
+  // SubmitWrite.
+  int SubmitWriteAt(uint64_t job_id, const std::string& path, const void* data,
+                    uint64_t len, uint64_t offset, uint64_t file_size);
   // Reads are never shed; they enqueue at high priority.
   void SubmitRead(uint64_t job_id, const std::string& path, void* dst,
                   uint64_t len, uint64_t offset);
@@ -133,6 +141,8 @@ class Engine {
   void WorkerLoop(int worker_index);
   bool RunTask(Task& task, StagingBuffer& staging);
   void FinishTask(const Task& task, bool ok);
+  bool ShouldShedWrite();
+  void EnqueueWrite(Task&& task);
   bool WriteStaged(const Task& task, StagingBuffer& staging);
   bool ReadStaged(const Task& task, StagingBuffer& staging);
 

@@ -34,7 +34,8 @@ class FileMapperConfig:
     kv_heads: int = 8
     head_dim: int = 128
     num_layers: int = 32
-    pages_per_file: int = 1
+    pages_per_file: int = 1   # blocks (slots) per file
+    pages_per_block: int = 1  # pages per slot — fixes the slot byte size
     engine: str = "kvtpu"
     mesh_sizes: dict[str, int] = field(
         default_factory=lambda: {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
@@ -68,6 +69,7 @@ class FileMapper:
             "head_dim": c.head_dim,
             "num_layers": c.num_layers,
             "pages_per_file": c.pages_per_file,
+            "pages_per_block": c.pages_per_block,
             "engine": c.engine,
             **({k: v for k, v in sorted(c.mesh_sizes.items())}
                if not c.parallel_agnostic else {}),

@@ -67,6 +67,11 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
         ]
+        lib.kvio_submit_write_at.restype = ctypes.c_int
+        lib.kvio_submit_write_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ]
         lib.kvio_submit_read.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint64,
@@ -172,6 +177,17 @@ class NativeIOEngine:
         return bool(self._lib.kvio_submit_write(
             self._handle, job_id, path.encode(), tmp_path.encode(),
             address, nbytes, int(skip_if_exists),
+        ))
+
+    def submit_write_at(self, job_id: int, path: str, buffer, offset: int,
+                        file_size: int) -> bool:
+        """Queue an in-place write of ``buffer`` at a byte offset into a
+        file provisioned to ``file_size`` (multi-block file slot update;
+        NOT atomic). Returns False when shed."""
+        address, nbytes = self._buffer_address(buffer, writable=False)
+        return bool(self._lib.kvio_submit_write_at(
+            self._handle, job_id, path.encode(), address, nbytes, offset,
+            file_size,
         ))
 
     def submit_read(self, job_id: int, path: str, buffer, offset: int = 0) -> None:

@@ -39,6 +39,11 @@ class SharedStorageOffloadSpec:
     io_threads: int = 4
     read_preferring_ratio: float = 0.75
     max_write_queued_seconds: float = 10.0
+    # Multi-block file geometry (reference spec.py:76-89): consecutive
+    # blocks per file (1 = one content-addressed file per block) and fixed
+    # pages per block slot.
+    blocks_per_file: int = 1
+    pages_per_block: int = 1
     rank: int = 0
     parallel_agnostic: bool = False
     events_endpoint: Optional[str] = None
@@ -82,6 +87,8 @@ class SharedStorageOffloadSpec:
             max_write_queued_seconds=get(
                 "maxWriteQueuedSeconds", "max_write_queued_seconds", default=10.0
             ),
+            blocks_per_file=get("blocksPerFile", "blocks_per_file", default=1),
+            pages_per_block=get("pagesPerBlock", "pages_per_block", default=1),
             rank=get("rank", default=0),
             parallel_agnostic=get(
                 "parallelAgnostic", "parallel_agnostic", default=False
@@ -100,6 +107,8 @@ class SharedStorageOffloadSpec:
                 kv_heads=self.kv_heads,
                 head_dim=self.head_dim,
                 num_layers=self.num_layers,
+                pages_per_file=self.blocks_per_file,
+                pages_per_block=self.pages_per_block,
                 mesh_sizes=mesh_fingerprint_fields(self.mesh),
                 rank=self.rank,
                 parallel_agnostic=self.parallel_agnostic,
@@ -158,4 +167,6 @@ class SharedStorageOffloadSpec:
             io_threads=self.io_threads,
             read_preferring_ratio=self.read_preferring_ratio,
             max_write_queued_seconds=self.max_write_queued_seconds,
+            blocks_per_file=self.blocks_per_file,
+            pages_per_block=self.pages_per_block,
         )

@@ -66,6 +66,61 @@ class _PendingJob:
     scatters: list = field(default_factory=list)
 
 
+@dataclass
+class FileSpan:
+    """One file's slice of a multi-block transfer.
+
+    A file holds ``blocks_per_file`` logically-consecutive blocks in fixed
+    slots; a span addresses the consecutive slots
+    ``[head_offset, head_offset + len(blocks))`` of the file keyed by
+    ``file_key``. ``blocks[i]`` is the page-id list of slot
+    ``head_offset + i``.
+    """
+
+    file_key: int
+    head_offset: int
+    blocks: list
+
+
+def map_blocks_to_file_spans(
+    file_keys: Sequence[int],
+    start_block_idx: int,
+    blocks: Sequence[Sequence[int]],
+    blocks_per_file: int,
+) -> list[FileSpan]:
+    """Split logically-consecutive blocks into per-file spans.
+
+    Files are aligned at multiples of ``blocks_per_file`` in logical block
+    space; a transfer may start AND/OR end mid-file (the reference's
+    unaligned head/tail mapping, ``worker.py:187-255``). ``file_keys`` has
+    one key per file the range [start_block_idx, +len(blocks)) intersects.
+    """
+    if not blocks:
+        return []
+    bpf = blocks_per_file
+    end_block_idx = start_block_idx + len(blocks)
+    start_file_idx = start_block_idx // bpf
+    num_files = (end_block_idx - 1) // bpf + 1 - start_file_idx
+    if len(file_keys) != num_files:
+        raise ValueError(
+            f"range [{start_block_idx}, {end_block_idx}) spans {num_files} "
+            f"files of {bpf} blocks, got {len(file_keys)} keys"
+        )
+    spans = []
+    consumed = 0
+    for f_idx, key in enumerate(file_keys):
+        file_lo = (start_file_idx + f_idx) * bpf
+        slice_lo = max(start_block_idx, file_lo)
+        slice_hi = min(end_block_idx, file_lo + bpf)
+        spans.append(FileSpan(
+            file_key=key,
+            head_offset=slice_lo - file_lo,
+            blocks=[list(b) for b in blocks[consumed:consumed + slice_hi - slice_lo]],
+        ))
+        consumed += slice_hi - slice_lo
+    return spans
+
+
 class OffloadHandlers:
     """Bidirectional transfer engine for one worker (one device's caches)."""
 
@@ -79,9 +134,18 @@ class OffloadHandlers:
         numa_node: int = -1,
         staging_bytes: Optional[int] = None,
         direct_io: bool = False,
+        blocks_per_file: int = 1,
+        pages_per_block: int = 1,
     ):
         self.copier = copier
         self.mapper = mapper
+        # Multi-block file geometry (reference spec.py:76-89): files hold
+        # blocks_per_file consecutive blocks in fixed slots of
+        # pages_per_block pages each.
+        self.blocks_per_file = blocks_per_file
+        self.pages_per_block = pages_per_block
+        self.slot_bytes = copier.slab_nbytes(pages_per_block)
+        self.file_bytes = self.slot_bytes * blocks_per_file
         read_pref = max(1, int(io_threads * read_preferring_ratio))
         if staging_bytes is None:
             # Size each worker's pinned staging to one single-page slab,
@@ -162,6 +226,119 @@ class OffloadHandlers:
             )
             job.buffers.append(buf)
             job.scatters.append((buf, list(page_ids)))
+            job.nbytes += buf.nbytes
+        self.io.seal_job(job_id)
+        with self._lock:
+            self._pending[job_id] = job
+        return job_id
+
+    # -- multi-block file spans (unaligned head/tail) --
+
+    def _check_span(self, span: FileSpan) -> None:
+        if span.head_offset + len(span.blocks) > self.blocks_per_file:
+            raise ValueError(
+                f"span [{span.head_offset}, "
+                f"{span.head_offset + len(span.blocks)}) exceeds "
+                f"{self.blocks_per_file} slots")
+        for b in span.blocks:
+            if len(b) != self.pages_per_block:
+                raise ValueError(
+                    f"block has {len(b)} pages, file layout expects "
+                    f"{self.pages_per_block}")
+
+    def async_store_spans(self, spans: Sequence[FileSpan],
+                          group_idx: int = 0) -> int:
+        """Store multi-block file spans; returns the job id.
+
+        Every touched file must be FULLY covered (spans for one file may be
+        split, but their union must be all ``blocks_per_file`` slots):
+        lookup treats file existence as "stored", so a file must only ever
+        appear atomically (tmp+rename) with every slot written — a
+        partially-provisioned file would serve zeros for its holes as
+        successful loads. Partial writes stay a load-side concept (head
+        offsets); this mirrors the reference, where a file is one offload
+        block and only complete offload blocks are stored.
+        """
+        by_file: dict[int, list[FileSpan]] = {}
+        for span in spans:
+            self._check_span(span)
+            by_file.setdefault(span.file_key, []).append(span)
+        for file_key, file_spans in by_file.items():
+            covered = sorted(
+                (s.head_offset, s.head_offset + len(s.blocks))
+                for s in file_spans
+            )
+            slots = []
+            for lo, hi in covered:
+                slots.extend(range(lo, hi))
+            if slots != list(range(self.blocks_per_file)):
+                raise ValueError(
+                    f"store for file {file_key:#x} covers slots {slots}, "
+                    f"need all of 0..{self.blocks_per_file - 1} (files "
+                    "publish atomically; partial stores are not durable)")
+
+        job_id = self.io.begin_job()
+        job = _PendingJob(job_id=job_id, is_store=True,
+                          started=time.perf_counter(), nbytes=0)
+        suffix = uuid.uuid4().hex[:8]
+        # One device program per job: per-block gathers keep slots
+        # independently addressable in the file (a fused multi-block gather
+        # would interleave blocks by layer).
+        all_slabs = self.copier.gather_many_to_host(
+            [list(b) for span in spans for b in span.blocks]
+        )
+        file_parts: dict[int, list[tuple[int, list]]] = {}
+        i = 0
+        for span in spans:
+            slabs = all_slabs[i:i + len(span.blocks)]
+            i += len(span.blocks)
+            file_parts.setdefault(span.file_key, []).append(
+                (span.head_offset, slabs))
+
+        for file_key, parts in file_parts.items():
+            flat = [
+                s.reshape(-1).view(np.uint8)
+                for _off, slabs in sorted(parts, key=lambda p: p[0])
+                for s in slabs
+            ]
+            buf = flat[0] if len(flat) == 1 else np.concatenate(flat)
+            queued = self.io.submit_write(
+                job_id,
+                self.mapper.block_path(file_key, group_idx),
+                self.mapper.tmp_path(file_key, group_idx, unique_suffix=suffix),
+                buf,
+            )
+            if queued:
+                job.buffers.append(buf)
+                job.nbytes += buf.nbytes
+            else:
+                job.shed_hashes.append(file_key)
+        self.io.seal_job(job_id)
+        with self._lock:
+            self._pending[job_id] = job
+        return job_id
+
+    def async_load_spans(self, spans: Sequence[FileSpan],
+                         group_idx: int = 0) -> int:
+        """Load multi-block file spans (partial-file reads start at the
+        span's head-offset byte); returns the job id."""
+        for span in spans:
+            self._check_span(span)
+        job_id = self.io.begin_job()
+        job = _PendingJob(job_id=job_id, is_store=False,
+                          started=time.perf_counter(), nbytes=0)
+        for span in spans:
+            buf = np.empty(len(span.blocks) * self.slot_bytes, np.uint8)
+            self.io.submit_read(
+                job_id, self.mapper.block_path(span.file_key, group_idx),
+                buf, offset=span.head_offset * self.slot_bytes,
+            )
+            job.buffers.append(buf)
+            for k, page_ids in enumerate(span.blocks):
+                job.scatters.append((
+                    buf[k * self.slot_bytes:(k + 1) * self.slot_bytes],
+                    list(page_ids),
+                ))
             job.nbytes += buf.nbytes
         self.io.seal_job(job_id)
         with self._lock:

@@ -1,0 +1,253 @@
+"""Multi-block offload files with unaligned head/tail spans.
+
+Counterpart of the reference's ``gpu_blocks_per_file > 1`` layout
+(``spec.py:76-89``) and its per-file block mapping with head offsets
+(``worker.py:187-255``): files hold N consecutive blocks in fixed slots;
+transfers may start and end mid-file.
+"""
+
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+from llmd_kv_cache_tpu.offload.worker import FileSpan, map_blocks_to_file_spans
+
+from tests.test_offload import make_caches, wait_results
+
+
+class TestSpanMapping:
+    def test_aligned_full_files(self):
+        spans = map_blocks_to_file_spans(
+            [11, 22], start_block_idx=0,
+            blocks=[[0], [1], [2], [3], [4], [5], [6], [7]],
+            blocks_per_file=4,
+        )
+        assert [(s.file_key, s.head_offset, len(s.blocks)) for s in spans] == [
+            (11, 0, 4), (22, 0, 4),
+        ]
+        assert spans[1].blocks == [[4], [5], [6], [7]]
+
+    def test_unaligned_head(self):
+        # range [2, 6) over 4-block files: head-partial file 0 (slots 2-3),
+        # then head of file 1 (slots 0-1).
+        spans = map_blocks_to_file_spans(
+            [11, 22], start_block_idx=2,
+            blocks=[[2], [3], [4], [5]], blocks_per_file=4,
+        )
+        assert [(s.file_key, s.head_offset, len(s.blocks)) for s in spans] == [
+            (11, 2, 2), (22, 0, 2),
+        ]
+
+    def test_unaligned_tail(self):
+        spans = map_blocks_to_file_spans(
+            [11], start_block_idx=4, blocks=[[0], [1]], blocks_per_file=4,
+        )
+        assert [(s.file_key, s.head_offset, len(s.blocks)) for s in spans] == [
+            (11, 0, 2),
+        ]
+
+    def test_mid_file_only(self):
+        spans = map_blocks_to_file_spans(
+            [11], start_block_idx=5, blocks=[[0], [1]], blocks_per_file=4,
+        )
+        assert [(s.file_key, s.head_offset, len(s.blocks)) for s in spans] == [
+            (11, 1, 2),
+        ]
+
+    def test_key_count_mismatch_raises(self):
+        with pytest.raises(ValueError, match="spans 2 files"):
+            map_blocks_to_file_spans(
+                [11], start_block_idx=2, blocks=[[0], [1], [2]],
+                blocks_per_file=4,
+            )
+
+    def test_empty(self):
+        assert map_blocks_to_file_spans([], 0, [], 4) == []
+
+
+def make_handlers(tmp_path, blocks_per_file=4, seed=0):
+    spec = SharedStorageOffloadSpec(
+        root=str(tmp_path), model_name="m", page_size=4,
+        num_layers=2, kv_heads=2, head_dim=8, io_threads=2,
+        blocks_per_file=blocks_per_file, pages_per_block=1,
+    )
+    k, v = make_caches(seed=seed)
+    return spec, spec.get_handlers(k, v)
+
+
+class TestMultiBlockRoundTrip:
+    def test_four_block_file_roundtrip(self, tmp_path):
+        spec, handlers = make_handlers(tmp_path)
+        try:
+            pages = [1, 2, 3, 4]
+            orig_k = np.asarray(handlers.copier.k_cache[:, pages])
+            span = FileSpan(file_key=0xF11E, head_offset=0,
+                            blocks=[[p] for p in pages])
+            res = wait_results(handlers, handlers.async_store_spans([span]))
+            assert res.success
+            # One file on disk holding all four slots.
+            path = handlers.mapper.block_path(0xF11E, 0)
+            import os
+            assert os.path.getsize(path) == handlers.file_bytes
+
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, pages].set(0)
+            handlers.copier.v_cache = handlers.copier.v_cache.at[:, pages].set(0)
+            res2 = wait_results(handlers, handlers.async_load_spans([span]))
+            assert res2.success
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, pages]), orig_k)
+        finally:
+            handlers.shutdown()
+
+    def test_partial_read_at_head_offset(self, tmp_path):
+        """Store a full 4-block file, then load only slots 2-3 (a read
+        starting at a nonzero byte offset into the file)."""
+        spec, handlers = make_handlers(tmp_path)
+        try:
+            pages = [1, 2, 3, 4]
+            orig_k = np.asarray(handlers.copier.k_cache[:, [3, 4]])
+            orig_v = np.asarray(handlers.copier.v_cache[:, [3, 4]])
+            full = FileSpan(file_key=0xF22E, head_offset=0,
+                            blocks=[[p] for p in pages])
+            assert wait_results(handlers, handlers.async_store_spans([full])).success
+
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, [3, 4]].set(0)
+            handlers.copier.v_cache = handlers.copier.v_cache.at[:, [3, 4]].set(0)
+            partial = FileSpan(file_key=0xF22E, head_offset=2,
+                               blocks=[[3], [4]])
+            res = wait_results(handlers, handlers.async_load_spans([partial]))
+            assert res.success
+            assert res.bytes_transferred == 2 * handlers.slot_bytes
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, [3, 4]]), orig_k)
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.v_cache[:, [3, 4]]), orig_v)
+        finally:
+            handlers.shutdown()
+
+    def test_split_spans_covering_file_store_atomically(self, tmp_path):
+        """One job may split a file across spans as long as their union
+        covers every slot; the file publishes once, fully written."""
+        spec, handlers = make_handlers(tmp_path)
+        try:
+            orig = {p: (np.asarray(handlers.copier.k_cache[:, [p]]),
+                        np.asarray(handlers.copier.v_cache[:, [p]]))
+                    for p in (1, 2, 3, 4)}
+            first = FileSpan(file_key=0xF33E, head_offset=0, blocks=[[1], [2]])
+            second = FileSpan(file_key=0xF33E, head_offset=2, blocks=[[3], [4]])
+            assert wait_results(
+                handlers, handlers.async_store_spans([second, first])).success
+
+            wipe = [1, 2, 3, 4]
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, wipe].set(0)
+            handlers.copier.v_cache = handlers.copier.v_cache.at[:, wipe].set(0)
+            full = FileSpan(file_key=0xF33E, head_offset=0,
+                            blocks=[[p] for p in wipe])
+            assert wait_results(handlers, handlers.async_load_spans([full])).success
+            for p, (ok, ov) in orig.items():
+                np.testing.assert_array_equal(
+                    np.asarray(handlers.copier.k_cache[:, [p]]), ok)
+                np.testing.assert_array_equal(
+                    np.asarray(handlers.copier.v_cache[:, [p]]), ov)
+        finally:
+            handlers.shutdown()
+
+    def test_partial_store_rejected(self, tmp_path):
+        """Stores that leave holes are refused: file existence is the
+        lookup predicate, so sparse files would serve zeros as hits."""
+        spec, handlers = make_handlers(tmp_path)
+        try:
+            with pytest.raises(ValueError, match="publish atomically"):
+                handlers.async_store_spans([
+                    FileSpan(file_key=0xF44E, head_offset=2,
+                             blocks=[[1], [2]])])
+            import os
+            assert not os.path.exists(handlers.mapper.block_path(0xF44E, 0))
+        finally:
+            handlers.shutdown()
+
+    def test_span_spanning_two_files_via_mapping(self, tmp_path):
+        """End-to-end through map_blocks_to_file_spans: logical range
+        [2, 6) over 4-block files -> tail of file A + head of file B."""
+        spec, handlers = make_handlers(tmp_path)
+        try:
+            # Pre-fill both files fully so partial loads have backing data.
+            a_pages, b_pages = [1, 2, 3, 4], [5, 6, 7, 8]
+            for key, pages in ((0xA, a_pages), (0xB, b_pages)):
+                span = FileSpan(file_key=key, head_offset=0,
+                                blocks=[[p] for p in pages])
+                assert wait_results(
+                    handlers, handlers.async_store_spans([span])).success
+
+            # Logical blocks 2..5 live in file A slots 2-3 + file B slots 0-1,
+            # holding pages 3,4,5,6.
+            target = [3, 4, 5, 6]
+            orig = np.asarray(handlers.copier.k_cache[:, target])
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, target].set(0)
+            handlers.copier.v_cache = handlers.copier.v_cache.at[:, target].set(0)
+            spans = map_blocks_to_file_spans(
+                [0xA, 0xB], start_block_idx=2,
+                blocks=[[p] for p in target], blocks_per_file=4,
+            )
+            assert wait_results(handlers, handlers.async_load_spans(spans)).success
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, target]), orig)
+        finally:
+            handlers.shutdown()
+
+    def test_bad_span_geometry_raises(self, tmp_path):
+        spec, handlers = make_handlers(tmp_path)
+        try:
+            with pytest.raises(ValueError, match="exceeds"):
+                handlers.async_store_spans([
+                    FileSpan(file_key=1, head_offset=3, blocks=[[1], [2]])])
+            with pytest.raises(ValueError, match="pages"):
+                handlers.async_store_spans([
+                    FileSpan(file_key=1, head_offset=0, blocks=[[1, 2]])])
+        finally:
+            handlers.shutdown()
+
+    def test_fingerprint_covers_file_geometry(self, tmp_path):
+        s1, h1 = make_handlers(tmp_path, blocks_per_file=1)
+        s4, h4 = make_handlers(tmp_path, blocks_per_file=4)
+        try:
+            # A bpf=1 deployment must not read bpf=4 files...
+            assert s1.build_mapper().fingerprint != s4.build_mapper().fingerprint
+            # ...nor may different slot sizes share a directory.
+            s4b = SharedStorageOffloadSpec(
+                root=str(tmp_path), model_name="m", page_size=4,
+                num_layers=2, kv_heads=2, head_dim=8,
+                blocks_per_file=4, pages_per_block=2,
+            )
+            assert s4.build_mapper().fingerprint != s4b.build_mapper().fingerprint
+        finally:
+            h1.shutdown()
+            h4.shutdown()
+
+
+class TestNativeWriteAt:
+    def test_write_at_primitive(self, tmp_path):
+        """The in-place range-write primitive (building block for future
+        multi-group slot layouts; not used by the atomic store path)."""
+        import os
+
+        from llmd_kv_cache_tpu.offload.native import NativeIOEngine
+        from tests.test_offload import wait_finished
+
+        engine = NativeIOEngine(num_threads=1)
+        try:
+            path = str(tmp_path / "multi.bin")
+            a = np.full(100, 1, dtype=np.uint8)
+            b = np.full(100, 2, dtype=np.uint8)
+            job = engine.begin_job()
+            assert engine.submit_write_at(job, path, a, offset=0, file_size=300)
+            assert engine.submit_write_at(job, path, b, offset=200, file_size=300)
+            engine.seal_job(job)
+            assert wait_finished(engine, job) == 0
+            assert os.path.getsize(path) == 300
+            out = np.fromfile(path, dtype=np.uint8)
+            np.testing.assert_array_equal(out[:100], a)
+            np.testing.assert_array_equal(out[200:], b)
+            assert (out[100:200] == 0).all()  # unwritten hole stays zero
+        finally:
+            engine.close()
