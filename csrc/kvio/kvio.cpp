@@ -135,8 +135,8 @@ bool WriteFileRangeAt(const std::string& path, const uint8_t* data,
   while (done < len) {
     ssize_t n = pwrite(fd, data + done, len - done,
                        static_cast<off_t>(offset + done));
-    if (n < 0) {
-      if (errno == EINTR) continue;
+    if (n <= 0) {  // n==0 would spin forever; treat as failure
+      if (n < 0 && errno == EINTR) continue;
       close(fd);
       return false;
     }

@@ -40,7 +40,9 @@ from .llama import (
     LlamaConfig,
     forward,
     forward_decode_pallas,
+    forward_hybrid,
     init_kv_cache,
+    init_kv_cache_hybrid,
     init_params,
 )
 
@@ -53,6 +55,12 @@ EventSink = Callable[[list[GenericEvent]], None]
 class EngineConfig:
     model: LlamaConfig = field(default_factory=LlamaConfig.tiny)
     num_pages: int = 512
+    # Hybrid models: size of the SWA group's separate page pool (None →
+    # num_pages). SWA pages are allocated just-in-time and reclaimed as
+    # slots fall out of the window, so per-request peak demand is
+    # window + prefill-chunk pages (+ the decode page), not prompt length —
+    # the memory win of hybrid attention.
+    num_swa_pages: Optional[int] = None
     max_pages_per_seq: int = 64
     max_batch: int = 8
     hash_seed: str = ""
@@ -85,6 +93,10 @@ class Request:
     # runtime state
     output: list[int] = field(default_factory=list)
     pages: list[int] = field(default_factory=list)  # physical pages, logical order
+    swa_pages: list[int] = field(default_factory=list)  # hybrid: group 1 pages
+    # Hybrid: first logical block whose SWA page this request references
+    # (earlier slots map to the garbage page — out of window at resume).
+    swa_acquired_from: int = 0
     block_hashes: list[int] = field(default_factory=list)  # hash-chained, per full block
     cached_len: int = 0  # tokens skipped via prefix cache at admission
     computed_len: int = 0  # tokens with KV resident (cached + prefilled + decoded)
@@ -105,27 +117,37 @@ class BlockManager:
     """
 
     def __init__(self, cfg: EngineConfig, processor: ChunkedTokenDatabase,
-                 event_sink: Optional[EventSink] = None):
+                 event_sink: Optional[EventSink] = None, group_idx: int = 0,
+                 num_pages: Optional[int] = None,
+                 spec_kind: Optional[str] = None,
+                 spec_window: Optional[int] = None):
         self.cfg = cfg
         self.processor = processor
         self.event_sink = event_sink
-        self.free_pages: list[int] = list(range(1, cfg.num_pages))  # 0 reserved
+        self.group_idx = group_idx
+        pool = num_pages if num_pages is not None else cfg.num_pages
+        self.free_pages: list[int] = list(range(1, pool))  # 0 reserved
         self.blocks: dict[int, _BlockInfo] = {}  # block_hash → info
         self.page_to_hash: dict[int, int] = {}
-        # KV-cache spec advertised in events (HMA group 0). The pool is
-        # unified across layers, so the spec is sliding_window only when
-        # every layer is SWA; any full-attention layer makes full retention
-        # the controlling constraint.
-        mcfg = cfg.model
-        if (
-            mcfg.sliding_window is not None
-            and set(mcfg.swa_layers) >= set(range(mcfg.num_layers))
-        ):
-            self.spec_kind = SPEC_SLIDING_WINDOW
-            self.spec_window: Optional[int] = mcfg.sliding_window
+        if spec_kind is not None:
+            self.spec_kind = spec_kind
+            self.spec_window = spec_window
         else:
-            self.spec_kind = SPEC_FULL_ATTENTION
-            self.spec_window = None
+            # KV-cache spec advertised in events. A unified (single-group)
+            # pool is sliding_window only when every layer is SWA; any
+            # full-attention layer makes full retention the controlling
+            # constraint. Hybrid engines construct one manager per group
+            # with explicit specs instead.
+            mcfg = cfg.model
+            if (
+                mcfg.sliding_window is not None
+                and set(mcfg.swa_layers) >= set(range(mcfg.num_layers))
+            ):
+                self.spec_kind = SPEC_SLIDING_WINDOW
+                self.spec_window = mcfg.sliding_window
+            else:
+                self.spec_kind = SPEC_FULL_ATTENTION
+                self.spec_window = None
 
     # -- accounting --
 
@@ -161,6 +183,21 @@ class BlockManager:
             info.last_used = now
         return pages
 
+    def try_acquire_blocks(self, block_hashes: Sequence[int]) -> Optional[list[int]]:
+        """All-or-nothing reference of specific blocks (SWA trailing-window
+        acquisition: the needed set is a window, not a prefix)."""
+        infos = []
+        for h in block_hashes:
+            info = self.blocks.get(h)
+            if info is None:
+                return None
+            infos.append(info)
+        now = time.monotonic()
+        for info in infos:
+            info.ref_count += 1
+            info.last_used = now
+        return [info.page for info in infos]
+
     def allocate_page(self) -> Optional[int]:
         """Pop a free page, evicting LRU unreferenced blocks if needed."""
         if not self.free_pages and not self._evict_one():
@@ -182,7 +219,8 @@ class BlockManager:
         # Must carry the same group tag as the BlockStored that created the
         # entry, or the index's entry-match eviction is a silent no-op.
         self._emit([
-            BlockRemovedEvent(block_hashes=[victim_hash], group_idx=0)
+            BlockRemovedEvent(block_hashes=[victim_hash],
+                              group_idx=self.group_idx)
         ])
         return True
 
@@ -221,7 +259,7 @@ class BlockManager:
                         tokens=list(run_tokens),
                         parent_hash=run_parent,
                         block_size=self.processor.block_size,
-                        group_idx=0,
+                        group_idx=self.group_idx,
                         kv_cache_spec_kind=self.spec_kind,
                         kv_cache_spec_sliding_window=self.spec_window,
                     )
@@ -262,13 +300,46 @@ class BlockManager:
                 info.ref_count -= 1
         self.free_pages.extend(orphan_pages)
 
-    def clear(self) -> None:
-        """Drop the whole prefix cache (weight rollout) and emit the reset."""
+    def release_dropping(self, block_hashes: Sequence[int]) -> None:
+        """Release references AND immediately evict now-unreferenced
+        blocks (freeing their pages, emitting BlockRemoved).
+
+        For SWA groups: blocks that fell out of every holder's trailing
+        window are worthless for any future resume, so caching them would
+        only burn pool space and advertise false residency to the index.
+        Blocks still referenced by other requests survive untouched.
+        """
+        removed: list[int] = []
+        for h in block_hashes:
+            info = self.blocks.get(h)
+            if info is None:
+                continue
+            if info.ref_count > 0:
+                info.ref_count -= 1
+            if info.ref_count == 0:
+                self.blocks.pop(h)
+                self.page_to_hash.pop(info.page, None)
+                self.free_pages.append(info.page)
+                removed.append(h)
+        if removed:
+            self._emit([
+                BlockRemovedEvent(block_hashes=removed,
+                                  group_idx=self.group_idx)
+            ])
+
+    def clear(self, emit: bool = True) -> None:
+        """Drop the whole prefix cache (weight rollout) and emit the reset.
+
+        AllBlocksCleared is pod-wide (clears every group at the index), so
+        a hybrid engine emits it from one manager only (``emit=False`` on
+        the other).
+        """
         for info in self.blocks.values():
             self.free_pages.append(info.page)
         self.blocks.clear()
         self.page_to_hash.clear()
-        self._emit([AllBlocksClearedEvent()])
+        if emit:
+            self._emit([AllBlocksClearedEvent()])
 
 
 class MiniEngine:
@@ -291,13 +362,39 @@ class MiniEngine:
                 block_size_tokens=mcfg.page_size, hash_seed=self.cfg.hash_seed
             )
         )
-        self.block_manager = BlockManager(self.cfg, self.processor, event_sink)
+        # Hybrid (mixed full/SWA layers): two cache groups with separate
+        # page pools and block managers; events carry group tags + specs so
+        # the indexer's GroupCatalog and HybridAwareScorer see the real
+        # layout (reference hma.go:32-66 from the producer side).
+        self.hybrid = mcfg.is_hybrid
         self.params = params if params is not None else init_params(
             jax.random.PRNGKey(seed), mcfg
         )
-        self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
         self.requests: dict[str, Request] = {}
         self._running: list[str] = []
+        self.swa_manager: Optional[BlockManager] = None
+        self.k_swa = self.v_swa = None
+        if self.hybrid:
+            if offload_spec is not None:
+                raise NotImplementedError(
+                    "shared-storage offload is single-group; disable it for "
+                    "hybrid models")
+            num_swa = self.cfg.num_swa_pages or self.cfg.num_pages
+            self.block_manager = BlockManager(
+                self.cfg, self.processor, event_sink, group_idx=0,
+                spec_kind=SPEC_FULL_ATTENTION, spec_window=None,
+            )
+            self.swa_manager = BlockManager(
+                self.cfg, self.processor, event_sink, group_idx=1,
+                num_pages=num_swa, spec_kind=SPEC_SLIDING_WINDOW,
+                spec_window=mcfg.sliding_window,
+            )
+            self.k_cache, self.v_cache, self.k_swa, self.v_swa = (
+                init_kv_cache_hybrid(mcfg, self.cfg.num_pages, num_swa)
+            )
+        else:
+            self.block_manager = BlockManager(self.cfg, self.processor, event_sink)
+            self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
 
         # Resolve the decode attention backend once (the platform cannot
         # change over the engine's lifetime).
@@ -305,6 +402,13 @@ class MiniEngine:
         on_tpu = jax.devices()[0].platform == "tpu"
         if use_pallas is None:
             use_pallas = on_tpu
+        if self.hybrid:
+            # Grouped caches decode through the XLA hybrid path; the Pallas
+            # flash-decode kernel is single-pool.
+            if use_pallas and self.cfg.use_pallas_decode:
+                logger.warning("hybrid model: Pallas decode unavailable, "
+                               "using XLA paged attention")
+            use_pallas = False
         if use_pallas:
             self._decode_forward = functools.partial(
                 forward_decode_pallas, interpret=not on_tpu
@@ -350,6 +454,31 @@ class MiniEngine:
         )
 
         cached_pages = self.block_manager.acquire_prefix(req.block_hashes)
+        if self.hybrid:
+            # A resume at depth d needs group 0's FULL chain [0, d) but
+            # only group 1's trailing window — blocks covering the last
+            # ``sliding_window`` tokens (earlier SWA blocks are dropped
+            # out-of-window and never needed again). Find the deepest d
+            # whose trailing SWA window is resident; out-of-window slots
+            # map to the garbage page (attention masks them anyway).
+            page_sz = self.cfg.model.page_size
+            window = self.cfg.model.sliding_window
+            d = len(cached_pages)
+            swa_map: dict[int, int] = {}
+            start_blk = 0
+            while d > 0:
+                start_blk = max(0, (d * page_sz - window) // page_sz)
+                pages = self.swa_manager.try_acquire_blocks(
+                    req.block_hashes[start_blk:d])
+                if pages is not None:
+                    swa_map = dict(zip(range(start_blk, d), pages))
+                    break
+                d -= 1
+            if d < len(cached_pages):
+                self.block_manager.release(req.block_hashes[d:len(cached_pages)], [])
+            cached_pages = cached_pages[:d]
+            req.swa_pages = [swa_map.get(i, 0) for i in range(d)]
+            req.swa_acquired_from = start_blk if d > 0 else 0
         req.pages = list(cached_pages)
         req.cached_len = len(cached_pages) * page_size
         req.computed_len = req.cached_len
@@ -360,19 +489,29 @@ class MiniEngine:
         if self.offload_manager is not None:
             self._restore_from_storage(req)
 
-        # Pages for the uncached remainder (incl. partial tail + decode room)
+        # Pages for the uncached remainder (incl. partial tail + decode
+        # room). Group 1 (SWA) pages are NOT pre-allocated: _prefill and
+        # decode allocate them lazily per chunk and reclaim out-of-window
+        # slots as the context advances, so peak SWA-pool demand stays
+        # window-bounded instead of prompt-length-bounded.
         new_pages: list[int] = []
+
+        def rollback():
+            # Return popped pages and drop the refs on every block this
+            # request holds — the HBM prefix AND any blocks just restored
+            # from storage — so a failed admission cannot shrink the pool
+            # or pin blocks against eviction.
+            n_cached = req.cached_len // page_size
+            self.block_manager.free_pages.extend(new_pages)
+            self.block_manager.release(req.block_hashes[:n_cached], [])
+            if self.hybrid:
+                self.swa_manager.release(
+                    req.block_hashes[req.swa_acquired_from:n_cached], [])
+
         while len(req.pages) + len(new_pages) < total_needed:
             page = self.block_manager.allocate_page()
             if page is None:
-                # Roll back: return popped pages and drop the refs on every
-                # block this request holds — the HBM prefix AND any blocks
-                # just restored from storage — so a failed admission cannot
-                # shrink the pool or pin blocks against eviction.
-                self.block_manager.free_pages.extend(new_pages)
-                self.block_manager.release(
-                    req.block_hashes[: req.cached_len // page_size], []
-                )
+                rollback()
                 raise RuntimeError("out of KV pages")
             new_pages.append(page)
         req.pages.extend(new_pages)
@@ -469,6 +608,53 @@ class MiniEngine:
         table[: len(req.pages)] = req.pages
         return table
 
+    def _swa_table_for(self, req: Request) -> np.ndarray:
+        table = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
+        table[: len(req.swa_pages)] = req.swa_pages
+        return table
+
+    def _swa_ensure(self, req: Request, upto_block: int) -> None:
+        """Lazily extend the request's SWA page list through ``upto_block``
+        (inclusive). SWA pages are allocated just-in-time so peak pool
+        demand is window + chunk, not prompt length."""
+        while len(req.swa_pages) <= upto_block:
+            page = self.swa_manager.allocate_page()
+            if page is None:
+                raise RuntimeError("out of SWA KV pages")
+            req.swa_pages.append(page)
+
+    def _swa_reclaim(self, req: Request) -> None:
+        """Return the request's out-of-window SWA pages to the pool.
+
+        Slots below the current window start are never read again by this
+        request (attention masks them): committed blocks drop this
+        request's reference (evicted + BlockRemoved once unreferenced),
+        private not-yet-committed pages free directly. Reclaimed slots map
+        to the garbage page.
+        """
+        page_size = self.cfg.model.page_size
+        window = self.cfg.model.sliding_window
+        first_in_window = max(0, req.computed_len - window) // page_size
+        start = req.swa_acquired_from
+        limit = min(first_in_window, len(req.swa_pages))
+        if limit <= start:
+            return
+        committed: list[int] = []
+        for i in range(start, limit):
+            page = req.swa_pages[i]
+            if not page:
+                continue
+            h = req.block_hashes[i] if i < len(req.block_hashes) else None
+            info = self.swa_manager.blocks.get(h) if h is not None else None
+            if info is not None and info.page == page:
+                committed.append(h)
+            else:
+                self.swa_manager.free_pages.append(page)
+            req.swa_pages[i] = 0
+        if committed:
+            self.swa_manager.release_dropping(committed)
+        req.swa_acquired_from = limit
+
     def _prefill(self, req: Request) -> None:
         """Run the model over the uncached prompt suffix, chunked.
 
@@ -497,14 +683,32 @@ class MiniEngine:
             tokens = np.zeros((1, seq), np.int32)
             tokens[0, : len(chunk)] = chunk
 
-            logits, self.k_cache, self.v_cache = forward(
-                self.params, self.cfg.model,
-                jnp.asarray(tokens),
-                self.k_cache, self.v_cache,
-                table,
-                jnp.asarray([pos], jnp.int32),
-                jnp.asarray([len(chunk)], jnp.int32),
-            )
+            if self.hybrid:
+                # SWA pages arrive just-in-time for this chunk's blocks and
+                # out-of-window slots return to the pool after it, so a
+                # long prompt's peak SWA demand is window + chunk.
+                self._swa_ensure(req, (pos + len(chunk) - 1) // page_size)
+                swa_table = jnp.asarray(self._swa_table_for(req))[None, :]
+                (logits, self.k_cache, self.v_cache,
+                 self.k_swa, self.v_swa) = forward_hybrid(
+                    self.params, self.cfg.model,
+                    jnp.asarray(tokens),
+                    self.k_cache, self.v_cache, self.k_swa, self.v_swa,
+                    table, swa_table,
+                    jnp.asarray([pos], jnp.int32),
+                    jnp.asarray([len(chunk)], jnp.int32),
+                )
+                req.computed_len = pos + len(chunk)
+                self._swa_reclaim(req)
+            else:
+                logits, self.k_cache, self.v_cache = forward(
+                    self.params, self.cfg.model,
+                    jnp.asarray(tokens),
+                    self.k_cache, self.v_cache,
+                    table,
+                    jnp.asarray([pos], jnp.int32),
+                    jnp.asarray([len(chunk)], jnp.int32),
+                )
             req.last_logits = np.asarray(logits[0, len(chunk) - 1])
             pos += len(chunk)
         req.computed_len = len(req.prompt)
@@ -530,6 +734,24 @@ class MiniEngine:
         )
         # Adopt canonical pages (duplicates swapped to the resident copy).
         req.pages[first_new:n_full] = canonical
+        if self.hybrid:
+            # Commit only slots still holding pages: blocks that already
+            # fell out of the window were reclaimed mid-prefill and are
+            # gone from group 1 by design.
+            swa_first = max(first_new, req.swa_acquired_from)
+            if swa_first < n_full:
+                swa_parent = (
+                    req.block_hashes[swa_first - 1] if swa_first > 0
+                    else EMPTY_BLOCK_HASH
+                )
+                swa_canonical = self.swa_manager.commit_blocks(
+                    req.block_hashes[swa_first:n_full],
+                    req.swa_pages[swa_first:n_full],
+                    [req.prompt[i * page_size:(i + 1) * page_size]
+                     for i in range(swa_first, n_full)],
+                    swa_parent,
+                )
+                req.swa_pages[swa_first:n_full] = swa_canonical
 
         # Write-through to the storage tier (async; writes may be shed under
         # pressure, degrading to future cache misses).
@@ -625,6 +847,7 @@ class MiniEngine:
         ctx = np.zeros((b,), np.int32)
         new_lens = np.zeros((b,), np.int32)
         tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
+        swa_tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
         for i, req in enumerate(chunk):
             last = (req.output[-1] if req.output else req.prompt[-1])
             tokens[i, 0] = last
@@ -633,14 +856,31 @@ class MiniEngine:
             ctx[i] = req.computed_len
             new_lens[i] = 1
             tables[i] = self._page_table_for(req)
+            if self.hybrid:
+                # The new token's KV writes at block computed_len//page —
+                # make sure that SWA slot has a live page.
+                self._swa_ensure(
+                    req, req.computed_len // self.cfg.model.page_size)
+                swa_tables[i] = self._swa_table_for(req)
 
-        logits, self.k_cache, self.v_cache = self._decode_forward(
-            self.params, self.cfg.model,
-            jnp.asarray(tokens), self.k_cache, self.v_cache,
-            jnp.asarray(tables),
-            jnp.asarray(ctx, jnp.int32),
-            jnp.asarray(new_lens),
-        )
+        if self.hybrid:
+            (logits, self.k_cache, self.v_cache,
+             self.k_swa, self.v_swa) = forward_hybrid(
+                self.params, self.cfg.model,
+                jnp.asarray(tokens),
+                self.k_cache, self.v_cache, self.k_swa, self.v_swa,
+                jnp.asarray(tables), jnp.asarray(swa_tables),
+                jnp.asarray(ctx, jnp.int32),
+                jnp.asarray(new_lens),
+            )
+        else:
+            logits, self.k_cache, self.v_cache = self._decode_forward(
+                self.params, self.cfg.model,
+                jnp.asarray(tokens), self.k_cache, self.v_cache,
+                jnp.asarray(tables),
+                jnp.asarray(ctx, jnp.int32),
+                jnp.asarray(new_lens),
+            )
         out = {}
         next_tokens = np.asarray(jnp.argmax(logits[:, 0], axis=-1))
         for i, req in enumerate(chunk):
@@ -650,6 +890,8 @@ class MiniEngine:
             out[req.request_id] = tok
             if len(req.output) >= req.max_new_tokens:
                 req.done = True
+            if self.hybrid:
+                self._swa_reclaim(req)
         return out
 
     def _release(self, req: Request) -> None:
@@ -658,6 +900,23 @@ class MiniEngine:
         hashed_pages = set(req.pages[:n_hashed])
         orphans = [p for p in req.pages[n_hashed:] if p not in hashed_pages]
         self.block_manager.release(req.block_hashes[:n_hashed], orphans)
+        if self.hybrid:
+            # SWA group: this request references blocks from
+            # swa_acquired_from onward (earlier slots were garbage-mapped).
+            # Blocks wholly outside the trailing window of the final
+            # context are worthless for any resume — drop them now (freeing
+            # pool space, emitting BlockRemoved so the index stops
+            # advertising them); in-window blocks stay cached for reuse.
+            window = self.cfg.model.sliding_window
+            first_in_window = max(0, req.total_len - window) // page_size
+            start = req.swa_acquired_from
+            split = max(start, first_in_window)
+            swa_hashed_pages = set(req.swa_pages[:n_hashed])
+            swa_orphans = [p for p in req.swa_pages[n_hashed:]
+                           if p and p not in swa_hashed_pages]
+            self.swa_manager.release_dropping(req.block_hashes[start:split])
+            self.swa_manager.release(
+                req.block_hashes[split:n_hashed], swa_orphans)
 
     # -- lifecycle --
 
@@ -689,6 +948,8 @@ class MiniEngine:
             req.done = True
             self._finish(req)
         self.block_manager.clear()
+        if self.hybrid:
+            self.swa_manager.clear(emit=False)
 
     def generate(self, request_id: str, prompt: Sequence[int],
                  max_new_tokens: int = 16) -> list[int]:

@@ -61,6 +61,27 @@ class LlamaConfig:
             return self.sliding_window
         return None
 
+    @property
+    def is_hybrid(self) -> bool:
+        """Mixed full-attention and SWA layers → two KV-cache groups with
+        separate page pools (vLLM's hybrid memory allocator model,
+        reference ``hma.go:32-66``)."""
+        if self.sliding_window is None or not self.swa_layers:
+            return False
+        swa = set(self.swa_layers) & set(range(self.num_layers))
+        return bool(swa) and swa != set(range(self.num_layers))
+
+    def group_layers(self, group_idx: int) -> tuple:
+        """Layer indices of a cache group: group 0 = full attention,
+        group 1 = sliding window (hybrid models only)."""
+        swa = set(self.swa_layers) if self.sliding_window is not None else set()
+        if group_idx == 0:
+            return tuple(li for li in range(self.num_layers) if li not in swa)
+        return tuple(li for li in range(self.num_layers) if li in swa)
+
+    def layer_group(self, layer_idx: int) -> int:
+        return 1 if (self.is_hybrid and layer_idx in self.swa_layers) else 0
+
     @classmethod
     def tiny(cls) -> "LlamaConfig":
         """Test-sized config (fast CPU compile)."""
@@ -119,6 +140,28 @@ def init_kv_cache(cfg: LlamaConfig, num_pages: int) -> tuple[jax.Array, jax.Arra
     """Allocate the paged K and V pools: ``[layers, pages, page, kvh, hd]``."""
     shape = (cfg.num_layers, num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def init_kv_cache_hybrid(
+    cfg: LlamaConfig, num_pages: int, num_swa_pages: int
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Allocate separate page pools for a hybrid model's two cache groups:
+    ``(k0, v0, k1, v1)`` with group 0 = full-attention layers (num_pages)
+    and group 1 = SWA layers (num_swa_pages — window-bounded, so typically
+    much smaller; this is the memory win of hybrid attention)."""
+    if not cfg.is_hybrid:
+        raise ValueError("init_kv_cache_hybrid needs a hybrid config")
+
+    def shape(group, pages):
+        return (len(cfg.group_layers(group)), pages, cfg.page_size,
+                cfg.num_kv_heads, cfg.head_dim)
+
+    return (
+        jnp.zeros(shape(0, num_pages), cfg.dtype),
+        jnp.zeros(shape(0, num_pages), cfg.dtype),
+        jnp.zeros(shape(1, num_swa_pages), cfg.dtype),
+        jnp.zeros(shape(1, num_swa_pages), cfg.dtype),
+    )
 
 
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
@@ -187,18 +230,34 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     ).astype(x.dtype)
 
 
-def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
-                  ctx_lens, new_lens, attention_fn):
-    """Shared transformer body; ``attention_fn(q, k_l, v_l, page_table,
-    positions, total_lens) -> [b, seq, heads, hd]`` picks the backend."""
+def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
+                          ctx_lens, new_lens, attention_fn):
+    """Shared transformer body over grouped KV pools.
+
+    ``k_caches[g]`` holds group g's layers stacked in ``cfg.group_layers(g)``
+    order with its own page pool; ``tables[g]`` is that pool's page table.
+    The non-hybrid case is the 1-tuple degenerate form. ``attention_fn(q,
+    k_l, v_l, page_table, positions, total_lens, window) -> [b, seq, heads,
+    hd]`` picks the backend.
+    """
     batch, seq = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
     valid = jnp.arange(seq)[None, :] < new_lens[:, None]
     total_lens = ctx_lens + new_lens
 
+    # Static layer→(group, local index) map, resolved at trace time.
+    local_idx = {}
+    for g in range(len(k_caches)):
+        for j, li in enumerate(cfg.group_layers(g)):
+            local_idx[li] = (g, j)
+
     x = params["embed"][tokens]  # [b, s, h]
 
+    k_caches = list(k_caches)
+    v_caches = list(v_caches)
     for li, layer in enumerate(params["layers"]):
+        g, lj = local_idx[li] if len(k_caches) > 1 else (0, li)
+        table = tables[g]
         attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = attn_in @ layer["wq"]
         k = attn_in @ layer["wk"]
@@ -209,15 +268,15 @@ def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        k_cache = k_cache.at[li].set(
-            scatter_kv_pages(k_cache[li], k, page_table, positions, valid)
+        k_caches[g] = k_caches[g].at[lj].set(
+            scatter_kv_pages(k_caches[g][lj], k, table, positions, valid)
         )
-        v_cache = v_cache.at[li].set(
-            scatter_kv_pages(v_cache[li], v, page_table, positions, valid)
+        v_caches[g] = v_caches[g].at[lj].set(
+            scatter_kv_pages(v_caches[g][lj], v, table, positions, valid)
         )
 
         attn = attention_fn(
-            q, k_cache[li], v_cache[li], page_table, positions, total_lens,
+            q, k_caches[g][lj], v_caches[g][lj], table, positions, total_lens,
             cfg.layer_window(li),
         )
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
@@ -227,7 +286,16 @@ def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, k_cache, v_cache
+    return logits, tuple(k_caches), tuple(v_caches)
+
+
+def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
+                  ctx_lens, new_lens, attention_fn):
+    logits, ks, vs = _forward_impl_grouped(
+        params, cfg, tokens, (k_cache,), (v_cache,), (page_table,),
+        ctx_lens, new_lens, attention_fn,
+    )
+    return logits, ks[0], vs[0]
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
@@ -257,6 +325,35 @@ def forward(
         params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
         xla_attention,
     )
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("k0", "v0", "k1", "v1"))
+def forward_hybrid(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,   # [batch, seq] int32 (padded)
+    k0: jax.Array,       # group 0 (full attention): [g0_layers, pages, p, kvh, hd]
+    v0: jax.Array,
+    k1: jax.Array,       # group 1 (SWA): [g1_layers, swa_pages, p, kvh, hd]
+    v1: jax.Array,
+    table0: jax.Array,   # [batch, pages_per_seq] into group 0's pool
+    table1: jax.Array,   # [batch, pages_per_seq] into group 1's pool
+    ctx_lens: jax.Array,
+    new_lens: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One model step for a hybrid (mixed full/SWA) model over two
+    separately-paged cache groups. XLA attention backend."""
+    def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
+        return paged_attention(
+            q, k_l, v_l, table, positions, total_lens, sliding_window=window
+        )
+
+    logits, ks, vs = _forward_impl_grouped(
+        params, cfg, tokens, (k0, k1), (v0, v1), (table0, table1),
+        ctx_lens, new_lens, xla_attention,
+    )
+    return logits, ks[0], vs[0], ks[1], vs[1]
 
 
 @partial(

@@ -69,7 +69,11 @@ class FileMapper:
             "head_dim": c.head_dim,
             "num_layers": c.num_layers,
             "pages_per_file": c.pages_per_file,
-            "pages_per_block": c.pages_per_block,
+            # Only when non-default: a (N,1) store's on-disk layout is
+            # byte-identical to the pre-pages_per_block format, and existing
+            # deployments must keep resolving to the same directory.
+            **({"pages_per_block": c.pages_per_block}
+               if c.pages_per_block != 1 else {}),
             "engine": c.engine,
             **({k: v for k, v in sorted(c.mesh_sizes.items())}
                if not c.parallel_agnostic else {}),
@@ -107,6 +111,7 @@ class FileMapper:
                     "head_dim": c.head_dim,
                     "num_layers": c.num_layers,
                     "pages_per_file": c.pages_per_file,
+                    "pages_per_block": c.pages_per_block,
                     "engine": c.engine,
                     "mesh_sizes": c.mesh_sizes,
                     "fingerprint": self._fingerprint,

@@ -302,6 +302,9 @@ class OffloadHandlers:
                 for s in slabs
             ]
             buf = flat[0] if len(flat) == 1 else np.concatenate(flat)
+            assert buf.nbytes == self.file_bytes, (
+                f"file {file_key:#x}: assembled {buf.nbytes} B, layout "
+                f"expects {self.file_bytes} B")
             queued = self.io.submit_write(
                 job_id,
                 self.mapper.block_path(file_key, group_idx),
