@@ -13,6 +13,7 @@ token step is one XLA program; donate the caches for in-place updates.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -48,6 +49,14 @@ class LlamaConfig:
     # the ``ep`` mesh axis.
     num_experts: int = 0
     num_experts_per_token: int = 2
+    # Expert compute: "capacity" = GShard-style top-k dispatch into fixed
+    # per-expert buffers of ceil(T·k/E · capacity_factor) tokens — compute
+    # scales with tokens, not num_experts; overflow tokens lose their MoE
+    # contribution (residual passes through). "dense" = every expert over
+    # every token with a one-hot mix (exact, O(E) compute; useful as the
+    # reference formulation and for tiny models).
+    moe_dispatch: str = "capacity"
+    moe_capacity_factor: float = 2.0
 
     def __post_init__(self):
         if self.num_experts > 0 and self.num_experts_per_token > self.num_experts:
@@ -170,46 +179,111 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
 
 
+def _moe_router(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
+                aux_out: Any):
+    """Shared routing: top-k expert choices + softmaxed weights, and the
+    Switch-style load-balancing term ``E·Σ_e f_e·P_e`` appended to
+    ``aux_out`` (training; None skips it)."""
+    e = cfg.num_experts
+    k = cfg.num_experts_per_token
+    router_logits = (
+        mlp_in @ layer["router"].astype(mlp_in.dtype)
+    ).astype(jnp.float32)  # [b,s,E]
+    top_w, top_idx = jax.lax.top_k(router_logits, k)  # [b,s,k]
+    weights = jax.nn.softmax(top_w, axis=-1)
+    onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [b,s,k,E]
+    if aux_out is not None:
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
+        f = jnp.mean(jnp.sum(onehot, axis=2) / k, axis=(0, 1))  # [E]
+        p = jnp.mean(probs, axis=(0, 1))  # [E]
+        aux_out.append(e * jnp.sum(f * p))
+    return top_idx, weights, onehot
+
+
+def _moe_dense(mlp_in, layer, cfg, aux_out):
+    """Reference formulation: every expert over every token, one-hot mix.
+    Exact but O(num_experts) compute."""
+    _top_idx, weights, onehot = _moe_router(mlp_in, layer, cfg, aux_out)
+    # bf16 matmuls, f32 activation math (mirrors the dense branch).
+    gate = jax.nn.silu(jnp.einsum(
+        "bsh,ehi->bsei", mlp_in, layer["w_gate"]
+    ).astype(jnp.float32))
+    up = jnp.einsum("bsh,ehi->bsei", mlp_in, layer["w_up"]).astype(jnp.float32)
+    expert_out = jnp.einsum(
+        "bsei,eih->bseh", (gate * up).astype(mlp_in.dtype), layer["w_down"]
+    ).astype(jnp.float32)
+    mix = jnp.einsum("bsk,bske,bseh->bsh", weights, onehot, expert_out)
+    return mix.astype(mlp_in.dtype)
+
+
+def _moe_capacity(mlp_in, layer, cfg, aux_out, valid=None):
+    """GShard/Switch-style capacity dispatch: tokens scatter into fixed
+    per-expert buffers of C = ceil(T·k/E · capacity_factor) slots via
+    one-hot einsums (static shapes, XLA/MXU-friendly), experts run on
+    [E, C, H], results combine back weighted. Compute scales with
+    T·k·capacity_factor — independent of num_experts — at the cost of
+    dropping assignments past an expert's capacity (earlier tokens win;
+    dropped assignments contribute nothing, the residual passes through).
+    Experts (and their buffers) shard over the ``ep`` mesh axis.
+
+    ``valid`` ([b, s] bool, optional): padded positions are excluded from
+    routing so they can never consume capacity slots that real tokens
+    need (attention masks them, the router would not).
+    """
+    batch, seq, hidden = mlp_in.shape
+    e = cfg.num_experts
+    k = cfg.num_experts_per_token
+    t = batch * seq
+    top_idx, weights, _onehot = _moe_router(mlp_in, layer, cfg, aux_out)
+
+    capacity = max(1, math.ceil(t * k * cfg.moe_capacity_factor / e))
+    x = mlp_in.reshape(t, hidden)
+    # Assignment axis a = (token, choice), token-major: earlier tokens win
+    # capacity slots.
+    oh = jax.nn.one_hot(top_idx.reshape(t * k), e, dtype=jnp.int32)  # [A,E]
+    if valid is not None:
+        mask = valid.reshape(t).astype(jnp.int32)
+        oh = oh * jnp.repeat(mask, k)[:, None]
+    pos_a = jnp.sum((jnp.cumsum(oh, axis=0) - oh) * oh, axis=1)      # [A]
+    # one_hot zeroes out-of-range rows, so over-capacity assignments (and
+    # masked tokens, whose oh row is zero) drop out of the dispatch.
+    pos_oh = jax.nn.one_hot(pos_a, capacity, dtype=mlp_in.dtype)     # [A,C]
+    oh_tk = oh.astype(mlp_in.dtype).reshape(t, k, e)
+    pos_tk = pos_oh.reshape(t, k, capacity)
+    # A token's k assignments land in distinct (expert, slot) cells, so
+    # summing the choice axis gives a lossless [T,E,C] dispatch — no
+    # k-times-repeated activations.
+    disp = jnp.einsum("tke,tkc->tec", oh_tk, pos_tk)                 # [T,E,C]
+
+    buf = jnp.einsum("tec,th->ech", disp, x)                         # [E,C,H]
+    gate = jax.nn.silu(
+        jnp.einsum("ech,ehi->eci", buf, layer["w_gate"]).astype(jnp.float32))
+    up = jnp.einsum("ech,ehi->eci", buf, layer["w_up"]).astype(jnp.float32)
+    expert_out = jnp.einsum(
+        "eci,eih->ech", (gate * up).astype(mlp_in.dtype), layer["w_down"]
+    ).astype(jnp.float32)
+    combine = jnp.einsum(
+        "tke,tkc,tk->tec", oh_tk.astype(jnp.float32),
+        pos_tk.astype(jnp.float32), weights.reshape(t, k))
+    y = jnp.einsum("tec,ech->th", combine, expert_out)               # [T,H]
+    return y.reshape(batch, seq, hidden).astype(mlp_in.dtype)
+
+
 def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
-         aux_out: Any = None) -> jax.Array:
-    """MLP block: dense SwiGLU or Mixtral-style top-k MoE.
+         aux_out: Any = None, valid: Any = None) -> jax.Array:
+    """MLP block: dense SwiGLU or top-k MoE (capacity dispatch by default,
+    dense reference formulation via ``cfg.moe_dispatch="dense"``).
 
-    The MoE path computes all experts densely and mixes with a top-k
-    one-hot — the XLA-friendly reference formulation (static shapes, no
-    ragged dispatch); a capacity-based dispatch kernel is the later
-    optimization. Expert matmuls stay in the model dtype (bf16 MXU path,
-    like the dense branch); only router/softmax/mix math runs in f32.
-    Experts shard over the ``ep`` mesh axis.
-
-    ``aux_out``: a list to which the Switch-style load-balancing term
-    ``E·Σ_e f_e·P_e`` is appended (training); None skips it.
+    Expert matmuls stay in the model dtype (bf16 MXU path, like the dense
+    branch); only router/softmax/mix math runs in f32. ``valid`` ([b, s]
+    bool) excludes padded positions from capacity routing.
     """
     if cfg.num_experts > 0:
-        e = cfg.num_experts
-        k = cfg.num_experts_per_token
-        router_logits = (
-            mlp_in @ layer["router"].astype(mlp_in.dtype)
-        ).astype(jnp.float32)  # [b,s,E]
-        top_w, top_idx = jax.lax.top_k(router_logits, k)  # [b,s,k]
-        weights = jax.nn.softmax(top_w, axis=-1)
-        onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [b,s,k,E]
-
-        if aux_out is not None:
-            probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
-            f = jnp.mean(jnp.sum(onehot, axis=2) / k, axis=(0, 1))  # [E]
-            p = jnp.mean(probs, axis=(0, 1))  # [E]
-            aux_out.append(e * jnp.sum(f * p))
-
-        # bf16 matmuls, f32 activation math (mirrors the dense branch).
-        gate = jax.nn.silu(jnp.einsum(
-            "bsh,ehi->bsei", mlp_in, layer["w_gate"]
-        ).astype(jnp.float32))
-        up = jnp.einsum("bsh,ehi->bsei", mlp_in, layer["w_up"]).astype(jnp.float32)
-        expert_out = jnp.einsum(
-            "bsei,eih->bseh", (gate * up).astype(mlp_in.dtype), layer["w_down"]
-        ).astype(jnp.float32)
-        mix = jnp.einsum("bsk,bske,bseh->bsh", weights, onehot, expert_out)
-        return mix.astype(mlp_in.dtype)
+        if cfg.moe_dispatch == "capacity":
+            return _moe_capacity(mlp_in, layer, cfg, aux_out, valid=valid)
+        if cfg.moe_dispatch == "dense":
+            return _moe_dense(mlp_in, layer, cfg, aux_out)
+        raise ValueError(f"unknown moe_dispatch: {cfg.moe_dispatch!r}")
 
     gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
     up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
@@ -282,7 +356,7 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
 
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(mlp_in, layer, cfg)
+        x = x + _mlp(mlp_in, layer, cfg, valid=valid)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)

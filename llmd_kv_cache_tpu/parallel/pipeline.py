@@ -1,12 +1,24 @@
 """Pipeline parallelism: layer-sharded training over a ``pp`` mesh axis.
 
-The dense transformer's layers are stacked into leading-axis arrays and
-scanned; sharding that leading axis over ``pp`` distributes the parameters
-(and their optimizer state) across pipeline stages — the memory-scaling
-half of pipeline parallelism, with XLA moving activations between stages
-at the scan steps. The schedule is sequential (GPipe-style microbatch
-interleaving / 1F1B is the round-2 follow-up); composes with dp/tp on the
-other axes.
+Two schedules:
+
+1. **Sequential stacked scan** (``make_pp_train_step``): layers stacked
+   into leading-axis arrays, scanned, the layer axis sharded over ``pp``.
+   Distributes parameters + optimizer state across stages; the whole
+   batch flows through the stages one layer-block at a time, so the
+   bubble fraction is (P-1)/P. Composes with dp AND tp.
+
+2. **Microbatched rotating-buffer pipeline**
+   (``make_pp_pipelined_train_step``): an explicit shard_map schedule —
+   the batch splits into M microbatches that stream through the stages,
+   activations hopping stage→stage via ``ppermute`` each tick, so up to P
+   microbatches are in flight at once and the bubble fraction drops to
+   (P-1)/(M+P-1) (``pipeline_bubble_fraction``). This is the SPMD
+   formulation of pipelined microbatching on TPU (collectives ride ICI;
+   the autodiff transpose replays the schedule in reverse, so memory is
+   GPipe-shaped: all forwards live until backwards drain). Composes with
+   dp; tp inside a shard_map stage would need hand-written collectives,
+   so the sequential schedule remains the dp×pp×tp path.
 
 Dense layers only (MoE layers scale across ``ep`` instead).
 """
@@ -80,23 +92,14 @@ def forward_train_pp(stacked_params: dict, cfg: LlamaConfig,
                      tokens: jax.Array) -> jax.Array:
     """Causal-LM forward scanning stacked (pipeline-sharded) layers.
 
-    The per-layer body is ``train.attention_block`` + ``_mlp`` — shared
-    with the python-loop formulation so the two paths cannot drift.
+    The per-layer body is ``_scan_layers`` (``train.attention_block`` +
+    ``_mlp``) — shared with the pipelined schedule and the python-loop
+    formulation so the paths cannot drift.
     """
-    from .train import attention_block
-
     batch, seq = tokens.shape
     positions = jnp.arange(seq)[None, :].repeat(batch, axis=0)
-
     x = stacked_params["embed"][tokens]
-
-    def layer_step(x, layer):
-        x = x + attention_block(x, layer, cfg, positions)
-        mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + _mlp(mlp_in, layer, cfg)
-        return x, None
-
-    x, _ = jax.lax.scan(layer_step, x, stacked_params["layers_stacked"])
+    x = _scan_layers(stacked_params["layers_stacked"], cfg, x, positions)
     x = _rms_norm(x, stacked_params["final_norm"], cfg.norm_eps)
     return (x @ stacked_params["lm_head"]).astype(jnp.float32)
 
@@ -116,6 +119,140 @@ def pp_train_step(stacked_params, opt_state, cfg: LlamaConfig,
     updates, opt_state = opt.update(grads, opt_state, stacked_params)
     stacked_params = optax.apply_updates(stacked_params, updates)
     return stacked_params, opt_state, loss
+
+
+def pipeline_bubble_fraction(pp_size: int, num_microbatches: int) -> float:
+    """Idle fraction of the microbatched schedule: (P-1)/(M+P-1). The
+    sequential stacked scan is the M=1 case, (P-1)/P."""
+    return (pp_size - 1) / (num_microbatches + pp_size - 1)
+
+
+def _scan_layers(layers_stacked, cfg: LlamaConfig, x: jax.Array,
+                 positions: jax.Array) -> jax.Array:
+    """Scan a stacked layer slab over activations ``x`` — the ONE per-layer
+    body shared by the sequential and pipelined schedules (and built from
+    ``train.attention_block`` + ``_mlp`` so the python-loop formulation
+    cannot drift either)."""
+    from .train import attention_block
+
+    def layer_step(x, layer):
+        x = x + attention_block(x, layer, cfg, positions)
+        mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(mlp_in, layer, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, layers_stacked)
+    return x
+
+
+def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
+                                 opt, num_microbatches: int):
+    """Microbatched rotating-buffer pipeline over ``mesh``'s ``pp`` axis
+    (× optional ``dp``).
+
+    Returns ``(step_fn, stacked_params, opt_state, data_sharding)`` like
+    ``make_pp_train_step``; the two produce identical losses/gradients for
+    the same params (the schedule changes wall-clock shape, not math).
+    """
+    from .ring_attention import shard_map  # jax-version compat shim
+
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if "pp" not in axis_sizes:
+        raise ValueError("pipelined training requires a 'pp' mesh axis")
+    if axis_sizes.get("tp", 1) > 1:
+        raise ValueError(
+            "the pipelined schedule composes with dp only; use "
+            "make_pp_train_step for dp×pp×tp")
+    if cfg.num_experts > 0:
+        raise ValueError("pipeline path supports dense layers (MoE uses ep)")
+    P_size = axis_sizes["pp"]
+    M = num_microbatches
+    if cfg.num_layers % P_size != 0:
+        raise ValueError(
+            f"num_layers ({cfg.num_layers}) must divide by pp size ({P_size})")
+    dp = "dp" if "dp" in axis_sizes else None
+
+    stacked = stack_layer_params(params)
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        stacked_param_pspecs(False, "pp"),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    stacked = jax.device_put(stacked, shardings)
+    opt_state = opt.init(stacked)
+    data_sharding = NamedSharding(mesh, P(dp, None))
+
+    param_specs = stacked_param_pspecs(False, "pp")
+    perm = [(i, i + 1) for i in range(P_size - 1)]
+
+    def pipeline_loss(sp, tokens):
+        # tokens: microbatch-local [b_mb, S] per (dp shard); split into M
+        # microbatches along batch.
+        b, S = tokens.shape
+        if b % M != 0:
+            raise ValueError(f"local batch {b} must divide by M={M}")
+        mbs = tokens.reshape(M, b // M, S)
+        positions = jnp.arange(S)[None, :].repeat(b // M, axis=0)
+        stage = jax.lax.axis_index("pp")
+        layers_local = sp["layers_stacked"]
+
+        # Streams padded to M+P-1 ticks: stage 0 consumes microbatch t;
+        # the last stage emits microbatch t-(P-1), so its target stream is
+        # pre-shifted by P-1.
+        pad = jnp.zeros((P_size - 1,) + mbs.shape[1:], mbs.dtype)
+        in_stream = jnp.concatenate([mbs, pad], axis=0)           # [T,...]
+        out_stream = jnp.concatenate([pad, mbs], axis=0)          # [T,...]
+
+        def tick(carry, xs):
+            x_prev, loss_acc = carry
+            t, mb_in, mb_out = xs
+            # Activations hop one stage forward; stage 0's slot is then
+            # replaced by the fresh microbatch's embedding.
+            recv = jax.lax.ppermute(x_prev, "pp", perm)
+            injected = sp["embed"][mb_in]
+            x_in = jnp.where(stage == 0, injected, recv)
+            y = _scan_layers(layers_local, cfg, x_in, positions)
+            # Last stage: head + NLL for the microbatch leaving the pipe.
+            h = _rms_norm(y, sp["final_norm"], cfg.norm_eps)
+            logits = (h @ sp["lm_head"]).astype(jnp.float32)
+            logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            nll = -jnp.take_along_axis(
+                logprobs, mb_out[:, 1:][..., None], axis=-1)[..., 0]
+            # Count only drain ticks (t >= P-1): earlier ticks see the
+            # zero-initialized buffer, not a real microbatch.
+            valid = jnp.logical_and(stage == P_size - 1, t >= P_size - 1)
+            loss_acc = loss_acc + jnp.where(valid, nll.mean(), 0.0)
+            return (y, loss_acc), None
+
+        x0 = jnp.zeros((b // M, S, cfg.hidden_size),
+                       sp["embed"].dtype)
+        ticks = jnp.arange(M + P_size - 1)
+        (_, loss_sum), _ = jax.lax.scan(
+            tick, (x0, jnp.float32(0.0)), (ticks, in_stream, out_stream))
+        # Valid losses accumulated on the last stage only, for ticks
+        # t >= P-1 … M+P-2 → exactly M microbatches. Average over M, then
+        # across the pipeline (sum picks up the last stage's value) and
+        # data shards.
+        loss = jax.lax.psum(loss_sum / M, "pp")
+        if dp is not None:
+            loss = jax.lax.pmean(loss, dp)
+        return loss
+
+    mapped = shard_map(
+        pipeline_loss,
+        mesh=mesh,
+        in_specs=(param_specs, P(dp, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def train_step(sp, opt_state, tokens):
+        loss, grads = jax.value_and_grad(mapped)(sp, tokens)
+        updates, opt_state = opt.update(grads, opt_state, sp)
+        sp = optax.apply_updates(sp, updates)
+        return sp, opt_state, loss
+
+    return jax.jit(train_step), stacked, opt_state, data_sharding
 
 
 def make_pp_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt):

@@ -118,3 +118,72 @@ class TestMoESharded:
                 losses.append(float(loss))
             assert all(np.isfinite(losses))
             assert losses[2] < losses[0]  # learning
+
+
+class TestCapacityDispatch:
+    def test_capacity_matches_dense_when_ample(self):
+        """With enough capacity for every assignment (factor >= E/k), the
+        dispatch path computes exactly the dense formulation's math."""
+        cfg_cap = moe_config(moe_dispatch="capacity", moe_capacity_factor=2.0)
+        cfg_dense = moe_config(moe_dispatch="dense")
+        params = init_params(jax.random.PRNGKey(0), cfg_cap)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (2, 8)), jnp.int32)
+        out_cap = forward_train(params, cfg_cap, tokens)
+        out_dense = forward_train(params, cfg_dense, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_cap), np.asarray(out_dense), atol=3e-2, rtol=3e-2)
+
+    def test_overflow_drops_tokens_but_stays_finite(self):
+        cfg = moe_config(moe_dispatch="capacity", moe_capacity_factor=0.1)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 128, (2, 16)), jnp.int32)
+        out = forward_train(params, cfg, tokens)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+    def test_flops_do_not_scale_with_num_experts(self):
+        """The VERDICT bar: expert compute scales with tokens, not E.
+        Compare compiled FLOPs at E=4 vs E=16 (same tokens): the capacity
+        path stays near-flat while dense grows ~4x."""
+        def flops(cfg):
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            tokens = jnp.asarray(
+                np.random.default_rng(0).integers(0, 128, (4, 32)), jnp.int32)
+            fn = jax.jit(lambda p, t: forward_train(p, cfg, t))
+            c = fn.lower(params, tokens).compile().cost_analysis()
+            if isinstance(c, list):
+                c = c[0]
+            return c["flops"]
+
+        f_cap_4 = flops(moe_config(moe_dispatch="capacity",
+                                   moe_capacity_factor=1.0, num_experts=4))
+        f_cap_16 = flops(moe_config(moe_dispatch="capacity",
+                                    moe_capacity_factor=1.0, num_experts=16))
+        f_dense_4 = flops(moe_config(moe_dispatch="dense", num_experts=4))
+        f_dense_16 = flops(moe_config(moe_dispatch="dense", num_experts=16))
+        assert f_dense_16 > 2.5 * f_dense_4  # dense scales with E
+        assert f_cap_16 < 1.5 * f_cap_4     # capacity does not
+        assert f_cap_16 < f_dense_16        # and beats dense at scale
+
+    def test_padded_positions_cannot_steal_capacity(self):
+        """Padded garbage tokens are excluded from routing: logits at
+        valid positions must not depend on padding content (which would
+        otherwise compete for expert capacity slots)."""
+        from llmd_kv_cache_tpu.models.llama import forward, init_kv_cache
+
+        cfg = moe_config(moe_dispatch="capacity", moe_capacity_factor=1.0)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        table = jnp.asarray(np.arange(1, 5)[None, :], jnp.int32)
+        ctx = jnp.zeros((1,), jnp.int32)
+        new = jnp.full((1,), 5, jnp.int32)  # 5 valid of 16
+
+        def run(pad_value):
+            tokens = np.full((1, 16), pad_value, np.int32)
+            tokens[0, :5] = [1, 2, 3, 4, 5]
+            k, v = init_kv_cache(cfg, 8)
+            logits, _, _ = forward(params, cfg, jnp.asarray(tokens),
+                                   k, v, table, ctx, new)
+            return np.asarray(logits[0, :5])
+
+        np.testing.assert_array_equal(run(0), run(77))
