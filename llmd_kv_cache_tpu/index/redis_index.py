@@ -82,6 +82,30 @@ def _engine_redis_key(engine_key: BlockHash) -> str:
     return f"engine:{engine_key}"
 
 
+# Atomic prunes, mirroring the reference's server-side scripts
+# (``redis.go:148-169``): deleting an empty hash / an engine mapping whose
+# request hashes are all empty must be atomic with the emptiness check, or
+# a concurrent Add between check and delete loses its entry.
+PRUNE_REQUEST_KEY_SCRIPT = """
+if redis.call('HLEN', KEYS[1]) == 0 then
+  redis.call('DEL', KEYS[1])
+  return 1
+end
+return 0
+"""
+
+PRUNE_ENGINE_KEY_SCRIPT = """
+local rks = redis.call('ZRANGE', KEYS[1], 0, -1)
+for i = 1, #rks do
+  if redis.call('HLEN', rks[i]) > 0 then
+    return 0
+  end
+end
+redis.call('DEL', KEYS[1])
+return 1
+"""
+
+
 class RedisIndex(Index):
     """Redis/Valkey-backed index."""
 
@@ -110,6 +134,39 @@ class RedisIndex(Index):
             elif "://" not in address:
                 address = "redis://" + address
             self._client = _redis.Redis.from_url(address)
+        # Atomic prunes need server-side scripting (registered once,
+        # EVALSHA per call when the client supports it); clients without
+        # scripting degrade to check-then-delete — a racing Add re-creates
+        # state on the next event, which the soft-state model tolerates.
+        self._prune_req = self._make_script(PRUNE_REQUEST_KEY_SCRIPT)
+        self._prune_eng = self._make_script(PRUNE_ENGINE_KEY_SCRIPT)
+        self._scripting = self._prune_req is not None
+
+    def _make_script(self, text: str):
+        reg = getattr(self._client, "register_script", None)
+        if reg is not None:
+            script = reg(text)
+            return lambda keys: script(keys=keys)
+        ev = getattr(self._client, "eval", None)
+        if ev is not None:
+            return lambda keys: ev(text, len(keys), *keys)
+        return None
+
+    def _prune_request_key(self, request_key: str) -> None:
+        if self._scripting:
+            self._prune_req([request_key])
+        elif self._client.hlen(request_key) == 0:
+            self._client.delete(request_key)
+
+    def _prune_engine_key(self, engine_key: BlockHash,
+                          rks: Sequence[str]) -> None:
+        # The script re-reads the request-key set from the engine zset
+        # server-side: a client-side snapshot would miss request keys a
+        # concurrent Add registers between snapshot and delete.
+        if self._scripting:
+            self._prune_eng([_engine_redis_key(engine_key)])
+        elif all(self._client.hlen(rk) == 0 for rk in rks):
+            self._client.delete(_engine_redis_key(engine_key))
 
     def lookup(
         self,
@@ -173,13 +230,10 @@ class RedisIndex(Index):
                 return
             for rk in rks:
                 self._evict_pods_from_request_key(rk, entries)
-            # Prune the engine mapping when every mapped request hash is
-            # empty. The reference does this atomically via a Lua script
-            # (redis.go:157-169); here it is check-then-delete — a racing
-            # Add may re-create the mapping on the next event, which the
-            # soft-state model tolerates.
-            if all(self._client.hlen(rk) == 0 for rk in rks):
-                self._client.delete(_engine_redis_key(key))
+            # Prune the engine mapping only if every mapped request hash is
+            # empty — atomically (server-side script), so a concurrent Add
+            # between the emptiness check and the delete cannot be lost.
+            self._prune_engine_key(key, rks)
         elif key_type is KeyType.REQUEST:
             self._evict_pods_from_request_key(str(key), entries)
         else:  # pragma: no cover
@@ -192,8 +246,7 @@ class RedisIndex(Index):
         for entry in entries:
             pipe.hdel(request_key, _encode_pod_field(entry))
         pipe.execute()
-        if self._client.hlen(request_key) == 0:
-            self._client.delete(request_key)
+        self._prune_request_key(request_key)
 
     def _get_request_keys(self, engine_key: BlockHash) -> list[str]:
         vals = self._client.zrange(_engine_redis_key(engine_key), 0, -1)
@@ -225,7 +278,6 @@ class RedisIndex(Index):
                 ]
                 if stale:
                     self._client.hdel(key_str, *stale)
-                    if self._client.hlen(key_str) == 0:
-                        self._client.delete(key_str)
+                    self._prune_request_key(key_str)
             if cursor == 0:
                 break

@@ -108,3 +108,24 @@ class FakeRedis:
             if match:
                 keys = [k for k in keys if fnmatch.fnmatch(k.decode(), match)]
             return 0, keys
+
+    def eval(self, script, numkeys, *keys_and_args):
+        """Execute the index's two prune scripts atomically (the role
+        miniredis' real Lua engine plays for the reference's tests). Any
+        other script is rejected loudly rather than faked."""
+        keys = [k.decode() if isinstance(k, bytes) else str(k)
+                for k in keys_and_args[:numkeys]]
+        with self._lock:
+            if "ZRANGE" in script:  # engine-key prune (zset read in-script)
+                rks = [m.decode() for m in self.zrange(keys[0], 0, -1)]
+                for rk in rks:
+                    if self.hlen(rk) > 0:
+                        return 0
+                self.delete(keys[0])
+                return 1
+            if "HLEN" in script and "DEL" in script:  # request-key prune
+                if self.hlen(keys[0]) == 0:
+                    self.delete(keys[0])
+                    return 1
+                return 0
+        raise NotImplementedError(f"unsupported script: {script[:60]!r}")

@@ -30,8 +30,30 @@ def pod(name, tier="tpu-hbm", **kw):
     return PodEntry(pod_identifier=name, device_tier=tier, **kw)
 
 
+def make_real_redis_client():
+    """Real-server tier (the reference's redis:7 CI service): connect to
+    ``$KVTPU_TEST_REDIS_URL``, flush the test DB, hand back a real client.
+    Skips when no server/driver is available so the tier is zero-cost
+    locally. A dedicated env var (not the generic REDIS_URL) because this
+    FLUSHES the target database."""
+    import os
+
+    url = os.environ.get("KVTPU_TEST_REDIS_URL")
+    if not url:
+        pytest.skip("set KVTPU_TEST_REDIS_URL to run the real-Redis tier")
+    redis = pytest.importorskip("redis")
+    client = redis.Redis.from_url(url)
+    try:
+        client.ping()
+    except Exception as e:  # pragma: no cover - server down
+        pytest.skip(f"redis server unreachable: {e}")
+    client.flushdb()
+    return client
+
+
 @pytest.fixture(
-    params=["in_memory", "cost_aware", "redis", "instrumented", "traced", "native"]
+    params=["in_memory", "cost_aware", "redis", "redis_real", "instrumented",
+            "traced", "native"]
 )
 def index(request):
     if request.param == "in_memory":
@@ -40,6 +62,8 @@ def index(request):
         return CostAwareMemoryIndex(CostAwareMemoryIndexConfig(max_cost="64MiB"))
     if request.param == "redis":
         return RedisIndex(RedisIndexConfig(), client=FakeRedis())
+    if request.param == "redis_real":
+        return RedisIndex(RedisIndexConfig(), client=make_real_redis_client())
     if request.param == "instrumented":
         return InstrumentedIndex(InMemoryIndex(InMemoryIndexConfig(size=1000)))
     if request.param == "native":

@@ -1,0 +1,148 @@
+"""Atomic prune semantics of the Redis index (reference redis.go:148-169:
+server-side scripts make empty-check + delete one atomic step)."""
+
+import threading
+
+import pytest
+
+from llmd_kv_cache_tpu.core.keys import KeyType, PodEntry
+from llmd_kv_cache_tpu.index.redis_index import RedisIndex, RedisIndexConfig
+
+from tests.fake_redis import FakeRedis
+
+
+def pod(name="pod-a", tier="tpu-hbm"):
+    return PodEntry(name, tier)
+
+
+class RecordingFake(FakeRedis):
+    def __init__(self):
+        super().__init__()
+        self.eval_calls = []
+
+    def eval(self, script, numkeys, *args):
+        self.eval_calls.append(script)
+        return super().eval(script, numkeys, *args)
+
+
+@pytest.fixture
+def stack():
+    client = RecordingFake()
+    return RedisIndex(RedisIndexConfig(), client=client), client
+
+
+class TestAtomicPrune:
+    def test_scripting_path_engaged(self, stack):
+        index, client = stack
+        index.add([1], [11], [pod()])
+        index.evict(11, KeyType.REQUEST, [pod()])
+        assert any("HLEN" in s for s in client.eval_calls)
+        assert client.hlen("11") == 0
+        assert index.lookup([11]) == {}
+
+    def test_request_prune_keeps_nonempty_hash(self, stack):
+        index, client = stack
+        index.add([1], [11], [pod("a"), pod("b")])
+        index.evict(11, KeyType.REQUEST, [pod("a")])
+        # hash still holds b's entry: prune must be a no-op
+        assert client.hlen("11") == 1
+        assert index.lookup([11])[11] == [pod("b")]
+
+    def test_engine_prune_requires_all_request_hashes_empty(self, stack):
+        index, client = stack
+        # engine key 5 maps to request keys 11, 22 (many:1)
+        index.add([5], [11, 22], [pod()])
+        # empty out 11 manually; 22 still holds the pod
+        client.delete("11")
+        index.evict(5, KeyType.ENGINE, [pod("nobody")])  # removes nothing
+        assert client.zrange("engine:5", 0, -1), (
+            "mapping must survive while any request hash is non-empty")
+        # now empty 22 too → engine eviction prunes the mapping
+        index.evict(5, KeyType.ENGINE, [pod()])
+        assert client.zrange("engine:5", 0, -1) == []
+        assert index.get_request_key(5) is None
+
+    def test_nonscripting_client_falls_back(self):
+        class NoEval:
+            """Delegates to FakeRedis but hides eval (a scripting-less
+            client)."""
+
+            def __init__(self):
+                self._inner = FakeRedis()
+
+            def __getattr__(self, name):
+                if name == "eval":
+                    raise AttributeError(name)
+                return getattr(self._inner, name)
+
+        client = NoEval()
+        index = RedisIndex(RedisIndexConfig(), client=client)
+        assert not index._scripting
+        index.add([1], [11], [pod()])
+        index.evict(11, KeyType.REQUEST, [pod()])
+        assert index.lookup([11]) == {}
+
+    def test_concurrent_add_during_eviction_storm(self, stack):
+        """Soft-state invariant under concurrency: after an add/evict storm
+        plus a final add, the entry must be present (no lost update from a
+        non-atomic prune window)."""
+        index, client = stack
+        stop = threading.Event()
+
+        def evictor():
+            while not stop.is_set():
+                index.evict(11, KeyType.REQUEST, [pod()])
+
+        t = threading.Thread(target=evictor)
+        t.start()
+        try:
+            for _ in range(300):
+                index.add([1], [11], [pod()])
+        finally:
+            stop.set()
+            t.join()
+        index.add([1], [11], [pod()])
+        assert index.lookup([11])[11] == [pod()]
+
+    def test_engine_prune_sees_concurrently_added_request_key(self, stack):
+        """The engine prune re-reads the request-key set server-side: a
+        request key registered after the evictor's client-side snapshot
+        must still protect the mapping (the TOCTOU the Lua closes)."""
+        index, client = stack
+        index.add([5], [11], [pod()])
+        real_prune_eng = index._prune_eng
+
+        def racing_prune(keys):
+            # Simulate an Add landing between the evictor's snapshot
+            # (rks=[11]) and the prune: register request key 22.
+            index.add([5], [11, 22], [pod("late")])
+            client.delete("11")  # 11 empty; 22 holds late's entry
+            return real_prune_eng(keys)
+
+        index._prune_eng = racing_prune
+        index.evict(5, KeyType.ENGINE, [pod()])
+        index._prune_eng = real_prune_eng
+        # mapping survives: the in-script ZRANGE saw 22
+        assert client.zrange("engine:5", 0, -1)
+        assert index.get_request_key(5) == 22
+
+
+class TestRealRedisPrune:
+    """Same assertions against a real server (REDIS_URL tier) where the
+    Lua actually executes server-side."""
+
+    @pytest.fixture
+    def real_index(self):
+        from tests.test_index import make_real_redis_client
+
+        client = make_real_redis_client()
+        return RedisIndex(RedisIndexConfig(), client=client), client
+
+    def test_lua_prune_round_trip(self, real_index):
+        index, client = real_index
+        index.add([5], [11, 22], [pod()])
+        index.evict(11, KeyType.REQUEST, [pod()])
+        assert client.exists("11") == 0
+        assert client.exists("engine:5") == 1  # 22 still non-empty
+        index.evict(5, KeyType.ENGINE, [pod()])
+        assert client.exists("engine:5") == 0
