@@ -1,0 +1,26 @@
+{{/* Common names and labels */}}
+{{- define "kvtpu.fullname" -}}
+{{- .Release.Name | trunc 52 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "kvtpu.labels" -}}
+app.kubernetes.io/part-of: kvtpu-fleet
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "kvtpu.engine.name" -}}
+{{ include "kvtpu.fullname" . }}-engine
+{{- end -}}
+
+{{- define "kvtpu.indexer.name" -}}
+{{ include "kvtpu.fullname" . }}-indexer
+{{- end -}}
+
+{{- define "kvtpu.redis.name" -}}
+{{ include "kvtpu.fullname" . }}-redis
+{{- end -}}
+
+{{- define "kvtpu.offload.pvc" -}}
+{{ include "kvtpu.fullname" . }}-kv-offload
+{{- end -}}

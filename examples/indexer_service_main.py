@@ -13,7 +13,6 @@ Usage:
 
 import argparse
 
-from llmd_kv_cache_tpu.core.token_processor import TokenProcessorConfig
 from llmd_kv_cache_tpu.events.pool import PoolConfig
 from llmd_kv_cache_tpu.events.reconciler import FileDiscovery, PodReconciler
 from llmd_kv_cache_tpu.scoring import IndexerConfig
@@ -31,10 +30,37 @@ def main() -> None:
     parser.add_argument("--concurrency", type=int, default=4)
     parser.add_argument("--engine-type", default="vllm")
     parser.add_argument(
+        "--scoring-strategy", default="LongestPrefix",
+        choices=["LongestPrefix", "HybridAware"],
+        help="pod scoring rule; HybridAware values SWA pods by their "
+             "usable trailing window (group catalog learned from events)",
+    )
+    parser.add_argument(
+        "--index-backend", default="memory",
+        choices=["memory", "redis", "valkey"],
+        help="index backend; redis/valkey persist across indexer restarts "
+             "and share state between active-active replicas",
+    )
+    parser.add_argument(
+        "--redis-address", default="redis://127.0.0.1:6379",
+        help="redis/valkey server for --index-backend redis|valkey",
+    )
+    parser.add_argument(
         "--discover-pods-file", default=None,
         help="JSON pod map file; enables per-pod subscribers instead of the "
              "centralized bound endpoint",
     )
+    parser.add_argument(
+        "--discover-k8s-selector", default=None,
+        help="pod label selector (e.g. llm-d.ai/inference-serving=true); "
+             "enables Kubernetes pod discovery — per-pod subscribers dialed "
+             "to tcp://<pod-ip>:<discover-port>",
+    )
+    parser.add_argument("--discover-namespace", default="",
+                        help="namespace for --discover-k8s-selector "
+                             "(default: all namespaces)")
+    parser.add_argument("--discover-port", type=int, default=5557,
+                        help="engine pods' ZMQ event port for k8s discovery")
     parser.add_argument(
         "--tokenizer-socket", default=None,
         help="UDS tokenizer sidecar socket for the protobuf prompt-scoring "
@@ -61,13 +87,24 @@ def main() -> None:
         def tokenize(prompt: str, model_name: str) -> list[int]:
             return registry.get(model_name).encode(prompt, add_special_tokens=True)
 
-    discover = args.discover_pods_file is not None
+    discover = (args.discover_pods_file is not None
+                or args.discover_k8s_selector is not None)
+    indexer_cfg_dict = {
+        "tokenProcessorConfig": {
+            "blockSize": args.block_size, "hashSeed": args.hash_seed,
+        },
+        "kvBlockScorerConfig": {
+            "scoringStrategy": "HybridAware"
+            if args.scoring_strategy == "HybridAware" else "LongestPrefix",
+        },
+    }
+    if args.index_backend in ("redis", "valkey"):
+        key = "valkeyConfig" if args.index_backend == "valkey" else "redisConfig"
+        indexer_cfg_dict["kvBlockIndexConfig"] = {
+            key: {"address": args.redis_address},
+        }
     service = IndexerService(
-        IndexerConfig(
-            token_processor_config=TokenProcessorConfig(
-                block_size_tokens=args.block_size, hash_seed=args.hash_seed
-            )
-        ),
+        IndexerConfig.from_dict(indexer_cfg_dict),
         PoolConfig(
             zmq_endpoint="" if discover else args.zmq_endpoint,
             concurrency=args.concurrency,
@@ -79,9 +116,18 @@ def main() -> None:
 
     reconciler = None
     if discover:
-        reconciler = PodReconciler(
-            FileDiscovery(args.discover_pods_file), service.subscriber_manager
-        )
+        if args.discover_k8s_selector is not None:
+            from llmd_kv_cache_tpu.events.pool import PodDiscoveryConfig
+            from llmd_kv_cache_tpu.events.reconciler import KubernetesDiscovery
+
+            source = KubernetesDiscovery(PodDiscoveryConfig(
+                pod_label_selector=args.discover_k8s_selector,
+                pod_namespace=args.discover_namespace,
+                socket_port=args.discover_port,
+            ))
+        else:
+            source = FileDiscovery(args.discover_pods_file)
+        reconciler = PodReconciler(source, service.subscriber_manager)
         reconciler.start()
 
     server = serve(args.grpc_address, service)

@@ -105,6 +105,9 @@ class IndexerService:
             self.pool.add_task, topic_filter=self.pool_config.topic_filter
         )
         self._central_subscriber: Optional[ZMQSubscriber] = None
+        # Hybrid-aware scoring reads the pool's learned group catalog
+        # (no-op for the default longest-prefix strategy).
+        self.indexer.attach_group_catalog(self.pool.group_catalog)
 
     def start(self) -> None:
         """Start the event plane: workers plus, in centralized mode, a

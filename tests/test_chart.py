@@ -1,0 +1,152 @@
+"""Fleet chart consistency checks (no helm binary needed).
+
+The chart is the reference vllm-setup-helm's counterpart; without `helm
+template` in the test image, lint what can drift silently: every
+``.Values.*`` path referenced by a template must exist in values.yaml,
+every ``include`` must name a defined helper, and the evictor's env wiring
+must match the real config's variable names.
+"""
+
+import pathlib
+import re
+
+import pytest
+import yaml
+
+CHART = pathlib.Path(__file__).resolve().parent.parent / "deploy" / "chart"
+
+
+def values_paths(node, prefix=""):
+    paths = set()
+    if isinstance(node, dict):
+        for key, child in node.items():
+            p = f"{prefix}.{key}" if prefix else key
+            paths.add(p)
+            paths |= values_paths(child, p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def chart():
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    templates = {
+        p.name: p.read_text() for p in (CHART / "templates").glob("*")
+    }
+    return values, templates
+
+
+def test_chart_metadata_parses():
+    meta = yaml.safe_load((CHART / "Chart.yaml").read_text())
+    assert meta["name"] == "kvtpu-fleet"
+    assert meta["apiVersion"] == "v2"
+
+
+def test_all_values_references_resolve(chart):
+    values, templates = chart
+    defined = values_paths(values)
+    refs = set()
+    for name, text in templates.items():
+        for m in re.finditer(r"\.Values\.([A-Za-z0-9_.]+)", text):
+            refs.add((name, m.group(1)))
+    missing = [(n, r) for n, r in refs if r not in defined]
+    assert not missing, f"templates reference undefined values: {missing}"
+
+
+def test_no_dead_knobs(chart):
+    """Reverse direction: every LEAF value must be referenced by some
+    template (a knob no template reads is a silent no-op for operators)."""
+    values, templates = chart
+    all_text = "\n".join(templates.values())
+
+    def leaves(node, prefix=""):
+        out = set()
+        if isinstance(node, dict) and node:
+            for key, child in node.items():
+                p = f"{prefix}.{key}" if prefix else key
+                out |= leaves(child, p)
+        else:
+            out.add(prefix)
+        return out
+
+    dead = {
+        leaf for leaf in leaves(values)
+        if f".Values.{leaf}" not in all_text
+        # a dict referenced whole (toYaml) covers its children
+        and not any(f".Values.{leaf.rsplit('.', i)[0]}" in all_text
+                    for i in range(1, leaf.count(".") + 1))
+    }
+    assert not dead, f"values no template references: {sorted(dead)}"
+
+
+def test_env_vars_injected_are_consumed(chart):
+    """Every KVTPU_* env var a template injects must be read somewhere in
+    the package (a renamed or invented variable ships a dead knob)."""
+    import subprocess
+
+    _, templates = chart
+    injected = set()
+    for text in templates.values():
+        injected |= set(re.findall(r"KVTPU_[A-Z_]+", text))
+    repo = CHART.parent.parent
+    src = subprocess.run(
+        ["grep", "-rho", r"KVTPU_[A-Z_]*", str(repo / "llmd_kv_cache_tpu")],
+        capture_output=True, text=True,
+    ).stdout
+    known = set(src.split())
+    unknown = injected - known
+    assert not unknown, f"templates inject unread env vars: {unknown}"
+
+
+def test_all_includes_are_defined(chart):
+    _, templates = chart
+    defined = set()
+    for text in templates.values():
+        defined |= set(re.findall(r'define\s+"([^"]+)"', text))
+    used = set()
+    for text in templates.values():
+        used |= set(re.findall(r'include\s+"([^"]+)"', text))
+    assert used <= defined, f"undefined helpers: {used - defined}"
+
+
+def test_fleet_assembly_shape(chart):
+    values, templates = chart
+    # 8-pod fleet default (the routing benchmark's shape)
+    assert values["engine"]["replicaCount"] == 8
+    # engines and indexer agree on the hash seed and block size by
+    # construction: both read the same top-level values
+    eng = templates["engine-statefulset.yaml"]
+    idx = templates["indexer-deployment.yaml"]
+    assert ".Values.hashSeed" in eng and ".Values.hashSeed" in idx
+    assert ".Values.blockSizeTokens" in idx
+    # discovery label the reconciler selects on
+    assert 'llm-d.ai/inference-serving: "true"' in eng
+
+
+def test_evictor_env_matches_config(chart):
+    """The chart's env wiring must use the evictor's real variable names
+    (a rename in config.py without a chart update ships a dead knob)."""
+    _, templates = chart
+    text = templates["offload-storage.yaml"]
+    chart_vars = set(re.findall(r"KVTPU_EVICTOR_[A-Z_]+", text))
+    from llmd_kv_cache_tpu.evictor.config import EvictorConfig
+    import inspect
+
+    src = inspect.getsource(EvictorConfig)
+    known = set(re.findall(r"KVTPU_EVICTOR_[A-Z_]+", src))
+    assert chart_vars <= known, f"unknown evictor env vars: {chart_vars - known}"
+
+
+def test_indexer_args_match_entry_point(chart):
+    """Chart args must exist in examples/indexer_service_main.py's parser."""
+    _, templates = chart
+    text = templates["indexer-deployment.yaml"]
+    repo = CHART.parent.parent
+    # the template runs two entry points: the indexer service and the
+    # tokenizer sidecar; every flag must exist in one of their parsers
+    sources = (
+        (repo / "examples" / "indexer_service_main.py").read_text()
+        + (repo / "llmd_kv_cache_tpu" / "services" / "tokenizer"
+           / "service.py").read_text()
+    )
+    for flag in re.findall(r"--([a-z-]+)=", text):
+        assert f'"--{flag}"' in sources, f"--{flag} not in any entry point"
