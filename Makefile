@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race native bench graft-check verify-examples lint clean
+.PHONY: test unit-test-race tsan native bench graft-check verify-examples lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -18,12 +18,19 @@ test: native
 # Python has no race detector, so the thread-heavy suites are repeated —
 # any single failure fails the target, surfacing flaky races instead of
 # hiding them).
-unit-test-race: native
+unit-test-race: native tsan
 	for i in 1 2 3; do \
 	  $(CPU_ENV) $(PY) -m pytest tests/test_stress.py tests/test_pool.py \
 	    tests/test_index.py tests/test_zmq_integration.py \
 	    tests/test_evictor.py -q || exit 1; \
 	done
+
+# Native race tier: the GIL hides C++ data races from the pytest reruns,
+# so the kvio pool and the kvindex engine get hammered under
+# ThreadSanitizer directly (go test -race parity for the native side).
+tsan:
+	$(MAKE) -s -C csrc/kvio tsan
+	$(MAKE) -s -C csrc/kvindex tsan
 
 native:
 	$(MAKE) -s -C csrc/kvio

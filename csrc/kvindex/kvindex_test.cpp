@@ -1,0 +1,83 @@
+// TSAN stress for the native index: concurrent add / lookup / evict /
+// score / clear against one instance (the role `go test -race` plays for
+// the reference's fine-grained-locking index; ours is coarser-locked, so
+// this guards the lock discipline as the implementation evolves).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* kvidx_create(uint64_t capacity, int pods_per_key,
+                   uint64_t mapping_capacity);
+void kvidx_destroy(void* idx);
+int32_t kvidx_intern(void* idx, const char* s);
+void kvidx_add(void* idx, const uint64_t* engine_keys, int n_ek,
+               const uint64_t* request_keys, int n_rk, const int32_t* pods,
+               const int32_t* tiers, const uint8_t* flags,
+               const int32_t* groups, int n_entries);
+int kvidx_lookup(void* idx, const uint64_t* keys, int n_keys,
+                 const int32_t* filter_pods, int n_filter,
+                 int32_t* out_counts, int32_t* out_entries, int out_cap);
+void kvidx_evict(void* idx, uint64_t key, int is_engine_key,
+                 const int32_t* pods, const int32_t* tiers,
+                 const uint8_t* flags, const int32_t* groups, int n);
+uint64_t kvidx_get_request_key(void* idx, uint64_t engine_key);
+void kvidx_clear(void* idx, int32_t pod);
+uint64_t kvidx_len(void* idx);
+}
+
+int main() {
+  void* idx = kvidx_create(100000, 4, 100000);
+  int32_t pods[4];
+  char name[8];
+  for (int p = 0; p < 4; ++p) {
+    std::snprintf(name, sizeof(name), "pod-%d", p);
+    pods[p] = kvidx_intern(idx, name);
+  }
+  int32_t tier = kvidx_intern(idx, "tpu-hbm");
+
+  constexpr int kThreads = 6;
+  constexpr int kOps = 4000;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&, t] {
+      std::mt19937_64 rng(t);
+      for (int i = 0; i < kOps; ++i) {
+        uint64_t keys[4] = {rng() % 512 + 1, rng() % 512 + 1,
+                            rng() % 512 + 1, rng() % 512 + 1};
+        int32_t entry_pod = pods[t % 4];
+        uint8_t flags = 0;
+        int32_t group = 0;
+        switch (i % 5) {
+          case 0:
+          case 1:
+            kvidx_add(idx, keys, 4, keys, 4, &entry_pod, &tier, &flags,
+                      &group, 1);
+            break;
+          case 2: {
+            int32_t counts[4], out_entries[256];
+            kvidx_lookup(idx, keys, 4, nullptr, 0, counts, out_entries, 256);
+            break;
+          }
+          case 3:
+            kvidx_evict(idx, keys[0], i % 2, &entry_pod, &tier, &flags,
+                        &group, 1);
+            kvidx_get_request_key(idx, keys[1]);
+            break;
+          case 4:
+            if (i % 1000 == 999) kvidx_clear(idx, entry_pod);
+            kvidx_len(idx);
+            break;
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  kvidx_destroy(idx);
+  std::printf("kvindex_test OK\n");
+  return 0;
+}

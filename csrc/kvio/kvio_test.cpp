@@ -1,0 +1,150 @@
+// Concurrency test driver for the kvio engine, built with
+// -fsanitize=thread (see Makefile `tsan` target): the GIL hides C++ data
+// races from the Python 3x-rerun tier, so the submit/poll/cancel/shed
+// paths get hammered here under TSAN, the role `go test -race` plays for
+// the reference's index.
+//
+// Exits non-zero on any functional failure; TSAN itself aborts the
+// process on a detected race.
+
+#include "kvio.hpp"
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int failures = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                  \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+
+std::string TmpDir() {
+  char templ[] = "/tmp/kvio_test_XXXXXX";
+  char* dir = mkdtemp(templ);
+  return dir != nullptr ? std::string(dir) : std::string("/tmp");
+}
+
+// Writers, readers, pollers, and cancellers all racing one engine.
+void StressMixedWorkload(const std::string& root) {
+  kvio::Engine engine(/*num_threads=*/4, /*read_preferring_workers=*/2,
+                      /*max_write_queued_seconds=*/5.0, /*numa_node=*/-2,
+                      /*staging_bytes=*/1 << 16, /*direct_io=*/true);
+
+  constexpr int kProducers = 4;
+  constexpr int kJobsPerProducer = 40;
+  constexpr int kBufBytes = 8192;
+  std::atomic<int> finished{0};
+  std::atomic<bool> stop_polling{false};
+
+  std::vector<std::thread> producers;
+  // Per-producer buffers outlive the jobs (engine holds raw pointers).
+  std::vector<std::vector<std::vector<uint8_t>>> buffers(kProducers);
+  for (int p = 0; p < kProducers; ++p) {
+    buffers[p].resize(kJobsPerProducer * 2,
+                      std::vector<uint8_t>(kBufBytes, 0));
+  }
+
+  for (int p = 0; p < kProducers; ++p) {
+    producers.emplace_back([&, p] {
+      std::mt19937 rng(p);
+      for (int j = 0; j < kJobsPerProducer; ++j) {
+        auto& wbuf = buffers[p][j * 2];
+        auto& rbuf = buffers[p][j * 2 + 1];
+        std::memset(wbuf.data(), (p * 37 + j) & 0xff, kBufBytes);
+        std::string path =
+            root + "/p" + std::to_string(p) + "_" + std::to_string(j);
+
+        uint64_t wjob = engine.BeginJob();
+        engine.SubmitWrite(wjob, path, path + ".tmp", wbuf.data(), kBufBytes,
+                           /*skip_if_exists=*/false);
+        engine.SealJob(wjob);
+        // Half the producers cancel-and-wait (the preemption path), half
+        // let the poller drain the job.
+        if (p % 2 == 0) {
+          int wstatus = engine.WaitJob(wjob, 10.0);
+          // cancel-and-wait may cancel the queued write; only a completed
+          // write guarantees the file exists for the read that follows.
+          if (wstatus == kvio::kOk) {
+            uint64_t rjob = engine.BeginJob();
+            engine.SubmitRead(rjob, path, rbuf.data(), kBufBytes, 0);
+            engine.SealJob(rjob);
+            int status = engine.WaitJob(rjob, 10.0);
+            // kOk when finished before the cancel, kCancelled otherwise;
+            // both are legal outcomes of cancel-and-wait.
+            CHECK(status == kvio::kOk || status == kvio::kCancelled);
+          }
+        }
+      }
+      finished.fetch_add(1);
+    });
+  }
+
+  std::thread poller([&] {
+    uint64_t ids[16];
+    int statuses[16];
+    while (!stop_polling.load()) {
+      engine.PollFinished(ids, statuses, 16);
+      engine.AvgWriteSeconds();
+      engine.QueuedWrites();
+    }
+  });
+
+  while (finished.load() < kProducers) {
+    std::this_thread::yield();
+  }
+  stop_polling.store(true);
+  poller.join();
+  for (auto& t : producers) t.join();
+  engine.Shutdown();
+}
+
+// Shutdown racing in-flight submissions must not crash or deadlock.
+void StressShutdownRace(const std::string& root) {
+  for (int round = 0; round < 8; ++round) {
+    auto* engine = new kvio::Engine(2, 1, 5.0, -2, 0, false);
+    std::vector<uint8_t> buf(4096, 7);
+    std::atomic<bool> stop{false};
+    std::thread submitter([&] {
+      int i = 0;
+      while (!stop.load()) {
+        uint64_t job = engine->BeginJob();
+        std::string path = root + "/s" + std::to_string(round) + "_" +
+                           std::to_string(i++ % 8);
+        engine->SubmitWrite(job, path, path + ".tmp", buf.data(), buf.size(),
+                            true);
+        engine->SealJob(job);
+      }
+    });
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    engine->Shutdown();
+    stop.store(true);
+    submitter.join();
+    delete engine;
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::string root = TmpDir();
+  StressMixedWorkload(root);
+  StressShutdownRace(root);
+  if (failures != 0) {
+    std::fprintf(stderr, "%d check(s) failed\n", failures);
+    return 1;
+  }
+  std::printf("kvio_test OK\n");
+  return 0;
+}

@@ -41,6 +41,7 @@ from .llama import (
     forward,
     forward_decode_pallas,
     forward_hybrid,
+    forward_prefill_pallas,
     init_kv_cache,
     init_kv_cache_hybrid,
     init_params,
@@ -413,8 +414,12 @@ class MiniEngine:
             self._decode_forward = functools.partial(
                 forward_decode_pallas, interpret=not on_tpu
             )
+            self._prefill_forward = functools.partial(
+                forward_prefill_pallas, interpret=not on_tpu
+            )
         else:
             self._decode_forward = forward
+            self._prefill_forward = forward
 
         # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
         # write-through on commit, restore on prefix miss at admission.
@@ -701,7 +706,7 @@ class MiniEngine:
                 req.computed_len = pos + len(chunk)
                 self._swa_reclaim(req)
             else:
-                logits, self.k_cache, self.v_cache = forward(
+                logits, self.k_cache, self.v_cache = self._prefill_forward(
                     self.params, self.cfg.model,
                     jnp.asarray(tokens),
                     self.k_cache, self.v_cache,

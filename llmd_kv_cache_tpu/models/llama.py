@@ -465,3 +465,49 @@ def forward_decode_pallas(
         params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
         pallas_attention,
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "interpret"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def forward_prefill_pallas(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [batch, seq] int32 (padded)
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, pages_per_seq]
+    ctx_lens: jax.Array,
+    new_lens: jax.Array,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill using the Pallas flash-prefill kernel.
+
+    Same semantics as ``forward``: queries attend causally over the cached
+    prefix plus themselves, streaming pages HBM→VMEM in-kernel instead of
+    materializing the gathered KV. SWA layers take the XLA path (the
+    prefill kernel has no window clipping yet); full-attention layers —
+    where long-prompt prefill cost lives — run the kernel.
+    """
+    from ..ops.pallas_paged_attention import pallas_paged_prefill_attention
+
+    seq = tokens.shape[1]
+    q_tile = math.gcd(seq, 16)
+
+    def attention_fn(q, k_l, v_l, table, positions, total_lens, window):
+        if window is not None:
+            return paged_attention(
+                q, k_l, v_l, table, positions, total_lens,
+                sliding_window=window,
+            )
+        return pallas_paged_prefill_attention(
+            q, k_l, v_l, table, ctx_lens, total_lens,
+            q_tile=q_tile, interpret=interpret,
+        )
+
+    return _forward_impl(
+        params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
+        attention_fn,
+    )

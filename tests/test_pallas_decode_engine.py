@@ -42,3 +42,27 @@ def test_pallas_decode_matches_xla_with_sliding_window():
         )
         outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=5)
     assert outs[False] == outs[True]
+
+
+def test_pallas_prefill_engine_matches_xla_path():
+    """With use_pallas_decode=True the engine now also prefills through
+    the Pallas flash-prefill kernel; outputs must match the XLA path,
+    including chunked prefill and prefix-cache resumes."""
+    prompt = list(range(30, 62))  # 8 pages of 4
+    outs = {}
+    for use_pallas in (False, True):
+        engine = MiniEngine(
+            EngineConfig(
+                model=LlamaConfig.tiny(), num_pages=64, max_pages_per_seq=16,
+                model_name="tiny", pod_identifier="p",
+                use_pallas_decode=use_pallas,
+                max_prefill_tokens=16,  # force chunked prefill
+            ),
+            seed=0,
+        )
+        first = engine.generate("r", prompt, max_new_tokens=4)
+        # resume with a shared prefix: nonzero ctx_lens into the kernel
+        resumed = engine.generate("r2", prompt + [7, 8, 9, 10],
+                                  max_new_tokens=4)
+        outs[use_pallas] = (first, resumed)
+    assert outs[False] == outs[True]
