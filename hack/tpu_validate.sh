@@ -79,9 +79,29 @@ err = float(jnp.max(jnp.abs(out.astype(jnp.float32)-ref.astype(jnp.float32))))
 print('max abs err vs XLA ref:', err); assert err < 0.1
 " || continue
 
+  stage engine_pallas_serve 900 "
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+cfg = LlamaConfig(vocab_size=8192, hidden_size=512, num_layers=4,
+                  num_heads=8, num_kv_heads=4, head_dim=128,
+                  intermediate_size=1408, page_size=16)
+import numpy as np
+prompt = np.random.default_rng(0).integers(1, 8000, 128).tolist()
+outs = {}
+for pallas in (False, True):
+    eng = MiniEngine(EngineConfig(model=cfg, num_pages=256,
+                                  max_pages_per_seq=32, model_name='m',
+                                  pod_identifier='p',
+                                  use_pallas_decode=pallas), seed=0)
+    outs[pallas] = eng.generate('r', prompt, max_new_tokens=8)
+assert outs[False] == outs[True], (outs)
+print('engine serve equivalence (XLA vs Pallas prefill+decode) OK on TPU')
+" || continue
+
   stage offload_throughput 600 "
-import sys; sys.argv=['bench','--offload']
-exec(open('bench.py').read())
+import runpy, sys
+sys.argv = ['offload_throughput', '--iters', '3']
+runpy.run_path('benchmarking/offload_throughput.py', run_name='__main__')
 " || continue
 
   stage ttft_bench 1200 "
