@@ -486,10 +486,9 @@ def forward_prefill_pallas(
     """Prefill using the Pallas flash-prefill kernel.
 
     Same semantics as ``forward``: queries attend causally over the cached
-    prefix plus themselves, streaming pages HBM→VMEM in-kernel instead of
-    materializing the gathered KV. SWA layers take the XLA path (the
-    prefill kernel has no window clipping yet); full-attention layers —
-    where long-prompt prefill cost lives — run the kernel.
+    prefix plus themselves (clipped to the layer's sliding window when
+    set, with out-of-window pages skipped), streaming pages HBM→VMEM
+    in-kernel instead of materializing the gathered KV.
     """
     from ..ops.pallas_paged_attention import pallas_paged_prefill_attention
 
@@ -497,14 +496,9 @@ def forward_prefill_pallas(
     q_tile = math.gcd(seq, 16)
 
     def attention_fn(q, k_l, v_l, table, positions, total_lens, window):
-        if window is not None:
-            return paged_attention(
-                q, k_l, v_l, table, positions, total_lens,
-                sliding_window=window,
-            )
         return pallas_paged_prefill_attention(
             q, k_l, v_l, table, ctx_lens, total_lens,
-            q_tile=q_tile, interpret=interpret,
+            q_tile=q_tile, sliding_window=window, interpret=interpret,
         )
 
     return _forward_impl(

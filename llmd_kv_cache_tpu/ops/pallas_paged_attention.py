@@ -147,6 +147,7 @@ def _prefill_kernel(
     page_size: int,
     q_tile: int,
     scale: float,
+    sliding_window: int | None,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -161,6 +162,14 @@ def _prefill_kernel(
     # Causality: this tile needs keys up to position q_start + q_tile - 1.
     max_key = jnp.minimum(q_start + q_tile, total_len)
     num_pages = (max_key + page_size - 1) // page_size
+    # SWA: the earliest key any query in this tile can see is
+    # q_start - W + 1 (XLA convention: q_pos - k_pos < W), so pages wholly
+    # before it are never streamed — long contexts cost ~W/page_size pages
+    # per tile, matching the decode kernel's page skipping.
+    if sliding_window is not None:
+        first_page = jnp.maximum(q_start - sliding_window + 1, 0) // page_size
+    else:
+        first_page = 0
 
     def page_dma(slot, page_idx):
         page = page_table_ref[b, page_idx]
@@ -173,9 +182,9 @@ def _prefill_kernel(
             ),
         )
 
-    @pl.when(num_pages > 0)
+    @pl.when(num_pages > first_page)
     def _():
-        for c in page_dma(0, 0):
+        for c in page_dma(first_page % 2, first_page):
             c.start()
 
     q = q_ref[0, 0, :, 0].astype(jnp.float32) * scale  # [q_tile, group, hd]
@@ -207,6 +216,8 @@ def _prefill_kernel(
             jnp.int32, (1, page_size), 1
         )
         mask = (k_pos <= q_pos) & (k_pos < total_len)  # [q_tile, page_size]
+        if sliding_window is not None:
+            mask = mask & (q_pos - k_pos < sliding_window)
         scores = jnp.where(mask[None], scores, _NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -223,13 +234,15 @@ def _prefill_kernel(
     m0 = jnp.full((group, q_tile, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, q_tile, 1), jnp.float32)
     acc0 = jnp.zeros((group, q_tile, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    _m, l_fin, acc = jax.lax.fori_loop(first_page, num_pages, body,
+                                       (m0, l0, acc0))
 
     out = acc / jnp.maximum(l_fin, 1e-30)  # [group, q_tile, head_dim]
     o_ref[0, 0, :, 0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("q_tile", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("q_tile", "sliding_window", "interpret"))
 def pallas_paged_prefill_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
     k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
@@ -239,6 +252,7 @@ def pallas_paged_prefill_attention(
     total_lens: jax.Array,  # [batch] ctx + valid new tokens
     *,
     q_tile: int = 16,
+    sliding_window: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash prefill over paged KV (new tokens' KV already scattered).
@@ -247,6 +261,8 @@ def pallas_paged_prefill_attention(
     pages HBM→VMEM per (batch, kv_head, q_tile) program. Returns
     ``[batch, q_seq, q_heads, head_dim]``. ``q_seq`` must divide by
     ``q_tile`` (callers pad; padded rows are masked out by total_lens).
+    ``sliding_window=W`` restricts each query to the last W keys and skips
+    pages wholly out of window.
     """
     batch, q_seq, q_heads, head_dim = q.shape
     _, page_size, kv_heads, _ = k_cache.shape
@@ -258,7 +274,7 @@ def pallas_paged_prefill_attention(
 
     kernel = functools.partial(
         _prefill_kernel, page_size=page_size, q_tile=q_tile,
-        scale=head_dim ** -0.5,
+        scale=head_dim ** -0.5, sliding_window=sliding_window,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

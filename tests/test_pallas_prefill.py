@@ -85,3 +85,37 @@ def test_prefill_gqa_bf16():
             np.asarray(ref[b, :n], np.float32),
             rtol=5e-2, atol=5e-2,
         )
+
+
+@pytest.mark.parametrize("window", [4, 7, 16])
+def test_prefill_sliding_window_matches_reference(window):
+    """SWA clipping (+ out-of-window page skipping) in the prefill kernel
+    matches the XLA reference's q_pos - k_pos < W convention."""
+    q, k, v, table, ctx, new = build_prefill_case(ctx=(12, 0), new=(8, 12))
+    total = ctx + new
+    out = pallas_paged_prefill_attention(
+        q, k, v, table, ctx, total,
+        q_tile=Q_TILE, sliding_window=window, interpret=True,
+    )
+    q_seq = q.shape[1]
+    q_pos = ctx[:, None] + jnp.arange(q_seq)[None, :]
+    ref = paged_attention(q, k, v, table, q_pos, total, sliding_window=window)
+    for b in range(q.shape[0]):
+        n = int(new[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n], np.float32),
+            np.asarray(ref[b, :n], np.float32), atol=2e-5, rtol=2e-5,
+        )
+
+
+def test_prefill_window_larger_than_context_equals_full():
+    q, k, v, table, ctx, new = build_prefill_case()
+    total = ctx + new
+    full = pallas_paged_prefill_attention(
+        q, k, v, table, ctx, total, q_tile=Q_TILE, interpret=True)
+    windowed = pallas_paged_prefill_attention(
+        q, k, v, table, ctx, total, q_tile=Q_TILE, sliding_window=10_000,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(windowed, np.float32),
+                               np.asarray(full, np.float32),
+                               atol=1e-6, rtol=1e-6)
