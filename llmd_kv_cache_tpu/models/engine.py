@@ -376,10 +376,6 @@ class MiniEngine:
         self.swa_manager: Optional[BlockManager] = None
         self.k_swa = self.v_swa = None
         if self.hybrid:
-            if offload_spec is not None:
-                raise NotImplementedError(
-                    "shared-storage offload is single-group; disable it for "
-                    "hybrid models")
             num_swa = self.cfg.num_swa_pages or self.cfg.num_pages
             self.block_manager = BlockManager(
                 self.cfg, self.processor, event_sink, group_idx=0,
@@ -432,6 +428,20 @@ class MiniEngine:
             self.offload_handlers = offload_spec.get_handlers(
                 self.k_cache, self.v_cache
             )
+            if self.hybrid:
+                # Hybrid: group 1 (SWA) gets its own copier bound to the
+                # SWA pool; both groups store/restore, keyed by group_idx
+                # into per-group store directories. Only backends with
+                # per-group copier routing qualify (POSIX today).
+                if not hasattr(self.offload_handlers, "copiers"):
+                    raise NotImplementedError(
+                        "hybrid models need per-group offload copiers; the "
+                        f"{offload_spec.backend!r} backend has none")
+                from ..offload.tpu_copier import TPUBlockCopier
+
+                self.offload_handlers.copiers[1] = TPUBlockCopier(
+                    self.k_swa, self.v_swa
+                )
             # Canonical medium label (matches KV-event medium strings).
             self._offload_medium = offload_spec.medium
 
@@ -541,17 +551,26 @@ class MiniEngine:
 
     def _sync_caches_to_copier(self) -> None:
         """Hand the current (possibly donated-and-replaced) cache arrays to
-        the offload copier; forward() replaces self.k_cache/v_cache every
-        step, so the copier must never hold stale references."""
+        the offload copiers; forward() replaces the cache arrays every
+        step, so the copiers must never hold stale references."""
         self.offload_handlers.copier.k_cache = self.k_cache
         self.offload_handlers.copier.v_cache = self.v_cache
+        if self.hybrid:
+            self.offload_handlers.copiers[1].k_cache = self.k_swa
+            self.offload_handlers.copiers[1].v_cache = self.v_swa
 
     def _sync_caches_from_copier(self) -> None:
         self.k_cache = self.offload_handlers.copier.k_cache
         self.v_cache = self.offload_handlers.copier.v_cache
+        if self.hybrid:
+            self.k_swa = self.offload_handlers.copiers[1].k_cache
+            self.v_swa = self.offload_handlers.copiers[1].v_cache
 
     def _restore_from_storage(self, req: Request) -> None:
         """Load storage-resident blocks that extend the HBM prefix hit."""
+        if self.hybrid:
+            self._restore_from_storage_hybrid(req)
+            return
         page_size = self.cfg.model.page_size
         first_missing = req.cached_len // page_size
         remaining = req.block_hashes[first_missing:]
@@ -607,6 +626,96 @@ class MiniEngine:
         req.pages.extend(canonical)
         req.cached_len += len(canonical) * page_size
         req.computed_len = req.cached_len
+
+    def _restore_from_storage_hybrid(self, req: Request) -> None:
+        """Storage restore for hybrid models.
+
+        A valid resume state needs group 0's full chain [0, d) AND group
+        1's trailing window of d — and ONLY the window: earlier SWA blocks
+        are masked for every future position, so recomputation cannot be
+        avoided anywhere the window is incomplete (SWA KV depends on
+        activations that depend on the missing keys). Group 1 stores are
+        exactly the in-window-at-commit blocks, so a full-chain resume
+        normally finds its window; anything less skips the restore
+        conservatively (all-or-nothing, no partial hybrid restores).
+        """
+        page_size = self.cfg.model.page_size
+        window = self.cfg.model.sliding_window
+        wb = -(-window // page_size)
+        d = req.cached_len // page_size  # HBM-resident depth
+        remaining = req.block_hashes[d:]
+        if not remaining:
+            return
+        n_stored = self.offload_manager.lookup(remaining)
+        if n_stored == 0:
+            return
+        depth_end = d + n_stored
+        win_start = max(0, depth_end - wb)
+        # Window slots below d are already HBM-resident (trailing-window
+        # acquisition guaranteed them); only [load_from, depth_end) loads.
+        load_from = max(win_start, d)
+        win_hashes = req.block_hashes[load_from:depth_end]
+        if self.offload_manager.lookup(win_hashes, group_idx=1) < len(win_hashes):
+            logger.info(
+                "hybrid restore skipped: SWA window of depth %d not fully "
+                "stored", depth_end)
+            return
+
+        g0_hashes = req.block_hashes[d:depth_end]
+        g0_pages = [self.block_manager.allocate_page() for _ in g0_hashes]
+        g1_pages = [self.swa_manager.allocate_page() for _ in win_hashes]
+        if any(p is None for p in g0_pages) or any(p is None for p in g1_pages):
+            self.block_manager.free_pages.extend(p for p in g0_pages if p)
+            self.swa_manager.free_pages.extend(p for p in g1_pages if p)
+            return
+
+        self._sync_caches_to_copier()
+        job0 = self.offload_handlers.async_load_blocks(
+            [(h, [p]) for h, p in zip(g0_hashes, g0_pages)])
+        job1 = self.offload_handlers.async_load_blocks(
+            [(h, [p]) for h, p in zip(win_hashes, g1_pages)], group_idx=1)
+        targets = {job0, job1}
+        results: dict = {}
+        deadline = time.monotonic() + 30.0
+        while len(results) < 2 and time.monotonic() < deadline:
+            results.update(self._drain_offload_multi(targets))
+            if len(results) < 2:
+                time.sleep(0.002)
+        for job in targets - set(results):
+            # Timed out: cancel so a late completion can never scatter
+            # into pages we are about to recycle.
+            self.offload_handlers.wait_job(job, timeout_s=5.0)
+            results[job] = None
+        if any(r is None or not r.success for r in results.values()):
+            logger.warning("hybrid storage restore failed; recomputing")
+            self.block_manager.free_pages.extend(g0_pages)
+            self.swa_manager.free_pages.extend(g1_pages)
+            return
+
+        def toks(i):
+            return req.prompt[i * page_size:(i + 1) * page_size]
+
+        g0_parent = req.block_hashes[d - 1] if d > 0 else EMPTY_BLOCK_HASH
+        canonical0 = self.block_manager.commit_blocks(
+            g0_hashes, g0_pages, [toks(d + i) for i in range(n_stored)],
+            g0_parent,
+        )
+        req.pages.extend(canonical0)
+        g1_parent = (
+            req.block_hashes[load_from - 1] if load_from > 0 else EMPTY_BLOCK_HASH
+        )
+        canonical1 = self.swa_manager.commit_blocks(
+            win_hashes, g1_pages,
+            [toks(load_from + i) for i in range(len(win_hashes))],
+            g1_parent,
+        )
+        req.swa_pages.extend([0] * (load_from - len(req.swa_pages)))
+        req.swa_pages.extend(canonical1)
+        req.cached_len = depth_end * page_size
+        req.computed_len = req.cached_len
+        # Blocks acquired for the OLD depth that now sit out of window
+        # return to the pool (refs drop; table slots go to garbage).
+        self._swa_reclaim(req)
 
     def _page_table_for(self, req: Request) -> np.ndarray:
         table = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
@@ -761,14 +870,32 @@ class MiniEngine:
         # Write-through to the storage tier (async; writes may be shed under
         # pressure, degrading to future cache misses).
         if self.offload_handlers is not None:
+            self._sync_caches_to_copier()
             to_store = self.offload_manager.prepare_store(new_hashes)
             if to_store:
                 page_of = dict(zip(new_hashes, canonical))
-                self._sync_caches_to_copier()
                 job = self.offload_handlers.async_store_blocks(
                     [(h, [page_of[h]]) for h in to_store]
                 )
                 self._pending_store_jobs[job] = list(to_store)
+            if self.hybrid and swa_first < n_full:
+                # Group 1: only the in-window committed blocks exist; they
+                # are exactly what a trailing-window restore needs.
+                # Deliberately NOT registered in _pending_store_jobs: the
+                # storage BlockStored advertisement is group-untagged and
+                # must assert a RESTORABLE state, which for hybrid means
+                # the group-0 chain — whose own store job publishes it.
+                # A group-1 file without its group-0 chain (e.g. the
+                # group-0 write shed) must not be advertised.
+                swa_hashes = req.block_hashes[swa_first:n_full]
+                to_store1 = self.offload_manager.prepare_store(
+                    swa_hashes, group_idx=1)
+                if to_store1:
+                    spage_of = dict(
+                        zip(swa_hashes, req.swa_pages[swa_first:n_full]))
+                    self.offload_handlers.async_store_blocks(
+                        [(h, [spage_of[h]]) for h in to_store1], group_idx=1,
+                    )
 
     # -- decode --
 
@@ -792,16 +919,23 @@ class MiniEngine:
         return emitted
 
     def _drain_offload(self, target_job: Optional[int] = None):
+        results = self._drain_offload_multi(
+            {target_job} if target_job is not None else frozenset())
+        return results.get(target_job)
+
+    def _drain_offload_multi(self, targets) -> dict:
         """Single dispatcher for offload completions.
 
         Every finished job is routed here exactly once: store jobs publish
-        their storage events (minus shed blocks); an optionally-awaited
-        job's result is returned. Cache references are re-synced after the
+        their storage events (minus shed blocks); results of awaited jobs
+        (ids in ``targets``) are returned — a multi-job await must pass
+        ALL its ids in one set, or the drain that surfaces one job drops
+        the others' results. Cache references are re-synced after the
         drain because load scatters donate-and-replace the pools.
         """
         from ..metrics.collector import record_offload_result
 
-        target_result = None
+        results: dict = {}
         self._sync_caches_to_copier()
         try:
             for res in self.offload_handlers.get_finished():
@@ -815,11 +949,11 @@ class MiniEngine:
                             self.offload_manager.complete_store(stored)
                     else:
                         logger.warning("write-through store job %d failed", res.job_id)
-                if target_job is not None and res.job_id == target_job:
-                    target_result = res
+                if res.job_id in targets:
+                    results[res.job_id] = res
         finally:
             self._sync_caches_from_copier()
-        return target_result
+        return results
 
     def poll_offload(self) -> None:
         """Reap finished offload jobs (called each step)."""

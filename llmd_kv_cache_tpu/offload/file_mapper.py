@@ -36,6 +36,12 @@ class FileMapperConfig:
     num_layers: int = 32
     pages_per_file: int = 1   # blocks (slots) per file
     pages_per_block: int = 1  # pages per slot — fixes the slot byte size
+    # Hybrid attention geometry: per-group file contents depend on the
+    # window size and the full/SWA layer split, so both enter the
+    # fingerprint (when set) — a redeploy with a different window must not
+    # resume from the old run's KV.
+    sliding_window: Optional[int] = None
+    swa_layers: tuple = ()
     engine: str = "kvtpu"
     mesh_sizes: dict[str, int] = field(
         default_factory=lambda: {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
@@ -74,6 +80,9 @@ class FileMapper:
             # deployments must keep resolving to the same directory.
             **({"pages_per_block": c.pages_per_block}
                if c.pages_per_block != 1 else {}),
+            **({"sliding_window": c.sliding_window,
+                "swa_layers": sorted(c.swa_layers)}
+               if c.sliding_window is not None else {}),
             "engine": c.engine,
             **({k: v for k, v in sorted(c.mesh_sizes.items())}
                if not c.parallel_agnostic else {}),

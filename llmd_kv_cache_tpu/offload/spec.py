@@ -44,6 +44,10 @@ class SharedStorageOffloadSpec:
     # pages per block slot.
     blocks_per_file: int = 1
     pages_per_block: int = 1
+    # Hybrid attention geometry (enters the store fingerprint: files
+    # written under one window/layer-split must not be resumed by another).
+    sliding_window: Optional[int] = None
+    swa_layers: tuple = ()
     rank: int = 0
     parallel_agnostic: bool = False
     events_endpoint: Optional[str] = None
@@ -89,6 +93,8 @@ class SharedStorageOffloadSpec:
             ),
             blocks_per_file=get("blocksPerFile", "blocks_per_file", default=1),
             pages_per_block=get("pagesPerBlock", "pages_per_block", default=1),
+            sliding_window=get("slidingWindow", "sliding_window"),
+            swa_layers=tuple(get("swaLayers", "swa_layers", default=()) or ()),
             rank=get("rank", default=0),
             parallel_agnostic=get(
                 "parallelAgnostic", "parallel_agnostic", default=False
@@ -109,6 +115,8 @@ class SharedStorageOffloadSpec:
                 num_layers=self.num_layers,
                 pages_per_file=self.blocks_per_file,
                 pages_per_block=self.pages_per_block,
+                sliding_window=self.sliding_window,
+                swa_layers=tuple(self.swa_layers),
                 mesh_sizes=mesh_fingerprint_fields(self.mesh),
                 rank=self.rank,
                 parallel_agnostic=self.parallel_agnostic,

@@ -64,6 +64,7 @@ class _PendingJob:
     buffers: list = field(default_factory=list)
     # Loads: (buffer, page_ids) to scatter on completion.
     scatters: list = field(default_factory=list)
+    group_idx: int = 0  # cache group the job's pages belong to
 
 
 @dataclass
@@ -136,8 +137,14 @@ class OffloadHandlers:
         direct_io: bool = False,
         blocks_per_file: int = 1,
         pages_per_block: int = 1,
+        copiers: Optional[dict[int, TPUBlockCopier]] = None,
     ):
         self.copier = copier
+        # Per-cache-group copiers (hybrid models: group 0 full-attention
+        # pool, group 1 SWA pool); group 0 defaults to ``copier``.
+        self.copiers: dict[int, TPUBlockCopier] = {0: copier}
+        if copiers:
+            self.copiers.update(copiers)
         self.mapper = mapper
         # Multi-block file geometry (reference spec.py:76-89): files hold
         # blocks_per_file consecutive blocks in fixed slots of
@@ -178,12 +185,13 @@ class OffloadHandlers:
         respect to the device stream, overlapped across files); file writes
         are queued on the native pool.
         """
+        copier = self.copiers[group_idx]
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, is_store=True, started=time.perf_counter(),
-                          nbytes=0)
+                          nbytes=0, group_idx=group_idx)
         suffix = uuid.uuid4().hex[:8]
         # One device program + one D2H transfer for the whole job.
-        slabs = self.copier.gather_many_to_host(
+        slabs = copier.gather_many_to_host(
             [list(page_ids) for _, page_ids in transfers]
         )
         for (block_hash, _page_ids), slab in zip(transfers, slabs):
@@ -216,11 +224,12 @@ class OffloadHandlers:
         priority); the H2D scatter happens when the caller polls
         ``get_finished`` and the job is complete.
         """
+        copier = self.copiers[group_idx]
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, is_store=False, started=time.perf_counter(),
-                          nbytes=0)
+                          nbytes=0, group_idx=group_idx)
         for block_hash, page_ids in transfers:
-            buf = np.empty(self.copier.slab_nbytes(len(page_ids)), np.uint8)
+            buf = np.empty(copier.slab_nbytes(len(page_ids)), np.uint8)
             self.io.submit_read(
                 job_id, self.mapper.block_path(block_hash, group_idx), buf
             )
@@ -277,14 +286,17 @@ class OffloadHandlers:
                     f"need all of 0..{self.blocks_per_file - 1} (files "
                     "publish atomically; partial stores are not durable)")
 
+        copier = self.copiers[group_idx]
+        file_bytes = copier.slab_nbytes(self.pages_per_block) * self.blocks_per_file
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, is_store=True,
-                          started=time.perf_counter(), nbytes=0)
+                          started=time.perf_counter(), nbytes=0,
+                          group_idx=group_idx)
         suffix = uuid.uuid4().hex[:8]
         # One device program per job: per-block gathers keep slots
         # independently addressable in the file (a fused multi-block gather
         # would interleave blocks by layer).
-        all_slabs = self.copier.gather_many_to_host(
+        all_slabs = copier.gather_many_to_host(
             [list(b) for span in spans for b in span.blocks]
         )
         file_parts: dict[int, list[tuple[int, list]]] = {}
@@ -302,9 +314,9 @@ class OffloadHandlers:
                 for s in slabs
             ]
             buf = flat[0] if len(flat) == 1 else np.concatenate(flat)
-            assert buf.nbytes == self.file_bytes, (
+            assert buf.nbytes == file_bytes, (
                 f"file {file_key:#x}: assembled {buf.nbytes} B, layout "
-                f"expects {self.file_bytes} B")
+                f"expects {file_bytes} B")
             queued = self.io.submit_write(
                 job_id,
                 self.mapper.block_path(file_key, group_idx),
@@ -327,19 +339,22 @@ class OffloadHandlers:
         span's head-offset byte); returns the job id."""
         for span in spans:
             self._check_span(span)
+        copier = self.copiers[group_idx]
+        slot_bytes = copier.slab_nbytes(self.pages_per_block)
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, is_store=False,
-                          started=time.perf_counter(), nbytes=0)
+                          started=time.perf_counter(), nbytes=0,
+                          group_idx=group_idx)
         for span in spans:
-            buf = np.empty(len(span.blocks) * self.slot_bytes, np.uint8)
+            buf = np.empty(len(span.blocks) * slot_bytes, np.uint8)
             self.io.submit_read(
                 job_id, self.mapper.block_path(span.file_key, group_idx),
-                buf, offset=span.head_offset * self.slot_bytes,
+                buf, offset=span.head_offset * slot_bytes,
             )
             job.buffers.append(buf)
             for k, page_ids in enumerate(span.blocks):
                 job.scatters.append((
-                    buf[k * self.slot_bytes:(k + 1) * self.slot_bytes],
+                    buf[k * slot_bytes:(k + 1) * slot_bytes],
                     list(page_ids),
                 ))
             job.nbytes += buf.nbytes
@@ -360,10 +375,11 @@ class OffloadHandlers:
                 continue
             success = status == STATUS_OK
             if success and not job.is_store:
-                self.copier.scatter_many_from_host([
+                copier = self.copiers[job.group_idx]
+                copier.scatter_many_from_host([
                     (
-                        np.frombuffer(buf, dtype=self.copier.dtype).reshape(
-                            self.copier.slab_shape(len(page_ids))
+                        np.frombuffer(buf, dtype=copier.dtype).reshape(
+                            copier.slab_shape(len(page_ids))
                         ),
                         page_ids,
                     )

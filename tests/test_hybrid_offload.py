@@ -1,0 +1,125 @@
+"""Hybrid engine + shared-storage offload: both cache groups write
+through (group 1 = in-window blocks only), and restore is all-or-nothing
+on the SWA trailing window — a resume needs group 0's full chain plus
+exactly the window, never partial SWA state.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+
+from tests.test_hybrid_engine import PAGE, WINDOW, hybrid_cfg
+
+PROMPT = list(range(1, 21))  # 5 blocks; window = 2 blocks
+
+
+def make_spec(tmp_path, **kw):
+    cfg = hybrid_cfg()
+    base = dict(
+        root=str(tmp_path), model_name="tiny-hybrid", page_size=PAGE,
+        num_layers=cfg.num_layers, kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim, io_threads=2,
+        sliding_window=cfg.sliding_window, swa_layers=cfg.swa_layers,
+    )
+    base.update(kw)
+    return SharedStorageOffloadSpec(**base)
+
+
+def make_engine(tmp_path=None, **kw):
+    return MiniEngine(
+        EngineConfig(
+            model=hybrid_cfg(), num_pages=64, max_pages_per_seq=16,
+            model_name="tiny-hybrid", pod_identifier="pod-h",
+        ),
+        offload_spec=make_spec(tmp_path) if tmp_path is not None else None,
+        **kw,
+    )
+
+
+def group_files(root, group):
+    return glob.glob(os.path.join(str(root), "**", f"*_g{group}", "*.bin"),
+                     recursive=True)
+
+
+class TestHybridWriteThrough:
+    def test_both_groups_store(self, tmp_path):
+        eng = make_engine(tmp_path)
+        eng.generate("a", PROMPT, max_new_tokens=2)
+        eng.flush_offload()
+        g0 = group_files(tmp_path, 0)
+        g1 = group_files(tmp_path, 1)
+        # group 0: every full prompt block; group 1: only the trailing
+        # window (2 of 5 blocks)
+        assert len(g0) == 5
+        assert len(g1) == WINDOW // PAGE
+        eng.offload_handlers.shutdown()
+
+
+class TestHybridRestore:
+    def test_restore_matches_cold_run(self, tmp_path):
+        warm = make_engine(tmp_path)
+        out_cold = warm.generate("a", PROMPT, max_new_tokens=4)
+        warm.flush_offload()
+        warm.offload_handlers.shutdown()
+
+        resumed = make_engine(tmp_path)
+        req = resumed.add_request("b", PROMPT, max_new_tokens=4)
+        # full-chain restore: group 0 chain + group 1 trailing window
+        assert req.cached_len == len(PROMPT) // PAGE * PAGE
+        while not req.done:
+            resumed.step()
+        # same model weights (same seed) must produce the same output
+        assert req.output == out_cold
+        resumed.offload_handlers.shutdown()
+
+    def test_missing_swa_window_skips_restore(self, tmp_path):
+        warm = make_engine(tmp_path)
+        out_cold = warm.generate("a", PROMPT, max_new_tokens=4)
+        warm.flush_offload()
+        warm.offload_handlers.shutdown()
+        for f in group_files(tmp_path, 1):
+            os.unlink(f)
+
+        resumed = make_engine(tmp_path)
+        req = resumed.add_request("b", PROMPT, max_new_tokens=4)
+        # window unavailable -> conservative: no restore, full recompute
+        assert req.cached_len == 0
+        while not req.done:
+            resumed.step()
+        assert req.output == out_cold  # correctness unaffected
+        resumed.offload_handlers.shutdown()
+
+    def test_offload_run_matches_plain_hybrid(self, tmp_path):
+        plain = make_engine()
+        out_plain = plain.generate("a", PROMPT, max_new_tokens=4)
+        offl = make_engine(tmp_path)
+        out_offl = offl.generate("a", PROMPT, max_new_tokens=4)
+        assert out_offl == out_plain
+        offl.flush_offload()
+        offl.offload_handlers.shutdown()
+
+
+class TestHybridOffloadGuards:
+    def test_window_change_changes_fingerprint(self, tmp_path):
+        """KV written under one window must never be resumed by a redeploy
+        with a different window — the fingerprint must diverge."""
+        fp8 = make_spec(tmp_path).build_mapper().fingerprint
+        fp16 = make_spec(tmp_path, sliding_window=16).build_mapper().fingerprint
+        fp_split = make_spec(tmp_path, swa_layers=(0,)).build_mapper().fingerprint
+        assert len({fp8, fp16, fp_split}) == 3
+
+    def test_object_backend_rejected_for_hybrid(self, tmp_path):
+        spec = make_spec(tmp_path, backend="object")
+        with pytest.raises(NotImplementedError, match="per-group"):
+            MiniEngine(
+                EngineConfig(
+                    model=hybrid_cfg(), num_pages=64, max_pages_per_seq=16,
+                    model_name="tiny-hybrid", pod_identifier="pod-h",
+                ),
+                offload_spec=spec,
+            )
