@@ -317,17 +317,16 @@ def main() -> None:
     }))
 
 
-def guarded_main() -> None:
-    """Run the TTFT benchmark in a watchdogged subprocess; if the
-    accelerator path is unavailable (e.g. device tunnel down), fall back to
-    the CPU-side index benchmark so the driver always gets a result line."""
+def _run_ttft_subprocess(env=None, timeout=900):
+    """Run the TTFT arm in a watchdogged subprocess; returns the JSON
+    result line or None."""
     import subprocess
     import sys
 
     try:
         proc = subprocess.run(
             [sys.executable, __file__, "--ttft"],
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True, timeout=timeout, env=env,
         )
         for line in reversed(proc.stdout.strip().splitlines()):
             line = line.strip()
@@ -336,10 +335,57 @@ def guarded_main() -> None:
                     json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                print(line)
-                return
+                return line
     except subprocess.TimeoutExpired:
         pass
+    return None
+
+
+def _accelerator_healthy(timeout=90) -> bool:
+    """Quick tunnel probe in a subprocess (a wedged device transport hangs
+    any jax init in-process, so probe out-of-process)."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "(jnp.ones((64,64))@jnp.ones((64,64))).block_until_ready(); "
+             "print('KVTPU_PROBE_OK')"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        return (proc.returncode == 0
+                and proc.stdout.strip().endswith("KVTPU_PROBE_OK"))
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def guarded_main() -> None:
+    """The driver entry: always emits exactly one JSON result line.
+
+    Ladder: (1) accelerator healthy → TTFT routing benchmark on the real
+    device; (2) tunnel down → the SAME headline routing metric on the CPU
+    backend (platform is recorded in the metric string) — the routing win
+    is prefill-skip-ratio-driven and backend-independent; (3) anything
+    else → the index micro-benchmark.
+    """
+    import os
+
+    if _accelerator_healthy():
+        line = _run_ttft_subprocess()
+        if line is not None:
+            print(line)
+            return
+    # CPU fallback: strip the accelerator plugin (PYTHONPATH sitecustomize)
+    # so jax cannot touch the wedged transport.
+    cpu_env = dict(os.environ)
+    cpu_env.pop("PYTHONPATH", None)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    line = _run_ttft_subprocess(env=cpu_env)
+    if line is not None:
+        print(line)
+        return
     try:
         print(json.dumps(bench_index_add()))
     except Exception:

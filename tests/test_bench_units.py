@@ -49,3 +49,55 @@ class TestBenchModes:
         line = out.stdout.strip().splitlines()[-1]
         parsed = json.loads(line)
         assert set(parsed) == {"metric", "value", "unit", "vs_baseline"}
+
+
+class TestGuardedLadder:
+    """The driver entry's fallback ladder: probe -> device TTFT -> CPU-env
+    TTFT -> index micro-bench."""
+
+    def test_cpu_rung_strips_accelerator_env(self, monkeypatch, capsys):
+        import bench
+
+        calls = []
+
+        def fake_ttft(env=None, timeout=900):
+            calls.append(env)
+            if env is None:
+                return None  # device rung fails
+            return '{"metric": "m", "value": 1, "unit": "%", "vs_baseline": 1}'
+
+        monkeypatch.setattr(bench, "_accelerator_healthy", lambda: True)
+        monkeypatch.setattr(bench, "_run_ttft_subprocess", fake_ttft)
+        monkeypatch.setenv("PYTHONPATH", "/some/plugin")
+        bench.guarded_main()
+        assert capsys.readouterr().out.strip().startswith('{"metric"')
+        assert calls[0] is None  # device rung ran first
+        cpu_env = calls[1]
+        assert "PYTHONPATH" not in cpu_env
+        assert cpu_env["JAX_PLATFORMS"] == "cpu"
+
+    def test_unhealthy_probe_skips_device_rung(self, monkeypatch, capsys):
+        import bench
+
+        calls = []
+
+        def fake_ttft(env=None, timeout=900):
+            calls.append(env)
+            return '{"metric": "m", "value": 1, "unit": "%", "vs_baseline": 1}'
+
+        monkeypatch.setattr(bench, "_accelerator_healthy", lambda: False)
+        monkeypatch.setattr(bench, "_run_ttft_subprocess", fake_ttft)
+        bench.guarded_main()
+        assert len(calls) == 1 and calls[0] is not None  # straight to CPU
+
+    def test_all_ttft_rungs_failing_falls_to_index_bench(self, monkeypatch, capsys):
+        import json
+
+        import bench
+
+        monkeypatch.setattr(bench, "_accelerator_healthy", lambda: False)
+        monkeypatch.setattr(bench, "_run_ttft_subprocess",
+                            lambda env=None, timeout=900: None)
+        bench.guarded_main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert "value" in out and "vs_baseline" in out
