@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from prometheus_client import Counter, Histogram
+from prometheus_client import Counter, Gauge, Histogram
 
 from ..utils.logging import get_logger
 
@@ -65,6 +65,28 @@ OFFLOAD_SHED_BLOCKS = Counter(
     "Store blocks dropped by write shedding",
     ["medium"],
 )
+
+# I/O pool placement: operators verify NUMA pinning and the engaged
+# transfer path from metrics instead of shelling into the pod.
+IO_POOL_NUMA_NODE = Gauge(
+    "kv_offload_io_numa_node",
+    "Resolved accelerator host NUMA node (-1 = unknown/disabled)",
+)
+IO_POOL_PINNED_STAGING = Gauge(
+    "kv_offload_io_pinned_staging_workers",
+    "I/O workers whose staging buffer is mlock'd",
+)
+IO_POOL_DIRECT_TRANSFERS = Gauge(
+    "kv_offload_io_direct_transfers_total",
+    "Transfers that took the O_DIRECT staged path",
+)
+
+
+def record_io_pool_placement(engine) -> None:
+    """Snapshot a NativeIOEngine's placement/transfer-path gauges."""
+    IO_POOL_NUMA_NODE.set(engine.numa_node())
+    IO_POOL_PINNED_STAGING.set(engine.pinned_staging_workers())
+    IO_POOL_DIRECT_TRANSFERS.set(engine.direct_transfers())
 
 
 def record_offload_result(medium: str, result) -> None:

@@ -933,9 +933,13 @@ class MiniEngine:
         the others' results. Cache references are re-synced after the
         drain because load scatters donate-and-replace the pools.
         """
-        from ..metrics.collector import record_offload_result
+        from ..metrics.collector import (
+            record_io_pool_placement,
+            record_offload_result,
+        )
 
         results: dict = {}
+        record_io_pool_placement(self.offload_handlers.io)
         self._sync_caches_to_copier()
         try:
             for res in self.offload_handlers.get_finished():

@@ -213,3 +213,21 @@ class TestDirectIO:
             assert os.path.getsize(path) == 8192
         finally:
             engine.close()
+
+
+class TestPlacementMetrics:
+    def test_gauges_snapshot_engine_state(self):
+        from llmd_kv_cache_tpu.metrics.collector import (
+            IO_POOL_NUMA_NODE,
+            IO_POOL_PINNED_STAGING,
+            record_io_pool_placement,
+        )
+
+        engine = NativeIOEngine(num_threads=2, numa_node=-2)
+        try:
+            wait_ready(engine)
+            record_io_pool_placement(engine)
+            assert IO_POOL_NUMA_NODE._value.get() == -1
+            assert IO_POOL_PINNED_STAGING._value.get() == 0
+        finally:
+            engine.close()
