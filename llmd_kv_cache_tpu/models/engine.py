@@ -939,7 +939,11 @@ class MiniEngine:
         )
 
         results: dict = {}
-        record_io_pool_placement(self.offload_handlers.io)
+        # Placement gauges exist only for backends with a native I/O pool
+        # (the object-store backend transfers through its client library).
+        io_pool = getattr(self.offload_handlers, "io", None)
+        if io_pool is not None:
+            record_io_pool_placement(io_pool)
         self._sync_caches_to_copier()
         try:
             for res in self.offload_handlers.get_finished():
