@@ -151,7 +151,7 @@ def bench_offload_throughput() -> dict:
             parallel_agnostic=True,
         )
         rng = np.random.default_rng(0)
-        shape = (layers, pages, page_size, kvh, hd)
+        shape = (layers, pages, kvh, page_size, hd)
         k = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
         v = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
         handlers = spec.get_handlers(k, v)

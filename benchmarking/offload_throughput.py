@@ -33,8 +33,8 @@ def bench_copier(pages: int, iters: int) -> dict:
 
     from llmd_kv_cache_tpu.offload.tpu_copier import TPUBlockCopier
 
-    layers, num_pages, page_size, kv_heads, head_dim = 4, pages + 1, 16, 8, 128
-    shape = (layers, num_pages, page_size, kv_heads, head_dim)
+    layers, num_pages, kv_heads, page_size, head_dim = 4, pages + 1, 8, 16, 128
+    shape = (layers, num_pages, kv_heads, page_size, head_dim)
     k = jnp.zeros(shape, jnp.bfloat16)
     v = jnp.zeros(shape, jnp.bfloat16)
     copier = TPUBlockCopier(k, v)

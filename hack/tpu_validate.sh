@@ -45,8 +45,8 @@ from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
 rng = np.random.default_rng(0)
 b,qh,kvh,hd,ps,npg,pps = 4, 8, 4, 128, 16, 256, 16
 q = jnp.asarray(rng.normal(size=(b,qh,hd)), jnp.bfloat16)
-k = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
-v = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
+k = jnp.asarray(rng.normal(size=(npg,kvh,ps,hd)), jnp.bfloat16)
+v = jnp.asarray(rng.normal(size=(npg,kvh,ps,hd)), jnp.bfloat16)
 table = jnp.asarray(1+np.arange(b*pps).reshape(b,pps), jnp.int32)
 lens = jnp.asarray([250, 100, 37, 16], jnp.int32)
 t=time.time(); out = pallas_paged_decode_attention(q,k,v,table,lens); out.block_until_ready()
@@ -67,8 +67,8 @@ from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
 rng = np.random.default_rng(0)
 b,qh,kvh,hd,ps,npg,pps,qs = 2, 8, 4, 128, 16, 256, 16, 128
 q = jnp.asarray(rng.normal(size=(b,qs,qh,hd)), jnp.bfloat16)
-k = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
-v = jnp.asarray(rng.normal(size=(npg,ps,kvh,hd)), jnp.bfloat16)
+k = jnp.asarray(rng.normal(size=(npg,kvh,ps,hd)), jnp.bfloat16)
+v = jnp.asarray(rng.normal(size=(npg,kvh,ps,hd)), jnp.bfloat16)
 table = jnp.asarray(1+np.arange(b*pps).reshape(b,pps), jnp.int32)
 ctx = jnp.asarray([64, 0], jnp.int32); total = ctx + qs
 t=time.time(); out = pallas_paged_prefill_attention(q,k,v,table,ctx,total,q_tile=16); out.block_until_ready()

@@ -146,8 +146,8 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 
 
 def init_kv_cache(cfg: LlamaConfig, num_pages: int) -> tuple[jax.Array, jax.Array]:
-    """Allocate the paged K and V pools: ``[layers, pages, page, kvh, hd]``."""
-    shape = (cfg.num_layers, num_pages, cfg.page_size, cfg.num_kv_heads, cfg.head_dim)
+    """Allocate the paged K and V pools: ``[layers, pages, kvh, page, hd]``."""
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, cfg.page_size, cfg.head_dim)
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
@@ -162,8 +162,8 @@ def init_kv_cache_hybrid(
         raise ValueError("init_kv_cache_hybrid needs a hybrid config")
 
     def shape(group, pages):
-        return (len(cfg.group_layers(group)), pages, cfg.page_size,
-                cfg.num_kv_heads, cfg.head_dim)
+        return (len(cfg.group_layers(group)), pages, cfg.num_kv_heads,
+                cfg.page_size, cfg.head_dim)
 
     return (
         jnp.zeros(shape(0, num_pages), cfg.dtype),
@@ -377,7 +377,7 @@ def forward(
     params: Params,
     cfg: LlamaConfig,
     tokens: jax.Array,  # [batch, seq] int32 (padded)
-    k_cache: jax.Array,  # [layers, pages, page_size, kvh, hd] (donated)
+    k_cache: jax.Array,  # [layers, pages, kvh, page_size, hd] (donated)
     v_cache: jax.Array,  # same (donated)
     page_table: jax.Array,  # [batch, pages_per_seq] int32
     ctx_lens: jax.Array,  # [batch] tokens already cached before this call
@@ -407,9 +407,9 @@ def forward_hybrid(
     params: Params,
     cfg: LlamaConfig,
     tokens: jax.Array,   # [batch, seq] int32 (padded)
-    k0: jax.Array,       # group 0 (full attention): [g0_layers, pages, p, kvh, hd]
+    k0: jax.Array,       # group 0 (full attention): [g0_layers, pages, kvh, p, hd]
     v0: jax.Array,
-    k1: jax.Array,       # group 1 (SWA): [g1_layers, swa_pages, p, kvh, hd]
+    k1: jax.Array,       # group 1 (SWA): [g1_layers, swa_pages, kvh, p, hd]
     v1: jax.Array,
     table0: jax.Array,   # [batch, pages_per_seq] into group 0's pool
     table1: jax.Array,   # [batch, pages_per_seq] into group 1's pool

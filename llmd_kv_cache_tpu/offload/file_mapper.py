@@ -75,6 +75,11 @@ class FileMapper:
             "head_dim": c.head_dim,
             "num_layers": c.num_layers,
             "pages_per_file": c.pages_per_file,
+            # Slab byte order: [layers, 2, pages, kv_heads, page_size, hd]
+            # (heads-major pages — the Mosaic-tileable cache layout). Keyed
+            # so stores written under the older page_size-major layout
+            # resolve to a different directory instead of mixing formats.
+            "kv_layout": "nkpd",
             # Only when non-default: a (N,1) store's on-disk layout is
             # byte-identical to the pre-pages_per_block format, and existing
             # deployments must keep resolving to the same directory.
@@ -121,6 +126,7 @@ class FileMapper:
                     "num_layers": c.num_layers,
                     "pages_per_file": c.pages_per_file,
                     "pages_per_block": c.pages_per_block,
+                    "kv_layout": "nkpd",
                     "engine": c.engine,
                     "mesh_sizes": c.mesh_sizes,
                     "fingerprint": self._fingerprint,

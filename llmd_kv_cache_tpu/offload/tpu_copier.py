@@ -9,7 +9,7 @@ host memory in a single device→host DMA. The reverse path scatters a host
 slab back into the paged pools inside one jit with donation.
 
 Slab layout per offloaded file (dtype = cache dtype):
-``[num_layers, 2 (K,V), pages_per_file, page_size, kv_heads, head_dim]``
+``[num_layers, 2 (K,V), pages_per_file, kv_heads, page_size, head_dim]``
 
 On TPU the host side lands in pinned host memory (`jax.device_get` uses
 the PJRT pinned path); on the CPU backend the same code degrades to plain
@@ -34,11 +34,11 @@ def _gather_slab(k_cache: jax.Array, v_cache: jax.Array,
                  page_ids: jax.Array) -> jax.Array:
     """Gather pages into one contiguous slab.
 
-    k_cache/v_cache: [layers, num_pages, page_size, kv_heads, head_dim]
+    k_cache/v_cache: [layers, num_pages, kv_heads, page_size, head_dim]
     page_ids: [n] physical page indices
-    returns: [layers, 2, n, page_size, kv_heads, head_dim]
+    returns: [layers, 2, n, kv_heads, page_size, head_dim]
     """
-    k = k_cache[:, page_ids]  # [layers, n, page, kvh, hd]
+    k = k_cache[:, page_ids]  # [layers, n, kvh, page, hd]
     v = v_cache[:, page_ids]
     return jnp.stack([k, v], axis=1)
 
@@ -59,8 +59,8 @@ class TPUBlockCopier:
         # The copier owns the cache references so scatter can donate them.
         self.k_cache = k_cache
         self.v_cache = v_cache
-        layers, _, page_size, kv_heads, head_dim = k_cache.shape
-        self.slab_shape = lambda n: (layers, 2, n, page_size, kv_heads, head_dim)
+        layers, _, kv_heads, page_size, head_dim = k_cache.shape
+        self.slab_shape = lambda n: (layers, 2, n, kv_heads, page_size, head_dim)
         self.dtype = k_cache.dtype
         try:
             self._pinned_sharding = jax.sharding.SingleDeviceSharding(

@@ -1,15 +1,21 @@
 """Paged KV-cache page scatter/gather.
 
 The paged cache is the TPU-native analogue of vLLM's block tables: one
-physical pool of pages per layer, shape ``[num_pages, page_size, kv_heads,
+physical pool of pages per layer, shape ``[num_pages, kv_heads, page_size,
 head_dim]``, addressed through per-sequence page tables. Everything here is
 shape-static and jit-safe: padded positions are routed to a reserved
 garbage page (page 0) instead of branching.
 
-These ops are also the heart of the offload data plane: ``gather_kv_pages``
-is what assembles the contiguous block that gets DMA'd to pinned host
-memory (the role ``tensor_copier.cu`` plays in the reference — see
-SURVEY.md §2.2).
+Layout note (TPU-deliberate): ``page_size`` and ``head_dim`` are the two
+minor dimensions, so a page of one kv head is exactly one Mosaic-tileable
+``[page_size, head_dim]`` block — the Pallas kernels DMA ``cache[page, h]``
+HBM→VMEM without slicing inside a tiled dimension (slicing one head out of
+a ``[.., page_size, kv_heads, ..]`` layout violates the (8/16,128) tiling
+and fails to lower). Verified on v5e.
+
+These ops are also the heart of the offload data plane: ``gather_pages_flat``
+assembles the contiguous slab that gets DMA'd to pinned host memory (the
+role ``tensor_copier.cu`` plays in the reference — see SURVEY.md §2.2).
 """
 
 from __future__ import annotations
@@ -23,7 +29,7 @@ GARBAGE_PAGE = 0
 
 
 def scatter_kv_pages(
-    cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     new_kv: jax.Array,  # [batch, seq, kv_heads, head_dim]
     page_table: jax.Array,  # [batch, pages_per_seq] int32 (physical page ids)
     positions: jax.Array,  # [batch, seq] int32 logical positions
@@ -34,24 +40,27 @@ def scatter_kv_pages(
     Invalid slots scatter into the garbage page. Donate ``cache`` under jit
     for an in-place update.
     """
-    num_pages, page_size, kv_heads, head_dim = cache.shape
+    num_pages, kv_heads, page_size, head_dim = cache.shape
+    batch, seq = positions.shape
     # Clamp: padded positions can point past the page table (their writes
     # are redirected to the garbage page below anyway).
     logical_page = jnp.minimum(positions // page_size, page_table.shape[1] - 1)
     slot = positions % page_size
     phys_page = jnp.take_along_axis(page_table, logical_page, axis=1)
-    flat_idx = phys_page * page_size + slot  # [batch, seq]
-    flat_idx = jnp.where(valid, flat_idx, GARBAGE_PAGE * page_size)
+    phys_page = jnp.where(valid, phys_page, GARBAGE_PAGE)
+    slot = jnp.where(valid, slot, 0)
 
-    cache_flat = cache.reshape(num_pages * page_size, kv_heads, head_dim)
-    cache_flat = cache_flat.at[flat_idx].set(
-        new_kv.astype(cache.dtype), mode="drop", unique_indices=False
+    flat_page = phys_page.reshape(batch * seq)
+    flat_slot = slot.reshape(batch * seq)
+    # [batch*seq, kv_heads, head_dim] values scattered on dims (0, 2).
+    vals = new_kv.astype(cache.dtype).reshape(batch * seq, kv_heads, head_dim)
+    return cache.at[flat_page, :, flat_slot, :].set(
+        vals, mode="drop", unique_indices=False
     )
-    return cache_flat.reshape(num_pages, page_size, kv_heads, head_dim)
 
 
 def gather_kv_pages(
-    cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     page_table: jax.Array,  # [batch, pages_per_seq] int32
 ) -> jax.Array:
     """Gather each sequence's pages into logical order.
@@ -59,19 +68,21 @@ def gather_kv_pages(
     Returns ``[batch, pages_per_seq * page_size, kv_heads, head_dim]``.
     """
     batch, pages_per_seq = page_table.shape
-    _, page_size, kv_heads, head_dim = cache.shape
-    gathered = cache[page_table]  # [batch, pages_per_seq, page_size, kv, hd]
-    return gathered.reshape(batch, pages_per_seq * page_size, kv_heads, head_dim)
+    _, kv_heads, page_size, head_dim = cache.shape
+    gathered = cache[page_table]  # [batch, pages_per_seq, kv, page_size, hd]
+    return gathered.transpose(0, 1, 3, 2, 4).reshape(
+        batch, pages_per_seq * page_size, kv_heads, head_dim
+    )
 
 
 def gather_pages_flat(
-    cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     page_ids: jax.Array,  # [n] int32 physical page ids
 ) -> jax.Array:
     """Gather arbitrary physical pages into one contiguous block.
 
     The offload store path: selected pages → a contiguous
-    ``[n, page_size, kv_heads, head_dim]`` slab ready for a device→host
+    ``[n, kv_heads, page_size, head_dim]`` slab ready for a device→host
     transfer.
     """
     return cache[page_ids]

@@ -23,8 +23,8 @@ _NEG_INF = -1e30
 
 def paged_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim]
-    k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
-    v_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
+    v_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     page_table: jax.Array,  # [batch, pages_per_seq] int32
     q_positions: jax.Array,  # [batch, q_seq] logical position of each query
     total_lens: jax.Array,  # [batch] total tokens (context + new) per sequence
@@ -39,7 +39,7 @@ def paged_attention(
     ``[batch, q_seq, q_heads, head_dim]`` in the query dtype.
     """
     batch, q_seq, q_heads, head_dim = q.shape
-    _, page_size, kv_heads, _ = k_cache.shape
+    _, kv_heads, page_size, _ = k_cache.shape
     if scale is None:
         scale = head_dim ** -0.5
 

@@ -33,7 +33,7 @@ def _decode_kernel(
     ctx_lens_ref,  # [batch] int32 (SMEM)
     # inputs
     q_ref,  # [1, 1, group, head_dim] VMEM block for (b, h)
-    k_hbm,  # [num_pages, page_size, kv_heads, head_dim] (ANY/HBM)
+    k_hbm,  # [num_pages, kv_heads, page_size, head_dim] (ANY/HBM)
     v_hbm,  # same
     # output
     o_ref,  # [1, 1, group, head_dim] VMEM block
@@ -63,10 +63,10 @@ def _decode_kernel(
     def page_dma(slot, page_idx):
         page = page_table_ref[b, page_idx]
         k_copy = pltpu.make_async_copy(
-            k_hbm.at[page, :, h, :], k_scratch.at[slot], sem.at[slot, 0]
+            k_hbm.at[page, h], k_scratch.at[slot], sem.at[slot, 0]
         )
         v_copy = pltpu.make_async_copy(
-            v_hbm.at[page, :, h, :], v_scratch.at[slot], sem.at[slot, 1]
+            v_hbm.at[page, h], v_scratch.at[slot], sem.at[slot, 1]
         )
         return k_copy, v_copy
 
@@ -175,10 +175,10 @@ def _prefill_kernel(
         page = page_table_ref[b, page_idx]
         return (
             pltpu.make_async_copy(
-                k_hbm.at[page, :, h, :], k_scratch.at[slot], sem.at[slot, 0]
+                k_hbm.at[page, h], k_scratch.at[slot], sem.at[slot, 0]
             ),
             pltpu.make_async_copy(
-                v_hbm.at[page, :, h, :], v_scratch.at[slot], sem.at[slot, 1]
+                v_hbm.at[page, h], v_scratch.at[slot], sem.at[slot, 1]
             ),
         )
 
@@ -245,7 +245,7 @@ def _prefill_kernel(
                    static_argnames=("q_tile", "sliding_window", "interpret"))
 def pallas_paged_prefill_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
-    k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     v_cache: jax.Array,
     page_table: jax.Array,  # [batch, pages_per_seq] int32
     ctx_lens: jax.Array,  # [batch] cached tokens before the new ones
@@ -265,7 +265,7 @@ def pallas_paged_prefill_attention(
     pages wholly out of window.
     """
     batch, q_seq, q_heads, head_dim = q.shape
-    _, page_size, kv_heads, _ = k_cache.shape
+    _, kv_heads, page_size, _ = k_cache.shape
     group = q_heads // kv_heads
     assert q_seq % q_tile == 0, "pad q_seq to a q_tile multiple"
 
@@ -315,7 +315,7 @@ def pallas_paged_prefill_attention(
 @functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
-    k_cache: jax.Array,  # [num_pages, page_size, kv_heads, head_dim]
+    k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     v_cache: jax.Array,  # same
     page_table: jax.Array,  # [batch, pages_per_seq] int32
     ctx_lens: jax.Array,  # [batch] int32 (keys to attend per sequence)
@@ -329,7 +329,7 @@ def pallas_paged_decode_attention(
     mask arithmetic are derived from it, so no override is offered.
     """
     batch, q_heads, head_dim = q.shape
-    num_pages_total, page_size, kv_heads, _ = k_cache.shape
+    num_pages_total, kv_heads, page_size, _ = k_cache.shape
     group = q_heads // kv_heads
 
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)

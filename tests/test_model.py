@@ -27,7 +27,7 @@ def params(cfg):
 
 class TestKVPages:
     def test_scatter_gather_roundtrip(self):
-        cache = jnp.zeros((8, 4, 2, 4), jnp.float32)
+        cache = jnp.zeros((8, 2, 4, 4), jnp.float32)
         new = jnp.arange(2 * 8 * 2 * 4, dtype=jnp.float32).reshape(2, 8, 2, 4)
         table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
         positions = jnp.arange(8)[None, :].repeat(2, axis=0)
@@ -37,15 +37,15 @@ class TestKVPages:
         np.testing.assert_allclose(np.asarray(out), np.asarray(new))
 
     def test_invalid_slots_go_to_garbage(self):
-        cache = jnp.zeros((4, 4, 1, 2), jnp.float32)
+        cache = jnp.zeros((4, 1, 4, 2), jnp.float32)
         new = jnp.ones((1, 4, 1, 2), jnp.float32)
         table = jnp.asarray([[2]], jnp.int32)
         positions = jnp.arange(4)[None, :]
         valid = jnp.asarray([[True, True, False, False]])
         cache = scatter_kv_pages(cache, new, table, positions, valid)
-        page2 = np.asarray(cache[2])
-        assert page2[:2].sum() == 4  # two valid slots written
-        assert page2[2:].sum() == 0  # invalid slots untouched
+        page2 = np.asarray(cache[2])  # [kv_heads, page_size, head_dim]
+        assert page2[:, :2].sum() == 4  # two valid slots written
+        assert page2[:, 2:].sum() == 0  # invalid slots untouched
         assert np.asarray(cache[0]).sum() != 0  # garbage page absorbed them
 
 
@@ -59,8 +59,8 @@ class TestPagedAttention:
         v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
 
         # scatter k/v into pages 1..4 (per sequence)
-        k_cache = jnp.zeros((16, page, h, d), jnp.float32)
-        v_cache = jnp.zeros((16, page, h, d), jnp.float32)
+        k_cache = jnp.zeros((16, h, page, d), jnp.float32)
+        v_cache = jnp.zeros((16, h, page, d), jnp.float32)
         table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
         positions = jnp.arange(s)[None, :].repeat(b, axis=0)
         valid = jnp.ones((b, s), bool)

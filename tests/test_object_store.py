@@ -30,7 +30,7 @@ def wait_results(handlers, job_id, timeout=10.0):
 
 def make_caches(seed=0):
     rng = np.random.default_rng(seed)
-    shape = (2, 16, 4, 2, 8)
+    shape = (2, 16, 2, 4, 8)  # [layers, pages, kv_heads, page_size, hd]
     return (jnp.asarray(rng.normal(size=shape), jnp.bfloat16),
             jnp.asarray(rng.normal(size=shape), jnp.bfloat16))
 

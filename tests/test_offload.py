@@ -181,7 +181,7 @@ class TestFileMapper:
 
 def make_caches(layers=2, pages=16, page_size=4, kvh=2, hd=8, seed=0):
     rng = np.random.default_rng(seed)
-    shape = (layers, pages, page_size, kvh, hd)
+    shape = (layers, pages, kvh, page_size, hd)
     k = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
     v = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
     return k, v

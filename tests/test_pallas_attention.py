@@ -20,8 +20,8 @@ def build_case(batch=2, ctx=13, q_heads=4, kv_heads=2, head_dim=8,
                page_size=4, num_pages=32, seed=0, dtype=jnp.float32):
     rng = np.random.default_rng(seed)
     pages_per_seq = 4
-    k_cache = jnp.zeros((num_pages, page_size, kv_heads, head_dim), dtype)
-    v_cache = jnp.zeros((num_pages, page_size, kv_heads, head_dim), dtype)
+    k_cache = jnp.zeros((num_pages, kv_heads, page_size, head_dim), dtype)
+    v_cache = jnp.zeros((num_pages, kv_heads, page_size, head_dim), dtype)
     # distinct physical pages per sequence
     table = jnp.asarray(
         1 + np.arange(batch * pages_per_seq).reshape(batch, pages_per_seq),

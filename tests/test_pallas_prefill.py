@@ -20,8 +20,8 @@ def build_prefill_case(batch=2, ctx=(5, 0), new=(8, 12), q_heads=4, kv_heads=2,
     rng = np.random.default_rng(seed)
     pages_per_seq = 8
     num_pages = 1 + batch * pages_per_seq
-    k_cache = jnp.zeros((num_pages, page_size, kv_heads, head_dim), dtype)
-    v_cache = jnp.zeros((num_pages, page_size, kv_heads, head_dim), dtype)
+    k_cache = jnp.zeros((num_pages, kv_heads, page_size, head_dim), dtype)
+    v_cache = jnp.zeros((num_pages, kv_heads, page_size, head_dim), dtype)
     table = jnp.asarray(
         1 + np.arange(batch * pages_per_seq).reshape(batch, pages_per_seq),
         jnp.int32,
