@@ -79,16 +79,27 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer):
 MODEL_NAME = "bench-llama"
 
 
-def run_replay(pods, workload, router):
-    """Admit each request on the routed pod; returns per-request TTFT (s)."""
+def run_replay(pods, workload, router, tag=""):
+    """Admit each request on the routed pod; returns per-request TTFT (s).
+
+    Coarse progress goes to stderr (the stdout contract is one JSON line);
+    on a tunneled TPU a silent 25-minute run is undebuggable without it.
+    """
+    import sys
+
     ttfts = []
     pod_names = list(pods.keys())
+    arm_start = time.perf_counter()
     for i, prompt in enumerate(workload):
         pod_name = router(i, prompt, pod_names)
         engine = pods[pod_name]
         start = time.perf_counter()
         req = engine.add_request(f"r{i}", prompt, max_new_tokens=1)
         ttfts.append(time.perf_counter() - start)
+        if i % 16 == 15:
+            print(f"[bench {tag}] {i + 1}/{len(workload)} requests, "
+                  f"{time.perf_counter() - arm_start:.1f}s elapsed",
+                  file=sys.stderr, flush=True)
     return ttfts
 
 
@@ -274,18 +285,26 @@ def main() -> None:
 
     # Warm the jit cache (prefill buckets + decode) so compile time doesn't
     # pollute TTFT for either arm.
+    import sys as _sys
+    _t0 = time.perf_counter()
     warm_indexer = fresh_indexer()
     warm = make_pods(1, model_cfg, engine_mod, warm_indexer)["pod-0"]
     for seq_pages in (1, 2, 4, 8, 16, 32):
+        _tb = time.perf_counter()
         prompt = rng.integers(1, 8000, seq_pages * model_cfg.page_size).tolist()
         warm.add_request(f"warm{seq_pages}", prompt, max_new_tokens=1)
+        print(f"[bench warm] bucket {seq_pages}p: "
+              f"{time.perf_counter() - _tb:.1f}s", file=_sys.stderr, flush=True)
+    print(f"[bench warm] total {time.perf_counter() - _t0:.1f}s",
+          file=_sys.stderr, flush=True)
     del warm
 
     # Arm 1: round-robin routing.
     rr_indexer = fresh_indexer()
     rr_pods = make_pods(n_pods, model_cfg, engine_mod, rr_indexer)
     rr_ttfts = run_replay(
-        rr_pods, workload, router=lambda i, _p, names: names[i % len(names)]
+        rr_pods, workload, router=lambda i, _p, names: names[i % len(names)],
+        tag="round-robin",
     )
 
     # Arm 2: KV-cache-aware routing via the Indexer.
@@ -301,7 +320,8 @@ def main() -> None:
         rr_counter[0] += 1
         return pick
 
-    kv_ttfts = run_replay(kv_pods, workload, router=kv_router)
+    kv_ttfts = run_replay(kv_pods, workload, router=kv_router,
+                          tag="kv-aware")
 
     p50_rr = statistics.median(rr_ttfts)
     p50_kv = statistics.median(kv_ttfts)

@@ -431,8 +431,8 @@ class MiniEngine:
             if self.hybrid:
                 # Hybrid: group 1 (SWA) gets its own copier bound to the
                 # SWA pool; both groups store/restore, keyed by group_idx
-                # into per-group store directories. Only backends with
-                # per-group copier routing qualify (POSIX today).
+                # into per-group store directories/key prefixes. Both the
+                # POSIX and object-store backends route per-group copiers.
                 if not hasattr(self.offload_handlers, "copiers"):
                     raise NotImplementedError(
                         "hybrid models need per-group offload copiers; the "

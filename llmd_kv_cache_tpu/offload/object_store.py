@@ -35,7 +35,8 @@ import numpy as np
 from ..events.publisher import StorageEventPublisher
 from ..utils.logging import get_logger
 from .tpu_copier import TPUBlockCopier
-from .worker import TransferResult
+from .worker import (FileSpan, TransferResult, assemble_file_buffers,
+                     check_span, validate_store_coverage)
 
 logger = get_logger("offload.object_store")
 
@@ -50,6 +51,23 @@ class ObjectStoreClient(Protocol):
     def delete(self, key: str) -> bool: ...
 
     def list_keys(self, prefix: str) -> list[str]: ...
+
+
+def client_get_range(client: ObjectStoreClient, key: str, start: int,
+                     length: int) -> Optional[bytes]:
+    """Ranged read: ``client.get_range`` when the client offers it (S3
+    Range GETs, seek+read on files), else a full ``get`` sliced host-side.
+    The fallback costs the whole object's bytes over the wire but keeps
+    every protocol-conforming client usable for multi-block span loads."""
+    getter = getattr(client, "get_range", None)
+    if getter is not None:
+        return getter(key, start, length)
+    data = client.get(key)
+    if data is None:
+        return None
+    if start + length > len(data):
+        return None  # short object: treat like a missing range
+    return data[start:start + length]
 
 
 class FSObjectStoreClient:
@@ -81,6 +99,15 @@ class FSObjectStoreClient:
                 return f.read()
         except FileNotFoundError:
             return None
+
+    def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                f.seek(start)
+                data = f.read(length)
+        except FileNotFoundError:
+            return None
+        return data if len(data) == length else None
 
     def exists(self, key: str) -> bool:
         return os.path.exists(self._path(key))
@@ -132,6 +159,17 @@ class S3ObjectStoreClient:  # pragma: no cover - requires boto3 + credentials
         except Exception:
             return False
 
+    def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
+        try:
+            resp = self._s3.get_object(
+                Bucket=self.bucket, Key=key,
+                Range=f"bytes={start}-{start + length - 1}",
+            )
+            data = resp["Body"].read()
+        except self._s3.exceptions.NoSuchKey:
+            return None
+        return data if len(data) == length else None
+
     def delete(self, key: str) -> bool:
         self._s3.delete_object(Bucket=self.bucket, Key=key)
         return True
@@ -173,15 +211,18 @@ class _ObjJob:
     is_store: bool
     started: float
     futures: list = field(default_factory=list)
-    scatters: list = field(default_factory=list)  # (future, page_ids)
+    # (future, page_ids, byte offset into payload, length|None=whole)
+    scatters: list = field(default_factory=list)
     shed_hashes: list = field(default_factory=list)
     nbytes: int = 0
     cancelled: bool = False
+    group_idx: int = 0  # cache group the job's pages belong to
 
 
 class ObjectStoreOffloadHandlers:
     """Async store/load over an object store, same surface as the POSIX
-    handlers."""
+    handlers (per-group copiers for hybrid models, multi-block span
+    objects with ranged loads)."""
 
     def __init__(
         self,
@@ -190,10 +231,20 @@ class ObjectStoreOffloadHandlers:
         mapper: ObjectKeyMapper,
         io_threads: int = 4,
         max_queued_puts: Optional[int] = None,
+        blocks_per_file: int = 1,
+        pages_per_block: int = 1,
+        copiers: Optional[dict[int, TPUBlockCopier]] = None,
     ):
         self.copier = copier
+        # Per-cache-group copiers (hybrid models: group 0 full-attention
+        # pool, group 1 SWA pool); group 0 defaults to ``copier``.
+        self.copiers: dict[int, TPUBlockCopier] = {0: copier}
+        if copiers:
+            self.copiers.update(copiers)
         self.client = client
         self.mapper = mapper
+        self.blocks_per_file = blocks_per_file
+        self.pages_per_block = pages_per_block
         self._executor = futures.ThreadPoolExecutor(
             max_workers=io_threads, thread_name_prefix="objstore-io"
         )
@@ -230,6 +281,8 @@ class ObjectStoreOffloadHandlers:
         self, transfers: Sequence[tuple[int, Sequence[int]]], group_idx: int = 0
     ) -> int:
         job = self._make_job(is_store=True)
+        job.group_idx = group_idx
+        copier = self.copiers[group_idx]
         # Acquire put slots BEFORE gathering: a saturated store must shed
         # without paying device gathers/DMAs for data it will discard.
         admitted: list[tuple[int, list[int]]] = []
@@ -238,7 +291,7 @@ class ObjectStoreOffloadHandlers:
                 admitted.append((block_hash, list(page_ids)))
             else:
                 job.shed_hashes.append(block_hash)
-        slabs = self.copier.gather_many_to_host([p for _, p in admitted])
+        slabs = copier.gather_many_to_host([p for _, p in admitted])
         for (block_hash, _page_ids), slab in zip(admitted, slabs):
             key = self.mapper.block_key(block_hash, group_idx)
             # Zero-copy byte view (bfloat16 etc. lack the buffer protocol,
@@ -254,11 +307,80 @@ class ObjectStoreOffloadHandlers:
         self, transfers: Sequence[tuple[int, Sequence[int]]], group_idx: int = 0
     ) -> int:
         job = self._make_job(is_store=False)
+        job.group_idx = group_idx
         for block_hash, page_ids in transfers:
             key = self.mapper.block_key(block_hash, group_idx)
             fut = self._executor.submit(self.client.get, key)
             job.futures.append(fut)
-            job.scatters.append((fut, list(page_ids)))
+            # (future, page_ids, byte offset into the payload, length|None
+            # = whole payload) — same record shape as the span loads.
+            job.scatters.append((fut, list(page_ids), 0, None))
+        return self._register(job)
+
+    # -- multi-block span objects (unaligned head/tail) --
+
+    def _check_span(self, span: FileSpan) -> None:
+        check_span(span, self.blocks_per_file, self.pages_per_block)
+
+    def async_store_spans(self, spans: Sequence[FileSpan],
+                          group_idx: int = 0) -> int:
+        """Store multi-block spans as whole objects; returns the job id.
+
+        Same durability rule as the POSIX engine: every touched object must
+        be FULLY covered by the spans' union (lookup treats object
+        existence as "stored", and object puts are atomic — a partially-
+        provisioned object would serve holes as successful loads).
+        """
+        by_file = validate_store_coverage(spans, self.blocks_per_file,
+                                          self.pages_per_block)
+
+        job = self._make_job(is_store=True)
+        job.group_idx = group_idx
+        copier = self.copiers[group_idx]
+        object_bytes = (copier.slab_nbytes(self.pages_per_block)
+                        * self.blocks_per_file)
+        admitted: list[FileSpan] = []
+        # Shed whole objects (every span of the object together): a put
+        # slot covers one assembled object buffer.
+        for file_key, file_spans in by_file.items():
+            if self._put_slots.acquire(blocking=False):
+                admitted.extend(file_spans)
+            else:
+                job.shed_hashes.append(file_key)
+        all_slabs = copier.gather_many_to_host(
+            [list(b) for span in admitted for b in span.blocks]
+        )
+        for file_key, buf in assemble_file_buffers(
+                admitted, all_slabs, object_bytes).items():
+            key = self.mapper.block_key(file_key, group_idx)
+            job.nbytes += buf.nbytes
+            fut = self._executor.submit(self.client.put, key, memoryview(buf))
+            fut.add_done_callback(self._put_released)
+            job.futures.append(fut)
+        return self._register(job)
+
+    def async_load_spans(self, spans: Sequence[FileSpan],
+                         group_idx: int = 0) -> int:
+        """Load multi-block spans via ranged object reads (partial objects
+        start at the span's head-offset byte); returns the job id."""
+        for span in spans:
+            self._check_span(span)
+        job = self._make_job(is_store=False)
+        job.group_idx = group_idx
+        copier = self.copiers[group_idx]
+        slot_bytes = copier.slab_nbytes(self.pages_per_block)
+        for span in spans:
+            key = self.mapper.block_key(span.file_key, group_idx)
+            fut = self._executor.submit(
+                client_get_range, self.client, key,
+                span.head_offset * slot_bytes, len(span.blocks) * slot_bytes,
+            )
+            job.futures.append(fut)
+            # One ranged read covers several block slots; split it into
+            # per-block scatters at completion.
+            for k, page_ids in enumerate(span.blocks):
+                job.scatters.append(
+                    (fut, list(page_ids), k * slot_bytes, slot_bytes))
         return self._register(job)
 
     def get_finished(self) -> list[TransferResult]:
@@ -271,24 +393,29 @@ class ObjectStoreOffloadHandlers:
             done_jobs = [self._jobs.pop(jid) for jid in done_ids]
 
         for job in done_jobs:
+            copier = self.copiers[job.group_idx]
             success = not job.cancelled
             for f in job.futures:
                 if f.cancelled() or f.exception() is not None:
                     success = False
                 elif not job.is_store and f.result() is None:
-                    success = False  # missing object
+                    success = False  # missing object / short range
             if success and not job.is_store:
                 batch = []
-                for fut, page_ids in job.scatters:
+                counted = set()
+                for fut, page_ids, off, length in job.scatters:
                     data = fut.result()
+                    if id(fut) not in counted:  # span loads share a future
+                        counted.add(id(fut))
+                        job.nbytes += len(data)
+                    payload = data if length is None else data[off:off + length]
                     batch.append((
-                        np.frombuffer(data, dtype=self.copier.dtype).reshape(
-                            self.copier.slab_shape(len(page_ids))
+                        np.frombuffer(payload, dtype=copier.dtype).reshape(
+                            copier.slab_shape(len(page_ids))
                         ),
                         page_ids,
                     ))
-                    job.nbytes += len(data)
-                self.copier.scatter_many_from_host(batch)
+                copier.scatter_many_from_host(batch)
             results.append(
                 TransferResult(
                     job_id=job.job_id,

@@ -167,7 +167,9 @@ class SharedStorageOffloadSpec:
 
             client, mapper = self._object_pieces()
             return ObjectStoreOffloadHandlers(
-                copier, client, mapper, io_threads=self.io_threads
+                copier, client, mapper, io_threads=self.io_threads,
+                blocks_per_file=self.blocks_per_file,
+                pages_per_block=self.pages_per_block,
             )
         return OffloadHandlers(
             copier,

@@ -122,6 +122,76 @@ def map_blocks_to_file_spans(
     return spans
 
 
+
+def check_span(span: FileSpan, blocks_per_file: int,
+               pages_per_block: int) -> None:
+    """Validate one span against the fixed file geometry (shared by the
+    POSIX and object-store backends)."""
+    if span.head_offset + len(span.blocks) > blocks_per_file:
+        raise ValueError(
+            f"span [{span.head_offset}, "
+            f"{span.head_offset + len(span.blocks)}) exceeds "
+            f"{blocks_per_file} slots")
+    for b in span.blocks:
+        if len(b) != pages_per_block:
+            raise ValueError(
+                f"block has {len(b)} pages, file layout expects "
+                f"{pages_per_block}")
+
+
+def validate_store_coverage(
+    spans: Sequence[FileSpan], blocks_per_file: int, pages_per_block: int
+) -> dict[int, list[FileSpan]]:
+    """Group spans by file and enforce the durability rule: every touched
+    file/object must be FULLY covered by its spans' union — lookup treats
+    existence as "stored" and writes publish atomically, so a partially-
+    provisioned file would serve holes as successful loads. Returns the
+    per-file grouping."""
+    by_file: dict[int, list[FileSpan]] = {}
+    for span in spans:
+        check_span(span, blocks_per_file, pages_per_block)
+        by_file.setdefault(span.file_key, []).append(span)
+    for file_key, file_spans in by_file.items():
+        slots: list[int] = []
+        for lo, hi in sorted((s.head_offset, s.head_offset + len(s.blocks))
+                             for s in file_spans):
+            slots.extend(range(lo, hi))
+        if slots != list(range(blocks_per_file)):
+            raise ValueError(
+                f"store for file {file_key:#x} covers slots {slots}, "
+                f"need all of 0..{blocks_per_file - 1} (files "
+                "publish atomically; partial stores are not durable)")
+    return by_file
+
+
+def assemble_file_buffers(
+    spans: Sequence[FileSpan], slabs: Sequence, expected_file_bytes: int
+) -> dict[int, "np.ndarray"]:
+    """Concatenate per-block slabs into one contiguous uint8 buffer per
+    file, slots ordered by head offset. ``slabs`` aligns with the spans'
+    flattened block lists (the gather output)."""
+    file_parts: dict[int, list[tuple[int, list]]] = {}
+    i = 0
+    for span in spans:
+        part = slabs[i:i + len(span.blocks)]
+        i += len(span.blocks)
+        file_parts.setdefault(span.file_key, []).append(
+            (span.head_offset, part))
+    out: dict[int, "np.ndarray"] = {}
+    for file_key, parts in file_parts.items():
+        flat = [
+            np.ascontiguousarray(s).view(np.uint8).reshape(-1)
+            for _off, ss in sorted(parts, key=lambda p: p[0])
+            for s in ss
+        ]
+        buf = flat[0] if len(flat) == 1 else np.concatenate(flat)
+        assert buf.nbytes == expected_file_bytes, (
+            f"file {file_key:#x}: assembled {buf.nbytes} B, layout "
+            f"expects {expected_file_bytes} B")
+        out[file_key] = buf
+    return out
+
+
 class OffloadHandlers:
     """Bidirectional transfer engine for one worker (one device's caches)."""
 
@@ -244,16 +314,7 @@ class OffloadHandlers:
     # -- multi-block file spans (unaligned head/tail) --
 
     def _check_span(self, span: FileSpan) -> None:
-        if span.head_offset + len(span.blocks) > self.blocks_per_file:
-            raise ValueError(
-                f"span [{span.head_offset}, "
-                f"{span.head_offset + len(span.blocks)}) exceeds "
-                f"{self.blocks_per_file} slots")
-        for b in span.blocks:
-            if len(b) != self.pages_per_block:
-                raise ValueError(
-                    f"block has {len(b)} pages, file layout expects "
-                    f"{self.pages_per_block}")
+        check_span(span, self.blocks_per_file, self.pages_per_block)
 
     def async_store_spans(self, spans: Sequence[FileSpan],
                           group_idx: int = 0) -> int:
@@ -268,23 +329,8 @@ class OffloadHandlers:
         offsets); this mirrors the reference, where a file is one offload
         block and only complete offload blocks are stored.
         """
-        by_file: dict[int, list[FileSpan]] = {}
-        for span in spans:
-            self._check_span(span)
-            by_file.setdefault(span.file_key, []).append(span)
-        for file_key, file_spans in by_file.items():
-            covered = sorted(
-                (s.head_offset, s.head_offset + len(s.blocks))
-                for s in file_spans
-            )
-            slots = []
-            for lo, hi in covered:
-                slots.extend(range(lo, hi))
-            if slots != list(range(self.blocks_per_file)):
-                raise ValueError(
-                    f"store for file {file_key:#x} covers slots {slots}, "
-                    f"need all of 0..{self.blocks_per_file - 1} (files "
-                    "publish atomically; partial stores are not durable)")
+        validate_store_coverage(spans, self.blocks_per_file,
+                                self.pages_per_block)
 
         copier = self.copiers[group_idx]
         file_bytes = copier.slab_nbytes(self.pages_per_block) * self.blocks_per_file
@@ -299,24 +345,8 @@ class OffloadHandlers:
         all_slabs = copier.gather_many_to_host(
             [list(b) for span in spans for b in span.blocks]
         )
-        file_parts: dict[int, list[tuple[int, list]]] = {}
-        i = 0
-        for span in spans:
-            slabs = all_slabs[i:i + len(span.blocks)]
-            i += len(span.blocks)
-            file_parts.setdefault(span.file_key, []).append(
-                (span.head_offset, slabs))
-
-        for file_key, parts in file_parts.items():
-            flat = [
-                s.reshape(-1).view(np.uint8)
-                for _off, slabs in sorted(parts, key=lambda p: p[0])
-                for s in slabs
-            ]
-            buf = flat[0] if len(flat) == 1 else np.concatenate(flat)
-            assert buf.nbytes == file_bytes, (
-                f"file {file_key:#x}: assembled {buf.nbytes} B, layout "
-                f"expects {file_bytes} B")
+        for file_key, buf in assemble_file_buffers(
+                spans, all_slabs, file_bytes).items():
             queued = self.io.submit_write(
                 job_id,
                 self.mapper.block_path(file_key, group_idx),

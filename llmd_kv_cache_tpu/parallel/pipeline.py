@@ -16,9 +16,16 @@ Two schedules:
    (P-1)/(M+P-1) (``pipeline_bubble_fraction``). This is the SPMD
    formulation of pipelined microbatching on TPU (collectives ride ICI;
    the autodiff transpose replays the schedule in reverse, so memory is
-   GPipe-shaped: all forwards live until backwards drain). Composes with
-   dp; tp inside a shard_map stage would need hand-written collectives,
-   so the sequential schedule remains the dp×pp×tp path.
+   GPipe-shaped: all forwards live until backwards drain —
+   ``remat=True`` rematerializes each tick's forward in the backward
+   pass, bounding live activations to the rotating buffer at the cost of
+   one extra forward). Composes with dp AND tp: inside shard_map, XLA
+   cannot derive collectives from sharding annotations, so the tp path is
+   hand-written Megatron — column-parallel wq/wk/wv/w_gate/w_up on local
+   heads/columns, ``psum`` after the row-parallel wo/w_down, a
+   vocab-parallel embedding (mask + psum) and a vocab-parallel
+   cross-entropy (``pmax``/``psum`` log-sum-exp) over the tp-sharded
+   lm_head.
 
 Dense layers only (MoE layers scale across ``ep`` instead).
 """
@@ -145,8 +152,88 @@ def _scan_layers(layers_stacked, cfg: LlamaConfig, x: jax.Array,
     return x
 
 
+def _tp_embed(embed_local: jax.Array, token_ids: jax.Array,
+              tp_axis: str) -> jax.Array:
+    """Vocab-parallel embedding lookup: each tp shard holds a contiguous
+    row slice; out-of-slice ids contribute zero and the ``psum`` assembles
+    the full vectors (Megatron VocabParallelEmbedding)."""
+    rows = embed_local.shape[0]
+    shard = jax.lax.axis_index(tp_axis)
+    local_ids = token_ids - shard * rows
+    ok = (local_ids >= 0) & (local_ids < rows)
+    e = embed_local[jnp.clip(local_ids, 0, rows - 1)]
+    return jax.lax.psum(jnp.where(ok[..., None], e, 0), tp_axis)
+
+
+def _tp_layer_step(x: jax.Array, layer: dict, cfg: LlamaConfig,
+                   positions: jax.Array, tp_axis: str) -> jax.Array:
+    """One dense layer on tp-local weight shards with explicit collectives.
+
+    Column-parallel wq/wk/wv give each shard ``num_heads/tp`` query heads
+    (heads are attention-independent, so no collective until the output
+    projection); the row-parallel wo/w_down products are partial sums over
+    the hidden/intermediate slices, fixed by one ``psum`` each — the
+    hand-written form of what XLA derives from sharding annotations in the
+    sequential schedule.
+    """
+    from ..models.llama import _rope
+
+    batch, seq = x.shape[0], x.shape[1]
+    attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (attn_in @ layer["wq"]).reshape(batch, seq, -1, cfg.head_dim)
+    k = (attn_in @ layer["wk"]).reshape(batch, seq, -1, cfg.head_dim)
+    v = (attn_in @ layer["wv"]).reshape(batch, seq, -1, cfg.head_dim)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.num_heads != cfg.num_kv_heads:
+        rep = cfg.num_heads // cfg.num_kv_heads  # per-shard ratio unchanged
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    causal = jnp.tril(jnp.ones((seq, seq), bool))
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * (cfg.head_dim ** -0.5)
+    scores = jnp.where(causal[None, None], scores, -1e30)
+    attn = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
+        v.astype(jnp.float32),
+    ).astype(x.dtype)
+    attn_out = attn.reshape(batch, seq, -1) @ layer["wo"]
+    x = x + jax.lax.psum(attn_out, tp_axis)
+
+    mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    h = jax.nn.silu(mlp_in @ layer["w_gate"]) * (mlp_in @ layer["w_up"])
+    return x + jax.lax.psum(h @ layer["w_down"], tp_axis)
+
+
+def _tp_vocab_parallel_nll(h: jax.Array, lm_head_local: jax.Array,
+                           targets: jax.Array, tp_axis: str) -> jax.Array:
+    """Cross-entropy over a vocab-sharded head without materializing the
+    full logits on any shard: a ``pmax``/``psum`` log-sum-exp plus a
+    masked ``psum`` gather of each target's logit (Megatron
+    vocab-parallel cross-entropy). ``h`` is [b, s, hidden] (positions
+    already shifted); ``targets`` is [b, s]."""
+    logits = (h @ lm_head_local).astype(jnp.float32)  # [b, s, vocab/tp]
+    v_local = logits.shape[-1]
+    # The stability shift is gradient-free (it cancels in lse - tgt), and
+    # pmax has no differentiation rule — detach before the collective.
+    m = jax.lax.pmax(
+        jnp.max(jax.lax.stop_gradient(logits), axis=-1), tp_axis)  # [b, s]
+    lse = jnp.log(jax.lax.psum(
+        jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), tp_axis)) + m
+    shard = jax.lax.axis_index(tp_axis)
+    local_t = targets - shard * v_local
+    ok = (local_t >= 0) & (local_t < v_local)
+    tgt = jnp.take_along_axis(
+        logits, jnp.clip(local_t, 0, v_local - 1)[..., None], axis=-1
+    )[..., 0]
+    tgt = jax.lax.psum(jnp.where(ok, tgt, 0.0), tp_axis)
+    return lse - tgt  # [b, s] per-token NLL
+
+
 def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
-                                 opt, num_microbatches: int):
+                                 opt, num_microbatches: int,
+                                 remat: bool = False):
     """Microbatched rotating-buffer pipeline over ``mesh``'s ``pp`` axis
     (× optional ``dp``).
 
@@ -159,10 +246,6 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if "pp" not in axis_sizes:
         raise ValueError("pipelined training requires a 'pp' mesh axis")
-    if axis_sizes.get("tp", 1) > 1:
-        raise ValueError(
-            "the pipelined schedule composes with dp only; use "
-            "make_pp_train_step for dp×pp×tp")
     if cfg.num_experts > 0:
         raise ValueError("pipeline path supports dense layers (MoE uses ep)")
     P_size = axis_sizes["pp"]
@@ -171,18 +254,28 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
         raise ValueError(
             f"num_layers ({cfg.num_layers}) must divide by pp size ({P_size})")
     dp = "dp" if "dp" in axis_sizes else None
+    tp_size = axis_sizes.get("tp", 1)
+    tp = "tp" if tp_size > 1 else None
+    if tp is not None:
+        if cfg.num_kv_heads % tp_size or cfg.vocab_size % tp_size:
+            raise ValueError(
+                f"tp={tp_size} must divide num_kv_heads "
+                f"({cfg.num_kv_heads}) and vocab_size ({cfg.vocab_size})")
 
     stacked = stack_layer_params(params)
+    # has_tp=True already places the embedding vocab-parallel (P(tp, None))
+    # and lm_head column-parallel — the Megatron layout the hand-written
+    # collectives below assume.
+    param_specs = stacked_param_pspecs(tp is not None, "pp")
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        stacked_param_pspecs(False, "pp"),
+        param_specs,
         is_leaf=lambda x: isinstance(x, P),
     )
     stacked = jax.device_put(stacked, shardings)
     opt_state = opt.init(stacked)
     data_sharding = NamedSharding(mesh, P(dp, None))
 
-    param_specs = stacked_param_pspecs(False, "pp")
     perm = [(i, i + 1) for i in range(P_size - 1)]
 
     def pipeline_loss(sp, tokens):
@@ -195,6 +288,30 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
         positions = jnp.arange(S)[None, :].repeat(b // M, axis=0)
         stage = jax.lax.axis_index("pp")
         layers_local = sp["layers_stacked"]
+
+        def embed(ids):
+            if tp is not None:
+                return _tp_embed(sp["embed"], ids, tp)
+            return sp["embed"][ids]
+
+        def run_layers(x):
+            if tp is not None:
+                def layer_step(x, layer):
+                    return _tp_layer_step(x, layer, cfg, positions, tp), None
+
+                x, _ = jax.lax.scan(layer_step, x, layers_local)
+                return x
+            return _scan_layers(layers_local, cfg, x, positions)
+
+        def head_nll(y, mb_out):
+            h = _rms_norm(y, sp["final_norm"], cfg.norm_eps)
+            if tp is not None:
+                return _tp_vocab_parallel_nll(
+                    h[:, :-1], sp["lm_head"], mb_out[:, 1:], tp)
+            logits = (h @ sp["lm_head"]).astype(jnp.float32)
+            logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            return -jnp.take_along_axis(
+                logprobs, mb_out[:, 1:][..., None], axis=-1)[..., 0]
 
         # Streams padded to M+P-1 ticks: stage 0 consumes microbatch t;
         # the last stage emits microbatch t-(P-1), so its target stream is
@@ -209,20 +326,23 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
             # Activations hop one stage forward; stage 0's slot is then
             # replaced by the fresh microbatch's embedding.
             recv = jax.lax.ppermute(x_prev, "pp", perm)
-            injected = sp["embed"][mb_in]
+            injected = embed(mb_in)
             x_in = jnp.where(stage == 0, injected, recv)
-            y = _scan_layers(layers_local, cfg, x_in, positions)
+            y = run_layers(x_in)
             # Last stage: head + NLL for the microbatch leaving the pipe.
-            h = _rms_norm(y, sp["final_norm"], cfg.norm_eps)
-            logits = (h @ sp["lm_head"]).astype(jnp.float32)
-            logprobs = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-            nll = -jnp.take_along_axis(
-                logprobs, mb_out[:, 1:][..., None], axis=-1)[..., 0]
+            nll = head_nll(y, mb_out)
             # Count only drain ticks (t >= P-1): earlier ticks see the
             # zero-initialized buffer, not a real microbatch.
             valid = jnp.logical_and(stage == P_size - 1, t >= P_size - 1)
             loss_acc = loss_acc + jnp.where(valid, nll.mean(), 0.0)
             return (y, loss_acc), None
+
+        if remat:
+            # Bound activation memory to the rotating buffer: the backward
+            # pass replays each tick's forward instead of keeping all
+            # M+P-1 tick activations live (GPipe memory → ~1F1B memory,
+            # paid with one extra forward).
+            tick = jax.checkpoint(tick)
 
         x0 = jnp.zeros((b // M, S, cfg.hidden_size),
                        sp["embed"].dtype)
@@ -234,6 +354,8 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
         # across the pipeline (sum picks up the last stage's value) and
         # data shards.
         loss = jax.lax.psum(loss_sum / M, "pp")
+        # (Already replicated across tp: every shard computed the same
+        # post-psum NLL, so no tp collective is needed here.)
         if dp is not None:
             loss = jax.lax.pmean(loss, dp)
         return loss

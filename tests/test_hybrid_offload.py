@@ -113,13 +113,32 @@ class TestHybridOffloadGuards:
         fp_split = make_spec(tmp_path, swa_layers=(0,)).build_mapper().fingerprint
         assert len({fp8, fp16, fp_split}) == 3
 
-    def test_object_backend_rejected_for_hybrid(self, tmp_path):
-        spec = make_spec(tmp_path, backend="object")
-        with pytest.raises(NotImplementedError, match="per-group"):
-            MiniEngine(
-                EngineConfig(
-                    model=hybrid_cfg(), num_pages=64, max_pages_per_seq=16,
-                    model_name="tiny-hybrid", pod_identifier="pod-h",
-                ),
-                offload_spec=spec,
-            )
+    def test_object_backend_hybrid_restore(self, tmp_path):
+        """The object-store backend routes per-group copiers too: a hybrid
+        engine writes both groups and a fresh pod resumes from the store."""
+        spec = make_spec(tmp_path, backend="object", parallel_agnostic=True)
+        warm = MiniEngine(
+            EngineConfig(
+                model=hybrid_cfg(), num_pages=64, max_pages_per_seq=16,
+                model_name="tiny-hybrid", pod_identifier="pod-h",
+            ),
+            offload_spec=spec,
+        )
+        out_cold = warm.generate("a", PROMPT, max_new_tokens=4)
+        warm.flush_offload()
+        warm.offload_handlers.shutdown()
+
+        resumed = MiniEngine(
+            EngineConfig(
+                model=hybrid_cfg(), num_pages=64, max_pages_per_seq=16,
+                model_name="tiny-hybrid", pod_identifier="pod-i",
+            ),
+            offload_spec=make_spec(tmp_path, backend="object",
+                                   parallel_agnostic=True),
+        )
+        req = resumed.add_request("b", PROMPT, max_new_tokens=4)
+        assert req.cached_len == len(PROMPT) // PAGE * PAGE
+        while not req.done:
+            resumed.step()
+        assert req.output == out_cold
+        resumed.offload_handlers.shutdown()

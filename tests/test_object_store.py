@@ -132,3 +132,135 @@ class TestEngineWithObjectBackend:
         while not req.done:
             b.step()
         assert req.output == out_a
+
+
+class TestObjectSpans:
+    """Multi-block span objects: whole-object atomic stores, ranged loads
+    at nonzero head offsets (mirrors the POSIX engine's file spans)."""
+
+    def make_handlers(self, tmp_path, blocks_per_file=4, client=None, seed=0):
+        from llmd_kv_cache_tpu.offload.worker import FileSpan  # noqa: F401
+        k, v = make_caches(seed)
+        client = client or FSObjectStoreClient(str(tmp_path))
+        mapper = ObjectKeyMapper(prefix="kv", fingerprint="test",
+                                 parallel_agnostic=True)
+        return ObjectStoreOffloadHandlers(
+            TPUBlockCopier(k, v), client, mapper, io_threads=2,
+            blocks_per_file=blocks_per_file, pages_per_block=1,
+        ), client, mapper
+
+    def test_four_block_object_roundtrip(self, tmp_path):
+        from llmd_kv_cache_tpu.offload.worker import FileSpan
+        handlers, client, mapper = self.make_handlers(tmp_path)
+        try:
+            pages = [1, 2, 3, 4]
+            orig_k = np.asarray(handlers.copier.k_cache[:, pages])
+            span = FileSpan(file_key=0xF11E, head_offset=0,
+                            blocks=[[p] for p in pages])
+            assert wait_results(handlers, handlers.async_store_spans([span])).success
+            data = client.get(mapper.block_key(0xF11E, 0))
+            assert data is not None
+            assert len(data) == 4 * handlers.copier.slab_nbytes(1)
+
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, pages].set(0)
+            handlers.copier.v_cache = handlers.copier.v_cache.at[:, pages].set(0)
+            assert wait_results(handlers, handlers.async_load_spans([span])).success
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, pages]), orig_k)
+        finally:
+            handlers.shutdown()
+
+    def test_partial_ranged_load_at_head_offset(self, tmp_path):
+        from llmd_kv_cache_tpu.offload.worker import FileSpan
+        handlers, client, mapper = self.make_handlers(tmp_path)
+        try:
+            pages = [1, 2, 3, 4]
+            orig_k = np.asarray(handlers.copier.k_cache[:, [3, 4]])
+            full = FileSpan(file_key=0xF22E, head_offset=0,
+                            blocks=[[p] for p in pages])
+            assert wait_results(handlers, handlers.async_store_spans([full])).success
+
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, [3, 4]].set(0)
+            partial = FileSpan(file_key=0xF22E, head_offset=2,
+                               blocks=[[3], [4]])
+            res = wait_results(handlers, handlers.async_load_spans([partial]))
+            assert res.success
+            assert res.bytes_transferred == 2 * handlers.copier.slab_nbytes(1)
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, [3, 4]]), orig_k)
+        finally:
+            handlers.shutdown()
+
+    def test_range_fallback_without_get_range(self, tmp_path):
+        """A minimal client with no get_range still serves span loads via
+        the full-get fallback slice."""
+        from llmd_kv_cache_tpu.offload.worker import FileSpan
+
+        class MinimalClient:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def put(self, key, data):
+                self.inner.put(key, data)
+
+            def get(self, key):
+                return self.inner.get(key)
+
+            def exists(self, key):
+                return self.inner.exists(key)
+
+            def delete(self, key):
+                return self.inner.delete(key)
+
+            def list_keys(self, prefix):
+                return self.inner.list_keys(prefix)
+
+        client = MinimalClient(FSObjectStoreClient(str(tmp_path)))
+        handlers, _, _ = self.make_handlers(tmp_path, client=client)
+        try:
+            pages = [1, 2, 3, 4]
+            orig_k = np.asarray(handlers.copier.k_cache[:, [2]])
+            full = FileSpan(file_key=0xF33E, head_offset=0,
+                            blocks=[[p] for p in pages])
+            assert wait_results(handlers, handlers.async_store_spans([full])).success
+            handlers.copier.k_cache = handlers.copier.k_cache.at[:, [2]].set(0)
+            res = wait_results(handlers, handlers.async_load_spans(
+                [FileSpan(file_key=0xF33E, head_offset=1, blocks=[[2]])]))
+            assert res.success
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, [2]]), orig_k)
+        finally:
+            handlers.shutdown()
+
+    def test_partial_store_coverage_rejected(self, tmp_path):
+        from llmd_kv_cache_tpu.offload.worker import FileSpan
+        handlers, _, _ = self.make_handlers(tmp_path)
+        try:
+            with pytest.raises(ValueError, match="publish atomically"):
+                handlers.async_store_spans(
+                    [FileSpan(file_key=0xBAD, head_offset=0, blocks=[[1], [2]])]
+                )
+        finally:
+            handlers.shutdown()
+
+    def test_per_group_copiers_route_to_own_pool(self, tmp_path):
+        """Group 1 transfers hit the group-1 copier's pools (hybrid SWA)."""
+        handlers, _, _ = self.make_handlers(tmp_path)
+        try:
+            k1, v1 = make_caches(seed=7)
+            handlers.copiers[1] = TPUBlockCopier(k1, v1)
+            orig = np.asarray(k1[:, [5]])
+            g0_before = np.asarray(handlers.copier.k_cache[:, [5]])
+            job = handlers.async_store_blocks([(0xD1, [5])], group_idx=1)
+            assert wait_results(handlers, job).success
+
+            c1 = handlers.copiers[1]
+            c1.k_cache = c1.k_cache.at[:, 5].set(0)
+            job2 = handlers.async_load_blocks([(0xD1, [5])], group_idx=1)
+            assert wait_results(handlers, job2).success
+            np.testing.assert_array_equal(np.asarray(c1.k_cache[:, [5]]), orig)
+            # group 0's pool is untouched by the group-1 traffic
+            np.testing.assert_array_equal(
+                np.asarray(handlers.copier.k_cache[:, [5]]), g0_before)
+        finally:
+            handlers.shutdown()
