@@ -41,11 +41,21 @@ def build_workload(rng, n_requests=64, n_prefixes=8, prefix_len=256, suffix_len=
     return workload
 
 
-def make_pods(n_pods, model_cfg, engine_mod, indexer):
-    """Fresh engine pods wired to feed the indexer's index via events."""
+def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None):
+    """Fresh engine pods wired to feed the indexer's index via events.
+
+    All pods share one parameter tree (same seed anyway — the engines
+    never donate params); per-pod init costs ~minutes of per-op dispatch
+    on a remote-tunneled TPU.
+    """
+    import jax
+
     from llmd_kv_cache_tpu.events.model import EventBatch
     from llmd_kv_cache_tpu.events.pool import Pool, PoolConfig
+    from llmd_kv_cache_tpu.models.llama import init_params
 
+    if params is None:
+        params = init_params(jax.random.PRNGKey(0), model_cfg)
     pool = Pool(PoolConfig(concurrency=1), indexer.kv_block_index,
                 indexer.token_processor)
     pods = {}
@@ -71,6 +81,7 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer):
                 pod_identifier=name,
             ),
             event_sink=sink,
+            params=params,
             seed=0,
         )
     return pods
@@ -287,8 +298,11 @@ def main() -> None:
     # pollute TTFT for either arm.
     import sys as _sys
     _t0 = time.perf_counter()
+    from llmd_kv_cache_tpu.models.llama import init_params as _init_params
+    shared_params = _init_params(jax.random.PRNGKey(0), model_cfg)
     warm_indexer = fresh_indexer()
-    warm = make_pods(1, model_cfg, engine_mod, warm_indexer)["pod-0"]
+    warm = make_pods(1, model_cfg, engine_mod, warm_indexer,
+                     params=shared_params)["pod-0"]
     for seq_pages in (1, 2, 4, 8, 16, 32):
         _tb = time.perf_counter()
         prompt = rng.integers(1, 8000, seq_pages * model_cfg.page_size).tolist()
@@ -301,7 +315,8 @@ def main() -> None:
 
     # Arm 1: round-robin routing.
     rr_indexer = fresh_indexer()
-    rr_pods = make_pods(n_pods, model_cfg, engine_mod, rr_indexer)
+    rr_pods = make_pods(n_pods, model_cfg, engine_mod, rr_indexer,
+                        params=shared_params)
     rr_ttfts = run_replay(
         rr_pods, workload, router=lambda i, _p, names: names[i % len(names)],
         tag="round-robin",
@@ -309,7 +324,8 @@ def main() -> None:
 
     # Arm 2: KV-cache-aware routing via the Indexer.
     kv_indexer = fresh_indexer()
-    kv_pods = make_pods(n_pods, model_cfg, engine_mod, kv_indexer)
+    kv_pods = make_pods(n_pods, model_cfg, engine_mod, kv_indexer,
+                        params=shared_params)
     rr_counter = [0]
 
     def kv_router(_i, prompt, names):
