@@ -45,6 +45,9 @@ class LlamaConfig:
     # full attention. Both unset → pure full attention.
     sliding_window: Any = None  # Optional[int]
     swa_layers: tuple = ()
+    # Per-head RMSNorm on Q and K before RoPE (Qwen3-style QK-norm).
+    # With GQA this makes the family cover Qwen3; False = plain Llama.
+    qk_norm: bool = False
     # Mixture-of-experts MLP (Mixtral-style): 0 → dense. Experts shard over
     # the ``ep`` mesh axis.
     num_experts: int = 0
@@ -99,6 +102,17 @@ class LlamaConfig:
             num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
         )
 
+    @classmethod
+    def qwen3_tiny(cls) -> "LlamaConfig":
+        """Test-sized Qwen3-family config (GQA + QK-norm — the
+        architecture of the reference's headline benchmark model,
+        ``benchmarking/73-capacity`` Qwen3-32B)."""
+        return cls(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+            qk_norm=True,
+        )
+
 
 def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     """Initialize parameters (truncated-normal projections, ones norms).
@@ -131,6 +145,9 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
             "wo": dense(lk[3], (cfg.num_heads * hd, h)),
             "mlp_norm": jnp.ones((h,), jnp.float32),
         }
+        if cfg.qk_norm:
+            layer["q_norm"] = jnp.ones((hd,), jnp.float32)
+            layer["k_norm"] = jnp.ones((hd,), jnp.float32)
         if cfg.num_experts > 0:
             e, inter = cfg.num_experts, cfg.intermediate_size
             layer.update({
@@ -349,6 +366,9 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
         q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
         k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
+            q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
+            k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 

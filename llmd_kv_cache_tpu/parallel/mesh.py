@@ -44,7 +44,7 @@ def make_mesh(
 
 
 def param_pspecs(has_tp: bool = True, has_ep: bool = False,
-                 moe_layer: bool = False) -> dict:
+                 moe_layer: bool = False, qk_norm: bool = False) -> dict:
     """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
@@ -64,6 +64,8 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
         "wo": P(tp, None),
         "mlp_norm": P(),
     }
+    if qk_norm:
+        layer.update({"q_norm": P(), "k_norm": P()})
     if moe_layer:
         layer.update({
             "router": P(),
@@ -96,8 +98,10 @@ def param_shardings(mesh: Mesh, params: Params) -> dict:
     has_tp = "tp" in mesh.axis_names
     has_ep = "ep" in mesh.axis_names
     moe = "router" in params["layers"][0]
+    qk = "q_norm" in params["layers"][0]
     specs = _tree_with_layers(
-        param_pspecs(has_tp, has_ep, moe_layer=moe), len(params["layers"])
+        param_pspecs(has_tp, has_ep, moe_layer=moe, qk_norm=qk),
+        len(params["layers"])
     )
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),

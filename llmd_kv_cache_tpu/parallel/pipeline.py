@@ -73,13 +73,17 @@ def unstack_layer_params(stacked_params: dict) -> Params:
     }
 
 
-def stacked_param_pspecs(has_tp: bool, pp_axis: Optional[str]) -> dict:
+def stacked_param_pspecs(has_tp: bool, pp_axis: Optional[str],
+                         qk_norm: bool = False) -> dict:
     """PartitionSpecs for the stacked tree: layer axis over ``pp``, the
     Megatron tp layout within each layer."""
     tp = "tp" if has_tp else None
+    qk = ({"q_norm": P(pp_axis, None), "k_norm": P(pp_axis, None)}
+          if qk_norm else {})
     return {
         "embed": P(tp, None),
         "layers_stacked": {
+            **qk,
             "attn_norm": P(pp_axis, None),
             "wq": P(pp_axis, None, tp),
             "wk": P(pp_axis, None, tp),
@@ -183,6 +187,9 @@ def _tp_layer_step(x: jax.Array, layer: dict, cfg: LlamaConfig,
     q = (attn_in @ layer["wq"]).reshape(batch, seq, -1, cfg.head_dim)
     k = (attn_in @ layer["wk"]).reshape(batch, seq, -1, cfg.head_dim)
     v = (attn_in @ layer["wv"]).reshape(batch, seq, -1, cfg.head_dim)
+    if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
+        q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
+        k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if cfg.num_heads != cfg.num_kv_heads:
@@ -266,7 +273,8 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
     # has_tp=True already places the embedding vocab-parallel (P(tp, None))
     # and lm_head column-parallel — the Megatron layout the hand-written
     # collectives below assume.
-    param_specs = stacked_param_pspecs(tp is not None, "pp")
+    param_specs = stacked_param_pspecs(tp is not None, "pp",
+                                       qk_norm=cfg.qk_norm)
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
         param_specs,
@@ -398,7 +406,7 @@ def make_pp_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params, opt):
     stacked = stack_layer_params(params)
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        stacked_param_pspecs(has_tp, "pp"),
+        stacked_param_pspecs(has_tp, "pp", qk_norm=cfg.qk_norm),
         is_leaf=lambda x: isinstance(x, P),
     )
     stacked = jax.device_put(stacked, shardings)
