@@ -40,6 +40,7 @@ from .llama import (
     LlamaConfig,
     forward,
     forward_decode_pallas,
+    forward_decode_steps,
     forward_hybrid,
     forward_prefill_pallas,
     init_kv_cache,
@@ -75,6 +76,13 @@ class EngineConfig:
     # most this many tokens (vLLM-style), bounding per-step activation
     # memory for long prompts. Must be a multiple of the page size.
     max_prefill_tokens: int = 512
+    # Fused decode bursts: up to this many greedy tokens per device
+    # dispatch (lax.scan inside one jit). 1 = one token per step() —
+    # finest-grained continuous batching; larger values amortize dispatch
+    # overhead (dominant on remote-tunneled TPUs, material everywhere) at
+    # the cost of admitting new requests only between bursts. Bursts are
+    # bucketed to powers of two so the jit cache stays O(log burst).
+    decode_burst: int = 1
 
 
 @dataclass
@@ -406,6 +414,11 @@ class MiniEngine:
                 logger.warning("hybrid model: Pallas decode unavailable, "
                                "using XLA paged attention")
             use_pallas = False
+            if self.cfg.decode_burst > 1:
+                logger.warning(
+                    "hybrid model: fused decode bursts unavailable (the SWA "
+                    "pool's just-in-time paging needs host control between "
+                    "tokens); decoding one token per step")
         if use_pallas:
             self._decode_forward = functools.partial(
                 forward_decode_pallas, interpret=not on_tpu
@@ -416,6 +429,10 @@ class MiniEngine:
         else:
             self._decode_forward = forward
             self._prefill_forward = forward
+        self._decode_multi = functools.partial(
+            forward_decode_steps, use_pallas=use_pallas,
+            interpret=use_pallas and not on_tpu,
+        )
 
         # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
         # write-through on commit, restore on prefix miss at admission.
@@ -900,10 +917,13 @@ class MiniEngine:
     # -- decode --
 
     def step(self) -> dict[str, int]:
-        """One greedy decode step for every running request.
+        """One decode step for every running request.
 
-        Returns {request_id: new_token}. Batched into a single jit call with
-        padding up to max_batch.
+        Returns {request_id: newest_token}. Batched into a single jit call
+        with padding up to max_batch; when ``decode_burst > 1`` each call
+        may emit a power-of-two burst of tokens per request (all of a
+        request's burst tokens land in ``req.output``; the returned dict
+        carries the newest).
         """
         self.poll_offload()
         active = [self.requests[rid] for rid in self._running
@@ -911,7 +931,12 @@ class MiniEngine:
         emitted: dict[str, int] = {}
         for chunk_start in range(0, len(active), self.cfg.max_batch):
             chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
-            emitted.update(self._decode_chunk(chunk))
+            burst = (self._decode_burst_size(chunk)
+                     if self.cfg.decode_burst > 1 and not self.hybrid else 1)
+            if burst > 1:
+                emitted.update(self._decode_chunk_burst(chunk, burst))
+            else:
+                emitted.update(self._decode_chunk(chunk))
         for rid in list(self._running):
             req = self.requests[rid]
             if req.done:
@@ -985,24 +1010,73 @@ class MiniEngine:
         # host memory unboundedly on a serving pod.
         self.requests.pop(req.request_id, None)
 
+    def _decode_batch_arrays(self, chunk: list[Request]):
+        """Padded per-row decode inputs shared by the single-step and burst
+        paths: (last tokens, computed context, page tables). The last
+        token may have come from sampling with its KV not yet computed —
+        that is why positions derive from ``computed_len``, and both paths
+        must keep doing so."""
+        b = self.cfg.max_batch
+        last = np.zeros((b,), np.int32)
+        ctx = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
+        for i, req in enumerate(chunk):
+            last[i] = req.output[-1] if req.output else req.prompt[-1]
+            ctx[i] = req.computed_len
+            tables[i] = self._page_table_for(req)
+        return last, ctx, tables
+
+    def _decode_burst_size(self, chunk: list[Request]) -> int:
+        """Largest power-of-two burst worth dispatching: bounded by
+        cfg.decode_burst and the chunk's MAXIMUM remaining budget (per-row
+        budgets freeze finished rows on-device, so a near-done request
+        never drags the whole chunk down to its remainder)."""
+        remaining = max(r.max_new_tokens - len(r.output) for r in chunk)
+        t = 1
+        while t * 2 <= min(self.cfg.decode_burst, remaining):
+            t *= 2
+        return t
+
+    def _decode_chunk_burst(self, chunk: list[Request], steps: int) -> dict[str, int]:
+        """Fused multi-token decode: one dispatch emits up to ``steps``
+        greedy tokens per row (``forward_decode_steps``); each row decodes
+        until its own remaining budget and freezes after. Non-hybrid only —
+        the SWA pool's just-in-time page dance needs host control between
+        tokens."""
+        last, ctx, tables = self._decode_batch_arrays(chunk)
+        budgets = np.zeros((self.cfg.max_batch,), np.int32)
+        for i, req in enumerate(chunk):
+            budgets[i] = req.max_new_tokens - len(req.output)
+
+        toks, self.k_cache, self.v_cache = self._decode_multi(
+            self.params, self.cfg.model,
+            jnp.asarray(last), self.k_cache, self.v_cache,
+            jnp.asarray(tables), jnp.asarray(ctx, jnp.int32),
+            jnp.asarray(budgets), steps=steps,
+        )
+        toks_host = np.asarray(toks)
+        out = {}
+        for i, req in enumerate(chunk):
+            taken = min(steps, int(budgets[i]))
+            burst = [int(t) for t in toks_host[i, :taken]]
+            req.output.extend(burst)
+            req.computed_len += taken
+            out[req.request_id] = burst[-1]
+            if len(req.output) >= req.max_new_tokens:
+                req.done = True
+        return out
+
     def _decode_chunk(self, chunk: list[Request]) -> dict[str, int]:
         # Pad to max_batch so decode compiles exactly once regardless of the
         # active-request count; padded rows have new_lens=0 (all writes go
         # to the garbage page, logits ignored).
         b = self.cfg.max_batch
-        tokens = np.zeros((b, 1), np.int32)
-        ctx = np.zeros((b,), np.int32)
+        last, ctx, tables = self._decode_batch_arrays(chunk)
+        tokens = last[:, None].copy()
         new_lens = np.zeros((b,), np.int32)
-        tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
         swa_tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
         for i, req in enumerate(chunk):
-            last = (req.output[-1] if req.output else req.prompt[-1])
-            tokens[i, 0] = last
-            # the last token's KV may not be computed yet when it came from
-            # sampling; positions: attend with context = computed_len
-            ctx[i] = req.computed_len
             new_lens[i] = 1
-            tables[i] = self._page_table_for(req)
             if self.hybrid:
                 # The new token's KV writes at block computed_len//page —
                 # make sure that SWA slot has a live page.

@@ -499,6 +499,76 @@ def forward_decode_pallas(
 
 @partial(
     jax.jit,
+    static_argnames=("cfg", "steps", "use_pallas", "interpret"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def forward_decode_steps(
+    params: Params,
+    cfg: LlamaConfig,
+    last_tokens: jax.Array,  # [batch] int32 — the most recent token per row
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [batch, pages_per_seq] int32
+    ctx_lens: jax.Array,  # [batch] computed context before this call
+    active: jax.Array,  # [batch] 1 for live rows, 0 for padding
+    steps: int,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy decode of ``steps`` tokens fused into ONE XLA program.
+
+    A ``lax.scan`` over the single-token decode body: each tick scatters
+    the previous token's KV, attends, and argmaxes the next token —
+    device-resident the whole way, so a burst costs one dispatch and one
+    logits-free [batch, steps] token download instead of ``steps``
+    round-trips. On a remote-tunneled TPU this is the difference between
+    dispatch-bound and compute-bound decode; on-host it still removes
+    per-token launch overhead and logits transfers.
+
+    ``active`` is each row's remaining token budget, not a binary mask: a
+    row decodes while the tick index is below its budget and freezes after
+    (writes land in the garbage page, context stops advancing, the token
+    output repeats its final value) — so one burst serves a mixed batch
+    where requests finish at different ticks, and rows with ``active == 0``
+    are inert padding throughout. Page tables must already cover
+    ``ctx + min(active, steps)`` tokens (the engine preallocates through
+    ``max_new_tokens`` at admission).
+    Returns ``(tokens [batch, steps], k_cache, v_cache)``; row i's valid
+    entries are the first ``min(active[i], steps)``.
+    """
+    from ..ops.pallas_paged_attention import pallas_paged_decode_attention
+
+    def attention(q, k_l, v_l, table, positions, total_lens, window):
+        if use_pallas:
+            out = pallas_paged_decode_attention(
+                q[:, 0], k_l, v_l, table, total_lens,
+                sliding_window=window, interpret=interpret,
+            )
+            return out[:, None]
+        return paged_attention(
+            q, k_l, v_l, table, positions, total_lens, sliding_window=window
+        )
+
+    def body(carry, tick):
+        toks, kc, vc, ctx = carry
+        live = (tick < active).astype(jnp.int32)  # [batch]
+        logits, kc, vc = _forward_impl(
+            params, cfg, toks[:, None], kc, vc, page_table, ctx, live,
+            attention,
+        )
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(live > 0, nxt, toks)
+        return (nxt, kc, vc, ctx + live), nxt
+
+    (_t, k_cache, v_cache, _c), toks = jax.lax.scan(
+        body, (last_tokens, k_cache, v_cache, ctx_lens),
+        jnp.arange(steps, dtype=jnp.int32),
+    )
+    return toks.T, k_cache, v_cache  # [batch, steps]
+
+
+@partial(
+    jax.jit,
     static_argnames=("cfg", "interpret"),
     donate_argnames=("k_cache", "v_cache"),
 )

@@ -267,3 +267,76 @@ class TestEngineIndexerLoop:
         )
         scores = indexer.score_tokens(shared_prefix, "tiny")
         assert "pod-b" not in scores
+
+
+class TestDecodeBurst:
+    """Fused multi-token decode (forward_decode_steps): burst size must be
+    a pure dispatch-count optimization — greedy outputs identical to
+    single-token stepping."""
+
+    def _generate(self, burst, use_pallas=False, max_new=7):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        eng = MiniEngine(
+            EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="tiny",
+                         pod_identifier="p", decode_burst=burst,
+                         use_pallas_decode=use_pallas or None),
+            seed=0,
+        )
+        return eng.generate("r", list(range(30, 42)), max_new_tokens=max_new)
+
+    def test_burst_matches_single_step(self):
+        assert self._generate(burst=4) == self._generate(burst=1)
+
+    def test_burst_matches_single_step_pallas(self):
+        assert (self._generate(burst=4, use_pallas=True)
+                == self._generate(burst=1, use_pallas=True))
+
+    def test_burst_exceeding_remaining_is_clamped(self):
+        # max_new 3: bursts must go 2, then 1 — never overshoot
+        out = self._generate(burst=8, max_new=3)
+        assert len(out) == 3
+        assert out == self._generate(burst=1, max_new=3)
+
+    def test_burst_mixed_batch(self):
+        """Two requests decoding together with different remaining budgets:
+        the chunk takes the min-bounded burst and both finish correctly."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        def run(burst):
+            eng = MiniEngine(
+                EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                             max_pages_per_seq=16, model_name="tiny",
+                             pod_identifier="p", decode_burst=burst),
+                seed=0,
+            )
+            a = eng.add_request("a", list(range(10, 22)), max_new_tokens=5)
+            b = eng.add_request("b", list(range(50, 66)), max_new_tokens=3)
+            while not (a.done and b.done):
+                eng.step()
+            return a.output, b.output
+
+        assert run(4) == run(1)
+
+    def test_burst_not_clamped_by_near_done_request(self):
+        """Per-row budget freezing: a request about to finish must not drag
+        the whole chunk's burst down to its remainder."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        eng = MiniEngine(
+            EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="tiny",
+                         pod_identifier="p", decode_burst=8),
+            seed=0,
+        )
+        a = eng.add_request("a", list(range(10, 22)), max_new_tokens=9)
+        b = eng.add_request("b", list(range(50, 66)), max_new_tokens=2)
+        # admission already emitted each request's first token (TTFT)
+        assert len(a.output) == 1 and len(b.output) == 1
+        eng.step()
+        assert b.done  # took its single remaining token, then froze
+        assert len(a.output) == 9  # full 8-token burst despite b's budget
