@@ -104,6 +104,11 @@ sys.argv = ['offload_throughput', '--iters', '3']
 runpy.run_path('benchmarking/offload_throughput.py', run_name='__main__')
 " || continue
 
+  stage decode_burst_bench 900 "
+import sys; sys.argv=['bench','--decode']
+exec(open('bench.py').read())
+" || continue
+
   stage ttft_bench 1200 "
 import sys; sys.argv=['bench','--ttft']
 exec(open('bench.py').read())
