@@ -236,8 +236,11 @@ def bench_decode_throughput() -> dict:
     import jax
 
     cfg = LlamaConfig(
+        # head_dim 128: the Mosaic lane-tiling unit, so the real-TPU run
+        # exercises the Pallas kernels (sub-128 head dims fall back to XLA)
+        # — and the shape real model families (Llama/Qwen) actually use.
         vocab_size=8192, hidden_size=512, num_layers=4, num_heads=8,
-        num_kv_heads=4, head_dim=64, intermediate_size=1408, page_size=16,
+        num_kv_heads=4, head_dim=128, intermediate_size=1408, page_size=16,
     )
     params = init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(7)
@@ -329,8 +332,10 @@ def main() -> None:
 
     rng = np.random.default_rng(42)
     model_cfg = LlamaConfig(
+        # head_dim 128 so the TTFT arms run the Pallas prefill/decode path
+        # on real TPU (see bench_decode_throughput's config note).
         vocab_size=8192, hidden_size=512, num_layers=4, num_heads=8,
-        num_kv_heads=4, head_dim=64, intermediate_size=1408, page_size=16,
+        num_kv_heads=4, head_dim=128, intermediate_size=1408, page_size=16,
     )
     n_pods = 4
     workload = build_workload(rng)

@@ -27,6 +27,27 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def head_dim_supported(head_dim: int) -> bool:
+    """Whether these kernels can compile on real TPU for this head size.
+
+    Mosaic requires per-(page, head) DMA slices to be 128-aligned along
+    the lane (head_dim) axis; sub-128 head dims fail to compile (measured
+    v5e: "Slice shape along dimension 3 must be aligned to tiling (128)").
+    Interpreter mode has no such restriction — this predicate gates the
+    compiled path only (the engine's backend selection and the kernels'
+    own guard both use it, so the rule cannot drift between them)."""
+    return head_dim % 128 == 0
+
+
+def _check_head_dim_alignment(head_dim: int, interpret: bool) -> None:
+    if not interpret and not head_dim_supported(head_dim) and (
+            jax.devices()[0].platform == "tpu"):
+        raise ValueError(
+            f"Pallas paged attention needs head_dim % 128 == 0 on TPU "
+            f"(got {head_dim}); use the XLA paged-attention fallback "
+            f"(ops.paged_attention) for this model")
+
+
 def _decode_kernel(
     # scalar prefetch
     page_table_ref,  # [batch, pages_per_seq] int32 (SMEM)
@@ -268,6 +289,7 @@ def pallas_paged_prefill_attention(
     _, kv_heads, page_size, _ = k_cache.shape
     group = q_heads // kv_heads
     assert q_seq % q_tile == 0, "pad q_seq to a q_tile multiple"
+    _check_head_dim_alignment(head_dim, interpret)
 
     # [batch, q_blocks, q_tile, kv_heads, group, head_dim] view via reshape:
     q_blocked = q.reshape(batch, q_seq // q_tile, q_tile, kv_heads, group, head_dim)
@@ -331,6 +353,7 @@ def pallas_paged_decode_attention(
     batch, q_heads, head_dim = q.shape
     num_pages_total, kv_heads, page_size, _ = k_cache.shape
     group = q_heads // kv_heads
+    _check_head_dim_alignment(head_dim, interpret)
 
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 

@@ -85,3 +85,21 @@ def test_bfloat16_cache():
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=5e-2, atol=5e-2,
     )
+
+
+def test_head_dim_alignment_guard(monkeypatch):
+    """On real TPU, sub-128 head dims must raise a clear error instead of
+    a Mosaic internal failure (lane tiling is 128; measured on v5e)."""
+    import pytest
+
+    from llmd_kv_cache_tpu.ops import pallas_paged_attention as mod
+
+    class _FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(mod.jax, "devices", lambda *a, **k: [_FakeDev()])
+    with pytest.raises(ValueError, match="head_dim % 128"):
+        mod._check_head_dim_alignment(64, interpret=False)
+    # interpreter mode and 128-multiples are unrestricted
+    mod._check_head_dim_alignment(64, interpret=True)
+    mod._check_head_dim_alignment(256, interpret=False)
