@@ -35,6 +35,9 @@ from ..events.model import (
     BlockStoredEvent,
     GenericEvent,
 )
+from ..ops.pallas_paged_attention import (
+    head_dim_supported as _pallas_head_dim_supported,
+)
 from ..utils.logging import get_logger
 from .llama import (
     LlamaConfig,
@@ -361,9 +364,22 @@ class MiniEngine:
         params=None,
         seed: int = 0,
         offload_spec=None,
+        mesh=None,
     ):
         self.cfg = cfg or EngineConfig()
         mcfg = self.cfg.model
+        # Tensor-parallel serving: with a mesh carrying a ``tp`` axis, the
+        # params take the Megatron layout and both KV pools shard their
+        # kv-heads axis; the same jitted forwards then run SPMD (XLA
+        # inserts the per-block all-reduces). Paging stays host-side and
+        # replicated — identical on every shard.
+        self.mesh = mesh
+        self._tp = 1
+        if mesh is not None:
+            from ..parallel.serve import mesh_tp_size, validate_tp_config
+
+            validate_tp_config(mcfg, mesh)
+            self._tp = mesh_tp_size(mesh)
         if self.cfg.max_pages_per_seq * self.cfg.max_batch > self.cfg.num_pages:
             logger.warning("page pool smaller than worst-case demand; requests may stall")
         self.processor = ChunkedTokenDatabase(
@@ -401,12 +417,41 @@ class MiniEngine:
             self.block_manager = BlockManager(self.cfg, self.processor, event_sink)
             self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
 
+        if mesh is not None:
+            from ..parallel.serve import shard_engine_params, shard_kv_pool
+
+            self.params = shard_engine_params(mesh, self.params)
+            self.k_cache, self.v_cache = shard_kv_pool(
+                mesh, self.k_cache, self.v_cache)
+            if self.hybrid:
+                self.k_swa, self.v_swa = shard_kv_pool(
+                    mesh, self.k_swa, self.v_swa)
+
         # Resolve the decode attention backend once (the platform cannot
         # change over the engine's lifetime).
         use_pallas = self.cfg.use_pallas_decode
         on_tpu = jax.devices()[0].platform == "tpu"
         if use_pallas is None:
             use_pallas = on_tpu
+        if use_pallas and on_tpu and not _pallas_head_dim_supported(
+                mcfg.head_dim):
+            # Mosaic lane-tiling constraint (see ops.pallas_paged_attention
+            # .head_dim_supported); interpreter-mode tests still cover such
+            # shapes, on-chip serving falls back to XLA paged attention.
+            if self.cfg.use_pallas_decode:
+                logger.warning(
+                    "head_dim=%d is not 128-aligned: Pallas paged attention "
+                    "cannot compile on TPU, using XLA paged attention",
+                    mcfg.head_dim)
+            use_pallas = False
+        if use_pallas and self._tp > 1:
+            # Pallas under TP needs the shard_map wrapper (per-shard kv
+            # heads); until it is wired, sharded engines attend via XLA.
+            if self.cfg.use_pallas_decode:
+                logger.warning("tp=%d: Pallas paged attention not wired for "
+                               "sharded serving, using XLA paged attention",
+                               self._tp)
+            use_pallas = False
         if self.hybrid:
             # Grouped caches decode through the XLA hybrid path; the Pallas
             # flash-decode kernel is single-pool.

@@ -124,7 +124,7 @@ def mesh_fingerprint_fields(mesh: Optional[Mesh]) -> dict[str, int]:
     """
     if mesh is None:
         return {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sizes = mesh.shape
     return {
         "tp_size": sizes.get("tp", 1),
         "pp_size": sizes.get("pp", 1),

@@ -1,0 +1,64 @@
+"""Tensor-parallel serving: mesh shardings for MiniEngine state.
+
+The reference only *fingerprints* TP topology (``file_mapper.py:63-74``
+keys the offload store by ``tp_size`` and per-rank ``_r<rank>`` folders);
+the engines themselves are vLLM's. Here the serving engine is in-tree, so
+TP is first-class: parameters take the Megatron layout
+(``mesh.param_pspecs``), both paged KV pools shard their kv-heads axis
+over ``tp``, and the unchanged jitted forwards run SPMD — XLA derives the
+per-block all-reduces from the shardings (no explicit collectives).
+
+Requirements: ``num_kv_heads % tp == 0`` (each shard owns whole kv heads,
+so GQA groups never straddle shards) and ``num_heads % num_kv_heads == 0``
+(already a model invariant). Page tables and token blocks stay replicated
+host-side — paging is control plane, identical on every shard, which is
+what makes the per-shard KV pools line up with the reference's per-rank
+offload folders.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, Params
+from .mesh import shard_params
+
+KV_CACHE_AXES = P(None, None, "tp", None, None)  # [layers, pages, kvh, ps, hd]
+
+
+def mesh_tp_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return mesh.shape.get("tp", 1)
+
+
+def validate_tp_config(cfg: LlamaConfig, mesh: Mesh) -> None:
+    tp = mesh_tp_size(mesh)
+    if cfg.num_kv_heads % tp != 0:
+        raise ValueError(
+            f"num_kv_heads ({cfg.num_kv_heads}) must divide by the tp axis "
+            f"({tp}) so every shard owns whole kv heads")
+    ep = mesh.shape.get("ep", 1)
+    if cfg.num_experts > 0 and cfg.num_experts % ep != 0:
+        raise ValueError(
+            f"num_experts ({cfg.num_experts}) must divide by the ep "
+            f"axis ({ep})")
+
+
+def shard_engine_params(mesh: Mesh, params: Params) -> Params:
+    """Megatron-place the parameter tree (same layout as training)."""
+    return shard_params(mesh, params)
+
+
+def shard_kv_pool(mesh: Mesh, k_cache: jax.Array, v_cache: jax.Array):
+    """Place one paged KV pool with its kv-heads axis over ``tp``.
+
+    On a mesh without a ``tp`` axis (e.g. a dp-only fleet mesh) the pool
+    is placed replicated — a PartitionSpec naming an absent axis is
+    rejected by NamedSharding."""
+    axes = KV_CACHE_AXES if "tp" in mesh.axis_names else P()
+    sharding = NamedSharding(mesh, axes)
+    return jax.device_put(k_cache, sharding), jax.device_put(v_cache, sharding)
