@@ -444,14 +444,6 @@ class MiniEngine:
                     "cannot compile on TPU, using XLA paged attention",
                     mcfg.head_dim)
             use_pallas = False
-        if use_pallas and self._tp > 1:
-            # Pallas under TP needs the shard_map wrapper (per-shard kv
-            # heads); until it is wired, sharded engines attend via XLA.
-            if self.cfg.use_pallas_decode:
-                logger.warning("tp=%d: Pallas paged attention not wired for "
-                               "sharded serving, using XLA paged attention",
-                               self._tp)
-            use_pallas = False
         if self.hybrid:
             # Grouped caches decode through the XLA hybrid path; the Pallas
             # flash-decode kernel is single-pool.
@@ -465,19 +457,34 @@ class MiniEngine:
                     "pool's just-in-time paging needs host control between "
                     "tokens); decoding one token per step")
         if use_pallas:
+            # Under tp the kernels run per-shard over the kv-heads
+            # sharding via shard_map (the decode grid is per-kv-head
+            # independent, so no cross-shard traffic in attention itself).
+            pallas_mesh = mesh if self._tp > 1 else None
             self._decode_forward = functools.partial(
-                forward_decode_pallas, interpret=not on_tpu
+                forward_decode_pallas, interpret=not on_tpu, mesh=pallas_mesh
             )
             self._prefill_forward = functools.partial(
-                forward_prefill_pallas, interpret=not on_tpu
+                forward_prefill_pallas, interpret=not on_tpu, mesh=pallas_mesh
             )
         else:
+            pallas_mesh = None
             self._decode_forward = forward
             self._prefill_forward = forward
         self._decode_multi = functools.partial(
             forward_decode_steps, use_pallas=use_pallas,
-            interpret=use_pallas and not on_tpu,
+            interpret=use_pallas and not on_tpu, mesh=pallas_mesh,
         )
+        # Burst size: the power-of-two floor of cfg.decode_burst, fixed for
+        # the engine's lifetime — ONE fused-decode program. Per-row budgets
+        # freeze finished rows on-device, so ticks past every row's budget
+        # cost ~a token's compute; shrinking the burst near a request's
+        # tail instead (an earlier design) compiled a fresh program per
+        # smaller bucket mid-serving — measured 2 s per compile on the v5e
+        # tunnel, cratering steady-state decode on short generations.
+        self._burst = 1
+        while self._burst * 2 <= self.cfg.decode_burst:
+            self._burst *= 2
 
         # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
         # write-through on commit, restore on prefix miss at admission.
@@ -885,8 +892,12 @@ class MiniEngine:
                     jnp.asarray([pos], jnp.int32),
                     jnp.asarray([len(chunk)], jnp.int32),
                 )
-            req.last_logits = np.asarray(logits[0, len(chunk) - 1])
+            last_chunk_len = len(chunk)
             pos += len(chunk)
+        # One logits download for the whole prefill: only the final chunk's
+        # last position feeds sampling, and each host transfer is a full
+        # round trip on a remote-tunneled device.
+        req.last_logits = np.asarray(logits[0, last_chunk_len - 1])
         req.computed_len = len(req.prompt)
 
     def _commit_full_blocks(self, req: Request) -> None:
@@ -976,8 +987,7 @@ class MiniEngine:
         emitted: dict[str, int] = {}
         for chunk_start in range(0, len(active), self.cfg.max_batch):
             chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
-            burst = (self._decode_burst_size(chunk)
-                     if self.cfg.decode_burst > 1 and not self.hybrid else 1)
+            burst = self._burst if not self.hybrid else 1
             if burst > 1:
                 emitted.update(self._decode_chunk_burst(chunk, burst))
             else:
@@ -1071,16 +1081,6 @@ class MiniEngine:
             tables[i] = self._page_table_for(req)
         return last, ctx, tables
 
-    def _decode_burst_size(self, chunk: list[Request]) -> int:
-        """Largest power-of-two burst worth dispatching: bounded by
-        cfg.decode_burst and the chunk's MAXIMUM remaining budget (per-row
-        budgets freeze finished rows on-device, so a near-done request
-        never drags the whole chunk down to its remainder)."""
-        remaining = max(r.max_new_tokens - len(r.output) for r in chunk)
-        t = 1
-        while t * 2 <= min(self.cfg.decode_burst, remaining):
-            t *= 2
-        return t
 
     def _decode_chunk_burst(self, chunk: list[Request], steps: int) -> dict[str, int]:
         """Fused multi-token decode: one dispatch emits up to ``steps``

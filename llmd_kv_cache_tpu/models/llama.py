@@ -462,7 +462,7 @@ def forward_hybrid(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "interpret"),
+    static_argnames=("cfg", "interpret", "mesh"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def forward_decode_pallas(
@@ -475,20 +475,29 @@ def forward_decode_pallas(
     ctx_lens: jax.Array,  # [batch]
     new_lens: jax.Array,  # [batch] 1 for live rows, 0 for padding
     interpret: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Decode step (seq == 1) using the Pallas flash-decode kernel.
 
     Same semantics as ``forward``; streaming pages HBM→VMEM in-kernel
     avoids materializing the gathered KV — the long-context win over the
-    XLA reference path.
+    XLA reference path. ``mesh`` (tp axis) runs the kernel per-shard over
+    the kv-heads sharding via ``shard_map``.
     """
-    from ..ops.pallas_paged_attention import pallas_paged_decode_attention
+    from ..ops.pallas_paged_attention import (
+        pallas_paged_decode_attention, sharded_paged_decode_attention)
 
     def pallas_attention(q, k_l, v_l, table, _positions, total_lens, window):
-        out = pallas_paged_decode_attention(
-            q[:, 0], k_l, v_l, table, total_lens,
-            sliding_window=window, interpret=interpret,
-        )
+        if mesh is not None:
+            out = sharded_paged_decode_attention(
+                mesh, q[:, 0], k_l, v_l, table, total_lens,
+                sliding_window=window, interpret=interpret,
+            )
+        else:
+            out = pallas_paged_decode_attention(
+                q[:, 0], k_l, v_l, table, total_lens,
+                sliding_window=window, interpret=interpret,
+            )
         return out[:, None]  # restore the seq axis
 
     return _forward_impl(
@@ -499,7 +508,7 @@ def forward_decode_pallas(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "use_pallas", "interpret"),
+    static_argnames=("cfg", "steps", "use_pallas", "interpret", "mesh"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def forward_decode_steps(
@@ -514,6 +523,7 @@ def forward_decode_steps(
     steps: int,
     use_pallas: bool = False,
     interpret: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Greedy decode of ``steps`` tokens fused into ONE XLA program.
 
@@ -536,9 +546,16 @@ def forward_decode_steps(
     Returns ``(tokens [batch, steps], k_cache, v_cache)``; row i's valid
     entries are the first ``min(active[i], steps)``.
     """
-    from ..ops.pallas_paged_attention import pallas_paged_decode_attention
+    from ..ops.pallas_paged_attention import (
+        pallas_paged_decode_attention, sharded_paged_decode_attention)
 
     def attention(q, k_l, v_l, table, positions, total_lens, window):
+        if use_pallas and mesh is not None:
+            out = sharded_paged_decode_attention(
+                mesh, q[:, 0], k_l, v_l, table, total_lens,
+                sliding_window=window, interpret=interpret,
+            )
+            return out[:, None]
         if use_pallas:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
@@ -569,7 +586,7 @@ def forward_decode_steps(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "interpret"),
+    static_argnames=("cfg", "interpret", "mesh"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def forward_prefill_pallas(
@@ -582,20 +599,28 @@ def forward_prefill_pallas(
     ctx_lens: jax.Array,
     new_lens: jax.Array,
     interpret: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill using the Pallas flash-prefill kernel.
 
     Same semantics as ``forward``: queries attend causally over the cached
     prefix plus themselves (clipped to the layer's sliding window when
     set, with out-of-window pages skipped), streaming pages HBM→VMEM
-    in-kernel instead of materializing the gathered KV.
+    in-kernel instead of materializing the gathered KV. ``mesh`` (tp axis)
+    runs the kernel per-shard over the kv-heads sharding.
     """
-    from ..ops.pallas_paged_attention import pallas_paged_prefill_attention
+    from ..ops.pallas_paged_attention import (
+        pallas_paged_prefill_attention, sharded_paged_prefill_attention)
 
     seq = tokens.shape[1]
     q_tile = math.gcd(seq, 16)
 
     def attention_fn(q, k_l, v_l, table, positions, total_lens, window):
+        if mesh is not None:
+            return sharded_paged_prefill_attention(
+                mesh, q, k_l, v_l, table, ctx_lens, total_lens,
+                q_tile=q_tile, sliding_window=window, interpret=interpret,
+            )
         return pallas_paged_prefill_attention(
             q, k_l, v_l, table, ctx_lens, total_lens,
             q_tile=q_tile, sliding_window=window, interpret=interpret,

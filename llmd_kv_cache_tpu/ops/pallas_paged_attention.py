@@ -397,3 +397,61 @@ def pallas_paged_decode_attention(
       q_blocked, k_cache, v_cache)
 
     return out.reshape(batch, q_heads, head_dim)
+
+
+def sharded_paged_decode_attention(
+    mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
+    sliding_window=None, interpret=False,
+):
+    """Flash-decode over a tp-sharded paged KV cache.
+
+    ``pallas_call`` cannot consume sharded operands directly, so each tp
+    shard runs the kernel on its local kv heads under ``shard_map`` — the
+    decode grid is (batch, kv_head)-independent, so sharding the kv-heads
+    axis needs no cross-shard communication at all (the per-block
+    all-reduce happens later, at the wo projection). Page tables and
+    lengths are replicated control state.
+
+    Shapes are global: q [batch, q_heads, hd] (heads sharded over tp),
+    caches [pages, kv_heads, ps, hd] (kv heads sharded over tp).
+    """
+    from ..utils.shard_map_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(q_, k_, v_, t_, l_):
+        return pallas_paged_decode_attention(
+            q_, k_, v_, t_, l_, sliding_window=sliding_window,
+            interpret=interpret,
+        )
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, "tp", None), P(None, "tp", None, None),
+                  P(None, "tp", None, None), P(None, None), P(None)),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )(q, k_cache, v_cache, page_table, ctx_lens)
+
+
+def sharded_paged_prefill_attention(
+    mesh, q, k_cache, v_cache, page_table, ctx_lens, total_lens, *,
+    q_tile=16, sliding_window=None, interpret=False,
+):
+    """Flash-prefill over a tp-sharded paged KV cache (see the decode
+    wrapper's rationale). q: [batch, q_seq, q_heads, hd], heads sharded."""
+    from ..utils.shard_map_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(q_, k_, v_, t_, cl_, tl_):
+        return pallas_paged_prefill_attention(
+            q_, k_, v_, t_, cl_, tl_, q_tile=q_tile,
+            sliding_window=sliding_window, interpret=interpret,
+        )
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, None, "tp", None), P(None, "tp", None, None),
+                  P(None, "tp", None, None), P(None, None), P(None), P(None)),
+        out_specs=P(None, None, "tp", None),
+        check_vma=False,
+    )(q, k_cache, v_cache, page_table, ctx_lens, total_lens)

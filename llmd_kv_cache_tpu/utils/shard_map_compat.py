@@ -1,0 +1,21 @@
+"""``jax.shard_map`` across JAX versions.
+
+Newer JAX exports ``jax.shard_map`` (varying-axes check spelled
+``check_vma``); older releases have ``jax.experimental.shard_map`` with
+``check_rep``. One shim, one spelling everywhere else.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+__all__ = ["shard_map"]

@@ -11,6 +11,11 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the 8-device virtual CPU mesh (tests/conftest.py)",
+)
+
 from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
 from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
 from llmd_kv_cache_tpu.parallel.mesh import make_mesh
@@ -85,6 +90,31 @@ def test_tp_hybrid_engine(setup):
     mesh = make_mesh({"tp": 2}, jax.devices()[:2])
     out = _engine(cfg, params, mesh=mesh).generate("r", prompt,
                                                    max_new_tokens=6)
+    assert out == ref
+
+
+def test_tp_pallas_attention(setup):
+    """Pallas flash prefill+decode under tp: shard_map runs the kernel on
+    each shard's local kv heads; tokens match the single-device XLA
+    engine (interpret mode on the CPU mesh)."""
+    cfg, params = setup
+    prompt = np.random.default_rng(6).integers(1, 250, 24).tolist()
+    ref = _engine(cfg, params).generate("r", prompt, max_new_tokens=8)
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    out = _engine(cfg, params, mesh=mesh,
+                  use_pallas_decode=True).generate("r", prompt,
+                                                   max_new_tokens=8)
+    assert out == ref
+
+
+def test_tp_pallas_decode_burst(setup):
+    """Fused decode bursts through the sharded Pallas kernel."""
+    cfg, params = setup
+    prompt = np.random.default_rng(7).integers(1, 250, 12).tolist()
+    ref = _engine(cfg, params).generate("r", prompt, max_new_tokens=8)
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    out = _engine(cfg, params, mesh=mesh, use_pallas_decode=True,
+                  decode_burst=4).generate("r", prompt, max_new_tokens=8)
     assert out == ref
 
 
