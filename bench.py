@@ -41,7 +41,8 @@ def build_workload(rng, n_requests=64, n_prefixes=8, prefix_len=256, suffix_len=
     return workload
 
 
-def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None):
+def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None,
+              pod_kw=None):
     """Fresh engine pods wired to feed the indexer's index via events.
 
     All pods share one parameter tree (same seed anyway — the engines
@@ -56,6 +57,12 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None):
 
     if params is None:
         params = init_params(jax.random.PRNGKey(0), model_cfg)
+    # Capacity-constrained page pool (the regime where routing matters:
+    # each pod can hold a few of the workload's shared prefixes, like the
+    # reference's 73%-capacity setup). Round-robin thrashes the prefix
+    # cache; KV-aware routing lets each pod own a prefix subset.
+    pod_kw = dict(pod_kw) if pod_kw is not None else {
+        "num_pages": 72, "max_pages_per_seq": 64}
     pool = Pool(PoolConfig(concurrency=1), indexer.kv_block_index,
                 indexer.token_processor)
     pods = {}
@@ -68,17 +75,12 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None):
                 pod_name, MODEL_NAME,
             )
 
-        # Capacity-constrained page pool (the regime where routing matters:
-        # each pod can hold ~2 of the workload's 8 shared prefixes, like the
-        # reference's 73%-capacity setup). Round-robin thrashes the prefix
-        # cache; KV-aware routing lets each pod own a prefix subset.
         pods[name] = engine_mod.MiniEngine(
             engine_mod.EngineConfig(
                 model=model_cfg,
-                num_pages=72,
-                max_pages_per_seq=64,
                 model_name=MODEL_NAME,
                 pod_identifier=name,
+                **pod_kw,
             ),
             event_sink=sink,
             params=params,
@@ -331,14 +333,41 @@ def main() -> None:
     from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
 
     rng = np.random.default_rng(42)
-    model_cfg = LlamaConfig(
-        # head_dim 128 so the TTFT arms run the Pallas prefill/decode path
-        # on real TPU (see bench_decode_throughput's config note).
-        vocab_size=8192, hidden_size=512, num_layers=4, num_heads=8,
-        num_kv_heads=4, head_dim=128, intermediate_size=1408, page_size=16,
-    )
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        # Production-shaped sizing: a ~0.9B-param model with 4k-token
+        # shared prefixes, so a prefix hit skips real MXU work (measured
+        # v5e: cold prefill 1.77 s vs 0.14 s on a hit — 12.8×). Tiny
+        # models underestimate the routing win on a remote-dispatched
+        # device because per-dispatch latency, identical for both arms,
+        # buries the prefill compute a hit would skip.
+        model_cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, num_layers=16,
+            num_heads=16, num_kv_heads=8, head_dim=128,
+            intermediate_size=5632, page_size=16,
+        )
+        wl_kw = dict(n_requests=40, n_prefixes=8, prefix_len=4096,
+                     suffix_len=64, vocab=30000)
+        # 1024 pages/pod = 16k tokens ≈ 3 resident prefixes of the 8.
+        pod_kw = dict(num_pages=1024, max_pages_per_seq=272,
+                      max_prefill_tokens=2048)
+        # Every prefill bucket a partial prefix hit can produce: the full
+        # prompt covers the 128-page chunk + 4-page tail; the shorter
+        # lengths cover 8..64-page buckets (a partially evicted prefix
+        # leaves a page-aligned remainder ≥ 4 pages). Unwarmed buckets
+        # would compile 20-40 s INSIDE an arm's timed window.
+        warm_lens = [4096 + 64, 1024, 512, 256, 128]
+    else:
+        model_cfg = LlamaConfig(
+            vocab_size=8192, hidden_size=512, num_layers=4, num_heads=8,
+            num_kv_heads=4, head_dim=128, intermediate_size=1408,
+            page_size=16,
+        )
+        wl_kw = {}
+        pod_kw = None
+        warm_lens = [p * 16 for p in (1, 2, 4, 8, 16, 32)]
     n_pods = 4
-    workload = build_workload(rng)
+    workload = build_workload(rng, **wl_kw)
 
     def fresh_indexer():
         return Indexer(
@@ -357,12 +386,12 @@ def main() -> None:
     shared_params = _init_params(jax.random.PRNGKey(0), model_cfg)
     warm_indexer = fresh_indexer()
     warm = make_pods(1, model_cfg, engine_mod, warm_indexer,
-                     params=shared_params)["pod-0"]
-    for seq_pages in (1, 2, 4, 8, 16, 32):
+                     params=shared_params, pod_kw=pod_kw)["pod-0"]
+    for wl in warm_lens:
         _tb = time.perf_counter()
-        prompt = rng.integers(1, 8000, seq_pages * model_cfg.page_size).tolist()
-        warm.add_request(f"warm{seq_pages}", prompt, max_new_tokens=1)
-        print(f"[bench warm] bucket {seq_pages}p: "
+        prompt = rng.integers(1, 8000, wl).tolist()
+        warm.add_request(f"warm{wl}", prompt, max_new_tokens=1)
+        print(f"[bench warm] len {wl}: "
               f"{time.perf_counter() - _tb:.1f}s", file=_sys.stderr, flush=True)
     print(f"[bench warm] total {time.perf_counter() - _t0:.1f}s",
           file=_sys.stderr, flush=True)
@@ -371,7 +400,7 @@ def main() -> None:
     # Arm 1: round-robin routing.
     rr_indexer = fresh_indexer()
     rr_pods = make_pods(n_pods, model_cfg, engine_mod, rr_indexer,
-                        params=shared_params)
+                        params=shared_params, pod_kw=pod_kw)
     rr_ttfts = run_replay(
         rr_pods, workload, router=lambda i, _p, names: names[i % len(names)],
         tag="round-robin",
@@ -380,7 +409,7 @@ def main() -> None:
     # Arm 2: KV-cache-aware routing via the Indexer.
     kv_indexer = fresh_indexer()
     kv_pods = make_pods(n_pods, model_cfg, engine_mod, kv_indexer,
-                        params=shared_params)
+                        params=shared_params, pod_kw=pod_kw)
     rr_counter = [0]
 
     def kv_router(_i, prompt, names):
