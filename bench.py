@@ -247,9 +247,10 @@ def bench_decode_throughput() -> dict:
     params = init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(7)
     prompts = [rng.integers(1, 8000, 64).tolist() for _ in range(8)]
-    max_new = 64
+    max_new = 128
     rates = {}
-    for burst in (1, 8):
+    bursts = (1, 32)
+    for burst in bursts:
         eng = engine_mod.MiniEngine(
             engine_mod.EngineConfig(
                 model=cfg, num_pages=256, max_pages_per_seq=16,
@@ -269,10 +270,10 @@ def bench_decode_throughput() -> dict:
         elapsed = time.perf_counter() - start
         rates[burst] = (sum(len(r.output) for r in reqs) - tokens_before) / elapsed
     return {
-        "metric": "greedy decode tok/s, batch 8 (burst 8 vs single-step "
-                  f"{rates[1]:.0f} tok/s)",
-        "value": round(rates[8], 1),
-        "unit": f"tok/s (x{rates[8] / rates[1]:.2f} vs single-step)",
+        "metric": f"greedy decode tok/s, batch 8 (burst {bursts[-1]} vs "
+                  f"single-step {rates[1]:.0f} tok/s)",
+        "value": round(rates[bursts[-1]], 1),
+        "unit": f"tok/s (x{rates[bursts[-1]] / rates[1]:.2f} vs single-step)",
         "vs_baseline": 1.0,
     }
 
