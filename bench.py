@@ -226,7 +226,7 @@ def bench_offload_throughput() -> dict:
 
 def bench_decode_throughput() -> dict:
     """Secondary metric: steady-state greedy decode tokens/s through the
-    engine, single-token stepping vs fused 8-token bursts
+    engine, single-token stepping vs fused 32-token bursts
     (``forward_decode_steps``). The burst factor is the dispatch-overhead
     amortization — the figure that matters on real deployments where
     per-launch latency competes with per-token compute."""

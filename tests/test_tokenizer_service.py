@@ -154,3 +154,72 @@ class TestServiceOverUDS:
             tools=[{"name": "search"}],
         )
         assert without.token_ids != with_tools.token_ids
+
+
+class TestHFBackendHermetic:
+    """The real transformers path, no downloads: a tiny vendored BPE
+    tokenizer + ChatML-style Jinja chat template (tools + multimodal
+    content parts) under tests/assets/ — the reference exercises its
+    vLLM renderer in service tests (`tokenizer_grpc_service.py`); this is
+    the equivalent against HF machinery."""
+
+    @pytest.fixture(scope="class")
+    def model_path(self):
+        import os
+        pytest.importorskip("transformers")
+        path = os.path.join(os.path.dirname(__file__), "assets",
+                            "tiny_hf_tokenizer")
+        if not os.path.isdir(path):
+            pytest.skip("vendored tokenizer assets missing")
+        return path
+
+    def test_render_chat_real_template(self, server_and_client, model_path):
+        _, client = server_and_client
+        resp = client.render_chat(
+            model_path,
+            [ChatMessage("user", [
+                {"type": "text", "text": "Describe"},
+                {"type": "image_url",
+                 "image_url": {"url": "http://x/cat.png"}},
+            ])],
+            tools=[{"type": "function", "function": {"name": "lookup"}}],
+        )
+        assert resp.token_ids
+        assert len(resp.mm_hashes["image"]) == 1
+        assert len(resp.mm_placeholders["image"]) == 1
+        # The Jinja template's <|image|> marker must map to a real token
+        # range inside the id stream.
+        offset, length = resp.mm_placeholders["image"][0]
+        assert 0 < offset < len(resp.token_ids) and length >= 1
+
+    def test_matches_direct_transformers_render(self, server_and_client,
+                                                model_path):
+        """Text-only chat: the service's ids equal encoding the template
+        output straight through transformers — no drift between the
+        service path and the library."""
+        AutoTokenizer = pytest.importorskip("transformers").AutoTokenizer
+
+        _, client = server_and_client
+        messages = [
+            {"role": "system", "content": "You are a helpful assistant."},
+            {"role": "user", "content": "What is the capital of France?"},
+        ]
+        resp = client.render_chat(
+            model_path,
+            [ChatMessage(m["role"], m["content"]) for m in messages],
+        )
+        tok = AutoTokenizer.from_pretrained(model_path)
+        text = tok.apply_chat_template(messages, tokenize=False,
+                                       add_generation_prompt=True)
+        assert resp.token_ids == tok.encode(text)
+
+    def test_tools_change_real_template_output(self, server_and_client,
+                                               model_path):
+        _, client = server_and_client
+        without = client.render_chat(model_path, [ChatMessage("user", "hi")])
+        with_tools = client.render_chat(
+            model_path, [ChatMessage("user", "hi")],
+            tools=[{"type": "function",
+                    "function": {"name": "search", "arguments": {}}}],
+        )
+        assert without.token_ids != with_tools.token_ids
