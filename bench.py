@@ -92,8 +92,17 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None,
 MODEL_NAME = "bench-llama"
 
 
-def run_replay(pods, workload, router, tag=""):
+def run_replay(pods, workload, router, tag="", arrivals=None):
     """Admit each request on the routed pod; returns per-request TTFT (s).
+
+    With ``arrivals`` (a nondecreasing array of open-loop arrival times),
+    queueing is simulated in virtual time the way inference-perf's
+    saturation runs behave: each pod serves FIFO, service time is the
+    MEASURED prefill wall time, and TTFT = queue wait + service. This is
+    the regime behind the reference's headline tables — at saturation,
+    routing quality compounds through queue depth, not just prefill skip
+    (`benchmarking/73-capacity/README.md`: precise 0.542 s vs 92.5 s p90
+    is queue-dominated). Without ``arrivals``, TTFT is bare service time.
 
     Coarse progress goes to stderr (the stdout contract is one JSON line);
     on a tunneled TPU a silent 25-minute run is undebuggable without it.
@@ -102,13 +111,20 @@ def run_replay(pods, workload, router, tag=""):
 
     ttfts = []
     pod_names = list(pods.keys())
+    pod_free = {name: 0.0 for name in pod_names}
     arm_start = time.perf_counter()
     for i, prompt in enumerate(workload):
         pod_name = router(i, prompt, pod_names)
         engine = pods[pod_name]
         start = time.perf_counter()
-        req = engine.add_request(f"r{i}", prompt, max_new_tokens=1)
-        ttfts.append(time.perf_counter() - start)
+        engine.add_request(f"r{i}", prompt, max_new_tokens=1)
+        service = time.perf_counter() - start
+        if arrivals is None:
+            ttfts.append(service)
+        else:
+            begin = max(arrivals[i], pod_free[pod_name])
+            pod_free[pod_name] = begin + service
+            ttfts.append(begin + service - arrivals[i])
         if i % 16 == 15:
             print(f"[bench {tag}] {i + 1}/{len(workload)} requests, "
                   f"{time.perf_counter() - arm_start:.1f}s elapsed",
@@ -325,7 +341,7 @@ def bench_event_ingestion() -> dict:
     }
 
 
-def main() -> None:
+def main(queued: bool = False) -> None:
     import jax
 
     from llmd_kv_cache_tpu.core import TokenProcessorConfig
@@ -396,6 +412,26 @@ def main() -> None:
               f"{time.perf_counter() - _tb:.1f}s", file=_sys.stderr, flush=True)
     print(f"[bench warm] total {time.perf_counter() - _t0:.1f}s",
           file=_sys.stderr, flush=True)
+
+    # Saturation mode: open-loop Poisson arrivals at 1.25× the fleet's
+    # all-cold service capacity — the round-robin arm (mostly cold)
+    # saturates and queues; the kv-aware arm (mostly hits, service far
+    # below cold) keeps up. Calibrate from a measured cold prefill on the
+    # warmed pod so the rate is platform-honest, then use the SAME
+    # arrival times for both arms.
+    arrivals = None
+    qps = None
+    if queued:
+        _tb = time.perf_counter()
+        warm.add_request(
+            "cal", rng.integers(1, 8000, wl_kw.get("prefix_len", 256)
+                                + wl_kw.get("suffix_len", 32)).tolist(),
+            max_new_tokens=1)
+        d_cold = time.perf_counter() - _tb
+        qps = 1.25 * n_pods / d_cold
+        arrivals = np.cumsum(rng.exponential(1.0 / qps, len(workload)))
+        print(f"[bench load] cold service {d_cold * 1e3:.0f}ms -> "
+              f"{qps:.1f} req/s open-loop", file=_sys.stderr, flush=True)
     del warm
 
     # Arm 1: round-robin routing.
@@ -404,7 +440,7 @@ def main() -> None:
                         params=shared_params, pod_kw=pod_kw)
     rr_ttfts = run_replay(
         rr_pods, workload, router=lambda i, _p, names: names[i % len(names)],
-        tag="round-robin",
+        tag="round-robin", arrivals=arrivals,
     )
 
     # Arm 2: KV-cache-aware routing via the Indexer.
@@ -422,18 +458,19 @@ def main() -> None:
         return pick
 
     kv_ttfts = run_replay(kv_pods, workload, router=kv_router,
-                          tag="kv-aware")
+                          tag="kv-aware", arrivals=arrivals)
 
     p50_rr = statistics.median(rr_ttfts)
     p50_kv = statistics.median(kv_ttfts)
     reduction_pct = 100.0 * (1.0 - p50_kv / p50_rr) if p50_rr > 0 else 0.0
 
+    load = (f", Poisson {qps:.1f} req/s open-loop" if queued else "")
     print(json.dumps({
         "metric": "p50 TTFT reduction, KV-aware routing vs round-robin "
-                  f"({n_pods} pods, shared-prefix replay, "
+                  f"({n_pods} pods, shared-prefix replay{load}, "
                   f"{jax.devices()[0].platform})",
         "value": round(reduction_pct, 2),
-        "unit": "%",
+        "unit": f"%{(' (p50 rr %.2fs vs kv %.3fs)' % (p50_rr, p50_kv)) if queued else ''}",
         "vs_baseline": round(reduction_pct / 40.0, 3),
     }))
 
@@ -518,7 +555,9 @@ def guarded_main() -> None:
 if __name__ == "__main__":
     import sys
 
-    if "--ttft" in sys.argv:
+    if "--ttft-load" in sys.argv:
+        main(queued=True)
+    elif "--ttft" in sys.argv:
         main()
     elif "--index" in sys.argv:
         print(json.dumps(bench_index_add()))

@@ -114,6 +114,17 @@ class Request:
     computed_len: int = 0  # tokens with KV resident (cached + prefilled + decoded)
     last_logits: Optional[np.ndarray] = None
     done: bool = False
+    # Continuous batching: next prompt index to prefill, or None once the
+    # request is decoding. ``enqueue`` admits with this set; ``step``
+    # advances one chunk at a time interleaved with decode.
+    prefill_pos: Optional[int] = None
+    # Prompt blocks registered in the block manager on this request's
+    # behalf (acquired prefix at admission, extended by
+    # _commit_full_blocks). _release must treat pages past this watermark
+    # as unregistered orphans — an aborted mid-prefill request's blocks
+    # were never committed, and release()ing unknown hashes would silently
+    # leak their pages.
+    committed_blocks: int = 0
 
     @property
     def total_len(self) -> int:
@@ -519,7 +530,28 @@ class MiniEngine:
     def add_request(self, request_id: str, prompt: Sequence[int],
                     max_new_tokens: int = 16) -> Request:
         """Admit a request: acquire cached prefix pages, allocate the rest,
-        and run the prefill step for the uncached suffix."""
+        and run the prefill step for the uncached suffix (synchronously —
+        the request returns ready to decode)."""
+        req = self._admit(request_id, prompt, max_new_tokens)
+        self._prefill(req)
+        self._finish_prefill(req)
+        return req
+
+    def enqueue(self, request_id: str, prompt: Sequence[int],
+                max_new_tokens: int = 16) -> Request:
+        """Admit a request for continuous batching: pages are acquired and
+        the storage tier consulted now, but prefill runs chunk-at-a-time
+        inside ``step()`` interleaved with decode — a long prompt stalls
+        running decodes by at most one chunk (``max_prefill_tokens``), not
+        its whole prefill (vLLM chunked-prefill scheduling)."""
+        req = self._admit(request_id, prompt, max_new_tokens)
+        req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
+        return req
+
+    def _admit(self, request_id: str, prompt: Sequence[int],
+               max_new_tokens: int) -> Request:
+        """Shared admission: prefix-cache acquisition, storage restore,
+        page allocation, registration. No model compute."""
         prompt = list(prompt)
         if not prompt:
             raise ValueError("empty prompt")
@@ -600,23 +632,24 @@ class MiniEngine:
             new_pages.append(page)
         req.pages.extend(new_pages)
 
+        # Everything acquired/restored so far is registered+refcounted in
+        # the block manager; later pages stay private until commit.
+        req.committed_blocks = req.cached_len // page_size
         self.requests[request_id] = req
         self._running.append(request_id)
+        return req
 
-        # Always compute at least the last prompt token (vLLM semantics: a
-        # full-prefix hit still recomputes one token to produce logits; the
-        # scatter rewrites identical KV into the shared page, which is
-        # benign).
-        self._prefill(req)
+    def _finish_prefill(self, req: Request) -> None:
+        """Prefill done: register the prompt's full blocks in the prefix
+        cache and bootstrap decoding with the first generated token (from
+        the prefill step's final logits — vLLM semantics: even a
+        full-prefix hit recomputes the last prompt token for logits)."""
         self._commit_full_blocks(req)
-        # Bootstrap decoding: the first generated token comes from the
-        # prefill step's final logits.
         first_token = int(np.argmax(req.last_logits))
         req.output.append(first_token)
         if req.max_new_tokens <= 1:
             req.done = True
             self._finish(req)
-        return req
 
     def _sync_caches_to_copier(self) -> None:
         """Hand the current (possibly donated-and-replaced) cache arrays to
@@ -839,66 +872,71 @@ class MiniEngine:
         req.swa_acquired_from = limit
 
     def _prefill(self, req: Request) -> None:
-        """Run the model over the uncached prompt suffix, chunked.
+        """Run the model over the whole uncached prompt suffix, chunked.
 
         Chunks of at most ``max_prefill_tokens`` bound activation memory on
         long prompts (vLLM-style chunked prefill); each chunk's KV lands in
         the paged cache so the next chunk attends over it.
         """
+        req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
+        while req.prefill_pos is not None:
+            self._prefill_chunk(req)
+
+    def _prefill_chunk(self, req: Request) -> None:
+        """One prefill chunk at ``req.prefill_pos``; advances it (None once
+        the prompt is fully prefilled, with ``last_logits`` populated —
+        only the final chunk's logits are downloaded: each host transfer
+        is a full round trip on a remote-tunneled device)."""
         page_size = self.cfg.model.page_size
-        start = min(req.cached_len, len(req.prompt) - 1)
         chunk_cap = max(page_size, self.cfg.max_prefill_tokens
                         // page_size * page_size)
         table = jnp.asarray(self._page_table_for(req))[None, :]
 
-        logits = None
-        pos = start
-        while pos < len(req.prompt):
-            chunk = req.prompt[pos:pos + chunk_cap]
-            # Bucket the padded length to powers of two (in pages) so the
-            # jit cache holds O(log max_prefill) shapes instead of one per
-            # suffix length — compiles are 20-40 s each on TPU.
-            pages_needed = max(1, (len(chunk) + page_size - 1) // page_size)
-            bucket = 1
-            while bucket < pages_needed:
-                bucket *= 2
-            seq = bucket * page_size
-            tokens = np.zeros((1, seq), np.int32)
-            tokens[0, : len(chunk)] = chunk
+        pos = req.prefill_pos
+        chunk = req.prompt[pos:pos + chunk_cap]
+        # Bucket the padded length to powers of two (in pages) so the
+        # jit cache holds O(log max_prefill) shapes instead of one per
+        # suffix length — compiles are 20-40 s each on TPU.
+        pages_needed = max(1, (len(chunk) + page_size - 1) // page_size)
+        bucket = 1
+        while bucket < pages_needed:
+            bucket *= 2
+        seq = bucket * page_size
+        tokens = np.zeros((1, seq), np.int32)
+        tokens[0, : len(chunk)] = chunk
 
-            if self.hybrid:
-                # SWA pages arrive just-in-time for this chunk's blocks and
-                # out-of-window slots return to the pool after it, so a
-                # long prompt's peak SWA demand is window + chunk.
-                self._swa_ensure(req, (pos + len(chunk) - 1) // page_size)
-                swa_table = jnp.asarray(self._swa_table_for(req))[None, :]
-                (logits, self.k_cache, self.v_cache,
-                 self.k_swa, self.v_swa) = forward_hybrid(
-                    self.params, self.cfg.model,
-                    jnp.asarray(tokens),
-                    self.k_cache, self.v_cache, self.k_swa, self.v_swa,
-                    table, swa_table,
-                    jnp.asarray([pos], jnp.int32),
-                    jnp.asarray([len(chunk)], jnp.int32),
-                )
-                req.computed_len = pos + len(chunk)
-                self._swa_reclaim(req)
-            else:
-                logits, self.k_cache, self.v_cache = self._prefill_forward(
-                    self.params, self.cfg.model,
-                    jnp.asarray(tokens),
-                    self.k_cache, self.v_cache,
-                    table,
-                    jnp.asarray([pos], jnp.int32),
-                    jnp.asarray([len(chunk)], jnp.int32),
-                )
-            last_chunk_len = len(chunk)
-            pos += len(chunk)
-        # One logits download for the whole prefill: only the final chunk's
-        # last position feeds sampling, and each host transfer is a full
-        # round trip on a remote-tunneled device.
-        req.last_logits = np.asarray(logits[0, last_chunk_len - 1])
-        req.computed_len = len(req.prompt)
+        if self.hybrid:
+            # SWA pages arrive just-in-time for this chunk's blocks and
+            # out-of-window slots return to the pool after it, so a
+            # long prompt's peak SWA demand is window + chunk.
+            self._swa_ensure(req, (pos + len(chunk) - 1) // page_size)
+            swa_table = jnp.asarray(self._swa_table_for(req))[None, :]
+            (logits, self.k_cache, self.v_cache,
+             self.k_swa, self.v_swa) = forward_hybrid(
+                self.params, self.cfg.model,
+                jnp.asarray(tokens),
+                self.k_cache, self.v_cache, self.k_swa, self.v_swa,
+                table, swa_table,
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([len(chunk)], jnp.int32),
+            )
+            req.computed_len = pos + len(chunk)  # _swa_reclaim reads it
+            self._swa_reclaim(req)
+        else:
+            logits, self.k_cache, self.v_cache = self._prefill_forward(
+                self.params, self.cfg.model,
+                jnp.asarray(tokens),
+                self.k_cache, self.v_cache,
+                table,
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([len(chunk)], jnp.int32),
+            )
+        req.computed_len = pos + len(chunk)
+        if pos + len(chunk) >= len(req.prompt):
+            req.last_logits = np.asarray(logits[0, len(chunk) - 1])
+            req.prefill_pos = None
+        else:
+            req.prefill_pos = pos + len(chunk)
 
     def _commit_full_blocks(self, req: Request) -> None:
         """Register newly computed full prompt blocks in the prefix cache."""
@@ -921,6 +959,7 @@ class MiniEngine:
         )
         # Adopt canonical pages (duplicates swapped to the resident copy).
         req.pages[first_new:n_full] = canonical
+        req.committed_blocks = max(req.committed_blocks, n_full)
         if self.hybrid:
             # Commit only slots still holding pages: blocks that already
             # fell out of the window were reclaimed mid-prefill and are
@@ -973,18 +1012,34 @@ class MiniEngine:
     # -- decode --
 
     def step(self) -> dict[str, int]:
-        """One decode step for every running request.
+        """One scheduling step: advance at most one prefill chunk, then one
+        decode step for every decoding request.
 
-        Returns {request_id: newest_token}. Batched into a single jit call
-        with padding up to max_batch; when ``decode_burst > 1`` each call
-        may emit a power-of-two burst of tokens per request (all of a
-        request's burst tokens land in ``req.output``; the returned dict
-        carries the newest).
+        Returns {request_id: newest_token}. Decode is batched into a single
+        jit call with padding up to max_batch; when ``decode_burst > 1``
+        each call may emit a power-of-two burst of tokens per request (all
+        of a request's burst tokens land in ``req.output``; the returned
+        dict carries the newest). ``enqueue``d requests prefill here,
+        chunk-at-a-time — a long prompt delays running decodes by one
+        chunk per step, never its whole prefill.
         """
         self.poll_offload()
-        active = [self.requests[rid] for rid in self._running
-                  if not self.requests[rid].done]
         emitted: dict[str, int] = {}
+        # Continuous batching: one prefill chunk for the oldest admitted-
+        # but-not-yet-decoding request (FIFO — finish one prefill before
+        # starting the next so TTFTs don't all pay for each other).
+        for rid in self._running:
+            req = self.requests[rid]
+            if req.prefill_pos is not None:
+                self._prefill_chunk(req)
+                if req.prefill_pos is None:
+                    self._finish_prefill(req)
+                    if req.output:
+                        emitted[req.request_id] = req.output[-1]
+                break
+        active = [self.requests[rid] for rid in self._running
+                  if not self.requests[rid].done
+                  and self.requests[rid].prefill_pos is None]
         for chunk_start in range(0, len(active), self.cfg.max_batch):
             chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
             burst = self._burst if not self.hybrid else 1
@@ -1163,9 +1218,14 @@ class MiniEngine:
     def _release(self, req: Request) -> None:
         page_size = self.cfg.model.page_size
         n_hashed = min(len(req.prompt) // page_size, len(req.block_hashes))
-        hashed_pages = set(req.pages[:n_hashed])
-        orphans = [p for p in req.pages[n_hashed:] if p not in hashed_pages]
-        self.block_manager.release(req.block_hashes[:n_hashed], orphans)
+        # Only blocks up to the committed watermark are registered in the
+        # block manager; an aborted mid-prefill request's later pages are
+        # private and must be freed directly (releasing their hashes would
+        # no-op on the unknown keys and leak the pages).
+        n_comm = min(req.committed_blocks, n_hashed)
+        committed_pages = set(req.pages[:n_comm])
+        orphans = [p for p in req.pages[n_comm:] if p not in committed_pages]
+        self.block_manager.release(req.block_hashes[:n_comm], orphans)
         if self.hybrid:
             # SWA group: this request references blocks from
             # swa_acquired_from onward (earlier slots were garbage-mapped).
@@ -1176,13 +1236,13 @@ class MiniEngine:
             window = self.cfg.model.sliding_window
             first_in_window = max(0, req.total_len - window) // page_size
             start = req.swa_acquired_from
-            split = max(start, first_in_window)
-            swa_hashed_pages = set(req.swa_pages[:n_hashed])
-            swa_orphans = [p for p in req.swa_pages[n_hashed:]
-                           if p and p not in swa_hashed_pages]
+            split = min(max(start, first_in_window), n_comm)
+            swa_committed_pages = set(req.swa_pages[:n_comm])
+            swa_orphans = [p for p in req.swa_pages[n_comm:]
+                           if p and p not in swa_committed_pages]
             self.swa_manager.release_dropping(req.block_hashes[start:split])
             self.swa_manager.release(
-                req.block_hashes[split:n_hashed], swa_orphans)
+                req.block_hashes[split:n_comm], swa_orphans)
 
     # -- lifecycle --
 

@@ -340,3 +340,127 @@ class TestDecodeBurst:
         eng.step()
         assert b.done  # took its single remaining token, then froze
         assert len(a.output) == 9  # full 8-token burst despite b's budget
+
+
+class TestContinuousBatching:
+    """enqueue(): admission now, prefill chunk-at-a-time inside step()
+    interleaved with decode (vLLM chunked-prefill scheduling)."""
+
+    def _cfg(self, **kw):
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+        from llmd_kv_cache_tpu.models.engine import EngineConfig
+
+        return EngineConfig(
+            model=LlamaConfig.tiny(), num_pages=128, max_pages_per_seq=32,
+            model_name="cb", pod_identifier="p", **kw)
+
+    def test_enqueue_matches_add_request(self):
+        from llmd_kv_cache_tpu.models.engine import MiniEngine
+
+        prompt = list(range(1, 40))
+        ref_eng = MiniEngine(self._cfg(), seed=3)
+        ref = ref_eng.generate("r", prompt, max_new_tokens=6)
+
+        eng = MiniEngine(self._cfg(max_prefill_tokens=16), seed=3)
+        req = eng.enqueue("r", prompt, max_new_tokens=6)
+        assert req.prefill_pos is not None and not req.output
+        while not req.done:
+            eng.step()
+        assert req.output == ref
+
+    def test_prefill_interleaves_with_decode(self):
+        from llmd_kv_cache_tpu.models.engine import MiniEngine
+
+        # Small chunks force the long prompt through several steps.
+        eng = MiniEngine(self._cfg(max_prefill_tokens=8), seed=1)
+        short = eng.add_request("short", list(range(1, 9)),
+                                max_new_tokens=12)
+        long_req = eng.enqueue("long", list(range(1, 81)), max_new_tokens=2)
+
+        decoded_while_prefilling = 0
+        while long_req.prefill_pos is not None:
+            before = len(short.output)
+            eng.step()
+            decoded_while_prefilling += len(short.output) - before
+        # The short request kept decoding during the long prefill.
+        assert decoded_while_prefilling >= 3
+        while not (short.done and long_req.done):
+            eng.step()
+        assert len(short.output) == 12 and len(long_req.output) == 2
+
+    def test_enqueue_prefix_hit_and_events(self):
+        """Deferred prefill still registers blocks + emits BlockStored, so
+        a second enqueue of the same prompt gets the prefix hit."""
+        from llmd_kv_cache_tpu.models.engine import MiniEngine
+
+        events = []
+        eng = MiniEngine(self._cfg(), event_sink=events.extend, seed=0)
+        prompt = list(range(1, 33))
+        r1 = eng.enqueue("a", prompt, max_new_tokens=2)
+        while not r1.done:
+            eng.step()
+        assert any(type(e).__name__ == "BlockStoredEvent" for e in events)
+        r2 = eng.enqueue("b", prompt, max_new_tokens=2)
+        assert r2.cached_len >= 32 - eng.cfg.model.page_size
+        while not r2.done:
+            eng.step()
+        assert r2.output == r1.output
+
+    def test_enqueue_hybrid(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        cfg = EngineConfig(
+            model=LlamaConfig(
+                vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, head_dim=16, intermediate_size=128,
+                page_size=4, sliding_window=8, swa_layers=(1,),
+            ),
+            num_pages=64, max_pages_per_seq=32, model_name="cb",
+            pod_identifier="p", max_prefill_tokens=8,
+        )
+        prompt = list(range(1, 41))
+        ref = MiniEngine(cfg, seed=2).generate("r", prompt, max_new_tokens=4)
+        eng = MiniEngine(cfg, seed=2)
+        req = eng.enqueue("r", prompt, max_new_tokens=4)
+        while not req.done:
+            eng.step()
+        assert req.output == ref
+
+    def test_abort_mid_prefill_frees_pages(self):
+        """Aborting an enqueue()d request before its prefill completes must
+        return every page to the pool (its blocks were never committed, so
+        release-by-hash would silently leak them)."""
+        from llmd_kv_cache_tpu.models.engine import MiniEngine
+
+        eng = MiniEngine(self._cfg(max_prefill_tokens=8), seed=0)
+        free0 = eng.block_manager.num_free
+        for i in range(3):
+            req = eng.enqueue(f"r{i}", list(range(1, 41)), max_new_tokens=4)
+            eng.step()  # one chunk only
+            assert req.prefill_pos is not None
+            assert eng.abort_request(f"r{i}")
+            assert eng.block_manager.num_free == free0, f"leak on abort {i}"
+
+    def test_abort_mid_prefill_hybrid_frees_pages(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        cfg = EngineConfig(
+            model=LlamaConfig(
+                vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, head_dim=16, intermediate_size=128,
+                page_size=4, sliding_window=8, swa_layers=(1,),
+            ),
+            num_pages=64, max_pages_per_seq=32, model_name="cb",
+            pod_identifier="p", max_prefill_tokens=8,
+        )
+        eng = MiniEngine(cfg, seed=0)
+        free0 = eng.block_manager.num_free
+        swa_free0 = eng.swa_manager.num_free
+        req = eng.enqueue("r", list(range(1, 41)), max_new_tokens=4)
+        eng.step()
+        assert req.prefill_pos is not None
+        assert eng.abort_request("r")
+        assert eng.block_manager.num_free == free0
+        assert eng.swa_manager.num_free == swa_free0
