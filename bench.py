@@ -464,13 +464,14 @@ def main(queued: bool = False) -> None:
     p50_kv = statistics.median(kv_ttfts)
     reduction_pct = 100.0 * (1.0 - p50_kv / p50_rr) if p50_rr > 0 else 0.0
 
-    load = (f", Poisson {qps:.1f} req/s open-loop" if queued else "")
+    load = (f", Poisson {qps:.1f} req/s open-loop, p50 rr {p50_rr:.2f}s "
+            f"vs kv {p50_kv:.3f}s" if queued else "")
     print(json.dumps({
         "metric": "p50 TTFT reduction, KV-aware routing vs round-robin "
                   f"({n_pods} pods, shared-prefix replay{load}, "
                   f"{jax.devices()[0].platform})",
         "value": round(reduction_pct, 2),
-        "unit": f"%{(' (p50 rr %.2fs vs kv %.3fs)' % (p50_rr, p50_kv)) if queued else ''}",
+        "unit": "%",
         "vs_baseline": round(reduction_pct / 40.0, 3),
     }))
 

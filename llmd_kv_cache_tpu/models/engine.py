@@ -125,6 +125,10 @@ class Request:
     # were never committed, and release()ing unknown hashes would silently
     # leak their pages.
     committed_blocks: int = 0
+    # Device-resident page table, cached across prefill chunks (pages are
+    # fixed from admission until commit; each upload is a host→device
+    # round trip). Cleared at prefill finish.
+    table_dev: Any = None
 
     @property
     def total_len(self) -> int:
@@ -544,9 +548,7 @@ class MiniEngine:
         inside ``step()`` interleaved with decode — a long prompt stalls
         running decodes by at most one chunk (``max_prefill_tokens``), not
         its whole prefill (vLLM chunked-prefill scheduling)."""
-        req = self._admit(request_id, prompt, max_new_tokens)
-        req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
-        return req
+        return self._admit(request_id, prompt, max_new_tokens)
 
     def _admit(self, request_id: str, prompt: Sequence[int],
                max_new_tokens: int) -> Request:
@@ -635,6 +637,10 @@ class MiniEngine:
         # Everything acquired/restored so far is registered+refcounted in
         # the block manager; later pages stay private until commit.
         req.committed_blocks = req.cached_len // page_size
+        # Prefill cursor (a full-prefix hit still recomputes the last
+        # prompt token for logits, hence the min with len-1); add_request
+        # drains it synchronously, enqueue leaves it for step().
+        req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
         self.requests[request_id] = req
         self._running.append(request_id)
         return req
@@ -644,6 +650,7 @@ class MiniEngine:
         cache and bootstrap decoding with the first generated token (from
         the prefill step's final logits — vLLM semantics: even a
         full-prefix hit recomputes the last prompt token for logits)."""
+        req.table_dev = None  # pages may swap to canonical at commit
         self._commit_full_blocks(req)
         first_token = int(np.argmax(req.last_logits))
         req.output.append(first_token)
@@ -878,7 +885,6 @@ class MiniEngine:
         long prompts (vLLM-style chunked prefill); each chunk's KV lands in
         the paged cache so the next chunk attends over it.
         """
-        req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
         while req.prefill_pos is not None:
             self._prefill_chunk(req)
 
@@ -890,7 +896,9 @@ class MiniEngine:
         page_size = self.cfg.model.page_size
         chunk_cap = max(page_size, self.cfg.max_prefill_tokens
                         // page_size * page_size)
-        table = jnp.asarray(self._page_table_for(req))[None, :]
+        if req.table_dev is None:
+            req.table_dev = jnp.asarray(self._page_table_for(req))[None, :]
+        table = req.table_dev
 
         pos = req.prefill_pos
         chunk = req.prompt[pos:pos + chunk_cap]

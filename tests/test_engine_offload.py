@@ -90,3 +90,50 @@ class TestWriteThroughAndRestore:
         b.add_request("r2", prompt, max_new_tokens=1)  # storage restore
         req3 = b.add_request("r3", prompt, max_new_tokens=1)  # HBM hit now
         assert req3.cached_len == len(prompt)
+
+
+class TestTPShardedOffload:
+    """Offload with a tensor-parallel engine: the copier gathers the
+    GLOBAL slab from the kv-head-sharded pools, so stored files are
+    topology-independent — a tp=2 pod's cache restores onto a tp=2 OR a
+    single-device pod (unlike the reference's per-rank `_r<rank>`
+    folders, which only match identical topologies)."""
+
+    def _tp_engine(self, tmp_path, pod, mesh):
+        return MiniEngine(
+            EngineConfig(model=LlamaConfig.tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="tiny",
+                         pod_identifier=pod),
+            offload_spec=make_spec(tmp_path), mesh=mesh,
+        )
+
+    def test_tp_store_restores_on_any_topology(self, tmp_path):
+        import jax
+        import pytest
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs ≥2 devices")
+        from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+        prompt = list(range(70, 86))  # 4 full blocks
+
+        a = self._tp_engine(tmp_path, "pod-a", mesh)
+        out_a = a.generate("r1", prompt, max_new_tokens=4)
+        a.flush_offload()
+
+        # tp=2 → tp=2 restore
+        b = self._tp_engine(tmp_path, "pod-b", mesh)
+        req_b = b.add_request("r2", prompt, max_new_tokens=4)
+        assert req_b.cached_len == len(prompt)
+        while not req_b.done:
+            b.step()
+        assert req_b.output == out_a
+
+        # tp=2 → single-device restore (global slab layout)
+        c = make_engine(tmp_path, "pod-c")
+        req_c = c.add_request("r3", prompt, max_new_tokens=4)
+        assert req_c.cached_len == len(prompt)
+        while not req_c.done:
+            c.step()
+        assert req_c.output == out_a
