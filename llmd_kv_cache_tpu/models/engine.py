@@ -20,7 +20,7 @@ from __future__ import annotations
 import functools
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -326,33 +326,6 @@ class BlockManager:
             if info is not None and info.ref_count > 0:
                 info.ref_count -= 1
         self.free_pages.extend(orphan_pages)
-
-    def release_dropping(self, block_hashes: Sequence[int]) -> None:
-        """Release references AND immediately evict now-unreferenced
-        blocks (freeing their pages, emitting BlockRemoved).
-
-        For SWA groups: blocks that fell out of every holder's trailing
-        window are worthless for any future resume, so caching them would
-        only burn pool space and advertise false residency to the index.
-        Blocks still referenced by other requests survive untouched.
-        """
-        removed: list[int] = []
-        for h in block_hashes:
-            info = self.blocks.get(h)
-            if info is None:
-                continue
-            if info.ref_count > 0:
-                info.ref_count -= 1
-            if info.ref_count == 0:
-                self.blocks.pop(h)
-                self.page_to_hash.pop(info.page, None)
-                self.free_pages.append(info.page)
-                removed.append(h)
-        if removed:
-            self._emit([
-                BlockRemovedEvent(block_hashes=removed,
-                                  group_idx=self.group_idx)
-            ])
 
     def clear(self, emit: bool = True) -> None:
         """Drop the whole prefix cache (weight rollout) and emit the reset.
@@ -850,10 +823,15 @@ class MiniEngine:
         """Return the request's out-of-window SWA pages to the pool.
 
         Slots below the current window start are never read again by this
-        request (attention masks them): committed blocks drop this
-        request's reference (evicted + BlockRemoved once unreferenced),
-        private not-yet-committed pages free directly. Reclaimed slots map
-        to the garbage page.
+        request (attention masks them). Private not-yet-committed pages
+        free directly. Committed blocks drop this request's reference but
+        STAY CACHED: a committed SWA block i always serves a resume at
+        block boundary i+1 (whose trailing window covers it), so no
+        committed block is ever resume-worthless — the live window slides
+        past it, cache value does not. Space comes back through normal
+        LRU pressure eviction (which emits BlockRemoved, keeping the
+        index honest), exactly as for full-attention blocks. Reclaimed
+        slots map to the garbage page.
         """
         page_size = self.cfg.model.page_size
         window = self.cfg.model.sliding_window
@@ -875,7 +853,7 @@ class MiniEngine:
                 self.swa_manager.free_pages.append(page)
             req.swa_pages[i] = 0
         if committed:
-            self.swa_manager.release_dropping(committed)
+            self.swa_manager.release(committed, [])
         req.swa_acquired_from = limit
 
     def _prefill(self, req: Request) -> None:
@@ -1236,21 +1214,18 @@ class MiniEngine:
         self.block_manager.release(req.block_hashes[:n_comm], orphans)
         if self.hybrid:
             # SWA group: this request references blocks from
-            # swa_acquired_from onward (earlier slots were garbage-mapped).
-            # Blocks wholly outside the trailing window of the final
-            # context are worthless for any resume — drop them now (freeing
-            # pool space, emitting BlockRemoved so the index stops
-            # advertising them); in-window blocks stay cached for reuse.
-            window = self.cfg.model.sliding_window
-            first_in_window = max(0, req.total_len - window) // page_size
+            # swa_acquired_from onward (earlier slots were reclaimed as
+            # the window slid, their refs already dropped). Committed
+            # blocks stay cached — a committed SWA block i always serves
+            # a resume at boundary i+1, so none is resume-worthless (see
+            # _swa_reclaim); LRU pressure eviction reclaims space and
+            # emits BlockRemoved.
             start = req.swa_acquired_from
-            split = min(max(start, first_in_window), n_comm)
             swa_committed_pages = set(req.swa_pages[:n_comm])
             swa_orphans = [p for p in req.swa_pages[n_comm:]
                            if p and p not in swa_committed_pages]
-            self.swa_manager.release_dropping(req.block_hashes[start:split])
             self.swa_manager.release(
-                req.block_hashes[split:n_comm], swa_orphans)
+                req.block_hashes[start:n_comm], swa_orphans)
 
     # -- lifecycle --
 

@@ -113,6 +113,28 @@ class LlamaConfig:
             qk_norm=True,
         )
 
+    @classmethod
+    def gemma_tiny(cls) -> "LlamaConfig":
+        """Test-sized Gemma-2/3-style hybrid config: sliding-window and
+        full-attention layers interleaved 1:1 — the layout that drives
+        the two-group HMA path (separate window-bounded SWA page pool,
+        group-tagged events; reference ``hma.go:32-66`` consumer side)."""
+        return cls(
+            vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+            sliding_window=8, swa_layers=(0, 2),
+        )
+
+    @classmethod
+    def mixtral_tiny(cls) -> "LlamaConfig":
+        """Test-sized Mixtral-style MoE config (top-2 of 4 experts,
+        GShard capacity dispatch)."""
+        return cls(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+            num_experts=4, num_experts_per_token=2,
+        )
+
 
 def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     """Initialize parameters (truncated-normal projections, ones norms).

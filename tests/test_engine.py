@@ -434,13 +434,13 @@ class TestContinuousBatching:
         from llmd_kv_cache_tpu.models.engine import MiniEngine
 
         eng = MiniEngine(self._cfg(max_prefill_tokens=8), seed=0)
-        free0 = eng.block_manager.num_free
+        free0 = eng.block_manager.num_free()
         for i in range(3):
             req = eng.enqueue(f"r{i}", list(range(1, 41)), max_new_tokens=4)
             eng.step()  # one chunk only
             assert req.prefill_pos is not None
             assert eng.abort_request(f"r{i}")
-            assert eng.block_manager.num_free == free0, f"leak on abort {i}"
+            assert eng.block_manager.num_free() == free0, f"leak on abort {i}"
 
     def test_abort_mid_prefill_hybrid_frees_pages(self):
         from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
@@ -456,11 +456,11 @@ class TestContinuousBatching:
             pod_identifier="p", max_prefill_tokens=8,
         )
         eng = MiniEngine(cfg, seed=0)
-        free0 = eng.block_manager.num_free
-        swa_free0 = eng.swa_manager.num_free
+        free0 = eng.block_manager.num_free()
+        swa_free0 = eng.swa_manager.num_free()
         req = eng.enqueue("r", list(range(1, 41)), max_new_tokens=4)
         eng.step()
         assert req.prefill_pos is not None
         assert eng.abort_request("r")
-        assert eng.block_manager.num_free == free0
-        assert eng.swa_manager.num_free == swa_free0
+        assert eng.block_manager.num_free() == free0
+        assert eng.swa_manager.num_free() == swa_free0

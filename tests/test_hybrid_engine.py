@@ -155,10 +155,14 @@ class TestGroupEvents:
         g1 = [h for e in by_group[1] for h in e.block_hashes]
         assert len(g0) == 4 and g1 == g0[2:]
 
-    def test_swa_blocks_dropped_as_decode_outgrows_window(self):
-        """Committed in-window SWA blocks expire once decode pushes them
-        out of the window: BlockRemoved(group 1) goes out so the index
-        stops advertising them; group 0 keeps everything."""
+    def test_prompt_tail_swa_window_survives_decode(self):
+        """Decode sliding the live window past the prompt tail must NOT
+        revoke committed SWA blocks: block i always serves a resume at
+        boundary i+1 (whose trailing window covers it), so committed SWA
+        blocks stay cached like full-attention blocks and only pressure
+        eviction (or clear) revokes them. An earlier policy dropped them
+        eagerly against the FINAL context's window, which destroyed
+        exactly the blocks a prompt replay resumes from."""
         events = []
         eng = make_engine(events)
         prompt = list(range(1, 17))  # 4 blocks; window = 2 blocks
@@ -167,12 +171,16 @@ class TestGroupEvents:
                    if isinstance(e, BlockStoredEvent) and e.group_idx == 1
                    for h in e.block_hashes]
         assert stored1  # blocks 2,3 were in-window at commit
-        removed = {h for e in events
-                   if isinstance(e, BlockRemovedEvent) and e.group_idx == 1
-                   for h in e.block_hashes}
-        # by total_len 26, window start 18 → blocks 2,3 (tokens 8..16)
-        # have fallen out and must be revoked
-        assert removed == set(stored1)
+        assert not any(isinstance(e, BlockRemovedEvent) and e.group_idx == 1
+                       for e in events)
+        # And they really do serve a replay: full prompt-prefix hit,
+        # token-identical continuation.
+        req2 = eng.add_request("replay", prompt, max_new_tokens=1)
+        assert req2.cached_len == len(prompt)
+        # Deeper prompts resume straight through them too.
+        req3 = eng.add_request("deeper", prompt + list(range(101, 109)),
+                               max_new_tokens=1)
+        assert req3.cached_len >= len(prompt)
         assert not any(isinstance(e, BlockRemovedEvent) and e.group_idx == 0
                        for e in events)
 
