@@ -464,3 +464,51 @@ class TestContinuousBatching:
         assert eng.abort_request("r")
         assert eng.block_manager.num_free() == free0
         assert eng.swa_manager.num_free() == swa_free0
+
+
+class TestLongContext:
+    """Long-context serving: chunked prefill + paged attention handle
+    prompts far beyond one chunk; SWA keeps the live working set
+    window-bounded (the serving-side long-context story; training-side
+    ring attention is tests/test_ring_attention.py)."""
+
+    def test_4k_prompt_chunked_prefill(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny()  # page_size 4
+        eng = MiniEngine(EngineConfig(
+            model=cfg, num_pages=1100, max_pages_per_seq=1040,
+            model_name="long", pod_identifier="p", max_prefill_tokens=512,
+        ), seed=0)
+        prompt = np.random.default_rng(0).integers(1, 250, 4096).tolist()
+        req = eng.add_request("r", prompt, max_new_tokens=2)
+        assert req.computed_len == 4096
+        while not req.done:
+            eng.step()
+        assert len(req.output) == 2
+        # The whole prompt is now prefix cache: replay is a full hit.
+        req2 = eng.add_request("r2", prompt, max_new_tokens=1)
+        assert req2.cached_len == 4096
+        assert req2.output == req.output[:1]
+
+    def test_4k_prompt_hybrid_swa_bounded_pool(self):
+        """A hybrid model's SWA group prefills a 4k prompt through an SWA
+        pool that could never hold it (window + chunk demand, not prompt
+        length); the full-attention group keeps the whole context."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+            sliding_window=32, swa_layers=(0,),  # hybrid: layer 1 full
+        )
+        eng = MiniEngine(EngineConfig(
+            model=cfg, num_pages=1100, num_swa_pages=80,  # << 1024 blocks
+            max_pages_per_seq=1040, model_name="swa-long",
+            pod_identifier="p", max_prefill_tokens=64,
+        ), seed=0)
+        prompt = np.random.default_rng(1).integers(1, 250, 4096).tolist()
+        out = eng.generate("r", prompt, max_new_tokens=2)
+        assert len(out) == 2
