@@ -75,6 +75,17 @@ class EngineConfig:
     # XLA reference elsewhere); True forces Pallas (interpreted on CPU);
     # False forces the XLA path.
     use_pallas_decode: Optional[bool] = None
+    # Prefill attention backend: None = auto, which is the XLA paged
+    # attention — measured on the v5e at production shapes (0.9B model,
+    # 2048-token chunks) the XLA path prefills ~12× faster than the
+    # page-at-a-time Pallas flash-prefill kernel (77 ms vs 1.1 s per
+    # chunk: 16-token DMAs and 16×128 tiles cannot feed the 128×128 MXU,
+    # while XLA's gathered-KV attention runs full-width matmuls). The
+    # kernel still wins where materializing gathered KV is the bottleneck
+    # (very long SWA contexts, page skipping) — True opts in (effective
+    # only while the Pallas decode backend is active, which carries the
+    # platform/head-dim gating).
+    use_pallas_prefill: Optional[bool] = None
     # Chunked prefill: the uncached suffix is processed in chunks of at
     # most this many tokens (vLLM-style), bounding per-step activation
     # memory for long prompts. Must be a multiple of the page size.
@@ -118,6 +129,13 @@ class Request:
     # request is decoding. ``enqueue`` admits with this set; ``step``
     # advances one chunk at a time interleaved with decode.
     prefill_pos: Optional[int] = None
+    # Deferred storage restore (enqueue path): the lookup hasn't run yet /
+    # an async load is in flight. ``step`` polls the job across steps so a
+    # slow restore never stalls running decodes (a synchronous restore in
+    # _admit blocked them for up to the 30 s deadline).
+    restore_pending: bool = False
+    # (job_id, first_missing_block, hashes, pages, deadline) while loading.
+    restore_job: Optional[tuple] = None
     # Prompt blocks registered in the block manager on this request's
     # behalf (acquired prefix at admission, extended by
     # _commit_full_blocks). _release must treat pages past this watermark
@@ -452,12 +470,22 @@ class MiniEngine:
             self._decode_forward = functools.partial(
                 forward_decode_pallas, interpret=not on_tpu, mesh=pallas_mesh
             )
+        else:
+            pallas_mesh = None
+            self._decode_forward = forward
+        # Prefill backend is independent of decode: XLA paged attention by
+        # default (see EngineConfig.use_pallas_prefill for the measured
+        # rationale); the flash-prefill kernel is opt-in.
+        if self.cfg.use_pallas_prefill and use_pallas:
             self._prefill_forward = functools.partial(
                 forward_prefill_pallas, interpret=not on_tpu, mesh=pallas_mesh
             )
         else:
-            pallas_mesh = None
-            self._decode_forward = forward
+            if self.cfg.use_pallas_prefill and not use_pallas:
+                logger.warning(
+                    "use_pallas_prefill=True ignored: the Pallas backend is "
+                    "inactive (platform/head-dim/hybrid gating above); using "
+                    "XLA prefill")
             self._prefill_forward = forward
         self._decode_multi = functools.partial(
             forward_decode_steps, use_pallas=use_pallas,
@@ -479,6 +507,11 @@ class MiniEngine:
         self.offload_manager = None
         self.offload_handlers = None
         self._pending_store_jobs: dict[int, list[int]] = {}
+        # Deferred-restore bookkeeping: results for these job ids must be
+        # stashed by ANY drain (poll_offload's untargeted drain would
+        # otherwise swallow a completion before the owning request polls).
+        self._restore_job_ids: set[int] = set()
+        self._restore_results: dict[int, Any] = {}
         self._offload_medium = ""
         if offload_spec is not None:
             self.offload_manager = offload_spec.get_manager()
@@ -517,14 +550,18 @@ class MiniEngine:
     def enqueue(self, request_id: str, prompt: Sequence[int],
                 max_new_tokens: int = 16) -> Request:
         """Admit a request for continuous batching: pages are acquired and
-        the storage tier consulted now, but prefill runs chunk-at-a-time
-        inside ``step()`` interleaved with decode — a long prompt stalls
+        the storage tier consulted from ``step()``, where prefill runs
+        chunk-at-a-time interleaved with decode — a long prompt stalls
         running decodes by at most one chunk (``max_prefill_tokens``), not
-        its whole prefill (vLLM chunked-prefill scheduling)."""
-        return self._admit(request_id, prompt, max_new_tokens)
+        its whole prefill (vLLM chunked-prefill scheduling). The storage
+        restore is likewise deferred and polled across steps, so a slow
+        storage tier costs the restored request latency, never the
+        running decodes'."""
+        return self._admit(request_id, prompt, max_new_tokens,
+                           defer_restore=True)
 
     def _admit(self, request_id: str, prompt: Sequence[int],
-               max_new_tokens: int) -> Request:
+               max_new_tokens: int, defer_restore: bool = False) -> Request:
         """Shared admission: prefix-cache acquisition, storage restore,
         page allocation, registration. No model compute."""
         prompt = list(prompt)
@@ -575,10 +612,18 @@ class MiniEngine:
         req.computed_len = req.cached_len
 
         # Storage tier: extend the HBM prefix hit with blocks resident on
-        # shared storage (loaded synchronously into fresh pages — the
-        # latency is one high-priority read, far below a prefill).
+        # shared storage. add_request (synchronous serving) restores here —
+        # one high-priority read, far below a prefill. enqueue (continuous
+        # batching) defers: the lookup+load start inside step() and the job
+        # is polled across steps, because a restore blocking _admit would
+        # stall every running decode for up to the load deadline (the
+        # hybrid two-pool restore is all-or-nothing and stays synchronous —
+        # its window coupling makes a half-restored resume unusable).
         if self.offload_manager is not None:
-            self._restore_from_storage(req)
+            if defer_restore and not self.hybrid:
+                req.restore_pending = True
+            else:
+                self._restore_from_storage(req)
 
         # Pages for the uncached remainder (incl. partial tail + decode
         # room). Group 1 (SWA) pages are NOT pre-allocated: _prefill and
@@ -695,19 +740,98 @@ class MiniEngine:
         # Register restored blocks in the prefix cache (no re-store event:
         # the blocks are already on the storage tier; the HBM BlockStored
         # is emitted through commit so the index learns the HBM copy).
-        tokens_per_block = [
-            req.prompt[(first_missing + i) * page_size:(first_missing + i + 1) * page_size]
-            for i in range(len(restore_hashes))
-        ]
-        parent = (
-            req.block_hashes[first_missing - 1] if first_missing > 0 else EMPTY_BLOCK_HASH
-        )
-        canonical = self.block_manager.commit_blocks(
-            restore_hashes, pages, tokens_per_block, parent
+        canonical = self._commit_restored_blocks(
+            req, first_missing, restore_hashes, pages
         )
         req.pages.extend(canonical)
         req.cached_len += len(canonical) * page_size
         req.computed_len = req.cached_len
+
+    def _commit_restored_blocks(self, req: Request, first_missing: int,
+                                hashes: list, pages: list[int]) -> list[int]:
+        """Adopt storage-restored blocks into the prefix cache — the shared
+        commit tail of the synchronous and deferred restore paths. Returns
+        the canonical pages (``commit_blocks`` may swap duplicates)."""
+        page_size = self.cfg.model.page_size
+        tokens_per_block = [
+            req.prompt[(first_missing + i) * page_size:
+                       (first_missing + i + 1) * page_size]
+            for i in range(len(hashes))
+        ]
+        parent = (
+            req.block_hashes[first_missing - 1] if first_missing > 0
+            else EMPTY_BLOCK_HASH
+        )
+        return self.block_manager.commit_blocks(
+            hashes, pages, tokens_per_block, parent
+        )
+
+    def _start_deferred_restore(self, req: Request) -> None:
+        """Kick off the enqueue-path storage restore (non-hybrid).
+
+        Unlike the synchronous path, the load lands in the pages the
+        request already owns for those blocks (allocated at admission for
+        the uncached remainder), so no extra pages are taken; on success
+        ``commit_blocks`` adopts canonical pages and frees duplicates.
+        """
+        req.restore_pending = False
+        page_size = self.cfg.model.page_size
+        first_missing = req.cached_len // page_size
+        remaining = req.block_hashes[first_missing:]
+        if not remaining:
+            return
+        n_stored = self.offload_manager.lookup(remaining)
+        if n_stored == 0:
+            return
+        restore_hashes = remaining[:n_stored]
+        pages = req.pages[first_missing:first_missing + len(restore_hashes)]
+        self._sync_caches_to_copier()
+        job = self.offload_handlers.async_load_blocks(
+            [(h, [p]) for h, p in zip(restore_hashes, pages)]
+        )
+        self._restore_job_ids.add(job)
+        req.restore_job = (job, first_missing, restore_hashes, pages,
+                          time.monotonic() + 30.0)
+
+    def _poll_deferred_restore(self, req: Request) -> bool:
+        """Advance an in-flight deferred restore. Returns True once settled
+        (success, failure, or timeout) — prefill may proceed; False while
+        the load is still in flight (the step goes on decoding)."""
+        job, first_missing, hashes, pages, deadline = req.restore_job
+        result = self._restore_results.pop(job, None)
+        if result is None:
+            result = self._drain_offload(target_job=job)
+        if result is not None:
+            self._restore_job_ids.discard(job)
+        if result is None:
+            if time.monotonic() < deadline:
+                return False
+            # Timed out: non-blocking cancel (timeout 0) — kvio marks the
+            # job cancelled so it can never scatter, and parks its staging
+            # buffers; blocking here would stall every running decode for
+            # exactly the degraded-storage case deferral exists to absorb.
+            self.offload_handlers.wait_job(job, timeout_s=0.0)
+            self._restore_job_ids.discard(job)
+            self._restore_results.pop(job, None)
+            req.restore_job = None
+            logger.warning("deferred storage restore timed out; recomputing")
+            return True
+        req.restore_job = None
+        if not result.success:
+            logger.warning("deferred storage restore failed; recomputing")
+            return True
+        page_size = self.cfg.model.page_size
+        canonical = self._commit_restored_blocks(
+            req, first_missing, hashes, pages
+        )
+        req.pages[first_missing:first_missing + len(canonical)] = canonical
+        req.cached_len = (first_missing + len(canonical)) * page_size
+        req.computed_len = max(req.computed_len, req.cached_len)
+        req.committed_blocks = max(req.committed_blocks,
+                                   first_missing + len(canonical))
+        req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
+        req.table_dev = None  # pages may have swapped to canonical
+        return True
 
     def _restore_from_storage_hybrid(self, req: Request) -> None:
         """Storage restore for hybrid models.
@@ -905,6 +1029,7 @@ class MiniEngine:
                 table, swa_table,
                 jnp.asarray([pos], jnp.int32),
                 jnp.asarray([len(chunk)], jnp.int32),
+                last_only=True,
             )
             req.computed_len = pos + len(chunk)  # _swa_reclaim reads it
             self._swa_reclaim(req)
@@ -916,10 +1041,12 @@ class MiniEngine:
                 table,
                 jnp.asarray([pos], jnp.int32),
                 jnp.asarray([len(chunk)], jnp.int32),
+                last_only=True,
             )
         req.computed_len = pos + len(chunk)
         if pos + len(chunk) >= len(req.prompt):
-            req.last_logits = np.asarray(logits[0, len(chunk) - 1])
+            # last_only: logits row 0 is the chunk's final valid position.
+            req.last_logits = np.asarray(logits[0, 0])
             req.prefill_pos = None
         else:
             req.prefill_pos = pos + len(chunk)
@@ -1014,18 +1141,41 @@ class MiniEngine:
         # Continuous batching: one prefill chunk for the oldest admitted-
         # but-not-yet-decoding request (FIFO — finish one prefill before
         # starting the next so TTFTs don't all pay for each other).
-        for rid in self._running:
+        # Snapshot: _prefill_chunk → _finish_prefill → _finish mutates
+        # self._running for 1-token requests.
+        just_prefilled: Optional[str] = None
+        # Start every pending deferred restore up front, not just the FIFO
+        # head's: the loads are independent DMA jobs, so a younger request's
+        # storage fetch overlaps the older request's restore+prefill instead
+        # of paying for it serially (kvio tracks multiple outstanding jobs).
+        for rid in list(self._running):
+            req = self.requests[rid]
+            if req.prefill_pos is not None and req.restore_pending:
+                self._start_deferred_restore(req)
+        for rid in list(self._running):
             req = self.requests[rid]
             if req.prefill_pos is not None:
+                # Deferred storage restore (enqueue path): started above on
+                # the request's first step, polled here across steps —
+                # decodes keep running below while the load is in flight.
+                if req.restore_job is not None:
+                    if not self._poll_deferred_restore(req):
+                        break
                 self._prefill_chunk(req)
                 if req.prefill_pos is None:
                     self._finish_prefill(req)
                     if req.output:
                         emitted[req.request_id] = req.output[-1]
+                        # Its decode starts next step: including it in this
+                        # step's decode batch would overwrite the prefill
+                        # bootstrap token just emitted (a streaming caller
+                        # would lose one token).
+                        just_prefilled = rid
                 break
         active = [self.requests[rid] for rid in self._running
                   if not self.requests[rid].done
-                  and self.requests[rid].prefill_pos is None]
+                  and self.requests[rid].prefill_pos is None
+                  and rid != just_prefilled]
         for chunk_start in range(0, len(active), self.cfg.max_batch):
             chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
             burst = self._burst if not self.hybrid else 1
@@ -1080,6 +1230,8 @@ class MiniEngine:
                         logger.warning("write-through store job %d failed", res.job_id)
                 if res.job_id in targets:
                     results[res.job_id] = res
+                elif res.job_id in self._restore_job_ids:
+                    self._restore_results[res.job_id] = res
         finally:
             self._sync_caches_from_copier()
         return results
@@ -1098,6 +1250,14 @@ class MiniEngine:
             time.sleep(0.005)
 
     def _finish(self, req: Request) -> None:
+        if req.restore_job is not None:
+            # Abort with a deferred restore in flight: non-blocking cancel —
+            # kvio marks the job cancelled (never scatters) and parks its
+            # staging buffers, so recycling the pages is safe immediately.
+            self.offload_handlers.wait_job(req.restore_job[0], timeout_s=0.0)
+            self._restore_job_ids.discard(req.restore_job[0])
+            self._restore_results.pop(req.restore_job[0], None)
+            req.restore_job = None
         if req.request_id in self._running:
             self._running.remove(req.request_id)
         self._release(req)
@@ -1235,7 +1395,8 @@ class MiniEngine:
         The offload analogue of the reference's wait_job cancellation path
         (request aborted mid-transfer): pending write-through stores for
         its blocks are harmless (content-addressed, idempotent) and are
-        left to complete; restores are synchronous so none are in flight.
+        left to complete; an in-flight deferred restore is cancelled-and-
+        waited in ``_finish`` before its pages are released.
         Returns False for unknown/finished requests.
         """
         req = self.requests.get(request_id)

@@ -354,7 +354,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
-                          ctx_lens, new_lens, attention_fn):
+                          ctx_lens, new_lens, attention_fn, last_only=False):
     """Shared transformer body over grouped KV pools.
 
     ``k_caches[g]`` holds group g's layers stacked in ``cfg.group_layers(g)``
@@ -362,6 +362,12 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
     The non-hybrid case is the 1-tuple degenerate form. ``attention_fn(q,
     k_l, v_l, page_table, positions, total_lens, window) -> [b, seq, heads,
     hd]`` picks the backend.
+
+    ``last_only=True`` computes logits only for each sequence's final valid
+    token (``new_lens - 1``) — the prefill-chunk case, where the full
+    [seq, vocab] lm_head matmul and its fp32 materialization are pure waste
+    (a 2048-token chunk of the bench model otherwise burns 0.27 TFLOP and a
+    262 MB HBM write per chunk on logits nobody reads).
     """
     batch, seq = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
@@ -411,20 +417,24 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
         x = x + _mlp(mlp_in, layer, cfg, valid=valid)
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if last_only:
+        idx = jnp.maximum(new_lens - 1, 0)  # [b]
+        x = jnp.take_along_axis(x, idx[:, None, None], axis=1)  # [b, 1, h]
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, tuple(k_caches), tuple(v_caches)
 
 
 def _forward_impl(params, cfg, tokens, k_cache, v_cache, page_table,
-                  ctx_lens, new_lens, attention_fn):
+                  ctx_lens, new_lens, attention_fn, last_only=False):
     logits, ks, vs = _forward_impl_grouped(
         params, cfg, tokens, (k_cache,), (v_cache,), (page_table,),
-        ctx_lens, new_lens, attention_fn,
+        ctx_lens, new_lens, attention_fn, last_only=last_only,
     )
     return logits, ks[0], vs[0]
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("k_cache", "v_cache"))
+@partial(jax.jit, static_argnames=("cfg", "last_only"),
+         donate_argnames=("k_cache", "v_cache"))
 def forward(
     params: Params,
     cfg: LlamaConfig,
@@ -434,13 +444,15 @@ def forward(
     page_table: jax.Array,  # [batch, pages_per_seq] int32
     ctx_lens: jax.Array,  # [batch] tokens already cached before this call
     new_lens: jax.Array,  # [batch] valid new tokens in `tokens`
+    last_only: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One model step (prefill or decode), XLA attention backend.
 
     Returns ``(logits [b, seq, vocab], k_cache, v_cache)``. Query i of
     sequence b sits at logical position ``ctx_lens[b] + i``; padded
     positions (``i >= new_lens[b]``) are masked and scatter to the garbage
-    page.
+    page. ``last_only=True`` → logits is [b, 1, vocab], the final valid
+    position of each row (prefill chunks; see ``_forward_impl_grouped``).
     """
     def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
         return paged_attention(
@@ -449,11 +461,11 @@ def forward(
 
     return _forward_impl(
         params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
-        xla_attention,
+        xla_attention, last_only=last_only,
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",),
+@partial(jax.jit, static_argnames=("cfg", "last_only"),
          donate_argnames=("k0", "v0", "k1", "v1"))
 def forward_hybrid(
     params: Params,
@@ -467,6 +479,7 @@ def forward_hybrid(
     table1: jax.Array,   # [batch, pages_per_seq] into group 1's pool
     ctx_lens: jax.Array,
     new_lens: jax.Array,
+    last_only: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """One model step for a hybrid (mixed full/SWA) model over two
     separately-paged cache groups. XLA attention backend."""
@@ -477,7 +490,7 @@ def forward_hybrid(
 
     logits, ks, vs = _forward_impl_grouped(
         params, cfg, tokens, (k0, k1), (v0, v1), (table0, table1),
-        ctx_lens, new_lens, xla_attention,
+        ctx_lens, new_lens, xla_attention, last_only=last_only,
     )
     return logits, ks[0], vs[0], ks[1], vs[1]
 
@@ -608,7 +621,7 @@ def forward_decode_steps(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "interpret", "mesh"),
+    static_argnames=("cfg", "interpret", "mesh", "last_only"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def forward_prefill_pallas(
@@ -622,6 +635,7 @@ def forward_prefill_pallas(
     new_lens: jax.Array,
     interpret: bool = False,
     mesh=None,
+    last_only: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Prefill using the Pallas flash-prefill kernel.
 
@@ -650,5 +664,5 @@ def forward_prefill_pallas(
 
     return _forward_impl(
         params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
-        attention_fn,
+        attention_fn, last_only=last_only,
     )

@@ -42,31 +42,34 @@ def paged_attention(
     _, kv_heads, page_size, _ = k_cache.shape
     if scale is None:
         scale = head_dim ** -0.5
+    group = q_heads // kv_heads
 
     k = gather_kv_pages(k_cache, page_table)  # [b, kv_len, kvh, hd]
     v = gather_kv_pages(v_cache, page_table)
     kv_len = k.shape[1]
 
-    # Grouped-query attention: repeat KV heads across the query-head groups.
-    if q_heads != kv_heads:
-        group = q_heads // kv_heads
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
+    # MXU-friendly numerics: feed the matmuls bf16 operands with fp32
+    # accumulation (bf16·bf16 products are exact in fp32) instead of
+    # upcasting K/V first — upcasting halves MXU throughput and doubles
+    # the HBM traffic of the gathered KV. Softmax stays fp32. GQA is a
+    # grouped einsum over [b, q, kvh, group, hd] so KV heads are never
+    # materialized ``group``× (the repeat would burn HBM bandwidth).
+    qg = q.reshape(batch, q_seq, kv_heads, group, head_dim)
+    # [b, kvh, group, q_seq, kv_len], fp32
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
 
-    qf = q.astype(jnp.float32) * scale
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-
-    # [b, heads, q_seq, kv_len]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
-
-    k_pos = jnp.arange(kv_len)[None, None, None, :]  # logical key positions
-    q_pos = q_positions[:, None, :, None]
-    mask = (k_pos <= q_pos) & (k_pos < total_lens[:, None, None, None])
+    k_pos = jnp.arange(kv_len)[None, None, None, None, :]
+    q_pos = q_positions[:, None, None, :, None]
+    mask = (k_pos <= q_pos) & (k_pos < total_lens[:, None, None, None, None])
     if sliding_window is not None:
         mask = mask & (q_pos - k_pos < sliding_window)
     logits = jnp.where(mask, logits, _NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
-    return out.astype(q.dtype)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(batch, q_seq, q_heads, head_dim).astype(q.dtype)

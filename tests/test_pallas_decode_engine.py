@@ -45,9 +45,11 @@ def test_pallas_decode_matches_xla_with_sliding_window():
 
 
 def test_pallas_prefill_engine_matches_xla_path():
-    """With use_pallas_decode=True the engine now also prefills through
-    the Pallas flash-prefill kernel; outputs must match the XLA path,
-    including chunked prefill and prefix-cache resumes."""
+    """With use_pallas_prefill=True the engine prefills through the Pallas
+    flash-prefill kernel; outputs must match the XLA path, including
+    chunked prefill and prefix-cache resumes. (Prefill defaults to the XLA
+    path — measured 12× faster at production shapes — so the kernel is
+    opt-in.)"""
     prompt = list(range(30, 62))  # 8 pages of 4
     outs = {}
     for use_pallas in (False, True):
@@ -56,6 +58,7 @@ def test_pallas_prefill_engine_matches_xla_path():
                 model=LlamaConfig.tiny(), num_pages=64, max_pages_per_seq=16,
                 model_name="tiny", pod_identifier="p",
                 use_pallas_decode=use_pallas,
+                use_pallas_prefill=use_pallas,
                 max_prefill_tokens=16,  # force chunked prefill
             ),
             seed=0,
