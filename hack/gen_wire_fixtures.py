@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Write tests/assets/wire/*.bin from the spec-derived fixture set.
+
+Run only when adding fixtures; test_wire_fixtures.py asserts the committed
+bytes stay identical to tests/wire_spec.fixtures().
+"""
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tests"))
+
+import wire_spec  # noqa: E402
+
+
+def main() -> None:
+    out = ROOT / "tests" / "assets" / "wire"
+    out.mkdir(parents=True, exist_ok=True)
+    for name, payload in wire_spec.fixtures().items():
+        (out / name).write_bytes(payload)
+        print(f"{name}: {len(payload)} bytes")
+
+
+if __name__ == "__main__":
+    main()
