@@ -148,8 +148,12 @@ class TestGoldenVectors:
         assert keys == [GOLDEN_MM_BLOCK]
 
 
-# Golden values frozen from the initial implementation (FNV-64a over
-# canonical CBOR [parent, tokens, extra], model-seeded chain init).
+# Golden values for the chain (FNV-64a over canonical CBOR
+# [parent, tokens, extra], model-seeded chain init). No longer only
+# self-referential: tests/test_cbor_cross.py recomputes equivalent chains
+# end-to-end with cbor2 (a foreign CBOR encoder) in the CI pip tier, and
+# fuzzes the bespoke encoder against cbor2's canonical mode over the full
+# hash-payload domain.
 GOLDEN_SINGLE_BLOCK = 14278394143299064148
 GOLDEN_TWO_BLOCKS = [12118088016799067563, 7239110961410683472]
 GOLDEN_MM_BLOCK = 14175943945182728553
