@@ -28,6 +28,26 @@ class TestWorkload:
         assert all(len(p) == 20 for p in wl)
 
 
+class TestQueueingTTFTs:
+    def test_no_arrivals_returns_bare_service(self):
+        assert bench.queueing_ttfts([1.0, 2.0], ["a", "b"], None) == [1.0, 2.0]
+
+    def test_fifo_queue_wait_accumulates_per_pod(self):
+        # Both requests hit pod "a"; the second arrives at t=0 but waits
+        # for the first's service to finish.
+        ttfts = bench.queueing_ttfts([1.0, 1.0], ["a", "a"], [0.0, 0.0])
+        assert ttfts == [1.0, 2.0]
+
+    def test_independent_pods_do_not_queue(self):
+        ttfts = bench.queueing_ttfts([1.0, 1.0], ["a", "b"], [0.0, 0.0])
+        assert ttfts == [1.0, 1.0]
+
+    def test_idle_gap_resets_queue(self):
+        # Second arrival lands after the first completes: no wait.
+        ttfts = bench.queueing_ttfts([1.0, 1.0], ["a", "a"], [0.0, 5.0])
+        assert ttfts == [1.0, 1.0]
+
+
 class TestBenchModes:
     def test_index_bench_emits_valid_json(self):
         result = bench.bench_index_add()
