@@ -44,6 +44,7 @@ from .llama import (
     forward,
     forward_decode_pallas,
     forward_decode_steps,
+    forward_decode_steps_hybrid,
     forward_hybrid,
     forward_prefill_pallas,
     init_kv_cache,
@@ -63,8 +64,11 @@ class EngineConfig:
     # Hybrid models: size of the SWA group's separate page pool (None →
     # num_pages). SWA pages are allocated just-in-time and reclaimed as
     # slots fall out of the window, so per-request peak demand is
-    # window + prefill-chunk pages (+ the decode page), not prompt length —
-    # the memory win of hybrid attention.
+    # window + max(prefill-chunk, decode_burst) pages (+ the decode page),
+    # not prompt length — the memory win of hybrid attention. Fused bursts
+    # freeze the window tables for up to decode_burst tokens and reclaim
+    # at the burst boundary; an undersized pool degrades that step to
+    # single-token decoding rather than failing.
     num_swa_pages: Optional[int] = None
     max_pages_per_seq: int = 64
     max_batch: int = 8
@@ -450,18 +454,27 @@ class MiniEngine:
                     "cannot compile on TPU, using XLA paged attention",
                     mcfg.head_dim)
             use_pallas = False
+        # Hybrid: fused bursts run the grouped two-pool scan
+        # (forward_decode_steps_hybrid) with freeze-and-reclaim SWA paging,
+        # and the flash-decode kernel applies there per layer (each layer
+        # sees only its own group's table/window). The SINGLE-token hybrid
+        # step stays on the XLA grouped forward — at one token per dispatch
+        # the kernel win is noise next to dispatch cost, and keeping one
+        # code path for it bounds the jit-cache footprint.
+        hybrid_burst_pallas = use_pallas and self.hybrid
         if self.hybrid:
-            # Grouped caches decode through the XLA hybrid path; the Pallas
-            # flash-decode kernel is single-pool.
             if use_pallas and self.cfg.use_pallas_decode:
-                logger.warning("hybrid model: Pallas decode unavailable, "
-                               "using XLA paged attention")
+                if self.cfg.decode_burst > 1:
+                    logger.info(
+                        "hybrid model: Pallas decode applies to fused "
+                        "bursts; single-token steps use XLA attention")
+                else:
+                    logger.warning(
+                        "hybrid model with decode_burst=1: Pallas decode "
+                        "only runs inside fused bursts, so every decode "
+                        "uses XLA attention (set decode_burst>1 to engage "
+                        "the kernel)")
             use_pallas = False
-            if self.cfg.decode_burst > 1:
-                logger.warning(
-                    "hybrid model: fused decode bursts unavailable (the SWA "
-                    "pool's just-in-time paging needs host control between "
-                    "tokens); decoding one token per step")
         if use_pallas:
             # Under tp the kernels run per-shard over the kv-heads
             # sharding via shard_map (the decode grid is per-kv-head
@@ -490,6 +503,11 @@ class MiniEngine:
         self._decode_multi = functools.partial(
             forward_decode_steps, use_pallas=use_pallas,
             interpret=use_pallas and not on_tpu, mesh=pallas_mesh,
+        )
+        self._decode_multi_hybrid = functools.partial(
+            forward_decode_steps_hybrid, use_pallas=hybrid_burst_pallas,
+            interpret=hybrid_burst_pallas and not on_tpu,
+            mesh=(mesh if hybrid_burst_pallas and self._tp > 1 else None),
         )
         # Burst size: the power-of-two floor of cfg.decode_burst, fixed for
         # the engine's lifetime — ONE fused-decode program. Per-row budgets
@@ -1178,7 +1196,7 @@ class MiniEngine:
                   and rid != just_prefilled]
         for chunk_start in range(0, len(active), self.cfg.max_batch):
             chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
-            burst = self._burst if not self.hybrid else 1
+            burst = self._burst
             if burst > 1:
                 emitted.update(self._decode_chunk_burst(chunk, burst))
             else:
@@ -1285,21 +1303,60 @@ class MiniEngine:
 
     def _decode_chunk_burst(self, chunk: list[Request], steps: int) -> dict[str, int]:
         """Fused multi-token decode: one dispatch emits up to ``steps``
-        greedy tokens per row (``forward_decode_steps``); each row decodes
-        until its own remaining budget and freezes after. Non-hybrid only —
-        the SWA pool's just-in-time page dance needs host control between
-        tokens."""
+        greedy tokens per row; each row decodes until its own remaining
+        budget and freezes after.
+
+        Hybrid models run the two-pool scan with freeze-and-reclaim SWA
+        paging (VERDICT r2 #4): the SWA table is pre-extended through every
+        page the burst will touch, frozen for the scan, and slots that
+        slid out of the window are reclaimed once per burst on the host —
+        so SWA families keep the burst's dispatch-amortization win at the
+        cost of up to ``steps`` tokens of extra transient window pages."""
+        page_size = self.cfg.model.page_size
         last, ctx, tables = self._decode_batch_arrays(chunk)
         budgets = np.zeros((self.cfg.max_batch,), np.int32)
+        swa_tables = (np.zeros((self.cfg.max_batch, self.cfg.max_pages_per_seq),
+                               np.int32) if self.hybrid else None)
         for i, req in enumerate(chunk):
             budgets[i] = req.max_new_tokens - len(req.output)
+            if self.hybrid:
+                taken = min(steps, int(budgets[i]))
+                # The burst writes KV at positions computed_len ..
+                # computed_len+taken-1; every SWA slot it touches needs a
+                # live page before the tables freeze. If the pool cannot
+                # cover the whole batch's burst transient (pool sized to
+                # the single-step bound), fall back to single-token
+                # stepping for this step instead of dying mid-decode —
+                # already-extended slots stay valid and reclaim normally.
+                try:
+                    self._swa_ensure(
+                        req,
+                        (req.computed_len + max(taken, 1) - 1) // page_size)
+                except RuntimeError:
+                    logger.warning(
+                        "SWA pool cannot cover a %d-token burst transient; "
+                        "decoding this step single-token (size num_swa_pages "
+                        "for window + decode_burst to keep bursts)", steps)
+                    return self._decode_chunk(chunk)
+                swa_tables[i] = self._swa_table_for(req)
 
-        toks, self.k_cache, self.v_cache = self._decode_multi(
-            self.params, self.cfg.model,
-            jnp.asarray(last), self.k_cache, self.v_cache,
-            jnp.asarray(tables), jnp.asarray(ctx, jnp.int32),
-            jnp.asarray(budgets), steps=steps,
-        )
+        if self.hybrid:
+            (toks, self.k_cache, self.v_cache,
+             self.k_swa, self.v_swa) = self._decode_multi_hybrid(
+                self.params, self.cfg.model,
+                jnp.asarray(last),
+                self.k_cache, self.v_cache, self.k_swa, self.v_swa,
+                jnp.asarray(tables), jnp.asarray(swa_tables),
+                jnp.asarray(ctx, jnp.int32),
+                jnp.asarray(budgets), steps=steps,
+            )
+        else:
+            toks, self.k_cache, self.v_cache = self._decode_multi(
+                self.params, self.cfg.model,
+                jnp.asarray(last), self.k_cache, self.v_cache,
+                jnp.asarray(tables), jnp.asarray(ctx, jnp.int32),
+                jnp.asarray(budgets), steps=steps,
+            )
         toks_host = np.asarray(toks)
         out = {}
         for i, req in enumerate(chunk):
@@ -1310,6 +1367,8 @@ class MiniEngine:
             out[req.request_id] = burst[-1]
             if len(req.output) >= req.max_new_tokens:
                 req.done = True
+            if self.hybrid:
+                self._swa_reclaim(req)
         return out
 
     def _decode_chunk(self, chunk: list[Request]) -> dict[str, int]:
