@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Deployment entry point: one engine pod of the chart's topology.
+
+Runs a MiniEngine whose KV events ride a ZMQ PUB socket to the indexer
+service (``deploy/chart`` wires the same triangle: indexer + engine pods +
+evictor over a shared store). Work arrives through a file-based control
+directory so the pod is drivable from shell scripts and the multi-process
+cluster test (tests/test_cluster_e2e.py) without an HTTP stack:
+
+    <control>/<name>.req.json   {"request_id": "...", "prompt": [ints],
+                                 "max_new_tokens": N}
+    <control>/<name>.out.json   {"request_id": "...", "output": [ints]}
+
+The pod writes ``<control>/<pod-id>.ready`` once serving. SIGTERM exits.
+
+Usage:
+  python examples/engine_pod_main.py --pod-id pod-0 \
+      --zmq-endpoint tcp://127.0.0.1:5557 --control-dir /tmp/ctl \
+      [--offload-root /mnt/kv-store] [--model-name tiny]
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import time
+
+from llmd_kv_cache_tpu.events.publisher import KVEventPublisher
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+from llmd_kv_cache_tpu.utils.logging import configure_from_env
+
+
+def main() -> None:
+    configure_from_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pod-id", required=True)
+    parser.add_argument("--zmq-endpoint", required=True)
+    parser.add_argument("--control-dir", required=True)
+    parser.add_argument("--model-name", default="tiny")
+    parser.add_argument("--offload-root", default=None)
+    args = parser.parse_args()
+
+    cfg = LlamaConfig.tiny()
+    publisher = KVEventPublisher(
+        args.zmq_endpoint, pod_identifier=args.pod_id,
+        model_name=args.model_name, bind=False,
+    )
+    spec = None
+    if args.offload_root:
+        spec = SharedStorageOffloadSpec(
+            root=args.offload_root, model_name=args.model_name,
+            page_size=cfg.page_size, num_layers=cfg.num_layers,
+            kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+            io_threads=2, parallel_agnostic=True,
+        )
+    engine = MiniEngine(
+        EngineConfig(
+            model=cfg, num_pages=64, max_pages_per_seq=16,
+            model_name=args.model_name, pod_identifier=args.pod_id,
+        ),
+        event_sink=publisher.publish,
+        offload_spec=spec,
+        seed=0,  # all pods share deterministic params: cross-pod
+        #         storage restores must be bit-exact resumable
+    )
+
+    control = pathlib.Path(args.control_dir)
+    control.mkdir(parents=True, exist_ok=True)
+
+    running = [True]
+    signal.signal(signal.SIGTERM, lambda *_: running.__setitem__(0, False))
+
+    # Warm the tiny model (first jit), then declare readiness.
+    engine.generate(f"{args.pod_id}-warm", [1, 2, 3, 4], max_new_tokens=1)
+    (control / f"{args.pod_id}.ready").write_text("ok")
+
+    served = set()
+    while running[0]:
+        for req_file in sorted(control.glob(f"{args.pod_id}.*.req.json")):
+            if req_file.name in served:
+                continue
+            served.add(req_file.name)
+            req = json.loads(req_file.read_text())
+            out = engine.generate(
+                req["request_id"], req["prompt"],
+                max_new_tokens=req.get("max_new_tokens", 4),
+            )
+            if spec is not None:
+                engine.flush_offload()
+            # Atomic publish: readers poll for the .out.json name, so it
+            # must never be observable half-written.
+            out_file = req_file.with_suffix("").with_suffix(".out.json")
+            tmp_file = out_file.with_suffix(".tmp")
+            tmp_file.write_text(json.dumps(
+                {"request_id": req["request_id"], "output": out}))
+            os.replace(tmp_file, out_file)
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

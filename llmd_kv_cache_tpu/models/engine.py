@@ -519,6 +519,11 @@ class MiniEngine:
         self._burst = 1
         while self._burst * 2 <= self.cfg.decode_burst:
             self._burst *= 2
+        # Latched when the SWA pool proves too small for burst transients:
+        # the engine then decodes single-token for its lifetime (warned
+        # once) — deterministic behavior instead of a doomed per-step
+        # allocation retry.
+        self._burst_degraded = False
 
         # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
         # write-through on commit, restore on prefix miss at admission.
@@ -946,6 +951,22 @@ class MiniEngine:
         table[: len(req.pages)] = req.pages
         return table
 
+    def _release_burst_transients(self, chunk: list[Request]) -> None:
+        """Hand back SWA pages pre-extended for a burst that cannot run.
+
+        Slots beyond each request's current decode block exist only
+        because of this burst attempt (after a completed burst,
+        ``computed_len`` has advanced past every written slot), so they
+        are private, uncommitted, and safe to free directly.
+        """
+        page_size = self.cfg.model.page_size
+        for req in chunk:
+            keep = req.computed_len // page_size + 1
+            while len(req.swa_pages) > keep:
+                page = req.swa_pages.pop()
+                if page:
+                    self.swa_manager.free_pages.append(page)
+
     def _swa_table_for(self, req: Request) -> np.ndarray:
         table = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
         table[: len(req.swa_pages)] = req.swa_pages
@@ -1313,6 +1334,8 @@ class MiniEngine:
         so SWA families keep the burst's dispatch-amortization win at the
         cost of up to ``steps`` tokens of extra transient window pages."""
         page_size = self.cfg.model.page_size
+        if self.hybrid and self._burst_degraded:
+            return self._decode_chunk(chunk)
         last, ctx, tables = self._decode_batch_arrays(chunk)
         budgets = np.zeros((self.cfg.max_batch,), np.int32)
         swa_tables = (np.zeros((self.cfg.max_batch, self.cfg.max_pages_per_seq),
@@ -1325,18 +1348,22 @@ class MiniEngine:
                 # computed_len+taken-1; every SWA slot it touches needs a
                 # live page before the tables freeze. If the pool cannot
                 # cover the whole batch's burst transient (pool sized to
-                # the single-step bound), fall back to single-token
-                # stepping for this step instead of dying mid-decode —
-                # already-extended slots stay valid and reclaim normally.
+                # the single-step bound), latch single-token decoding for
+                # this engine instead of dying mid-decode: the transients
+                # already taken for the chunk are handed back first, so
+                # the single-step path's own page needs are met.
                 try:
                     self._swa_ensure(
                         req,
                         (req.computed_len + max(taken, 1) - 1) // page_size)
                 except RuntimeError:
+                    self._release_burst_transients(chunk)
+                    self._burst_degraded = True
                     logger.warning(
                         "SWA pool cannot cover a %d-token burst transient; "
-                        "decoding this step single-token (size num_swa_pages "
-                        "for window + decode_burst to keep bursts)", steps)
+                        "decoding single-token from now on (size "
+                        "num_swa_pages for window + decode_burst to keep "
+                        "bursts)", steps)
                     return self._decode_chunk(chunk)
                 swa_tables[i] = self._swa_table_for(req)
 
