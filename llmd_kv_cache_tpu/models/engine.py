@@ -138,6 +138,9 @@ class Request:
     # slow restore never stalls running decodes (a synchronous restore in
     # _admit blocked them for up to the 30 s deadline).
     restore_pending: bool = False
+    # enqueue() timestamp, cleared at first prefill schedule — feeds the
+    # burst-admission-delay histogram.
+    enqueued_at: Optional[float] = None
     # (job_id, first_missing_block, hashes, pages, deadline) while loading.
     restore_job: Optional[tuple] = None
     # Prompt blocks registered in the block manager on this request's
@@ -580,8 +583,13 @@ class MiniEngine:
         restore is likewise deferred and polled across steps, so a slow
         storage tier costs the restored request latency, never the
         running decodes'."""
-        return self._admit(request_id, prompt, max_new_tokens,
-                           defer_restore=True)
+        req = self._admit(request_id, prompt, max_new_tokens,
+                          defer_restore=True)
+        # Burst-admission latency: with decode_burst > 1 the first prefill
+        # chunk can only run once the in-flight burst drains — observed at
+        # first schedule (kvcache_engine_admission_delay_seconds).
+        req.enqueued_at = time.monotonic()
+        return req
 
     def _admit(self, request_id: str, prompt: Sequence[int],
                max_new_tokens: int, defer_restore: bool = False) -> Request:
@@ -1194,6 +1202,17 @@ class MiniEngine:
         for rid in list(self._running):
             req = self.requests[rid]
             if req.prefill_pos is not None:
+                if req.enqueued_at is not None:
+                    # First scheduler pick: the wait is the burst-admission
+                    # latency (plus queueing behind older prefills). A
+                    # deferred storage restore may still follow — that wait
+                    # is a storage cost (kv_offload_*), deliberately not
+                    # part of this scheduling metric.
+                    from ..metrics.collector import record_admission_delay
+
+                    record_admission_delay(
+                        time.monotonic() - req.enqueued_at)
+                    req.enqueued_at = None
                 # Deferred storage restore (enqueue path): started above on
                 # the request's first step, polled here across steps —
                 # decodes keep running below while the load is in flight.
