@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.hma import SPEC_FULL_ATTENTION, SPEC_SLIDING_WINDOW
+from ..core.hma import SPEC_FULL_ATTENTION, SPEC_MLA, SPEC_SLIDING_WINDOW
 from ..core.keys import EMPTY_BLOCK_HASH
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from ..events.model import (
@@ -189,9 +189,15 @@ class BlockManager:
             # pool is sliding_window only when every layer is SWA; any
             # full-attention layer makes full retention the controlling
             # constraint. Hybrid engines construct one manager per group
-            # with explicit specs instead.
+            # with explicit specs instead. MLA pools advertise
+            # mla_attention (events.go:34): block payloads are latents,
+            # not per-head K/V, so consumers must not mix them with
+            # full_attention blocks of the same tokens.
             mcfg = cfg.model
-            if (
+            if mcfg.is_mla:
+                self.spec_kind = SPEC_MLA
+                self.spec_window = None
+            elif (
                 mcfg.sliding_window is not None
                 and set(mcfg.swa_layers) >= set(range(mcfg.num_layers))
             ):
@@ -391,6 +397,17 @@ class MiniEngine:
         if mesh is not None:
             from ..parallel.serve import mesh_tp_size, validate_tp_config
 
+            if mcfg.is_mla:
+                # Megatron placement shards wk/wv on kv-heads; MLA's
+                # latent projections have no kv-head axis (the latent is
+                # shared across heads), so the serve-time shard map does
+                # not apply. DP-sharded fleets of single-chip MLA engines
+                # work today; tp-sharded MLA needs a dedicated layout
+                # (shard w_uk/w_uv on the head axis, replicate the
+                # latent cache).
+                raise NotImplementedError(
+                    "tensor-parallel serving for MLA models is not "
+                    "implemented; run MLA engines per-chip (dp)")
             validate_tp_config(mcfg, mesh)
             self._tp = mesh_tp_size(mesh)
         if self.cfg.max_pages_per_seq * self.cfg.max_batch > self.cfg.num_pages:
@@ -456,6 +473,15 @@ class MiniEngine:
                     "head_dim=%d is not 128-aligned: Pallas paged attention "
                     "cannot compile on TPU, using XLA paged attention",
                     mcfg.head_dim)
+            use_pallas = False
+        if use_pallas and mcfg.is_mla:
+            # The flash kernels iterate per-kv-head K/V pools; MLA's
+            # absorbed attention is multi-query over the latent with a
+            # q/kv width of rank+rope (576 for DeepSeek-V2 shapes — not
+            # 128-lane aligned anyway). XLA paged attention serves MLA.
+            if self.cfg.use_pallas_decode:
+                logger.warning("MLA model: Pallas decode unavailable, "
+                               "using XLA paged attention")
             use_pallas = False
         # Hybrid: fused bursts run the grouped two-pool scan
         # (forward_decode_steps_hybrid) with freeze-and-reclaim SWA paging,

@@ -60,6 +60,18 @@ class LlamaConfig:
     # reference formulation and for tiny models).
     moe_dispatch: str = "capacity"
     moe_capacity_factor: float = 2.0
+    # Multi-head latent attention (DeepSeek-V2/V3): KV is cached as one
+    # per-token latent of ``kv_lora_rank`` dims plus a decoupled-RoPE key
+    # of ``qk_rope_head_dim`` dims SHARED across heads — ~an order of
+    # magnitude less KV memory/bandwidth than GQA, which is the TPU-first
+    # reason to run MLA in its absorbed form (see _forward_impl_grouped):
+    # attention becomes multi-query over the latent itself (kv_heads=1,
+    # head_dim=rank+rope), so the paged cache, offload, and event
+    # machinery apply unchanged with the latent as the block payload.
+    # 0 → standard attention. Events tag blocks ``mla_attention``
+    # (reference events.go:34 KVCacheSpecKindMlaAttention).
+    kv_lora_rank: int = 0
+    qk_rope_head_dim: int = 0
 
     def __post_init__(self):
         if self.num_experts > 0 and self.num_experts_per_token > self.num_experts:
@@ -67,6 +79,17 @@ class LlamaConfig:
                 f"num_experts_per_token ({self.num_experts_per_token}) exceeds "
                 f"num_experts ({self.num_experts})"
             )
+        if self.kv_lora_rank > 0:
+            if self.qk_rope_head_dim <= 0 or self.qk_rope_head_dim % 2:
+                raise ValueError(
+                    "MLA needs an even qk_rope_head_dim > 0 (decoupled-RoPE "
+                    f"key dims), got {self.qk_rope_head_dim}")
+            if self.sliding_window is not None or self.swa_layers:
+                raise ValueError(
+                    "sliding_window_mla is not implemented: MLA configs "
+                    "cannot set sliding_window/swa_layers")
+            if self.qk_norm:
+                raise ValueError("qk_norm is not defined for MLA configs")
 
     def layer_window(self, layer_idx: int):
         if self.sliding_window is not None and layer_idx in self.swa_layers:
@@ -93,6 +116,24 @@ class LlamaConfig:
 
     def layer_group(self, layer_idx: int) -> int:
         return 1 if (self.is_hybrid and layer_idx in self.swa_layers) else 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def kv_cache_heads(self) -> int:
+        """Head count of the paged cache layout (MLA: the latent is one
+        shared 'head' — multi-query over the compressed KV)."""
+        return 1 if self.is_mla else self.num_kv_heads
+
+    @property
+    def kv_cache_head_dim(self) -> int:
+        """Per-token width of the paged cache payload (MLA: latent rank +
+        decoupled-RoPE key; offload specs must use this, not head_dim)."""
+        if self.is_mla:
+            return self.kv_lora_rank + self.qk_rope_head_dim
+        return self.head_dim
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -123,6 +164,18 @@ class LlamaConfig:
             vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
             num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
             sliding_window=8, swa_layers=(0, 2),
+        )
+
+    @classmethod
+    def deepseek_tiny(cls) -> "LlamaConfig":
+        """Test-sized DeepSeek-family config (MLA: latent KV cache with
+        decoupled-RoPE keys, served in absorbed form). Cache payload is
+        16+8=24 dims/token vs GQA-tiny's 2×2×16=64 — the memory ratio is
+        the point of the family."""
+        return cls(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=4, head_dim=16, intermediate_size=128, page_size=4,
+            kv_lora_rank=16, qk_rope_head_dim=8,
         )
 
     @classmethod
@@ -158,15 +211,30 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
 
     layers = []
     for i in range(cfg.num_layers):
-        lk = jax.random.split(keys[2 + i], 8)
+        lk = jax.random.split(keys[2 + i], 10)
         layer = {
             "attn_norm": jnp.ones((h,), jnp.float32),
-            "wq": dense(lk[0], (h, cfg.num_heads * hd)),
-            "wk": dense(lk[1], (h, cfg.num_kv_heads * hd)),
-            "wv": dense(lk[2], (h, cfg.num_kv_heads * hd)),
             "wo": dense(lk[3], (cfg.num_heads * hd, h)),
             "mlp_norm": jnp.ones((h,), jnp.float32),
         }
+        if cfg.is_mla:
+            r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+            layer.update({
+                # q carries nope (head_dim) + decoupled-rope dims per head;
+                # KV is down-projected to the shared latent, with per-head
+                # up-projections absorbed into the attention at serve time.
+                "wq": dense(lk[0], (h, cfg.num_heads * (hd + dr))),
+                "w_dkv": dense(lk[1], (h, r)),
+                "w_kr": dense(lk[2], (h, dr)),
+                "w_uk": dense(lk[8], (cfg.num_heads, r, hd)),
+                "w_uv": dense(lk[9], (cfg.num_heads, r, hd)),
+            })
+        else:
+            layer.update({
+                "wq": dense(lk[0], (h, cfg.num_heads * hd)),
+                "wk": dense(lk[1], (h, cfg.num_kv_heads * hd)),
+                "wv": dense(lk[2], (h, cfg.num_kv_heads * hd)),
+            })
         if cfg.qk_norm:
             layer["q_norm"] = jnp.ones((hd,), jnp.float32)
             layer["k_norm"] = jnp.ones((hd,), jnp.float32)
@@ -195,9 +263,17 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
 
 
 def init_kv_cache(cfg: LlamaConfig, num_pages: int) -> tuple[jax.Array, jax.Array]:
-    """Allocate the paged K and V pools: ``[layers, pages, kvh, page, hd]``."""
-    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, cfg.page_size, cfg.head_dim)
-    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+    """Allocate the paged K and V pools: ``[layers, pages, kvh, page, hd]``.
+
+    MLA: the K pool holds the per-token latent (+rope key) as one shared
+    head; the V pool is width-0 — attention reads values from the same
+    latent, so a separate V cache would double the memory MLA exists to
+    save. The zero-width array keeps every donation/offload seam shaped.
+    """
+    shape = (cfg.num_layers, num_pages, cfg.kv_cache_heads, cfg.page_size,
+             cfg.kv_cache_head_dim)
+    v_width = 0 if cfg.is_mla else cfg.kv_cache_head_dim
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape[:-1] + (v_width,), cfg.dtype)
 
 
 def init_kv_cache_hybrid(
@@ -388,29 +464,65 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
         g, lj = local_idx[li] if len(k_caches) > 1 else (0, li)
         table = tables[g]
         attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = attn_in @ layer["wq"]
-        k = attn_in @ layer["wk"]
-        v = attn_in @ layer["wv"]
-        q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
-        if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
-            q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
-            k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.is_mla:
+            # Absorbed MLA (DeepSeek-V2 §2.1.2, TPU-first formulation):
+            # cache ONLY the latent [c_kv ; rope-key] per token and fold
+            # the per-head up-projections into the query and output — the
+            # attention core is then plain multi-query paged attention
+            # with head_dim = rank+rope over the cache this file already
+            # pages, and HBM traffic per token drops by ~num_heads·2.
+            r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+            q = (attn_in @ layer["wq"]).reshape(
+                batch, seq, cfg.num_heads, cfg.head_dim + dr)
+            q_nope, q_rope = q[..., :cfg.head_dim], q[..., cfg.head_dim:]
+            q_rope = _rope(q_rope, positions, cfg.rope_theta)
+            c_kv = attn_in @ layer["w_dkv"]  # [b, s, r]
+            k_rope = _rope((attn_in @ layer["w_kr"])[:, :, None, :],
+                           positions, cfg.rope_theta)  # [b, s, 1, dr]
+            latent = jnp.concatenate(
+                [c_kv[:, :, None, :], k_rope], axis=-1)  # [b, s, 1, r+dr]
+            # Absorb W_UK: q·(latent@W_UK) == (q@W_UK^T)·latent.
+            q_lat = jnp.einsum("bshd,hrd->bshr", q_nope, layer["w_uk"])
+            q_eff = jnp.concatenate([q_lat, q_rope], axis=-1)
+            # paged_attention scales by q.shape[-1]^-0.5 = (r+dr)^-0.5;
+            # MLA's logical scale is the per-head q/k width (nope+rope).
+            q_eff = q_eff * ((r + dr) ** 0.5 / (cfg.head_dim + dr) ** 0.5)
 
-        k_caches[g] = k_caches[g].at[lj].set(
-            scatter_kv_pages(k_caches[g][lj], k, table, positions, valid)
-        )
-        v_caches[g] = v_caches[g].at[lj].set(
-            scatter_kv_pages(v_caches[g][lj], v, table, positions, valid)
-        )
+            k_caches[g] = k_caches[g].at[lj].set(
+                scatter_kv_pages(k_caches[g][lj], latent, table, positions,
+                                 valid)
+            )
+            # Values ARE the latent: pass the K pool as both K and V (the
+            # width-0 V pool is never read), then un-absorb W_UV.
+            ctx = attention_fn(
+                q_eff, k_caches[g][lj], k_caches[g][lj], table, positions,
+                total_lens, None,
+            )
+            attn = jnp.einsum("bshr,hrv->bshv", ctx[..., :r], layer["w_uv"])
+        else:
+            q = attn_in @ layer["wq"]
+            k = attn_in @ layer["wk"]
+            v = attn_in @ layer["wv"]
+            q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+            v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
+                q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
+                k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
 
-        attn = attention_fn(
-            q, k_caches[g][lj], v_caches[g][lj], table, positions, total_lens,
-            cfg.layer_window(li),
-        )
+            k_caches[g] = k_caches[g].at[lj].set(
+                scatter_kv_pages(k_caches[g][lj], k, table, positions, valid)
+            )
+            v_caches[g] = v_caches[g].at[lj].set(
+                scatter_kv_pages(v_caches[g][lj], v, table, positions, valid)
+            )
+
+            attn = attention_fn(
+                q, k_caches[g][lj], v_caches[g][lj], table, positions,
+                total_lens, cfg.layer_window(li),
+            )
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
 
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)

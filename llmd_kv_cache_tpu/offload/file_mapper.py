@@ -42,6 +42,9 @@ class FileMapperConfig:
     # resume from the old run's KV.
     sliding_window: Optional[int] = None
     swa_layers: tuple = ()
+    # Streams per slab: 2 (K,V) for standard attention, 1 for MLA (the
+    # latent IS the payload; there is no V stream).
+    kv_streams: int = 2
     engine: str = "kvtpu"
     mesh_sizes: dict[str, int] = field(
         default_factory=lambda: {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
@@ -88,6 +91,9 @@ class FileMapper:
             **({"sliding_window": c.sliding_window,
                 "swa_layers": sorted(c.swa_layers)}
                if c.sliding_window is not None else {}),
+            # Only when non-default (MLA's single latent stream): existing
+            # two-stream deployments keep resolving to the same directory.
+            **({"kv_streams": c.kv_streams} if c.kv_streams != 2 else {}),
             "engine": c.engine,
             **({k: v for k, v in sorted(c.mesh_sizes.items())}
                if not c.parallel_agnostic else {}),
@@ -127,6 +133,7 @@ class FileMapper:
                     "pages_per_file": c.pages_per_file,
                     "pages_per_block": c.pages_per_block,
                     "kv_layout": "nkpd",
+                    "kv_streams": c.kv_streams,
                     "engine": c.engine,
                     "mesh_sizes": c.mesh_sizes,
                     "fingerprint": self._fingerprint,

@@ -48,6 +48,9 @@ class SharedStorageOffloadSpec:
     # written under one window/layer-split must not be resumed by another).
     sliding_window: Optional[int] = None
     swa_layers: tuple = ()
+    # 1 for MLA latent stores (use cfg.kv_cache_heads/kv_cache_head_dim
+    # for kv_heads/head_dim then); 2 for standard K+V.
+    kv_streams: int = 2
     rank: int = 0
     parallel_agnostic: bool = False
     events_endpoint: Optional[str] = None
@@ -95,6 +98,7 @@ class SharedStorageOffloadSpec:
             pages_per_block=get("pagesPerBlock", "pages_per_block", default=1),
             sliding_window=get("slidingWindow", "sliding_window"),
             swa_layers=tuple(get("swaLayers", "swa_layers", default=()) or ()),
+            kv_streams=get("kvStreams", "kv_streams", default=2),
             rank=get("rank", default=0),
             parallel_agnostic=get(
                 "parallelAgnostic", "parallel_agnostic", default=False
@@ -117,6 +121,7 @@ class SharedStorageOffloadSpec:
                 pages_per_block=self.pages_per_block,
                 sliding_window=self.sliding_window,
                 swa_layers=tuple(self.swa_layers),
+                kv_streams=self.kv_streams,
                 mesh_sizes=mesh_fingerprint_fields(self.mesh),
                 rank=self.rank,
                 parallel_agnostic=self.parallel_agnostic,
@@ -162,6 +167,28 @@ class SharedStorageOffloadSpec:
     def get_handlers(self, k_cache: jax.Array, v_cache: jax.Array):
         """Worker-side handlers bound to this worker's cache pools."""
         copier = TPUBlockCopier(k_cache, v_cache)
+        # The fingerprint/config.json must describe the bytes the copier
+        # actually moves — a misdeclared spec (e.g. an MLA engine left at
+        # the kv_streams=2 default) would silently write files under
+        # metadata for a different layout. Per-shard head counts may be
+        # below the spec's full-model kv_heads under tp, so heads are
+        # checked as an upper bound only.
+        layers, _, kv_heads, page_size, head_dim = k_cache.shape
+        if (self.kv_streams != copier.streams
+                or head_dim != self.head_dim
+                or page_size != self.page_size
+                or layers > self.num_layers
+                or kv_heads > self.kv_heads):
+            raise ValueError(
+                f"offload spec geometry (streams={self.kv_streams}, "
+                f"kv_heads={self.kv_heads}, head_dim={self.head_dim}, "
+                f"page_size={self.page_size}, layers={self.num_layers}) "
+                f"does not match the bound cache "
+                f"(streams={copier.streams}, kv_heads={kv_heads}, "
+                f"head_dim={head_dim}, page_size={page_size}, "
+                f"layers={layers}); MLA engines must set kv_streams=1 and "
+                "size kv_heads/head_dim from cfg.kv_cache_heads/"
+                "cfg.kv_cache_head_dim")
         if self.backend == "object":
             from .object_store import ObjectStoreOffloadHandlers
 

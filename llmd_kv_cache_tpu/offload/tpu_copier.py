@@ -29,26 +29,34 @@ from ..utils.logging import get_logger
 logger = get_logger("offload.copier")
 
 
-@partial(jax.jit, static_argnames=())
+@partial(jax.jit, static_argnames=("streams",))
 def _gather_slab(k_cache: jax.Array, v_cache: jax.Array,
-                 page_ids: jax.Array) -> jax.Array:
+                 page_ids: jax.Array, streams: int = 2) -> jax.Array:
     """Gather pages into one contiguous slab.
 
     k_cache/v_cache: [layers, num_pages, kv_heads, page_size, head_dim]
     page_ids: [n] physical page indices
-    returns: [layers, 2, n, kv_heads, page_size, head_dim]
+    returns: [layers, streams, n, kv_heads, page_size, head_dim]
+
+    ``streams=1`` is the MLA layout: the K pool holds the whole per-token
+    latent and the V pool is width-0, so block files carry one stream.
     """
     k = k_cache[:, page_ids]  # [layers, n, kvh, page, hd]
+    if streams == 1:
+        return k[:, None]
     v = v_cache[:, page_ids]
     return jnp.stack([k, v], axis=1)
 
 
-@partial(jax.jit, donate_argnames=("k_cache", "v_cache"))
+@partial(jax.jit, donate_argnames=("k_cache", "v_cache"),
+         static_argnames=("streams",))
 def _scatter_slab(k_cache: jax.Array, v_cache: jax.Array, slab: jax.Array,
-                  page_ids: jax.Array) -> tuple[jax.Array, jax.Array]:
+                  page_ids: jax.Array,
+                  streams: int = 2) -> tuple[jax.Array, jax.Array]:
     """Scatter a slab back into the paged pools (donated, in-place)."""
     k_cache = k_cache.at[:, page_ids].set(slab[:, 0])
-    v_cache = v_cache.at[:, page_ids].set(slab[:, 1])
+    if streams == 2:
+        v_cache = v_cache.at[:, page_ids].set(slab[:, 1])
     return k_cache, v_cache
 
 
@@ -60,7 +68,11 @@ class TPUBlockCopier:
         self.k_cache = k_cache
         self.v_cache = v_cache
         layers, _, kv_heads, page_size, head_dim = k_cache.shape
-        self.slab_shape = lambda n: (layers, 2, n, kv_heads, page_size, head_dim)
+        # MLA pools: V is width-0 (values live in the latent K pool), so
+        # block files carry a single stream.
+        self.streams = 1 if v_cache.shape[-1] == 0 else 2
+        self.slab_shape = lambda n: (layers, self.streams, n, kv_heads,
+                                     page_size, head_dim)
         self.dtype = k_cache.dtype
         try:
             self._pinned_sharding = jax.sharding.SingleDeviceSharding(
@@ -97,7 +109,8 @@ class TPUBlockCopier:
     def gather_to_host(self, page_ids: list[int]) -> np.ndarray:
         """Device-side page gather + one D2H transfer; returns the host slab."""
         ids = jnp.asarray(page_ids, jnp.int32)
-        slab = _gather_slab(self.k_cache, self.v_cache, ids)
+        slab = _gather_slab(self.k_cache, self.v_cache, ids,
+                            streams=self.streams)
         return np.asarray(jax.device_get(slab))
 
     # Cap on pages merged into one device transfer: bounds the transient
@@ -124,7 +137,8 @@ class TPUBlockCopier:
                 return
             all_ids = [p for group in chunk for p in group]
             slab = _gather_slab(self.k_cache, self.v_cache,
-                                jnp.asarray(all_ids, jnp.int32))
+                                jnp.asarray(all_ids, jnp.int32),
+                                streams=self.streams)
             merged = np.asarray(jax.device_get(self._to_pinned_host(slab)))
             pos = 0
             for group in chunk:
@@ -174,7 +188,7 @@ class TPUBlockCopier:
             device_slab = jax.device_put(merged)
             self.k_cache, self.v_cache = _scatter_slab(
                 self.k_cache, self.v_cache, device_slab.astype(self.dtype),
-                jnp.asarray(all_ids, jnp.int32),
+                jnp.asarray(all_ids, jnp.int32), streams=self.streams,
             )
             chunk, chunk_pages = [], 0
 

@@ -2,8 +2,10 @@
 
 One test per family the framework claims: Llama (GQA), Qwen3 (QK-norm),
 Gemma-style hybrid (interleaved SWA/full layers → two HMA cache groups),
-Mixtral-style MoE (capacity dispatch). Each family admits, prefills,
-decodes, and emits well-formed KV events.
+Mixtral-style MoE (capacity dispatch), DeepSeek-style MLA (absorbed
+latent attention, single-stream paged cache — see tests/test_mla.py for
+the family's correctness oracle). Each family admits, prefills, decodes,
+and emits well-formed KV events.
 """
 
 import numpy as np
@@ -18,6 +20,7 @@ FAMILIES = {
     "qwen3": LlamaConfig.qwen3_tiny,
     "gemma": LlamaConfig.gemma_tiny,
     "mixtral": LlamaConfig.mixtral_tiny,
+    "deepseek": LlamaConfig.deepseek_tiny,
 }
 
 
