@@ -109,6 +109,37 @@ import sys; sys.argv=['bench','--decode']
 exec(open('bench.py').read())
 " || continue
 
+  stage hybrid_burst_bench 900 "
+import sys; sys.argv=['bench','--decode-hybrid']
+exec(open('bench.py').read())
+" || continue
+
+  stage mla_serve 900 "
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+import numpy as np
+# Production-ish MLA shapes (DeepSeek-V2-lite-like ratios, small depth).
+cfg = LlamaConfig(vocab_size=8192, hidden_size=512, num_layers=4,
+                  num_heads=8, num_kv_heads=8, head_dim=128,
+                  intermediate_size=1408, page_size=16,
+                  kv_lora_rank=256, qk_rope_head_dim=64)
+prompt = np.random.default_rng(0).integers(1, 8000, 128).tolist()
+eng = MiniEngine(EngineConfig(model=cfg, num_pages=256, max_pages_per_seq=32,
+                              model_name='ds', pod_identifier='p',
+                              decode_burst=8), seed=0)
+single = MiniEngine(EngineConfig(model=cfg, num_pages=256, max_pages_per_seq=32,
+                                 model_name='ds', pod_identifier='p'), seed=0)
+b = eng.generate('r', prompt, max_new_tokens=16)
+s = single.generate('r', prompt, max_new_tokens=16)
+assert b == s, (b, s)
+print('MLA absorbed serve on TPU: burst==single-step', b[:4], '...')
+" || continue
+
+  stage mfu_probe 900 "
+import runpy
+runpy.run_path('hack/mfu_probe.py', run_name='__main__')
+" || continue
+
   stage ttft_bench 1200 "
 import sys; sys.argv=['bench','--ttft']
 exec(open('bench.py').read())
