@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.hma import SPEC_FULL_ATTENTION, SPEC_MLA, SPEC_SLIDING_WINDOW
+from ..core.hma import (
+    SPEC_FULL_ATTENTION,
+    SPEC_MLA,
+    SPEC_SINK_FULL,
+    SPEC_SLIDING_WINDOW,
+)
 from ..core.keys import EMPTY_BLOCK_HASH
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from ..events.model import (
@@ -201,7 +206,10 @@ class BlockManager:
                 mcfg.sliding_window is not None
                 and set(mcfg.swa_layers) >= set(range(mcfg.num_layers))
             ):
-                self.spec_kind = SPEC_SLIDING_WINDOW
+                # Uniform SWA; with sinks it is the reference's
+                # sink_full_attention kind (events.go:40).
+                self.spec_kind = (SPEC_SINK_FULL if mcfg.attention_sinks
+                                  else SPEC_SLIDING_WINDOW)
                 self.spec_window = mcfg.sliding_window
             else:
                 self.spec_kind = SPEC_FULL_ATTENTION
@@ -474,6 +482,13 @@ class MiniEngine:
                     "cannot compile on TPU, using XLA paged attention",
                     mcfg.head_dim)
             use_pallas = False
+        if use_pallas and mcfg.attention_sinks:
+            # The flash kernels implement causal + window masks only; the
+            # sink mask (first-S always attendable) runs on the XLA path.
+            if self.cfg.use_pallas_decode:
+                logger.warning("attention-sink model: Pallas decode "
+                               "unavailable, using XLA paged attention")
+            use_pallas = False
         if use_pallas and mcfg.is_mla:
             # The flash kernels iterate per-kv-head K/V pools; MLA's
             # absorbed attention is multi-query over the latent with a
@@ -566,6 +581,15 @@ class MiniEngine:
         self._restore_results: dict[int, Any] = {}
         self._offload_medium = ""
         if offload_spec is not None:
+            if getattr(offload_spec, "attention_sinks", 0) != (
+                    mcfg.attention_sinks):
+                # The sink mask changes deeper layers' KV past the window;
+                # a spec that disagrees would fingerprint to the wrong
+                # store directory and resume byte-incompatible blocks.
+                raise ValueError(
+                    f"offload spec attention_sinks="
+                    f"{getattr(offload_spec, 'attention_sinks', 0)} does "
+                    f"not match the model's {mcfg.attention_sinks}")
             self.offload_manager = offload_spec.get_manager()
             self.offload_handlers = offload_spec.get_handlers(
                 self.k_cache, self.v_cache

@@ -72,6 +72,12 @@ class LlamaConfig:
     # (reference events.go:34 KVCacheSpecKindMlaAttention).
     kv_lora_rank: int = 0
     qk_rope_head_dim: int = 0
+    # Attention sinks (StreamingLLM): with a sliding window, the first
+    # ``attention_sinks`` positions stay attendable past the window — the
+    # reference's ``sink_full_attention`` spec kind (events.go:40).
+    # Supported for uniform-SWA models (every layer in swa_layers); the
+    # hybrid two-pool reclamation would free sink blocks.
+    attention_sinks: int = 0
 
     def __post_init__(self):
         if self.num_experts > 0 and self.num_experts_per_token > self.num_experts:
@@ -90,6 +96,14 @@ class LlamaConfig:
                     "cannot set sliding_window/swa_layers")
             if self.qk_norm:
                 raise ValueError("qk_norm is not defined for MLA configs")
+        if self.attention_sinks:
+            if self.sliding_window is None:
+                raise ValueError("attention_sinks requires sliding_window")
+            if self.is_hybrid:
+                raise ValueError(
+                    "attention sinks need a uniform-SWA model "
+                    "(sink_full_attention); hybrid layouts would reclaim "
+                    "sink blocks from the window-bounded SWA pool")
 
     def layer_window(self, layer_idx: int):
         if self.sliding_window is not None and layer_idx in self.swa_layers:
@@ -164,6 +178,16 @@ class LlamaConfig:
             vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
             num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
             sliding_window=8, swa_layers=(0, 2),
+        )
+
+    @classmethod
+    def sink_tiny(cls) -> "LlamaConfig":
+        """Test-sized StreamingLLM-style config: every layer SWA with
+        attention sinks — the ``sink_full_attention`` spec kind."""
+        return cls(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+            sliding_window=8, swa_layers=(0, 1), attention_sinks=4,
         )
 
     @classmethod
@@ -568,7 +592,8 @@ def forward(
     """
     def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
         return paged_attention(
-            q, k_l, v_l, table, positions, total_lens, sliding_window=window
+            q, k_l, v_l, table, positions, total_lens, sliding_window=window,
+            attention_sinks=cfg.attention_sinks or None,
         )
 
     return _forward_impl(
@@ -597,7 +622,8 @@ def forward_hybrid(
     separately-paged cache groups. XLA attention backend."""
     def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
         return paged_attention(
-            q, k_l, v_l, table, positions, total_lens, sliding_window=window
+            q, k_l, v_l, table, positions, total_lens, sliding_window=window,
+            attention_sinks=cfg.attention_sinks or None,
         )
 
     logits, ks, vs = _forward_impl_grouped(
@@ -653,11 +679,18 @@ def forward_decode_pallas(
     )
 
 
-def _decode_step_attention(use_pallas: bool, interpret: bool, mesh):
+def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
+                           sinks: int | None = None):
     """Attention closure for fused decode bodies — one implementation for
     the single-pool and hybrid two-pool scans (the grouped forward hands
     each layer its own group's table and window, so the closure is
-    pool-agnostic)."""
+    pool-agnostic). ``sinks`` applies on the XLA path only; the engine
+    gates Pallas off for sink models, and a direct caller combining both
+    is refused rather than silently served window-masked logits."""
+    if use_pallas and sinks:
+        raise NotImplementedError(
+            "the Pallas decode kernels implement causal+window masks only; "
+            "attention-sink models must use the XLA path (use_pallas=False)")
     from ..ops.pallas_paged_attention import (
         pallas_paged_decode_attention, sharded_paged_decode_attention)
 
@@ -675,7 +708,8 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh):
             )
             return out[:, None]
         return paged_attention(
-            q, k_l, v_l, table, positions, total_lens, sliding_window=window
+            q, k_l, v_l, table, positions, total_lens, sliding_window=window,
+            attention_sinks=sinks,
         )
 
     return attention
@@ -724,7 +758,8 @@ def forward_decode_steps(
     toks, ks, vs = _decode_steps_scan(
         params, cfg, last_tokens, (k_cache,), (v_cache,), (page_table,),
         ctx_lens, active, steps,
-        _decode_step_attention(use_pallas, interpret, mesh),
+        _decode_step_attention(use_pallas, interpret, mesh,
+                               sinks=cfg.attention_sinks or None),
     )
     return toks, ks[0], vs[0]
 
@@ -789,7 +824,8 @@ def forward_decode_steps_hybrid(
     toks, ks, vs = _decode_steps_scan(
         params, cfg, last_tokens, (k0, k1), (v0, v1), (table0, table1),
         ctx_lens, active, steps,
-        _decode_step_attention(use_pallas, interpret, mesh),
+        _decode_step_attention(use_pallas, interpret, mesh,
+                               sinks=cfg.attention_sinks or None),
     )
     return toks, ks[0], vs[0], ks[1], vs[1]
 

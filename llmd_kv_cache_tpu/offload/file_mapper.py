@@ -45,6 +45,10 @@ class FileMapperConfig:
     # Streams per slab: 2 (K,V) for standard attention, 1 for MLA (the
     # latent IS the payload; there is no V stream).
     kv_streams: int = 2
+    # StreamingLLM sinks: the sink mask changes deeper layers' KV for
+    # positions past the window, so stores written with and without sinks
+    # are byte-incompatible and must not share a directory.
+    attention_sinks: int = 0
     engine: str = "kvtpu"
     mesh_sizes: dict[str, int] = field(
         default_factory=lambda: {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
@@ -94,6 +98,8 @@ class FileMapper:
             # Only when non-default (MLA's single latent stream): existing
             # two-stream deployments keep resolving to the same directory.
             **({"kv_streams": c.kv_streams} if c.kv_streams != 2 else {}),
+            **({"attention_sinks": c.attention_sinks}
+               if c.attention_sinks else {}),
             "engine": c.engine,
             **({k: v for k, v in sorted(c.mesh_sizes.items())}
                if not c.parallel_agnostic else {}),
@@ -134,6 +140,7 @@ class FileMapper:
                     "pages_per_block": c.pages_per_block,
                     "kv_layout": "nkpd",
                     "kv_streams": c.kv_streams,
+                    "attention_sinks": c.attention_sinks,
                     "engine": c.engine,
                     "mesh_sizes": c.mesh_sizes,
                     "fingerprint": self._fingerprint,

@@ -51,6 +51,9 @@ class SharedStorageOffloadSpec:
     # 1 for MLA latent stores (use cfg.kv_cache_heads/kv_cache_head_dim
     # for kv_heads/head_dim then); 2 for standard K+V.
     kv_streams: int = 2
+    # StreamingLLM sinks (enters the store fingerprint: sink and
+    # sink-free KV of the same model are byte-incompatible).
+    attention_sinks: int = 0
     rank: int = 0
     parallel_agnostic: bool = False
     events_endpoint: Optional[str] = None
@@ -99,6 +102,8 @@ class SharedStorageOffloadSpec:
             sliding_window=get("slidingWindow", "sliding_window"),
             swa_layers=tuple(get("swaLayers", "swa_layers", default=()) or ()),
             kv_streams=get("kvStreams", "kv_streams", default=2),
+            attention_sinks=get("attentionSinks", "attention_sinks",
+                                default=0),
             rank=get("rank", default=0),
             parallel_agnostic=get(
                 "parallelAgnostic", "parallel_agnostic", default=False
@@ -122,6 +127,7 @@ class SharedStorageOffloadSpec:
                 sliding_window=self.sliding_window,
                 swa_layers=tuple(self.swa_layers),
                 kv_streams=self.kv_streams,
+                attention_sinks=self.attention_sinks,
                 mesh_sizes=mesh_fingerprint_fields(self.mesh),
                 rank=self.rank,
                 parallel_agnostic=self.parallel_agnostic,

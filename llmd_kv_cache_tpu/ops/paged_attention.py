@@ -30,13 +30,17 @@ def paged_attention(
     total_lens: jax.Array,  # [batch] total tokens (context + new) per sequence
     scale: float | None = None,
     sliding_window: int | None = None,
+    attention_sinks: int | None = None,
 ) -> jax.Array:
     """Causal attention of new queries against paged KV (cached + new).
 
     The KV for the new tokens must already be scattered into the cache.
     ``sliding_window=W`` restricts each query to the last W keys (SWA
-    layers of hybrid-attention models). Returns
-    ``[batch, q_seq, q_heads, head_dim]`` in the query dtype.
+    layers of hybrid-attention models); ``attention_sinks=S`` additionally
+    keeps the first S positions attendable past the window (StreamingLLM
+    sinks — the reference's ``sink_full_attention`` spec kind,
+    ``events.go:40``). Returns ``[batch, q_seq, q_heads, head_dim]`` in
+    the query dtype.
     """
     batch, q_seq, q_heads, head_dim = q.shape
     _, kv_heads, page_size, _ = k_cache.shape
@@ -64,7 +68,10 @@ def paged_attention(
     q_pos = q_positions[:, None, None, :, None]
     mask = (k_pos <= q_pos) & (k_pos < total_lens[:, None, None, None, None])
     if sliding_window is not None:
-        mask = mask & (q_pos - k_pos < sliding_window)
+        in_window = q_pos - k_pos < sliding_window
+        if attention_sinks:
+            in_window = in_window | (k_pos < attention_sinks)
+        mask = mask & in_window
     logits = jnp.where(mask, logits, _NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)

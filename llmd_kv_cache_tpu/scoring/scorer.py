@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from ..core.hma import SPEC_SINK_FULL
 from ..core.keys import (
     TIER_CPU,
     TIER_OBJECT_STORE,
@@ -147,11 +148,20 @@ class HybridAwareScorer(LongestPrefixScorer):
         self.block_size_tokens = block_size_tokens
 
     def _window_blocks(self, pod: str, group_idx) -> Optional[int]:
-        """A group's sliding window in blocks; None = full attention."""
+        """A group's sliding window in blocks; None = full attention.
+
+        ``sink_full_attention`` groups also return None: their mask keeps
+        the sink prefix attendable past the window, and the producing
+        engines resume by longest prefix over a non-reclaiming pool — so
+        a trailing window without block 0 is worthless there, and valuing
+        it like plain SWA would systematically overscore sink pods that
+        lost early blocks to eviction.
+        """
         if group_idx is None or self.group_catalog is None:
             return None
         meta = self.group_catalog.get(pod, group_idx)
-        if meta is not None and meta.sliding_window_size:
+        if (meta is not None and meta.sliding_window_size
+                and meta.kind != SPEC_SINK_FULL):
             return max(1, -(-meta.sliding_window_size // self.block_size_tokens))
         return None
 

@@ -21,6 +21,7 @@ FAMILIES = {
     "gemma": LlamaConfig.gemma_tiny,
     "mixtral": LlamaConfig.mixtral_tiny,
     "deepseek": LlamaConfig.deepseek_tiny,
+    "sink": LlamaConfig.sink_tiny,
 }
 
 
