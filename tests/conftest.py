@@ -7,6 +7,7 @@ Environment must be set before the first ``jax`` import, hence module level.
 """
 
 import os
+import sys
 
 # Hard-set (not setdefault): the environment pins JAX_PLATFORMS to the TPU
 # tunnel plugin, which would silently route "CPU" tests onto the real chip.
@@ -16,3 +17,38 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The ambient environment loads an out-of-tree PJRT plugin from a
+# sitecustomize on PYTHONPATH, which imports jax at interpreter start —
+# *before* this file runs — so jax has already read JAX_PLATFORMS from the
+# original environment and the env-var above is too late. Backend
+# *initialization* is still lazy, so jax.config.update() wins as long as no
+# device call has happened yet. If a backend somehow initialized already
+# (a plugin that eagerly creates devices), abort immediately with the
+# working recipe instead of hanging 25 minutes into the suite on a dead
+# tunnel.
+if "jax" in sys.modules:
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _live = (
+            _xb.backends_are_initialized()
+            if hasattr(_xb, "backends_are_initialized")
+            else bool(_xb._backends)
+        )
+    except Exception:  # private API moved: assume lazy (the common case)
+        _live = False
+
+    if _live and jax.default_backend() != "cpu":
+        # A non-CPU backend is already live: config update can't save us.
+        # (A live CPU backend — e.g. a wrapper touched jax.numpy under the
+        # correct env before pytest started — is the wanted state; keep it.)
+        raise SystemExit(
+            "tests/conftest.py: a JAX backend is already initialized "
+            "— the ambient PJRT plugin claimed the runtime "
+            f"before conftest could force CPU. Re-run as:\n"
+            f"  env PYTHONPATH= JAX_PLATFORMS=cpu python -m pytest tests/"
+        )
+    jax.config.update("jax_platforms", "cpu")
