@@ -396,26 +396,19 @@ class MiniEngine:
         self.cfg = cfg or EngineConfig()
         mcfg = self.cfg.model
         # Tensor-parallel serving: with a mesh carrying a ``tp`` axis, the
-        # params take the Megatron layout and both KV pools shard their
-        # kv-heads axis; the same jitted forwards then run SPMD (XLA
-        # inserts the per-block all-reduces). Paging stays host-side and
-        # replicated — identical on every shard.
+        # params take the Megatron layout and the KV pools shard their
+        # kv-heads axis (MLA: heads shard instead and the single shared
+        # latent pool replicates); the same jitted forwards then run SPMD
+        # (XLA inserts the per-block all-reduces). Paging stays host-side
+        # and replicated — identical on every shard.
         self.mesh = mesh
         self._tp = 1
         if mesh is not None:
             from ..parallel.serve import mesh_tp_size, validate_tp_config
 
-            if mcfg.is_mla:
-                # Megatron placement shards wk/wv on kv-heads; MLA's
-                # latent projections have no kv-head axis (the latent is
-                # shared across heads), so the serve-time shard map does
-                # not apply. DP-sharded fleets of single-chip MLA engines
-                # work today; tp-sharded MLA needs a dedicated layout
-                # (shard w_uk/w_uv on the head axis, replicate the
-                # latent cache).
-                raise NotImplementedError(
-                    "tensor-parallel serving for MLA models is not "
-                    "implemented; run MLA engines per-chip (dp)")
+            # MLA shards on the head axis (wq/w_uk/w_uv/wo split per
+            # head, latent projections + latent cache replicated) —
+            # validate_tp_config checks the per-family divisibility.
             validate_tp_config(mcfg, mesh)
             self._tp = mesh_tp_size(mesh)
         if self.cfg.max_pages_per_seq * self.cfg.max_batch > self.cfg.num_pages:

@@ -44,7 +44,8 @@ def make_mesh(
 
 
 def param_pspecs(has_tp: bool = True, has_ep: bool = False,
-                 moe_layer: bool = False, qk_norm: bool = False) -> dict:
+                 moe_layer: bool = False, qk_norm: bool = False,
+                 mla_layer: bool = False) -> dict:
     """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
@@ -52,6 +53,15 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
     embed/lm_head — the standard Megatron-style layout that keeps matmuls
     large on the MXU and puts one all-reduce per block on ICI. MoE expert
     tensors additionally shard their leading expert dim over ``ep``.
+
+    MLA layers shard on the *head* axis instead of kv-heads: ``wq`` stays
+    column-parallel (its flat output dim is head-major, so a contiguous
+    ``tp`` split assigns whole heads), the absorbed up-projections
+    ``w_uk``/``w_uv`` shard their leading head dim, and the latent
+    down-projections ``w_dkv``/``w_kr`` replicate — the latent is one
+    shared head by construction (DeepSeek-V2 §2.1), so every shard
+    recomputes the tiny rank-wide projection rather than paying a
+    collective for it.
     """
     tp = "tp" if has_tp else None
     ep = "ep" if has_ep else None
@@ -59,11 +69,18 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
     layer = {
         "attn_norm": P(),
         "wq": P(None, tp),
-        "wk": P(None, tp),
-        "wv": P(None, tp),
         "wo": P(tp, None),
         "mlp_norm": P(),
     }
+    if mla_layer:
+        layer.update({
+            "w_dkv": P(),
+            "w_kr": P(),
+            "w_uk": P(tp, None, None),
+            "w_uv": P(tp, None, None),
+        })
+    else:
+        layer.update({"wk": P(None, tp), "wv": P(None, tp)})
     if qk_norm:
         layer.update({"q_norm": P(), "k_norm": P()})
     if moe_layer:
@@ -99,8 +116,10 @@ def param_shardings(mesh: Mesh, params: Params) -> dict:
     has_ep = "ep" in mesh.axis_names
     moe = "router" in params["layers"][0]
     qk = "q_norm" in params["layers"][0]
+    mla = "w_uk" in params["layers"][0]
     specs = _tree_with_layers(
-        param_pspecs(has_tp, has_ep, moe_layer=moe, qk_norm=qk),
+        param_pspecs(has_tp, has_ep, moe_layer=moe, qk_norm=qk,
+                     mla_layer=mla),
         len(params["layers"])
     )
     return jax.tree.map(

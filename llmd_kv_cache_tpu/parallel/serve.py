@@ -8,12 +8,15 @@ TP is first-class: parameters take the Megatron layout
 over ``tp``, and the unchanged jitted forwards run SPMD — XLA derives the
 per-block all-reduces from the shardings (no explicit collectives).
 
-Requirements: ``num_kv_heads % tp == 0`` (each shard owns whole kv heads,
-so GQA groups never straddle shards) and ``num_heads % num_kv_heads == 0``
-(already a model invariant). Page tables and token blocks stay replicated
-host-side — paging is control plane, identical on every shard, which is
-what makes the per-shard KV pools line up with the reference's per-rank
-offload folders.
+Requirements: ``num_kv_heads % tp == 0`` for standard/GQA attention (each
+shard owns whole kv heads, so GQA groups never straddle shards) and
+``num_heads % num_kv_heads == 0`` (already a model invariant). MLA models
+instead require ``num_heads % tp == 0``: they shard the *head* axis
+(wq/w_uk/w_uv/wo) and replicate the single shared latent cache head, so
+each shard runs absorbed multi-query attention locally. Page tables and
+token blocks stay replicated host-side — paging is control plane,
+identical on every shard, which is what makes the per-shard KV pools line
+up with the reference's per-rank offload folders.
 """
 
 from __future__ import annotations
@@ -37,7 +40,15 @@ def mesh_tp_size(mesh: Optional[Mesh]) -> int:
 
 def validate_tp_config(cfg: LlamaConfig, mesh: Mesh) -> None:
     tp = mesh_tp_size(mesh)
-    if cfg.num_kv_heads % tp != 0:
+    if cfg.is_mla:
+        # MLA shards the *head* axis (wq/w_uk/w_uv/wo); the single shared
+        # latent head replicates, so kv-head divisibility does not apply.
+        if cfg.num_heads % tp != 0:
+            raise ValueError(
+                f"num_heads ({cfg.num_heads}) must divide by the tp axis "
+                f"({tp}) so every shard owns whole query heads (MLA "
+                f"shards the absorbed up-projections per head)")
+    elif cfg.num_kv_heads % tp != 0:
         raise ValueError(
             f"num_kv_heads ({cfg.num_kv_heads}) must divide by the tp axis "
             f"({tp}) so every shard owns whole kv heads")
@@ -58,7 +69,12 @@ def shard_kv_pool(mesh: Mesh, k_cache: jax.Array, v_cache: jax.Array):
 
     On a mesh without a ``tp`` axis (e.g. a dp-only fleet mesh) the pool
     is placed replicated — a PartitionSpec naming an absent axis is
-    rejected by NamedSharding."""
-    axes = KV_CACHE_AXES if "tp" in mesh.axis_names else P()
+    rejected by NamedSharding. An MLA latent pool (single shared cache
+    head, ``kv_cache_heads == 1``) also places replicated: the latent is
+    shared across heads by construction, and replicating it is what lets
+    every shard run absorbed multi-query attention with no collective in
+    the attention core."""
+    shardable = "tp" in mesh.axis_names and k_cache.shape[2] > 1
+    axes = KV_CACHE_AXES if shardable else P()
     sharding = NamedSharding(mesh, axes)
     return jax.device_put(k_cache, sharding), jax.device_put(v_cache, sharding)

@@ -170,7 +170,10 @@ class TestMLAEngine:
         assert stored and all(
             e.kv_cache_spec_kind == SPEC_MLA for e in stored)
 
-    def test_tp_mesh_rejected(self):
+    def test_tp_mesh_accepted(self):
+        """TP MLA serving is implemented (head-axis sharding, replicated
+        latent pool) — engine init must accept a tp mesh. Token identity
+        vs single-device is covered in test_tp_serve.py."""
         import pytest
 
         devs = jax.devices()
@@ -179,11 +182,13 @@ class TestMLAEngine:
         from jax.sharding import Mesh
 
         mesh = Mesh(np.array(devs[:2]), ("tp",))
-        with pytest.raises(NotImplementedError, match="MLA"):
-            MiniEngine(
-                EngineConfig(model=CFG, num_pages=64, max_pages_per_seq=16,
-                             model_name="ds", pod_identifier="p"),
-                seed=0, mesh=mesh)
+        eng = MiniEngine(
+            EngineConfig(model=CFG, num_pages=64, max_pages_per_seq=16,
+                         model_name="ds", pod_identifier="p"),
+            seed=0, mesh=mesh)
+        # The latent pool replicates: every shard holds the full pool.
+        assert next(iter(eng.k_cache.addressable_shards)).data.shape == \
+            eng.k_cache.shape
 
 
 class TestMLAOffload:

@@ -132,6 +132,64 @@ def test_tp_less_mesh_replicates(setup):
         eng.k_cache.shape
 
 
+@pytest.fixture(scope="module")
+def mla_setup():
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=4, head_dim=16, intermediate_size=128, page_size=4,
+        kv_lora_rank=16, qk_rope_head_dim=8,
+    )
+    params = init_params(jax.random.PRNGKey(11), cfg)
+    return cfg, params
+
+
+def test_tp_mla_matches_single_device(mla_setup):
+    """Absorbed MLA under tp: heads shard (wq/w_uk/w_uv/wo), the latent
+    cache replicates, tokens match the single-device engine.
+
+    MLA as a first-class family: reference events.go:34 mla_attention."""
+    cfg, params = mla_setup
+    prompt = np.random.default_rng(8).integers(1, 250, 24).tolist()
+    ref = _engine(cfg, params).generate("r", prompt, max_new_tokens=8)
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    out = _engine(cfg, params, mesh=mesh).generate("r", prompt,
+                                                   max_new_tokens=8)
+    assert out == ref
+
+
+def test_tp_mla_decode_burst(mla_setup):
+    """Fused decode bursts through the sharded absorbed-MLA path."""
+    cfg, params = mla_setup
+    prompt = np.random.default_rng(9).integers(1, 250, 12).tolist()
+    ref = _engine(cfg, params, decode_burst=4).generate(
+        "r", prompt, max_new_tokens=8)
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    out = _engine(cfg, params, mesh=mesh, decode_burst=4).generate(
+        "r", prompt, max_new_tokens=8)
+    assert out == ref
+
+
+def test_tp_mla_latent_cache_replicates(mla_setup):
+    """The latent pool must place replicated under tp — every shard reads
+    the full latent for its local heads' multi-query attention."""
+    cfg, params = mla_setup
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    eng = _engine(cfg, params, mesh=mesh)
+    assert next(iter(eng.k_cache.addressable_shards)).data.shape == \
+        eng.k_cache.shape
+
+
+def test_tp_mla_validation():
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=6,
+        num_kv_heads=6, head_dim=16, intermediate_size=128, page_size=4,
+        kv_lora_rank=16, qk_rope_head_dim=8,
+    )
+    mesh = make_mesh({"tp": 4}, jax.devices()[:4])
+    with pytest.raises(ValueError, match="num_heads"):
+        validate_tp_config(cfg, mesh)
+
+
 def test_tp_validation():
     cfg = LlamaConfig.tiny()  # num_kv_heads=2
     mesh = make_mesh({"tp": 4}, jax.devices()[:4])
