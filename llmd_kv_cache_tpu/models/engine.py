@@ -464,32 +464,25 @@ class MiniEngine:
         on_tpu = jax.devices()[0].platform == "tpu"
         if use_pallas is None:
             use_pallas = on_tpu
+        # The kernels' per-page DMA width is the cache payload width:
+        # head_dim for standard/GQA attention, the latent width
+        # (rank + rope + latent_pad) for absorbed MLA — which runs as the
+        # kernels' kv_heads=1 multi-query case. Sink masks apply in-kernel
+        # (StreamingLLM first-S positions), so neither family gates Pallas
+        # off anymore; only Mosaic's 128-lane alignment does.
+        kernel_width = mcfg.kv_cache_head_dim
         if use_pallas and on_tpu and not _pallas_head_dim_supported(
-                mcfg.head_dim):
+                kernel_width):
             # Mosaic lane-tiling constraint (see ops.pallas_paged_attention
             # .head_dim_supported); interpreter-mode tests still cover such
             # shapes, on-chip serving falls back to XLA paged attention.
             if self.cfg.use_pallas_decode:
+                hint = (" (set LlamaConfig.latent_pad to align the latent "
+                        "width)" if mcfg.is_mla else "")
                 logger.warning(
-                    "head_dim=%d is not 128-aligned: Pallas paged attention "
-                    "cannot compile on TPU, using XLA paged attention",
-                    mcfg.head_dim)
-            use_pallas = False
-        if use_pallas and mcfg.attention_sinks:
-            # The flash kernels implement causal + window masks only; the
-            # sink mask (first-S always attendable) runs on the XLA path.
-            if self.cfg.use_pallas_decode:
-                logger.warning("attention-sink model: Pallas decode "
-                               "unavailable, using XLA paged attention")
-            use_pallas = False
-        if use_pallas and mcfg.is_mla:
-            # The flash kernels iterate per-kv-head K/V pools; MLA's
-            # absorbed attention is multi-query over the latent with a
-            # q/kv width of rank+rope (576 for DeepSeek-V2 shapes — not
-            # 128-lane aligned anyway). XLA paged attention serves MLA.
-            if self.cfg.use_pallas_decode:
-                logger.warning("MLA model: Pallas decode unavailable, "
-                               "using XLA paged attention")
+                    "cache payload width %d is not 128-aligned: Pallas "
+                    "paged attention cannot compile on TPU, using XLA "
+                    "paged attention%s", kernel_width, hint)
             use_pallas = False
         # Hybrid: fused bursts run the grouped two-pool scan
         # (forward_decode_steps_hybrid) with freeze-and-reclaim SWA paging,

@@ -72,6 +72,14 @@ class LlamaConfig:
     # (reference events.go:34 KVCacheSpecKindMlaAttention).
     kv_lora_rank: int = 0
     qk_rope_head_dim: int = 0
+    # Zero-padding appended to the MLA latent cache payload so its width
+    # (rank + rope + pad) hits the Mosaic 128-lane alignment the Pallas
+    # kernels need on real TPU — DeepSeek-V2 shapes set 64 (512+64+64=640).
+    # The pad is part of the cache layout everywhere (pool, offload files,
+    # fingerprints), so padded and unpadded engines never share a store;
+    # attention math is invariant to it up to fp rounding of the scale
+    # factor (zero key dims score zero, value reads slice [:rank]).
+    latent_pad: int = 0
     # Attention sinks (StreamingLLM): with a sliding window, the first
     # ``attention_sinks`` positions stay attendable past the window — the
     # reference's ``sink_full_attention`` spec kind (events.go:40).
@@ -96,6 +104,11 @@ class LlamaConfig:
                     "cannot set sliding_window/swa_layers")
             if self.qk_norm:
                 raise ValueError("qk_norm is not defined for MLA configs")
+        if self.latent_pad:
+            if not self.is_mla:
+                raise ValueError("latent_pad only applies to MLA configs")
+            if self.latent_pad < 0:
+                raise ValueError("latent_pad must be >= 0")
         if self.attention_sinks:
             if self.sliding_window is None:
                 raise ValueError("attention_sinks requires sliding_window")
@@ -144,9 +157,10 @@ class LlamaConfig:
     @property
     def kv_cache_head_dim(self) -> int:
         """Per-token width of the paged cache payload (MLA: latent rank +
-        decoupled-RoPE key; offload specs must use this, not head_dim)."""
+        decoupled-RoPE key + alignment pad; offload specs must use this,
+        not head_dim)."""
         if self.is_mla:
-            return self.kv_lora_rank + self.qk_rope_head_dim
+            return self.kv_lora_rank + self.qk_rope_head_dim + self.latent_pad
         return self.head_dim
 
     @classmethod
@@ -508,9 +522,19 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             # Absorb W_UK: q·(latent@W_UK) == (q@W_UK^T)·latent.
             q_lat = jnp.einsum("bshd,hrd->bshr", q_nope, layer["w_uk"])
             q_eff = jnp.concatenate([q_lat, q_rope], axis=-1)
-            # paged_attention scales by q.shape[-1]^-0.5 = (r+dr)^-0.5;
-            # MLA's logical scale is the per-head q/k width (nope+rope).
-            q_eff = q_eff * ((r + dr) ** 0.5 / (cfg.head_dim + dr) ** 0.5)
+            if cfg.latent_pad:
+                # 128-lane alignment pad (see LlamaConfig.latent_pad):
+                # zero key dims score zero against any query, so the
+                # attention output only sees the pad through fp rounding
+                # of the two-step scale factor (~1 ulp).
+                pad = [(0, 0)] * 3 + [(0, cfg.latent_pad)]
+                latent = jnp.pad(latent, pad)
+                q_eff = jnp.pad(q_eff, pad)
+            # The attention backends scale by q.shape[-1]^-0.5 (the padded
+            # cache width); MLA's logical scale is the per-head q/k width
+            # (nope+rope).
+            q_eff = q_eff * (
+                q_eff.shape[-1] ** 0.5 / (cfg.head_dim + dr) ** 0.5)
 
             k_caches[g] = k_caches[g].at[lj].set(
                 scatter_kv_pages(k_caches[g][lj], latent, table, positions,
@@ -660,16 +684,20 @@ def forward_decode_pallas(
     from ..ops.pallas_paged_attention import (
         pallas_paged_decode_attention, sharded_paged_decode_attention)
 
+    sinks = cfg.attention_sinks or None
+
     def pallas_attention(q, k_l, v_l, table, _positions, total_lens, window):
         if mesh is not None:
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, interpret=interpret,
+                sliding_window=window, sinks=sinks,
+                interpret=interpret,
             )
         else:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, interpret=interpret,
+                sliding_window=window, sinks=sinks,
+                interpret=interpret,
             )
         return out[:, None]  # restore the seq axis
 
@@ -684,13 +712,9 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
     """Attention closure for fused decode bodies — one implementation for
     the single-pool and hybrid two-pool scans (the grouped forward hands
     each layer its own group's table and window, so the closure is
-    pool-agnostic). ``sinks`` applies on the XLA path only; the engine
-    gates Pallas off for sink models, and a direct caller combining both
-    is refused rather than silently served window-masked logits."""
-    if use_pallas and sinks:
-        raise NotImplementedError(
-            "the Pallas decode kernels implement causal+window masks only; "
-            "attention-sink models must use the XLA path (use_pallas=False)")
+    pool-agnostic). ``sinks`` (StreamingLLM) applies in-kernel on the
+    Pallas path and in-mask on the XLA path — same semantics, parity
+    tested in tests/test_pallas_attention.py."""
     from ..ops.pallas_paged_attention import (
         pallas_paged_decode_attention, sharded_paged_decode_attention)
 
@@ -698,13 +722,15 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
         if use_pallas and mesh is not None:
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, interpret=interpret,
+                sliding_window=window, sinks=sinks,
+                interpret=interpret,
             )
             return out[:, None]
         if use_pallas:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, interpret=interpret,
+                sliding_window=window, sinks=sinks,
+                interpret=interpret,
             )
             return out[:, None]
         return paged_attention(
@@ -862,15 +888,19 @@ def forward_prefill_pallas(
     seq = tokens.shape[1]
     q_tile = math.gcd(seq, 16)
 
+    sinks = cfg.attention_sinks or None
+
     def attention_fn(q, k_l, v_l, table, positions, total_lens, window):
         if mesh is not None:
             return sharded_paged_prefill_attention(
                 mesh, q, k_l, v_l, table, ctx_lens, total_lens,
-                q_tile=q_tile, sliding_window=window, interpret=interpret,
+                q_tile=q_tile, sliding_window=window,
+                sinks=sinks, interpret=interpret,
             )
         return pallas_paged_prefill_attention(
             q, k_l, v_l, table, ctx_lens, total_lens,
-            q_tile=q_tile, sliding_window=window, interpret=interpret,
+            q_tile=q_tile, sliding_window=window,
+            sinks=sinks, interpret=interpret,
         )
 
     return _forward_impl(

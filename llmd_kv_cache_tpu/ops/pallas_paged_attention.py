@@ -66,6 +66,7 @@ def _decode_kernel(
     page_size: int,
     scale: float,
     sliding_window: int | None,
+    sinks: int,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -74,12 +75,28 @@ def _decode_kernel(
     ctx_len = ctx_lens_ref[b]
     num_pages = (ctx_len + page_size - 1) // page_size
     # SWA: pages entirely outside the window are skipped, so long contexts
-    # stream only ~window/page_size pages.
+    # stream only ~window/page_size pages. Attention sinks (StreamingLLM,
+    # reference events.go:40 sink_full_attention) additionally stream the
+    # first ceil(S/page_size) pages: the loop counter j is remapped to a
+    # page index — sink pages [0, sink_pages) first, then window pages
+    # [first_window, num_pages) — so the double-buffered DMA pipeline is
+    # unchanged and the skipped middle costs nothing.
     if sliding_window is not None:
-        first_pos = jnp.maximum(ctx_len - sliding_window, 0)
-        first_page = first_pos // page_size
+        first_window = jnp.maximum(ctx_len - sliding_window, 0) // page_size
     else:
-        first_page = 0
+        first_window = jnp.int32(0)
+    if sinks:
+        sink_pages = jnp.minimum(
+            (sinks + page_size - 1) // page_size, num_pages)
+        first_window = jnp.maximum(first_window, sink_pages)
+    else:
+        sink_pages = jnp.int32(0)
+    num_iters = sink_pages + num_pages - first_window
+
+    def page_for(j):
+        if not sinks:
+            return first_window + j
+        return jnp.where(j < sink_pages, j, first_window + (j - sink_pages))
 
     def page_dma(slot, page_idx):
         page = page_table_ref[b, page_idx]
@@ -91,24 +108,24 @@ def _decode_kernel(
         )
         return k_copy, v_copy
 
-    @pl.when(num_pages > first_page)
+    @pl.when(num_iters > 0)
     def _():
-        for c in page_dma(0, first_page):
+        for c in page_dma(0, page_for(0)):
             c.start()
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, head_dim]
 
-    def body(i, carry):
+    def body(j, carry):
         m_prev, l_prev, acc_prev = carry
-        slot = (i - first_page) % 2
-        next_slot = (i - first_page + 1) % 2
+        slot = j % 2
+        next_slot = (j + 1) % 2
 
-        @pl.when(i + 1 < num_pages)
+        @pl.when(j + 1 < num_iters)
         def _():
-            for c in page_dma(next_slot, i + 1):
+            for c in page_dma(next_slot, page_for(j + 1)):
                 c.start()
 
-        for c in page_dma(slot, i):
+        for c in page_dma(slot, page_for(j)):
             c.wait()
 
         k = k_scratch[slot].astype(jnp.float32)  # [page_size, head_dim]
@@ -120,13 +137,17 @@ def _decode_kernel(
         )  # [group, page_size]
 
         # mask slots beyond the context length on the last page (and, for
-        # SWA, positions that fell out of the window)
-        positions = i * page_size + jax.lax.broadcasted_iota(
+        # SWA, positions that fell out of the window — unless they are
+        # sink positions, which stay attendable forever)
+        positions = page_for(j) * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1
         )
         in_bounds = positions < ctx_len
         if sliding_window is not None:
-            in_bounds = in_bounds & (positions >= ctx_len - sliding_window)
+            in_window = positions >= ctx_len - sliding_window
+            if sinks:
+                in_window = in_window | (positions < sinks)
+            in_bounds = in_bounds & in_window
         scores = jnp.where(in_bounds, scores, _NEG_INF)
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # [group, 1]
@@ -143,7 +164,7 @@ def _decode_kernel(
     m0 = jnp.full((group, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, 1), jnp.float32)
     acc0 = jnp.zeros((group, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(first_page, num_pages, body, (m0, l0, acc0))
+    _m, l_fin, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l_fin, 1e-30)
     o_ref[0, 0] = out.astype(o_ref.dtype)
@@ -169,6 +190,7 @@ def _prefill_kernel(
     q_tile: int,
     scale: float,
     sliding_window: int | None,
+    sinks: int,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -186,11 +208,25 @@ def _prefill_kernel(
     # SWA: the earliest key any query in this tile can see is
     # q_start - W + 1 (XLA convention: q_pos - k_pos < W), so pages wholly
     # before it are never streamed — long contexts cost ~W/page_size pages
-    # per tile, matching the decode kernel's page skipping.
+    # per tile, matching the decode kernel's page skipping. Sinks keep the
+    # first ceil(S/page_size) pages streamed too, via the same loop-counter
+    # → page-index remap as the decode kernel.
     if sliding_window is not None:
-        first_page = jnp.maximum(q_start - sliding_window + 1, 0) // page_size
+        first_window = jnp.maximum(q_start - sliding_window + 1, 0) // page_size
     else:
-        first_page = 0
+        first_window = jnp.int32(0)
+    if sinks:
+        sink_pages = jnp.minimum(
+            (sinks + page_size - 1) // page_size, num_pages)
+        first_window = jnp.maximum(first_window, sink_pages)
+    else:
+        sink_pages = jnp.int32(0)
+    num_iters = sink_pages + num_pages - jnp.minimum(first_window, num_pages)
+
+    def page_for(j):
+        if not sinks:
+            return first_window + j
+        return jnp.where(j < sink_pages, j, first_window + (j - sink_pages))
 
     def page_dma(slot, page_idx):
         page = page_table_ref[b, page_idx]
@@ -203,26 +239,26 @@ def _prefill_kernel(
             ),
         )
 
-    @pl.when(num_pages > first_page)
+    @pl.when(num_iters > 0)
     def _():
-        for c in page_dma(first_page % 2, first_page):
+        for c in page_dma(0, page_for(0)):
             c.start()
 
     q = q_ref[0, 0, :, 0].astype(jnp.float32) * scale  # [q_tile, group, hd]
     q2d = q.transpose(1, 0, 2)  # [group, q_tile, head_dim]
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_tile, 1), 0)
 
-    def body(i, carry):
+    def body(j, carry):
         m_prev, l_prev, acc_prev = carry
-        slot = i % 2
-        next_slot = (i + 1) % 2
+        slot = j % 2
+        next_slot = (j + 1) % 2
 
-        @pl.when(i + 1 < num_pages)
+        @pl.when(j + 1 < num_iters)
         def _():
-            for c in page_dma(next_slot, i + 1):
+            for c in page_dma(next_slot, page_for(j + 1)):
                 c.start()
 
-        for c in page_dma(slot, i):
+        for c in page_dma(slot, page_for(j)):
             c.wait()
 
         k = k_scratch[slot].astype(jnp.float32)  # [page_size, head_dim]
@@ -233,12 +269,15 @@ def _prefill_kernel(
             q2d, k, dimension_numbers=(((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        k_pos = i * page_size + jax.lax.broadcasted_iota(
+        k_pos = page_for(j) * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1
         )
         mask = (k_pos <= q_pos) & (k_pos < total_len)  # [q_tile, page_size]
         if sliding_window is not None:
-            mask = mask & (q_pos - k_pos < sliding_window)
+            in_window = q_pos - k_pos < sliding_window
+            if sinks:
+                in_window = in_window | (k_pos < sinks)
+            mask = mask & in_window
         scores = jnp.where(mask[None], scores, _NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -255,7 +294,7 @@ def _prefill_kernel(
     m0 = jnp.full((group, q_tile, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, q_tile, 1), jnp.float32)
     acc0 = jnp.zeros((group, q_tile, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(first_page, num_pages, body,
+    _m, l_fin, acc = jax.lax.fori_loop(0, num_iters, body,
                                        (m0, l0, acc0))
 
     out = acc / jnp.maximum(l_fin, 1e-30)  # [group, q_tile, head_dim]
@@ -263,7 +302,8 @@ def _prefill_kernel(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("q_tile", "sliding_window", "interpret"))
+                   static_argnames=("q_tile", "sliding_window", "sinks",
+                                    "interpret"))
 def pallas_paged_prefill_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -274,6 +314,7 @@ def pallas_paged_prefill_attention(
     *,
     q_tile: int = 16,
     sliding_window: int | None = None,
+    sinks: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash prefill over paged KV (new tokens' KV already scattered).
@@ -283,12 +324,18 @@ def pallas_paged_prefill_attention(
     ``[batch, q_seq, q_heads, head_dim]``. ``q_seq`` must divide by
     ``q_tile`` (callers pad; padded rows are masked out by total_lens).
     ``sliding_window=W`` restricts each query to the last W keys and skips
-    pages wholly out of window.
+    pages wholly out of window; ``sinks=S`` keeps the first S positions
+    attendable past the window (StreamingLLM; needs a window).
     """
     batch, q_seq, q_heads, head_dim = q.shape
     _, kv_heads, page_size, _ = k_cache.shape
     group = q_heads // kv_heads
     assert q_seq % q_tile == 0, "pad q_seq to a q_tile multiple"
+    if sliding_window is None:
+        # Without a window every position is causally attendable anyway —
+        # the sink mask is a semantic no-op, so callers can pass a model's
+        # sinks unconditionally (full-attention layers included).
+        sinks = None
     _check_head_dim_alignment(head_dim, interpret)
 
     # [batch, q_blocks, q_tile, kv_heads, group, head_dim] view via reshape:
@@ -297,6 +344,7 @@ def pallas_paged_prefill_attention(
     kernel = functools.partial(
         _prefill_kernel, page_size=page_size, q_tile=q_tile,
         scale=head_dim ** -0.5, sliding_window=sliding_window,
+        sinks=int(sinks or 0),
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -334,7 +382,8 @@ def pallas_paged_prefill_attention(
     return out.reshape(batch, q_seq, q_heads, head_dim)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "sliding_window", "sinks"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -343,23 +392,30 @@ def pallas_paged_decode_attention(
     ctx_lens: jax.Array,  # [batch] int32 (keys to attend per sequence)
     *,
     sliding_window: int | None = None,
+    sinks: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
 
     The page size is the cache's native page dimension — the DMA tiles and
     mask arithmetic are derived from it, so no override is offered.
+    ``sinks=S`` (StreamingLLM) keeps the first S positions attendable past
+    the sliding window; their pages are streamed in addition to the
+    window's. MLA's absorbed multi-query form is the ``kv_heads == 1``
+    case: one shared latent 'head' serves every query head as one group.
     """
     batch, q_heads, head_dim = q.shape
     num_pages_total, kv_heads, page_size, _ = k_cache.shape
     group = q_heads // kv_heads
+    if sliding_window is None:
+        sinks = None  # no-op without a window (see the prefill wrapper)
     _check_head_dim_alignment(head_dim, interpret)
 
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, sinks=int(sinks or 0),
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -399,9 +455,22 @@ def pallas_paged_decode_attention(
     return out.reshape(batch, q_heads, head_dim)
 
 
+def _kv_pool_spec(k_cache):
+    """Cache PartitionSpec under tp: kv-heads axis sharded, except the
+    single-shared-head (MQA/absorbed-MLA) pool, which replicates — a
+    width-1 axis cannot shard, and replicating the latent is what lets
+    each shard attend its local query heads with zero cross-shard traffic
+    (matches ``parallel.serve.shard_kv_pool`` placement)."""
+    from jax.sharding import PartitionSpec as P
+
+    if k_cache.shape[1] == 1:
+        return P()
+    return P(None, "tp", None, None)
+
+
 def sharded_paged_decode_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
-    sliding_window=None, interpret=False,
+    sliding_window=None, sinks=None, interpret=False,
 ):
     """Flash-decode over a tp-sharded paged KV cache.
 
@@ -413,21 +482,24 @@ def sharded_paged_decode_attention(
     lengths are replicated control state.
 
     Shapes are global: q [batch, q_heads, hd] (heads sharded over tp),
-    caches [pages, kv_heads, ps, hd] (kv heads sharded over tp).
+    caches [pages, kv_heads, ps, hd] (kv heads sharded over tp; a
+    single-head MQA/MLA pool replicates and each shard runs its local
+    query heads as one group against the full pool).
     """
     from ..utils.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def local(q_, k_, v_, t_, l_):
         return pallas_paged_decode_attention(
-            q_, k_, v_, t_, l_, sliding_window=sliding_window,
+            q_, k_, v_, t_, l_, sliding_window=sliding_window, sinks=sinks,
             interpret=interpret,
         )
 
+    kv_spec = _kv_pool_spec(k_cache)
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(None, "tp", None), P(None, "tp", None, None),
-                  P(None, "tp", None, None), P(None, None), P(None)),
+        in_specs=(P(None, "tp", None), kv_spec, kv_spec,
+                  P(None, None), P(None)),
         out_specs=P(None, "tp", None),
         check_vma=False,
     )(q, k_cache, v_cache, page_table, ctx_lens)
@@ -435,7 +507,7 @@ def sharded_paged_decode_attention(
 
 def sharded_paged_prefill_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, total_lens, *,
-    q_tile=16, sliding_window=None, interpret=False,
+    q_tile=16, sliding_window=None, sinks=None, interpret=False,
 ):
     """Flash-prefill over a tp-sharded paged KV cache (see the decode
     wrapper's rationale). q: [batch, q_seq, q_heads, hd], heads sharded."""
@@ -445,13 +517,14 @@ def sharded_paged_prefill_attention(
     def local(q_, k_, v_, t_, cl_, tl_):
         return pallas_paged_prefill_attention(
             q_, k_, v_, t_, cl_, tl_, q_tile=q_tile,
-            sliding_window=sliding_window, interpret=interpret,
+            sliding_window=sliding_window, sinks=sinks, interpret=interpret,
         )
 
+    kv_spec = _kv_pool_spec(k_cache)
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(None, None, "tp", None), P(None, "tp", None, None),
-                  P(None, "tp", None, None), P(None, None), P(None), P(None)),
+        in_specs=(P(None, None, "tp", None), kv_spec, kv_spec,
+                  P(None, None), P(None), P(None)),
         out_specs=P(None, None, "tp", None),
         check_vma=False,
     )(q, k_cache, v_cache, page_table, ctx_lens, total_lens)

@@ -87,6 +87,117 @@ def test_bfloat16_cache():
     )
 
 
+@pytest.mark.parametrize("ctx", [3, 7, 11, 16])
+def test_sliding_window(ctx):
+    """Kernel-level SWA parity: window masking + out-of-window page skip."""
+    q, k_cache, v_cache, table, _ = build_case(ctx=16)
+    ctx_lens = jnp.asarray([ctx, max(ctx - 2, 1)], jnp.int32)
+    window = 6
+    out = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+        interpret=True,
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+        ctx_lens, sliding_window=window,
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("ctx,sinks", [(3, 4), (7, 4), (11, 4), (16, 4),
+                                       (16, 1), (13, 5)])
+def test_attention_sinks(ctx, sinks):
+    """StreamingLLM sink mask in-kernel: first-S positions stay attendable
+    past the window, their pages streamed via the loop-counter remap —
+    parity with the XLA mask across window/sink page overlaps (reference
+    spec kind sink_full_attention, events.go:40)."""
+    q, k_cache, v_cache, table, _ = build_case(ctx=16)
+    ctx_lens = jnp.asarray([ctx, max(ctx - 2, 1)], jnp.int32)
+    window = 6
+    out = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+        sinks=sinks, interpret=True,
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+        ctx_lens, sliding_window=window, attention_sinks=sinks,
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sinks_without_window_are_noop():
+    """Without a window the causal mask already attends every position, so
+    sinks normalize away — callers pass a model's sinks unconditionally
+    (full-attention layers included)."""
+    q, k_cache, v_cache, table, ctx_lens = build_case()
+    out = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, sinks=4, interpret=True)
+    ref = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sharded_sinks_match_reference():
+    """The sink mask survives the shard_map plumbing: tp-sharded
+    flash-decode over a sink model's window matches the XLA mask (the old
+    NotImplementedError guard existed to prevent exactly a silent
+    window-only-masked regression here)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from jax.sharding import Mesh
+    from llmd_kv_cache_tpu.ops.pallas_paged_attention import (
+        sharded_paged_decode_attention,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    q, k_cache, v_cache, table, _ = build_case(ctx=16)
+    ctx_lens = jnp.asarray([13, 9], jnp.int32)
+    out = sharded_paged_decode_attention(
+        mesh, q, k_cache, v_cache, table, ctx_lens, sliding_window=6,
+        sinks=4, interpret=True,
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+        ctx_lens, sliding_window=6, attention_sinks=4,
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("head_dim", [24, 128])
+def test_multi_query_single_kv_head(head_dim):
+    """kv_heads=1 multi-query — absorbed MLA's attention core: every query
+    head is one group over the single shared latent 'head' (wide head_dim
+    = rank + rope (+ pad); 128 is the aligned on-chip case)."""
+    q, k_cache, v_cache, table, ctx_lens = build_case(
+        q_heads=8, kv_heads=1, head_dim=head_dim)
+    out = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, interpret=True
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None], ctx_lens
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_multi_query_shared_kv_operand():
+    """MLA passes the latent pool as BOTH K and V (values are the latent);
+    the kernel must tolerate aliased k/v operands."""
+    q, k_cache, _v, table, ctx_lens = build_case(
+        q_heads=4, kv_heads=1, head_dim=24)
+    out = pallas_paged_decode_attention(
+        q, k_cache, k_cache, table, ctx_lens, interpret=True
+    )
+    ref = paged_attention(
+        q[:, None], k_cache, k_cache, table, (ctx_lens - 1)[:, None], ctx_lens
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_head_dim_alignment_guard(monkeypatch):
     """On real TPU, sub-128 head dims must raise a clear error instead of
     a Mosaic internal failure (lane tiling is 128; measured on v5e)."""

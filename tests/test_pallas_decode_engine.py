@@ -69,3 +69,82 @@ def test_pallas_prefill_engine_matches_xla_path():
                                   max_new_tokens=4)
         outs[use_pallas] = (first, resumed)
     assert outs[False] == outs[True]
+
+
+def test_pallas_decode_matches_xla_with_attention_sinks():
+    """Sink models (StreamingLLM, sink_full_attention) decode through the
+    flash kernel: the first-S mask applies in-kernel and matches the XLA
+    path — the engine no longer gates Pallas off for this family."""
+    prompt = list(range(60, 84))  # 24-token context >> window 8, sinks 4
+    outs = {}
+    for use_pallas in (False, True):
+        engine = MiniEngine(
+            EngineConfig(model=LlamaConfig.sink_tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="sink",
+                         pod_identifier="p", use_pallas_decode=use_pallas),
+            seed=0,
+        )
+        outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=6)
+    assert outs[False] == outs[True]
+
+
+def test_pallas_decode_matches_xla_with_sink_bursts():
+    """Fused decode bursts through the kernel for sink models."""
+    prompt = list(range(60, 80))
+    outs = {}
+    for use_pallas in (False, True):
+        engine = MiniEngine(
+            EngineConfig(model=LlamaConfig.sink_tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="sink",
+                         pod_identifier="p", use_pallas_decode=use_pallas,
+                         decode_burst=4),
+            seed=0,
+        )
+        outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=8)
+    assert outs[False] == outs[True]
+
+
+def test_pallas_decode_matches_xla_for_mla():
+    """Absorbed MLA decodes through the flash kernel as the kv_heads=1
+    multi-query case (latent pool passed as both K and V) — the engine no
+    longer gates Pallas off for the MLA family."""
+    prompt = list(range(40, 64))
+    outs = {}
+    for use_pallas in (False, True):
+        engine = MiniEngine(
+            EngineConfig(model=LlamaConfig.deepseek_tiny(), num_pages=64,
+                         max_pages_per_seq=16, model_name="ds",
+                         pod_identifier="p", use_pallas_decode=use_pallas),
+            seed=0,
+        )
+        outs[use_pallas] = engine.generate("r", prompt, max_new_tokens=6)
+    assert outs[False] == outs[True]
+
+
+def test_mla_latent_pad_is_semantics_invariant():
+    """latent_pad (Mosaic lane alignment for the on-chip kernel) must not
+    change served tokens: zero key dims score zero and value reads slice
+    [:rank], so padded and unpadded engines emit identical streams."""
+    base = LlamaConfig.deepseek_tiny()
+    padded = LlamaConfig(
+        vocab_size=base.vocab_size, hidden_size=base.hidden_size,
+        num_layers=base.num_layers, num_heads=base.num_heads,
+        num_kv_heads=base.num_kv_heads, head_dim=base.head_dim,
+        intermediate_size=base.intermediate_size, page_size=base.page_size,
+        kv_lora_rank=base.kv_lora_rank,
+        qk_rope_head_dim=base.qk_rope_head_dim,
+        latent_pad=104,  # 16+8+104 = 128: the aligned on-chip layout
+    )
+    prompt = list(range(40, 60))
+    outs = {}
+    for name, cfg in (("base", base), ("padded", padded)):
+        for use_pallas in (False, True):
+            engine = MiniEngine(
+                EngineConfig(model=cfg, num_pages=64, max_pages_per_seq=16,
+                             model_name="ds", pod_identifier="p",
+                             use_pallas_decode=use_pallas),
+                seed=0,
+            )
+            outs[name, use_pallas] = engine.generate(
+                "r", prompt, max_new_tokens=6)
+    assert len({tuple(v) for v in outs.values()}) == 1, outs

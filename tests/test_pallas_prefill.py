@@ -108,6 +108,30 @@ def test_prefill_sliding_window_matches_reference(window):
         )
 
 
+@pytest.mark.parametrize("window,sinks", [(4, 2), (4, 5), (7, 4), (16, 1)])
+def test_prefill_sinks_match_reference(window, sinks):
+    """StreamingLLM sink mask in the prefill kernel: first-S positions stay
+    attendable past the window; parity with the XLA mask including
+    sink/window page overlaps and tiles whose window start precedes the
+    sink region's end."""
+    q, k, v, table, ctx, new = build_prefill_case(ctx=(12, 0), new=(8, 12))
+    total = ctx + new
+    out = pallas_paged_prefill_attention(
+        q, k, v, table, ctx, total,
+        q_tile=Q_TILE, sliding_window=window, sinks=sinks, interpret=True,
+    )
+    q_seq = q.shape[1]
+    q_pos = ctx[:, None] + jnp.arange(q_seq)[None, :]
+    ref = paged_attention(q, k, v, table, q_pos, total, sliding_window=window,
+                          attention_sinks=sinks)
+    for b in range(q.shape[0]):
+        n = int(new[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n], np.float32),
+            np.asarray(ref[b, :n], np.float32), atol=2e-5, rtol=2e-5,
+        )
+
+
 def test_prefill_window_larger_than_context_equals_full():
     q, k, v, table, ctx, new = build_prefill_case()
     total = ctx + new

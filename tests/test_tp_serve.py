@@ -169,6 +169,19 @@ def test_tp_mla_decode_burst(mla_setup):
     assert out == ref
 
 
+def test_tp_mla_pallas_decode(mla_setup):
+    """Absorbed MLA through the flash-decode kernel under tp: each shard
+    runs its local query heads as one multi-query group against the
+    replicated latent pool (interpret mode on the CPU mesh)."""
+    cfg, params = mla_setup
+    prompt = np.random.default_rng(10).integers(1, 250, 24).tolist()
+    ref = _engine(cfg, params).generate("r", prompt, max_new_tokens=8)
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    out = _engine(cfg, params, mesh=mesh, use_pallas_decode=True,
+                  decode_burst=4).generate("r", prompt, max_new_tokens=8)
+    assert out == ref
+
+
 def test_tp_mla_latent_cache_replicates(mla_setup):
     """The latent pool must place replicated under tp — every shard reads
     the full latent for its local heads' multi-query attention."""
