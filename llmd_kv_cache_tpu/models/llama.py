@@ -886,7 +886,11 @@ def forward_prefill_pallas(
         pallas_paged_prefill_attention, sharded_paged_prefill_attention)
 
     seq = tokens.shape[1]
-    q_tile = math.gcd(seq, 16)
+    # 128 query rows per program when the chunk allows: with the 128-key
+    # superblocks this makes each online-softmax round a full
+    # [group·128, head_dim]×[head_dim, 128] MXU-tile matmul (the bench's
+    # 2048-token chunks hit this; tiny test seqs fall back to their gcd).
+    q_tile = math.gcd(seq, 128)
 
     sinks = cfg.attention_sinks or None
 

@@ -1,18 +1,28 @@
-"""Pallas TPU kernel: flash-decode attention over a paged KV cache.
+"""Pallas TPU kernels: flash attention over a paged KV cache.
 
-The decode-step fast path (seq == 1): instead of materializing each
-sequence's gathered KV ([batch, pages*page_size, heads, dim] in HBM, which
-``ops.paged_attention`` does and which wastes HBM bandwidth on long
-contexts), each (batch, kv_head) program streams the sequence's pages
-HBM→VMEM with double-buffered async DMA and folds them into an online
-softmax — the ragged-paged-attention recipe specialized to decode.
+Instead of materializing each sequence's gathered KV **and the fp32
+attention probs** in HBM (which ``ops.paged_attention`` does — the
+dominant excess HBM traffic of the XLA prefill path, see
+benchmarking/r4-mfu/README.md), each (batch, kv_head[, q_tile]) program
+streams the sequence's pages HBM→VMEM with double-buffered async DMA and
+folds them into an online softmax — the ragged-paged-attention recipe.
 
-Grid: ``(batch, kv_heads)``. Scalar-prefetched page table + context lengths
-drive the DMA indices (``PrefetchScalarGridSpec``). GQA: each program
-serves its kv head's whole query group.
+Pages stream in **superblocks** of ``pages_per_block`` pages (default
+targets 128 keys): each online-softmax round is then a full-width MXU
+matmul and a 64 KB-class DMA batch, instead of one page_size-wide sliver
+per round. Matmul operands stay in the cache dtype (bf16×bf16, fp32
+accumulate — the MXU fast path) with the softmax scale applied to the
+fp32 scores, matching the XLA reference's numerics.
 
-The jnp reference path remains the fallback (CPU tests run this kernel in
-interpreter mode against it).
+Grid: ``(batch, kv_heads)`` for decode, ``(batch, kv_heads, q_blocks)``
+for prefill. Scalar-prefetched page table + context lengths drive the DMA
+indices (``PrefetchScalarGridSpec``). GQA: each program serves its kv
+head's whole query group; absorbed MLA is the kv_heads=1 multi-query
+case. SWA skips out-of-window pages; StreamingLLM sinks stream the first
+pages too via a loop-counter→page-index remap.
+
+The jnp reference path remains the fallback (CPU tests run these kernels
+in interpreter mode against it).
 """
 
 from __future__ import annotations
@@ -48,6 +58,42 @@ def _check_head_dim_alignment(head_dim: int, interpret: bool) -> None:
             f"(ops.paged_attention) for this model")
 
 
+def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
+                         v_scratch, sem, *, kpb, num_iters, first_window,
+                         sink_pages, sinks):
+    """Shared page remap + superblock DMA for the decode/prefill kernels.
+
+    ``page_for`` maps a loop counter to a page-table index — sink pages
+    ([0, sink_pages)) first, then window pages ([first_window, …)) —
+    with DMA-safe clamping for sub-pages past ``num_iters`` (their
+    garbage loads are masked out by position). One definition for both
+    kernels so the clamp/remap subtleties cannot drift between them."""
+    pp_seq = page_table_ref.shape[1]
+
+    def page_for(j):
+        j = jnp.minimum(j, jnp.maximum(num_iters - 1, 0))  # DMA-safe clamp
+        if not sinks:
+            idx = first_window + j
+        else:
+            idx = jnp.where(j < sink_pages, j,
+                            first_window + (j - sink_pages))
+        return jnp.minimum(idx, pp_seq - 1)
+
+    def sb_dma(slot, sb):
+        copies = []
+        for t in range(kpb):
+            page = page_table_ref[b, page_for(sb * kpb + t)]
+            copies.append(pltpu.make_async_copy(
+                k_hbm.at[page, h], k_scratch.at[slot, t], sem.at[slot, t, 0]
+            ))
+            copies.append(pltpu.make_async_copy(
+                v_hbm.at[page, h], v_scratch.at[slot, t], sem.at[slot, t, 1]
+            ))
+        return copies
+
+    return page_for, sb_dma
+
+
 def _decode_kernel(
     # scalar prefetch
     page_table_ref,  # [batch, pages_per_seq] int32 (SMEM)
@@ -59,18 +105,20 @@ def _decode_kernel(
     # output
     o_ref,  # [1, 1, group, head_dim] VMEM block
     # scratch
-    k_scratch,  # [2, page_size, head_dim] VMEM
-    v_scratch,  # [2, page_size, head_dim] VMEM
-    sem,  # DMA semaphores [2, 2]
+    k_scratch,  # [2, pages_per_block, page_size, head_dim] VMEM
+    v_scratch,  # same
+    sem,  # DMA semaphores [2, pages_per_block, 2]
     *,
     page_size: int,
     scale: float,
     sliding_window: int | None,
     sinks: int,
+    pages_per_block: int,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
     group, head_dim = q_ref.shape[2], q_ref.shape[3]
+    kpb = pages_per_block
 
     ctx_len = ctx_lens_ref[b]
     num_pages = (ctx_len + page_size - 1) // page_size
@@ -92,56 +140,60 @@ def _decode_kernel(
     else:
         sink_pages = jnp.int32(0)
     num_iters = sink_pages + num_pages - first_window
+    # Pages stream in superblocks of ``kpb``: each round waits on one
+    # batch of kpb in-flight DMAs (4 KB single-page transfers underuse
+    # HBM bandwidth; a 128-key superblock moves 64 KB per K/V round) and
+    # feeds the MXU a [head_dim, kpb·page_size] operand instead of a
+    # page_size-wide sliver. A superblock may straddle the sink→window
+    # jump; per-sub-page positions keep the mask exact.
+    num_sb = (num_iters + kpb - 1) // kpb
 
-    def page_for(j):
-        if not sinks:
-            return first_window + j
-        return jnp.where(j < sink_pages, j, first_window + (j - sink_pages))
+    page_for, sb_dma = _superblock_streamer(
+        page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
+        kpb=kpb, num_iters=num_iters, first_window=first_window,
+        sink_pages=sink_pages, sinks=sinks)
 
-    def page_dma(slot, page_idx):
-        page = page_table_ref[b, page_idx]
-        k_copy = pltpu.make_async_copy(
-            k_hbm.at[page, h], k_scratch.at[slot], sem.at[slot, 0]
-        )
-        v_copy = pltpu.make_async_copy(
-            v_hbm.at[page, h], v_scratch.at[slot], sem.at[slot, 1]
-        )
-        return k_copy, v_copy
-
-    @pl.when(num_iters > 0)
+    @pl.when(num_sb > 0)
     def _():
-        for c in page_dma(0, page_for(0)):
+        for c in sb_dma(0, 0):
             c.start()
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, head_dim]
+    # Cache-dtype q, scale applied to the fp32 scores after the matmul:
+    # bf16×bf16 + fp32 accumulate is the MXU fast path and matches the
+    # XLA reference's numerics.
+    q = q_ref[0, 0]  # [group, head_dim]
 
-    def body(j, carry):
+    def body(sb, carry):
         m_prev, l_prev, acc_prev = carry
-        slot = j % 2
-        next_slot = (j + 1) % 2
+        slot = sb % 2
+        next_slot = (sb + 1) % 2
 
-        @pl.when(j + 1 < num_iters)
+        @pl.when(sb + 1 < num_sb)
         def _():
-            for c in page_dma(next_slot, page_for(j + 1)):
+            for c in sb_dma(next_slot, sb + 1):
                 c.start()
 
-        for c in page_dma(slot, page_for(j)):
+        for c in sb_dma(slot, sb):
             c.wait()
 
-        k = k_scratch[slot].astype(jnp.float32)  # [page_size, head_dim]
-        v = v_scratch[slot].astype(jnp.float32)
+        k = k_scratch[slot].reshape(kpb * page_size, head_dim)
+        v = v_scratch[slot].reshape(kpb * page_size, head_dim)
 
         scores = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [group, page_size]
+        ) * scale  # [group, kpb*page_size]
 
         # mask slots beyond the context length on the last page (and, for
         # SWA, positions that fell out of the window — unless they are
-        # sink positions, which stay attendable forever)
-        positions = page_for(j) * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1
+        # sink positions, which stay attendable forever); sub-pages past
+        # num_iters park at ctx_len so every mask term rejects them.
+        sub = sb * kpb + jax.lax.broadcasted_iota(jnp.int32, (kpb, 1), 0)
+        pos = page_for(sub) * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (kpb, page_size), 1
         )
+        positions = jnp.where(sub < num_iters, pos, ctx_len).reshape(
+            1, kpb * page_size)
         in_bounds = positions < ctx_len
         if sliding_window is not None:
             in_window = positions >= ctx_len - sliding_window
@@ -152,11 +204,12 @@ def _decode_kernel(
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # [group, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(scores - m_new)  # [group, page_size]
+        p = jnp.exp(scores - m_new)  # [group, kpb*page_size]
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_new = acc_prev * alpha + jax.lax.dot_general(
-            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -164,7 +217,7 @@ def _decode_kernel(
     m0 = jnp.full((group, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, 1), jnp.float32)
     acc0 = jnp.zeros((group, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    _m, l_fin, acc = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l_fin, 1e-30)
     o_ref[0, 0] = out.astype(o_ref.dtype)
@@ -182,21 +235,23 @@ def _prefill_kernel(
     # output
     o_ref,
     # scratch
-    k_scratch,
+    k_scratch,  # [2, pages_per_block, page_size, head_dim]
     v_scratch,
-    sem,
+    sem,  # [2, pages_per_block, 2]
     *,
     page_size: int,
     q_tile: int,
     scale: float,
     sliding_window: int | None,
     sinks: int,
+    pages_per_block: int,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
     qt = pl.program_id(2)
     # q_ref block: [1, 1, q_tile, 1, group, head_dim]
     group, head_dim = q_ref.shape[4], q_ref.shape[5]
+    kpb = pages_per_block
 
     ctx_len = ctx_lens_ref[b]
     total_len = total_lens_ref[b]
@@ -222,57 +277,65 @@ def _prefill_kernel(
     else:
         sink_pages = jnp.int32(0)
     num_iters = sink_pages + num_pages - jnp.minimum(first_window, num_pages)
+    # MXU utilization: pages stream in superblocks of ``kpb`` pages, so
+    # each online-softmax round multiplies [group·q_tile, head_dim] by
+    # [head_dim, kpb·page_size] — full 128-wide MXU tiles instead of one
+    # page_size-wide sliver per round (the round-2 kernel's 12×-slower
+    # root cause; see benchmarking/r4-mfu/README.md). A superblock may
+    # straddle the sink→window jump: each sub-page's positions come from
+    # its own remapped index, so masking stays exact.
+    num_sb = (num_iters + kpb - 1) // kpb
 
-    def page_for(j):
-        if not sinks:
-            return first_window + j
-        return jnp.where(j < sink_pages, j, first_window + (j - sink_pages))
+    page_for, sb_dma = _superblock_streamer(
+        page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
+        kpb=kpb, num_iters=num_iters, first_window=first_window,
+        sink_pages=sink_pages, sinks=sinks)
 
-    def page_dma(slot, page_idx):
-        page = page_table_ref[b, page_idx]
-        return (
-            pltpu.make_async_copy(
-                k_hbm.at[page, h], k_scratch.at[slot], sem.at[slot, 0]
-            ),
-            pltpu.make_async_copy(
-                v_hbm.at[page, h], v_scratch.at[slot], sem.at[slot, 1]
-            ),
-        )
-
-    @pl.when(num_iters > 0)
+    @pl.when(num_sb > 0)
     def _():
-        for c in page_dma(0, page_for(0)):
+        for c in sb_dma(0, 0):
             c.start()
 
-    q = q_ref[0, 0, :, 0].astype(jnp.float32) * scale  # [q_tile, group, hd]
+    # Keep q in the cache dtype and scale AFTER the QK^T matmul (fp32
+    # scores): bf16×bf16 with fp32 accumulation is the MXU fast path, and
+    # it matches the XLA reference's numerics (paged_attention scales the
+    # fp32 einsum output).
+    q = q_ref[0, 0, :, 0]  # [q_tile, group, head_dim]
     q2d = q.transpose(1, 0, 2)  # [group, q_tile, head_dim]
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_tile, 1), 0)
 
-    def body(j, carry):
+    def body(sb, carry):
         m_prev, l_prev, acc_prev = carry
-        slot = j % 2
-        next_slot = (j + 1) % 2
+        slot = sb % 2
+        next_slot = (sb + 1) % 2
 
-        @pl.when(j + 1 < num_iters)
+        @pl.when(sb + 1 < num_sb)
         def _():
-            for c in page_dma(next_slot, page_for(j + 1)):
+            for c in sb_dma(next_slot, sb + 1):
                 c.start()
 
-        for c in page_dma(slot, page_for(j)):
+        for c in sb_dma(slot, sb):
             c.wait()
 
-        k = k_scratch[slot].astype(jnp.float32)  # [page_size, head_dim]
-        v = v_scratch[slot].astype(jnp.float32)
+        k = k_scratch[slot].reshape(kpb * page_size, head_dim)
+        v = v_scratch[slot].reshape(kpb * page_size, head_dim)
 
-        # [group, q_tile, page_size]
+        # [group, q_tile, kpb*page_size], fp32 accumulate off bf16 operands
         scores = jax.lax.dot_general(
             q2d, k, dimension_numbers=(((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+        ) * scale
+        # Per-sub-page key positions (each from its own remapped page
+        # index); sub-pages past num_iters park at total_len so every
+        # mask term rejects them.
+        sub = sb * kpb + jax.lax.broadcasted_iota(jnp.int32, (kpb, 1), 0)
+        valid_sub = sub < num_iters
+        pos = page_for(sub) * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (kpb, page_size), 1
         )
-        k_pos = page_for(j) * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1
-        )
-        mask = (k_pos <= q_pos) & (k_pos < total_len)  # [q_tile, page_size]
+        k_pos = jnp.where(valid_sub, pos, total_len).reshape(
+            1, kpb * page_size)
+        mask = (k_pos <= q_pos) & (k_pos < total_len)  # [q_tile, kpb*ps]
         if sliding_window is not None:
             in_window = q_pos - k_pos < sliding_window
             if sinks:
@@ -286,7 +349,8 @@ def _prefill_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc_prev * alpha + jax.lax.dot_general(
-            p, v, dimension_numbers=(((2,), (0,)), ((), ())),
+            p.astype(v.dtype), v,
+            dimension_numbers=(((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -294,8 +358,7 @@ def _prefill_kernel(
     m0 = jnp.full((group, q_tile, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, q_tile, 1), jnp.float32)
     acc0 = jnp.zeros((group, q_tile, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(0, num_iters, body,
-                                       (m0, l0, acc0))
+    _m, l_fin, acc = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l_fin, 1e-30)  # [group, q_tile, head_dim]
     o_ref[0, 0, :, 0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
@@ -303,7 +366,7 @@ def _prefill_kernel(
 
 @functools.partial(jax.jit,
                    static_argnames=("q_tile", "sliding_window", "sinks",
-                                    "interpret"))
+                                    "pages_per_block", "interpret"))
 def pallas_paged_prefill_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -315,17 +378,21 @@ def pallas_paged_prefill_attention(
     q_tile: int = 16,
     sliding_window: int | None = None,
     sinks: int | None = None,
+    pages_per_block: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash prefill over paged KV (new tokens' KV already scattered).
 
     Queries attend causally over cached prefix + themselves, streaming
-    pages HBM→VMEM per (batch, kv_head, q_tile) program. Returns
-    ``[batch, q_seq, q_heads, head_dim]``. ``q_seq`` must divide by
-    ``q_tile`` (callers pad; padded rows are masked out by total_lens).
-    ``sliding_window=W`` restricts each query to the last W keys and skips
-    pages wholly out of window; ``sinks=S`` keeps the first S positions
-    attendable past the window (StreamingLLM; needs a window).
+    page superblocks HBM→VMEM per (batch, kv_head, q_tile) program.
+    Returns ``[batch, q_seq, q_heads, head_dim]``. ``q_seq`` must divide
+    by ``q_tile`` (callers pad; padded rows are masked out by
+    total_lens). ``sliding_window=W`` restricts each query to the last W
+    keys and skips pages wholly out of window; ``sinks=S`` keeps the
+    first S positions attendable past the window (StreamingLLM; needs a
+    window). ``pages_per_block`` sets the keys per online-softmax round
+    (``pages_per_block * page_size``); the default targets 128 keys —
+    one full MXU tile — per round.
     """
     batch, q_seq, q_heads, head_dim = q.shape
     _, kv_heads, page_size, _ = k_cache.shape
@@ -337,6 +404,8 @@ def pallas_paged_prefill_attention(
         # sinks unconditionally (full-attention layers included).
         sinks = None
     _check_head_dim_alignment(head_dim, interpret)
+    if pages_per_block is None:
+        pages_per_block = max(1, 128 // page_size)
 
     # [batch, q_blocks, q_tile, kv_heads, group, head_dim] view via reshape:
     q_blocked = q.reshape(batch, q_seq // q_tile, q_tile, kv_heads, group, head_dim)
@@ -344,7 +413,7 @@ def pallas_paged_prefill_attention(
     kernel = functools.partial(
         _prefill_kernel, page_size=page_size, q_tile=q_tile,
         scale=head_dim ** -0.5, sliding_window=sliding_window,
-        sinks=int(sinks or 0),
+        sinks=int(sinks or 0), pages_per_block=pages_per_block,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -363,9 +432,11 @@ def pallas_paged_prefill_attention(
             lambda b, h, qt, *_p: (b, qt, 0, h, 0, 0),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
-            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+                       k_cache.dtype),
+            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+                       k_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
         ],
     )
 
@@ -383,7 +454,8 @@ def pallas_paged_prefill_attention(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("interpret", "sliding_window", "sinks"))
+                   static_argnames=("interpret", "sliding_window", "sinks",
+                                    "pages_per_block"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -393,6 +465,7 @@ def pallas_paged_decode_attention(
     *,
     sliding_window: int | None = None,
     sinks: int | None = None,
+    pages_per_block: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
@@ -410,12 +483,15 @@ def pallas_paged_decode_attention(
     if sliding_window is None:
         sinks = None  # no-op without a window (see the prefill wrapper)
     _check_head_dim_alignment(head_dim, interpret)
+    if pages_per_block is None:
+        pages_per_block = max(1, 128 // page_size)
 
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
         sliding_window=sliding_window, sinks=int(sinks or 0),
+        pages_per_block=pages_per_block,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -436,9 +512,11 @@ def pallas_paged_decode_attention(
         ),
         scratch_shapes=[
             # DMA staging must match the cache dtype; upcast after load.
-            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
-            pltpu.VMEM((2, page_size, head_dim), k_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+                       k_cache.dtype),
+            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+                       k_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
         ],
     )
 
@@ -470,7 +548,7 @@ def _kv_pool_spec(k_cache):
 
 def sharded_paged_decode_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
-    sliding_window=None, sinks=None, interpret=False,
+    sliding_window=None, sinks=None, pages_per_block=None, interpret=False,
 ):
     """Flash-decode over a tp-sharded paged KV cache.
 
@@ -492,7 +570,7 @@ def sharded_paged_decode_attention(
     def local(q_, k_, v_, t_, l_):
         return pallas_paged_decode_attention(
             q_, k_, v_, t_, l_, sliding_window=sliding_window, sinks=sinks,
-            interpret=interpret,
+            pages_per_block=pages_per_block, interpret=interpret,
         )
 
     kv_spec = _kv_pool_spec(k_cache)
@@ -507,7 +585,8 @@ def sharded_paged_decode_attention(
 
 def sharded_paged_prefill_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, total_lens, *,
-    q_tile=16, sliding_window=None, sinks=None, interpret=False,
+    q_tile=16, sliding_window=None, sinks=None, pages_per_block=None,
+    interpret=False,
 ):
     """Flash-prefill over a tp-sharded paged KV cache (see the decode
     wrapper's rationale). q: [batch, q_seq, q_heads, hd], heads sharded."""
@@ -517,7 +596,8 @@ def sharded_paged_prefill_attention(
     def local(q_, k_, v_, t_, cl_, tl_):
         return pallas_paged_prefill_attention(
             q_, k_, v_, t_, cl_, tl_, q_tile=q_tile,
-            sliding_window=sliding_window, sinks=sinks, interpret=interpret,
+            sliding_window=sliding_window, sinks=sinks,
+            pages_per_block=pages_per_block, interpret=interpret,
         )
 
     kv_spec = _kv_pool_spec(k_cache)

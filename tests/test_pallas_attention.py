@@ -198,6 +198,39 @@ def test_multi_query_shared_kv_operand():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("kpb", [1, 2, 3])
+def test_pages_per_block_variants(kpb):
+    """Superblock streaming (kpb pages per online-softmax round) is
+    numerics-identical across block sizes, including partial trailing
+    superblocks (ctx=13 → 4 pages, kpb=3 → one full + one partial)."""
+    q, k_cache, v_cache, table, ctx_lens = build_case(ctx=13)
+    ref = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, pages_per_block=1,
+        interpret=True)
+    out = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, pages_per_block=kpb,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("kpb", [2, 3])
+def test_pages_per_block_with_sinks(kpb):
+    """A superblock straddling the sink→window page jump masks each
+    sub-page by its own remapped position."""
+    q, k_cache, v_cache, table, _ = build_case(ctx=16)
+    ctx_lens = jnp.asarray([16, 11], jnp.int32)
+    out = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, sliding_window=6, sinks=4,
+        pages_per_block=kpb, interpret=True)
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+        ctx_lens, sliding_window=6, attention_sinks=4,
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_head_dim_alignment_guard(monkeypatch):
     """On real TPU, sub-128 head dims must raise a clear error instead of
     a Mosaic internal failure (lane tiling is 128; measured on v5e)."""

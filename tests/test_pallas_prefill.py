@@ -132,6 +132,25 @@ def test_prefill_sinks_match_reference(window, sinks):
         )
 
 
+@pytest.mark.parametrize("kpb", [1, 3])
+def test_prefill_pages_per_block_variants(kpb):
+    """Superblock streaming matches the single-page path, including
+    partial trailing superblocks and window-skipped prefixes."""
+    q, k, v, table, ctx, new = build_prefill_case(ctx=(12, 0), new=(8, 12))
+    total = ctx + new
+    ref = pallas_paged_prefill_attention(
+        q, k, v, table, ctx, total, q_tile=Q_TILE, sliding_window=7,
+        sinks=4, pages_per_block=1, interpret=True)
+    out = pallas_paged_prefill_attention(
+        q, k, v, table, ctx, total, q_tile=Q_TILE, sliding_window=7,
+        sinks=4, pages_per_block=kpb, interpret=True)
+    for b in range(q.shape[0]):
+        n = int(new[b])
+        np.testing.assert_allclose(
+            np.asarray(out[b, :n], np.float32),
+            np.asarray(ref[b, :n], np.float32), atol=2e-5, rtol=2e-5)
+
+
 def test_prefill_window_larger_than_context_equals_full():
     q, k, v, table, ctx, new = build_prefill_case()
     total = ctx + new
