@@ -131,6 +131,108 @@ def run_replay(pods, workload, router, tag=""):
     return services, chosen, hit_tokens / max(total_tokens, 1), cached_lens
 
 
+def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
+                   tag=""):
+    """Arrival-timed CONCURRENT replay through ``enqueue()``/``step()``.
+
+    The virtual-time FIFO model (``queueing_ttfts``) composes serially
+    measured service times, so they never interact with concurrency. This
+    arm serves the workload through each pod's continuous-batching
+    scheduler instead: requests are admitted when they arrive (in virtual
+    time), prefill chunks interleave with running decodes, and decode
+    steps batch every live request — so a measured TTFT includes queue
+    wait, chunked-prefill stalls, batching interference, and decode load
+    (reference analog: the real inference-perf runs behind
+    ``benchmarking/73-capacity/README.md``).
+
+    Virtual-time accounting over real compute: each pod has a clock;
+    every ``enqueue``/``step`` call's wall time advances it. A pod picks
+    up work when its clock is the fleet minimum, admissions happen at
+    ``max(arrival, pod clock)``, and a request's TTFT is the clock at the
+    end of the step that emitted its first token minus its arrival. Wall
+    clock on one host would serialize the pods against each other (they
+    share the machine), so virtual time is what makes an N-pod fleet
+    honest here — the same reasoning as ``queueing_ttfts``, but with the
+    service process real.
+
+    Returns ``(ttfts, hit_rate)`` with one TTFT per request.
+    """
+    import math
+    import sys
+    from collections import deque
+
+    names = list(pods.keys())
+    queues: dict = {p: deque() for p in names}
+    clocks: dict = {p: 0.0 for p in names}
+    arr_of: dict = {}
+    ttfts: dict = {}
+    emitted_once: set = set()
+    hit_tokens = total_tokens = 0
+    n = len(workload)
+    i = 0
+    arm_start = time.perf_counter()
+
+    def inflight(p):
+        return len(pods[p]._running)
+
+    def busy(p):
+        return bool(queues[p]) or inflight(p) > 0
+
+    while i < n or any(busy(p) for p in names):
+        t_arr = arrivals[i] if i < n else math.inf
+        t_pod, pick = math.inf, None
+        for p in names:
+            if busy(p) and clocks[p] < t_pod:
+                t_pod, pick = clocks[p], p
+        if t_arr <= t_pod:
+            # Next event is an arrival: route it with the index as of the
+            # work already performed (events publish inside step()).
+            p = router(i, workload[i], names)
+            queues[p].append(i)
+            arr_of[i] = t_arr
+            if inflight(p) == 0 and len(queues[p]) == 1:
+                clocks[p] = max(clocks[p], t_arr)  # idle pod fast-forwards
+            i += 1
+            continue
+
+        p, eng = pick, pods[pick]
+        # Admit everything that has arrived by this pod's clock (pool
+        # permitting; an out-of-pages admission retries after steps free
+        # pages as requests finish).
+        while queues[p]:
+            j = queues[p][0]
+            t0 = time.perf_counter()
+            try:
+                req = eng.enqueue(f"r{j}", workload[j],
+                                  max_new_tokens=max_new_tokens)
+            except RuntimeError:
+                clocks[p] += time.perf_counter() - t0
+                if inflight(p) == 0:
+                    raise  # nothing running will ever free pages
+                break
+            clocks[p] += time.perf_counter() - t0
+            queues[p].popleft()
+            hit_tokens += min(req.cached_len, len(workload[j]))
+            total_tokens += len(workload[j])
+        t0 = time.perf_counter()
+        emitted = eng.step()
+        clocks[p] += time.perf_counter() - t0
+        new_first = False
+        for rid in emitted:
+            if rid not in emitted_once:
+                emitted_once.add(rid)
+                new_first = True
+                j = int(rid[1:])
+                ttfts[j] = clocks[p] - arr_of[j]
+        if new_first and len(emitted_once) % 16 == 0:
+            print(f"[bench {tag}] {len(emitted_once)}/{n} first tokens, "
+                  f"{time.perf_counter() - arm_start:.1f}s elapsed",
+                  file=sys.stderr, flush=True)
+
+    assert len(ttfts) == n, f"served {len(ttfts)} of {n}"
+    return [ttfts[j] for j in range(n)], hit_tokens / max(total_tokens, 1)
+
+
 def make_kv_router(indexer):
     """Score-argmax router with round-robin fallback — shared by every
     KV-routed arm so the arms cannot silently diverge in policy."""
@@ -465,6 +567,15 @@ def main(queued: bool = True) -> None:
         warm.add_request(f"warm{wl}", prompt, max_new_tokens=1)
         print(f"[bench warm] len {wl}: "
               f"{time.perf_counter() - _tb:.1f}s", file=_sys.stderr, flush=True)
+    # Warm the continuous-batching step path too (enqueue-side prefill
+    # chunk + the padded batched-decode program the concurrent arms use).
+    _tb = time.perf_counter()
+    warm.enqueue("warmstep", rng.integers(1, 8000, 128).tolist(),
+                 max_new_tokens=3)
+    while warm.step():
+        pass
+    print(f"[bench warm] step path: {time.perf_counter() - _tb:.1f}s",
+          file=_sys.stderr, flush=True)
     print(f"[bench warm] total {time.perf_counter() - _t0:.1f}s",
           file=_sys.stderr, flush=True)
 
@@ -507,12 +618,14 @@ def main(queued: bool = True) -> None:
     # so it is opt-in via KVTPU_BENCH_STORAGE=1 until run on-host.
     import os as _os
     st_p50 = st_hit = None
+    st_n = 0
     if platform != "tpu" or _os.environ.get("KVTPU_BENCH_STORAGE") == "1":
-        st_restore_svc, st_hit = _storage_arm(
+        st_restore_svc, st_hit, st_fleets = _storage_arm(
             model_cfg, engine_mod, fresh_indexer, shared_params,
-            pod_kw, n_pods, workload)
+            pod_kw, n_pods, wl_kw)
         if st_restore_svc:
             st_p50 = statistics.median(st_restore_svc)
+            st_n = len(st_restore_svc)
 
     # QPS sweep (reference "Summary across QPS"): the measured service
     # times are fixed, so one replay per arm supports the whole open-loop
@@ -540,6 +653,52 @@ def main(queued: bool = True) -> None:
               f"p90 rr {row['rr_p90']:.3f}s kv {row['kv_p90']:.3f}s",
               file=_sys.stderr, flush=True)
 
+    # Concurrent open-loop arms (VERDICT r3 #3): re-serve the workload
+    # through the continuous-batching scheduler with arrival-timed
+    # admission and real decode load, so TTFTs include batching
+    # interference — methodology check on the virtual-time FIFO model
+    # above (same arrival seeds; fewer points, each re-serves the fleet).
+    conc_sweep = []
+    # On the tunneled TPU each concurrent fleet re-serves the workload at
+    # real service times (~minutes): run the headline point only; CPU
+    # sweeps three points.
+    conc_mults = (1.25,) if platform == "tpu" else (0.75, 1.25, 2.0)
+    for mult in conc_mults:
+        qps = mult * fleet_qps
+        arr = np.cumsum(
+            np.random.default_rng(7).exponential(1.0 / qps, len(workload)))
+        crr_indexer = fresh_indexer()
+        crr_pods = make_pods(n_pods, model_cfg, engine_mod, crr_indexer,
+                             params=shared_params, pod_kw=pod_kw)
+        crr_t, crr_hit = run_concurrent(
+            crr_pods, workload,
+            lambda i, _p, names: names[i % len(names)], arr,
+            tag=f"conc-rr {mult}x")
+        del crr_pods
+        ckv_indexer = fresh_indexer()
+        ckv_pods = make_pods(n_pods, model_cfg, engine_mod, ckv_indexer,
+                             params=shared_params, pod_kw=pod_kw)
+        ckv_t, ckv_hit = run_concurrent(
+            ckv_pods, workload, make_kv_router(ckv_indexer), arr,
+            tag=f"conc-kv {mult}x")
+        del ckv_pods
+        crow = {
+            "qps": round(qps, 2), "mult": mult,
+            "rr_p50": round(statistics.median(crr_t), 4),
+            "rr_p90": round(float(np.quantile(crr_t, 0.9)), 4),
+            "kv_p50": round(statistics.median(ckv_t), 4),
+            "kv_p90": round(float(np.quantile(ckv_t, 0.9)), 4),
+            "rr_hit": round(crr_hit, 4), "kv_hit": round(ckv_hit, 4),
+        }
+        crow["reduction_pct"] = round(
+            100.0 * (1.0 - crow["kv_p50"] / crow["rr_p50"]), 2)
+        conc_sweep.append(crow)
+        print(f"[bench conc ] {mult:4.2f}x capacity ({qps:6.2f} qps): "
+              f"p50 rr {crow['rr_p50']:.3f}s kv {crow['kv_p50']:.3f}s "
+              f"(-{crow['reduction_pct']:.1f}%), "
+              f"p90 rr {crow['rr_p90']:.3f}s kv {crow['kv_p90']:.3f}s",
+              file=_sys.stderr, flush=True)
+
     # Headline: the 1.25×-capacity point (continuity with rounds 1-2).
     head = next(r for r in sweep if r["mult"] == 1.25)
     reduction_pct = head["reduction_pct"]
@@ -549,7 +708,8 @@ def main(queued: bool = True) -> None:
     if st_p50 is not None:
         cold_p50 = statistics.median(rr_svc)
         storage = (f", storage-restore p50 {st_p50:.3f}s vs cold "
-                   f"{cold_p50:.3f}s (hit-rate {st_hit:.2f})")
+                   f"{cold_p50:.3f}s (N={st_n}, {st_fleets} cold fleets, "
+                   f"hit-rate {st_hit:.2f})")
     line = {
         "metric": "p50 TTFT reduction, KV-aware routing vs round-robin "
                   f"({n_pods} pods, shared-prefix replay, Poisson "
@@ -563,28 +723,36 @@ def main(queued: bool = True) -> None:
         "hit_rate_kv": round(kv_hit, 4),
         "hit_rate_rr": round(rr_hit, 4),
         "qps_sweep": sweep,
+        "concurrent_sweep": conc_sweep,
     }
     if st_p50 is not None:
         line["storage_restore_p50_s"] = round(st_p50, 4)
         line["storage_hit_rate"] = round(st_hit, 4)
+        line["storage_restore_samples"] = st_n
     print(json.dumps(line))
 
 
 def _storage_arm(model_cfg, engine_mod, fresh_indexer, shared_params,
-                 pod_kw, n_pods, workload):
+                 pod_kw, n_pods, wl_kw, min_restores=50, max_fleets=4):
     """Measure restore-from-shared-storage service times.
 
     A 'historic' pod serves every unique prefix once with write-through
-    offload, flushes, and retires; a fresh KV-routed fleet sharing the
-    storage root then replays the workload — admissions hit the storage
+    offload, flushes, and retires; fresh KV-routed fleets sharing the
+    storage root then replay the workload — admissions hit the storage
     tier (`offload/manager.py` lookup → restore) instead of recomputing.
     Mirrors the reference's medium-tier weights
     (`pkg/kvcache/backend.go:19-33`: storage hits are worth routing to).
 
-    Returns ``(restore_services, hit_rate)`` where restore_services covers
-    ONLY the requests actually served by a storage restore — the first
-    touch of each prefix on a cold pod. Later requests for the same prefix
-    are ordinary HBM hits and would dilute the restore number.
+    Sample-size hardening (VERDICT r3 weak #3): the arm builds its own
+    workload with ≥32 unique prefixes and replays it on repeated COLD
+    fleets until at least ``min_restores`` genuine restore admissions are
+    collected — a p50 over ≥50 points instead of 8.
+
+    Returns ``(restore_services, hit_rate, fleets)`` where
+    restore_services covers ONLY the requests actually served by a
+    storage restore — the first touch of each prefix on a cold pod.
+    Later requests for the same prefix are ordinary HBM hits and would
+    dilute the restore number.
     """
     import shutil
     import sys as _sys
@@ -601,6 +769,10 @@ def _storage_arm(model_cfg, engine_mod, fresh_indexer, shared_params,
             head_dim=model_cfg.head_dim, io_threads=4,
             parallel_agnostic=True,
         )
+
+    st_kw = dict(wl_kw)
+    st_kw["n_prefixes"] = max(32, st_kw.get("n_prefixes", 8))
+    workload = build_workload(np.random.default_rng(1234), **st_kw)
 
     try:
         indexer = fresh_indexer()
@@ -619,33 +791,48 @@ def _storage_arm(model_cfg, engine_mod, fresh_indexer, shared_params,
         print(f"[bench storage] {len(seen)} prefixes stored to {root}",
               file=_sys.stderr, flush=True)
 
-        st_indexer = fresh_indexer()
-        pods = make_pods(n_pods, model_cfg, engine_mod, st_indexer,
-                         params=shared_params, pod_kw=pod_kw,
-                         offload_spec_factory=spec)
-        services, chosen, hit, cached = run_replay(
-            pods, workload, make_kv_router(st_indexer),
-            tag="storage-restore")
-        # Restore-serving requests: first touch of a prefix on a pod whose
-        # HBM cannot hold it yet, with cached tokens at admission — those
-        # tokens can only have come from the storage tier.
-        touched: set = set()
-        restore_services = []
-        for i, prompt in enumerate(workload):
-            pair = (chosen[i], tuple(prompt[:64]))
-            if pair not in touched and cached[i] > 0:
-                restore_services.append(services[i])
-            touched.add(pair)
-        print(f"[bench storage] {len(restore_services)} storage-restore "
-              f"admissions of {len(workload)}", file=_sys.stderr, flush=True)
-        return restore_services, hit
+        restore_services: list = []
+        fleet_hits: list = []
+        fleets = 0
+        while len(restore_services) < min_restores and fleets < max_fleets:
+            fleets += 1
+            st_indexer = fresh_indexer()
+            pods = make_pods(n_pods, model_cfg, engine_mod, st_indexer,
+                             params=shared_params, pod_kw=pod_kw,
+                             offload_spec_factory=spec)
+            services, chosen, fleet_hit, cached = run_replay(
+                pods, workload, make_kv_router(st_indexer),
+                tag=f"storage-restore fleet {fleets}")
+            fleet_hits.append(fleet_hit)
+            del pods
+            # Restore-serving requests: first touch of a prefix on a pod
+            # whose HBM cannot hold it yet, with cached tokens at
+            # admission — those tokens can only have come from the
+            # storage tier.
+            touched: set = set()
+            for i, prompt in enumerate(workload):
+                pair = (chosen[i], tuple(prompt[:64]))
+                if pair not in touched and cached[i] > 0:
+                    restore_services.append(services[i])
+                touched.add(pair)
+            print(f"[bench storage] fleet {fleets}: "
+                  f"{len(restore_services)} restore admissions so far",
+                  file=_sys.stderr, flush=True)
+        # Every fleet replays the same workload, so the mean of per-fleet
+        # hit-rates is the token-weighted aggregate across all samples.
+        hit = sum(fleet_hits) / max(len(fleet_hits), 1)
+        return restore_services, hit, fleets
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
 
-def _run_ttft_subprocess(env=None, timeout=900):
+def _run_ttft_subprocess(env=None, timeout=2400):
     """Run the TTFT arm in a watchdogged subprocess; returns the JSON
-    result line or None."""
+    result line or None. The budget covers the replay arms, the hardened
+    multi-fleet storage arm, AND the concurrent open-loop sweep (which
+    re-serves cold fleets per QPS point) at tunneled-TPU service times —
+    a too-tight watchdog here silently downgrades a TPU headline to the
+    CPU fallback."""
     import subprocess
     import sys
 
