@@ -135,12 +135,62 @@ assert b == s, (b, s)
 print('MLA absorbed serve on TPU: burst==single-step', b[:4], '...')
 " || continue
 
+  stage mla_pallas_serve 900 "
+# Compiled flash-decode over the MLA latent: rank+rope=320 is NOT
+# 128-aligned, so latent_pad=64 (-> 384 = 3x128) engages the Mosaic
+# path — exactly the DeepSeek-shape recipe (512+64+64=640). Verify the
+# kernel actually engaged (a silent XLA fallback would assert XLA==XLA).
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig, forward_decode_pallas
+import numpy as np
+cfg = LlamaConfig(vocab_size=8192, hidden_size=512, num_layers=4,
+                  num_heads=8, num_kv_heads=8, head_dim=128,
+                  intermediate_size=1408, page_size=16,
+                  kv_lora_rank=256, qk_rope_head_dim=64, latent_pad=64)
+prompt = np.random.default_rng(0).integers(1, 8000, 128).tolist()
+outs = {}
+for pallas in (False, True):
+    eng = MiniEngine(EngineConfig(model=cfg, num_pages=256,
+                                  max_pages_per_seq=32, model_name='ds',
+                                  pod_identifier='p',
+                                  use_pallas_decode=pallas), seed=0)
+    if pallas:
+        fwd = getattr(eng._decode_forward, 'func', eng._decode_forward)
+        assert fwd is forward_decode_pallas, 'Pallas decode did not engage'
+    outs[pallas] = eng.generate('r', prompt, max_new_tokens=8)
+assert outs[False] == outs[True], outs
+print('MLA flash-decode on TPU (latent 384): pallas==xla', outs[True][:4])
+" || continue
+
+  stage sink_pallas_serve 900 "
+# StreamingLLM sink mask compiled in-kernel (sink pages streamed via
+# the loop remap) vs the XLA mask, on-chip.
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig
+import numpy as np
+cfg = LlamaConfig(vocab_size=8192, hidden_size=512, num_layers=4,
+                  num_heads=8, num_kv_heads=4, head_dim=128,
+                  intermediate_size=1408, page_size=16,
+                  sliding_window=64, swa_layers=(0, 1, 2, 3),
+                  attention_sinks=16)
+prompt = np.random.default_rng(0).integers(1, 8000, 256).tolist()
+outs = {}
+for pallas in (False, True):
+    eng = MiniEngine(EngineConfig(model=cfg, num_pages=256,
+                                  max_pages_per_seq=32, model_name='sink',
+                                  pod_identifier='p',
+                                  use_pallas_decode=pallas), seed=0)
+    outs[pallas] = eng.generate('r', prompt, max_new_tokens=8)
+assert outs[False] == outs[True], outs
+print('sink flash-decode on TPU: pallas==xla', outs[True][:4], '...')
+" || continue
+
   stage mfu_probe 900 "
 import runpy
 runpy.run_path('hack/mfu_probe.py', run_name='__main__')
 " || continue
 
-  stage ttft_bench 1200 "
+  stage ttft_bench 2700 "
 import sys; sys.argv=['bench','--ttft']
 exec(open('bench.py').read())
 " || continue
