@@ -690,13 +690,13 @@ def forward_decode_pallas(
         if mesh is not None:
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, sinks=sinks,
+                sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
                 interpret=interpret,
             )
         else:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, sinks=sinks,
+                sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
                 interpret=interpret,
             )
         return out[:, None]  # restore the seq axis
@@ -708,7 +708,8 @@ def forward_decode_pallas(
 
 
 def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
-                           sinks: int | None = None):
+                           sinks: int | None = None,
+                           shared_kv: bool = False):
     """Attention closure for fused decode bodies — one implementation for
     the single-pool and hybrid two-pool scans (the grouped forward hands
     each layer its own group's table and window, so the closure is
@@ -722,14 +723,14 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
         if use_pallas and mesh is not None:
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, sinks=sinks,
+                sliding_window=window, sinks=sinks, shared_kv=shared_kv,
                 interpret=interpret,
             )
             return out[:, None]
         if use_pallas:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
-                sliding_window=window, sinks=sinks,
+                sliding_window=window, sinks=sinks, shared_kv=shared_kv,
                 interpret=interpret,
             )
             return out[:, None]
@@ -785,7 +786,8 @@ def forward_decode_steps(
         params, cfg, last_tokens, (k_cache,), (v_cache,), (page_table,),
         ctx_lens, active, steps,
         _decode_step_attention(use_pallas, interpret, mesh,
-                               sinks=cfg.attention_sinks or None),
+                               sinks=cfg.attention_sinks or None,
+                               shared_kv=cfg.is_mla),
     )
     return toks, ks[0], vs[0]
 
@@ -851,7 +853,8 @@ def forward_decode_steps_hybrid(
         params, cfg, last_tokens, (k0, k1), (v0, v1), (table0, table1),
         ctx_lens, active, steps,
         _decode_step_attention(use_pallas, interpret, mesh,
-                               sinks=cfg.attention_sinks or None),
+                               sinks=cfg.attention_sinks or None,
+                               shared_kv=cfg.is_mla),
     )
     return toks, ks[0], vs[0], ks[1], vs[1]
 
@@ -899,12 +902,12 @@ def forward_prefill_pallas(
             return sharded_paged_prefill_attention(
                 mesh, q, k_l, v_l, table, ctx_lens, total_lens,
                 q_tile=q_tile, sliding_window=window,
-                sinks=sinks, interpret=interpret,
+                sinks=sinks, shared_kv=cfg.is_mla, interpret=interpret,
             )
         return pallas_paged_prefill_attention(
             q, k_l, v_l, table, ctx_lens, total_lens,
             q_tile=q_tile, sliding_window=window,
-            sinks=sinks, interpret=interpret,
+            sinks=sinks, shared_kv=cfg.is_mla, interpret=interpret,
         )
 
     return _forward_impl(

@@ -60,14 +60,19 @@ def _check_head_dim_alignment(head_dim: int, interpret: bool) -> None:
 
 def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
                          v_scratch, sem, *, kpb, num_iters, first_window,
-                         sink_pages, sinks):
+                         sink_pages, sinks, shared_kv=False):
     """Shared page remap + superblock DMA for the decode/prefill kernels.
 
     ``page_for`` maps a loop counter to a page-table index — sink pages
     ([0, sink_pages)) first, then window pages ([first_window, …)) —
     with DMA-safe clamping for sub-pages past ``num_iters`` (their
     garbage loads are masked out by position). One definition for both
-    kernels so the clamp/remap subtleties cannot drift between them."""
+    kernels so the clamp/remap subtleties cannot drift between them.
+
+    ``shared_kv`` (absorbed MLA: values ARE the latent keys) streams each
+    page ONCE into the K scratch and skips the V stream entirely —
+    halving the attention's HBM traffic, which is the point of caching
+    only the latent."""
     pp_seq = page_table_ref.shape[1]
 
     def page_for(j):
@@ -86,9 +91,11 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
             copies.append(pltpu.make_async_copy(
                 k_hbm.at[page, h], k_scratch.at[slot, t], sem.at[slot, t, 0]
             ))
-            copies.append(pltpu.make_async_copy(
-                v_hbm.at[page, h], v_scratch.at[slot, t], sem.at[slot, t, 1]
-            ))
+            if not shared_kv:
+                copies.append(pltpu.make_async_copy(
+                    v_hbm.at[page, h], v_scratch.at[slot, t],
+                    sem.at[slot, t, 1]
+                ))
         return copies
 
     return page_for, sb_dma
@@ -114,6 +121,7 @@ def _decode_kernel(
     sliding_window: int | None,
     sinks: int,
     pages_per_block: int,
+    shared_kv: bool,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -151,7 +159,7 @@ def _decode_kernel(
     page_for, sb_dma = _superblock_streamer(
         page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
-        sink_pages=sink_pages, sinks=sinks)
+        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
 
     @pl.when(num_sb > 0)
     def _():
@@ -177,7 +185,8 @@ def _decode_kernel(
             c.wait()
 
         k = k_scratch[slot].reshape(kpb * page_size, head_dim)
-        v = v_scratch[slot].reshape(kpb * page_size, head_dim)
+        v = k if shared_kv else v_scratch[slot].reshape(
+            kpb * page_size, head_dim)
 
         scores = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -245,6 +254,7 @@ def _prefill_kernel(
     sliding_window: int | None,
     sinks: int,
     pages_per_block: int,
+    shared_kv: bool,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -289,7 +299,7 @@ def _prefill_kernel(
     page_for, sb_dma = _superblock_streamer(
         page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
-        sink_pages=sink_pages, sinks=sinks)
+        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
 
     @pl.when(num_sb > 0)
     def _():
@@ -318,7 +328,8 @@ def _prefill_kernel(
             c.wait()
 
         k = k_scratch[slot].reshape(kpb * page_size, head_dim)
-        v = v_scratch[slot].reshape(kpb * page_size, head_dim)
+        v = k if shared_kv else v_scratch[slot].reshape(
+            kpb * page_size, head_dim)
 
         # [group, q_tile, kpb*page_size], fp32 accumulate off bf16 operands
         scores = jax.lax.dot_general(
@@ -366,7 +377,8 @@ def _prefill_kernel(
 
 @functools.partial(jax.jit,
                    static_argnames=("q_tile", "sliding_window", "sinks",
-                                    "pages_per_block", "interpret"))
+                                    "pages_per_block", "shared_kv",
+                                    "interpret"))
 def pallas_paged_prefill_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -379,6 +391,7 @@ def pallas_paged_prefill_attention(
     sliding_window: int | None = None,
     sinks: int | None = None,
     pages_per_block: int | None = None,
+    shared_kv: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash prefill over paged KV (new tokens' KV already scattered).
@@ -414,6 +427,7 @@ def pallas_paged_prefill_attention(
         _prefill_kernel, page_size=page_size, q_tile=q_tile,
         scale=head_dim ** -0.5, sliding_window=sliding_window,
         sinks=int(sinks or 0), pages_per_block=pages_per_block,
+        shared_kv=shared_kv,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -434,7 +448,10 @@ def pallas_paged_prefill_attention(
         scratch_shapes=[
             pltpu.VMEM((2, pages_per_block, page_size, head_dim),
                        k_cache.dtype),
-            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+            # shared_kv (absorbed MLA): the V stream is skipped, so its
+            # scratch shrinks to a placeholder allocation.
+            pltpu.VMEM((1, 1, 1, 1) if shared_kv else
+                       (2, pages_per_block, page_size, head_dim),
                        k_cache.dtype),
             pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
         ],
@@ -455,7 +472,7 @@ def pallas_paged_prefill_attention(
 
 @functools.partial(jax.jit,
                    static_argnames=("interpret", "sliding_window", "sinks",
-                                    "pages_per_block"))
+                                    "pages_per_block", "shared_kv"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -466,6 +483,7 @@ def pallas_paged_decode_attention(
     sliding_window: int | None = None,
     sinks: int | None = None,
     pages_per_block: int | None = None,
+    shared_kv: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
@@ -491,7 +509,7 @@ def pallas_paged_decode_attention(
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
         sliding_window=sliding_window, sinks=int(sinks or 0),
-        pages_per_block=pages_per_block,
+        pages_per_block=pages_per_block, shared_kv=shared_kv,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -514,7 +532,9 @@ def pallas_paged_decode_attention(
             # DMA staging must match the cache dtype; upcast after load.
             pltpu.VMEM((2, pages_per_block, page_size, head_dim),
                        k_cache.dtype),
-            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+            # shared_kv (absorbed MLA): V stream skipped, placeholder.
+            pltpu.VMEM((1, 1, 1, 1) if shared_kv else
+                       (2, pages_per_block, page_size, head_dim),
                        k_cache.dtype),
             pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
         ],
@@ -548,7 +568,8 @@ def _kv_pool_spec(k_cache):
 
 def sharded_paged_decode_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
-    sliding_window=None, sinks=None, pages_per_block=None, interpret=False,
+    sliding_window=None, sinks=None, pages_per_block=None, shared_kv=False,
+    interpret=False,
 ):
     """Flash-decode over a tp-sharded paged KV cache.
 
@@ -570,7 +591,8 @@ def sharded_paged_decode_attention(
     def local(q_, k_, v_, t_, l_):
         return pallas_paged_decode_attention(
             q_, k_, v_, t_, l_, sliding_window=sliding_window, sinks=sinks,
-            pages_per_block=pages_per_block, interpret=interpret,
+            pages_per_block=pages_per_block, shared_kv=shared_kv,
+            interpret=interpret,
         )
 
     kv_spec = _kv_pool_spec(k_cache)
@@ -586,7 +608,7 @@ def sharded_paged_decode_attention(
 def sharded_paged_prefill_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, total_lens, *,
     q_tile=16, sliding_window=None, sinks=None, pages_per_block=None,
-    interpret=False,
+    shared_kv=False, interpret=False,
 ):
     """Flash-prefill over a tp-sharded paged KV cache (see the decode
     wrapper's rationale). q: [batch, q_seq, q_heads, hd], heads sharded."""
@@ -597,7 +619,8 @@ def sharded_paged_prefill_attention(
         return pallas_paged_prefill_attention(
             q_, k_, v_, t_, cl_, tl_, q_tile=q_tile,
             sliding_window=sliding_window, sinks=sinks,
-            pages_per_block=pages_per_block, interpret=interpret,
+            pages_per_block=pages_per_block, shared_kv=shared_kv,
+            interpret=interpret,
         )
 
     kv_spec = _kv_pool_spec(k_cache)

@@ -198,6 +198,22 @@ def test_multi_query_shared_kv_operand():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("kpb", [1, 3])
+def test_shared_kv_single_stream(kpb):
+    """shared_kv=True streams each page once (no V DMA) and reuses the K
+    scratch as values — bit-identical to the double-stream aliased path.
+    This is absorbed MLA's decode fast path: half the HBM traffic."""
+    q, k_cache, _v, table, ctx_lens = build_case(
+        q_heads=8, kv_heads=1, head_dim=24)
+    ref = pallas_paged_decode_attention(
+        q, k_cache, k_cache, table, ctx_lens, pages_per_block=kpb,
+        interpret=True)
+    out = pallas_paged_decode_attention(
+        q, k_cache, k_cache, table, ctx_lens, pages_per_block=kpb,
+        shared_kv=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 @pytest.mark.parametrize("kpb", [1, 2, 3])
 def test_pages_per_block_variants(kpb):
     """Superblock streaming (kpb pages per online-softmax round) is

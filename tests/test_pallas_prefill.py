@@ -151,6 +151,26 @@ def test_prefill_pages_per_block_variants(kpb):
             np.asarray(ref[b, :n], np.float32), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("kpb", [1, 3])
+def test_prefill_shared_kv_single_stream(kpb):
+    """shared_kv=True (absorbed MLA: values ARE the latent keys) streams
+    each page once and reuses the K scratch as values — bit-identical to
+    the double-stream aliased path, including partial superblocks."""
+    q, k, _v, table, ctx, new = build_prefill_case(
+        ctx=(5, 0), new=(8, 12), kv_heads=1, q_heads=4)
+    total = ctx + new
+    ref = pallas_paged_prefill_attention(
+        q, k, k, table, ctx, total, q_tile=Q_TILE, pages_per_block=kpb,
+        interpret=True)
+    out = pallas_paged_prefill_attention(
+        q, k, k, table, ctx, total, q_tile=Q_TILE, pages_per_block=kpb,
+        shared_kv=True, interpret=True)
+    for b in range(q.shape[0]):
+        n = int(new[b])
+        np.testing.assert_array_equal(np.asarray(out[b, :n]),
+                                      np.asarray(ref[b, :n]))
+
+
 def test_prefill_window_larger_than_context_equals_full():
     q, k, v, table, ctx, new = build_prefill_case()
     total = ctx + new
