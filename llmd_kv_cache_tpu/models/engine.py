@@ -403,6 +403,7 @@ class MiniEngine:
         # and replicated — identical on every shard.
         self.mesh = mesh
         self._tp = 1
+        self._sp = 1
         if mesh is not None:
             from ..parallel.serve import mesh_tp_size, validate_tp_config
 
@@ -411,6 +412,26 @@ class MiniEngine:
             # validate_tp_config checks the per-family divisibility.
             validate_tp_config(mcfg, mesh)
             self._tp = mesh_tp_size(mesh)
+            # Sequence parallelism for prefill: with an ``sp`` mesh axis,
+            # chunk tokens are placed sharded on the sequence dim and XLA
+            # propagates — per-token projections/MLP/attention-q compute
+            # splits sp-ways (one long prompt's prefill FLOPs spread over
+            # sp chips), with the collectives (scatter all-gathers, one
+            # logits all-reduce) derived from the shardings. Verified
+            # bit-exact vs single-device and predominantly seq-sharded in
+            # the compiled HLO (tests/test_sp_serve.py). Decode (seq=1)
+            # is unaffected.
+            self._sp = mesh.shape.get("sp", 1)
+            if self._sp > 1 and mcfg.page_size % self._sp != 0:
+                # Chunk buckets are 2^k × page_size; a chunk shards only
+                # when sp divides its bucket. sp ∤ page_size means short
+                # chunks (and, for non-power-of-two sp, EVERY chunk) run
+                # unsharded — surface it instead of silently idling chips.
+                logger.warning(
+                    "sp=%d does not divide page_size=%d: prefill chunks "
+                    "whose bucketed length is not a multiple of sp run "
+                    "unsharded (non-power-of-two sp never shards)",
+                    self._sp, mcfg.page_size)
         if self.cfg.max_pages_per_seq * self.cfg.max_batch > self.cfg.num_pages:
             logger.warning("page pool smaller than worst-case demand; requests may stall")
         self.processor = ChunkedTokenDatabase(
@@ -1097,6 +1118,16 @@ class MiniEngine:
         seq = bucket * page_size
         tokens = np.zeros((1, seq), np.int32)
         tokens[0, : len(chunk)] = chunk
+        if self._sp > 1 and seq % self._sp == 0:
+            # Sequence-parallel prefill: place the chunk sharded on seq
+            # in ONE host→device transfer; XLA splits the per-token
+            # compute sp-ways (see __init__).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            tokens_dev = jax.device_put(
+                tokens, NamedSharding(self.mesh, P(None, "sp")))
+        else:
+            tokens_dev = jnp.asarray(tokens)
 
         if self.hybrid:
             # SWA pages arrive just-in-time for this chunk's blocks and
@@ -1107,7 +1138,7 @@ class MiniEngine:
             (logits, self.k_cache, self.v_cache,
              self.k_swa, self.v_swa) = forward_hybrid(
                 self.params, self.cfg.model,
-                jnp.asarray(tokens),
+                tokens_dev,
                 self.k_cache, self.v_cache, self.k_swa, self.v_swa,
                 table, swa_table,
                 jnp.asarray([pos], jnp.int32),
@@ -1119,7 +1150,7 @@ class MiniEngine:
         else:
             logits, self.k_cache, self.v_cache = self._prefill_forward(
                 self.params, self.cfg.model,
-                jnp.asarray(tokens),
+                tokens_dev,
                 self.k_cache, self.v_cache,
                 table,
                 jnp.asarray([pos], jnp.int32),
