@@ -23,7 +23,7 @@ from llmd_kv_cache_tpu.models.llama import (
 )
 from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
 from llmd_kv_cache_tpu.ops.pallas_paged_attention import (
-    pallas_paged_prefill_attention,
+    pallas_paged_decode_attention, pallas_paged_prefill_attention,
 )
 from llmd_kv_cache_tpu.ops.kv_pages import scatter_kv_pages
 
@@ -240,6 +240,43 @@ def main():
     timed_threaded("4096-tok prefill, single chunk in-jit",
                    prefill_one_step, (k_cache, v_cache), iters=4,
                    flops=prefill_flops)
+
+    # --- flash-prefill tuning sweep: q_tile × pages_per_block at the
+    # bench chunk shape (reusing the attention-stage q/kc/vc arrays —
+    # re-uploading 100 MB over the tunnel would dominate the stage). The
+    # superblock rework targets full MXU tiles (q_tile 128, 128
+    # keys/round); this table is the on-chip evidence for the engine's
+    # default and the r4-mfu hypothesis-1 discriminator
+    # (probs-materialization-free prefill vs the XLA path above). ---
+    for q_tile in (16, 64, 128):
+        for kpb in (1, 4, 8, 16):
+            try:
+                timed(f"flash prefill q_tile={q_tile:<3d} kpb={kpb:<2d}",
+                      lambda *a, qt=q_tile, kb=kpb:
+                      pallas_paged_prefill_attention(
+                          *a, q_tile=qt, pages_per_block=kb),
+                      q, kc, vc, table, ctx, tot,
+                      flops=per_layer_attn)
+            except Exception as e:  # Mosaic rejection at an extreme point
+                print(f"flash prefill q_tile={q_tile} kpb={kpb}: "
+                      f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+    # Flash-decode superblock sweep at long context (batch 8, ctx 4096).
+    qd = jnp.asarray(rng.normal(size=(8, 16, 128)), jnp.bfloat16)
+    table8 = jnp.asarray(
+        1 + np.arange(8 * PAGES_PER_SEQ).reshape(8, PAGES_PER_SEQ) %
+        (NUM_PAGES - 1), jnp.int32)
+    lens8 = jnp.full((8,), 4096, jnp.int32)
+    dec_flops = 8 * 4 * 4096 * 16 * 128
+    for kpb in (1, 4, 8, 16):
+        try:
+            timed(f"flash decode kpb={kpb:<2d} (b8, ctx 4k)",
+                  lambda *a, kb=kpb: pallas_paged_decode_attention(
+                      *a, pages_per_block=kb),
+                  qd, kc, vc, table8, lens8, flops=dec_flops)
+        except Exception as e:  # Mosaic rejection at an extreme point
+            print(f"flash decode kpb={kpb}: "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
 
 
 if __name__ == "__main__":
