@@ -45,6 +45,7 @@ verify-examples: native
 	$(CPU_ENV) $(PY) examples/offline_events.py
 	$(CPU_ENV) $(PY) examples/fleet_demo.py
 	$(CPU_ENV) $(PY) examples/tp_serving_demo.py
+	$(CPU_ENV) $(PY) examples/long_context_sp.py
 	$(CPU_ENV) $(PY) examples/redis_indexer.py
 
 # Developer check on the CPU backend (the driver separately compile-checks

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Long-context serving with sequence-parallel prefill.
+
+A long prompt's prefill is the serving cost that scales quadratically
+with context; with an ``sp`` axis on the engine mesh, each chunk's tokens
+place sharded on the sequence dim and XLA splits the per-token compute
+across sp devices (collectives derived from the shardings) — the serving
+analog of the training-side ring attention, composed here with tp
+(Megatron params) on one mesh. Tokens must be identical to the
+single-device engine; prefix-cache resume (nonzero ctx into the sharded
+chunk) works unchanged.
+
+Usage:
+  PYTHONPATH=. JAX_PLATFORMS=cpu \\
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+  python examples/long_context_sp.py
+"""
+
+import numpy as np
+
+import jax
+
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
+from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+
+MODEL = "sp-demo"
+
+
+def engine(cfg, params, mesh=None):
+    return MiniEngine(
+        EngineConfig(model=cfg, num_pages=192, max_pages_per_seq=96,
+                     model_name=MODEL, pod_identifier="pod-0",
+                     max_prefill_tokens=64),  # chunked long-prompt prefill
+        params=params, mesh=mesh,
+    )
+
+
+def main() -> None:
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=16, intermediate_size=128, page_size=4,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    long_prompt = rng.integers(1, 500, 256).tolist()  # 4 sp-sharded chunks
+
+    print(f"devices: {len(jax.devices())} × {jax.devices()[0].platform}")
+
+    ref = engine(cfg, params).generate("r", long_prompt, max_new_tokens=8)
+    mesh = make_mesh({"tp": 2, "sp": 2}, jax.devices()[:4])
+    sp = engine(cfg, params, mesh=mesh)
+    out = sp.generate("r", long_prompt, max_new_tokens=8)
+    print(f"single-device tokens: {ref}")
+    print(f"tp=2 × sp=2 tokens:   {out}")
+    assert out == ref
+
+    # Prefix-cache resume: the shared 256-token prefix is already paged in,
+    # so only the 16-token suffix prefills (one sharded chunk).
+    ext = long_prompt + rng.integers(1, 500, 16).tolist()
+    ref2 = engine(cfg, params).generate("r2", ext, max_new_tokens=4)
+    req = sp.add_request("r2", ext, max_new_tokens=4)  # prefill now
+    cached = req.cached_len
+    while not req.done:  # decode through the scheduler
+        sp.step()
+    print(f"resume: cached {cached}/{len(ext)} tokens, "
+          f"tokens {req.output} == {ref2}")
+    assert req.output == ref2 and cached >= 250
+
+    print("OK: sp prefill serves long contexts token-identically")
+
+
+if __name__ == "__main__":
+    main()
