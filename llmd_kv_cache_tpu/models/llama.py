@@ -551,6 +551,10 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             q = attn_in @ layer["wq"]
             k = attn_in @ layer["wk"]
             v = attn_in @ layer["wv"]
+            if "bq" in layer:  # Qwen2-lineage QKV projection biases
+                q = q + layer["bq"]
+                k = k + layer["bk"]
+                v = v + layer["bv"]
             q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
             k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
             v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)

@@ -45,7 +45,7 @@ def make_mesh(
 
 def param_pspecs(has_tp: bool = True, has_ep: bool = False,
                  moe_layer: bool = False, qk_norm: bool = False,
-                 mla_layer: bool = False) -> dict:
+                 mla_layer: bool = False, qkv_bias: bool = False) -> dict:
     """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
@@ -81,6 +81,8 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
         })
     else:
         layer.update({"wk": P(None, tp), "wv": P(None, tp)})
+        if qkv_bias:  # column-parallel bias shards with its output dim
+            layer.update({"bq": P(tp), "bk": P(tp), "bv": P(tp)})
     if qk_norm:
         layer.update({"q_norm": P(), "k_norm": P()})
     if moe_layer:
@@ -117,9 +119,10 @@ def param_shardings(mesh: Mesh, params: Params) -> dict:
     moe = "router" in params["layers"][0]
     qk = "q_norm" in params["layers"][0]
     mla = "w_uk" in params["layers"][0]
+    bias = "bq" in params["layers"][0]
     specs = _tree_with_layers(
         param_pspecs(has_tp, has_ep, moe_layer=moe, qk_norm=qk,
-                     mla_layer=mla),
+                     mla_layer=mla, qkv_bias=bias),
         len(params["layers"])
     )
     return jax.tree.map(
