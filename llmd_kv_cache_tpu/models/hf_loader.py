@@ -1,6 +1,7 @@
 """HuggingFace checkpoint loading: serve real Llama-family weights.
 
-Maps a ``transformers`` Llama/Mistral/Qwen2/Qwen3-architecture state dict (or a
+Maps a ``transformers`` Llama/Mistral/Qwen2/Qwen3/DeepSeek-architecture
+state dict (or a
 checkpoint directory) onto this repo's parameter pytree, so the paged
 serving engine runs real checkpoints instead of random init. The mapping
 is validated end-to-end by logits parity against the authoritative HF
@@ -33,6 +34,18 @@ import numpy as np
 from .llama import LlamaConfig, Params
 
 
+def _refuse_rope_scaling(hf_cfg: Any) -> None:
+    """Refuse non-default RoPE scaling (yarn/llama3/linear — both the
+    modern ``rope_type`` and legacy ``type`` key spellings): converting
+    would silently change every position's frequencies vs the
+    checkpoint's training."""
+    rope_scaling = getattr(hf_cfg, "rope_scaling", None)
+    if rope_scaling and rope_scaling.get(
+            "rope_type", rope_scaling.get("type", "default")) != "default":
+        raise NotImplementedError(
+            f"rope_scaling={rope_scaling!r} is not implemented")
+
+
 def config_from_hf(hf_cfg: Any, page_size: int = 16,
                    dtype: Any = jnp.bfloat16) -> LlamaConfig:
     """Translate a ``transformers`` Llama/Mistral/Qwen config.
@@ -49,7 +62,8 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
     # exactly. Anything else (Gemma's GELU + softcapping + scaled embeds,
     # Phi's partial rotary, …) must refuse rather than convert to
     # silently-wrong logits.
-    supported = ("llama", "mistral", "qwen2", "qwen3")
+    supported = ("llama", "mistral", "qwen2", "qwen3",
+                 "deepseek_v2", "deepseek_v3")
     if hf_cfg.model_type not in supported:
         raise NotImplementedError(
             f"model_type {hf_cfg.model_type!r} is not supported "
@@ -59,14 +73,9 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
         raise NotImplementedError(
             f"hidden_act {act!r} != silu: the SwiGLU MLP here would be "
             f"silently wrong")
-
-    rope_scaling = getattr(hf_cfg, "rope_scaling", None)
-    if rope_scaling and rope_scaling.get(
-            "rope_type", rope_scaling.get("type", "default")) != "default":
-        raise NotImplementedError(
-            f"rope_scaling={rope_scaling!r} is not implemented — "
-            f"converting would silently change every position's RoPE "
-            f"frequencies vs the checkpoint's training")
+    _refuse_rope_scaling(hf_cfg)
+    if hf_cfg.model_type.startswith("deepseek"):
+        return _config_from_deepseek(hf_cfg, page_size, dtype)
     if getattr(hf_cfg, "mlp_bias", False):
         raise NotImplementedError(
             "MLP biases are not implemented; a bias-free conversion "
@@ -112,12 +121,73 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
     )
 
 
-def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> Params:
+def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any
+                          ) -> LlamaConfig:
+    """DeepSeek-V2/V3 → absorbed-MLA config.
+
+    Supported subset: no q-LoRA (V2-lite-style full q projection), dense
+    MLP layers only (``num_hidden_layers <= first_k_dense_replace``),
+    ``v_head_dim == qk_nope_head_dim`` (the shared head_dim here). The
+    parity test pins our *absorbed* attention against HF's materialized
+    MLA — a cross-implementation check of the absorption algebra.
+    """
+    if getattr(hf_cfg, "q_lora_rank", None):
+        raise NotImplementedError(
+            "q_lora_rank (compressed q projection) is not implemented")
+    if hf_cfg.v_head_dim != hf_cfg.qk_nope_head_dim:
+        raise NotImplementedError(
+            f"v_head_dim {hf_cfg.v_head_dim} != qk_nope_head_dim "
+            f"{hf_cfg.qk_nope_head_dim}: this model shares one head_dim")
+    n_layers = hf_cfg.num_hidden_layers
+    if getattr(hf_cfg, "n_routed_experts", None) and n_layers > getattr(
+            hf_cfg, "first_k_dense_replace", 0):
+        raise NotImplementedError(
+            "DeepSeek MoE layers are not implemented (dense layers only: "
+            "num_hidden_layers <= first_k_dense_replace)")
+    return LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        num_layers=n_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=hf_cfg.num_attention_heads,
+        head_dim=hf_cfg.qk_nope_head_dim,
+        intermediate_size=hf_cfg.intermediate_size,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        norm_eps=float(hf_cfg.rms_norm_eps),
+        page_size=page_size,
+        dtype=dtype,
+        kv_lora_rank=hf_cfg.kv_lora_rank,
+        qk_rope_head_dim=hf_cfg.qk_rope_head_dim,
+    )
+
+
+def _deinterleave(w: np.ndarray, dr: int) -> np.ndarray:
+    """Permute the trailing ``dr`` output columns from HF DeepSeek's
+    interleaved-rotary layout (pairs (2i, 2i+1)) to this repo's
+    half-split layout (pairs (i, i+dr/2)).
+
+    Rotations act on activations, so permuting the columns that PRODUCE
+    the rope dims makes half-split rope equal interleaved rope up to the
+    same permutation on both q_pe and k_pe — and their dot product (the
+    only consumer) is permutation-invariant.
+    """
+    order = np.concatenate([np.arange(0, dr, 2), np.arange(1, dr, 2)])
+    out = w.copy()
+    out[..., -dr:] = w[..., -dr:][..., order]
+    return out
+
+
+def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig,
+                   mla_rope_interleaved: bool = True) -> Params:
     """Build the parameter pytree from an HF Llama-architecture state dict.
 
     Accepts torch tensors or numpy arrays. Norm scales stay fp32 (this
     repo's convention — norms compute in fp32); projections cast to
-    ``cfg.dtype``.
+    ``cfg.dtype``. ``mla_rope_interleaved`` mirrors DeepSeek's
+    ``rope_interleave`` (True in both HF implementations; V3 exposes the
+    flag) — when set, the rope-producing weight columns are permuted so
+    this repo's half-split rotary reproduces HF's interleaved one (see
+    ``_deinterleave``).
     """
     consumed: set = set()
 
@@ -139,23 +209,51 @@ def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> Params:
         p = f"model.layers.{i}."
         layer = {
             "attn_norm": norm(p + "input_layernorm.weight"),
-            "wq": proj(p + "self_attn.q_proj.weight"),
-            "wk": proj(p + "self_attn.k_proj.weight"),
-            "wv": proj(p + "self_attn.v_proj.weight"),
-            "wo": proj(p + "self_attn.o_proj.weight"),
             "mlp_norm": norm(p + "post_attention_layernorm.weight"),
             "w_gate": proj(p + "mlp.gate_proj.weight"),
             "w_up": proj(p + "mlp.up_proj.weight"),
             "w_down": proj(p + "mlp.down_proj.weight"),
+            "wo": proj(p + "self_attn.o_proj.weight"),
         }
-        if cfg.qk_norm:  # Qwen3: per-head RMS on Q/K pre-RoPE
-            layer["q_norm"] = norm(p + "self_attn.q_norm.weight")
-            layer["k_norm"] = norm(p + "self_attn.k_norm.weight")
-        if p + "self_attn.q_proj.bias" in state_dict:  # Qwen2 lineage
-            for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
-                                 ("bv", "v_proj")):
-                layer[ours] = jnp.asarray(
-                    get(p + f"self_attn.{theirs}.bias"), cfg.dtype)
+        if cfg.is_mla:
+            # DeepSeek: full q projection (q-LoRA refused in config),
+            # fused latent down-projection, RMS-normed latent, fused
+            # k_nope/v up-projections split into the absorbed form.
+            r, dr, hd = (cfg.kv_lora_rank, cfg.qk_rope_head_dim,
+                         cfg.head_dim)
+            H = cfg.num_heads
+            wq = get(p + "self_attn.q_proj.weight").T  # [h, H*(hd+dr)]
+            wq = wq.reshape(wq.shape[0], H, hd + dr)
+            if mla_rope_interleaved:
+                wq = _deinterleave(wq, dr)
+            layer["wq"] = jnp.asarray(
+                wq.reshape(wq.shape[0], H * (hd + dr)), cfg.dtype)
+            kva = get(p + "self_attn.kv_a_proj_with_mqa.weight").T
+            layer["w_dkv"] = jnp.asarray(kva[:, :r], cfg.dtype)
+            k_rope = kva[:, r:]
+            if mla_rope_interleaved:
+                k_rope = _deinterleave(k_rope, dr)
+            layer["w_kr"] = jnp.asarray(k_rope, cfg.dtype)
+            layer["latent_norm"] = norm(
+                p + "self_attn.kv_a_layernorm.weight")
+            kvb = get(p + "self_attn.kv_b_proj.weight").reshape(
+                H, 2 * hd, r)  # [H, nope+v, r]
+            layer["w_uk"] = jnp.asarray(
+                kvb[:, :hd, :].transpose(0, 2, 1), cfg.dtype)
+            layer["w_uv"] = jnp.asarray(
+                kvb[:, hd:, :].transpose(0, 2, 1), cfg.dtype)
+        else:
+            layer["wq"] = proj(p + "self_attn.q_proj.weight")
+            layer["wk"] = proj(p + "self_attn.k_proj.weight")
+            layer["wv"] = proj(p + "self_attn.v_proj.weight")
+            if cfg.qk_norm:  # Qwen3: per-head RMS on Q/K pre-RoPE
+                layer["q_norm"] = norm(p + "self_attn.q_norm.weight")
+                layer["k_norm"] = norm(p + "self_attn.k_norm.weight")
+            if p + "self_attn.q_proj.bias" in state_dict:  # Qwen2 lineage
+                for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
+                                     ("bv", "v_proj")):
+                    layer[ours] = jnp.asarray(
+                        get(p + f"self_attn.{theirs}.bias"), cfg.dtype)
         layers.append(layer)
 
     embed = jnp.asarray(get("model.embed_tokens.weight"), cfg.dtype)
@@ -200,5 +298,7 @@ def load_hf_checkpoint(path: str, page_size: int = 16,
     # (get() upcasts per-tensor during conversion anyway).
     model = AutoModelForCausalLM.from_pretrained(
         path, torch_dtype="auto", low_cpu_mem_usage=True)
-    params = params_from_hf(model.state_dict(), cfg)
+    params = params_from_hf(
+        model.state_dict(), cfg,
+        mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
     return cfg, params

@@ -515,6 +515,11 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             q_nope, q_rope = q[..., :cfg.head_dim], q[..., cfg.head_dim:]
             q_rope = _rope(q_rope, positions, cfg.rope_theta)
             c_kv = attn_in @ layer["w_dkv"]  # [b, s, r]
+            if "latent_norm" in layer:
+                # DeepSeek kv_a_layernorm: the latent is RMS-normed before
+                # the up-projections — cached post-norm, so absorption is
+                # unchanged (w_uk applies to the normed latent).
+                c_kv = _rms_norm(c_kv, layer["latent_norm"], cfg.norm_eps)
             k_rope = _rope((attn_in @ layer["w_kr"])[:, :, None, :],
                            positions, cfg.rope_theta)  # [b, s, 1, dr]
             latent = jnp.concatenate(

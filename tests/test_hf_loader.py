@@ -224,6 +224,46 @@ def test_qwen2_tp_serve_with_biases():
     assert serve(make_mesh({"tp": 2}, jax.devices()[:2])) == ref
 
 
+@pytest.mark.parametrize("which", ["v2", "v3"])
+def test_deepseek_mla_matches_transformers(which):
+    """The STRONG MLA oracle: our absorbed attention (latent-only cache,
+    up-projections folded into q and the output) must reproduce HF's
+    materialized MLA logits — a cross-implementation check of the
+    absorption algebra, the kv_a_layernorm placement, and the
+    interleaved→half-split rotary weight permutation."""
+    if which == "v2":
+        from transformers import DeepseekV2Config as DSConfig
+        from transformers import DeepseekV2ForCausalLM as DSModel
+    else:
+        from transformers import DeepseekV3Config as DSConfig
+        from transformers import DeepseekV3ForCausalLM as DSModel
+
+    torch.manual_seed(7)
+    hf_cfg = DSConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=None, kv_lora_rank=16,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        first_k_dense_replace=2,  # all layers dense: no MoE weights
+        tie_word_embeddings=False)
+    model = DSModel(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.is_mla and cfg.kv_lora_rank == 16
+    params = params_from_hf(
+        model.state_dict(), cfg,
+        mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
+    assert "latent_norm" in params["layers"][0]
+
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(1, 250, 19).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 def test_served_tokens_match_hf_greedy():
     """End-to-end: the serving engine over converted weights generates the
     same greedy continuation as transformers' generate()."""
