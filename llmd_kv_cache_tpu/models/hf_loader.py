@@ -1,6 +1,6 @@
 """HuggingFace checkpoint loading: serve real Llama-family weights.
 
-Maps a ``transformers`` Llama/Mistral/Qwen2/Qwen3/DeepSeek-architecture
+Maps a ``transformers`` Llama/Mistral/Mixtral/Qwen2/Qwen3/DeepSeek-architecture
 state dict (or a
 checkpoint directory) onto this repo's parameter pytree, so the paged
 serving engine runs real checkpoints instead of random init. The mapping
@@ -62,7 +62,7 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
     # exactly. Anything else (Gemma's GELU + softcapping + scaled embeds,
     # Phi's partial rotary, …) must refuse rather than convert to
     # silently-wrong logits.
-    supported = ("llama", "mistral", "qwen2", "qwen3",
+    supported = ("llama", "mistral", "mixtral", "qwen2", "qwen3",
                  "deepseek_v2", "deepseek_v3")
     if hf_cfg.model_type not in supported:
         raise NotImplementedError(
@@ -80,10 +80,22 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
         raise NotImplementedError(
             "MLP biases are not implemented; a bias-free conversion "
             "would be silently wrong")
-    if getattr(hf_cfg, "num_experts", 0) or getattr(
+    moe_kw = {}
+    if hf_cfg.model_type == "mixtral":
+        moe_kw = dict(
+            num_experts=hf_cfg.num_local_experts,
+            num_experts_per_token=hf_cfg.num_experts_per_tok,
+            # "dense" computes every expert with an exact one-hot top-k
+            # mix — the semantics HF Mixtral implements
+            # (softmax→top-k→renorm == top-k→softmax). The GShard
+            # capacity dispatch stays the opt-in performance mode
+            # (dataclasses.replace(moe_dispatch="capacity")).
+            moe_dispatch="dense",
+        )
+    elif getattr(hf_cfg, "num_experts", 0) or getattr(
             hf_cfg, "num_local_experts", 0):
         raise NotImplementedError(
-            "MoE checkpoint mapping is not implemented")
+            "MoE checkpoint mapping is only implemented for mixtral")
 
     layer_types = getattr(hf_cfg, "layer_types", None)
     if layer_types:
@@ -118,6 +130,7 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
         sliding_window=window,
         swa_layers=swa,
         qk_norm=hf_cfg.model_type == "qwen3",
+        **moe_kw,
     )
 
 
@@ -210,11 +223,24 @@ def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig,
         layer = {
             "attn_norm": norm(p + "input_layernorm.weight"),
             "mlp_norm": norm(p + "post_attention_layernorm.weight"),
-            "w_gate": proj(p + "mlp.gate_proj.weight"),
-            "w_up": proj(p + "mlp.up_proj.weight"),
-            "w_down": proj(p + "mlp.down_proj.weight"),
             "wo": proj(p + "self_attn.o_proj.weight"),
         }
+        if cfg.num_experts > 0:  # Mixtral block-sparse MoE
+            E = cfg.num_experts
+            layer["router"] = proj(p + "block_sparse_moe.gate.weight")
+            for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"),
+                                 ("w_down", "w2")):
+                # Stack via per-expert proj(): only ONE expert's fp32
+                # copy is live at a time (a real 8x7B stack would
+                # otherwise hold ~2 GB of transient fp32 per tensor).
+                layer[ours] = jnp.stack([
+                    proj(p + f"block_sparse_moe.experts.{e}"
+                             f".{theirs}.weight")
+                    for e in range(E)])
+        else:
+            layer["w_gate"] = proj(p + "mlp.gate_proj.weight")
+            layer["w_up"] = proj(p + "mlp.up_proj.weight")
+            layer["w_down"] = proj(p + "mlp.down_proj.weight")
         if cfg.is_mla:
             # DeepSeek: full q projection (q-LoRA refused in config),
             # fused latent down-projection, RMS-normed latent, fused

@@ -264,6 +264,33 @@ def test_deepseek_mla_matches_transformers(which):
     np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
+def test_mixtral_moe_matches_transformers():
+    """Mixtral block-sparse MoE: the exact 'dense' dispatch (one-hot
+    top-k mix) must reproduce HF's routed expert outputs — top-k→softmax
+    here equals HF's softmax→top-k→renorm."""
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(9)
+    hf_cfg = MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False)
+    model = MixtralForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.num_experts == 4 and cfg.moe_dispatch == "dense"
+    params = params_from_hf(model.state_dict(), cfg)
+    assert params["layers"][0]["w_gate"].shape == (4, 64, 128)
+
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(1, 250, 17).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 def test_served_tokens_match_hf_greedy():
     """End-to-end: the serving engine over converted weights generates the
     same greedy continuation as transformers' generate()."""
