@@ -291,6 +291,32 @@ def test_mixtral_moe_matches_transformers():
     np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
+def test_load_checkpoint_directory_roundtrip(tmp_path):
+    """The disk path: save_pretrained (safetensors) → load_hf_checkpoint
+    → serve. Covers AutoConfig/AutoModel materialization, the dtype-auto
+    load, and the rope_interleave plumbing end-to-end."""
+    from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+    from llmd_kv_cache_tpu.models.hf_loader import load_hf_checkpoint
+
+    hf_cfg, model = _build_hf(seed=10)
+    ckpt = tmp_path / "ckpt"
+    model.save_pretrained(ckpt)
+    cfg, params = load_hf_checkpoint(str(ckpt), page_size=4,
+                                     dtype=jnp.float32)
+    assert cfg.num_layers == hf_cfg.num_hidden_layers
+
+    prompt = np.random.default_rng(7).integers(1, 250, 12).tolist()
+    with torch.no_grad():
+        hf_toks = model.generate(
+            torch.tensor([prompt]), max_new_tokens=4, do_sample=False,
+            pad_token_id=0)[0, len(prompt):].tolist()
+    eng = MiniEngine(
+        EngineConfig(model=cfg, num_pages=64, max_pages_per_seq=16,
+                     model_name="ckpt", pod_identifier="p"),
+        params=params)
+    assert eng.generate("r", prompt, max_new_tokens=4) == hf_toks
+
+
 def test_served_tokens_match_hf_greedy():
     """End-to-end: the serving engine over converted weights generates the
     same greedy continuation as transformers' generate()."""
