@@ -34,16 +34,27 @@ import numpy as np
 from .llama import LlamaConfig, Params
 
 
-def _refuse_rope_scaling(hf_cfg: Any) -> None:
-    """Refuse non-default RoPE scaling (yarn/llama3/linear — both the
-    modern ``rope_type`` and legacy ``type`` key spellings): converting
-    would silently change every position's frequencies vs the
+def _convert_rope_scaling(hf_cfg: Any) -> tuple:
+    """Map HF ``rope_scaling`` to ``LlamaConfig.rope_scaling``.
+
+    ``llama3`` (the Llama-3.1+ frequency-band NTK scheme) is implemented
+    by ``llama._rope``; every other kind (yarn, linear, dynamic — both
+    the modern ``rope_type`` and legacy ``type`` key spellings) refuses:
+    converting would silently change every position's frequencies vs the
     checkpoint's training."""
     rope_scaling = getattr(hf_cfg, "rope_scaling", None)
-    if rope_scaling and rope_scaling.get(
-            "rope_type", rope_scaling.get("type", "default")) != "default":
-        raise NotImplementedError(
-            f"rope_scaling={rope_scaling!r} is not implemented")
+    if not rope_scaling:
+        return ()
+    kind = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if kind == "default":
+        return ()
+    if kind == "llama3":
+        return ("llama3", float(rope_scaling["factor"]),
+                float(rope_scaling["low_freq_factor"]),
+                float(rope_scaling["high_freq_factor"]),
+                float(rope_scaling["original_max_position_embeddings"]))
+    raise NotImplementedError(
+        f"rope_scaling={rope_scaling!r} is not implemented")
 
 
 def config_from_hf(hf_cfg: Any, page_size: int = 16,
@@ -73,8 +84,12 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
         raise NotImplementedError(
             f"hidden_act {act!r} != silu: the SwiGLU MLP here would be "
             f"silently wrong")
-    _refuse_rope_scaling(hf_cfg)
+    rope_scaling = _convert_rope_scaling(hf_cfg)
     if hf_cfg.model_type.startswith("deepseek"):
+        if rope_scaling:
+            raise NotImplementedError(
+                "llama3 rope scaling does not apply to DeepSeek "
+                "(it uses yarn+mscale, unimplemented)")
         return _config_from_deepseek(hf_cfg, page_size, dtype)
     if getattr(hf_cfg, "mlp_bias", False):
         raise NotImplementedError(
@@ -130,6 +145,7 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
         sliding_window=window,
         swa_layers=swa,
         qk_norm=hf_cfg.model_type == "qwen3",
+        rope_scaling=rope_scaling,
         **moe_kw,
     )
 
@@ -138,15 +154,13 @@ def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any
                           ) -> LlamaConfig:
     """DeepSeek-V2/V3 → absorbed-MLA config.
 
-    Supported subset: no q-LoRA (V2-lite-style full q projection), dense
-    MLP layers only (``num_hidden_layers <= first_k_dense_replace``),
-    ``v_head_dim == qk_nope_head_dim`` (the shared head_dim here). The
-    parity test pins our *absorbed* attention against HF's materialized
-    MLA — a cross-implementation check of the absorption algebra.
+    Supported subset: dense MLP layers only (``num_hidden_layers <=
+    first_k_dense_replace``) and ``v_head_dim == qk_nope_head_dim`` (the
+    shared head_dim here); q-LoRA (the full V2/V3 form) and the direct q
+    projection (V2-lite) both convert. The parity test pins our
+    *absorbed* attention against HF's materialized MLA — a
+    cross-implementation check of the absorption algebra.
     """
-    if getattr(hf_cfg, "q_lora_rank", None):
-        raise NotImplementedError(
-            "q_lora_rank (compressed q projection) is not implemented")
     if hf_cfg.v_head_dim != hf_cfg.qk_nope_head_dim:
         raise NotImplementedError(
             f"v_head_dim {hf_cfg.v_head_dim} != qk_nope_head_dim "
@@ -242,13 +256,20 @@ def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig,
             layer["w_up"] = proj(p + "mlp.up_proj.weight")
             layer["w_down"] = proj(p + "mlp.down_proj.weight")
         if cfg.is_mla:
-            # DeepSeek: full q projection (q-LoRA refused in config),
-            # fused latent down-projection, RMS-normed latent, fused
-            # k_nope/v up-projections split into the absorbed form.
+            # DeepSeek: q either direct (V2-lite) or via the q-LoRA
+            # compressed latent; fused latent down-projection, RMS-normed
+            # latent, fused k_nope/v up-projections split into the
+            # absorbed form.
             r, dr, hd = (cfg.kv_lora_rank, cfg.qk_rope_head_dim,
                          cfg.head_dim)
             H = cfg.num_heads
-            wq = get(p + "self_attn.q_proj.weight").T  # [h, H*(hd+dr)]
+            if p + "self_attn.q_a_proj.weight" in state_dict:  # q-LoRA
+                layer["w_dq"] = proj(p + "self_attn.q_a_proj.weight")
+                layer["q_latent_norm"] = norm(
+                    p + "self_attn.q_a_layernorm.weight")
+                wq = get(p + "self_attn.q_b_proj.weight").T
+            else:
+                wq = get(p + "self_attn.q_proj.weight").T  # [h|q_lora, H*(hd+dr)]
             wq = wq.reshape(wq.shape[0], H, hd + dr)
             if mla_rope_interleaved:
                 wq = _deinterleave(wq, dr)

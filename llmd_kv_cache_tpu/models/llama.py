@@ -80,6 +80,11 @@ class LlamaConfig:
     # attention math is invariant to it up to fp rounding of the scale
     # factor (zero key dims score zero, value reads slice [:rank]).
     latent_pad: int = 0
+    # RoPE scaling: () = plain RoPE, or ("llama3", factor,
+    # low_freq_factor, high_freq_factor, original_max_position_embeddings)
+    # — Llama-3.1's frequency-band NTK scheme (see _rope). A tuple so the
+    # frozen config stays hashable for jit static args.
+    rope_scaling: tuple = ()
     # Attention sinks (StreamingLLM): with a sliding window, the first
     # ``attention_sinks`` positions stay attendable past the window — the
     # reference's ``sink_full_attention`` spec kind (events.go:40).
@@ -104,6 +109,12 @@ class LlamaConfig:
                     "cannot set sliding_window/swa_layers")
             if self.qk_norm:
                 raise ValueError("qk_norm is not defined for MLA configs")
+        if self.rope_scaling:
+            if self.rope_scaling[0] != "llama3" or len(self.rope_scaling) != 5:
+                raise ValueError(
+                    "rope_scaling must be ('llama3', factor, low_freq_factor,"
+                    " high_freq_factor, original_max_position_embeddings); "
+                    f"got {self.rope_scaling!r}")
         if self.latent_pad:
             if not self.is_mla:
                 raise ValueError("latent_pad only applies to MLA configs")
@@ -453,11 +464,29 @@ def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
     return (gate * up).astype(mlp_in.dtype) @ layer["w_down"]
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding. x: [b, s, heads, hd], positions: [b, s]."""
+def _rope(x: jax.Array, positions: jax.Array, theta: float,
+          scaling: tuple = ()) -> jax.Array:
+    """Rotary position embedding. x: [b, s, heads, hd], positions: [b, s].
+
+    ``scaling`` is ``LlamaConfig.rope_scaling``: ``()`` for plain RoPE or
+    ``("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)`` — the Llama-3.1 frequency-band
+    NTK scheme (long wavelengths divided by ``factor``, short kept,
+    smooth ramp between; matches transformers' ``_compute_llama3_...``).
+    """
     hd = x.shape[-1]
     half = hd // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling:
+        kind, factor, low_f, high_f, orig = scaling
+        assert kind == "llama3", kind  # validated at config construction
+        wavelen = 2.0 * math.pi / freqs
+        low_wl = orig / low_f       # wavelengths above this: fully scaled
+        high_wl = orig / high_f     # wavelengths below this: unscaled
+        smooth = (orig / wavelen - low_f) / (high_f - low_f)
+        mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = jnp.where(wavelen > low_wl, freqs / factor,
+                          jnp.where(wavelen < high_wl, freqs, mid))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -510,10 +539,19 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             # with head_dim = rank+rope over the cache this file already
             # pages, and HBM traffic per token drops by ~num_heads·2.
             r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
-            q = (attn_in @ layer["wq"]).reshape(
+            if "w_dq" in layer:
+                # DeepSeek q-LoRA: q is down-projected to a compressed
+                # latent, RMS-normed, then up-projected per head — the
+                # norm between the two matmuls prevents precomposition.
+                q_in = _rms_norm(attn_in @ layer["w_dq"],
+                                 layer["q_latent_norm"], cfg.norm_eps)
+            else:
+                q_in = attn_in
+            q = (q_in @ layer["wq"]).reshape(
                 batch, seq, cfg.num_heads, cfg.head_dim + dr)
             q_nope, q_rope = q[..., :cfg.head_dim], q[..., cfg.head_dim:]
-            q_rope = _rope(q_rope, positions, cfg.rope_theta)
+            q_rope = _rope(q_rope, positions, cfg.rope_theta,
+                           cfg.rope_scaling)
             c_kv = attn_in @ layer["w_dkv"]  # [b, s, r]
             if "latent_norm" in layer:
                 # DeepSeek kv_a_layernorm: the latent is RMS-normed before
@@ -521,7 +559,8 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                 # unchanged (w_uk applies to the normed latent).
                 c_kv = _rms_norm(c_kv, layer["latent_norm"], cfg.norm_eps)
             k_rope = _rope((attn_in @ layer["w_kr"])[:, :, None, :],
-                           positions, cfg.rope_theta)  # [b, s, 1, dr]
+                           positions, cfg.rope_theta,
+                           cfg.rope_scaling)  # [b, s, 1, dr]
             latent = jnp.concatenate(
                 [c_kv[:, :, None, :], k_rope], axis=-1)  # [b, s, 1, r+dr]
             # Absorb W_UK: q·(latent@W_UK) == (q@W_UK^T)·latent.
@@ -566,8 +605,8 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
                 q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
                 k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
+            q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+            k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
             k_caches[g] = k_caches[g].at[lj].set(
                 scatter_kv_pages(k_caches[g][lj], k, table, positions, valid)

@@ -46,7 +46,7 @@ def make_mesh(
 def param_pspecs(has_tp: bool = True, has_ep: bool = False,
                  moe_layer: bool = False, qk_norm: bool = False,
                  mla_layer: bool = False, qkv_bias: bool = False,
-                 latent_norm: bool = False) -> dict:
+                 latent_norm: bool = False, q_lora: bool = False) -> dict:
     """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
@@ -82,6 +82,8 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
         })
         if latent_norm:  # DeepSeek kv_a_layernorm: replicated like w_dkv
             layer["latent_norm"] = P()
+        if q_lora:  # DeepSeek q-LoRA: compressed-q path, replicated
+            layer.update({"w_dq": P(), "q_latent_norm": P()})
     else:
         layer.update({"wk": P(None, tp), "wv": P(None, tp)})
         if qkv_bias:  # column-parallel bias shards with its output dim
@@ -124,9 +126,11 @@ def param_shardings(mesh: Mesh, params: Params) -> dict:
     mla = "w_uk" in params["layers"][0]
     bias = "bq" in params["layers"][0]
     lat_norm = "latent_norm" in params["layers"][0]
+    q_lora = "w_dq" in params["layers"][0]
     specs = _tree_with_layers(
         param_pspecs(has_tp, has_ep, moe_layer=moe, qk_norm=qk,
-                     mla_layer=mla, qkv_bias=bias, latent_norm=lat_norm),
+                     mla_layer=mla, qkv_bias=bias, latent_norm=lat_norm,
+                     q_lora=q_lora),
         len(params["layers"])
     )
     return jax.tree.map(

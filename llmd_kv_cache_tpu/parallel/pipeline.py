@@ -190,8 +190,8 @@ def _tp_layer_step(x: jax.Array, layer: dict, cfg: LlamaConfig,
     if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
         q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
         k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     if cfg.num_heads != cfg.num_kv_heads:
         rep = cfg.num_heads // cfg.num_kv_heads  # per-shard ratio unchanged
         k = jnp.repeat(k, rep, axis=2)

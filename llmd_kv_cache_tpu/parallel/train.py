@@ -75,8 +75,8 @@ def attention_block(x, layer, cfg, positions, attention_fn=None):
     if cfg.qk_norm:  # Qwen3: per-head RMS over head_dim, pre-RoPE
         q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
         k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     if cfg.num_heads != cfg.num_kv_heads:
         rep = cfg.num_heads // cfg.num_kv_heads
         k = jnp.repeat(k, rep, axis=2)

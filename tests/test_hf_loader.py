@@ -156,6 +156,38 @@ def test_qwen2_partial_window_layer_types():
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_llama3_rope_scaling_matches_transformers():
+    """Llama-3.1's frequency-band NTK rope scaling: our per-band freq
+    transform must reproduce HF's logits at positions deep enough that
+    the scaled bands actually matter (orig_max=32 with a 48-token
+    prompt crosses it)."""
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(12)
+    hf_cfg = HFLlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-5, rope_theta=10000.0,
+        max_position_embeddings=256, attention_bias=False, mlp_bias=False,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    model = LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.rope_scaling[0] == "llama3"
+    params = params_from_hf(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(1, 250, 48).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 def test_unsupported_features_raise():
     """rope_scaling / projection biases / MoE must refuse loudly instead
     of converting to silently-wrong logits."""
@@ -166,9 +198,7 @@ def test_unsupported_features_raise():
                 num_key_value_heads=2)
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(HFLlamaConfig(
-            **base, rope_scaling={"rope_type": "llama3", "factor": 8.0,
-                                  "low_freq_factor": 1.0,
-                                  "high_freq_factor": 4.0,
+            **base, rope_scaling={"rope_type": "yarn", "factor": 4.0,
                                   "original_max_position_embeddings": 8192}))
     with pytest.raises(NotImplementedError, match="bias"):
         config_from_hf(HFLlamaConfig(**base, mlp_bias=True))
@@ -222,6 +252,35 @@ def test_qwen2_tp_serve_with_biases():
 
     ref = serve(None)
     assert serve(make_mesh({"tp": 2}, jax.devices()[:2])) == ref
+
+
+def test_deepseek_q_lora_matches_transformers():
+    """The full V2/V3 form: q down-projected to a compressed latent,
+    RMS-normed, up-projected per head — parity through the q-LoRA path."""
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    torch.manual_seed(14)
+    hf_cfg = DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=24, kv_lora_rank=16,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0, first_k_dense_replace=2,
+        tie_word_embeddings=False)
+    model = DeepseekV3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    params = params_from_hf(
+        model.state_dict(), cfg,
+        mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
+    assert "w_dq" in params["layers"][0]
+
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(1, 250, 18).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
 @pytest.mark.parametrize("which", ["v2", "v3"])
