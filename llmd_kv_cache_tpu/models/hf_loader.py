@@ -26,6 +26,8 @@ no loader; this is part of the in-tree serving engine
 
 from __future__ import annotations
 
+import math
+
 from typing import Any, Mapping
 
 import jax.numpy as jnp
@@ -53,6 +55,29 @@ def _convert_rope_scaling(hf_cfg: Any) -> tuple:
                 float(rope_scaling["low_freq_factor"]),
                 float(rope_scaling["high_freq_factor"]),
                 float(rope_scaling["original_max_position_embeddings"]))
+    if kind == "yarn":
+        if not rope_scaling.get("truncate", True):
+            raise NotImplementedError(
+                "yarn with truncate=false (untruncated correction bounds) "
+                "is not implemented")
+        factor = float(rope_scaling["factor"])
+        att = rope_scaling.get("attention_factor")
+        mscale = rope_scaling.get("mscale")
+        mscale_all = rope_scaling.get("mscale_all_dim")
+        if att is None:
+            def _get_mscale(scale, m=1.0):
+                return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+            if mscale and mscale_all:
+                att = _get_mscale(factor, mscale) / _get_mscale(
+                    factor, mscale_all)
+            else:
+                att = _get_mscale(factor)
+        orig = (rope_scaling.get("original_max_position_embeddings")
+                or hf_cfg.max_position_embeddings)
+        return ("yarn", factor,
+                float(rope_scaling.get("beta_fast") or 32),
+                float(rope_scaling.get("beta_slow") or 1),
+                float(orig), float(att))
     raise NotImplementedError(
         f"rope_scaling={rope_scaling!r} is not implemented")
 
@@ -87,9 +112,11 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
     rope_scaling = _convert_rope_scaling(hf_cfg)
     if hf_cfg.model_type.startswith("deepseek"):
         if rope_scaling:
+            # DeepSeek's yarn couples mscale into the softmax scale, not
+            # just cos/sin — unimplemented; refuse rather than drift.
             raise NotImplementedError(
-                "llama3 rope scaling does not apply to DeepSeek "
-                "(it uses yarn+mscale, unimplemented)")
+                "rope scaling for DeepSeek (yarn+mscale softmax coupling) "
+                "is not implemented")
         return _config_from_deepseek(hf_cfg, page_size, dtype)
     if getattr(hf_cfg, "mlp_bias", False):
         raise NotImplementedError(

@@ -80,10 +80,12 @@ class LlamaConfig:
     # attention math is invariant to it up to fp rounding of the scale
     # factor (zero key dims score zero, value reads slice [:rank]).
     latent_pad: int = 0
-    # RoPE scaling: () = plain RoPE, or ("llama3", factor,
-    # low_freq_factor, high_freq_factor, original_max_position_embeddings)
-    # — Llama-3.1's frequency-band NTK scheme (see _rope). A tuple so the
-    # frozen config stays hashable for jit static args.
+    # RoPE scaling: () = plain RoPE; ("llama3", factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings) — Llama-3.1's
+    # frequency-band NTK scheme; or ("yarn", factor, beta_fast, beta_slow,
+    # original_max, attention_factor) — NTK-by-parts with cos/sin scaling
+    # (see _rope). Tuples so the frozen config stays hashable for jit
+    # static args.
     rope_scaling: tuple = ()
     # Attention sinks (StreamingLLM): with a sliding window, the first
     # ``attention_sinks`` positions stay attendable past the window — the
@@ -110,10 +112,15 @@ class LlamaConfig:
             if self.qk_norm:
                 raise ValueError("qk_norm is not defined for MLA configs")
         if self.rope_scaling:
-            if self.rope_scaling[0] != "llama3" or len(self.rope_scaling) != 5:
+            ok = (self.rope_scaling[0] == "llama3"
+                  and len(self.rope_scaling) == 5) or (
+                 self.rope_scaling[0] == "yarn"
+                 and len(self.rope_scaling) == 6)
+            if not ok:
                 raise ValueError(
                     "rope_scaling must be ('llama3', factor, low_freq_factor,"
-                    " high_freq_factor, original_max_position_embeddings); "
+                    " high_freq_factor, original_max) or ('yarn', factor, "
+                    "beta_fast, beta_slow, original_max, attention_factor); "
                     f"got {self.rope_scaling!r}")
         if self.latent_pad:
             if not self.is_mla:
@@ -468,18 +475,20 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float,
           scaling: tuple = ()) -> jax.Array:
     """Rotary position embedding. x: [b, s, heads, hd], positions: [b, s].
 
-    ``scaling`` is ``LlamaConfig.rope_scaling``: ``()`` for plain RoPE or
+    ``scaling`` is ``LlamaConfig.rope_scaling``: ``()`` for plain RoPE,
     ``("llama3", factor, low_freq_factor, high_freq_factor,
     original_max_position_embeddings)`` — the Llama-3.1 frequency-band
     NTK scheme (long wavelengths divided by ``factor``, short kept,
-    smooth ramp between; matches transformers' ``_compute_llama3_...``).
+    smooth ramp between) — or ``("yarn", factor, beta_fast, beta_slow,
+    original_max, attention_factor)``; both match transformers'
+    ``modeling_rope_utils`` formulas.
     """
     hd = x.shape[-1]
     half = hd // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    if scaling:
-        kind, factor, low_f, high_f, orig = scaling
-        assert kind == "llama3", kind  # validated at config construction
+    att = 1.0
+    if scaling and scaling[0] == "llama3":
+        _, factor, low_f, high_f, orig = scaling
         wavelen = 2.0 * math.pi / freqs
         low_wl = orig / low_f       # wavelengths above this: fully scaled
         high_wl = orig / high_f     # wavelengths below this: unscaled
@@ -487,9 +496,28 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float,
         mid = (1.0 - smooth) * freqs / factor + smooth * freqs
         freqs = jnp.where(wavelen > low_wl, freqs / factor,
                           jnp.where(wavelen < high_wl, freqs, mid))
+    elif scaling:
+        # yarn (NTK-by-parts, paper 2309.00071; matches transformers'
+        # _compute_yarn_parameters with truncate=True): dims below the
+        # beta_fast correction bound extrapolate (unscaled), above the
+        # beta_slow bound interpolate (freq/factor), linear ramp between;
+        # cos/sin are scaled by the pre-resolved attention factor.
+        _, factor, beta_fast, beta_slow, orig, att = scaling
+
+        def corr_dim(n_rot):  # full-dim index for a rotation count
+            return (hd * math.log(orig / (n_rot * 2.0 * math.pi))
+                    ) / (2.0 * math.log(theta))
+
+        low = max(math.floor(corr_dim(beta_fast)), 0)
+        high = min(math.ceil(corr_dim(beta_slow)), hd - 1)
+        ramp = jnp.clip(
+            (jnp.arange(half, dtype=jnp.float32) - low)
+            / max(high - low, 0.001), 0.0, 1.0)
+        extrap = 1.0 - ramp
+        freqs = (freqs / factor) * (1.0 - extrap) + freqs * extrap
     angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, half]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :] * att
+    sin = jnp.sin(angles)[:, :, None, :] * att
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1

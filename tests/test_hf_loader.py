@@ -188,6 +188,34 @@ def test_llama3_rope_scaling_matches_transformers():
     np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
+def test_yarn_rope_scaling_matches_transformers():
+    """Yarn (NTK-by-parts) scaling with an inferred attention factor:
+    frequency blend + cos/sin scaling must match HF at positions past
+    the original max."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(15)
+    hf_cfg = Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-5, rope_theta=10000.0, use_sliding_window=False,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.rope_scaling[0] == "yarn" and cfg.rope_scaling[5] > 1.0
+    params = params_from_hf(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(15)
+    tokens = rng.integers(1, 250, 48).tolist()  # crosses orig_max=32
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 def test_unsupported_features_raise():
     """rope_scaling / projection biases / MoE must refuse loudly instead
     of converting to silently-wrong logits."""
@@ -198,8 +226,7 @@ def test_unsupported_features_raise():
                 num_key_value_heads=2)
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(HFLlamaConfig(
-            **base, rope_scaling={"rope_type": "yarn", "factor": 4.0,
-                                  "original_max_position_embeddings": 8192}))
+            **base, rope_scaling={"rope_type": "linear", "factor": 2.0}))
     with pytest.raises(NotImplementedError, match="bias"):
         config_from_hf(HFLlamaConfig(**base, mlp_bias=True))
     with pytest.raises(NotImplementedError, match="model_type"):
