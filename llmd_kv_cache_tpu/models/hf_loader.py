@@ -36,6 +36,11 @@ import numpy as np
 from .llama import LlamaConfig, Params
 
 
+def _yarn_get_mscale(scale: float, m: float = 1.0) -> float:
+    """transformers' yarn_get_mscale: 0.1·m·ln(scale)+1 (1.0 for ≤1)."""
+    return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+
 def _convert_rope_scaling(hf_cfg: Any) -> tuple:
     """Map HF ``rope_scaling`` to ``LlamaConfig.rope_scaling``.
 
@@ -65,13 +70,11 @@ def _convert_rope_scaling(hf_cfg: Any) -> tuple:
         mscale = rope_scaling.get("mscale")
         mscale_all = rope_scaling.get("mscale_all_dim")
         if att is None:
-            def _get_mscale(scale, m=1.0):
-                return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
             if mscale and mscale_all:
-                att = _get_mscale(factor, mscale) / _get_mscale(
+                att = _yarn_get_mscale(factor, mscale) / _yarn_get_mscale(
                     factor, mscale_all)
             else:
-                att = _get_mscale(factor)
+                att = _yarn_get_mscale(factor)
         orig = (rope_scaling.get("original_max_position_embeddings")
                 or hf_cfg.max_position_embeddings)
         return ("yarn", factor,
@@ -111,13 +114,8 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
             f"silently wrong")
     rope_scaling = _convert_rope_scaling(hf_cfg)
     if hf_cfg.model_type.startswith("deepseek"):
-        if rope_scaling:
-            # DeepSeek's yarn couples mscale into the softmax scale, not
-            # just cos/sin — unimplemented; refuse rather than drift.
-            raise NotImplementedError(
-                "rope scaling for DeepSeek (yarn+mscale softmax coupling) "
-                "is not implemented")
-        return _config_from_deepseek(hf_cfg, page_size, dtype)
+        return _config_from_deepseek(hf_cfg, page_size, dtype,
+                                     rope_scaling)
     if getattr(hf_cfg, "mlp_bias", False):
         raise NotImplementedError(
             "MLP biases are not implemented; a bias-free conversion "
@@ -177,8 +175,8 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
     )
 
 
-def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any
-                          ) -> LlamaConfig:
+def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any,
+                          rope_scaling: tuple = ()) -> LlamaConfig:
     """DeepSeek-V2/V3 → absorbed-MLA config.
 
     Supported subset: dense MLP layers only (``num_hidden_layers <=
@@ -198,6 +196,19 @@ def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any
         raise NotImplementedError(
             "DeepSeek MoE layers are not implemented (dense layers only: "
             "num_hidden_layers <= first_k_dense_replace)")
+    # DeepSeek yarn: the generic cos/sin attention factor applies via
+    # rope_scaling; for deepseek_v3 ONLY, mscale_all_dim ADDITIONALLY
+    # multiplies the softmax scale by mscale^2 (in-tree
+    # DeepseekV3Attention.__init__ — DeepseekV2Attention has no such
+    # term, verified against transformers 4.57; the V2 parity test pins
+    # it).
+    scale_mult = 1.0
+    hf_rs = getattr(hf_cfg, "rope_scaling", None)
+    if (rope_scaling and hf_cfg.model_type == "deepseek_v3"
+            and hf_rs and hf_rs.get("mscale_all_dim")):
+        m = _yarn_get_mscale(float(hf_rs["factor"]),
+                             float(hf_rs["mscale_all_dim"]))
+        scale_mult = m * m
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         hidden_size=hf_cfg.hidden_size,
@@ -212,6 +223,8 @@ def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any
         dtype=dtype,
         kv_lora_rank=hf_cfg.kv_lora_rank,
         qk_rope_head_dim=hf_cfg.qk_rope_head_dim,
+        rope_scaling=rope_scaling,
+        softmax_scale_mult=scale_mult,
     )
 
 

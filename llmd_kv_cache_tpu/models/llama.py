@@ -87,6 +87,11 @@ class LlamaConfig:
     # (see _rope). Tuples so the frozen config stays hashable for jit
     # static args.
     rope_scaling: tuple = ()
+    # DeepSeek yarn couples mscale into the ATTENTION SCALE (in-tree
+    # transformers: scaling = qk_head_dim^-0.5 * mscale(factor,
+    # mscale_all_dim)^2) on top of the generic cos/sin factor; this
+    # multiplier carries that term. 1.0 everywhere else.
+    softmax_scale_mult: float = 1.0
     # Attention sinks (StreamingLLM): with a sliding window, the first
     # ``attention_sinks`` positions stay attendable past the window — the
     # reference's ``sink_full_attention`` spec kind (events.go:40).
@@ -122,6 +127,9 @@ class LlamaConfig:
                     " high_freq_factor, original_max) or ('yarn', factor, "
                     "beta_fast, beta_slow, original_max, attention_factor); "
                     f"got {self.rope_scaling!r}")
+        if self.softmax_scale_mult != 1.0 and not self.is_mla:
+            raise ValueError(
+                "softmax_scale_mult is a DeepSeek-yarn (MLA) knob")
         if self.latent_pad:
             if not self.is_mla:
                 raise ValueError("latent_pad only applies to MLA configs")
@@ -604,9 +612,10 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                 q_eff = jnp.pad(q_eff, pad)
             # The attention backends scale by q.shape[-1]^-0.5 (the padded
             # cache width); MLA's logical scale is the per-head q/k width
-            # (nope+rope).
+            # (nope+rope), times the DeepSeek-yarn mscale^2 when set.
             q_eff = q_eff * (
-                q_eff.shape[-1] ** 0.5 / (cfg.head_dim + dr) ** 0.5)
+                q_eff.shape[-1] ** 0.5 / (cfg.head_dim + dr) ** 0.5
+                * cfg.softmax_scale_mult)
 
             k_caches[g] = k_caches[g].at[lj].set(
                 scatter_kv_pages(k_caches[g][lj], latent, table, positions,

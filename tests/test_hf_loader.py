@@ -310,6 +310,74 @@ def test_deepseek_q_lora_matches_transformers():
     np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
+def test_deepseek_v2_yarn_matches_transformers():
+    """In-tree DeepseekV2Attention applies NO mscale^2 softmax term
+    (unlike V3) — a V2+yarn conversion must set softmax_scale_mult=1 and
+    still match HF logits."""
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    torch.manual_seed(18)
+    hf_cfg = DeepseekV2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=None, kv_lora_rank=16,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0, first_k_dense_replace=2,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "mscale": 0.707, "mscale_all_dim": 0.707,
+                      "original_max_position_embeddings": 32})
+    model = DeepseekV2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.softmax_scale_mult == 1.0
+    params = params_from_hf(
+        model.state_dict(), cfg,
+        mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
+
+    rng = np.random.default_rng(18)
+    tokens = rng.integers(1, 250, 44).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_deepseek_yarn_matches_transformers():
+    """DeepSeek's yarn: generic NTK-by-parts on the decoupled rope dims
+    PLUS mscale^2 folded into the softmax scale (mscale_all_dim) — both
+    must match the in-tree DeepseekV3Attention at positions past the
+    original max."""
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    torch.manual_seed(16)
+    hf_cfg = DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=24, kv_lora_rank=16,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0, first_k_dense_replace=2,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0, "mscale": 1.0,
+                      "mscale_all_dim": 1.0, "beta_fast": 32,
+                      "beta_slow": 1,
+                      "original_max_position_embeddings": 32})
+    model = DeepseekV3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.rope_scaling[0] == "yarn" and cfg.softmax_scale_mult > 1.0
+    params = params_from_hf(
+        model.state_dict(), cfg,
+        mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
+
+    rng = np.random.default_rng(16)
+    tokens = rng.integers(1, 250, 48).tolist()  # crosses orig_max=32
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 @pytest.mark.parametrize("which", ["v2", "v3"])
 def test_deepseek_mla_matches_transformers(which):
     """The STRONG MLA oracle: our absorbed attention (latent-only cache,
