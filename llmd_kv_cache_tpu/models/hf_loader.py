@@ -191,11 +191,29 @@ def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any,
             f"v_head_dim {hf_cfg.v_head_dim} != qk_nope_head_dim "
             f"{hf_cfg.qk_nope_head_dim}: this model shares one head_dim")
     n_layers = hf_cfg.num_hidden_layers
-    if getattr(hf_cfg, "n_routed_experts", None) and n_layers > getattr(
-            hf_cfg, "first_k_dense_replace", 0):
-        raise NotImplementedError(
-            "DeepSeek MoE layers are not implemented (dense layers only: "
-            "num_hidden_layers <= first_k_dense_replace)")
+    moe_kw = {}
+    first_dense = getattr(hf_cfg, "first_k_dense_replace", 0)
+    if getattr(hf_cfg, "n_routed_experts", None) and n_layers > first_dense:
+        if hf_cfg.model_type != "deepseek_v3":
+            raise NotImplementedError(
+                "MoE conversion is implemented for deepseek_v3 only "
+                "(V2's softmax/greedy router differs)")
+        if getattr(hf_cfg, "topk_method", "noaux_tc") not in (
+                "noaux_tc", None):
+            raise NotImplementedError(
+                f"topk_method {hf_cfg.topk_method!r} unsupported")
+        moe_kw = dict(
+            num_experts=hf_cfg.n_routed_experts,
+            num_experts_per_token=hf_cfg.num_experts_per_tok,
+            moe_layers=tuple(range(first_dense, n_layers)),
+            n_shared_experts=hf_cfg.n_shared_experts,
+            moe_intermediate_size=hf_cfg.moe_intermediate_size,
+            moe_router=("deepseek_v3", hf_cfg.n_group,
+                        hf_cfg.topk_group,
+                        int(bool(hf_cfg.norm_topk_prob)),
+                        float(hf_cfg.routed_scaling_factor)),
+            moe_dispatch="dense",
+        )
     # DeepSeek yarn: the generic cos/sin attention factor applies via
     # rope_scaling; for deepseek_v3 ONLY, mscale_all_dim ADDITIONALLY
     # multiplies the softmax scale by mscale^2 (in-tree
@@ -225,6 +243,7 @@ def _config_from_deepseek(hf_cfg: Any, page_size: int, dtype: Any,
         qk_rope_head_dim=hf_cfg.qk_rope_head_dim,
         rope_scaling=rope_scaling,
         softmax_scale_mult=scale_mult,
+        **moe_kw,
     )
 
 
@@ -279,7 +298,24 @@ def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig,
             "mlp_norm": norm(p + "post_attention_layernorm.weight"),
             "wo": proj(p + "self_attn.o_proj.weight"),
         }
-        if cfg.num_experts > 0:  # Mixtral block-sparse MoE
+        if p + "mlp.gate.weight" in state_dict:
+            # DeepSeek MoE layer: sigmoid router (+ e_score_correction
+            # bias buffer), routed experts, always-on shared expert.
+            E = cfg.num_experts
+            layer["router"] = proj(p + "mlp.gate.weight")
+            layer["router_bias"] = norm(
+                p + "mlp.gate.e_score_correction_bias")
+            for ours, theirs in (("w_gate", "gate_proj"),
+                                 ("w_up", "up_proj"),
+                                 ("w_down", "down_proj")):
+                layer[ours] = jnp.stack([
+                    proj(p + f"mlp.experts.{e}.{theirs}.weight")
+                    for e in range(E)])
+            for ours, theirs in (("w_gate_sh", "gate_proj"),
+                                 ("w_up_sh", "up_proj"),
+                                 ("w_down_sh", "down_proj")):
+                layer[ours] = proj(p + f"mlp.shared_experts.{theirs}.weight")
+        elif cfg.num_experts > 0 and not cfg.is_mla:  # Mixtral
             E = cfg.num_experts
             layer["router"] = proj(p + "block_sparse_moe.gate.weight")
             for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"),

@@ -60,6 +60,20 @@ class LlamaConfig:
     # reference formulation and for tiny models).
     moe_dispatch: str = "capacity"
     moe_capacity_factor: float = 2.0
+    # DeepSeek-style MoE extensions (all () /0 for the classic Mixtral
+    # family): ``moe_layers`` lists the MoE layer indices (empty = every
+    # layer when num_experts > 0 — dense-first_k layouts list the rest);
+    # ``n_shared_experts``/``moe_intermediate_size`` size the always-on
+    # shared expert and the routed experts' inner dim; ``moe_router`` =
+    # ("deepseek_v3", n_group, topk_group, norm_topk_prob, 
+    # routed_scaling_factor) selects the sigmoid scoring +
+    # bias-corrected group-limited top-k router (weights from unbiased
+    # sigmoid scores; the e_score_correction bias is a parameter,
+    # ``router_bias``).
+    moe_layers: tuple = ()
+    n_shared_experts: int = 0
+    moe_intermediate_size: int = 0
+    moe_router: tuple = ()
     # Multi-head latent attention (DeepSeek-V2/V3): KV is cached as one
     # per-token latent of ``kv_lora_rank`` dims plus a decoupled-RoPE key
     # of ``qk_rope_head_dim`` dims SHARED across heads — ~an order of
@@ -116,6 +130,27 @@ class LlamaConfig:
                     "cannot set sliding_window/swa_layers")
             if self.qk_norm:
                 raise ValueError("qk_norm is not defined for MLA configs")
+        if self.moe_router:
+            if (self.moe_router[0] != "deepseek_v3"
+                    or len(self.moe_router) != 5):
+                raise ValueError(
+                    "moe_router must be ('deepseek_v3', n_group, "
+                    f"topk_group, norm_topk_prob, factor); got "
+                    f"{self.moe_router!r}")
+            if self.moe_dispatch != "dense":
+                raise ValueError(
+                    "the deepseek_v3 router is implemented for the exact "
+                    "'dense' dispatch only")
+            n_group = self.moe_router[1]
+            if n_group < 1 or self.num_experts % n_group != 0:
+                raise ValueError("num_experts must divide by n_group >= 1")
+            if self.num_experts // n_group < 2:
+                raise ValueError(
+                    "deepseek_v3 group scoring sums each group's top-2 "
+                    "corrected scores: groups need >= 2 experts")
+        if self.moe_layers and not all(
+                0 <= i < self.num_layers for i in self.moe_layers):
+            raise ValueError("moe_layers indices out of range")
         if self.rope_scaling:
             ok = (self.rope_scaling[0] == "llama3"
                   and len(self.rope_scaling) == 5) or (
@@ -302,14 +337,26 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
         if cfg.qk_norm:
             layer["q_norm"] = jnp.ones((hd,), jnp.float32)
             layer["k_norm"] = jnp.ones((hd,), jnp.float32)
-        if cfg.num_experts > 0:
-            e, inter = cfg.num_experts, cfg.intermediate_size
+        is_moe_layer = cfg.num_experts > 0 and (
+            not cfg.moe_layers or i in cfg.moe_layers)
+        if is_moe_layer:
+            e = cfg.num_experts
+            inter = cfg.moe_intermediate_size or cfg.intermediate_size
             layer.update({
                 "router": dense(lk[7], (h, e)),
                 "w_gate": dense(lk[4], (e, h, inter)),
                 "w_up": dense(lk[5], (e, h, inter)),
                 "w_down": dense(lk[6], (e, inter, h)),
             })
+            if cfg.moe_router:  # deepseek_v3: bias + shared expert
+                sh = inter * max(cfg.n_shared_experts, 1)
+                skeys = jax.random.split(lk[7], 4)
+                layer.update({
+                    "router_bias": jnp.zeros((e,), jnp.float32),
+                    "w_gate_sh": dense(skeys[1], (h, sh)),
+                    "w_up_sh": dense(skeys[2], (h, sh)),
+                    "w_down_sh": dense(skeys[3], (sh, h)),
+                })
         else:
             layer.update({
                 "w_gate": dense(lk[4], (h, cfg.intermediate_size)),
@@ -458,16 +505,66 @@ def _moe_capacity(mlp_in, layer, cfg, aux_out, valid=None):
     return y.reshape(batch, seq, hidden).astype(mlp_in.dtype)
 
 
+def _moe_deepseek(mlp_in, layer, cfg):
+    """DeepSeek-V3 MoE, exact dense form (DeepseekV3TopkRouter +
+    DeepseekV3MoE semantics): sigmoid scores; top-k SELECTION uses
+    bias-corrected scores restricted to the best ``topk_group`` of
+    ``n_group`` expert groups (group score = sum of its top-2 corrected
+    scores); mix WEIGHTS are the unbiased sigmoid scores of the chosen
+    experts, optionally renormalized, times the routed scaling factor;
+    a shared expert always adds in."""
+    _kind, n_group, topk_group, norm_flag, factor = cfg.moe_router
+    b, s, h = mlp_in.shape
+    e = layer["w_gate"].shape[0]
+    k = cfg.num_experts_per_token
+    x = mlp_in.reshape(b * s, h)
+
+    logits = x.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+    scores = jax.nn.sigmoid(logits)  # [T, E]
+    choice = scores + layer["router_bias"][None, :].astype(jnp.float32)
+    group_scores = jax.lax.top_k(
+        choice.reshape(-1, n_group, e // n_group), 2)[0].sum(-1)
+    _, gidx = jax.lax.top_k(group_scores, topk_group)  # [T, topk_group]
+    gmask = jnp.sum(jax.nn.one_hot(gidx, n_group), axis=1)  # [T, n_group]
+    smask = jnp.repeat(gmask, e // n_group, axis=-1)  # [T, E]
+    masked = jnp.where(smask > 0, choice, 0.0)
+    _, idx = jax.lax.top_k(masked, k)  # [T, k]
+    w = jnp.take_along_axis(scores, idx, axis=-1)
+    if norm_flag:
+        w = w / (jnp.sum(w, axis=-1, keepdims=True) + 1e-20)
+    w = w * factor
+    mix_w = jnp.einsum(
+        "tk,tke->te", w, jax.nn.one_hot(idx, e, dtype=jnp.float32))
+
+    gate = jax.nn.silu(jnp.einsum(
+        "th,ehi->tei", x, layer["w_gate"]).astype(jnp.float32))
+    up = jnp.einsum("th,ehi->tei", x, layer["w_up"]).astype(jnp.float32)
+    expert_out = jnp.einsum(
+        "tei,eih->teh", (gate * up).astype(x.dtype), layer["w_down"]
+    ).astype(jnp.float32)
+    out = jnp.einsum("te,teh->th", mix_w, expert_out).astype(mlp_in.dtype)
+
+    sh_gate = jax.nn.silu((x @ layer["w_gate_sh"]).astype(jnp.float32))
+    sh_up = (x @ layer["w_up_sh"]).astype(jnp.float32)
+    shared = (sh_gate * sh_up).astype(x.dtype) @ layer["w_down_sh"]
+    return (out + shared).reshape(b, s, h)
+
+
 def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
          aux_out: Any = None, valid: Any = None) -> jax.Array:
     """MLP block: dense SwiGLU or top-k MoE (capacity dispatch by default,
-    dense reference formulation via ``cfg.moe_dispatch="dense"``).
+    dense reference formulation via ``cfg.moe_dispatch="dense"``; the
+    deepseek_v3 router when ``cfg.moe_router`` selects it).
 
+    Dispatch is keyed on the LAYER's parameters (``router`` present →
+    MoE), so dense-first_k DeepSeek layouts mix layer kinds in one model.
     Expert matmuls stay in the model dtype (bf16 MXU path, like the dense
     branch); only router/softmax/mix math runs in f32. ``valid`` ([b, s]
     bool) excludes padded positions from capacity routing.
     """
-    if cfg.num_experts > 0:
+    if "router" in layer:
+        if cfg.moe_router:
+            return _moe_deepseek(mlp_in, layer, cfg)
         if cfg.moe_dispatch == "capacity":
             return _moe_capacity(mlp_in, layer, cfg, aux_out, valid=valid)
         if cfg.moe_dispatch == "dense":

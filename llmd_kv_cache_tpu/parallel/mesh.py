@@ -46,7 +46,9 @@ def make_mesh(
 def param_pspecs(has_tp: bool = True, has_ep: bool = False,
                  moe_layer: bool = False, qk_norm: bool = False,
                  mla_layer: bool = False, qkv_bias: bool = False,
-                 latent_norm: bool = False, q_lora: bool = False) -> dict:
+                 latent_norm: bool = False, q_lora: bool = False,
+                 shared_expert: bool = False,
+                 router_bias: bool = False) -> dict:
     """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
@@ -97,6 +99,14 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
             "w_up": P(ep, None, tp),
             "w_down": P(ep, tp, None),
         })
+        if router_bias:  # DeepSeek e_score_correction: replicated vector
+            layer["router_bias"] = P()
+        if shared_expert:  # always-on shared expert: dense Megatron layout
+            layer.update({
+                "w_gate_sh": P(None, tp),
+                "w_up_sh": P(None, tp),
+                "w_down_sh": P(tp, None),
+            })
     else:
         layer.update({
             "w_gate": P(None, tp),
@@ -111,28 +121,33 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
     }
 
 
-def _tree_with_layers(spec_tree: dict, num_layers: int) -> dict:
-    out = dict(spec_tree)
-    out["layers"] = [spec_tree["layers"]] * num_layers
-    return out
+def _layer_flags(layer: dict) -> dict:
+    """Derive the pspec-family flags from one layer's parameter keys —
+    per LAYER, because DeepSeek layouts mix dense and MoE layers in one
+    model (first_k_dense_replace)."""
+    return dict(
+        moe_layer="router" in layer,
+        qk_norm="q_norm" in layer,
+        mla_layer="w_uk" in layer,
+        qkv_bias="bq" in layer,
+        latent_norm="latent_norm" in layer,
+        q_lora="w_dq" in layer,
+        shared_expert="w_gate_sh" in layer,
+        router_bias="router_bias" in layer,
+    )
 
 
 def param_shardings(mesh: Mesh, params: Params) -> dict:
-    """NamedShardings matching the parameter tree structure."""
+    """NamedShardings matching the parameter tree structure (per-layer
+    spec derivation — layer kinds may differ within one model)."""
     has_tp = "tp" in mesh.axis_names
     has_ep = "ep" in mesh.axis_names
-    moe = "router" in params["layers"][0]
-    qk = "q_norm" in params["layers"][0]
-    mla = "w_uk" in params["layers"][0]
-    bias = "bq" in params["layers"][0]
-    lat_norm = "latent_norm" in params["layers"][0]
-    q_lora = "w_dq" in params["layers"][0]
-    specs = _tree_with_layers(
-        param_pspecs(has_tp, has_ep, moe_layer=moe, qk_norm=qk,
-                     mla_layer=mla, qkv_bias=bias, latent_norm=lat_norm,
-                     q_lora=q_lora),
-        len(params["layers"])
-    )
+    base = param_pspecs(has_tp, has_ep)
+    specs = dict(base)
+    specs["layers"] = [
+        param_pspecs(has_tp, has_ep, **_layer_flags(layer))["layers"]
+        for layer in params["layers"]
+    ]
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
         specs,

@@ -343,6 +343,48 @@ def test_deepseek_v2_yarn_matches_transformers():
     np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
+def test_deepseek_moe_matches_transformers():
+    """The full DeepSeek-V3 MoE: sigmoid scoring, e_score_correction-
+    biased group-limited top-k selection (weights from UNBIASED scores),
+    routed scaling, shared expert, and the dense-first_k mixed layout —
+    all against the in-tree DeepseekV3MoE, with a non-zero correction
+    bias so the biased-selection path demonstrably engages."""
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    torch.manual_seed(19)
+    hf_cfg = DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=None, kv_lora_rank=16,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        first_k_dense_replace=1, n_routed_experts=8,
+        num_experts_per_tok=2, n_group=4, topk_group=2,
+        norm_topk_prob=True, routed_scaling_factor=2.5,
+        n_shared_experts=1, moe_intermediate_size=32,
+        tie_word_embeddings=False)
+    model = DeepseekV3ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():  # engage the bias-corrected selection path
+        for li in (1, 2):
+            model.model.layers[li].mlp.gate.e_score_correction_bias.copy_(
+                torch.randn(8) * 0.5)
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.moe_layers == (1, 2) and cfg.moe_router[0] == "deepseek_v3"
+    params = params_from_hf(
+        model.state_dict(), cfg,
+        mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
+    assert "router" not in params["layers"][0]  # dense first layer
+    assert "w_gate_sh" in params["layers"][1]
+
+    rng = np.random.default_rng(19)
+    tokens = rng.integers(1, 250, 21).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 def test_deepseek_yarn_matches_transformers():
     """DeepSeek's yarn: generic NTK-by-parts on the decoupled rope dims
     PLUS mscale^2 folded into the softmax scale (mscale_all_dim) — both
