@@ -102,7 +102,7 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
     # Phi's partial rotary, …) must refuse rather than convert to
     # silently-wrong logits.
     supported = ("llama", "mistral", "mixtral", "qwen2", "qwen3",
-                 "deepseek_v2", "deepseek_v3")
+                 "qwen3_moe", "deepseek_v2", "deepseek_v3")
     if hf_cfg.model_type not in supported:
         raise NotImplementedError(
             f"model_type {hf_cfg.model_type!r} is not supported "
@@ -132,10 +132,29 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
             # (dataclasses.replace(moe_dispatch="capacity")).
             moe_dispatch="dense",
         )
+    elif hf_cfg.model_type == "qwen3_moe":
+        # HF layer rule: MoE unless listed in mlp_only_layers, gated by
+        # decoder_sparse_step (modeling_qwen3_moe decoder layer init).
+        step = getattr(hf_cfg, "decoder_sparse_step", 1) or 1
+        only = set(getattr(hf_cfg, "mlp_only_layers", ()) or ())
+        moe_layers = tuple(
+            i for i in range(n_layers)
+            if i not in only and (i + 1) % step == 0)
+        if moe_layers:
+            moe_kw = dict(
+                num_experts=hf_cfg.num_experts,
+                num_experts_per_token=hf_cfg.num_experts_per_tok,
+                moe_layers=moe_layers,
+                moe_intermediate_size=hf_cfg.moe_intermediate_size,
+                moe_router=("softmax_topk",
+                            int(bool(hf_cfg.norm_topk_prob))),
+                moe_dispatch="dense",
+            )
     elif getattr(hf_cfg, "num_experts", 0) or getattr(
             hf_cfg, "num_local_experts", 0):
         raise NotImplementedError(
-            "MoE checkpoint mapping is only implemented for mixtral")
+            "MoE checkpoint mapping is only implemented for mixtral and "
+            "qwen3_moe")
 
     layer_types = getattr(hf_cfg, "layer_types", None)
     if layer_types:
@@ -169,7 +188,7 @@ def config_from_hf(hf_cfg: Any, page_size: int = 16,
         dtype=dtype,
         sliding_window=window,
         swa_layers=swa,
-        qk_norm=hf_cfg.model_type == "qwen3",
+        qk_norm=hf_cfg.model_type in ("qwen3", "qwen3_moe"),
         rope_scaling=rope_scaling,
         **moe_kw,
     )
@@ -299,23 +318,30 @@ def params_from_hf(state_dict: Mapping[str, Any], cfg: LlamaConfig,
             "wo": proj(p + "self_attn.o_proj.weight"),
         }
         if p + "mlp.gate.weight" in state_dict:
-            # DeepSeek MoE layer: sigmoid router (+ e_score_correction
-            # bias buffer), routed experts, always-on shared expert.
+            # DeepSeek / Qwen3-MoE layer: router + routed experts. The
+            # router KIND decides the extra tensors: deepseek_v3 REQUIRES
+            # the e_score_correction bias and shared expert (a truncated
+            # checkpoint fails here, at load, naming the tensor);
+            # softmax_topk (Qwen3-MoE) has neither.
             E = cfg.num_experts
+            deepseek = cfg.moe_router and cfg.moe_router[0] == "deepseek_v3"
             layer["router"] = proj(p + "mlp.gate.weight")
-            layer["router_bias"] = norm(
-                p + "mlp.gate.e_score_correction_bias")
+            if deepseek:
+                layer["router_bias"] = norm(
+                    p + "mlp.gate.e_score_correction_bias")
             for ours, theirs in (("w_gate", "gate_proj"),
                                  ("w_up", "up_proj"),
                                  ("w_down", "down_proj")):
                 layer[ours] = jnp.stack([
                     proj(p + f"mlp.experts.{e}.{theirs}.weight")
                     for e in range(E)])
-            for ours, theirs in (("w_gate_sh", "gate_proj"),
-                                 ("w_up_sh", "up_proj"),
-                                 ("w_down_sh", "down_proj")):
-                layer[ours] = proj(p + f"mlp.shared_experts.{theirs}.weight")
-        elif cfg.num_experts > 0 and not cfg.is_mla:  # Mixtral
+            if deepseek:
+                for ours, theirs in (("w_gate_sh", "gate_proj"),
+                                     ("w_up_sh", "up_proj"),
+                                     ("w_down_sh", "down_proj")):
+                    layer[ours] = proj(
+                        p + f"mlp.shared_experts.{theirs}.weight")
+        elif p + "block_sparse_moe.gate.weight" in state_dict:  # Mixtral
             E = cfg.num_experts
             layer["router"] = proj(p + "block_sparse_moe.gate.weight")
             for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"),

@@ -131,23 +131,28 @@ class LlamaConfig:
             if self.qk_norm:
                 raise ValueError("qk_norm is not defined for MLA configs")
         if self.moe_router:
-            if (self.moe_router[0] != "deepseek_v3"
-                    or len(self.moe_router) != 5):
+            kind = self.moe_router[0]
+            if kind == "deepseek_v3" and len(self.moe_router) == 5:
+                if self.moe_dispatch != "dense":
+                    raise ValueError(
+                        "the deepseek_v3 router is implemented for the "
+                        "exact 'dense' dispatch only")
+                n_group = self.moe_router[1]
+                if n_group < 1 or self.num_experts % n_group != 0:
+                    raise ValueError(
+                        "num_experts must divide by n_group >= 1")
+                if self.num_experts // n_group < 2:
+                    raise ValueError(
+                        "deepseek_v3 group scoring sums each group's "
+                        "top-2 corrected scores: groups need >= 2 experts")
+            elif kind == "softmax_topk" and len(self.moe_router) == 2:
+                pass  # Qwen3-MoE: classic router, norm_topk_prob in [1]
+            else:
                 raise ValueError(
                     "moe_router must be ('deepseek_v3', n_group, "
-                    f"topk_group, norm_topk_prob, factor); got "
+                    "topk_group, norm_topk_prob, factor) or "
+                    f"('softmax_topk', norm_topk_prob); got "
                     f"{self.moe_router!r}")
-            if self.moe_dispatch != "dense":
-                raise ValueError(
-                    "the deepseek_v3 router is implemented for the exact "
-                    "'dense' dispatch only")
-            n_group = self.moe_router[1]
-            if n_group < 1 or self.num_experts % n_group != 0:
-                raise ValueError("num_experts must divide by n_group >= 1")
-            if self.num_experts // n_group < 2:
-                raise ValueError(
-                    "deepseek_v3 group scoring sums each group's top-2 "
-                    "corrected scores: groups need >= 2 experts")
         if self.moe_layers and not all(
                 0 <= i < self.num_layers for i in self.moe_layers):
             raise ValueError("moe_layers indices out of range")
@@ -348,7 +353,8 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
                 "w_up": dense(lk[5], (e, h, inter)),
                 "w_down": dense(lk[6], (e, inter, h)),
             })
-            if cfg.moe_router:  # deepseek_v3: bias + shared expert
+            if cfg.moe_router and cfg.moe_router[0] == "deepseek_v3":
+                # deepseek_v3: bias + shared expert
                 sh = inter * max(cfg.n_shared_experts, 1)
                 skeys = jax.random.split(lk[7], 4)
                 layer.update({
@@ -426,7 +432,15 @@ def _moe_router(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
         mlp_in @ layer["router"].astype(mlp_in.dtype)
     ).astype(jnp.float32)  # [b,s,E]
     top_w, top_idx = jax.lax.top_k(router_logits, k)  # [b,s,k]
-    weights = jax.nn.softmax(top_w, axis=-1)
+    if (cfg.moe_router and cfg.moe_router[0] == "softmax_topk"
+            and not cfg.moe_router[1]):
+        # Qwen3-MoE with norm_topk_prob=False: weights are the top-k
+        # entries of the FULL softmax, NOT renormalized (HF
+        # Qwen3MoeSparseMoeBlock — "only diff with mixtral").
+        weights = jnp.take_along_axis(
+            jax.nn.softmax(router_logits, axis=-1), top_idx, axis=-1)
+    else:
+        weights = jax.nn.softmax(top_w, axis=-1)
     onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # [b,s,k,E]
     if aux_out is not None:
         probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
@@ -563,7 +577,7 @@ def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
     bool) excludes padded positions from capacity routing.
     """
     if "router" in layer:
-        if cfg.moe_router:
+        if cfg.moe_router and cfg.moe_router[0] == "deepseek_v3":
             return _moe_deepseek(mlp_in, layer, cfg)
         if cfg.moe_dispatch == "capacity":
             return _moe_capacity(mlp_in, layer, cfg, aux_out, valid=valid)

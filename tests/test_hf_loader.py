@@ -343,6 +343,66 @@ def test_deepseek_v2_yarn_matches_transformers():
     np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
 
 
+def test_qwen3_moe_matches_transformers():
+    """Qwen3-MoE (the A3B lineage): Mixtral-style routed experts with
+    norm_topk_prob=False — weights are the top-k entries of the FULL
+    softmax, unnormalized — plus QK-norm and an mlp_only dense layer."""
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    torch.manual_seed(21)
+    hf_cfg = Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        moe_intermediate_size=32, decoder_sparse_step=1,
+        mlp_only_layers=[0], tie_word_embeddings=False,
+        use_sliding_window=False)
+    model = Qwen3MoeForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.moe_layers == (1, 2)
+    assert cfg.moe_router == ("softmax_topk", 0) and cfg.qk_norm
+    params = params_from_hf(model.state_dict(), cfg)
+    assert "router" not in params["layers"][0]
+    assert "router_bias" not in params["layers"][1]  # no DeepSeek bias
+
+    rng = np.random.default_rng(21)
+    tokens = rng.integers(1, 250, 19).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_qwen3_moe_norm_topk_matches_transformers():
+    """The production Qwen3-MoE config (norm_topk_prob=True, as released
+    A3B checkpoints ship): renormalized top-k weights through the
+    softmax_topk dispatch."""
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    torch.manual_seed(22)
+    hf_cfg = Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_experts=4, num_experts_per_tok=2,
+        norm_topk_prob=True, moe_intermediate_size=32,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        tie_word_embeddings=False, use_sliding_window=False)
+    model = Qwen3MoeForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, page_size=4, dtype=jnp.float32)
+    assert cfg.moe_router == ("softmax_topk", 1)
+    params = params_from_hf(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(22)
+    tokens = rng.integers(1, 250, 17).tolist()
+    with torch.no_grad():
+        ref = model(torch.tensor([tokens])).logits[0].float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
 def test_deepseek_moe_matches_transformers():
     """The full DeepSeek-V3 MoE: sigmoid scoring, e_score_correction-
     biased group-limited top-k selection (weights from UNBIASED scores),
