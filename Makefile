@@ -46,6 +46,7 @@ verify-examples: native
 	$(CPU_ENV) $(PY) examples/fleet_demo.py
 	$(CPU_ENV) $(PY) examples/tp_serving_demo.py
 	$(CPU_ENV) $(PY) examples/long_context_sp.py
+	$(CPU_ENV) $(PY) examples/serve_hf_checkpoint.py
 	$(CPU_ENV) $(PY) examples/redis_indexer.py
 
 # Developer check on the CPU backend (the driver separately compile-checks

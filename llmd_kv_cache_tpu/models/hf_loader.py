@@ -1,8 +1,8 @@
 """HuggingFace checkpoint loading: serve real Llama-family weights.
 
-Maps a ``transformers`` Llama/Mistral/Mixtral/Qwen2/Qwen3/DeepSeek-architecture
-state dict (or a
-checkpoint directory) onto this repo's parameter pytree, so the paged
+Maps a ``transformers`` Llama / Mistral / Mixtral / Qwen2 / Qwen3 /
+Qwen3-MoE / DeepSeek-architecture state dict (or a checkpoint
+directory) onto this repo's parameter pytree, so the paged
 serving engine runs real checkpoints instead of random init. The mapping
 is validated end-to-end by logits parity against the authoritative HF
 implementation (``tests/test_hf_loader.py`` builds a random-init HF model
@@ -445,8 +445,19 @@ def load_hf_checkpoint(path: str, page_size: int = 16,
     # checkpoint's own dtype without full nn.Module init — fp32
     # materialization of an 8B checkpoint would double peak host RAM
     # (get() upcasts per-tensor during conversion anyway).
+    import inspect as _inspect
+
+    # transformers >= 4.56 renamed torch_dtype -> dtype; pick by
+    # signature (an unknown kwarg can be silently absorbed into config
+    # kwargs on some releases, so try/except is not a reliable probe).
+    sig = _inspect.signature(AutoModelForCausalLM.from_pretrained)
+    accepts_dtype = "dtype" in sig.parameters or any(
+        p.kind is _inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values())
+    dtype_kw = {"dtype": "auto"} if accepts_dtype else {
+        "torch_dtype": "auto"}
     model = AutoModelForCausalLM.from_pretrained(
-        path, torch_dtype="auto", low_cpu_mem_usage=True)
+        path, low_cpu_mem_usage=True, **dtype_kw)
     params = params_from_hf(
         model.state_dict(), cfg,
         mla_rope_interleaved=getattr(hf_cfg, "rope_interleave", True))
