@@ -63,11 +63,14 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
                          sink_pages, sinks, shared_kv=False):
     """Shared page remap + superblock DMA for the decode/prefill kernels.
 
-    ``page_for`` maps a loop counter to a page-table index — sink pages
-    ([0, sink_pages)) first, then window pages ([first_window, …)) —
-    with DMA-safe clamping for sub-pages past ``num_iters`` (their
-    garbage loads are masked out by position). One definition for both
-    kernels so the clamp/remap subtleties cannot drift between them.
+    ``page_for`` (internal) maps a loop counter to a page-table index —
+    sink pages ([0, sink_pages)) first, then window pages
+    ([first_window, …)) — with DMA-safe clamping for sub-pages past
+    ``num_iters`` (their garbage loads are masked out by position).
+    Returns ``(positions, sb_dma)``: the flat-lane key-position builder
+    for the mask and the double-buffered DMA batch. One definition for
+    both kernels so the clamp/remap subtleties cannot drift between
+    them.
 
     ``shared_kv`` (absorbed MLA: values ARE the latent keys) streams each
     page ONCE into the K scratch and skips the V stream entirely —
@@ -98,7 +101,23 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
                 ))
         return copies
 
-    return page_for, sb_dma
+    def positions(sb, park, page_size):
+        """Key positions for superblock ``sb`` as [1, kpb*page_size] i32.
+
+        Built directly in the flat lane layout — Mosaic's
+        infer-vector-layout rejects the (kpb, page_size) →
+        (1, kpb*page_size) shape cast (sublane→lane collapse) — by
+        deriving sub-page index and in-page offset from one lane iota.
+        Sub-pages past ``num_iters`` park at ``park`` (a position every
+        mask term rejects: ctx_len for decode, total_len for prefill).
+        """
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, kpb * page_size), 1)
+        jp = j // page_size
+        sub = sb * kpb + jp
+        pos = page_for(sub) * page_size + (j - jp * page_size)
+        return jnp.where(sub < num_iters, pos, park)
+
+    return positions, sb_dma
 
 
 def _decode_kernel(
@@ -156,7 +175,7 @@ def _decode_kernel(
     # jump; per-sub-page positions keep the mask exact.
     num_sb = (num_iters + kpb - 1) // kpb
 
-    page_for, sb_dma = _superblock_streamer(
+    sb_positions, sb_dma = _superblock_streamer(
         page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
         sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
@@ -197,12 +216,7 @@ def _decode_kernel(
         # SWA, positions that fell out of the window — unless they are
         # sink positions, which stay attendable forever); sub-pages past
         # num_iters park at ctx_len so every mask term rejects them.
-        sub = sb * kpb + jax.lax.broadcasted_iota(jnp.int32, (kpb, 1), 0)
-        pos = page_for(sub) * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (kpb, page_size), 1
-        )
-        positions = jnp.where(sub < num_iters, pos, ctx_len).reshape(
-            1, kpb * page_size)
+        positions = sb_positions(sb, ctx_len, page_size)
         in_bounds = positions < ctx_len
         if sliding_window is not None:
             in_window = positions >= ctx_len - sliding_window
@@ -296,7 +310,7 @@ def _prefill_kernel(
     # its own remapped index, so masking stays exact.
     num_sb = (num_iters + kpb - 1) // kpb
 
-    page_for, sb_dma = _superblock_streamer(
+    sb_positions, sb_dma = _superblock_streamer(
         page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
         sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
@@ -339,13 +353,7 @@ def _prefill_kernel(
         # Per-sub-page key positions (each from its own remapped page
         # index); sub-pages past num_iters park at total_len so every
         # mask term rejects them.
-        sub = sb * kpb + jax.lax.broadcasted_iota(jnp.int32, (kpb, 1), 0)
-        valid_sub = sub < num_iters
-        pos = page_for(sub) * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (kpb, page_size), 1
-        )
-        k_pos = jnp.where(valid_sub, pos, total_len).reshape(
-            1, kpb * page_size)
+        k_pos = sb_positions(sb, total_len, page_size)
         mask = (k_pos <= q_pos) & (k_pos < total_len)  # [q_tile, kpb*ps]
         if sliding_window is not None:
             in_window = q_pos - k_pos < sliding_window
