@@ -181,7 +181,8 @@ def main():
     @jax.jit
     def mm_scan(a, b):
         def body(c, _):
-            return c @ b * 0 + a @ b, None  # defeat CSE via dependence on c
+            # defeat CSE/hoisting: operand depends on the carry
+            return (a * (1 + c[0, 0] * 0)) @ b, None
         out, _ = jax.lax.scan(body, a @ b, None, length=reps)
         return out[0, 0]
 
@@ -194,30 +195,36 @@ def main():
     prefill_flops = (2 * p_nonembed * 4096
                      + CFG.num_layers * 4 * (4096 ** 2 / 2) * 2048)
 
-    @jax.jit
-    def prefill_chunked(params, k, v, tokens):
-        def body(carry, i):
-            k, v = carry
-            chunk = jax.lax.dynamic_slice(tokens, (0, i * CHUNK), (1, CHUNK))
-            logits, k, v = forward(
-                params, CFG, chunk, k, v, table,
-                (i * CHUNK)[None].astype(jnp.int32),
-                jnp.asarray([CHUNK], jnp.int32), last_only=True)
-            return (k, v), logits[0, 0, 0]
-        (k, v), ls = jax.lax.scan(body, (k, v),
-                                  jnp.arange(2, dtype=jnp.int32))
-        return k, v, ls
+    def make_prefill_chunked(fwd):
+        @jax.jit
+        def prefill_chunked(params, k, v, tokens):
+            def body(carry, i):
+                k, v = carry
+                chunk = jax.lax.dynamic_slice(
+                    tokens, (0, i * CHUNK), (1, CHUNK))
+                logits, k, v = fwd(
+                    params, CFG, chunk, k, v, table,
+                    (i * CHUNK)[None].astype(jnp.int32),
+                    jnp.asarray([CHUNK], jnp.int32), last_only=True)
+                return (k, v), logits[0, 0, 0]
+            (k, v), ls = jax.lax.scan(body, (k, v),
+                                      jnp.arange(2, dtype=jnp.int32))
+            return k, v, ls
+        return prefill_chunked
 
-    k_cache, v_cache = init_kv_cache(CFG, NUM_PAGES)
+    for fwd, label in ((forward, "4096-tok prefill, 2x2048 chunks in-jit"),
+                       (forward_prefill_pallas,
+                        "same, flash prefill (engine TPU default)")):
+        prefill_chunked = make_prefill_chunked(fwd)
+        k_cache, v_cache = init_kv_cache(CFG, NUM_PAGES)
 
-    def prefill_step(state):
-        k, v = state
-        k, v, _ = prefill_chunked(params, k, v, full_tokens)
-        return (k, v)
+        def prefill_step(state):
+            k, v = state
+            k, v, _ = prefill_chunked(params, k, v, full_tokens)
+            return (k, v)
 
-    timed_threaded("4096-tok prefill, 2x2048 chunks in-jit",
-                   prefill_step, (k_cache, v_cache), iters=4,
-                   flops=prefill_flops)
+        timed_threaded(label, prefill_step, (k_cache, v_cache), iters=4,
+                       flops=prefill_flops)
 
     # Same, single 4096-token chunk (no scan): the chunking overhead bound.
     table_full = table
@@ -241,39 +248,78 @@ def main():
                    prefill_one_step, (k_cache, v_cache), iters=4,
                    flops=prefill_flops)
 
-    # --- flash-prefill tuning sweep: q_tile × pages_per_block at the
-    # bench chunk shape (reusing the attention-stage q/kc/vc arrays —
-    # re-uploading 100 MB over the tunnel would dominate the stage). The
-    # superblock rework targets full MXU tiles (q_tile 128, 128
-    # keys/round); this table is the on-chip evidence for the engine's
-    # default and the r4-mfu hypothesis-1 discriminator
-    # (probs-materialization-free prefill vs the XLA path above). ---
-    for q_tile in (16, 64, 128):
-        for kpb in (1, 4, 8, 16):
+    # --- per-layer attention, in-jit (the single-dispatch measurements
+    # above are pinned at the tunnel's ~9 ms dispatch floor — 67 ms sync
+    # over 8 dispatches — so the op is scanned REPS× inside one program
+    # with a carry dependence defeating CSE; this is the methodology that
+    # exposed flash > XLA after the floor-polluted one-layer numbers said
+    # the opposite). ---
+    attn_reps = 16
+
+    def op_injit(label, fn, q_op, flops, unit, iters=4):
+        """Time fn(q_like, kc, vc) scanned attn_reps× inside one jit.
+
+        The carry dependence defeats CSE/hoisting; the multiplier is cast
+        back to the query dtype so the timed op runs the production bf16
+        path (an f32 carry would silently promote q to fp32 — off the
+        bf16 MXU fast path)."""
+        @jax.jit
+        def scanned(q_op, kc, vc):
+            def body(c, _):
+                o = fn(q_op * (1 + c * 0).astype(q_op.dtype), kc, vc)
+                return o.ravel()[0].astype(jnp.float32), None
+            out, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                  length=attn_reps)
+            return out
+        out = scanned(q_op, kc, vc)
+        _sync(out)
+        start = time.perf_counter()
+        for _ in range(iters):
+            out = scanned(q_op, kc, vc)
+        _sync(out)
+        dt = (time.perf_counter() - start) / iters / attn_reps
+        print(f"{label:<44s} {dt * 1e3:8.2f} {unit}  "
+              f"{flops / dt / 1e12:.1f} TFLOP/s "
+              f"({flops / dt / 197e12 * 100:.1f}% of v5e peak)",
+              flush=True)
+
+    def attn_injit(label, fn):
+        op_injit(label, fn, q, per_layer_attn, "ms/layer")
+
+    attn_injit("XLA paged_attention in-jit x16",
+               lambda q, kc, vc: paged_attention(q, kc, vc, table, qpos, tot))
+    # q_tile × keys-per-round sweep around the engine default
+    # (group·q_tile ≈ 1024 rows, ~1024 keys per online-softmax round —
+    # the measured optimum; see forward_prefill_pallas).
+    for q_tile in (128, 256, 512, 1024):
+        for kpb in (8, 32, 64):
             try:
-                timed(f"flash prefill q_tile={q_tile:<3d} kpb={kpb:<2d}",
-                      lambda *a, qt=q_tile, kb=kpb:
-                      pallas_paged_prefill_attention(
-                          *a, q_tile=qt, pages_per_block=kb),
-                      q, kc, vc, table, ctx, tot,
-                      flops=per_layer_attn)
+                attn_injit(
+                    f"flash prefill q_tile={q_tile:<4d} kpb={kpb:<2d} in-jit",
+                    lambda q, kc, vc, qt=q_tile, kb=kpb:
+                    pallas_paged_prefill_attention(
+                        q, kc, vc, table, ctx, tot, q_tile=qt,
+                        pages_per_block=kb))
             except Exception as e:  # Mosaic rejection at an extreme point
                 print(f"flash prefill q_tile={q_tile} kpb={kpb}: "
                       f"{type(e).__name__}: {str(e)[:120]}", flush=True)
 
-    # Flash-decode superblock sweep at long context (batch 8, ctx 4096).
+    # Flash-decode superblock sweep at long context (batch 8, ctx 4096),
+    # in-jit for the same reason (decode steps are ~100 µs — far below
+    # the dispatch floor).
     qd = jnp.asarray(rng.normal(size=(8, 16, 128)), jnp.bfloat16)
     table8 = jnp.asarray(
         1 + np.arange(8 * PAGES_PER_SEQ).reshape(8, PAGES_PER_SEQ) %
         (NUM_PAGES - 1), jnp.int32)
     lens8 = jnp.full((8,), 4096, jnp.int32)
     dec_flops = 8 * 4 * 4096 * 16 * 128
-    for kpb in (1, 4, 8, 16):
+
+    for kpb in (4, 8, 16, 32):
         try:
-            timed(f"flash decode kpb={kpb:<2d} (b8, ctx 4k)",
-                  lambda *a, kb=kpb: pallas_paged_decode_attention(
-                      *a, pages_per_block=kb),
-                  qd, kc, vc, table8, lens8, flops=dec_flops)
+            op_injit(f"flash decode kpb={kpb:<2d} (b8, ctx 4k) in-jit",
+                     lambda qd, kc, vc, kb=kpb: pallas_paged_decode_attention(
+                         qd, kc, vc, table8, lens8, pages_per_block=kb),
+                     qd, dec_flops, "ms/step ")
         except Exception as e:  # Mosaic rejection at an extreme point
             print(f"flash decode kpb={kpb}: "
                   f"{type(e).__name__}: {str(e)[:120]}", flush=True)

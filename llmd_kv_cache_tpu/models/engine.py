@@ -84,16 +84,18 @@ class EngineConfig:
     # XLA reference elsewhere); True forces Pallas (interpreted on CPU);
     # False forces the XLA path.
     use_pallas_decode: Optional[bool] = None
-    # Prefill attention backend: None = auto, which is the XLA paged
-    # attention — measured on the v5e at production shapes (0.9B model,
-    # 2048-token chunks) the XLA path prefills ~12× faster than the
-    # page-at-a-time Pallas flash-prefill kernel (77 ms vs 1.1 s per
-    # chunk: 16-token DMAs and 16×128 tiles cannot feed the 128×128 MXU,
-    # while XLA's gathered-KV attention runs full-width matmuls). The
-    # kernel still wins where materializing gathered KV is the bottleneck
-    # (very long SWA contexts, page skipping) — True opts in (effective
-    # only while the Pallas decode backend is active, which carries the
-    # platform/head-dim gating).
+    # Prefill attention backend: None = auto — the Pallas flash-prefill
+    # kernel whenever the Pallas backend is active (TPU + aligned
+    # head_dim), XLA paged attention otherwise. Measured on a real v5e
+    # at the bench's production shapes (0.9B model, 2048-token chunks,
+    # in-jit so dispatch is excluded — hack/mfu_probe.py): the superblock
+    # flash kernel runs 1.9 ms/layer vs XLA's 3.5 ms — the fp32
+    # logits/probs tensor XLA materializes per layer costs more HBM
+    # round-trips than the kernel's streamed online softmax. (The
+    # pre-superblock kernel this default once gated off was 12× *slower*:
+    # 16-token DMAs and 16×128 tiles cannot feed the 128×128 MXU.)
+    # False forces XLA prefill; True insists and warns if the Pallas
+    # backend is inactive.
     use_pallas_prefill: Optional[bool] = None
     # Chunked prefill: the uncached suffix is processed in chunks of at
     # most this many tokens (vLLM-style), bounding per-step activation
@@ -537,10 +539,17 @@ class MiniEngine:
         else:
             pallas_mesh = None
             self._decode_forward = forward
-        # Prefill backend is independent of decode: XLA paged attention by
-        # default (see EngineConfig.use_pallas_prefill for the measured
-        # rationale); the flash-prefill kernel is opt-in.
-        if self.cfg.use_pallas_prefill and use_pallas:
+        # Prefill backend is independent of decode: auto (None) follows
+        # the Pallas backend's platform/head-dim gating — the flash
+        # kernel measured 1.9× faster than XLA attention at production
+        # chunks on a real v5e (see EngineConfig.use_pallas_prefill).
+        # Auto engages only on real TPU: interpret-mode flash prefill on
+        # CPU is orders slower than XLA with no fidelity gain (tests that
+        # want it opt in with use_pallas_prefill=True).
+        prefill_pallas = (use_pallas and on_tpu
+                          if self.cfg.use_pallas_prefill is None
+                          else self.cfg.use_pallas_prefill)
+        if prefill_pallas and use_pallas:
             self._prefill_forward = functools.partial(
                 forward_prefill_pallas, interpret=not on_tpu, mesh=pallas_mesh
             )

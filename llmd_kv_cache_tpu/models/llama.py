@@ -1085,11 +1085,14 @@ def forward_prefill_pallas(
         pallas_paged_prefill_attention, sharded_paged_prefill_attention)
 
     seq = tokens.shape[1]
-    # 128 query rows per program when the chunk allows: with the 128-key
-    # superblocks this makes each online-softmax round a full
-    # [group·128, head_dim]×[head_dim, 128] MXU-tile matmul (the bench's
-    # 2048-token chunks hit this; tiny test seqs fall back to their gcd).
-    q_tile = math.gcd(seq, 128)
+    # Query rows per program: target group·q_tile ≈ 1024 so each
+    # online-softmax round is a [~1024, head_dim]×[head_dim, keys]
+    # matmul. Measured on a real v5e at the bench's 2048-token chunks
+    # (hack/mfu_probe.py in-jit sweep): q_tile 512 at group 2 runs
+    # 1.9 ms/layer vs 3.0 ms at q_tile 128 — bigger tiles re-stream the
+    # KV fewer times. Tiny test seqs fall back to their gcd.
+    group = cfg.num_heads // max(1, cfg.kv_cache_heads)
+    q_tile = math.gcd(seq, max(128, 1024 // max(1, group)))
 
     sinks = cfg.attention_sinks or None
 

@@ -47,9 +47,10 @@ def test_pallas_decode_matches_xla_with_sliding_window():
 def test_pallas_prefill_engine_matches_xla_path():
     """With use_pallas_prefill=True the engine prefills through the Pallas
     flash-prefill kernel; outputs must match the XLA path, including
-    chunked prefill and prefix-cache resumes. (Prefill defaults to the XLA
-    path — measured 12× faster at production shapes — so the kernel is
-    opt-in.)"""
+    chunked prefill and prefix-cache resumes. (On TPU the flash kernel is
+    the auto default — measured 1.9 ms/layer vs XLA's 3.5 at production
+    chunks; on CPU auto stays XLA because interpret-mode Pallas is orders
+    slower, so this test opts in explicitly.)"""
     prompt = list(range(30, 62))  # 8 pages of 4
     outs = {}
     for use_pallas in (False, True):
