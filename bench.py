@@ -660,9 +660,10 @@ def main(queued: bool = True) -> None:
     # above (same arrival seeds; fewer points, each re-serves the fleet).
     conc_sweep = []
     # On the tunneled TPU each concurrent fleet re-serves the workload at
-    # real service times (~minutes): run the headline point only; CPU
-    # sweeps three points.
-    conc_mults = (1.25,) if platform == "tpu" else (0.75, 1.25, 2.0)
+    # real service times (~minutes): run the headline point plus one
+    # light- and one over-load point; CPU sweeps three points.
+    conc_mults = ((0.75, 1.25, 1.5) if platform == "tpu"
+                  else (0.75, 1.25, 2.0))
     for mult in conc_mults:
         qps = mult * fleet_qps
         arr = np.cumsum(
@@ -699,8 +700,23 @@ def main(queued: bool = True) -> None:
               f"p90 rr {crow['rr_p90']:.3f}s kv {crow['kv_p90']:.3f}s",
               file=_sys.stderr, flush=True)
 
-    # Headline: the 1.25×-capacity point (continuity with rounds 1-2).
-    head = next(r for r in sweep if r["mult"] == 1.25)
+    # Headline: the 1.25×-capacity point, from the CONCURRENT
+    # continuous-batching arm when it ran — measured TTFTs under real
+    # batching interference and decode load, matching how the
+    # reference's headline tables are produced (real inference-perf
+    # serving, 73-capacity README). The virtual-time FIFO model stays in
+    # the payload as the fast methodology-comparison arm; it
+    # under-credits routing once prefill is fast (cold prefills cost
+    # little when nothing else is running) and over-credits it at
+    # saturation, so the served number is the honest one.
+    head = next((r for r in conc_sweep if r["mult"] == 1.25), None)
+    if head is not None:
+        head_tag = "concurrent continuous batching"
+        head_kv_hit, head_rr_hit = head["kv_hit"], head["rr_hit"]
+    else:
+        head = next(r for r in sweep if r["mult"] == 1.25)
+        head_tag = "virtual-time replay"
+        head_kv_hit, head_rr_hit = kv_hit, rr_hit
     reduction_pct = head["reduction_pct"]
     p50_rr, p50_kv = head["rr_p50"], head["kv_p50"]
 
@@ -712,16 +728,21 @@ def main(queued: bool = True) -> None:
                    f"hit-rate {st_hit:.2f})")
     line = {
         "metric": "p50 TTFT reduction, KV-aware routing vs round-robin "
-                  f"({n_pods} pods, shared-prefix replay, Poisson "
+                  f"({n_pods} pods, shared-prefix {head_tag}, Poisson "
                   f"{head['qps']:.1f} req/s open-loop, p50 rr {p50_rr:.2f}s "
-                  f"vs kv {p50_kv:.3f}s, hit-rate kv {kv_hit:.2f} vs rr "
-                  f"{rr_hit:.2f}{storage}, "
+                  f"vs kv {p50_kv:.3f}s, hit-rate kv {head_kv_hit:.2f} vs rr "
+                  f"{head_rr_hit:.2f}{storage}, "
                   f"{jax.devices()[0].platform})",
         "value": round(reduction_pct, 2),
         "unit": "%",
         "vs_baseline": round(reduction_pct / 40.0, 3),
-        "hit_rate_kv": round(kv_hit, 4),
-        "hit_rate_rr": round(rr_hit, 4),
+        # Headline-arm hit rates (match `value`/`metric`); the serial
+        # replay arm's are kept under replay_* so consumers never mix
+        # measurement arms.
+        "hit_rate_kv": round(head_kv_hit, 4),
+        "hit_rate_rr": round(head_rr_hit, 4),
+        "replay_hit_rate_kv": round(kv_hit, 4),
+        "replay_hit_rate_rr": round(rr_hit, 4),
         "qps_sweep": sweep,
         "concurrent_sweep": conc_sweep,
     }
