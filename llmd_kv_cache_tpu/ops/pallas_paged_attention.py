@@ -91,12 +91,17 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
         copies = []
         for t in range(kpb):
             page = page_table_ref[b, page_for(sb * kpb + t)]
+            # h=None: merged-heads mode — one whole-page copy carries
+            # every kv head ([kv_heads, page_size, head_dim] slice),
+            # cutting the DMA count by kv_heads×.
+            k_src = k_hbm.at[page] if h is None else k_hbm.at[page, h]
             copies.append(pltpu.make_async_copy(
-                k_hbm.at[page, h], k_scratch.at[slot, t], sem.at[slot, t, 0]
+                k_src, k_scratch.at[slot, t], sem.at[slot, t, 0]
             ))
             if not shared_kv:
+                v_src = v_hbm.at[page] if h is None else v_hbm.at[page, h]
                 copies.append(pltpu.make_async_copy(
-                    v_hbm.at[page, h], v_scratch.at[slot, t],
+                    v_src, v_scratch.at[slot, t],
                     sem.at[slot, t, 1]
                 ))
         return copies
@@ -118,6 +123,41 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
         return jnp.where(sub < num_iters, pos, park)
 
     return positions, sb_dma
+
+
+def _decode_stream_bounds(ctx_len, page_size, sliding_window, sinks):
+    """(first_window, sink_pages, num_iters) for a decode stream over
+    keys [0, ctx_len). One definition for the per-head and merged decode
+    kernels so the window/sink page arithmetic cannot drift between
+    them (same rationale as ``_superblock_streamer``). SWA skips pages
+    wholly before ctx_len - window; sinks keep the first
+    ceil(S/page_size) pages streamed via the loop-counter remap."""
+    num_pages = (ctx_len + page_size - 1) // page_size
+    if sliding_window is not None:
+        first_window = jnp.maximum(ctx_len - sliding_window, 0) // page_size
+    else:
+        first_window = jnp.int32(0)
+    if sinks:
+        sink_pages = jnp.minimum(
+            (sinks + page_size - 1) // page_size, num_pages)
+        first_window = jnp.maximum(first_window, sink_pages)
+    else:
+        sink_pages = jnp.int32(0)
+    num_iters = sink_pages + num_pages - first_window
+    return first_window, sink_pages, num_iters
+
+
+def _decode_mask(positions, ctx_len, sliding_window, sinks):
+    """Attendability of decode key ``positions``: in-bounds, and inside
+    the sliding window unless a sink position. Shared between the
+    per-head and merged decode kernels."""
+    in_bounds = positions < ctx_len
+    if sliding_window is not None:
+        in_window = positions >= ctx_len - sliding_window
+        if sinks:
+            in_window = in_window | (positions < sinks)
+        in_bounds = in_bounds & in_window
+    return in_bounds
 
 
 def _decode_kernel(
@@ -148,7 +188,6 @@ def _decode_kernel(
     kpb = pages_per_block
 
     ctx_len = ctx_lens_ref[b]
-    num_pages = (ctx_len + page_size - 1) // page_size
     # SWA: pages entirely outside the window are skipped, so long contexts
     # stream only ~window/page_size pages. Attention sinks (StreamingLLM,
     # reference events.go:40 sink_full_attention) additionally stream the
@@ -156,17 +195,8 @@ def _decode_kernel(
     # page index — sink pages [0, sink_pages) first, then window pages
     # [first_window, num_pages) — so the double-buffered DMA pipeline is
     # unchanged and the skipped middle costs nothing.
-    if sliding_window is not None:
-        first_window = jnp.maximum(ctx_len - sliding_window, 0) // page_size
-    else:
-        first_window = jnp.int32(0)
-    if sinks:
-        sink_pages = jnp.minimum(
-            (sinks + page_size - 1) // page_size, num_pages)
-        first_window = jnp.maximum(first_window, sink_pages)
-    else:
-        sink_pages = jnp.int32(0)
-    num_iters = sink_pages + num_pages - first_window
+    first_window, sink_pages, num_iters = _decode_stream_bounds(
+        ctx_len, page_size, sliding_window, sinks)
     # Pages stream in superblocks of ``kpb``: each round waits on one
     # batch of kpb in-flight DMAs (4 KB single-page transfers underuse
     # HBM bandwidth; a 128-key superblock moves 64 KB per K/V round) and
@@ -217,12 +247,7 @@ def _decode_kernel(
         # sink positions, which stay attendable forever); sub-pages past
         # num_iters park at ctx_len so every mask term rejects them.
         positions = sb_positions(sb, ctx_len, page_size)
-        in_bounds = positions < ctx_len
-        if sliding_window is not None:
-            in_window = positions >= ctx_len - sliding_window
-            if sinks:
-                in_window = in_window | (positions < sinks)
-            in_bounds = in_bounds & in_window
+        in_bounds = _decode_mask(positions, ctx_len, sliding_window, sinks)
         scores = jnp.where(in_bounds, scores, _NEG_INF)
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # [group, 1]
@@ -244,6 +269,121 @@ def _decode_kernel(
 
     out = acc / jnp.maximum(l_fin, 1e-30)
     o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _decode_kernel_merged(
+    # scalar prefetch
+    page_table_ref,  # [batch, pages_per_seq] int32 (SMEM)
+    ctx_lens_ref,  # [batch] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, kv_heads, group, head_dim] VMEM block for (b,)
+    k_hbm,  # [num_pages, kv_heads, page_size, head_dim] (ANY/HBM)
+    v_hbm,  # same
+    # output
+    o_ref,  # [1, kv_heads, group, head_dim] VMEM block
+    # scratch
+    k_scratch,  # [2, pages_per_block, kv_heads, page_size, head_dim] VMEM
+    v_scratch,  # same
+    sem,  # DMA semaphores [2, pages_per_block, 2]
+    *,
+    page_size: int,
+    scale: float,
+    sliding_window: int | None,
+    sinks: int,
+    pages_per_block: int,
+    shared_kv: bool,
+):
+    """Decode with every kv head in ONE program per batch item.
+
+    The per-head grid (``_decode_kernel``) pays pipeline fill/drain and
+    per-page 4 KB DMAs once per (batch, head) program — measured on a
+    real v5e at batch 8 / ctx 4k it sustains only ~105 GB/s of the
+    chip's 819 (benchmarking/r4-mfu, "decode" table). Merging heads
+    makes each sub-page copy one whole-page transfer carrying all kv
+    heads (DMA count ÷ kv_heads), computes the position mask once per
+    round instead of per head, and amortizes the program overhead over
+    kv_heads× more work. The head loop is a static Python unroll of
+    per-head [group, head_dim]×[head_dim, keys] matmuls over the shared
+    streamed superblock.
+    """
+    b = pl.program_id(0)
+    kv_heads, group = q_ref.shape[1], q_ref.shape[2]
+    head_dim = q_ref.shape[3]
+    kpb = pages_per_block
+
+    ctx_len = ctx_lens_ref[b]
+    first_window, sink_pages, num_iters = _decode_stream_bounds(
+        ctx_len, page_size, sliding_window, sinks)
+    num_sb = (num_iters + kpb - 1) // kpb
+
+    sb_positions, sb_dma = _superblock_streamer(
+        page_table_ref, b, None, k_hbm, v_hbm, k_scratch, v_scratch, sem,
+        kpb=kpb, num_iters=num_iters, first_window=first_window,
+        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
+
+    @pl.when(num_sb > 0)
+    def _():
+        for c in sb_dma(0, 0):
+            c.start()
+
+    qs = [q_ref[0, h] for h in range(kv_heads)]  # each [group, head_dim]
+
+    def body(sb, carry):
+        ms, ls, accs = carry
+        slot = sb % 2
+        next_slot = (sb + 1) % 2
+
+        @pl.when(sb + 1 < num_sb)
+        def _():
+            for c in sb_dma(next_slot, sb + 1):
+                c.start()
+
+        for c in sb_dma(slot, sb):
+            c.wait()
+
+        # Shared mask for every head: positions depend only on the batch
+        # item's pages — the per-head grid recomputed this kv_heads×.
+        positions = sb_positions(sb, ctx_len, page_size)
+        in_bounds = _decode_mask(positions, ctx_len, sliding_window, sinks)
+
+        new_ms, new_ls, new_accs = [], [], []
+        for h in range(kv_heads):
+            # [kpb, page_size, head_dim] slice of this head's keys →
+            # leading-collapse reshape (lane dim unchanged).
+            k = k_scratch[slot, :, h].reshape(kpb * page_size, head_dim)
+            v = k if shared_kv else v_scratch[slot, :, h].reshape(
+                kpb * page_size, head_dim)
+            scores = jax.lax.dot_general(
+                qs[h], k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [group, kpb*page_size]
+            scores = jnp.where(in_bounds, scores, _NEG_INF)
+
+            m_cur = jnp.max(scores, axis=1, keepdims=True)
+            m_new = jnp.maximum(ms[h], m_cur)
+            p = jnp.exp(scores - m_new)
+            alpha = jnp.exp(ms[h] - m_new)
+            l_new = ls[h] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_new = accs[h] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            new_ms.append(m_new)
+            new_ls.append(l_new)
+            new_accs.append(acc_new)
+        return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+
+    m0 = tuple(jnp.full((group, 1), _NEG_INF, jnp.float32)
+               for _ in range(kv_heads))
+    l0 = tuple(jnp.zeros((group, 1), jnp.float32) for _ in range(kv_heads))
+    acc0 = tuple(jnp.zeros((group, head_dim), jnp.float32)
+                 for _ in range(kv_heads))
+    _ms, l_fin, accs = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
+
+    for h in range(kv_heads):
+        out = accs[h] / jnp.maximum(l_fin[h], 1e-30)
+        o_ref[0, h] = out.astype(o_ref.dtype)
 
 
 def _prefill_kernel(
@@ -486,7 +626,8 @@ def pallas_paged_prefill_attention(
 
 @functools.partial(jax.jit,
                    static_argnames=("interpret", "sliding_window", "sinks",
-                                    "pages_per_block", "shared_kv"))
+                                    "pages_per_block", "shared_kv",
+                                    "merge_heads"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -498,6 +639,7 @@ def pallas_paged_decode_attention(
     sinks: int | None = None,
     pages_per_block: int | None = None,
     shared_kv: bool = False,
+    merge_heads: bool | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
@@ -508,6 +650,13 @@ def pallas_paged_decode_attention(
     the sliding window; their pages are streamed in addition to the
     window's. MLA's absorbed multi-query form is the ``kv_heads == 1``
     case: one shared latent 'head' serves every query head as one group.
+
+    ``merge_heads`` (default: on when ``kv_heads > 1``) runs every kv
+    head of a batch item in one program — whole-page DMAs carry all
+    heads, the position mask is computed once per round, and program
+    count drops kv_heads× (see ``_decode_kernel_merged``). The per-head
+    grid remains for kv_heads == 1 (identical work) and as an escape
+    hatch.
     """
     batch, q_heads, head_dim = q.shape
     num_pages_total, kv_heads, page_size, _ = k_cache.shape
@@ -515,53 +664,95 @@ def pallas_paged_decode_attention(
     if sliding_window is None:
         sinks = None  # no-op without a window (see the prefill wrapper)
     _check_head_dim_alignment(head_dim, interpret)
+    if merge_heads is None:
+        merge_heads = kv_heads > 1
     if pages_per_block is None:
         # ~1024 keys per online-softmax round: measured on a real v5e at
         # batch 8 / ctx 4k (hack/mfu_probe.py), widening rounds from 128
         # to 1024-2048 keys cut the step from 2.5 ms to ~1.3 ms — fewer
         # DMA waits and per-round fixed costs against the same bytes.
-        # The decode scores tile [group, keys] is small, so no VMEM clamp
-        # is needed at these widths. Clamped to the table's static page
-        # capacity so short-context configs don't pay for redundant
-        # clamped copies.
-        pages_per_block = max(1, min(1024 // page_size,
+        # The decode scores tile [group, keys] is small; the merged
+        # kernel's scratch carries every head per key, so its keys/round
+        # are clamped to keep the double-buffered K+V staging ≤ ~8 MB of
+        # VMEM. Clamped to the table's static page capacity so
+        # short-context configs don't pay for redundant clamped copies.
+        keys = 1024
+        if merge_heads:
+            kv_streams = 1 if shared_kv else 2
+            budget = (8 * 2 ** 20) // (
+                2 * kv_heads * head_dim * k_cache.dtype.itemsize * kv_streams)
+            keys = min(keys, max(page_size, budget))
+        pages_per_block = max(1, min(keys // page_size,
                                      page_table.shape[1]))
 
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 
-    kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
-        sliding_window=sliding_window, sinks=int(sinks or 0),
-        pages_per_block=pages_per_block, shared_kv=shared_kv,
-    )
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(batch, kv_heads),
-        in_specs=[
-            pl.BlockSpec(
+    if merge_heads:
+        kernel = functools.partial(
+            _decode_kernel_merged, page_size=page_size,
+            scale=head_dim ** -0.5, sliding_window=sliding_window,
+            sinks=int(sinks or 0), pages_per_block=pages_per_block,
+            shared_kv=shared_kv,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch,),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, kv_heads, group, head_dim),
+                    lambda b, *_prefetch: (b, 0, 0, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, kv_heads, group, head_dim),
+                lambda b, *_prefetch: (b, 0, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM(
+                    (2, pages_per_block, kv_heads, page_size, head_dim),
+                    k_cache.dtype),
+                pltpu.VMEM((1, 1, 1, 1, 1) if shared_kv else
+                           (2, pages_per_block, kv_heads, page_size,
+                            head_dim),
+                           k_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
+            ],
+        )
+    else:
+        kernel = functools.partial(
+            _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
+            sliding_window=sliding_window, sinks=int(sinks or 0),
+            pages_per_block=pages_per_block, shared_kv=shared_kv,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(batch, kv_heads),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, group, head_dim),
+                    # scalar-prefetch refs are appended to index_map args
+                    lambda b, h, *_prefetch: (b, h, 0, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
                 (1, 1, group, head_dim),
-                # scalar-prefetch refs are appended to index_map args
                 lambda b, h, *_prefetch: (b, h, 0, 0),
             ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, group, head_dim),
-            lambda b, h, *_prefetch: (b, h, 0, 0),
-        ),
-        scratch_shapes=[
-            # DMA staging must match the cache dtype; upcast after load.
-            pltpu.VMEM((2, pages_per_block, page_size, head_dim),
-                       k_cache.dtype),
-            # shared_kv (absorbed MLA): V stream skipped, placeholder.
-            pltpu.VMEM((1, 1, 1, 1) if shared_kv else
-                       (2, pages_per_block, page_size, head_dim),
-                       k_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
-        ],
-    )
+            scratch_shapes=[
+                # DMA staging must match the cache dtype; upcast after load.
+                pltpu.VMEM((2, pages_per_block, page_size, head_dim),
+                           k_cache.dtype),
+                # shared_kv (absorbed MLA): V stream skipped, placeholder.
+                pltpu.VMEM((1, 1, 1, 1) if shared_kv else
+                           (2, pages_per_block, page_size, head_dim),
+                           k_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
+            ],
+        )
 
     out = pl.pallas_call(
         kernel,
@@ -592,16 +783,19 @@ def _kv_pool_spec(k_cache):
 def sharded_paged_decode_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
     sliding_window=None, sinks=None, pages_per_block=None, shared_kv=False,
-    interpret=False,
+    merge_heads=None, interpret=False,
 ):
     """Flash-decode over a tp-sharded paged KV cache.
 
     ``pallas_call`` cannot consume sharded operands directly, so each tp
-    shard runs the kernel on its local kv heads under ``shard_map`` — the
-    decode grid is (batch, kv_head)-independent, so sharding the kv-heads
-    axis needs no cross-shard communication at all (the per-block
-    all-reduce happens later, at the wo projection). Page tables and
-    lengths are replicated control state.
+    shard runs the kernel on its local kv heads under ``shard_map``.
+    Heads stay shard-local either way the local kernel grids them (one
+    program per (batch, local head), or the merged-heads default's one
+    program per batch item covering every local head — kv_heads× larger
+    scratch per program), so sharding the kv-heads axis needs no
+    cross-shard communication at all (the per-block all-reduce happens
+    later, at the wo projection). Page tables and lengths are replicated
+    control state.
 
     Shapes are global: q [batch, q_heads, hd] (heads sharded over tp),
     caches [pages, kv_heads, ps, hd] (kv heads sharded over tp; a
@@ -615,7 +809,7 @@ def sharded_paged_decode_attention(
         return pallas_paged_decode_attention(
             q_, k_, v_, t_, l_, sliding_window=sliding_window, sinks=sinks,
             pages_per_block=pages_per_block, shared_kv=shared_kv,
-            interpret=interpret,
+            merge_heads=merge_heads, interpret=interpret,
         )
 
     kv_spec = _kv_pool_spec(k_cache)

@@ -247,6 +247,30 @@ def test_pages_per_block_with_sinks(kpb):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("window,sinks", [(None, None), (6, None), (6, 4)])
+def test_merged_vs_per_head_parity(window, sinks):
+    """The merged-heads kernel (default for kv_heads > 1) and the
+    per-head escape hatch (merge_heads=False) are numerics-identical —
+    including windows and sinks, whose mask is computed once per round
+    in the merged kernel instead of per head."""
+    q, k_cache, v_cache, table, _ = build_case(q_heads=8, kv_heads=2, ctx=16)
+    ctx_lens = jnp.asarray([16, 11], jnp.int32)
+    outs = {}
+    for mh in (False, True):
+        outs[mh] = pallas_paged_decode_attention(
+            q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+            sinks=sinks, merge_heads=mh, interpret=True)
+    np.testing.assert_allclose(np.asarray(outs[True]),
+                               np.asarray(outs[False]),
+                               rtol=2e-5, atol=2e-5)
+    ref = paged_attention(
+        q[:, None], k_cache, v_cache, table, (ctx_lens - 1)[:, None],
+        ctx_lens, sliding_window=window, attention_sinks=sinks,
+    )[:, 0]
+    np.testing.assert_allclose(np.asarray(outs[True]), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_head_dim_alignment_guard(monkeypatch):
     """On real TPU, sub-128 head dims must raise a clear error instead of
     a Mosaic internal failure (lane tiling is 128; measured on v5e)."""
