@@ -155,7 +155,10 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
     honest here — the same reasoning as ``queueing_ttfts``, but with the
     service process real.
 
-    Returns ``(ttfts, hit_rate)`` with one TTFT per request.
+    Returns ``(ttfts, hit_rate, out_tok_s)`` — one TTFT per request, the
+    prefix hit rate, and the fleet's sustained output throughput
+    (decoded tokens / virtual makespan — the reference capacity tables'
+    headline unit, 73-capacity README "Summary across QPS").
     """
     import math
     import sys
@@ -167,7 +170,7 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
     arr_of: dict = {}
     ttfts: dict = {}
     emitted_once: set = set()
-    hit_tokens = total_tokens = 0
+    hit_tokens = total_tokens = out_tokens = 0
     n = len(workload)
     i = 0
     arm_start = time.perf_counter()
@@ -217,6 +220,7 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
         t0 = time.perf_counter()
         emitted = eng.step()
         clocks[p] += time.perf_counter() - t0
+        out_tokens += len(emitted)
         new_first = False
         for rid in emitted:
             if rid not in emitted_once:
@@ -230,7 +234,9 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
                   file=sys.stderr, flush=True)
 
     assert len(ttfts) == n, f"served {len(ttfts)} of {n}"
-    return [ttfts[j] for j in range(n)], hit_tokens / max(total_tokens, 1)
+    makespan = max(clocks.values())
+    return ([ttfts[j] for j in range(n)], hit_tokens / max(total_tokens, 1),
+            out_tokens / max(makespan, 1e-9))
 
 
 def make_kv_router(indexer):
@@ -671,7 +677,7 @@ def main(queued: bool = True) -> None:
         crr_indexer = fresh_indexer()
         crr_pods = make_pods(n_pods, model_cfg, engine_mod, crr_indexer,
                              params=shared_params, pod_kw=pod_kw)
-        crr_t, crr_hit = run_concurrent(
+        crr_t, crr_hit, crr_tps = run_concurrent(
             crr_pods, workload,
             lambda i, _p, names: names[i % len(names)], arr,
             tag=f"conc-rr {mult}x")
@@ -679,7 +685,7 @@ def main(queued: bool = True) -> None:
         ckv_indexer = fresh_indexer()
         ckv_pods = make_pods(n_pods, model_cfg, engine_mod, ckv_indexer,
                              params=shared_params, pod_kw=pod_kw)
-        ckv_t, ckv_hit = run_concurrent(
+        ckv_t, ckv_hit, ckv_tps = run_concurrent(
             ckv_pods, workload, make_kv_router(ckv_indexer), arr,
             tag=f"conc-kv {mult}x")
         del ckv_pods
@@ -690,6 +696,10 @@ def main(queued: bool = True) -> None:
             "kv_p50": round(statistics.median(ckv_t), 4),
             "kv_p90": round(float(np.quantile(ckv_t, 0.9)), 4),
             "rr_hit": round(crr_hit, 4), "kv_hit": round(ckv_hit, 4),
+            # Sustained output throughput (decoded tok / virtual
+            # makespan) — the reference capacity tables' headline unit.
+            "rr_out_tok_s": round(crr_tps, 1),
+            "kv_out_tok_s": round(ckv_tps, 1),
         }
         crow["reduction_pct"] = round(
             100.0 * (1.0 - crow["kv_p50"] / crow["rr_p50"]), 2)
@@ -697,7 +707,9 @@ def main(queued: bool = True) -> None:
         print(f"[bench conc ] {mult:4.2f}x capacity ({qps:6.2f} qps): "
               f"p50 rr {crow['rr_p50']:.3f}s kv {crow['kv_p50']:.3f}s "
               f"(-{crow['reduction_pct']:.1f}%), "
-              f"p90 rr {crow['rr_p90']:.3f}s kv {crow['kv_p90']:.3f}s",
+              f"p90 rr {crow['rr_p90']:.3f}s kv {crow['kv_p90']:.3f}s, "
+              f"out tok/s rr {crow['rr_out_tok_s']:.0f} "
+              f"kv {crow['kv_out_tok_s']:.0f}",
               file=_sys.stderr, flush=True)
 
     # Headline: the 1.25×-capacity point, from the CONCURRENT

@@ -78,11 +78,13 @@ class TestRunConcurrent:
         # Two bursts: 4 requests at t=0 (they must queue behind each
         # other's service) and 4 long after (no queueing).
         arrivals = [0.0, 0.0, 0.0, 0.0, 1e6, 1e6 + 1, 1e6 + 2, 1e6 + 3]
-        ttfts, hit = bench.run_concurrent(
+        ttfts, hit, out_tps = bench.run_concurrent(
             pods, wl, lambda i, _p, names: names[i % len(names)], arrivals,
             max_new_tokens=4)
         assert len(ttfts) == 8 and all(t > 0 for t in ttfts)
         assert 0.0 <= hit <= 1.0
+        # 8 requests x 4 decoded tokens over a positive makespan.
+        assert out_tps > 0
         # Every request decoded to completion through step().
         for p in pods.values():
             assert not p._running
@@ -102,7 +104,7 @@ class TestRunConcurrent:
                                   n_prefixes=1, prefix_len=12, suffix_len=4,
                                   vocab=200)
         arrivals = [0.0, 0.0, 0.0, 0.0]
-        ttfts, _ = bench.run_concurrent(
+        ttfts, _, _ = bench.run_concurrent(
             pods, wl, lambda *_a: "pod-0", arrivals, max_new_tokens=4)
         assert len(ttfts) == 4 and all(t > 0 for t in ttfts)
 
