@@ -644,7 +644,8 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float,
 
 
 def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
-                          ctx_lens, new_lens, attention_fn, last_only=False):
+                          ctx_lens, new_lens, attention_fn, last_only=False,
+                          tails=None):
     """Shared transformer body over grouped KV pools.
 
     ``k_caches[g]`` holds group g's layers stacked in ``cfg.group_layers(g)``
@@ -658,11 +659,40 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
     [seq, vocab] lm_head matmul and its fp32 materialization are pure waste
     (a 2048-token chunk of the bench model otherwise burns 0.27 TFLOP and a
     262 MB HBM write per chunk on logits nobody reads).
+
+    ``tails=(tail_ks, tail_vs, ctx_base)`` is the fused-decode-burst mode
+    (seq == 1): the paged caches are READ-ONLY (XLA copies large scan
+    carries every iteration, so the burst scan must not carry them) and
+    the current token's K/V is written into the burst-local tail buffers
+    ``tail_ks[g]`` [layers_g, batch, steps, kvh, width] at slot
+    ``ctx_lens - ctx_base`` instead; attention folds the tail after the
+    paged keys (ops-level ``tail_k/tail_v/tail_lens``). Returns
+    ``(logits, tail_ks, tail_vs)`` in place of the caches; the caller
+    scatters the accumulated tail into the caches once, outside the scan.
     """
     batch, seq = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
     valid = jnp.arange(seq)[None, :] < new_lens[:, None]
     total_lens = ctx_lens + new_lens
+    if tails is not None:
+        tail_ks, tail_vs, ctx_base = tails
+        tail_ks, tail_vs = list(tail_ks), list(tail_vs)
+        t_steps = tail_ks[0].shape[2]
+        slot = ctx_lens - ctx_base  # [b] tail tokens already written
+        # One-hot write mask over tail slots (t_steps ≤ burst size, so a
+        # where over [b, T, ...] beats any scatter): live rows write the
+        # current token at slot; frozen rows write nothing.
+        tmask = ((jnp.arange(t_steps)[None, :] == slot[:, None])
+                 & valid)  # [b, T]
+        tail_lens = slot + new_lens  # attendable tail keys incl. current
+
+        def write_tail(buf, new_kv):
+            # buf [b, T, kvh, w]; new_kv [b, 1, kvh, w] broadcasts over T.
+            return jnp.where(tmask[:, :, None, None], new_kv, buf)
+
+        def tail_kwargs(tk_l, tv_l):
+            return dict(tail_k=tk_l, tail_v=tv_l, tail_lens=tail_lens,
+                        ctx_base=ctx_base)
 
     # Static layer→(group, local index) map, resolved at trace time.
     local_idx = {}
@@ -728,16 +758,27 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                 q_eff.shape[-1] ** 0.5 / (cfg.head_dim + dr) ** 0.5
                 * cfg.softmax_scale_mult)
 
-            k_caches[g] = k_caches[g].at[lj].set(
-                scatter_kv_pages(k_caches[g][lj], latent, table, positions,
-                                 valid)
-            )
-            # Values ARE the latent: pass the K pool as both K and V (the
-            # width-0 V pool is never read), then un-absorb W_UV.
-            ctx = attention_fn(
-                q_eff, k_caches[g][lj], k_caches[g][lj], table, positions,
-                total_lens, None,
-            )
+            if tails is not None:
+                tail_ks[g] = tail_ks[g].at[lj].set(
+                    write_tail(tail_ks[g][lj], latent))
+                ctx = attention_fn(
+                    q_eff, k_caches[g][lj], k_caches[g][lj], table,
+                    positions, total_lens, None,
+                    k_stack=k_caches[g], v_stack=k_caches[g], layer_idx=lj,
+                    **tail_kwargs(tail_ks[g][lj], tail_ks[g][lj]),
+                )
+            else:
+                k_caches[g] = k_caches[g].at[lj].set(
+                    scatter_kv_pages(k_caches[g][lj], latent, table,
+                                     positions, valid)
+                )
+                # Values ARE the latent: pass the K pool as both K and V
+                # (the width-0 V pool is never read), then un-absorb W_UV.
+                ctx = attention_fn(
+                    q_eff, k_caches[g][lj], k_caches[g][lj], table,
+                    positions, total_lens, None,
+                    k_stack=k_caches[g], v_stack=k_caches[g], layer_idx=lj,
+                )
             attn = jnp.einsum("bshr,hrv->bshv", ctx[..., :r], layer["w_uv"])
         else:
             q = attn_in @ layer["wq"]
@@ -756,17 +797,32 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
             k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
-            k_caches[g] = k_caches[g].at[lj].set(
-                scatter_kv_pages(k_caches[g][lj], k, table, positions, valid)
-            )
-            v_caches[g] = v_caches[g].at[lj].set(
-                scatter_kv_pages(v_caches[g][lj], v, table, positions, valid)
-            )
+            if tails is not None:
+                tail_ks[g] = tail_ks[g].at[lj].set(
+                    write_tail(tail_ks[g][lj], k))
+                tail_vs[g] = tail_vs[g].at[lj].set(
+                    write_tail(tail_vs[g][lj], v))
+                attn = attention_fn(
+                    q, k_caches[g][lj], v_caches[g][lj], table, positions,
+                    total_lens, cfg.layer_window(li),
+                    k_stack=k_caches[g], v_stack=v_caches[g], layer_idx=lj,
+                    **tail_kwargs(tail_ks[g][lj], tail_vs[g][lj]),
+                )
+            else:
+                k_caches[g] = k_caches[g].at[lj].set(
+                    scatter_kv_pages(k_caches[g][lj], k, table, positions,
+                                     valid)
+                )
+                v_caches[g] = v_caches[g].at[lj].set(
+                    scatter_kv_pages(v_caches[g][lj], v, table, positions,
+                                     valid)
+                )
 
-            attn = attention_fn(
-                q, k_caches[g][lj], v_caches[g][lj], table, positions,
-                total_lens, cfg.layer_window(li),
-            )
+                attn = attention_fn(
+                    q, k_caches[g][lj], v_caches[g][lj], table, positions,
+                    total_lens, cfg.layer_window(li),
+                    k_stack=k_caches[g], v_stack=v_caches[g], layer_idx=lj,
+                )
         x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
 
         mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -777,6 +833,8 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
         idx = jnp.maximum(new_lens - 1, 0)  # [b]
         x = jnp.take_along_axis(x, idx[:, None, None], axis=1)  # [b, 1, h]
     logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if tails is not None:
+        return logits, tuple(tail_ks), tuple(tail_vs)
     return logits, tuple(k_caches), tuple(v_caches)
 
 
@@ -810,7 +868,8 @@ def forward(
     page. ``last_only=True`` → logits is [b, 1, vocab], the final valid
     position of each row (prefill chunks; see ``_forward_impl_grouped``).
     """
-    def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
+    def xla_attention(q, k_l, v_l, table, positions, total_lens, window,
+                      **_stack_kw):  # slices fuse into XLA's gather
         return paged_attention(
             q, k_l, v_l, table, positions, total_lens, sliding_window=window,
             attention_sinks=cfg.attention_sinks or None,
@@ -840,7 +899,8 @@ def forward_hybrid(
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """One model step for a hybrid (mixed full/SWA) model over two
     separately-paged cache groups. XLA attention backend."""
-    def xla_attention(q, k_l, v_l, table, positions, total_lens, window):
+    def xla_attention(q, k_l, v_l, table, positions, total_lens, window,
+                      **_stack_kw):  # slices fuse into XLA's gather
         return paged_attention(
             q, k_l, v_l, table, positions, total_lens, sliding_window=window,
             attention_sinks=cfg.attention_sinks or None,
@@ -882,18 +942,24 @@ def forward_decode_pallas(
 
     sinks = cfg.attention_sinks or None
 
-    def pallas_attention(q, k_l, v_l, table, _positions, total_lens, window):
+    def pallas_attention(q, k_l, v_l, table, _positions, total_lens, window,
+                         k_stack=None, v_stack=None, layer_idx=None):
+        # Prefer the stacked operand + in-kernel layer index: a sliced
+        # cache materializes a per-layer copy at the pallas custom-call
+        # boundary (see ops.pallas_paged_attention._superblock_streamer).
+        if k_stack is not None:
+            k_l, v_l = k_stack, v_stack
         if mesh is not None:
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, total_lens,
                 sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
-                interpret=interpret,
+                layer_idx=layer_idx, interpret=interpret,
             )
         else:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
                 sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
-                interpret=interpret,
+                layer_idx=layer_idx, interpret=interpret,
             )
         return out[:, None]  # restore the seq axis
 
@@ -915,24 +981,39 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
     from ..ops.pallas_paged_attention import (
         pallas_paged_decode_attention, sharded_paged_decode_attention)
 
-    def attention(q, k_l, v_l, table, positions, total_lens, window):
+    def attention(q, k_l, v_l, table, positions, total_lens, window,
+                  tail_k=None, tail_v=None, tail_lens=None, ctx_base=None,
+                  k_stack=None, v_stack=None, layer_idx=None):
+        # Burst-tail mode: the paged cache covers only ctx_base keys; the
+        # tail holds the burst's tokens (see _forward_impl_grouped).
+        base_lens = total_lens if ctx_base is None else ctx_base
+        if use_pallas and k_stack is not None:
+            # Stacked operand + in-kernel layer index: a sliced cache
+            # materializes a per-layer copy at the pallas custom-call
+            # boundary.
+            k_l, v_l = k_stack, v_stack
+        else:
+            layer_idx = None
         if use_pallas and mesh is not None:
             out = sharded_paged_decode_attention(
-                mesh, q[:, 0], k_l, v_l, table, total_lens,
+                mesh, q[:, 0], k_l, v_l, table, base_lens,
                 sliding_window=window, sinks=sinks, shared_kv=shared_kv,
-                interpret=interpret,
+                tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
+                layer_idx=layer_idx, interpret=interpret,
             )
             return out[:, None]
         if use_pallas:
             out = pallas_paged_decode_attention(
-                q[:, 0], k_l, v_l, table, total_lens,
+                q[:, 0], k_l, v_l, table, base_lens,
                 sliding_window=window, sinks=sinks, shared_kv=shared_kv,
-                interpret=interpret,
+                tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
+                layer_idx=layer_idx, interpret=interpret,
             )
             return out[:, None]
         return paged_attention(
-            q, k_l, v_l, table, positions, total_lens, sliding_window=window,
-            attention_sinks=sinks,
+            q, k_l, v_l, table, positions, base_lens, sliding_window=window,
+            attention_sinks=sinks, tail_k=tail_k, tail_v=tail_v,
+            tail_lens=tail_lens,
         )
 
     return attention
@@ -977,6 +1058,16 @@ def forward_decode_steps(
     ``max_new_tokens`` at admission).
     Returns ``(tokens [batch, steps], k_cache, v_cache)``; row i's valid
     entries are the first ``min(active[i], steps)``.
+
+    The scan does NOT carry the caches (XLA copies large while-loop
+    carries every iteration — see ``_decode_steps_scan``); burst tokens
+    accumulate in a small KV tail folded into attention per step and are
+    scattered into the caches once, after the scan. The XLA backend's
+    burst is bit-identical to single-stepping (same softmax structure);
+    the Pallas backend's fp32 tail round sums in a different order than
+    the in-page rounds, so greedy argmax can legitimately flip on
+    logit ties within ~1 bf16 ulp (random-weight test models tie often;
+    trained models rarely).
     """
     toks, ks, vs = _decode_steps_scan(
         params, cfg, last_tokens, (k_cache,), (v_cache,), (page_table,),
@@ -993,23 +1084,58 @@ def _decode_steps_scan(params, cfg, last_tokens, k_caches, v_caches, tables,
     """The fused-decode scan body over grouped KV pools — one
     implementation for the single-pool (1-tuple degenerate form, mirroring
     ``_forward_impl``) and hybrid two-pool variants, so the live/freeze and
-    ctx-advance semantics cannot diverge between them."""
+    ctx-advance semantics cannot diverge between them.
+
+    The paged caches are scan CONSTANTS, not carries: XLA copies large
+    while-loop carries every iteration (measured ~300 GB/s r+w on a v5e —
+    a 4.6 GB cache pair cost ~30 ms/step of pure copy at production pool
+    sizes), so each tick attends over the frozen base cache plus a
+    burst-local KV tail (≤steps tokens, the only carried KV state) and
+    the accumulated tail is scattered into the caches ONCE after the
+    scan, where jit-boundary donation keeps it in place.
+    """
+    batch = last_tokens.shape[0]
+    tail_ks = tuple(
+        jnp.zeros((kc.shape[0], batch, steps) + kc.shape[2:3] + kc.shape[4:],
+                  kc.dtype)
+        for kc in k_caches)
+    tail_vs = tuple(
+        jnp.zeros((vc.shape[0], batch, steps) + vc.shape[2:3] + vc.shape[4:],
+                  vc.dtype)
+        for vc in v_caches)
 
     def body(carry, tick):
-        toks, ks, vs, ctx = carry
+        toks, tks, tvs, ctx = carry
         live = (tick < active).astype(jnp.int32)  # [batch]
-        logits, ks, vs = _forward_impl_grouped(
-            params, cfg, toks[:, None], ks, vs, tables, ctx, live, attention,
+        logits, tks, tvs = _forward_impl_grouped(
+            params, cfg, toks[:, None], k_caches, v_caches, tables, ctx,
+            live, attention, tails=(tks, tvs, ctx_lens),
         )
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
         nxt = jnp.where(live > 0, nxt, toks)
-        return (nxt, ks, vs, ctx + live), nxt
+        return (nxt, tks, tvs, ctx + live), nxt
 
-    (_t, k_caches, v_caches, _c), toks = jax.lax.scan(
-        body, (last_tokens, tuple(k_caches), tuple(v_caches), ctx_lens),
+    (_t, tail_ks, tail_vs, _c), toks = jax.lax.scan(
+        body, (last_tokens, tail_ks, tail_vs, ctx_lens),
         jnp.arange(steps, dtype=jnp.int32),
     )
-    return toks.T, k_caches, v_caches  # toks [batch, steps]
+
+    # Fold the burst's tokens into the paged caches — one batched scatter
+    # per (group, layer, K/V) at the program tail, in place on the
+    # donated buffers.
+    tpos = ctx_lens[:, None] + jnp.arange(steps)[None, :]  # [b, T]
+    tvalid = jnp.arange(steps)[None, :] < jnp.minimum(active, steps)[:, None]
+    k_caches = list(k_caches)
+    v_caches = list(v_caches)
+    for g in range(len(k_caches)):
+        for lj in range(k_caches[g].shape[0]):
+            k_caches[g] = k_caches[g].at[lj].set(scatter_kv_pages(
+                k_caches[g][lj], tail_ks[g][lj], tables[g], tpos, tvalid))
+            if v_caches[g].shape[-1]:  # MLA's width-0 V pool has no data
+                v_caches[g] = v_caches[g].at[lj].set(scatter_kv_pages(
+                    v_caches[g][lj], tail_vs[g][lj], tables[g], tpos,
+                    tvalid))
+    return toks.T, tuple(k_caches), tuple(v_caches)  # toks [batch, steps]
 
 
 @partial(
@@ -1096,17 +1222,25 @@ def forward_prefill_pallas(
 
     sinks = cfg.attention_sinks or None
 
-    def attention_fn(q, k_l, v_l, table, positions, total_lens, window):
+    def attention_fn(q, k_l, v_l, table, positions, total_lens, window,
+                     k_stack=None, v_stack=None, layer_idx=None):
+        # Stacked operand + in-kernel layer index: a sliced cache
+        # materializes a per-layer copy at the pallas custom-call
+        # boundary (see ops.pallas_paged_attention._superblock_streamer).
+        if k_stack is not None:
+            k_l, v_l = k_stack, v_stack
         if mesh is not None:
             return sharded_paged_prefill_attention(
                 mesh, q, k_l, v_l, table, ctx_lens, total_lens,
                 q_tile=q_tile, sliding_window=window,
-                sinks=sinks, shared_kv=cfg.is_mla, interpret=interpret,
+                sinks=sinks, shared_kv=cfg.is_mla, layer_idx=layer_idx,
+                interpret=interpret,
             )
         return pallas_paged_prefill_attention(
             q, k_l, v_l, table, ctx_lens, total_lens,
             q_tile=q_tile, sliding_window=window,
-            sinks=sinks, shared_kv=cfg.is_mla, interpret=interpret,
+            sinks=sinks, shared_kv=cfg.is_mla, layer_idx=layer_idx,
+            interpret=interpret,
         )
 
     return _forward_impl(

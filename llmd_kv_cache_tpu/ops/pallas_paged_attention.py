@@ -60,7 +60,8 @@ def _check_head_dim_alignment(head_dim: int, interpret: bool) -> None:
 
 def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
                          v_scratch, sem, *, kpb, num_iters, first_window,
-                         sink_pages, sinks, shared_kv=False):
+                         sink_pages, sinks, shared_kv=False,
+                         layer_idx=None):
     """Shared page remap + superblock DMA for the decode/prefill kernels.
 
     ``page_for`` (internal) maps a loop counter to a page-table index —
@@ -87,21 +88,28 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
                             first_window + (j - sink_pages))
         return jnp.minimum(idx, pp_seq - 1)
 
+    def page_src(hbm, page):
+        # layer_idx: the operand is the engine's full [layers, pages, …]
+        # stack and the kernel indexes the layer itself — slicing the
+        # stack OUTSIDE a pallas_call materializes a full per-layer copy
+        # at the custom-call boundary (XLA cannot fuse a producer slice
+        # into a custom call; measured ~0.9 ms/layer/step in the decode
+        # burst). h=None: merged-heads mode — one whole-page copy
+        # carries every kv head, cutting the DMA count by kv_heads×.
+        src = hbm if layer_idx is None else hbm.at[layer_idx]
+        return src.at[page] if h is None else src.at[page, h]
+
     def sb_dma(slot, sb):
         copies = []
         for t in range(kpb):
             page = page_table_ref[b, page_for(sb * kpb + t)]
-            # h=None: merged-heads mode — one whole-page copy carries
-            # every kv head ([kv_heads, page_size, head_dim] slice),
-            # cutting the DMA count by kv_heads×.
-            k_src = k_hbm.at[page] if h is None else k_hbm.at[page, h]
             copies.append(pltpu.make_async_copy(
-                k_src, k_scratch.at[slot, t], sem.at[slot, t, 0]
+                page_src(k_hbm, page), k_scratch.at[slot, t],
+                sem.at[slot, t, 0]
             ))
             if not shared_kv:
-                v_src = v_hbm.at[page] if h is None else v_hbm.at[page, h]
                 copies.append(pltpu.make_async_copy(
-                    v_src, v_scratch.at[slot, t],
+                    page_src(v_hbm, page), v_scratch.at[slot, t],
                     sem.at[slot, t, 1]
                 ))
         return copies
@@ -125,16 +133,19 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
     return positions, sb_dma
 
 
-def _decode_stream_bounds(ctx_len, page_size, sliding_window, sinks):
+def _decode_stream_bounds(ctx_len, q_end, page_size, sliding_window, sinks):
     """(first_window, sink_pages, num_iters) for a decode stream over
     keys [0, ctx_len). One definition for the per-head and merged decode
     kernels so the window/sink page arithmetic cannot drift between
     them (same rationale as ``_superblock_streamer``). SWA skips pages
-    wholly before ctx_len - window; sinks keep the first
-    ceil(S/page_size) pages streamed via the loop-counter remap."""
+    wholly before q_end - window (``q_end`` is the exclusive query
+    position bound — ctx_len without a burst tail, ctx_len + tail_len
+    with one); sinks keep the first ceil(S/page_size) pages streamed via
+    the loop-counter remap."""
     num_pages = (ctx_len + page_size - 1) // page_size
     if sliding_window is not None:
-        first_window = jnp.maximum(ctx_len - sliding_window, 0) // page_size
+        first_window = jnp.minimum(
+            jnp.maximum(q_end - sliding_window, 0) // page_size, num_pages)
     else:
         first_window = jnp.int32(0)
     if sinks:
@@ -147,27 +158,79 @@ def _decode_stream_bounds(ctx_len, page_size, sliding_window, sinks):
     return first_window, sink_pages, num_iters
 
 
-def _decode_mask(positions, ctx_len, sliding_window, sinks):
-    """Attendability of decode key ``positions``: in-bounds, and inside
-    the sliding window unless a sink position. Shared between the
-    per-head and merged decode kernels."""
+def _decode_mask(positions, ctx_len, q_end, sliding_window, sinks):
+    """Attendability of decode key ``positions``: in-bounds (< ctx_len),
+    and inside the sliding window of the query at position ``q_end - 1``
+    unless a sink position. Shared between the per-head and merged
+    decode kernels."""
     in_bounds = positions < ctx_len
     if sliding_window is not None:
-        in_window = positions >= ctx_len - sliding_window
+        in_window = positions >= q_end - sliding_window
         if sinks:
             in_window = in_window | (positions < sinks)
         in_bounds = in_bounds & in_window
     return in_bounds
 
 
+def _tail_fold(q_h, k_t, v_t, tail_len, ctx_len, m, l, acc, *,
+               scale, sliding_window, sinks):
+    """Fold the dense burst-local KV tail (one extra online-softmax
+    round) into one head's state. ``k_t``/``v_t`` are that head's tail
+    keys/values [T, head_dim]. Tail slot ``j`` holds the key at logical
+    position ctx_len + j, attendable while ``j < tail_len``; the query
+    sits at ctx_len + tail_len - 1, so the window condition is
+    ``tail_len - 1 - j < W`` — except sink positions (absolute position
+    ctx_len + j < S), which stay attendable past the window like any
+    other sink key (reachable only when ctx_len < S and the burst
+    outruns the window, but the XLA reference keeps them and the mask
+    must not drift). Shared by the merged and per-head decode kernels.
+
+    The fold computes in explicit fp32: Mosaic miscompiles
+    mixed-precision dots with tiny contraction/result dims (T ≤ burst —
+    loud 'vector.broadcast' verifier failure at T=1, silently wrong
+    values at T=8 with 384-wide MLA operands on a real v5e). bf16→fp32
+    upcast is exact, so the scores match the bf16-operand/fp32-accum
+    MXU path up to summation order, and the tail is tiny so fp32 VPU
+    compute costs nothing."""
+    t = k_t.shape[0]
+    scores = jax.lax.dot_general(
+        q_h.astype(jnp.float32), k_t.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [group, T]
+    jt = jax.lax.broadcasted_iota(jnp.int32, (1, t), 1)
+    ok = jt < tail_len
+    if sliding_window is not None:
+        in_window = tail_len - 1 - jt < sliding_window
+        if sinks:
+            in_window = in_window | (ctx_len + jt < sinks)
+        ok = ok & in_window
+    scores = jnp.where(ok, scores, _NEG_INF)
+
+    m_cur = jnp.max(scores, axis=1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(scores - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_new = acc * alpha + jax.lax.dot_general(
+        p, v_t.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
 def _decode_kernel(
     # scalar prefetch
     page_table_ref,  # [batch, pages_per_seq] int32 (SMEM)
     ctx_lens_ref,  # [batch] int32 (SMEM)
+    tail_lens_ref,  # [batch] int32 (SMEM; zeros when has_tail=False)
     # inputs
     q_ref,  # [1, 1, group, head_dim] VMEM block for (b, h)
     k_hbm,  # [num_pages, kv_heads, page_size, head_dim] (ANY/HBM)
     v_hbm,  # same
+    tail_k_ref,  # [1, 1, T, head_dim] VMEM block for (b, h); dummy if no tail
+    tail_v_ref,  # same (placeholder when shared_kv)
     # output
     o_ref,  # [1, 1, group, head_dim] VMEM block
     # scratch
@@ -181,6 +244,8 @@ def _decode_kernel(
     sinks: int,
     pages_per_block: int,
     shared_kv: bool,
+    has_tail: bool,
+    layer_idx: int | None,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -188,6 +253,8 @@ def _decode_kernel(
     kpb = pages_per_block
 
     ctx_len = ctx_lens_ref[b]
+    tail_len = tail_lens_ref[b] if has_tail else jnp.int32(0)
+    q_end = ctx_len + tail_len
     # SWA: pages entirely outside the window are skipped, so long contexts
     # stream only ~window/page_size pages. Attention sinks (StreamingLLM,
     # reference events.go:40 sink_full_attention) additionally stream the
@@ -196,7 +263,7 @@ def _decode_kernel(
     # [first_window, num_pages) — so the double-buffered DMA pipeline is
     # unchanged and the skipped middle costs nothing.
     first_window, sink_pages, num_iters = _decode_stream_bounds(
-        ctx_len, page_size, sliding_window, sinks)
+        ctx_len, q_end, page_size, sliding_window, sinks)
     # Pages stream in superblocks of ``kpb``: each round waits on one
     # batch of kpb in-flight DMAs (4 KB single-page transfers underuse
     # HBM bandwidth; a 128-key superblock moves 64 KB per K/V round) and
@@ -208,7 +275,8 @@ def _decode_kernel(
     sb_positions, sb_dma = _superblock_streamer(
         page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
-        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
+        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv,
+        layer_idx=layer_idx)
 
     @pl.when(num_sb > 0)
     def _():
@@ -247,7 +315,8 @@ def _decode_kernel(
         # sink positions, which stay attendable forever); sub-pages past
         # num_iters park at ctx_len so every mask term rejects them.
         positions = sb_positions(sb, ctx_len, page_size)
-        in_bounds = _decode_mask(positions, ctx_len, sliding_window, sinks)
+        in_bounds = _decode_mask(positions, ctx_len, q_end, sliding_window,
+                                 sinks)
         scores = jnp.where(in_bounds, scores, _NEG_INF)
 
         m_cur = jnp.max(scores, axis=1, keepdims=True)  # [group, 1]
@@ -265,7 +334,13 @@ def _decode_kernel(
     m0 = jnp.full((group, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((group, 1), jnp.float32)
     acc0 = jnp.zeros((group, head_dim), jnp.float32)
-    _m, l_fin, acc = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
+    m_fin, l_fin, acc = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
+    if has_tail:
+        k_t = tail_k_ref[0, 0]  # [T, head_dim]; head picked by the block
+        v_t = k_t if shared_kv else tail_v_ref[0, 0]
+        m_fin, l_fin, acc = _tail_fold(
+            q, k_t, v_t, tail_len, ctx_len, m_fin, l_fin, acc,
+            scale=scale, sliding_window=sliding_window, sinks=sinks)
 
     out = acc / jnp.maximum(l_fin, 1e-30)
     o_ref[0, 0] = out.astype(o_ref.dtype)
@@ -275,10 +350,13 @@ def _decode_kernel_merged(
     # scalar prefetch
     page_table_ref,  # [batch, pages_per_seq] int32 (SMEM)
     ctx_lens_ref,  # [batch] int32 (SMEM)
+    tail_lens_ref,  # [batch] int32 (SMEM; zeros when has_tail=False)
     # inputs
     q_ref,  # [1, kv_heads, group, head_dim] VMEM block for (b,)
     k_hbm,  # [num_pages, kv_heads, page_size, head_dim] (ANY/HBM)
     v_hbm,  # same
+    tail_k_ref,  # [1, T, kv_heads, head_dim] VMEM block; dummy if no tail
+    tail_v_ref,  # same (placeholder when shared_kv)
     # output
     o_ref,  # [1, kv_heads, group, head_dim] VMEM block
     # scratch
@@ -292,6 +370,8 @@ def _decode_kernel_merged(
     sinks: int,
     pages_per_block: int,
     shared_kv: bool,
+    has_tail: bool,
+    layer_idx: int | None,
 ):
     """Decode with every kv head in ONE program per batch item.
 
@@ -312,14 +392,17 @@ def _decode_kernel_merged(
     kpb = pages_per_block
 
     ctx_len = ctx_lens_ref[b]
+    tail_len = tail_lens_ref[b] if has_tail else jnp.int32(0)
+    q_end = ctx_len + tail_len
     first_window, sink_pages, num_iters = _decode_stream_bounds(
-        ctx_len, page_size, sliding_window, sinks)
+        ctx_len, q_end, page_size, sliding_window, sinks)
     num_sb = (num_iters + kpb - 1) // kpb
 
     sb_positions, sb_dma = _superblock_streamer(
         page_table_ref, b, None, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
-        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
+        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv,
+        layer_idx=layer_idx)
 
     @pl.when(num_sb > 0)
     def _():
@@ -344,7 +427,8 @@ def _decode_kernel_merged(
         # Shared mask for every head: positions depend only on the batch
         # item's pages — the per-head grid recomputed this kv_heads×.
         positions = sb_positions(sb, ctx_len, page_size)
-        in_bounds = _decode_mask(positions, ctx_len, sliding_window, sinks)
+        in_bounds = _decode_mask(positions, ctx_len, q_end, sliding_window,
+                                 sinks)
 
         new_ms, new_ls, new_accs = [], [], []
         for h in range(kv_heads):
@@ -379,7 +463,19 @@ def _decode_kernel_merged(
     l0 = tuple(jnp.zeros((group, 1), jnp.float32) for _ in range(kv_heads))
     acc0 = tuple(jnp.zeros((group, head_dim), jnp.float32)
                  for _ in range(kv_heads))
-    _ms, l_fin, accs = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
+    ms, l_fin, accs = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
+
+    if has_tail:
+        folded = [_tail_fold(qs[h], tail_k_ref[0, :, h],
+                             tail_k_ref[0, :, h] if shared_kv
+                             else tail_v_ref[0, :, h],
+                             tail_len, ctx_len, ms[h], l_fin[h], accs[h],
+                             scale=scale, sliding_window=sliding_window,
+                             sinks=sinks)
+                  for h in range(kv_heads)]
+        ms = tuple(f[0] for f in folded)
+        l_fin = tuple(f[1] for f in folded)
+        accs = tuple(f[2] for f in folded)
 
     for h in range(kv_heads):
         out = accs[h] / jnp.maximum(l_fin[h], 1e-30)
@@ -409,6 +505,7 @@ def _prefill_kernel(
     sinks: int,
     pages_per_block: int,
     shared_kv: bool,
+    layer_idx: int | None,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -453,7 +550,8 @@ def _prefill_kernel(
     sb_positions, sb_dma = _superblock_streamer(
         page_table_ref, b, h, k_hbm, v_hbm, k_scratch, v_scratch, sem,
         kpb=kpb, num_iters=num_iters, first_window=first_window,
-        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv)
+        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv,
+        layer_idx=layer_idx)
 
     @pl.when(num_sb > 0)
     def _():
@@ -526,7 +624,7 @@ def _prefill_kernel(
 @functools.partial(jax.jit,
                    static_argnames=("q_tile", "sliding_window", "sinks",
                                     "pages_per_block", "shared_kv",
-                                    "interpret"))
+                                    "layer_idx", "interpret"))
 def pallas_paged_prefill_attention(
     q: jax.Array,  # [batch, q_seq, q_heads, head_dim] (new tokens, padded)
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -540,6 +638,7 @@ def pallas_paged_prefill_attention(
     sinks: int | None = None,
     pages_per_block: int | None = None,
     shared_kv: bool = False,
+    layer_idx: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash prefill over paged KV (new tokens' KV already scattered).
@@ -560,7 +659,12 @@ def pallas_paged_prefill_attention(
     stays within a few MB of VMEM.
     """
     batch, q_seq, q_heads, head_dim = q.shape
-    _, kv_heads, page_size, _ = k_cache.shape
+    # layer_idx: caches are the engine's full [layers, pages, …] stack and
+    # the kernel DMAs from [layer_idx, page, …] directly — slicing the
+    # stack outside the pallas_call would materialize a per-layer copy at
+    # the custom-call boundary.
+    cache_dims = k_cache.shape[1:] if layer_idx is not None else k_cache.shape
+    _, kv_heads, page_size, _ = cache_dims
     group = q_heads // kv_heads
     assert q_seq % q_tile == 0, "pad q_seq to a q_tile multiple"
     if sliding_window is None:
@@ -581,7 +685,7 @@ def pallas_paged_prefill_attention(
         _prefill_kernel, page_size=page_size, q_tile=q_tile,
         scale=head_dim ** -0.5, sliding_window=sliding_window,
         sinks=int(sinks or 0), pages_per_block=pages_per_block,
-        shared_kv=shared_kv,
+        shared_kv=shared_kv, layer_idx=layer_idx,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -627,7 +731,7 @@ def pallas_paged_prefill_attention(
 @functools.partial(jax.jit,
                    static_argnames=("interpret", "sliding_window", "sinks",
                                     "pages_per_block", "shared_kv",
-                                    "merge_heads"))
+                                    "merge_heads", "layer_idx"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -640,6 +744,10 @@ def pallas_paged_decode_attention(
     pages_per_block: int | None = None,
     shared_kv: bool = False,
     merge_heads: bool | None = None,
+    tail_k: jax.Array | None = None,  # [batch, T, kv_heads, head_dim]
+    tail_v: jax.Array | None = None,
+    tail_lens: jax.Array | None = None,  # [batch] valid tail tokens
+    layer_idx: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
@@ -659,7 +767,10 @@ def pallas_paged_decode_attention(
     hatch.
     """
     batch, q_heads, head_dim = q.shape
-    num_pages_total, kv_heads, page_size, _ = k_cache.shape
+    # layer_idx: see the prefill wrapper — stacked caches, in-kernel
+    # layer indexing, no per-layer slice copy at the custom-call boundary.
+    cache_dims = k_cache.shape[1:] if layer_idx is not None else k_cache.shape
+    num_pages_total, kv_heads, page_size, _ = cache_dims
     group = q_heads // kv_heads
     if sliding_window is None:
         sinks = None  # no-op without a window (see the prefill wrapper)
@@ -687,15 +798,26 @@ def pallas_paged_decode_attention(
 
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 
+    has_tail = tail_k is not None
+    if not has_tail:
+        # Structural placeholders: the kernels always take tail refs so
+        # the two arities share one code path; has_tail=False makes the
+        # fold dead code and the 2 KB dummy blocks are never read.
+        tail_k = jnp.zeros((batch, 1, kv_heads, head_dim), k_cache.dtype)
+        tail_lens = jnp.zeros((batch,), jnp.int32)
+    if shared_kv or not has_tail:
+        tail_v = jnp.zeros((batch, 1, kv_heads, head_dim), k_cache.dtype)
+    t_len = tail_k.shape[1]
+
     if merge_heads:
         kernel = functools.partial(
             _decode_kernel_merged, page_size=page_size,
             scale=head_dim ** -0.5, sliding_window=sliding_window,
             sinks=int(sinks or 0), pages_per_block=pages_per_block,
-            shared_kv=shared_kv,
+            shared_kv=shared_kv, has_tail=has_tail, layer_idx=layer_idx,
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(batch,),
             in_specs=[
                 pl.BlockSpec(
@@ -704,6 +826,14 @@ def pallas_paged_decode_attention(
                 ),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(
+                    (1, t_len, kv_heads, head_dim),
+                    lambda b, *_prefetch: (b, 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, tail_v.shape[1], kv_heads, head_dim),
+                    lambda b, *_prefetch: (b, 0, 0, 0),
+                ),
             ],
             out_specs=pl.BlockSpec(
                 (1, kv_heads, group, head_dim),
@@ -721,13 +851,21 @@ def pallas_paged_decode_attention(
             ],
         )
     else:
+        # Tail transposed to [batch, kvh, T, hd] for this path: Mosaic
+        # requires the last two block dims to divide (8, 128) or equal
+        # the array dims — a size-1 block on a kvh>1 second-to-last axis
+        # is rejected, so the head axis moves out of the blocked pair
+        # and is picked by the index map.
+        tail_k = tail_k.transpose(0, 2, 1, 3)
+        tail_v = tail_v.transpose(0, 2, 1, 3)
         kernel = functools.partial(
             _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
             sliding_window=sliding_window, sinks=int(sinks or 0),
             pages_per_block=pages_per_block, shared_kv=shared_kv,
+            has_tail=has_tail, layer_idx=layer_idx,
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(batch, kv_heads),
             in_specs=[
                 pl.BlockSpec(
@@ -737,6 +875,14 @@ def pallas_paged_decode_attention(
                 ),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(
+                    (1, 1, t_len, head_dim),
+                    lambda b, h, *_prefetch: (b, h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, tail_v.shape[2], head_dim),
+                    lambda b, h, *_prefetch: (b, h, 0, 0),
+                ),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, group, head_dim),
@@ -762,28 +908,36 @@ def pallas_paged_decode_attention(
         grid_spec=grid_spec,
         interpret=interpret,
     )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-      q_blocked, k_cache, v_cache)
+      tail_lens.astype(jnp.int32),
+      q_blocked, k_cache, v_cache, tail_k.astype(k_cache.dtype),
+      tail_v.astype(k_cache.dtype))
 
     return out.reshape(batch, q_heads, head_dim)
 
 
-def _kv_pool_spec(k_cache):
+def _kv_pool_spec(k_cache, stacked=False):
     """Cache PartitionSpec under tp: kv-heads axis sharded, except the
     single-shared-head (MQA/absorbed-MLA) pool, which replicates — a
     width-1 axis cannot shard, and replicating the latent is what lets
     each shard attend its local query heads with zero cross-shard traffic
-    (matches ``parallel.serve.shard_kv_pool`` placement)."""
+    (matches ``parallel.serve.shard_kv_pool`` placement). ``stacked``:
+    the operand is the full [layers, pages, kvh, ps, hd] stack (kernel
+    indexes the layer in-DMA)."""
     from jax.sharding import PartitionSpec as P
 
-    if k_cache.shape[1] == 1:
+    kvh_axis = 2 if stacked else 1
+    if k_cache.shape[kvh_axis] == 1:
         return P()
+    if stacked:
+        return P(None, None, "tp", None, None)
     return P(None, "tp", None, None)
 
 
 def sharded_paged_decode_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
     sliding_window=None, sinks=None, pages_per_block=None, shared_kv=False,
-    merge_heads=None, interpret=False,
+    merge_heads=None, tail_k=None, tail_v=None, tail_lens=None,
+    layer_idx=None, interpret=False,
 ):
     """Flash-decode over a tp-sharded paged KV cache.
 
@@ -805,27 +959,48 @@ def sharded_paged_decode_attention(
     from ..utils.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
-    def local(q_, k_, v_, t_, l_):
+    has_tail = tail_k is not None
+
+    def local(q_, k_, v_, t_, l_, tk_, tv_, tl_):
         return pallas_paged_decode_attention(
             q_, k_, v_, t_, l_, sliding_window=sliding_window, sinks=sinks,
             pages_per_block=pages_per_block, shared_kv=shared_kv,
-            merge_heads=merge_heads, interpret=interpret,
+            merge_heads=merge_heads,
+            tail_k=tk_ if has_tail else None,
+            tail_v=tv_ if has_tail else None,
+            tail_lens=tl_ if has_tail else None,
+            layer_idx=layer_idx, interpret=interpret,
         )
 
-    kv_spec = _kv_pool_spec(k_cache)
+    kv_spec = _kv_pool_spec(k_cache, stacked=layer_idx is not None)
+    # Tail buffers shard on their kv-heads axis alongside the pool (a
+    # replicated single-head MLA pool replicates its tail too).
+    kvh_axis = 2 if layer_idx is not None else 1
+    tail_spec = (P() if k_cache.shape[kvh_axis] == 1
+                 else P(None, None, "tp", None))
+    if not has_tail:
+        # Zero-size placeholders keep the shard_map arity fixed.
+        batch = q.shape[0]
+        tail_k = jnp.zeros(
+            (batch, 1, k_cache.shape[kvh_axis], k_cache.shape[-1]),
+            k_cache.dtype)
+        tail_v = tail_k
+        tail_lens = jnp.zeros((batch,), jnp.int32)
+    elif tail_v is None:  # shared_kv callers pass only the latent tail
+        tail_v = tail_k
     return shard_map(
         local, mesh=mesh,
         in_specs=(P(None, "tp", None), kv_spec, kv_spec,
-                  P(None, None), P(None)),
+                  P(None, None), P(None), tail_spec, tail_spec, P(None)),
         out_specs=P(None, "tp", None),
         check_vma=False,
-    )(q, k_cache, v_cache, page_table, ctx_lens)
+    )(q, k_cache, v_cache, page_table, ctx_lens, tail_k, tail_v, tail_lens)
 
 
 def sharded_paged_prefill_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, total_lens, *,
     q_tile=16, sliding_window=None, sinks=None, pages_per_block=None,
-    shared_kv=False, interpret=False,
+    shared_kv=False, layer_idx=None, interpret=False,
 ):
     """Flash-prefill over a tp-sharded paged KV cache (see the decode
     wrapper's rationale). q: [batch, q_seq, q_heads, hd], heads sharded."""
@@ -837,10 +1012,10 @@ def sharded_paged_prefill_attention(
             q_, k_, v_, t_, cl_, tl_, q_tile=q_tile,
             sliding_window=sliding_window, sinks=sinks,
             pages_per_block=pages_per_block, shared_kv=shared_kv,
-            interpret=interpret,
+            layer_idx=layer_idx, interpret=interpret,
         )
 
-    kv_spec = _kv_pool_spec(k_cache)
+    kv_spec = _kv_pool_spec(k_cache, stacked=layer_idx is not None)
     return shard_map(
         local, mesh=mesh,
         in_specs=(P(None, None, "tp", None), kv_spec, kv_spec,

@@ -271,6 +271,138 @@ def test_merged_vs_per_head_parity(window, sinks):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("window,sinks", [(None, None), (12, None), (12, 4)])
+def test_burst_tail_matches_scattered_reference(window, sinks):
+    """The dense burst-local KV tail (fused-decode path: base cache
+    frozen, burst tokens in a small carried tail) must equal scattering
+    the valid tail tokens into the cache and attending normally — for
+    the XLA path and both kernel grids, across window/sink configs."""
+    # Table capacity is 16 tokens (4 pages x 4): ctx + T must fit so the
+    # scattered reference is faithful.
+    T = 6
+    q, k_cache, v_cache, table, _ = build_case(q_heads=8, kv_heads=2, ctx=10)
+    rng = np.random.default_rng(3)
+    B = q.shape[0]
+    ctx_lens = jnp.asarray([10, 7], jnp.int32)
+    tail_lens = jnp.asarray([5, 1], jnp.int32)
+    tail_k = jnp.asarray(rng.normal(size=(B, T, 2, 8)), jnp.float32)
+    tail_v = jnp.asarray(rng.normal(size=(B, T, 2, 8)), jnp.float32)
+
+    tpos = ctx_lens[:, None] + jnp.arange(T)[None, :]
+    tvalid = jnp.arange(T)[None, :] < tail_lens[:, None]
+    k_full = scatter_kv_pages(k_cache, tail_k, table, tpos, tvalid)
+    v_full = scatter_kv_pages(v_cache, tail_v, table, tpos, tvalid)
+    total = ctx_lens + tail_lens
+    ref = paged_attention(q[:, None], k_full, v_full, table,
+                          (total - 1)[:, None], total,
+                          sliding_window=window, attention_sinks=sinks)[:, 0]
+
+    got_xla = paged_attention(q[:, None], k_cache, v_cache, table,
+                              (total - 1)[:, None], ctx_lens,
+                              sliding_window=window, attention_sinks=sinks,
+                              tail_k=tail_k, tail_v=tail_v,
+                              tail_lens=tail_lens)[:, 0]
+    np.testing.assert_allclose(np.asarray(got_xla), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    for mh in (False, True):
+        got = pallas_paged_decode_attention(
+            q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+            sinks=sinks, merge_heads=mh, tail_k=tail_k, tail_v=tail_v,
+            tail_lens=tail_lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_burst_tail_sink_positions():
+    """Torture case: a request enters the burst with ctx_base < sinks, so
+    some TAIL slots sit at sink positions — they must stay attendable
+    once the burst outruns the window (the XLA reference keeps them via
+    the concatenated-position mask; the kernels' tail fold must agree)."""
+    T = 8
+    q, k_cache, v_cache, table, _ = build_case(q_heads=8, kv_heads=2, ctx=2)
+    rng = np.random.default_rng(6)
+    B = q.shape[0]
+    ctx_lens = jnp.asarray([2, 1], jnp.int32)
+    tail_lens = jnp.asarray([8, 6], jnp.int32)  # burst outran window=3
+    tail_k = jnp.asarray(rng.normal(size=(B, T, 2, 8)), jnp.float32)
+    tail_v = jnp.asarray(rng.normal(size=(B, T, 2, 8)), jnp.float32)
+    window, sinks = 3, 4
+
+    tpos = ctx_lens[:, None] + jnp.arange(T)[None, :]
+    tvalid = jnp.arange(T)[None, :] < tail_lens[:, None]
+    k_full = scatter_kv_pages(k_cache, tail_k, table, tpos, tvalid)
+    v_full = scatter_kv_pages(v_cache, tail_v, table, tpos, tvalid)
+    total = ctx_lens + tail_lens
+    ref = paged_attention(q[:, None], k_full, v_full, table,
+                          (total - 1)[:, None], total,
+                          sliding_window=window, attention_sinks=sinks)[:, 0]
+    got_xla = paged_attention(q[:, None], k_cache, v_cache, table,
+                              (total - 1)[:, None], ctx_lens,
+                              sliding_window=window, attention_sinks=sinks,
+                              tail_k=tail_k, tail_v=tail_v,
+                              tail_lens=tail_lens)[:, 0]
+    np.testing.assert_allclose(np.asarray(got_xla), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    for mh in (False, True):
+        got = pallas_paged_decode_attention(
+            q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+            sinks=sinks, merge_heads=mh, tail_k=tail_k, tail_v=tail_v,
+            tail_lens=tail_lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_burst_tail_shared_kv():
+    """Absorbed-MLA form: the latent tail is both K and V (single-stream),
+    with the value read being the same latent the key matched."""
+    T = 4
+    q, k_cache, _v, table, _ = build_case(q_heads=8, kv_heads=1, ctx=12)
+    rng = np.random.default_rng(4)
+    B = q.shape[0]
+    ctx_lens = jnp.asarray([12, 9], jnp.int32)
+    tail_lens = jnp.asarray([3, 1], jnp.int32)
+    tail_k = jnp.asarray(rng.normal(size=(B, T, 1, 8)), jnp.float32)
+
+    tpos = ctx_lens[:, None] + jnp.arange(T)[None, :]
+    tvalid = jnp.arange(T)[None, :] < tail_lens[:, None]
+    k_full = scatter_kv_pages(k_cache, tail_k, table, tpos, tvalid)
+    total = ctx_lens + tail_lens
+    ref = paged_attention(q[:, None], k_full, k_full, table,
+                          (total - 1)[:, None], total)[:, 0]
+    for mh in (False, True):
+        got = pallas_paged_decode_attention(
+            q, k_cache, k_cache, table, ctx_lens, shared_kv=True,
+            merge_heads=mh, tail_k=tail_k, tail_lens=tail_lens,
+            interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_stacked_cache_layer_idx():
+    """layer_idx mode: the kernel DMAs from the full [layers, pages, …]
+    stack (slicing outside the pallas_call would materialize a per-layer
+    copy at the custom-call boundary) and must equal attention over the
+    slice."""
+    L = 3
+    q, k_cache, v_cache, table, ctx_lens = build_case(ctx=13)
+    rng = np.random.default_rng(5)
+    kstack = jnp.stack([k_cache] + [
+        jnp.asarray(rng.normal(size=k_cache.shape), jnp.float32)
+        for _ in range(L - 1)])
+    vstack = jnp.stack([v_cache] + [
+        jnp.asarray(rng.normal(size=v_cache.shape), jnp.float32)
+        for _ in range(L - 1)])
+    for li in range(L):
+        ref = paged_attention(q[:, None], kstack[li], vstack[li], table,
+                              (ctx_lens - 1)[:, None], ctx_lens)[:, 0]
+        for mh in (False, True):
+            got = pallas_paged_decode_attention(
+                q, kstack, vstack, table, ctx_lens, merge_heads=mh,
+                layer_idx=li, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+
 def test_head_dim_alignment_guard(monkeypatch):
     """On real TPU, sub-128 head dims must raise a clear error instead of
     a Mosaic internal failure (lane tiling is 128; measured on v5e)."""
