@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Decode throughput vs batch and context at the bench's production
+sizing (0.46 B params) — the evidence behind the decode tables in
+benchmarking/r4-mfu/README.md ("engine decode, burst 32").
+
+Serves each (batch, ctx) point end-to-end through MiniEngine: admit
+`batch` requests of `ctx` prompt tokens, then time decoding 128 tokens
+each in fused 32-token bursts. Throughput counts decoded tokens only,
+but the timed window includes whatever prefill interleaves after the
+first step — run on an idle chip for clean numbers.
+
+Usage: env PYTHONPATH=/root/.axon_site:. python hack/decode_batch_sweep.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from llmd_kv_cache_tpu.models import engine as engine_mod
+from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
+
+
+def main():
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, num_layers=16,
+                      num_heads=16, num_kv_heads=8, head_dim=128,
+                      intermediate_size=5632, page_size=16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(7)
+    max_new = 128
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    for batch, ctx in ((8, 64), (16, 64), (32, 64), (8, 2048), (32, 2048)):
+        prompts = [rng.integers(1, 30000, ctx).tolist() for _ in range(batch)]
+        pages_needed = batch * ((ctx + max_new) // 16 + 2)
+        eng = engine_mod.MiniEngine(
+            engine_mod.EngineConfig(
+                model=cfg, num_pages=pages_needed + 64,
+                max_pages_per_seq=(ctx + max_new) // 16 + 2,
+                max_batch=batch, model_name="bench-decode",
+                pod_identifier="p", decode_burst=32,
+                max_prefill_tokens=2048,
+            ),
+            params=params, seed=0,
+        )
+        reqs = [eng.add_request(f"r{i}", p, max_new_tokens=max_new)
+                for i, p in enumerate(prompts)]
+        eng.step()  # compile + first prefills outside the timed window
+        start = time.perf_counter()
+        before = sum(len(r.output) for r in reqs)
+        while not all(r.done for r in reqs):
+            eng.step()
+        elapsed = time.perf_counter() - start
+        toks = sum(len(r.output) for r in reqs) - before
+        print(f"0.46B decode b{batch:<3d} ctx{ctx:<5d} burst32: "
+              f"{toks / elapsed:7.0f} tok/s ({toks} toks in {elapsed:.2f}s)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
