@@ -190,6 +190,17 @@ import runpy
 runpy.run_path('hack/mfu_probe.py', run_name='__main__')
 " || continue
 
+  stage mfu_big 900 "
+import runpy, sys
+sys.argv = ['mfu_probe', '--big']
+runpy.run_path('hack/mfu_probe.py', run_name='__main__')
+" || continue
+
+  stage decode_batch_sweep 1800 "
+import runpy
+runpy.run_path('hack/decode_batch_sweep.py', run_name='__main__')
+" || continue
+
   stage ttft_bench 2700 "
 import sys; sys.argv=['bench','--ttft']
 exec(open('bench.py').read())
