@@ -189,8 +189,11 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
                 t_pod, pick = clocks[p], p
         if t_arr <= t_pod:
             # Next event is an arrival: route it with the index as of the
-            # work already performed (events publish inside step()).
-            p = router(i, workload[i], names)
+            # work already performed (events publish inside step()); load
+            # routers also see each pod's outstanding work (queued +
+            # in-flight) as of now.
+            loads = {q: len(queues[q]) + inflight(q) for q in names}
+            p = router(i, workload[i], names, loads)
             queues[p].append(i)
             arr_of[i] = t_arr
             if inflight(p) == 0 and len(queues[p]) == 1:
@@ -241,10 +244,14 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
 
 def make_kv_router(indexer):
     """Score-argmax router with round-robin fallback — shared by every
-    KV-routed arm so the arms cannot silently diverge in policy."""
+    KV-routed arm so the arms cannot silently diverge in policy.
+
+    This is the reference's "precise scheduling" strategy (the EPP
+    scoring from this indexer, benchmarking/37-capacity README); the
+    factories below mirror its comparison strategies."""
     rr_counter = [0]
 
-    def router(_i, prompt, names):
+    def router(_i, prompt, names, loads=None):
         scores = indexer.score_tokens(prompt, MODEL_NAME)
         if scores:
             return max(scores.items(), key=lambda kv: kv[1])[0]
@@ -252,6 +259,32 @@ def make_kv_router(indexer):
         rr_counter[0] += 1
         return pick
 
+    return router
+
+
+def make_rr_router(_indexer=None):
+    """Round-robin baseline (deterministic uniform spread)."""
+    def router(i, _p, names, loads=None):
+        return names[i % len(names)]
+    return router
+
+
+def make_random_router(_indexer=None, seed=11):
+    """Uniform-random scheduling — the reference's "random" strategy."""
+    r = np.random.default_rng(seed)
+
+    def router(_i, _p, names, loads=None):
+        return names[int(r.integers(len(names)))]
+    return router
+
+
+def make_load_router(_indexer=None):
+    """Least-outstanding-work scheduling — the reference's "load-aware"
+    strategy: route to the pod with the fewest queued + in-flight
+    requests at arrival (name order breaks ties)."""
+    def router(_i, _p, names, loads=None):
+        loads = loads or {}
+        return min(names, key=lambda p: (loads.get(p, 0), p))
     return router
 
 
@@ -678,8 +711,7 @@ def main(queued: bool = True) -> None:
         crr_pods = make_pods(n_pods, model_cfg, engine_mod, crr_indexer,
                              params=shared_params, pod_kw=pod_kw)
         crr_t, crr_hit, crr_tps = run_concurrent(
-            crr_pods, workload,
-            lambda i, _p, names: names[i % len(names)], arr,
+            crr_pods, workload, make_rr_router(), arr,
             tag=f"conc-rr {mult}x")
         del crr_pods
         ckv_indexer = fresh_indexer()
@@ -711,6 +743,41 @@ def main(queued: bool = True) -> None:
               f"out tok/s rr {crow['rr_out_tok_s']:.0f} "
               f"kv {crow['kv_out_tok_s']:.0f}",
               file=_sys.stderr, flush=True)
+
+    # Strategy matrix at the headline point — the reference's
+    # 37-capacity report compares precise (this indexer) / default /
+    # load-aware / random scheduling on one workload; rr and kv already
+    # ran above, so two more fleets cover the matrix.
+    strategy_comparison = {}
+    head_conc = next((r for r in conc_sweep if r["mult"] == 1.25), None)
+    if head_conc is not None:
+        strategy_comparison["round_robin"] = {
+            "p50": head_conc["rr_p50"], "p90": head_conc["rr_p90"],
+            "hit": head_conc["rr_hit"],
+            "out_tok_s": head_conc["rr_out_tok_s"]}
+        strategy_comparison["kv_precise"] = {
+            "p50": head_conc["kv_p50"], "p90": head_conc["kv_p90"],
+            "hit": head_conc["kv_hit"],
+            "out_tok_s": head_conc["kv_out_tok_s"]}
+        arr = np.cumsum(np.random.default_rng(7).exponential(
+            1.0 / (1.25 * fleet_qps), len(workload)))
+        for strat, factory in (("random", make_random_router),
+                               ("load_aware", make_load_router)):
+            s_indexer = fresh_indexer()
+            s_pods = make_pods(n_pods, model_cfg, engine_mod, s_indexer,
+                               params=shared_params, pod_kw=pod_kw)
+            s_t, s_hit, s_tps = run_concurrent(
+                s_pods, workload, factory(s_indexer), arr,
+                tag=f"conc-{strat}")
+            del s_pods
+            strategy_comparison[strat] = {
+                "p50": round(statistics.median(s_t), 4),
+                "p90": round(float(np.quantile(s_t, 0.9)), 4),
+                "hit": round(s_hit, 4), "out_tok_s": round(s_tps, 1)}
+            print(f"[bench strat] {strat}: p50 "
+                  f"{strategy_comparison[strat]['p50']:.3f}s hit "
+                  f"{s_hit:.2f} out {s_tps:.0f} tok/s",
+                  file=_sys.stderr, flush=True)
 
     # Headline: the 1.25×-capacity point, from the CONCURRENT
     # continuous-batching arm when it ran — measured TTFTs under real
@@ -757,6 +824,7 @@ def main(queued: bool = True) -> None:
         "replay_hit_rate_rr": round(rr_hit, 4),
         "qps_sweep": sweep,
         "concurrent_sweep": conc_sweep,
+        "strategy_comparison": strategy_comparison,
     }
     if st_p50 is not None:
         line["storage_restore_p50_s"] = round(st_p50, 4)

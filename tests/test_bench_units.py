@@ -79,7 +79,7 @@ class TestRunConcurrent:
         # other's service) and 4 long after (no queueing).
         arrivals = [0.0, 0.0, 0.0, 0.0, 1e6, 1e6 + 1, 1e6 + 2, 1e6 + 3]
         ttfts, hit, out_tps = bench.run_concurrent(
-            pods, wl, lambda i, _p, names: names[i % len(names)], arrivals,
+            pods, wl, bench.make_rr_router(), arrivals,
             max_new_tokens=4)
         assert len(ttfts) == 8 and all(t > 0 for t in ttfts)
         assert 0.0 <= hit <= 1.0
@@ -105,7 +105,8 @@ class TestRunConcurrent:
                                   vocab=200)
         arrivals = [0.0, 0.0, 0.0, 0.0]
         ttfts, _, _ = bench.run_concurrent(
-            pods, wl, lambda *_a: "pod-0", arrivals, max_new_tokens=4)
+            pods, wl, lambda *_a, **_kw: "pod-0", arrivals,
+            max_new_tokens=4)
         assert len(ttfts) == 4 and all(t > 0 for t in ttfts)
 
 
