@@ -192,8 +192,10 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
             # work already performed (events publish inside step()); load
             # routers also see each pod's outstanding work (queued +
             # in-flight) as of now.
-            loads = {q: len(queues[q]) + inflight(q) for q in names}
-            p = router(i, workload[i], names, loads)
+            # Lazy: only the load router pays for the fleet scan.
+            p = router(i, workload[i], names,
+                       lambda: {q: len(queues[q]) + inflight(q)
+                                for q in names})
             queues[p].append(i)
             arr_of[i] = t_arr
             if inflight(p) == 0 and len(queues[p]) == 1:
@@ -283,7 +285,7 @@ def make_load_router(_indexer=None):
     strategy: route to the pod with the fewest queued + in-flight
     requests at arrival (name order breaks ties)."""
     def router(_i, _p, names, loads=None):
-        loads = loads or {}
+        loads = (loads() if callable(loads) else loads) or {}
         return min(names, key=lambda p: (loads.get(p, 0), p))
     return router
 

@@ -675,6 +675,12 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
     valid = jnp.arange(seq)[None, :] < new_lens[:, None]
     total_lens = ctx_lens + new_lens
     if tails is not None:
+        # The burst path is single-token-per-tick: tmask broadcasts
+        # valid [b, 1] over [b, T] and tail_lens counts exactly one new
+        # token per live row. A seq>1 caller would mis-mask silently.
+        if seq != 1:
+            raise ValueError(
+                f"tails mode requires seq == 1 (decode bursts), got {seq}")
         tail_ks, tail_vs, ctx_base = tails
         tail_ks, tail_vs = list(tail_ks), list(tail_vs)
         t_steps = tail_ks[0].shape[2]

@@ -799,6 +799,18 @@ def pallas_paged_decode_attention(
     q_blocked = q.reshape(batch, kv_heads, group, head_dim)
 
     has_tail = tail_k is not None
+    if has_tail:
+        # The tail arguments travel as a set: a tail without its valid
+        # lengths (or, for separate K/V caches, without its values) would
+        # surface much later as an opaque shape/attribute error.
+        if tail_lens is None:
+            raise ValueError(
+                "tail_k requires tail_lens [batch] int32 (valid tail "
+                "tokens per sequence)")
+        if tail_v is None and not shared_kv:
+            raise ValueError(
+                "tail_k requires tail_v [batch, T, kv_heads, head_dim] "
+                "unless shared_kv=True (single-stream MLA)")
     if not has_tail:
         # Structural placeholders: the kernels always take tail refs so
         # the two arities share one code path; has_tail=False makes the

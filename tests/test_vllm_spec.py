@@ -1,0 +1,346 @@
+"""vLLM OffloadingSpec shim contract tests.
+
+The reference proves its vLLM entry point without a GPU (or vllm
+installed) by injecting fake ``vllm.*`` modules into ``sys.modules``
+before importing the connector (reference
+``tests/cpu/test_storage_events.py:20-60``); same pattern here. The
+data plane under the shim is real: TPUBlockCopier gathers from jax
+arrays, the native I/O pool writes the files, loads scatter back.
+"""
+
+import importlib
+import sys
+import types
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+# -- minimal vLLM API doubles (shapes from vllm.v1.kv_offload) --
+
+
+@dataclass
+class PrepareStoreOutput:
+    keys_to_store: list
+    store_spec: object
+    evicted_keys: list
+
+
+@dataclass
+class TransferResult:
+    job_id: int
+    success: bool
+    transfer_size: int = 0
+    transfer_time: float = 0.0
+    transfer_type: tuple = ()
+
+
+class GPULoadStoreSpec:
+    def __init__(self, block_ids):
+        self.block_ids = list(block_ids)
+
+    @staticmethod
+    def medium():
+        return "GPU"
+
+
+@dataclass(frozen=True)
+class OffloadKey:
+    """vLLM's offload key: (group, hash)."""
+
+    group_idx: int
+    block_hash: int
+
+
+def _module(name, **attrs):
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    return mod
+
+
+def _package(name):
+    mod = _module(name)
+    mod.__path__ = []
+    return mod
+
+
+@pytest.fixture()
+def vllm_spec_module(monkeypatch):
+    base = _module(
+        "vllm.v1.kv_offload.base",
+        LoadStoreSpec=object,
+        OffloadingManager=object,
+        OffloadingSpec=object,
+        PrepareStoreOutput=PrepareStoreOutput,
+        GPULoadStoreSpec=GPULoadStoreSpec,
+        get_offload_block_hash=lambda k: k.block_hash,
+        get_offload_group_idx=lambda k: k.group_idx,
+    )
+    worker = _module(
+        "vllm.v1.kv_offload.worker.worker",
+        OffloadingHandler=object,
+        TransferResult=TransferResult,
+        TransferSpec=tuple,
+        TransferType=tuple,
+    )
+    fakes = {
+        "vllm": _package("vllm"),
+        "vllm.v1": _package("vllm.v1"),
+        "vllm.v1.kv_offload": _package("vllm.v1.kv_offload"),
+        "vllm.v1.kv_offload.base": base,
+        "vllm.v1.kv_offload.worker": _package("vllm.v1.kv_offload.worker"),
+        "vllm.v1.kv_offload.worker.worker": worker,
+    }
+    for name, mod in fakes.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    sys.modules.pop("llmd_kv_cache_tpu.offload.vllm_spec", None)
+    mod = importlib.import_module("llmd_kv_cache_tpu.offload.vllm_spec")
+    yield mod
+    sys.modules.pop("llmd_kv_cache_tpu.offload.vllm_spec", None)
+
+
+@dataclass
+class FakeKVTransferConfig:
+    kv_connector_extra_config: dict = field(default_factory=dict)
+
+
+@dataclass
+class FakeModelConfig:
+    model: str = "meta-llama/Llama-3.1-8B-Instruct"
+
+
+@dataclass
+class FakeCacheConfig:
+    block_size: int = 4
+
+
+@dataclass
+class FakeVllmConfig:
+    kv_transfer_config: FakeKVTransferConfig = None
+    model_config: FakeModelConfig = field(default_factory=FakeModelConfig)
+    cache_config: FakeCacheConfig = field(default_factory=FakeCacheConfig)
+
+
+LAYERS, PAGES, KV_HEADS, PAGE_SIZE, HEAD_DIM = 2, 32, 2, 4, 8
+
+
+def make_spec(vllm_spec_module, tmp_path, **extra):
+    cfg_extra = {
+        "shared_storage_path": str(tmp_path / "kv"),
+        "block_size": 8,  # tokens/file -> 2 pages per offload key
+        "num_layers": LAYERS,
+        "kv_heads": KV_HEADS,
+        "head_dim": HEAD_DIM,
+        "dtype": "float32",
+        "io_threads": 2,
+    }
+    cfg_extra.update(extra)
+    vllm_config = FakeVllmConfig(
+        kv_transfer_config=FakeKVTransferConfig(cfg_extra))
+    return vllm_spec_module.TPUStorageOffloadingSpec(
+        vllm_config, kv_cache_config=None)
+
+
+def make_caches(seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (LAYERS, PAGES, KV_HEADS, PAGE_SIZE, HEAD_DIM)
+    k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    return k, v
+
+
+def drain(handler, job_id, timeout=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for res in handler.get_finished():
+            if res.job_id == job_id:
+                return res
+        time.sleep(0.005)
+    raise TimeoutError("transfer did not finish")
+
+
+def keys(*hashes, group=0):
+    return [OffloadKey(group, h) for h in hashes]
+
+
+class TestManagerContract:
+    def test_lookup_prepare_complete_cycle(self, vllm_spec_module, tmp_path):
+        spec = make_spec(vllm_spec_module, tmp_path)
+        mgr = spec.get_manager()
+        (k1,) = keys(0xAB)
+        assert mgr.lookup(k1, None) is False
+        out = mgr.prepare_store(keys(0xAB, 0xCD), None)
+        assert [k.block_hash for k in out.keys_to_store] == [0xAB, 0xCD]
+        assert out.evicted_keys == []
+        assert out.store_spec.keys == out.keys_to_store
+        assert out.store_spec.medium() == "SHARED_STORAGE"
+        # Loads are stateless specs over the requested keys.
+        load_spec = mgr.prepare_load(keys(0xAB), None)
+        assert [k.block_hash for k in load_spec.keys] == [0xAB]
+        mgr.touch(keys(0xAB), None)
+        mgr.complete_load(keys(0xAB), None)
+        mgr.shutdown()
+
+    def test_prepare_store_skips_existing_files(self, vllm_spec_module,
+                                                tmp_path):
+        spec = make_spec(vllm_spec_module, tmp_path)
+        mgr = spec.get_manager()
+        path = spec.inner.build_mapper().block_path(0xAB, 0)
+        import os
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"x")
+        out = mgr.prepare_store(keys(0xAB, 0xCD), None)
+        assert [k.block_hash for k in out.keys_to_store] == [0xCD]
+        assert mgr.lookup(keys(0xAB)[0], None) is True
+
+    def test_block_size_must_divide(self, vllm_spec_module, tmp_path):
+        with pytest.raises(ValueError, match="multiple of"):
+            make_spec(vllm_spec_module, tmp_path, block_size=6, page_size=4)
+
+    def test_prepare_store_freshness_is_per_group(self, vllm_spec_module,
+                                                  tmp_path):
+        """The same hash stored in group 0 but not group 1 must re-store
+        only the group-1 key (hybrid models hash identically per group)."""
+        spec = make_spec(vllm_spec_module, tmp_path)
+        mgr = spec.get_manager()
+        path = spec.inner.build_mapper().block_path(0xAB, 0)
+        import os
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"x")
+        out = mgr.prepare_store(
+            keys(0xAB, group=0) + keys(0xAB, group=1), None)
+        assert [(k.group_idx, k.block_hash) for k in out.keys_to_store] == [
+            (1, 0xAB)]
+
+
+class TestHandlerRoundTrip:
+    def test_store_then_load_round_trip(self, vllm_spec_module, tmp_path):
+        spec = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        k, v = make_caches()
+        pairs = list(spec.get_handlers((k, v)))
+        assert len(pairs) == 2
+        (src_t, dst_t, store_h), (src_t2, dst_t2, load_h) = pairs
+        assert src_t is vllm_spec_module.GPULoadStoreSpec
+        assert dst_t is vllm_spec_module.TPUSharedStorageLoadStoreSpec
+        assert (src_t2, dst_t2) == (dst_t, src_t)
+
+        # Store pages 3,4 (key 0xA) and 7,8 (key 0xB): 2 pages/file.
+        store_keys = keys(0xA, 0xB)
+        gpu = GPULoadStoreSpec([3, 4, 7, 8])
+        storage = vllm_spec_module.TPUSharedStorageLoadStoreSpec(store_keys)
+        assert store_h.transfer_async(17, (gpu, storage)) is True
+        res = drain(store_h, 17)
+        assert res.success and res.transfer_size > 0
+        assert res.transfer_type == ("gpu", "storage")
+
+        # Load them back into different pages of a zeroed cache pool.
+        spec2 = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        kz = jnp.zeros_like(k)
+        vz = jnp.zeros_like(v)
+        pairs2 = list(spec2.get_handlers((kz, vz)))
+        load_h2 = pairs2[1][2]
+        gpu2 = GPULoadStoreSpec([10, 11, 20, 21])
+        storage2 = vllm_spec_module.TPUSharedStorageLoadStoreSpec(store_keys)
+        assert load_h2.transfer_async(99, (storage2, gpu2)) is True
+        res2 = drain(load_h2, 99)
+        assert res2.success
+        k2 = np.asarray(spec2._handlers.copiers[0].k_cache)
+        np.testing.assert_array_equal(k2[:, 10], np.asarray(k)[:, 3])
+        np.testing.assert_array_equal(k2[:, 11], np.asarray(k)[:, 4])
+        np.testing.assert_array_equal(k2[:, 20], np.asarray(k)[:, 7])
+        np.testing.assert_array_equal(k2[:, 21], np.asarray(k)[:, 8])
+
+    def test_mismatched_spec_lengths_fail_cleanly(self, vllm_spec_module,
+                                                  tmp_path):
+        spec = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        k, v = make_caches()
+        store_h = list(spec.get_handlers((k, v)))[0][2]
+        gpu = GPULoadStoreSpec([3, 4, 7])  # 3 blocks for 2 keys x 2
+        storage = vllm_spec_module.TPUSharedStorageLoadStoreSpec(keys(1, 2))
+        assert store_h.transfer_async(1, (gpu, storage)) is False
+
+    def test_load_missing_file_reports_failure(self, vllm_spec_module,
+                                               tmp_path):
+        spec = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        k, v = make_caches()
+        load_h = list(spec.get_handlers((k, v)))[1][2]
+        gpu = GPULoadStoreSpec([0, 1])
+        storage = vllm_spec_module.TPUSharedStorageLoadStoreSpec(
+            keys(0xDEAD))
+        assert load_h.transfer_async(5, (storage, gpu)) is True
+        res = drain(load_h, 5)
+        assert res.success is False
+
+    def test_wait_blocks_until_done_and_applies_scatter(
+            self, vllm_spec_module, tmp_path):
+        """wait() must complete loads INCLUDING the H2D scatter, and the
+        results must remain available to a later get_finished."""
+        spec = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        k, v = make_caches()
+        store_h = list(spec.get_handlers((k, v)))[0][2]
+        store_keys = keys(0x31)
+        gpu = GPULoadStoreSpec([1, 2])
+        storage = vllm_spec_module.TPUSharedStorageLoadStoreSpec(store_keys)
+        assert store_h.transfer_async(4, (gpu, storage)) is True
+        store_h.wait([4])
+        spec2 = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        load_h = list(spec2.get_handlers(
+            (jnp.zeros_like(k), jnp.zeros_like(v))))[1][2]
+        gpu2 = GPULoadStoreSpec([9, 12])
+        storage2 = vllm_spec_module.TPUSharedStorageLoadStoreSpec(store_keys)
+        assert load_h.transfer_async(8, (storage2, gpu2)) is True
+        load_h.wait([8])
+        # Scatter applied by the time wait returns:
+        k2 = np.asarray(spec2._handlers.copiers[0].k_cache)
+        np.testing.assert_array_equal(k2[:, 9], np.asarray(k)[:, 1])
+        np.testing.assert_array_equal(k2[:, 12], np.asarray(k)[:, 2])
+        # Result not swallowed by wait:
+        results = load_h.get_finished()
+        assert [r.job_id for r in results] == [8] and results[0].success
+        # wait on unknown/finished ids returns immediately.
+        load_h.wait([8, 1234])
+
+    def test_manager_handler_agree_via_files(self, vllm_spec_module,
+                                             tmp_path):
+        """Scheduler-side lookup sees what the worker stored — the
+        end-to-end contract a vLLM pod depends on."""
+        spec = make_spec(vllm_spec_module, tmp_path, page_size=PAGE_SIZE)
+        k, v = make_caches()
+        store_h = list(spec.get_handlers((k, v)))[0][2]
+        mgr = spec.get_manager()
+        (key,) = keys(0x77)
+        assert mgr.lookup(key, None) is False
+        out = mgr.prepare_store([key], None)
+        gpu = GPULoadStoreSpec([5, 6])
+        assert store_h.transfer_async(3, (gpu, out.store_spec)) is True
+        assert drain(store_h, 3).success
+        mgr.complete_store([key], None)
+        assert mgr.lookup(key, None) is True
+        # A second prepare_store now skips it.
+        assert mgr.prepare_store([key], None).keys_to_store == []
+
+
+class TestImportGuard:
+    def test_import_without_vllm_raises_clear_error(self, monkeypatch):
+        for n in list(sys.modules):
+            if n == "vllm" or n.startswith("vllm."):
+                monkeypatch.delitem(sys.modules, n)
+        # None blocks re-import even where vllm IS installed ("import of
+        # vllm halted" -> ImportError), so the guard test is hermetic.
+        monkeypatch.setitem(sys.modules, "vllm", None)
+        sys.modules.pop("llmd_kv_cache_tpu.offload.vllm_spec", None)
+        try:
+            with pytest.raises(ImportError, match="requires vllm"):
+                importlib.import_module(
+                    "llmd_kv_cache_tpu.offload.vllm_spec")
+        finally:
+            sys.modules.pop("llmd_kv_cache_tpu.offload.vllm_spec", None)
