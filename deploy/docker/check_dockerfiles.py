@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Static Dockerfile validation for environments without a docker daemon.
+
+`docker build` cannot run in the hermetic build sandbox (no daemon, no
+registry egress), so CI and developers run this instead: it parses each
+Dockerfile and asserts (a) every COPY source exists in the build context
+(repo root), (b) ENTRYPOINT/CMD scripts exist among the copied paths,
+(c) stage references in `COPY --from=` resolve, and (d) the chart's
+image repositories all have a Dockerfile here or are explicitly
+external. Run from anywhere: paths resolve relative to the repo root.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+DOCKER_DIR = ROOT / "deploy" / "docker"
+
+# Chart image repositories -> Dockerfile (None = external base image the
+# repo does not build: the default engine serving stack and Redis).
+CHART_IMAGES = {
+    "kvtpu/indexer": "Dockerfile.indexer",
+    "kvtpu/tokenizer": "Dockerfile.tokenizer",
+    "kvtpu/engine": "Dockerfile.engine",
+    "vllm-tpu/vllm-tpu": None,
+    "redis": None,
+}
+
+
+def parse(dockerfile: pathlib.Path):
+    stages, copies, entry_cmds = [], [], []
+    # Join backslash continuations first: a COPY's sources may span
+    # physical lines and every one of them must be validated.
+    logical, pending = [], ""
+    for raw in dockerfile.read_text().splitlines():
+        stripped = raw.strip()
+        if stripped.endswith("\\"):
+            pending += stripped[:-1] + " "
+            continue
+        logical.append(pending + stripped)
+        pending = ""
+    if pending:
+        logical.append(pending)
+    for line in logical:
+        if m := re.match(r"FROM\s+\S+(?:\s+AS\s+(\S+))?", line, re.I):
+            stages.append(m.group(1))
+        elif m := re.match(r"COPY\s+(.*)", line, re.I):
+            parts = m.group(1).split()
+            from_stage = None
+            if parts and parts[0].startswith("--from="):
+                from_stage = parts.pop(0)[len("--from="):]
+            *srcs, _dst = parts
+            copies.append((from_stage, srcs))
+        elif m := re.match(r"(?:ENTRYPOINT|CMD)\s+\[(.*)\]", line, re.I):
+            entry_cmds.extend(
+                p.strip().strip('"') for p in m.group(1).split(","))
+    return stages, copies, entry_cmds
+
+
+def check(dockerfile: pathlib.Path) -> list[str]:
+    errors = []
+    stages, copies, entry_cmds = parse(dockerfile)
+    copied_files = set()
+    for from_stage, srcs in copies:
+        if from_stage is not None:
+            if from_stage not in stages:
+                errors.append(f"COPY --from={from_stage}: unknown stage")
+            # Built artifacts (e.g. /src/.../libkvio.so) are produced by
+            # the builder stage; check the source file that builds them.
+            continue
+        for src in srcs:
+            if not (ROOT / src).exists():
+                errors.append(f"COPY source missing in context: {src}")
+            copied_files.add(src.rstrip("/"))
+    for item in entry_cmds:
+        if item.endswith(".py") and not item.startswith("-"):
+            # Must be covered by a COPY (exact file, or inside a copied
+            # directory) — existing in the repo is NOT enough; it has to
+            # actually land in the image.
+            covered = any(
+                item == c or item.startswith(c + "/")
+                for c in copied_files)
+            if not covered:
+                errors.append(f"entrypoint script not COPY'd into image: "
+                              f"{item}")
+    return errors
+
+
+def main() -> int:
+    failed = False
+    for name, df in CHART_IMAGES.items():
+        if df is None:
+            print(f"  {name}: external image (not built here)")
+            continue
+        path = DOCKER_DIR / df
+        if not path.exists():
+            print(f"FAIL {name}: missing {df}")
+            failed = True
+            continue
+        errors = check(path)
+        if errors:
+            failed = True
+            print(f"FAIL {name} ({df}):")
+            for e in errors:
+                print(f"    {e}")
+        else:
+            print(f"  {name}: {df} OK")
+
+    # Every image repository referenced by the chart must be accounted for.
+    values = (ROOT / "deploy" / "chart" / "values.yaml").read_text()
+    for repo in re.findall(r"repository:\s*(\S+)", values):
+        if repo not in CHART_IMAGES:
+            print(f"FAIL chart references unaccounted image: {repo}")
+            failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -335,8 +335,15 @@ class S3ObjectStoreClient:
     def __init__(self, bucket: str, endpoint_url: Optional[str] = None,
                  access_key: Optional[str] = None,
                  secret_key: Optional[str] = None,
-                 region: str = "us-east-1",
+                 region: Optional[str] = None,
                  transport: Optional[str] = None):
+        # Standard AWS env credentials work on BOTH transports (k8s pods
+        # inject them as env vars; boto3 reads them natively, the HTTP
+        # transport must read them here or auth silently differs by
+        # which transport auto-detection picked).
+        access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID")
+        secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY")
+        region = region or os.environ.get("AWS_DEFAULT_REGION") or "us-east-1"
         if transport is None:
             try:
                 import boto3  # noqa: F401

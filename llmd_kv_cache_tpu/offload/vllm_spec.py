@@ -96,6 +96,18 @@ class TPUOffloadingManager(OffloadingManager):
         self.inner = inner
 
     def lookup(self, key, req_context=None):
+        # Current vLLM contract (reference manager.py:100-105): single
+        # key -> bool. Older generations passed an iterable of keys and
+        # sliced by the returned hit-prefix length — accept both.
+        if isinstance(key, (list, tuple)):
+            counts = {}
+            for k in key:
+                g = _group_idx(k)
+                if g not in counts:
+                    counts[g] = self.inner.lookup(
+                        [_block_hash(k2) for k2 in key
+                         if _group_idx(k2) == g], g)
+            return min(counts.values()) if counts else 0
         return self.inner.lookup([_block_hash(key)], _group_idx(key)) == 1
 
     def prepare_load(self, keys, req_context=None) -> LoadStoreSpec:

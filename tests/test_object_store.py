@@ -293,6 +293,10 @@ class _S3Stub:
 
             def _key(self):
                 path = unquote(urlparse(self.path).path).lstrip("/")
+                # Reverse-proxy shape: strip the gateway prefix when the
+                # client addresses the endpoint as <url>/gateway.
+                if path.startswith("gateway/"):
+                    path = path[len("gateway/"):]
                 bucket, _, key = path.partition("/")
                 return bucket, key
 
@@ -459,13 +463,29 @@ class TestS3Client:
                                 transport="boto")
 
     def test_pathful_endpoint(self, s3_stub):
-        # Reverse-proxied gateway shape: endpoint with a path component.
-        # The stub ignores the leading segment (bucket parse strips one
-        # component), so exercise URL assembly + signing end-to-end by
-        # treating the path segment as the bucket position.
+        # Reverse-proxied gateway shape: the endpoint carries a path
+        # component the server also sees, so the client must both request
+        # AND sign /gateway/bucket/key (the stub strips the prefix).
         from llmd_kv_cache_tpu.offload.object_store import _HttpS3
 
-        c = _HttpS3("kv-bucket", s3_stub.url + "/", access_key="AK",
-                    secret_key="SK")
+        c = _HttpS3("kv-bucket", s3_stub.url + "/gateway",
+                    access_key="AK", secret_key="SK")
         c.put("p/x", b"data")
         assert c.get("p/x") == b"data"
+        assert c.exists("p/x") is True
+        assert c.get_range("p/x", 1, 2) == b"at"
+
+    def test_env_credentials_reach_http_transport(self, s3_stub,
+                                                  monkeypatch):
+        from llmd_kv_cache_tpu.offload.object_store import (
+            S3ObjectStoreClient, _HttpS3)
+
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "ENVAK")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "ENVSK")
+        c = S3ObjectStoreClient("b", endpoint_url=s3_stub.url,
+                                transport="http")
+        assert isinstance(c._impl, _HttpS3)
+        assert c._impl.access_key == "ENVAK"
+        assert c._impl.secret_key == "ENVSK"
+        c.put("e/k", b"v")  # signed requests accepted end-to-end
+        assert c.get("e/k") == b"v"
