@@ -53,10 +53,15 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None,
 
     from llmd_kv_cache_tpu.events.model import EventBatch
     from llmd_kv_cache_tpu.events.pool import Pool, PoolConfig
-    from llmd_kv_cache_tpu.models.llama import init_params
+    from llmd_kv_cache_tpu.models.llama import fuse_params, init_params
 
     if params is None:
         params = init_params(jax.random.PRNGKey(0), model_cfg)
+    # Fuse ONCE before sharing: each engine fuses by default, and fusing
+    # a shared unfused tree per pod would materialize n_pods private
+    # weight copies (~1 GiB each at the TPU bench shape). fuse_params is
+    # a no-op on an already-fused tree, so the engines just adopt it.
+    params = fuse_params(params, model_cfg)
     # Capacity-constrained page pool (the regime where routing matters:
     # each pod can hold a few of the workload's shared prefixes, like the
     # reference's 73%-capacity setup). Round-robin thrashes the prefix
@@ -155,10 +160,14 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
     honest here — the same reasoning as ``queueing_ttfts``, but with the
     service process real.
 
-    Returns ``(ttfts, hit_rate, out_tok_s)`` — one TTFT per request, the
-    prefix hit rate, and the fleet's sustained output throughput
+    Returns ``(ttfts, hit_rate, out_tok_s, decode)`` — one TTFT per
+    request, the prefix hit rate, the fleet's sustained output throughput
     (decoded tokens / virtual makespan — the reference capacity tables'
-    headline unit, 73-capacity README "Summary across QPS").
+    headline unit, 73-capacity README "Summary across QPS"), and decode
+    latency samples: ``decode["itl"]`` is every inter-token gap in
+    virtual time (the reference tables' "ITL mean" unit) and
+    ``decode["tpot"]`` one per-request mean time-per-output-token
+    (requests with ≥2 tokens).
     """
     import math
     import sys
@@ -170,6 +179,12 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
     arr_of: dict = {}
     ttfts: dict = {}
     emitted_once: set = set()
+    # Decode latency accounting: last emission clock and token count per
+    # request; gaps between consecutive emissions are the ITL samples.
+    last_emit: dict = {}
+    first_emit: dict = {}
+    n_emitted: dict = {}
+    itls: list = []
     hit_tokens = total_tokens = out_tokens = 0
     n = len(workload)
     i = 0
@@ -233,6 +248,12 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
                 new_first = True
                 j = int(rid[1:])
                 ttfts[j] = clocks[p] - arr_of[j]
+                first_emit[rid] = clocks[p]
+                n_emitted[rid] = 1
+            else:
+                itls.append(clocks[p] - last_emit[rid])
+                n_emitted[rid] += 1
+            last_emit[rid] = clocks[p]
         if new_first and len(emitted_once) % 16 == 0:
             print(f"[bench {tag}] {len(emitted_once)}/{n} first tokens, "
                   f"{time.perf_counter() - arm_start:.1f}s elapsed",
@@ -240,8 +261,13 @@ def run_concurrent(pods, workload, router, arrivals, max_new_tokens=8,
 
     assert len(ttfts) == n, f"served {len(ttfts)} of {n}"
     makespan = max(clocks.values())
+    tpots = [
+        (last_emit[rid] - first_emit[rid]) / (n_emitted[rid] - 1)
+        for rid in first_emit if n_emitted[rid] > 1
+    ]
     return ([ttfts[j] for j in range(n)], hit_tokens / max(total_tokens, 1),
-            out_tokens / max(makespan, 1e-9))
+            out_tokens / max(makespan, 1e-9),
+            {"itl": itls, "tpot": tpots})
 
 
 def make_kv_router(indexer):
@@ -597,8 +623,12 @@ def main(queued: bool = True) -> None:
     # pollute TTFT for either arm.
     import sys as _sys
     _t0 = time.perf_counter()
+    from llmd_kv_cache_tpu.models.llama import fuse_params as _fuse_params
     from llmd_kv_cache_tpu.models.llama import init_params as _init_params
-    shared_params = _init_params(jax.random.PRNGKey(0), model_cfg)
+    # Fused once here; every fleet shares this single tree (make_pods's
+    # fuse and the engines' are no-ops on it).
+    shared_params = _fuse_params(
+        _init_params(jax.random.PRNGKey(0), model_cfg), model_cfg)
     warm_indexer = fresh_indexer()
     warm = make_pods(1, model_cfg, engine_mod, warm_indexer,
                      params=shared_params, pod_kw=pod_kw)["pod-0"]
@@ -703,8 +733,15 @@ def main(queued: bool = True) -> None:
     # On the tunneled TPU each concurrent fleet re-serves the workload at
     # real service times (~minutes): run the headline point plus one
     # light- and one over-load point; CPU sweeps three points.
-    conc_mults = ((0.75, 1.25, 1.5) if platform == "tpu"
-                  else (0.75, 1.25, 2.0))
+    # KVTPU_BENCH_FULL=1 widens the on-chip sweep to 6 QPS points (the
+    # reference capacity tables' grid); default keeps the driver's
+    # end-of-round run inside its window.
+    if platform == "tpu":
+        conc_mults = ((0.5, 0.75, 1.0, 1.25, 1.5, 2.0)
+                      if _os.environ.get("KVTPU_BENCH_FULL")
+                      else (0.75, 1.25, 1.5))
+    else:
+        conc_mults = (0.75, 1.25, 2.0)
     for mult in conc_mults:
         qps = mult * fleet_qps
         arr = np.cumsum(
@@ -712,14 +749,14 @@ def main(queued: bool = True) -> None:
         crr_indexer = fresh_indexer()
         crr_pods = make_pods(n_pods, model_cfg, engine_mod, crr_indexer,
                              params=shared_params, pod_kw=pod_kw)
-        crr_t, crr_hit, crr_tps = run_concurrent(
+        crr_t, crr_hit, crr_tps, _ = run_concurrent(
             crr_pods, workload, make_rr_router(), arr,
             tag=f"conc-rr {mult}x")
         del crr_pods
         ckv_indexer = fresh_indexer()
         ckv_pods = make_pods(n_pods, model_cfg, engine_mod, ckv_indexer,
                              params=shared_params, pod_kw=pod_kw)
-        ckv_t, ckv_hit, ckv_tps = run_concurrent(
+        ckv_t, ckv_hit, ckv_tps, _ = run_concurrent(
             ckv_pods, workload, make_kv_router(ckv_indexer), arr,
             tag=f"conc-kv {mult}x")
         del ckv_pods
@@ -768,7 +805,7 @@ def main(queued: bool = True) -> None:
             s_indexer = fresh_indexer()
             s_pods = make_pods(n_pods, model_cfg, engine_mod, s_indexer,
                                params=shared_params, pod_kw=pod_kw)
-            s_t, s_hit, s_tps = run_concurrent(
+            s_t, s_hit, s_tps, _ = run_concurrent(
                 s_pods, workload, factory(s_indexer), arr,
                 tag=f"conc-{strat}")
             del s_pods
@@ -780,6 +817,48 @@ def main(queued: bool = True) -> None:
                   f"{strategy_comparison[strat]['p50']:.3f}s hit "
                   f"{s_hit:.2f} out {s_tps:.0f} tok/s",
                   file=_sys.stderr, flush=True)
+
+    # Decode-heavy arm (VERDICT r4 #6): the 8-token decodes above make
+    # "out tok/s" mostly prefill amortization; the reference capacity
+    # tables report ITL mean alongside TTFT (73-capacity README "ITL
+    # mean 0.026 s"). Re-serve the headline point with long decodes and
+    # report ITL (inter-token gap) and TPOT (per-request mean) per
+    # strategy. KVTPU_BENCH_DECODE_TOKENS overrides the depth.
+    decode_heavy = {}
+    decode_tokens = int(_os.environ.get(
+        "KVTPU_BENCH_DECODE_TOKENS", 96 if platform == "tpu" else 24))
+    if decode_tokens > 1:
+        arr = np.cumsum(np.random.default_rng(7).exponential(
+            1.0 / (1.25 * fleet_qps), len(workload)))
+        dh_strategies = (("kv_precise", make_kv_router),
+                         ("round_robin", make_rr_router),
+                         ("load_aware", make_load_router),
+                         ("random", make_random_router))
+        for strat, factory in dh_strategies:
+            d_indexer = fresh_indexer()
+            d_pods = make_pods(n_pods, model_cfg, engine_mod, d_indexer,
+                               params=shared_params, pod_kw=pod_kw)
+            d_t, d_hit, d_tps, d_dec = run_concurrent(
+                d_pods, workload, factory(d_indexer), arr,
+                max_new_tokens=decode_tokens, tag=f"decode-{strat}")
+            del d_pods
+            itl, tpot = d_dec["itl"], d_dec["tpot"]
+            decode_heavy[strat] = {
+                "ttft_p50": round(statistics.median(d_t), 4),
+                "itl_p50": round(statistics.median(itl), 5) if itl else None,
+                "itl_p90": round(float(np.quantile(itl, 0.9)), 5)
+                           if itl else None,
+                "tpot_p50": round(statistics.median(tpot), 5)
+                            if tpot else None,
+                "tpot_p90": round(float(np.quantile(tpot, 0.9)), 5)
+                            if tpot else None,
+                "hit": round(d_hit, 4), "out_tok_s": round(d_tps, 1)}
+            row = decode_heavy[strat]
+            print(f"[bench decode] {strat}: ttft p50 {row['ttft_p50']:.3f}s "
+                  f"itl p50 {row['itl_p50']}s p90 {row['itl_p90']}s "
+                  f"out {row['out_tok_s']:.0f} tok/s",
+                  file=_sys.stderr, flush=True)
+        decode_heavy["max_new_tokens"] = decode_tokens
 
     # Headline: the 1.25×-capacity point, from the CONCURRENT
     # continuous-batching arm when it ran — measured TTFTs under real
@@ -828,6 +907,8 @@ def main(queued: bool = True) -> None:
         "concurrent_sweep": conc_sweep,
         "strategy_comparison": strategy_comparison,
     }
+    if decode_heavy:
+        line["decode_heavy"] = decode_heavy
     if st_p50 is not None:
         line["storage_restore_p50_s"] = round(st_p50, 4)
         line["storage_hit_rate"] = round(st_hit, 4)

@@ -334,6 +334,67 @@ def main():
                   f"{type(e).__name__}: {str(e)[:120]}", flush=True)
 
 
+def main_decode():
+    """Decode-bandwidth sweep (`--decode`, VERDICT r5 #1): the merged
+    flash-decode kernel across batch_rows (rows co-scheduled per
+    program) × keys-per-round, at b8/b32 × ctx 2k/4k — ms/step,
+    effective KV GB/s, and % of the ~819 GB/s v5e HBM roofline. KV bytes
+    per step = b · ctx · kvh · hd · 2 streams · itemsize; the weights
+    are not in this op, so the number isolates the attention stream."""
+    rng = np.random.default_rng(0)
+    kvh, hd, ps = 8, 128, 16  # kv_heads, head_dim, page size
+    num_pages = 16 * 1024 + 1
+    kc = jnp.asarray(rng.normal(size=(num_pages, kvh, ps, hd)), jnp.bfloat16)
+    vc = jnp.asarray(rng.normal(size=(num_pages, kvh, ps, hd)), jnp.bfloat16)
+    attn_reps = 16
+
+    def run(batch, ctx, rows, kpb):
+        q = jnp.asarray(rng.normal(size=(batch, 16, hd)), jnp.bfloat16)
+        pages_per_seq = ctx // ps
+        table = jnp.asarray(
+            1 + (np.arange(batch * pages_per_seq, dtype=np.int64)
+                 * 2654435761 % (num_pages - 1)).reshape(
+                     batch, pages_per_seq).astype(np.int32))
+        lens = jnp.full((batch,), ctx, jnp.int32)
+        kv_bytes = batch * ctx * kvh * hd * 2 * 2
+
+        @jax.jit
+        def scanned(q_op, kc, vc):
+            def body(c, _):
+                o = pallas_paged_decode_attention(
+                    q_op * (1 + c * 0).astype(q_op.dtype), kc, vc, table,
+                    lens, pages_per_block=kpb, batch_rows=rows)
+                return o.ravel()[0].astype(jnp.float32), None
+            out, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                  length=attn_reps)
+            return out
+
+        out = scanned(q, kc, vc)
+        _sync(out)
+        start = time.perf_counter()
+        iters = 4
+        for _ in range(iters):
+            out = scanned(q, kc, vc)
+        _sync(out)
+        dt = (time.perf_counter() - start) / iters / attn_reps
+        gbs = kv_bytes / dt / 1e9
+        print(f"decode b{batch:<3d} ctx{ctx:<5d} rows={rows:<2d} "
+              f"kpb={'auto' if kpb is None else kpb:<4} "
+              f"{dt * 1e3:8.3f} ms/step  {gbs:7.1f} GB/s eff "
+              f"({gbs / 819 * 100:5.1f}% of v5e HBM)", flush=True)
+
+    for batch, ctx in ((8, 4096), (8, 2048), (32, 2048), (32, 4096)):
+        for rows in (1, 2, 4, 8):
+            if rows > batch:
+                continue
+            for kpb in (None, 8, 16, 32, 64):
+                try:
+                    run(batch, ctx, rows, kpb)
+                except Exception as e:
+                    print(f"decode b{batch} ctx{ctx} rows={rows} kpb={kpb}: "
+                          f"{type(e).__name__}: {str(e)[:110]}", flush=True)
+
+
 def main_big():
     """3.1B-param scaling datapoint (`--big`): the bench model's MFU is
     bounded by its small matmul shapes (hidden 2048); at Llama-7B-like
@@ -372,5 +433,7 @@ if __name__ == "__main__":
     import sys
     if "--big" in sys.argv:
         main_big()
+    elif "--decode" in sys.argv:
+        main_decode()
     else:
         main()

@@ -18,7 +18,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from ..utils.logging import get_logger
-from .llama import LlamaConfig, Params, init_params
+from .llama import LlamaConfig, Params, init_params, unfuse_params
 
 logger = get_logger("models.checkpoint")
 
@@ -27,8 +27,13 @@ _META_FILE = "engine_meta.json"
 
 def save_engine_checkpoint(path: str, params: Params, model_cfg: LlamaConfig,
                            model_name: str, hash_seed: str = "") -> None:
-    """Save params + engine identity to ``path`` (a directory)."""
+    """Save params + engine identity to ``path`` (a directory).
+
+    Checkpoints always store the canonical (unfused) projection layout —
+    portable across fused serving engines, TP sharding, and the trainer;
+    a fused tree (models.llama.fuse_params) is split back on save."""
     path = os.path.abspath(path)
+    params = unfuse_params(params, model_cfg)
     with ocp.StandardCheckpointer() as ckptr:
         # force=True: periodic re-checkpointing to a fixed path overwrites.
         ckptr.save(os.path.join(path, "params"), params, force=True)

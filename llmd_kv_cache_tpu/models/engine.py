@@ -97,6 +97,22 @@ class EngineConfig:
     # False forces XLA prefill; True insists and warns if the Pallas
     # backend is inactive.
     use_pallas_prefill: Optional[bool] = None
+    # Fuse QKV (and gate+up, MLA input) projections into single wider
+    # matmuls at startup (models.llama.fuse_params). None = auto: fused
+    # on single-shard engines, unfused under a mesh (the fused column
+    # blocks shard non-uniformly across tp). When sharing one params
+    # tree across pods, pass it through fuse_params FIRST (fusing is a
+    # no-op on a fused tree) — otherwise each engine materializes its
+    # own fused weight copy. Checkpoints store the canonical unfused
+    # layout either way (models.checkpoint unfuses on save).
+    fuse_projections: Optional[bool] = None
+    # Batch rows co-scheduled per flash-decode program (merged-heads
+    # kernel): each round issues every row's page DMAs together and the
+    # pipeline fills once per program instead of once per batch item —
+    # the decode-bandwidth lever (VERDICT r4 #1). 1 = one program per
+    # batch item (round-4 behavior). Single-shard Pallas decode only;
+    # ignored under tp sharding and on the XLA backend.
+    decode_batch_rows: int = 1
     # Chunked prefill: the uncached suffix is processed in chunks of at
     # most this many tokens (vLLM-style), bounding per-step activation
     # memory for long prompts. Must be a multiple of the page size.
@@ -471,6 +487,18 @@ class MiniEngine:
             self.block_manager = BlockManager(self.cfg, self.processor, event_sink)
             self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
 
+        fuse = self.cfg.fuse_projections
+        if fuse is None:
+            fuse = mesh is None
+        if fuse and mesh is not None:
+            raise ValueError(
+                "fuse_projections=True is incompatible with a mesh: fused "
+                "column blocks shard non-uniformly across tp")
+        if fuse:
+            from .llama import fuse_params
+
+            self.params = fuse_params(self.params, mcfg)
+
         if mesh is not None:
             from ..parallel.serve import shard_engine_params, shard_kv_pool
 
@@ -528,13 +556,23 @@ class MiniEngine:
                         "uses XLA attention (set decode_burst>1 to engage "
                         "the kernel)")
             use_pallas = False
+        rows = max(1, self.cfg.decode_batch_rows)
+        if mcfg.kv_cache_heads == 1:
+            # The multi-row path rides the merged-heads kernel, which the
+            # wrapper only engages for kv_heads > 1 (MLA/MQA pools run the
+            # per-head grid) — clamp instead of crashing, matching the
+            # knob's documented ignore-when-unavailable behavior.
+            rows = 1
         if use_pallas:
             # Under tp the kernels run per-shard over the kv-heads
             # sharding via shard_map (the decode grid is per-kv-head
             # independent, so no cross-shard traffic in attention itself).
             pallas_mesh = mesh if self._tp > 1 else None
+            if pallas_mesh is not None:
+                rows = 1  # sharded path keeps one row per program
             self._decode_forward = functools.partial(
-                forward_decode_pallas, interpret=not on_tpu, mesh=pallas_mesh
+                forward_decode_pallas, interpret=not on_tpu,
+                mesh=pallas_mesh, batch_rows=rows,
             )
         else:
             pallas_mesh = None
@@ -563,11 +601,16 @@ class MiniEngine:
         self._decode_multi = functools.partial(
             forward_decode_steps, use_pallas=use_pallas,
             interpret=use_pallas and not on_tpu, mesh=pallas_mesh,
+            batch_rows=rows if use_pallas else 1,
         )
+        hybrid_mesh = (mesh if hybrid_burst_pallas and self._tp > 1
+                       else None)
         self._decode_multi_hybrid = functools.partial(
             forward_decode_steps_hybrid, use_pallas=hybrid_burst_pallas,
             interpret=hybrid_burst_pallas and not on_tpu,
-            mesh=(mesh if hybrid_burst_pallas and self._tp > 1 else None),
+            mesh=hybrid_mesh,
+            batch_rows=(rows if hybrid_burst_pallas and hybrid_mesh is None
+                        else 1),
         )
         # Burst size: the power-of-two floor of cfg.decode_burst, fixed for
         # the engine's lifetime — ONE fused-decode program. Per-row budgets

@@ -379,6 +379,95 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
     }
 
 
+def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
+    """Fuse per-layer projections that share an input into wider matmuls.
+
+    Serving-time transform (applied once at engine startup): the hidden
+    size is the matmuls' K dimension, and at K=2048 the MXU spends a
+    larger share of each narrow-N matmul on pipeline fill — one
+    [h, Nq+Nk+Nv] product reads the activations once and keeps the
+    systolic array busier than three back-to-back [h, N] products
+    (measured lever from the round-4 MFU roofline, benchmarking/r4-mfu).
+
+    - ``wq/wk/wv`` (+ ``bq/bk/bv``) → ``w_qkv`` (+ ``b_qkv``)
+    - MLA: ``wq|w_dq`` + ``w_dkv`` + ``w_kr`` → ``w_mla_in``
+      (all consume post-norm attn input; q-LoRA keeps its separate
+      ``wq`` over the normed q latent)
+    - dense SwiGLU: ``w_gate/w_up`` → ``w_gate_up``
+    - DeepSeek shared experts: ``w_gate_sh/w_up_sh`` → ``w_gate_up_sh``
+
+    Originals are dropped (no weight memory doubling). The forward
+    accepts both layouts. TP-sharded serving keeps the unfused layout:
+    the fused column blocks (q vs kv heads, gate vs up) would shard
+    non-uniformly across the tp axis.
+    """
+    out = dict(params)
+    fused_layers = []
+    for layer in params["layers"]:
+        lyr = dict(layer)
+        if "wk" in lyr:  # standard / GQA attention
+            lyr["w_qkv"] = jnp.concatenate(
+                [lyr.pop("wq"), lyr.pop("wk"), lyr.pop("wv")], axis=1)
+            if "bq" in lyr:
+                lyr["b_qkv"] = jnp.concatenate(
+                    [lyr.pop("bq"), lyr.pop("bk"), lyr.pop("bv")])
+        elif "w_dkv" in lyr:  # absorbed MLA
+            head_in = (lyr.pop("w_dq") if "w_dq" in lyr
+                       else lyr.pop("wq"))
+            lyr["w_mla_in"] = jnp.concatenate(
+                [head_in, lyr.pop("w_dkv"), lyr.pop("w_kr")], axis=1)
+        if "w_gate" in lyr and lyr["w_gate"].ndim == 2:  # dense SwiGLU
+            lyr["w_gate_up"] = jnp.concatenate(
+                [lyr.pop("w_gate"), lyr.pop("w_up")], axis=1)
+        if "w_gate_sh" in lyr:
+            lyr["w_gate_up_sh"] = jnp.concatenate(
+                [lyr.pop("w_gate_sh"), lyr.pop("w_up_sh")], axis=1)
+        fused_layers.append(lyr)
+    out["layers"] = fused_layers
+    return out
+
+
+def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
+    """Inverse of :func:`fuse_params`: split fused projections back into
+    the canonical per-projection layout. Checkpoints always store the
+    canonical layout (portable across fused/unfused engines, TP sharding,
+    and the trainer); a fused serving tree is unfused on save. No-op on
+    an already-canonical tree."""
+    out = dict(params)
+    layers = []
+    for layer in params["layers"]:
+        lyr = dict(layer)
+        if "w_qkv" in lyr:
+            nq = cfg.num_heads * cfg.head_dim
+            nk = cfg.num_kv_heads * cfg.head_dim
+            w = lyr.pop("w_qkv")
+            lyr["wq"], lyr["wk"], lyr["wv"] = (
+                w[:, :nq], w[:, nq:nq + nk], w[:, nq + nk:])
+            if "b_qkv" in lyr:
+                b = lyr.pop("b_qkv")
+                lyr["bq"], lyr["bk"], lyr["bv"] = (
+                    b[:nq], b[nq:nq + nk], b[nq + nk:])
+        if "w_mla_in" in lyr:
+            r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+            w = lyr.pop("w_mla_in")
+            qc = w.shape[1] - r - dr
+            head_key = "w_dq" if "q_latent_norm" in lyr else "wq"
+            lyr[head_key] = w[:, :qc]
+            lyr["w_dkv"] = w[:, qc:qc + r]
+            lyr["w_kr"] = w[:, qc + r:]
+        if "w_gate_up" in lyr:
+            w = lyr.pop("w_gate_up")
+            inter = w.shape[1] // 2
+            lyr["w_gate"], lyr["w_up"] = w[:, :inter], w[:, inter:]
+        if "w_gate_up_sh" in lyr:
+            w = lyr.pop("w_gate_up_sh")
+            sh = w.shape[1] // 2
+            lyr["w_gate_sh"], lyr["w_up_sh"] = w[:, :sh], w[:, sh:]
+        layers.append(lyr)
+    out["layers"] = layers
+    return out
+
+
 def init_kv_cache(cfg: LlamaConfig, num_pages: int) -> tuple[jax.Array, jax.Array]:
     """Allocate the paged K and V pools: ``[layers, pages, kvh, page, hd]``.
 
@@ -558,8 +647,14 @@ def _moe_deepseek(mlp_in, layer, cfg):
     ).astype(jnp.float32)
     out = jnp.einsum("te,teh->th", mix_w, expert_out).astype(mlp_in.dtype)
 
-    sh_gate = jax.nn.silu((x @ layer["w_gate_sh"]).astype(jnp.float32))
-    sh_up = (x @ layer["w_up_sh"]).astype(jnp.float32)
+    if "w_gate_up_sh" in layer:  # fused serving layout (fuse_params)
+        sh_gu = (x @ layer["w_gate_up_sh"]).astype(jnp.float32)
+        sh_i = sh_gu.shape[-1] // 2
+        sh_gate = jax.nn.silu(sh_gu[..., :sh_i])
+        sh_up = sh_gu[..., sh_i:]
+    else:
+        sh_gate = jax.nn.silu((x @ layer["w_gate_sh"]).astype(jnp.float32))
+        sh_up = (x @ layer["w_up_sh"]).astype(jnp.float32)
     shared = (sh_gate * sh_up).astype(x.dtype) @ layer["w_down_sh"]
     return (out + shared).reshape(b, s, h)
 
@@ -585,8 +680,14 @@ def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
             return _moe_dense(mlp_in, layer, cfg, aux_out)
         raise ValueError(f"unknown moe_dispatch: {cfg.moe_dispatch!r}")
 
-    gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
-    up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
+    if "w_gate_up" in layer:  # fused serving layout (fuse_params)
+        gu = (mlp_in @ layer["w_gate_up"]).astype(jnp.float32)
+        inter = gu.shape[-1] // 2
+        gate = jax.nn.silu(gu[..., :inter])
+        up = gu[..., inter:]
+    else:
+        gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
+        up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
     return (gate * up).astype(mlp_in.dtype) @ layer["w_down"]
 
 
@@ -722,26 +823,41 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
             # with head_dim = rank+rope over the cache this file already
             # pages, and HBM traffic per token drops by ~num_heads·2.
             r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
-            if "w_dq" in layer:
-                # DeepSeek q-LoRA: q is down-projected to a compressed
-                # latent, RMS-normed, then up-projected per head — the
-                # norm between the two matmuls prevents precomposition.
-                q_in = _rms_norm(attn_in @ layer["w_dq"],
-                                 layer["q_latent_norm"], cfg.norm_eps)
+            if "w_mla_in" in layer:  # fused serving layout (fuse_params)
+                fused = attn_in @ layer["w_mla_in"]
+                qc = fused.shape[-1] - r - dr  # static split point
+                head_in = fused[..., :qc]
+                c_kv = fused[..., qc:qc + r]
+                k_rope_in = fused[..., qc + r:]
+                if "q_latent_norm" in layer:
+                    # q-LoRA: the fused block holds w_dq's output; the
+                    # norm between down- and up-projection stays.
+                    q = _rms_norm(head_in, layer["q_latent_norm"],
+                                  cfg.norm_eps) @ layer["wq"]
+                else:
+                    q = head_in
             else:
-                q_in = attn_in
-            q = (q_in @ layer["wq"]).reshape(
-                batch, seq, cfg.num_heads, cfg.head_dim + dr)
+                if "w_dq" in layer:
+                    # DeepSeek q-LoRA: q is down-projected to a compressed
+                    # latent, RMS-normed, then up-projected per head — the
+                    # norm between the two matmuls prevents precomposition.
+                    q_in = _rms_norm(attn_in @ layer["w_dq"],
+                                     layer["q_latent_norm"], cfg.norm_eps)
+                else:
+                    q_in = attn_in
+                q = q_in @ layer["wq"]
+                c_kv = attn_in @ layer["w_dkv"]  # [b, s, r]
+                k_rope_in = attn_in @ layer["w_kr"]
+            q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim + dr)
             q_nope, q_rope = q[..., :cfg.head_dim], q[..., cfg.head_dim:]
             q_rope = _rope(q_rope, positions, cfg.rope_theta,
                            cfg.rope_scaling)
-            c_kv = attn_in @ layer["w_dkv"]  # [b, s, r]
             if "latent_norm" in layer:
                 # DeepSeek kv_a_layernorm: the latent is RMS-normed before
                 # the up-projections — cached post-norm, so absorption is
                 # unchanged (w_uk applies to the normed latent).
                 c_kv = _rms_norm(c_kv, layer["latent_norm"], cfg.norm_eps)
-            k_rope = _rope((attn_in @ layer["w_kr"])[:, :, None, :],
+            k_rope = _rope(k_rope_in[:, :, None, :],
                            positions, cfg.rope_theta,
                            cfg.rope_scaling)  # [b, s, 1, dr]
             latent = jnp.concatenate(
@@ -787,13 +903,23 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                 )
             attn = jnp.einsum("bshr,hrv->bshv", ctx[..., :r], layer["w_uv"])
         else:
-            q = attn_in @ layer["wq"]
-            k = attn_in @ layer["wk"]
-            v = attn_in @ layer["wv"]
-            if "bq" in layer:  # Qwen2-lineage QKV projection biases
-                q = q + layer["bq"]
-                k = k + layer["bk"]
-                v = v + layer["bv"]
+            if "w_qkv" in layer:  # fused serving layout (fuse_params)
+                qkv = attn_in @ layer["w_qkv"]
+                if "b_qkv" in layer:
+                    qkv = qkv + layer["b_qkv"]
+                nq = cfg.num_heads * cfg.head_dim
+                nk = cfg.num_kv_heads * cfg.head_dim
+                q = qkv[..., :nq]
+                k = qkv[..., nq:nq + nk]
+                v = qkv[..., nq + nk:]
+            else:
+                q = attn_in @ layer["wq"]
+                k = attn_in @ layer["wk"]
+                v = attn_in @ layer["wv"]
+                if "bq" in layer:  # Qwen2-lineage QKV projection biases
+                    q = q + layer["bq"]
+                    k = k + layer["bk"]
+                    v = v + layer["bv"]
             q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
             k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
             v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
@@ -921,7 +1047,7 @@ def forward_hybrid(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "interpret", "mesh"),
+    static_argnames=("cfg", "interpret", "mesh", "batch_rows"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def forward_decode_pallas(
@@ -935,6 +1061,7 @@ def forward_decode_pallas(
     new_lens: jax.Array,  # [batch] 1 for live rows, 0 for padding
     interpret: bool = False,
     mesh=None,
+    batch_rows: int = 1,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Decode step (seq == 1) using the Pallas flash-decode kernel.
 
@@ -965,7 +1092,8 @@ def forward_decode_pallas(
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
                 sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
-                layer_idx=layer_idx, interpret=interpret,
+                layer_idx=layer_idx, batch_rows=batch_rows,
+                interpret=interpret,
             )
         return out[:, None]  # restore the seq axis
 
@@ -977,7 +1105,8 @@ def forward_decode_pallas(
 
 def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
                            sinks: int | None = None,
-                           shared_kv: bool = False):
+                           shared_kv: bool = False,
+                           batch_rows: int = 1):
     """Attention closure for fused decode bodies — one implementation for
     the single-pool and hybrid two-pool scans (the grouped forward hands
     each layer its own group's table and window, so the closure is
@@ -1013,7 +1142,8 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
                 q[:, 0], k_l, v_l, table, base_lens,
                 sliding_window=window, sinks=sinks, shared_kv=shared_kv,
                 tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
-                layer_idx=layer_idx, interpret=interpret,
+                layer_idx=layer_idx, batch_rows=batch_rows,
+                interpret=interpret,
             )
             return out[:, None]
         return paged_attention(
@@ -1027,7 +1157,8 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "use_pallas", "interpret", "mesh"),
+    static_argnames=("cfg", "steps", "use_pallas", "interpret", "mesh",
+                     "batch_rows"),
     donate_argnames=("k_cache", "v_cache"),
 )
 def forward_decode_steps(
@@ -1043,6 +1174,7 @@ def forward_decode_steps(
     use_pallas: bool = False,
     interpret: bool = False,
     mesh=None,
+    batch_rows: int = 1,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Greedy decode of ``steps`` tokens fused into ONE XLA program.
 
@@ -1080,7 +1212,8 @@ def forward_decode_steps(
         ctx_lens, active, steps,
         _decode_step_attention(use_pallas, interpret, mesh,
                                sinks=cfg.attention_sinks or None,
-                               shared_kv=cfg.is_mla),
+                               shared_kv=cfg.is_mla,
+                               batch_rows=batch_rows),
     )
     return toks, ks[0], vs[0]
 
@@ -1146,7 +1279,8 @@ def _decode_steps_scan(params, cfg, last_tokens, k_caches, v_caches, tables,
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "use_pallas", "interpret", "mesh"),
+    static_argnames=("cfg", "steps", "use_pallas", "interpret", "mesh",
+                     "batch_rows"),
     donate_argnames=("k0", "v0", "k1", "v1"),
 )
 def forward_decode_steps_hybrid(
@@ -1163,6 +1297,7 @@ def forward_decode_steps_hybrid(
     use_pallas: bool = False,
     interpret: bool = False,
     mesh=None,
+    batch_rows: int = 1,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused multi-token decode over the hybrid two-pool layout.
 
@@ -1182,7 +1317,8 @@ def forward_decode_steps_hybrid(
         ctx_lens, active, steps,
         _decode_step_attention(use_pallas, interpret, mesh,
                                sinks=cfg.attention_sinks or None,
-                               shared_kv=cfg.is_mla),
+                               shared_kv=cfg.is_mla,
+                               batch_rows=batch_rows),
     )
     return toks, ks[0], vs[0], ks[1], vs[1]
 

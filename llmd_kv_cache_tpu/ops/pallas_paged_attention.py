@@ -61,7 +61,7 @@ def _check_head_dim_alignment(head_dim: int, interpret: bool) -> None:
 def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
                          v_scratch, sem, *, kpb, num_iters, first_window,
                          sink_pages, sinks, shared_kv=False,
-                         layer_idx=None):
+                         layer_idx=None, row=None):
     """Shared page remap + superblock DMA for the decode/prefill kernels.
 
     ``page_for`` (internal) maps a loop counter to a page-table index —
@@ -76,8 +76,19 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
     ``shared_kv`` (absorbed MLA: values ARE the latent keys) streams each
     page ONCE into the K scratch and skips the V stream entirely —
     halving the attention's HBM traffic, which is the point of caching
-    only the latent."""
+    only the latent.
+
+    ``row``: multi-row decode programs (``batch_rows > 1``) stage each
+    batch row in its own scratch slice ``[slot, row, t]`` / semaphore
+    plane; ``None`` keeps the single-row ``[slot, t]`` layout."""
     pp_seq = page_table_ref.shape[1]
+
+    def dst(buf, slot, t):
+        return buf.at[slot, t] if row is None else buf.at[slot, row, t]
+
+    def dsem(slot, t, s):
+        return (sem.at[slot, t, s] if row is None
+                else sem.at[slot, row, t, s])
 
     def page_for(j):
         j = jnp.minimum(j, jnp.maximum(num_iters - 1, 0))  # DMA-safe clamp
@@ -104,13 +115,13 @@ def _superblock_streamer(page_table_ref, b, h, k_hbm, v_hbm, k_scratch,
         for t in range(kpb):
             page = page_table_ref[b, page_for(sb * kpb + t)]
             copies.append(pltpu.make_async_copy(
-                page_src(k_hbm, page), k_scratch.at[slot, t],
-                sem.at[slot, t, 0]
+                page_src(k_hbm, page), dst(k_scratch, slot, t),
+                dsem(slot, t, 0)
             ))
             if not shared_kv:
                 copies.append(pltpu.make_async_copy(
-                    page_src(v_hbm, page), v_scratch.at[slot, t],
-                    sem.at[slot, t, 1]
+                    page_src(v_hbm, page), dst(v_scratch, slot, t),
+                    dsem(slot, t, 1)
                 ))
         return copies
 
@@ -373,7 +384,8 @@ def _decode_kernel_merged(
     has_tail: bool,
     layer_idx: int | None,
 ):
-    """Decode with every kv head in ONE program per batch item.
+    """Decode with every kv head — and up to ``batch_rows`` batch items —
+    in ONE program.
 
     The per-head grid (``_decode_kernel``) pays pipeline fill/drain and
     per-page 4 KB DMAs once per (batch, head) program — measured on a
@@ -385,31 +397,60 @@ def _decode_kernel_merged(
     kv_heads× more work. The head loop is a static Python unroll of
     per-head [group, head_dim]×[head_dim, keys] matmuls over the shared
     streamed superblock.
+
+    ``batch_rows > 1`` additionally co-schedules several batch items per
+    program: each round issues every row's superblock DMAs together
+    (more copies in flight against the same HBM latency) and the
+    pipeline fills/drains once per program instead of once per batch
+    item. Rows already out of rounds skip their DMAs and carry their
+    state through unchanged; ragged contexts therefore cost bandwidth
+    only up to each row's own length. VMEM budgeting in the wrapper
+    divides the superblock across rows, so keys-per-round shrinks as
+    rows grow — the on-chip sweep picks the operating point.
     """
-    b = pl.program_id(0)
-    kv_heads, group = q_ref.shape[1], q_ref.shape[2]
+    b0 = pl.program_id(0)
+    rows, kv_heads, group = q_ref.shape[0], q_ref.shape[1], q_ref.shape[2]
     head_dim = q_ref.shape[3]
     kpb = pages_per_block
 
-    ctx_len = ctx_lens_ref[b]
-    tail_len = tail_lens_ref[b] if has_tail else jnp.int32(0)
-    q_end = ctx_len + tail_len
-    first_window, sink_pages, num_iters = _decode_stream_bounds(
-        ctx_len, q_end, page_size, sliding_window, sinks)
-    num_sb = (num_iters + kpb - 1) // kpb
+    ctx_len, tail_len, q_end = [], [], []
+    num_iters, num_sb_r, streamers = [], [], []
+    for r in range(rows):
+        b = b0 * rows + r
+        cl = ctx_lens_ref[b]
+        tl = tail_lens_ref[b] if has_tail else jnp.int32(0)
+        qe = cl + tl
+        fw, sp, ni = _decode_stream_bounds(
+            cl, qe, page_size, sliding_window, sinks)
+        ctx_len.append(cl)
+        tail_len.append(tl)
+        q_end.append(qe)
+        num_iters.append(ni)
+        num_sb_r.append((ni + kpb - 1) // kpb)
+        streamers.append(_superblock_streamer(
+            page_table_ref, b, None, k_hbm, v_hbm, k_scratch, v_scratch,
+            sem, kpb=kpb, num_iters=ni, first_window=fw, sink_pages=sp,
+            sinks=sinks, shared_kv=shared_kv, layer_idx=layer_idx,
+            row=r if rows > 1 else None))
+    num_sb = num_sb_r[0]
+    for r in range(1, rows):
+        num_sb = jnp.maximum(num_sb, num_sb_r[r])
 
-    sb_positions, sb_dma = _superblock_streamer(
-        page_table_ref, b, None, k_hbm, v_hbm, k_scratch, v_scratch, sem,
-        kpb=kpb, num_iters=num_iters, first_window=first_window,
-        sink_pages=sink_pages, sinks=sinks, shared_kv=shared_kv,
-        layer_idx=layer_idx)
+    def start_round(slot, sb):
+        # Per-row guard: a row past its rounds neither starts nor waits
+        # its copies (the same predicate gates both, below).
+        for r in range(rows):
+            @pl.when(sb < num_sb_r[r])
+            def _(r=r):
+                for c in streamers[r][1](slot, sb):
+                    c.start()
 
     @pl.when(num_sb > 0)
     def _():
-        for c in sb_dma(0, 0):
-            c.start()
+        start_round(0, 0)
 
-    qs = [q_ref[0, h] for h in range(kv_heads)]  # each [group, head_dim]
+    # qs[r][h]: [group, head_dim]
+    qs = [[q_ref[r, h] for h in range(kv_heads)] for r in range(rows)]
 
     def body(sb, carry):
         ms, ls, accs = carry
@@ -418,68 +459,88 @@ def _decode_kernel_merged(
 
         @pl.when(sb + 1 < num_sb)
         def _():
-            for c in sb_dma(next_slot, sb + 1):
-                c.start()
+            start_round(next_slot, sb + 1)
 
-        for c in sb_dma(slot, sb):
-            c.wait()
+        new_ms = [list(row_m) for row_m in ms]
+        new_ls = [list(row_l) for row_l in ls]
+        new_accs = [list(row_a) for row_a in accs]
+        for r in range(rows):
+            @pl.when(sb < num_sb_r[r])
+            def _(r=r):
+                for c in streamers[r][1](slot, sb):
+                    c.wait()
 
-        # Shared mask for every head: positions depend only on the batch
-        # item's pages — the per-head grid recomputed this kv_heads×.
-        positions = sb_positions(sb, ctx_len, page_size)
-        in_bounds = _decode_mask(positions, ctx_len, q_end, sliding_window,
-                                 sinks)
+            # Shared mask for every head: positions depend only on the
+            # row's pages — the per-head grid recomputed this kv_heads×.
+            positions = streamers[r][0](sb, ctx_len[r], page_size)
+            in_bounds = _decode_mask(positions, ctx_len[r], q_end[r],
+                                     sliding_window, sinks)
+            # Row liveness: past its last round the row's state must pass
+            # through untouched (an all-masked round with m still at
+            # -inf would turn exp(scores - m) into exp(0) garbage).
+            live = sb * kpb < num_iters[r]
 
-        new_ms, new_ls, new_accs = [], [], []
-        for h in range(kv_heads):
-            # [kpb, page_size, head_dim] slice of this head's keys →
-            # leading-collapse reshape (lane dim unchanged).
-            k = k_scratch[slot, :, h].reshape(kpb * page_size, head_dim)
-            v = k if shared_kv else v_scratch[slot, :, h].reshape(
-                kpb * page_size, head_dim)
-            scores = jax.lax.dot_general(
-                qs[h], k, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [group, kpb*page_size]
-            scores = jnp.where(in_bounds, scores, _NEG_INF)
+            for h in range(kv_heads):
+                # [kpb, page_size, head_dim] slice of this head's keys →
+                # leading-collapse reshape (lane dim unchanged).
+                ks = k_scratch[slot, :, h] if rows == 1 else \
+                    k_scratch[slot, r, :, h]
+                k = ks.reshape(kpb * page_size, head_dim)
+                if shared_kv:
+                    v = k
+                else:
+                    vs = v_scratch[slot, :, h] if rows == 1 else \
+                        v_scratch[slot, r, :, h]
+                    v = vs.reshape(kpb * page_size, head_dim)
+                scores = jax.lax.dot_general(
+                    qs[r][h], k,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale  # [group, kpb*page_size]
+                scores = jnp.where(in_bounds, scores, _NEG_INF)
 
-            m_cur = jnp.max(scores, axis=1, keepdims=True)
-            m_new = jnp.maximum(ms[h], m_cur)
-            p = jnp.exp(scores - m_new)
-            alpha = jnp.exp(ms[h] - m_new)
-            l_new = ls[h] * alpha + jnp.sum(p, axis=1, keepdims=True)
-            acc_new = accs[h] * alpha + jax.lax.dot_general(
-                p.astype(v.dtype), v,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            new_ms.append(m_new)
-            new_ls.append(l_new)
-            new_accs.append(acc_new)
-        return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+                m_cur = jnp.max(scores, axis=1, keepdims=True)
+                m_new = jnp.maximum(ms[r][h], m_cur)
+                p = jnp.exp(scores - m_new)
+                alpha = jnp.exp(ms[r][h] - m_new)
+                l_new = ls[r][h] * alpha + jnp.sum(p, axis=1, keepdims=True)
+                acc_new = accs[r][h] * alpha + jax.lax.dot_general(
+                    p.astype(v.dtype), v,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                new_ms[r][h] = jnp.where(live, m_new, ms[r][h])
+                new_ls[r][h] = jnp.where(live, l_new, ls[r][h])
+                new_accs[r][h] = jnp.where(live, acc_new, accs[r][h])
+        to_t = lambda rows_list: tuple(tuple(x) for x in rows_list)
+        return to_t(new_ms), to_t(new_ls), to_t(new_accs)
 
-    m0 = tuple(jnp.full((group, 1), _NEG_INF, jnp.float32)
-               for _ in range(kv_heads))
-    l0 = tuple(jnp.zeros((group, 1), jnp.float32) for _ in range(kv_heads))
-    acc0 = tuple(jnp.zeros((group, head_dim), jnp.float32)
-                 for _ in range(kv_heads))
+    m0 = tuple(tuple(jnp.full((group, 1), _NEG_INF, jnp.float32)
+                     for _ in range(kv_heads)) for _ in range(rows))
+    l0 = tuple(tuple(jnp.zeros((group, 1), jnp.float32)
+                     for _ in range(kv_heads)) for _ in range(rows))
+    acc0 = tuple(tuple(jnp.zeros((group, head_dim), jnp.float32)
+                       for _ in range(kv_heads)) for _ in range(rows))
     ms, l_fin, accs = jax.lax.fori_loop(0, num_sb, body, (m0, l0, acc0))
+    ms = [list(x) for x in ms]
+    l_fin = [list(x) for x in l_fin]
+    accs = [list(x) for x in accs]
 
     if has_tail:
-        folded = [_tail_fold(qs[h], tail_k_ref[0, :, h],
-                             tail_k_ref[0, :, h] if shared_kv
-                             else tail_v_ref[0, :, h],
-                             tail_len, ctx_len, ms[h], l_fin[h], accs[h],
-                             scale=scale, sliding_window=sliding_window,
-                             sinks=sinks)
-                  for h in range(kv_heads)]
-        ms = tuple(f[0] for f in folded)
-        l_fin = tuple(f[1] for f in folded)
-        accs = tuple(f[2] for f in folded)
+        for r in range(rows):
+            for h in range(kv_heads):
+                ms[r][h], l_fin[r][h], accs[r][h] = _tail_fold(
+                    qs[r][h], tail_k_ref[r, :, h],
+                    tail_k_ref[r, :, h] if shared_kv
+                    else tail_v_ref[r, :, h],
+                    tail_len[r], ctx_len[r], ms[r][h], l_fin[r][h],
+                    accs[r][h], scale=scale, sliding_window=sliding_window,
+                    sinks=sinks)
 
-    for h in range(kv_heads):
-        out = accs[h] / jnp.maximum(l_fin[h], 1e-30)
-        o_ref[0, h] = out.astype(o_ref.dtype)
+    for r in range(rows):
+        for h in range(kv_heads):
+            out = accs[r][h] / jnp.maximum(l_fin[r][h], 1e-30)
+            o_ref[r, h] = out.astype(o_ref.dtype)
 
 
 def _prefill_kernel(
@@ -731,7 +792,8 @@ def pallas_paged_prefill_attention(
 @functools.partial(jax.jit,
                    static_argnames=("interpret", "sliding_window", "sinks",
                                     "pages_per_block", "shared_kv",
-                                    "merge_heads", "layer_idx"))
+                                    "merge_heads", "layer_idx",
+                                    "batch_rows"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -748,6 +810,7 @@ def pallas_paged_decode_attention(
     tail_v: jax.Array | None = None,
     tail_lens: jax.Array | None = None,  # [batch] valid tail tokens
     layer_idx: int | None = None,
+    batch_rows: int = 1,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash-decode over paged KV. Returns ``[batch, q_heads, head_dim]``.
@@ -765,6 +828,13 @@ def pallas_paged_decode_attention(
     count drops kv_heads× (see ``_decode_kernel_merged``). The per-head
     grid remains for kv_heads == 1 (identical work) and as an escape
     hatch.
+
+    ``batch_rows`` (merged path only) co-schedules that many batch items
+    per program: per-round DMAs issue for every row together (more
+    copies in flight) and pipeline fill/drain amortizes across rows.
+    The VMEM superblock budget is divided across rows, so keys-per-round
+    shrinks accordingly; the batch is zero-padded to a multiple (padded
+    rows stream nothing and their outputs are sliced off).
     """
     batch, q_heads, head_dim = q.shape
     # layer_idx: see the prefill wrapper — stacked caches, in-kernel
@@ -777,6 +847,9 @@ def pallas_paged_decode_attention(
     _check_head_dim_alignment(head_dim, interpret)
     if merge_heads is None:
         merge_heads = kv_heads > 1
+    if batch_rows > 1 and not merge_heads:
+        raise ValueError("batch_rows > 1 requires the merged-heads kernel")
+    batch_rows = max(1, min(batch_rows, batch))
     if pages_per_block is None:
         # ~1024 keys per online-softmax round: measured on a real v5e at
         # batch 8 / ctx 4k (hack/mfu_probe.py), widening rounds from 128
@@ -791,7 +864,8 @@ def pallas_paged_decode_attention(
         if merge_heads:
             kv_streams = 1 if shared_kv else 2
             budget = (8 * 2 ** 20) // (
-                2 * kv_heads * head_dim * k_cache.dtype.itemsize * kv_streams)
+                2 * batch_rows * kv_heads * head_dim
+                * k_cache.dtype.itemsize * kv_streams)
             keys = min(keys, max(page_size, budget))
         pages_per_block = max(1, min(keys // page_size,
                                      page_table.shape[1]))
@@ -821,45 +895,62 @@ def pallas_paged_decode_attention(
         tail_v = jnp.zeros((batch, 1, kv_heads, head_dim), k_cache.dtype)
     t_len = tail_k.shape[1]
 
+    # Multi-row programs: zero-pad the batch to a row multiple. Padded
+    # rows have ctx_len 0 → no rounds, no DMAs; their outputs are 0 and
+    # sliced off below.
+    out_batch = batch
+    if batch % batch_rows:
+        pad = batch_rows - batch % batch_rows
+        bpad = [(0, pad)] + [(0, 0)] * 3
+        q_blocked = jnp.pad(q_blocked, bpad)
+        tail_k = jnp.pad(tail_k, bpad)
+        tail_v = jnp.pad(tail_v, bpad)
+        page_table = jnp.pad(page_table, [(0, pad), (0, 0)])
+        ctx_lens = jnp.pad(ctx_lens, (0, pad))
+        tail_lens = jnp.pad(tail_lens, (0, pad))
+        batch += pad
+
     if merge_heads:
+        rr = batch_rows
         kernel = functools.partial(
             _decode_kernel_merged, page_size=page_size,
             scale=head_dim ** -0.5, sliding_window=sliding_window,
             sinks=int(sinks or 0), pages_per_block=pages_per_block,
             shared_kv=shared_kv, has_tail=has_tail, layer_idx=layer_idx,
         )
+        k_scr = ((2, pages_per_block, kv_heads, page_size, head_dim)
+                 if rr == 1 else
+                 (2, rr, pages_per_block, kv_heads, page_size, head_dim))
+        v_scr = ((1,) * (5 if rr == 1 else 6)) if shared_kv else k_scr
+        sem_shape = ((2, pages_per_block, 2) if rr == 1
+                     else (2, rr, pages_per_block, 2))
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
-            grid=(batch,),
+            grid=(batch // rr,),
             in_specs=[
                 pl.BlockSpec(
-                    (1, kv_heads, group, head_dim),
+                    (rr, kv_heads, group, head_dim),
                     lambda b, *_prefetch: (b, 0, 0, 0),
                 ),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(
-                    (1, t_len, kv_heads, head_dim),
+                    (rr, t_len, kv_heads, head_dim),
                     lambda b, *_prefetch: (b, 0, 0, 0),
                 ),
                 pl.BlockSpec(
-                    (1, tail_v.shape[1], kv_heads, head_dim),
+                    (rr, tail_v.shape[1], kv_heads, head_dim),
                     lambda b, *_prefetch: (b, 0, 0, 0),
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, kv_heads, group, head_dim),
+                (rr, kv_heads, group, head_dim),
                 lambda b, *_prefetch: (b, 0, 0, 0),
             ),
             scratch_shapes=[
-                pltpu.VMEM(
-                    (2, pages_per_block, kv_heads, page_size, head_dim),
-                    k_cache.dtype),
-                pltpu.VMEM((1, 1, 1, 1, 1) if shared_kv else
-                           (2, pages_per_block, kv_heads, page_size,
-                            head_dim),
-                           k_cache.dtype),
-                pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
+                pltpu.VMEM(k_scr, k_cache.dtype),
+                pltpu.VMEM(v_scr, k_cache.dtype),
+                pltpu.SemaphoreType.DMA(sem_shape),
             ],
         )
     else:
@@ -924,7 +1015,7 @@ def pallas_paged_decode_attention(
       q_blocked, k_cache, v_cache, tail_k.astype(k_cache.dtype),
       tail_v.astype(k_cache.dtype))
 
-    return out.reshape(batch, q_heads, head_dim)
+    return out.reshape(batch, q_heads, head_dim)[:out_batch]
 
 
 def _kv_pool_spec(k_cache, stacked=False):

@@ -78,13 +78,19 @@ class TestRunConcurrent:
         # Two bursts: 4 requests at t=0 (they must queue behind each
         # other's service) and 4 long after (no queueing).
         arrivals = [0.0, 0.0, 0.0, 0.0, 1e6, 1e6 + 1, 1e6 + 2, 1e6 + 3]
-        ttfts, hit, out_tps = bench.run_concurrent(
+        ttfts, hit, out_tps, decode = bench.run_concurrent(
             pods, wl, bench.make_rr_router(), arrivals,
             max_new_tokens=4)
         assert len(ttfts) == 8 and all(t > 0 for t in ttfts)
         assert 0.0 <= hit <= 1.0
         # 8 requests x 4 decoded tokens over a positive makespan.
         assert out_tps > 0
+        # Decode latency accounting: 3 inter-token gaps per request (4
+        # tokens), one TPOT per request, all positive virtual times.
+        assert len(decode["itl"]) == 8 * 3
+        assert len(decode["tpot"]) == 8
+        assert all(g > 0 for g in decode["itl"])
+        assert all(t > 0 for t in decode["tpot"])
         # Every request decoded to completion through step().
         for p in pods.values():
             assert not p._running
@@ -104,7 +110,7 @@ class TestRunConcurrent:
                                   n_prefixes=1, prefix_len=12, suffix_len=4,
                                   vocab=200)
         arrivals = [0.0, 0.0, 0.0, 0.0]
-        ttfts, _, _ = bench.run_concurrent(
+        ttfts, _, _, _ = bench.run_concurrent(
             pods, wl, lambda *_a, **_kw: "pod-0", arrivals,
             max_new_tokens=4)
         assert len(ttfts) == 4 and all(t > 0 for t in ttfts)

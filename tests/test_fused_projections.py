@@ -1,0 +1,180 @@
+"""fuse_params parity: fused wider matmuls must reproduce the unfused
+forward exactly-enough (same dtype math over the same reductions — the
+per-column dot products are identical; only tiling may differ).
+
+Families covered: GQA (tiny), QKV biases + qk_norm (qwen-lineage),
+absorbed MLA incl. q-LoRA + shared-expert MoE (deepseek), dense SwiGLU.
+The serving engine turns fusion on by default for single-shard engines,
+so the whole engine suite exercises the fused path; this file pins the
+equivalence and the layout contract directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    fuse_params,
+    init_kv_cache,
+    init_params,
+)
+
+
+def run_forward(cfg, params, seed=5):
+    rng = np.random.default_rng(seed)
+    batch, seq = 2, 8
+    k, v = init_kv_cache(cfg, num_pages=16)
+    tokens = jnp.asarray(
+        rng.integers(1, cfg.vocab_size - 1, (batch, seq)), jnp.int32)
+    table = jnp.asarray(
+        rng.permutation(16)[: batch * 4].reshape(batch, 4), jnp.int32)
+    ctx = jnp.zeros((batch,), jnp.int32)
+    new = jnp.full((batch,), seq, jnp.int32)
+    logits, k, v = forward(params, cfg, tokens, k, v, table, ctx, new)
+    return np.asarray(logits), np.asarray(k), np.asarray(v)
+
+
+FAMILIES = {
+    "gqa": lambda: LlamaConfig.tiny(),
+    "qwen3_qknorm": lambda: LlamaConfig.qwen3_tiny(),
+    "deepseek_mla_moe": lambda: LlamaConfig.deepseek_tiny(),
+    "mixtral_moe": lambda: LlamaConfig.mixtral_tiny(),
+    "sinks": lambda: LlamaConfig.sink_tiny(),
+}
+
+
+class TestFusedParity:
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_logits_and_cache_parity(self, family):
+        cfg = FAMILIES[family]()
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        fused = fuse_params(params, cfg)
+        base_logits, base_k, base_v = run_forward(cfg, params)
+        f_logits, f_k, f_v = run_forward(cfg, fused)
+        np.testing.assert_allclose(f_logits, base_logits,
+                                   rtol=2e-5, atol=2e-5)
+        assert np.argmax(f_logits[..., -1, :], -1).tolist() == \
+            np.argmax(base_logits[..., -1, :], -1).tolist()
+        np.testing.assert_allclose(f_k, base_k, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(f_v, base_v, rtol=2e-5, atol=2e-5)
+
+    def test_qkv_biases_fuse(self):
+        cfg = LlamaConfig.tiny()
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        rng = np.random.default_rng(3)
+        for layer in params["layers"]:
+            for name, w in (("bq", "wq"), ("bk", "wk"), ("bv", "wv")):
+                layer[name] = jnp.asarray(
+                    rng.standard_normal(layer[w].shape[1]) * 0.02,
+                    layer[w].dtype)
+        fused = fuse_params(params, cfg)
+        assert "b_qkv" in fused["layers"][0]
+        base_logits, *_ = run_forward(cfg, params)
+        f_logits, *_ = run_forward(cfg, fused)
+        np.testing.assert_allclose(f_logits, base_logits,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_layout_contract(self):
+        cfg = LlamaConfig.tiny()
+        fused = fuse_params(init_params(jax.random.PRNGKey(0), cfg), cfg)
+        lyr = fused["layers"][0]
+        assert "w_qkv" in lyr and "w_gate_up" in lyr
+        for gone in ("wq", "wk", "wv", "w_gate", "w_up"):
+            assert gone not in lyr
+        h = cfg.hidden_size
+        assert lyr["w_qkv"].shape == (
+            h, (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim)
+        assert lyr["w_gate_up"].shape == (h, 2 * cfg.intermediate_size)
+
+    def test_moe_expert_weights_untouched(self):
+        cfg = LlamaConfig.mixtral_tiny()
+        fused = fuse_params(init_params(jax.random.PRNGKey(0), cfg), cfg)
+        lyr = fused["layers"][0]
+        # 3-D expert stacks stay; only the attention projections fuse.
+        assert "w_gate" in lyr and lyr["w_gate"].ndim == 3
+        assert "w_qkv" in lyr
+
+
+class TestEngineFusion:
+    def test_engine_defaults_to_fused_single_shard(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        eng = MiniEngine(EngineConfig(num_pages=32, max_pages_per_seq=8))
+        assert "w_qkv" in eng.params["layers"][0]
+        req = eng.add_request("r0", list(range(1, 20)), max_new_tokens=4)
+        while not req.done:
+            eng.step()
+        assert len(req.output) == 4
+
+    def test_fused_engine_matches_unfused_tokens(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        prompt = list(range(1, 40))
+        outs = []
+        for fuse in (False, True):
+            eng = MiniEngine(EngineConfig(
+                num_pages=64, max_pages_per_seq=16, fuse_projections=fuse),
+                seed=0)
+            req = eng.add_request("r0", prompt, max_new_tokens=8)
+            while not req.done:
+                eng.step()
+            outs.append(list(req.output))
+        assert outs[0] == outs[1]
+
+
+class TestUnfuse:
+    @pytest.mark.parametrize("family", sorted(FAMILIES))
+    def test_round_trip_is_identity(self, family):
+        from llmd_kv_cache_tpu.models.llama import unfuse_params
+
+        cfg = FAMILIES[family]()
+        params = init_params(jax.random.PRNGKey(4), cfg)
+        back = unfuse_params(fuse_params(params, cfg), cfg)
+        flat_a = jax.tree_util.tree_leaves_with_path(params)
+        flat_b = dict(jax.tree_util.tree_leaves_with_path(back))
+        assert len(flat_a) == len(flat_b)
+        for path, leaf in flat_a:
+            np.testing.assert_array_equal(np.asarray(leaf),
+                                          np.asarray(flat_b[path]))
+
+    def test_unfuse_is_noop_on_canonical(self):
+        from llmd_kv_cache_tpu.models.llama import unfuse_params
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(jax.random.PRNGKey(4), cfg)
+        back = unfuse_params(params, cfg)
+        assert set(back["layers"][0]) == set(params["layers"][0])
+
+
+class TestFusionInterplay:
+    def test_mla_engine_ignores_decode_batch_rows(self):
+        """kv_cache_heads == 1 (absorbed MLA) runs the per-head kernel;
+        the rows knob must clamp, not crash (review r5 finding)."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        eng = MiniEngine(EngineConfig(
+            model=LlamaConfig.deepseek_tiny(), num_pages=64,
+            max_pages_per_seq=16, use_pallas_decode=True,
+            decode_batch_rows=4, decode_burst=2))
+        req = eng.add_request("r0", list(range(1, 20)), max_new_tokens=3)
+        while not req.done:
+            eng.step()
+        assert len(req.output) == 3
+
+    def test_checkpoint_saves_canonical_layout(self, tmp_path):
+        from llmd_kv_cache_tpu.models.checkpoint import (
+            load_engine_checkpoint, save_engine_checkpoint)
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        cfg = LlamaConfig.tiny()
+        eng = MiniEngine(EngineConfig(model=cfg, num_pages=32,
+                                      max_pages_per_seq=8), seed=1)
+        assert "w_qkv" in eng.params["layers"][0]  # fused serving tree
+        save_engine_checkpoint(str(tmp_path / "ck"), eng.params, cfg,
+                               "tiny", "s")
+        params, cfg2, _, _ = load_engine_checkpoint(str(tmp_path / "ck"))
+        assert "wq" in params["layers"][0]
+        assert "w_qkv" not in params["layers"][0]

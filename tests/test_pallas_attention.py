@@ -419,3 +419,85 @@ def test_head_dim_alignment_guard(monkeypatch):
     # interpreter mode and 128-multiples are unrestricted
     mod._check_head_dim_alignment(64, interpret=True)
     mod._check_head_dim_alignment(256, interpret=False)
+
+
+@pytest.mark.parametrize("rows", [2, 3, 4])
+def test_batch_rows_parity(rows):
+    """Multi-row programs (batch_rows) must be numerics-identical to the
+    single-row merged kernel — including ragged contexts (rows finish
+    their rounds at different superblocks and must carry state through)
+    and a batch that does not divide the row count (zero-padded rows)."""
+    # Built directly (build_case fixes ctx_lens at 2 rows): 4 ragged
+    # rows over distinct pages.
+    batch, kvh, hd, ps = 4, 2, 8, 4
+    rng = np.random.default_rng(7)
+    k_cache = jnp.zeros((64, kvh, ps, hd), jnp.float32)
+    v_cache = jnp.zeros((64, kvh, ps, hd), jnp.float32)
+    table = jnp.asarray(1 + np.arange(batch * 4).reshape(batch, 4),
+                        jnp.int32)
+    max_ctx = 16
+    k_ctx = jnp.asarray(rng.normal(size=(batch, max_ctx, kvh, hd)),
+                        jnp.float32)
+    v_ctx = jnp.asarray(rng.normal(size=(batch, max_ctx, kvh, hd)),
+                        jnp.float32)
+    positions = jnp.arange(max_ctx)[None, :].repeat(batch, 0)
+    ctx_lens = jnp.asarray([16, 3, 9, 1], jnp.int32)
+    valid = positions < ctx_lens[:, None]
+    k_cache = scatter_kv_pages(k_cache, k_ctx, table, positions, valid)
+    v_cache = scatter_kv_pages(v_cache, v_ctx, table, positions, valid)
+    q = jnp.asarray(rng.normal(size=(batch, 8, hd)), jnp.float32)
+
+    base = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, interpret=True)
+    multi = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, batch_rows=rows,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(multi), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window,sinks", [(None, None), (12, 4)])
+def test_batch_rows_with_tail_and_windows(window, sinks):
+    """batch_rows composed with the burst tail, sliding windows, and
+    sinks — the full fused-decode feature set in one multi-row program."""
+    T = 6
+    q, k_cache, v_cache, table, _ = build_case(q_heads=8, kv_heads=2, ctx=10)
+    rng = np.random.default_rng(3)
+    B = q.shape[0]
+    ctx_lens = jnp.asarray([10, 7], jnp.int32)
+    tail_lens = jnp.asarray([5, 1], jnp.int32)
+    tail_k = jnp.asarray(rng.normal(size=(B, T, 2, 8)), jnp.float32)
+    tail_v = jnp.asarray(rng.normal(size=(B, T, 2, 8)), jnp.float32)
+
+    base = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+        sinks=sinks, tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
+        interpret=True)
+    multi = pallas_paged_decode_attention(
+        q, k_cache, v_cache, table, ctx_lens, sliding_window=window,
+        sinks=sinks, tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
+        batch_rows=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(multi), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_batch_rows_shared_kv():
+    """batch_rows on the single-stream (absorbed-MLA shared_kv) path."""
+    q, k_cache, v_cache, table, ctx_lens = build_case(
+        q_heads=8, kv_heads=2, ctx=14)
+    base = pallas_paged_decode_attention(
+        q, k_cache, k_cache, table, ctx_lens, shared_kv=True,
+        interpret=True)
+    multi = pallas_paged_decode_attention(
+        q, k_cache, k_cache, table, ctx_lens, shared_kv=True,
+        batch_rows=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(multi), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_batch_rows_requires_merged():
+    q, k_cache, v_cache, table, ctx_lens = build_case()
+    with pytest.raises(ValueError, match="merged-heads"):
+        pallas_paged_decode_attention(
+            q, k_cache, v_cache, table, ctx_lens, merge_heads=False,
+            batch_rows=2, interpret=True)

@@ -149,3 +149,28 @@ def test_mla_latent_pad_is_semantics_invariant():
             outs[name, use_pallas] = engine.generate(
                 "r", prompt, max_new_tokens=6)
     assert len({tuple(v) for v in outs.values()}) == 1, outs
+
+
+def test_pallas_decode_batch_rows_matches_single_row():
+    """decode_batch_rows co-schedules batch items per kernel program; the
+    served tokens must not change (multi-request batch so the decode
+    batch really has multiple rows, with distinct prompts)."""
+    prompts = {f"r{i}": list(range(10 + 7 * i, 30 + 7 * i))
+               for i in range(4)}
+    outs = {}
+    for rows in (1, 2, 4):
+        engine = MiniEngine(
+            EngineConfig(
+                model=LlamaConfig.tiny(), num_pages=128,
+                max_pages_per_seq=16, model_name="tiny", pod_identifier="p",
+                use_pallas_decode=True, decode_batch_rows=rows,
+                decode_burst=4,
+            ),
+            seed=0,
+        )
+        reqs = {rid: engine.enqueue(rid, p, max_new_tokens=6)
+                for rid, p in prompts.items()}
+        while not all(r.done for r in reqs.values()):
+            engine.step()
+        outs[rows] = {rid: list(r.output) for rid, r in reqs.items()}
+    assert outs[1] == outs[2] == outs[4]
