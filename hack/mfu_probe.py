@@ -82,6 +82,29 @@ def timed_threaded(label, fn, state, iters=8, flops=None):
     return dt
 
 
+def timed_scanned(op, operand, reps=16, iters=4):
+    """Steady-state seconds per op via a jit'd ``lax.scan`` of ``reps``
+    applications with a carry-dependent operand (defeats CSE/hoisting;
+    the multiplier casts back to the operand dtype so the timed op runs
+    the production bf16 path). One definition for every in-jit probe so
+    the methodology cannot drift between stages (review r5)."""
+    @jax.jit
+    def scanned(x):
+        def body(c, _):
+            o = op(x * (1 + c * 0).astype(x.dtype))
+            return o.ravel()[0].astype(jnp.float32), None
+        out, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
+        return out
+
+    out = scanned(operand)
+    _sync(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = scanned(operand)
+    _sync(out)
+    return (time.perf_counter() - start) / iters / reps
+
+
 def timed_chunked_prefill(label, fwd, cfg, params, table, full_tokens,
                           num_pages, flops, iters, chunk=CHUNK):
     """Time the engine-style chunked 4k prefill (2 chunks scanned inside
@@ -341,12 +364,13 @@ def main_decode():
     effective KV GB/s, and % of the ~819 GB/s v5e HBM roofline. KV bytes
     per step = b · ctx · kvh · hd · 2 streams · itemsize; the weights
     are not in this op, so the number isolates the attention stream."""
+    import sys
+
     rng = np.random.default_rng(0)
     kvh, hd, ps = 8, 128, 16  # kv_heads, head_dim, page size
     num_pages = 16 * 1024 + 1
     kc = jnp.asarray(rng.normal(size=(num_pages, kvh, ps, hd)), jnp.bfloat16)
     vc = jnp.asarray(rng.normal(size=(num_pages, kvh, ps, hd)), jnp.bfloat16)
-    attn_reps = 16
 
     def run(batch, ctx, rows, kpb):
         q = jnp.asarray(rng.normal(size=(batch, 16, hd)), jnp.bfloat16)
@@ -357,33 +381,25 @@ def main_decode():
                      batch, pages_per_seq).astype(np.int32))
         lens = jnp.full((batch,), ctx, jnp.int32)
         kv_bytes = batch * ctx * kvh * hd * 2 * 2
-
-        @jax.jit
-        def scanned(q_op, kc, vc):
-            def body(c, _):
-                o = pallas_paged_decode_attention(
-                    q_op * (1 + c * 0).astype(q_op.dtype), kc, vc, table,
-                    lens, pages_per_block=kpb, batch_rows=rows)
-                return o.ravel()[0].astype(jnp.float32), None
-            out, _ = jax.lax.scan(body, jnp.float32(0), None,
-                                  length=attn_reps)
-            return out
-
-        out = scanned(q, kc, vc)
-        _sync(out)
-        start = time.perf_counter()
-        iters = 4
-        for _ in range(iters):
-            out = scanned(q, kc, vc)
-        _sync(out)
-        dt = (time.perf_counter() - start) / iters / attn_reps
+        dt = timed_scanned(
+            lambda q_op: pallas_paged_decode_attention(
+                q_op, kc, vc, table, lens, pages_per_block=kpb,
+                batch_rows=rows),
+            q)
         gbs = kv_bytes / dt / 1e9
         print(f"decode b{batch:<3d} ctx{ctx:<5d} rows={rows:<2d} "
               f"kpb={'auto' if kpb is None else kpb:<4} "
               f"{dt * 1e3:8.3f} ms/step  {gbs:7.1f} GB/s eff "
               f"({gbs / 819 * 100:5.1f}% of v5e HBM)", flush=True)
 
+    # Optional shape filter ("b8x4096") so the TPU ladder can run each
+    # shape as its own resumable stage — ~20 fresh kernel compiles per
+    # shape at 20-40 s each on the tunnel; one monolithic stage would
+    # blow its time box and restart from zero every attempt (review r5).
+    only = next((a for a in sys.argv[1:] if a.startswith("b")), None)
     for batch, ctx in ((8, 4096), (8, 2048), (32, 2048), (32, 4096)):
+        if only and only != f"b{batch}x{ctx}":
+            continue
         for rows in (1, 2, 4, 8):
             if rows > batch:
                 continue
@@ -393,6 +409,103 @@ def main_decode():
                 except Exception as e:
                     print(f"decode b{batch} ctx{ctx} rows={rows} kpb={kpb}: "
                           f"{type(e).__name__}: {str(e)[:110]}", flush=True)
+
+
+def main_moe():
+    """MoE expert-dispatch probe (`--moe`, VERDICT r5 #5a): time the
+    capacity-dispatch einsum path at Qwen3-MoE-A3B-like and
+    Mixtral-like shapes against (a) a dense MLP doing the same ACTIVE
+    FLOPs (dispatch overhead bound) and (b) the all-expert weight-read
+    byte roofline (at low tokens/expert the expert matmuls are
+    bandwidth-bound on reading every expert's weights, not FLOPs)."""
+    from llmd_kv_cache_tpu.models.llama import _mlp
+
+    rng = np.random.default_rng(0)
+    shapes = {
+        # (hidden, inter_per_expert, experts, top_k)
+        "qwen3-moe-a3b": (2048, 768, 128, 8),
+        "mixtral-8x7b-ish": (4096, 14336, 8, 2),
+    }
+    tokens = 2048
+    for name, (h, inter, e, k) in shapes.items():
+        # capacity_factor pinned to 1.0: at the default 2.0 the expert
+        # einsums do 2x the active FLOPs, and the dense-baseline ratio
+        # would conflate that extra compute with dispatch cost
+        # (review r5). The default-capacity point is printed separately.
+        cfgs = {
+            1.0: LlamaConfig(
+                vocab_size=32000, hidden_size=h, num_layers=1,
+                num_heads=16, num_kv_heads=8, head_dim=128,
+                intermediate_size=inter, num_experts=e,
+                num_experts_per_token=k, moe_intermediate_size=inter,
+                moe_capacity_factor=1.0, page_size=16),
+            2.0: LlamaConfig(
+                vocab_size=32000, hidden_size=h, num_layers=1,
+                num_heads=16, num_kv_heads=8, head_dim=128,
+                intermediate_size=inter, num_experts=e,
+                num_experts_per_token=k, moe_intermediate_size=inter,
+                moe_capacity_factor=2.0, page_size=16),
+        }
+        params = init_params(jax.random.PRNGKey(0), cfgs[1.0])
+        layer = params["layers"][0]
+        x = jnp.asarray(rng.normal(size=(1, tokens, h)), jnp.bfloat16)
+        active_flops = 2 * tokens * k * 3 * h * inter
+        w_bytes = e * 3 * h * inter * 2  # every expert's weights, bf16
+
+        dts = {}
+        for cf, cfg in cfgs.items():
+            dts[cf] = timed_scanned(
+                lambda x_op, cfg=cfg: _mlp(x_op, layer, cfg), x, reps=8)
+        dt = dts[1.0]
+        print(f"moe {name:<18s} {tokens} tok cf=1: {dt * 1e3:8.2f} ms  "
+              f"{active_flops / dt / 1e12:6.1f} TFLOP/s active "
+              f"({active_flops / dt / 197e12 * 100:4.1f}% peak)  "
+              f"weight-read roofline {w_bytes / 819e9 * 1e3:.2f} ms "
+              f"({w_bytes / dt / 1e9:.0f} GB/s eff)", flush=True)
+        print(f"    cf=2 (engine default):         "
+              f"{dts[2.0] * 1e3:8.2f} ms", flush=True)
+
+        # Dense MLP at the same ACTIVE shape: k experts' worth of inter.
+        dcfg = LlamaConfig(
+            vocab_size=32000, hidden_size=h, num_layers=1, num_heads=16,
+            num_kv_heads=8, head_dim=128, intermediate_size=inter * k,
+            page_size=16)
+        dparams = init_params(jax.random.PRNGKey(0), dcfg)
+        dlayer = dparams["layers"][0]
+        ddt = timed_scanned(
+            lambda x_op: _mlp(x_op, dlayer, dcfg), x, reps=8)
+        print(f"    dense same-active-FLOPs MLP:   {ddt * 1e3:8.2f} ms  "
+              f"(dispatch overhead {dt / ddt:.2f}x at cf=1)", flush=True)
+
+
+def main_mla():
+    """MLA flash-decode probe (`--mla`, VERDICT r5 #5b): DeepSeek
+    latent-576 shapes (512 rank + 64 rope, latent_pad 64 → 640 kernel
+    width), single-stream (shared_kv: V DMA skipped) vs two-stream —
+    the measured check on the 'half the latent HBM traffic' claim."""
+    rng = np.random.default_rng(0)
+    width, ps = 640, 16  # padded latent width, page size
+    num_pages = 8 * 1024 + 1
+    latent = jnp.asarray(rng.normal(size=(num_pages, 1, ps, width)),
+                         jnp.bfloat16)
+    for batch, ctx in ((8, 4096), (32, 2048)):
+        q = jnp.asarray(rng.normal(size=(batch, 16, width)), jnp.bfloat16)
+        pps = ctx // ps
+        table = jnp.asarray(
+            1 + (np.arange(batch * pps, dtype=np.int64) * 2654435761
+                 % (num_pages - 1)).reshape(batch, pps).astype(np.int32))
+        lens = jnp.full((batch,), ctx, jnp.int32)
+        for shared in (True, False):
+            streams = 1 if shared else 2
+            kv_bytes = batch * ctx * width * streams * 2
+            dt = timed_scanned(
+                lambda q_op, sh=shared: pallas_paged_decode_attention(
+                    q_op, latent, latent, table, lens, shared_kv=sh),
+                q)
+            print(f"mla decode b{batch:<3d} ctx{ctx:<5d} "
+                  f"{'single-stream' if shared else 'two-stream   '} "
+                  f"{dt * 1e3:8.3f} ms/step  "
+                  f"{kv_bytes / dt / 1e9:7.1f} GB/s eff", flush=True)
 
 
 def main_big():
@@ -435,5 +548,9 @@ if __name__ == "__main__":
         main_big()
     elif "--decode" in sys.argv:
         main_decode()
+    elif "--moe" in sys.argv:
+        main_moe()
+    elif "--mla" in sys.argv:
+        main_mla()
     else:
         main()
