@@ -198,29 +198,31 @@ sys.argv = ['mfu_probe', '--big']
 runpy.run_path('hack/mfu_probe.py', run_name='__main__')
 " || continue
 
-  # One resumable sub-stage per shape: ~20 fresh kernel compiles each at
-  # 20-40 s on the tunnel; a monolithic 80-compile stage would blow any
-  # reasonable time box and restart from zero on every attempt.
-  for shape in b8x4096 b8x2048 b32x2048 b32x4096; do
-    stage "decode_bw_$shape" 1800 "
-import runpy, sys
-sys.argv = ['mfu_probe', '--decode', '$shape']
-runpy.run_path('hack/mfu_probe.py', run_name='__main__')
-" || break
-  done
-  grep -q "^PASS decode_bw_b32x4096" "$OUT" || continue
-
+  # Independent perf probes first (cheap, nothing downstream needs them
+  # — a persistent failure in one must not starve the others, review r5).
   stage moe_dispatch_probe 1200 "
 import runpy, sys
 sys.argv = ['mfu_probe', '--moe']
 runpy.run_path('hack/mfu_probe.py', run_name='__main__')
-" || continue
+"
 
   stage mla_decode_probe 1200 "
 import runpy, sys
 sys.argv = ['mfu_probe', '--mla']
 runpy.run_path('hack/mfu_probe.py', run_name='__main__')
-" || continue
+"
+
+  # One resumable sub-stage per shape: ~20 fresh kernel compiles each at
+  # 20-40 s on the tunnel; a monolithic 80-compile stage would blow any
+  # reasonable time box and restart from zero on every attempt. Failed
+  # shapes retry next attempt without blocking the stages below.
+  for shape in b8x4096 b8x2048 b32x2048 b32x4096; do
+    stage "decode_bw_$shape" 1800 "
+import runpy, sys
+sys.argv = ['mfu_probe', '--decode', '$shape']
+runpy.run_path('hack/mfu_probe.py', run_name='__main__')
+"
+  done
 
   stage decode_batch_sweep 1800 "
 import runpy

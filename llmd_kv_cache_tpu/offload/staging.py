@@ -1,0 +1,105 @@
+"""Recycled host staging buffers for the offload data plane.
+
+Counterpart of the reference's ``_StagedBackend`` mixin
+(``llmd_nixl/staged_backend.py:25-106``): that design keeps a pool of
+pre-registered pinned CPU buffers so the hot path never pays
+allocate+register per transfer, sizes the pool as
+``max(io_threads * 8, blocks / blocks_per_file + 1)``, extends it on
+shortfall instead of failing, and returns slots on completion or submit
+error. The TPU analog has no NIXL registration, but the same two costs
+exist: large-buffer allocation (page faults on first touch) and the
+allocator churn of a fresh multi-megabyte numpy array per load job.
+Load destinations therefore come from this pool and return to it once
+the H2D scatter has consumed them.
+
+Stores don't stage through the pool: the device gather already lands in
+a pinned-host jax buffer (``TPUBlockCopier._to_pinned_host``) that the
+native writer reads directly — copying it into a pool slot would add
+the copy the pool exists to avoid.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+logger = get_logger("offload.staging")
+
+
+class HostStagingPool:
+    """Fixed-size-slot buffer pool with extend-on-shortfall.
+
+    Slots are uint8 arrays of ``slot_bytes``; ``acquire(n)`` returns a
+    length-``n`` view of a free slot (n ≤ slot_bytes) and ``release``
+    returns the slot. Thread-safe: the I/O pool's completion threads
+    release concurrently with the engine thread acquiring.
+    """
+
+    def __init__(self, slot_bytes: int, slots: int):
+        self.slot_bytes = int(slot_bytes)
+        self._lock = threading.Lock()
+        self._free: list[np.ndarray] = [
+            np.empty(self.slot_bytes, np.uint8) for _ in range(slots)
+        ]
+        self._total = slots
+        # Views keyed by the base buffer id so release() can recover the
+        # full slot from the view handed out by acquire().
+        self._out: dict[int, np.ndarray] = {}
+
+    @property
+    def total_slots(self) -> int:
+        return self._total
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        """A length-``nbytes`` uint8 view of a free slot.
+
+        Oversize requests (a caller reading more pages per unit than the
+        pool was sized for) get a transient non-pool buffer — correct,
+        just unrecycled; ``release`` no-ops on it."""
+        if nbytes > self.slot_bytes:
+            logger.debug("staging request %d B > slot %d B; transient "
+                         "buffer", nbytes, self.slot_bytes)
+            return np.empty(nbytes, np.uint8)
+        with self._lock:
+            if not self._free:
+                # Extend instead of failing (reference
+                # ``_extend_staging_pool``): a burst beyond the sizing
+                # heuristic is a workload fact, not an error.
+                added = max(self._total, 1)
+                logger.info(
+                    "staging pool exhausted: extending by %d slots "
+                    "(%d -> %d)", added, self._total, self._total + added)
+                self._free.extend(
+                    np.empty(self.slot_bytes, np.uint8)
+                    for _ in range(added))
+                self._total += added
+            slot = self._free.pop()
+            view = slot[:nbytes]  # basic slice: view.base IS the slot
+            self._out[id(slot)] = slot
+            return view
+
+    def release(self, view: np.ndarray) -> None:
+        """Return the slot backing ``view`` (idempotent per acquire)."""
+        base = view.base if view.base is not None else view
+        with self._lock:
+            slot = self._out.pop(id(base), None)
+            if slot is not None:
+                self._free.append(slot)
+
+
+def pool_size_for(io_threads: int) -> int:
+    """Slots for every I/O thread to keep several reads in flight
+    (reference ``staged_backend.py:44-47``'s thread term). The
+    reference's second term — one slot per file the whole cache could
+    occupy — is dropped: there the pool doubled as the registered host
+    storage tier, here it is transit staging only and extends on
+    shortfall, so a cache-sized preallocation would be pure waste."""
+    return max(io_threads * 8, 16)

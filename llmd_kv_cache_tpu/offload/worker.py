@@ -223,6 +223,19 @@ class OffloadHandlers:
         self.pages_per_block = pages_per_block
         self.slot_bytes = copier.slab_nbytes(pages_per_block)
         self.file_bytes = self.slot_bytes * blocks_per_file
+        # Recycled host destinations for load jobs (reference
+        # _StagedBackend pool; see offload.staging). Slots are sized to
+        # the largest read unit ANY group's copier issues — a hybrid
+        # model's SWA pool can have more layers than group 0, and a slot
+        # sized for group 0 alone would push every group-1 load onto the
+        # transient-allocation path the pool exists to eliminate.
+        from .staging import HostStagingPool, pool_size_for
+
+        max_slot = max(
+            c.slab_nbytes(pages_per_block) * blocks_per_file
+            for c in self.copiers.values())
+        self.staging = HostStagingPool(
+            slot_bytes=max_slot, slots=pool_size_for(io_threads))
         read_pref = max(1, int(io_threads * read_preferring_ratio))
         if staging_bytes is None:
             # Size each worker's pinned staging to one single-page slab,
@@ -299,7 +312,7 @@ class OffloadHandlers:
         job = _PendingJob(job_id=job_id, is_store=False, started=time.perf_counter(),
                           nbytes=0, group_idx=group_idx)
         for block_hash, page_ids in transfers:
-            buf = np.empty(copier.slab_nbytes(len(page_ids)), np.uint8)
+            buf = self.staging.acquire(copier.slab_nbytes(len(page_ids)))
             self.io.submit_read(
                 job_id, self.mapper.block_path(block_hash, group_idx), buf
             )
@@ -376,7 +389,7 @@ class OffloadHandlers:
                           started=time.perf_counter(), nbytes=0,
                           group_idx=group_idx)
         for span in spans:
-            buf = np.empty(len(span.blocks) * slot_bytes, np.uint8)
+            buf = self.staging.acquire(len(span.blocks) * slot_bytes)
             self.io.submit_read(
                 job_id, self.mapper.block_path(span.file_key, group_idx),
                 buf, offset=span.head_offset * slot_bytes,
@@ -419,6 +432,11 @@ class OffloadHandlers:
                 logger.warning("load job %d failed (status %d)", job_id, status)
             elif not success:
                 logger.warning("store job %d failed (status %d)", job_id, status)
+            if not job.is_store:
+                # Scatter has consumed the staged bytes: recycle the
+                # slots (release no-ops on non-pool buffers).
+                for buf in job.buffers:
+                    self.staging.release(buf)
             results.append(
                 TransferResult(
                     job_id=job_id,
@@ -439,7 +457,10 @@ class OffloadHandlers:
             # drained: a timed-out job may still have an in-flight read
             # holding raw pointers into them.
             with self._lock:
-                self._pending.pop(job_id, None)
+                job = self._pending.pop(job_id, None)
+            if job is not None and not job.is_store:
+                for buf in job.buffers:
+                    self.staging.release(buf)
         else:
             logger.warning(
                 "job %d still in flight after cancel timeout; parking buffers",

@@ -1,0 +1,95 @@
+"""Host staging pool: the TPU analog of the reference's _StagedBackend
+pinned-buffer staging (reuse across jobs, extend-on-shortfall, release
+on completion/cancel). See llmd_kv_cache_tpu/offload/staging.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.offload.staging import HostStagingPool, pool_size_for
+
+
+class TestPool:
+    def test_acquire_release_recycles_backing_slot(self):
+        pool = HostStagingPool(slot_bytes=64, slots=1)
+        a = pool.acquire(32)
+        assert a.nbytes == 32 and pool.free_slots == 0
+        base_a = a.base
+        pool.release(a)
+        assert pool.free_slots == 1
+        b = pool.acquire(64)
+        assert b.base is base_a or b is base_a  # same slot reused
+
+    def test_extends_on_shortfall_instead_of_failing(self):
+        pool = HostStagingPool(slot_bytes=16, slots=2)
+        views = [pool.acquire(16) for _ in range(5)]
+        assert pool.total_slots >= 5
+        for v in views:
+            pool.release(v)
+        assert pool.free_slots == pool.total_slots
+
+    def test_release_is_idempotent_and_ignores_foreign_buffers(self):
+        pool = HostStagingPool(slot_bytes=16, slots=1)
+        v = pool.acquire(8)
+        pool.release(v)
+        pool.release(v)  # second release must not double-free
+        assert pool.free_slots == 1
+        pool.release(np.empty(8, np.uint8))  # store slabs pass through here
+        assert pool.free_slots == 1
+
+    def test_oversize_requests_get_transient_buffers(self):
+        pool = HostStagingPool(slot_bytes=16, slots=1)
+        big = pool.acquire(64)
+        assert big.nbytes == 64
+        assert pool.free_slots == 1  # pool untouched
+        pool.release(big)  # no-op
+        assert pool.free_slots == 1
+
+    def test_sizing_heuristic(self):
+        # Thread-depth term only: the pool is transit staging, not a
+        # host storage tier, so it must NOT scale with the cache size.
+        assert pool_size_for(4) == 32
+        assert pool_size_for(1) == 16
+        assert pool_size_for(64) == 512
+
+
+class TestWorkerStagingReuse:
+    def test_load_jobs_reuse_slots_across_jobs(self, tmp_path):
+        """Two sequential load jobs must draw from the same recycled
+        slots (the pool's whole point); slots return on completion."""
+        import time
+
+        from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+
+        rng = np.random.default_rng(0)
+        shape = (2, 8, 2, 4, 8)
+        k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        k_np = np.asarray(k)  # snapshot: load scatters donate the cache
+        spec = SharedStorageOffloadSpec(
+            root=str(tmp_path), model_name="m", page_size=4, num_layers=2,
+            kv_heads=2, head_dim=8, dtype="float32", io_threads=2)
+        h = spec.get_handlers(k, v)
+
+        def wait(job):
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                for res in h.get_finished():
+                    if res.job_id == job:
+                        return res
+                time.sleep(0.005)
+            raise TimeoutError
+
+        assert wait(h.async_store_blocks([(0xA, [1]), (0xB, [2])])).success
+        free0 = h.staging.free_slots
+        total0 = h.staging.total_slots
+        r1 = wait(h.async_load_blocks([(0xA, [5])]))
+        r2 = wait(h.async_load_blocks([(0xB, [6])]))
+        assert r1.success and r2.success
+        # All slots back; no pool growth for sequential loads.
+        assert h.staging.free_slots == free0
+        assert h.staging.total_slots == total0
+        np.testing.assert_array_equal(
+            np.asarray(h.copier.k_cache)[:, 5], k_np[:, 1])
+        np.testing.assert_array_equal(
+            np.asarray(h.copier.k_cache)[:, 6], k_np[:, 2])
