@@ -29,10 +29,16 @@ def save_engine_checkpoint(path: str, params: Params, model_cfg: LlamaConfig,
                            model_name: str, hash_seed: str = "") -> None:
     """Save params + engine identity to ``path`` (a directory).
 
-    Checkpoints always store the canonical (unfused) projection layout —
-    portable across fused serving engines, TP sharding, and the trainer;
-    a fused tree (models.llama.fuse_params) is split back on save."""
+    Checkpoints always store the canonical (unfused, per-layer-list)
+    layout — portable across fused serving engines, pp-stacked engines,
+    TP sharding, and the trainer; fused trees (models.llama.fuse_params)
+    and pp-stacked trees (parallel.pipeline.stack_layer_params) convert
+    back on save."""
     path = os.path.abspath(path)
+    if "layers_stacked" in params:
+        from ..parallel.pipeline import unstack_layer_params
+
+        params = unstack_layer_params(params)
     params = unfuse_params(params, model_cfg)
     with ocp.StandardCheckpointer() as ckptr:
         # force=True: periodic re-checkpointing to a fixed path overwrites.

@@ -422,6 +422,7 @@ class MiniEngine:
         self.mesh = mesh
         self._tp = 1
         self._sp = 1
+        self._pp = 1
         if mesh is not None:
             from ..parallel.serve import mesh_tp_size, validate_tp_config
 
@@ -440,6 +441,35 @@ class MiniEngine:
             # the compiled HLO (tests/test_sp_serve.py). Decode (seq=1)
             # is unaffected.
             self._sp = mesh.shape.get("sp", 1)
+            # Pipeline-parallel serving: layer blocks + the layer axis of
+            # the paged caches shard over ``pp``; prefill chunks and
+            # decode batches stream through the stages as microbatches
+            # (parallel.pp_serve). v1 scope: dense models, XLA attention,
+            # no tp on the same mesh, single-token decode.
+            self._pp = mesh.shape.get("pp", 1)
+            if self._pp > 1:
+                from ..parallel.pp_serve import validate_pp_serve_config
+
+                if self._tp > 1 or self._sp > 1:
+                    raise NotImplementedError(
+                        "pp serving does not yet compose with tp/sp on "
+                        "one mesh (training pp+tp exists in "
+                        "parallel.pipeline)")
+                if self.cfg.max_batch % self._pp == 0:
+                    self._pp_decode_mb = self._pp
+                else:
+                    # Surface the idle stages instead of silently running
+                    # the unpipelined M=1 schedule (same policy as the sp
+                    # divisibility warning below).
+                    logger.warning(
+                        "max_batch=%d does not divide by pp=%d: decode "
+                        "runs unpipelined (one microbatch; %d of %d "
+                        "stages idle each tick) — size max_batch to a "
+                        "pp multiple", self.cfg.max_batch, self._pp,
+                        self._pp - 1, self._pp)
+                    self._pp_decode_mb = 1
+                validate_pp_serve_config(mcfg, mesh, self._pp_decode_mb,
+                                         self.cfg.max_batch)
             if self._sp > 1 and mcfg.page_size % self._sp != 0:
                 # Chunk buckets are 2^k × page_size; a chunk shards only
                 # when sp divides its bucket. sp ∤ page_size means short
@@ -499,7 +529,14 @@ class MiniEngine:
 
             self.params = fuse_params(self.params, mcfg)
 
-        if mesh is not None:
+        if mesh is not None and self._pp > 1:
+            from ..parallel.pp_serve import shard_pp_state
+
+            # self.params becomes the STACKED layer tree (layer axis over
+            # pp); checkpoint save unstacks back to the canonical layout.
+            self.params, self.k_cache, self.v_cache = shard_pp_state(
+                mesh, mcfg, self.params, self.k_cache, self.v_cache)
+        elif mesh is not None:
             from ..parallel.serve import shard_engine_params, shard_kv_pool
 
             self.params = shard_engine_params(mesh, self.params)
@@ -515,6 +552,11 @@ class MiniEngine:
         on_tpu = jax.devices()[0].platform == "tpu"
         if use_pallas is None:
             use_pallas = on_tpu
+        if self._pp > 1:
+            if self.cfg.use_pallas_decode:
+                logger.warning("pp serving v1 runs the XLA attention "
+                               "backend; use_pallas_decode ignored")
+            use_pallas = False
         # The kernels' per-page DMA width is the cache payload width:
         # head_dim for standard/GQA attention, the latent width
         # (rank + rope + latent_pad) for absorbed MLA — which runs as the
@@ -612,6 +654,37 @@ class MiniEngine:
             batch_rows=(rows if hybrid_burst_pallas and hybrid_mesh is None
                         else 1),
         )
+        if self._pp > 1:
+            from ..parallel.pp_serve import make_pp_serve_forward
+
+            # Prefill runs per request (batch 1 → the sequential M=1
+            # schedule); decode pads to max_batch and streams pp
+            # microbatches through the stages.
+            pp_prefill_fn = make_pp_serve_forward(mesh, mcfg, self.params,
+                                                  microbatches=1)
+            pp_decode_fn = (pp_prefill_fn if self._pp_decode_mb == 1
+                            else make_pp_serve_forward(
+                                mesh, mcfg, self.params,
+                                microbatches=self._pp_decode_mb))
+
+            def pp_prefill(params, _cfg, tokens, k, v, table, ctx, new,
+                           last_only=True):
+                logits, k, v = pp_prefill_fn(params, k, v, tokens, table,
+                                             ctx, new)
+                return logits[:, None, :], k, v
+
+            def pp_decode(params, _cfg, tokens, k, v, tables, ctx, new):
+                logits, k, v = pp_decode_fn(params, k, v, tokens, tables,
+                                            ctx, new)
+                return logits[:, None, :], k, v
+
+            self._prefill_forward = pp_prefill
+            self._decode_forward = pp_decode
+            if self.cfg.decode_burst > 1:
+                logger.warning("pp serving v1 decodes single-token; "
+                               "decode_burst=%d clamped to 1",
+                               self.cfg.decode_burst)
+
         # Burst size: the power-of-two floor of cfg.decode_burst, fixed for
         # the engine's lifetime — ONE fused-decode program. Per-row budgets
         # freeze finished rows on-device, so ticks past every row's budget
@@ -620,7 +693,7 @@ class MiniEngine:
         # smaller bucket mid-serving — measured 2 s per compile on the v5e
         # tunnel, cratering steady-state decode on short generations.
         self._burst = 1
-        while self._burst * 2 <= self.cfg.decode_burst:
+        while self._burst * 2 <= self.cfg.decode_burst and self._pp == 1:
             self._burst *= 2
         # Latched when the SWA pool proves too small for burst transients:
         # the engine then decodes single-token for its lifetime (warned
