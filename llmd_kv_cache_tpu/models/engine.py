@@ -713,14 +713,10 @@ class MiniEngine:
         self._restore_results: dict[int, Any] = {}
         self._offload_medium = ""
         if offload_spec is not None:
-            if self._pp > 1:
-                # The block copier's gather/scatter programs address one
-                # device's cache; a layer-axis-sharded pool needs
-                # per-stage copiers keyed by layer ownership — not built
-                # yet. Refuse loudly instead of failing mid-serving.
-                raise NotImplementedError(
-                    "storage offload under pp serving is not implemented "
-                    "(the cache's layer axis is sharded across stages)")
+            # Works under pp too: the copier's gather/scatter programs
+            # run SPMD over the layer-sharded pools (GSPMD inserts the
+            # collectives; scatter preserves the pp sharding) — pinned by
+            # tests/test_pp_serve.py's offload round-trip.
             if getattr(offload_spec, "attention_sinks", 0) != (
                     mcfg.attention_sinks):
                 # The sink mask changes deeper layers' KV past the window;

@@ -151,3 +151,56 @@ def test_pp_qwen_biases_match(prompts):
     ref = serve(build(None), prompts)
     got = serve(build(make_mesh(2)), prompts)
     assert got == ref
+
+
+def test_pp_offload_store_restore_cycle(tmp_path, prompts):
+    """Storage offload under pp serving: write-through from a pp engine,
+    then a FRESH pp engine restores the prefix from the shared store and
+    resumes with the same tokens (the copier's gather/scatter run SPMD
+    over the layer-sharded pools)."""
+    from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+
+    cfg = cfg4()
+
+    def spec():
+        return SharedStorageOffloadSpec(
+            root=str(tmp_path), model_name="t", page_size=cfg.page_size,
+            num_layers=cfg.num_layers, kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim, io_threads=2, parallel_agnostic=True)
+
+    def build(pod):
+        return MiniEngine(
+            EngineConfig(model=cfg, num_pages=128, max_pages_per_seq=16,
+                         max_batch=4, model_name="t", pod_identifier=pod),
+            seed=0, mesh=make_mesh(2), offload_spec=spec())
+
+    prompt = list(prompts["r0"])[:16]  # full blocks only
+    a = build("pod-a")
+    out_a = a.generate("r1", prompt, max_new_tokens=4)
+    a.flush_offload()
+
+    b = build("pod-b")
+    req = b.add_request("r2", prompt, max_new_tokens=4)
+    assert req.cached_len == len(prompt)  # restored, not recomputed
+    while not req.done:
+        b.step()
+    assert req.output == out_a
+    # The restore's donated scatter must PRESERVE the pp layer split —
+    # a silently replicated cache would still produce matching tokens
+    # while doubling per-device memory (review r5).
+    assert b.k_cache.sharding.shard_shape(b.k_cache.shape)[0] == \
+        cfg.num_layers // 2
+
+    # Deferred restore (the mid-serving interleaving the old guard
+    # feared): enqueue() defers the storage lookup into step(), where the
+    # async scatter lands between decode steps of a RUNNING request.
+    c = build("pod-c")
+    filler = c.enqueue("warm", list(prompts["r1"])[:16], max_new_tokens=8)
+    c.step()  # filler decoding when the restore job starts
+    req2 = c.enqueue("r3", prompt, max_new_tokens=4)
+    while not (req2.done and filler.done):
+        c.step()
+    assert req2.cached_len == len(prompt)
+    assert req2.output == out_a
+    assert c.k_cache.sharding.shard_shape(c.k_cache.shape)[0] == \
+        cfg.num_layers // 2
