@@ -450,11 +450,10 @@ class MiniEngine:
             if self._pp > 1:
                 from ..parallel.pp_serve import validate_pp_serve_config
 
-                if self._tp > 1 or self._sp > 1:
+                if self._sp > 1:
                     raise NotImplementedError(
-                        "pp serving does not yet compose with tp/sp on "
-                        "one mesh (training pp+tp exists in "
-                        "parallel.pipeline)")
+                        "pp serving does not yet compose with sp on one "
+                        "mesh (tp composes: Megatron within each stage)")
                 if self.cfg.max_batch % self._pp == 0:
                     self._pp_decode_mb = self._pp
                 else:

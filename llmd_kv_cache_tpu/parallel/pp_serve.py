@@ -24,10 +24,11 @@ TPU-first design notes:
 - Collectives are explicit (``ppermute`` for the activation hop, one
   final ``psum`` to replicate the departing logits) because inside
   ``shard_map`` XLA does not derive collectives from shardings.
-- Scope (v1): dense non-hybrid models, XLA attention backend, pp as the
-  only model-parallel axis (compose dp outside; tp composition uses the
-  Megatron layer from ``parallel.pipeline`` and is left to a later
-  round). Decode is single-token per call.
+- Scope: dense uniform-attention models (incl. uniform SWA + sinks and
+  Qwen-bias families), XLA attention backend, single-token decode.
+  Composes with ``tp`` on the same mesh (Megatron column/row shards +
+  kv-head-sharded cache slabs within each stage, explicit psums) and
+  with ``dp`` outside; ``sp`` is not composed yet.
 """
 
 from __future__ import annotations
@@ -81,44 +82,67 @@ def validate_pp_serve_config(cfg: LlamaConfig, mesh: Mesh,
             f"({microbatches}) — every tick moves one microbatch")
 
 
-def pp_param_pspecs(stacked: dict) -> dict:
+# Megatron placement within each stage when a ``tp`` axis is present:
+# column-parallel in-projections (their biases follow the columns),
+# row-parallel out-projections (one psum each in _pp_layer).
+_TP_COL = {"wq", "wk", "wv", "w_gate", "w_up", "bq", "bk", "bv"}
+_TP_ROW = {"wo", "w_down"}
+
+
+def pp_param_pspecs(stacked: dict, tp: bool = False) -> dict:
     """Stacked-tree specs DERIVED from the tree itself: every stacked
     layer leaf shards its leading (layer) axis over ``pp``, whatever the
     key — qk norms, Qwen2 QKV biases, future additions — so the spec
-    tree can never drift from the parameter tree (review r5). Embed and
-    head replicate: stage 0 embeds, the last stage projects, which keeps
-    the schedule collective-free at the ends for one matrix copy each."""
+    tree can never drift from the parameter tree (review r5). With
+    ``tp``, the known Megatron keys additionally shard within the stage.
+    Embed and head replicate: stage 0 embeds, the last stage projects,
+    which keeps the schedule collective-free at the ends for one matrix
+    copy each."""
+    def leaf_spec(path, a):
+        key = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        rest = [None] * (a.ndim - 1)
+        if tp and key in _TP_COL:
+            rest[-1] = "tp"  # biases are 1-D: their only axis follows
+        elif tp and key in _TP_ROW:
+            rest[0] = "tp"
+        return P("pp", *rest)
+
     return {
         "embed": P(),
-        "layers_stacked": jax.tree.map(
-            lambda a: P("pp", *([None] * (a.ndim - 1))),
-            stacked["layers_stacked"]),
+        "layers_stacked": jax.tree_util.tree_map_with_path(
+            leaf_spec, stacked["layers_stacked"]),
         "final_norm": P(),
         "lm_head": P(),
     }
 
 
-KV_PP_AXES = P("pp", None, None, None, None)  # [layers, pages, kvh, ps, hd]
+def kv_pp_axes(tp: bool = False) -> P:
+    """[layers, pages, kvh, ps, hd]: layer axis over pp, kv heads over
+    tp when present (each tp shard owns whole kv heads, like
+    parallel.serve.shard_kv_pool)."""
+    return P("pp", None, "tp" if tp else None, None, None)
 
 
 def shard_pp_state(mesh: Mesh, cfg: LlamaConfig, params: Params,
                    k_cache: jax.Array, v_cache: jax.Array):
     """(stacked_params, k, v) placed for pp serving: stacked layer trees
-    with the layer axis over ``pp``; cache slabs likewise."""
+    with the layer axis over ``pp``; cache slabs likewise (+ the kv-head
+    axis over ``tp`` when the mesh has one)."""
+    tp = mesh.shape.get("tp", 1) > 1
     stacked = stack_layer_params(params)
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        pp_param_pspecs(stacked),
+        pp_param_pspecs(stacked, tp),
         is_leaf=lambda x: isinstance(x, P),
     )
     stacked = jax.device_put(stacked, shardings)
-    kv_sharding = NamedSharding(mesh, KV_PP_AXES)
+    kv_sharding = NamedSharding(mesh, kv_pp_axes(tp))
     return (stacked, jax.device_put(k_cache, kv_sharding),
             jax.device_put(v_cache, kv_sharding))
 
 
 def _pp_layer(x, layer, cfg, k_layer, v_layer, table, positions,
-              total_lens, valid, window):
+              total_lens, valid, window, tp_axis=None):
     """One dense layer with paged attention over this stage's cache slab.
 
     Scatters the microbatch's K/V into the LOCAL layer cache (functional
@@ -127,6 +151,14 @@ def _pp_layer(x, layer, cfg, k_layer, v_layer, table, positions,
     Mirrors the per-layer body of ``models.llama._forward_impl_grouped``
     for the dense path: qk-norm, GQA, QKV biases, uniform SWA windows,
     and StreamingLLM sinks.
+
+    ``tp_axis``: Megatron within the stage — the projections are local
+    column/row shards (head counts derive from the LOCAL weight shapes,
+    the GQA group ratio is shard-invariant), attention runs on local
+    heads over the kv-head-sharded cache slab, and the row-parallel
+    wo/w_down partial sums are fixed by one ``psum`` each (the explicit
+    form ``parallel.pipeline._tp_layer_step`` uses for training —
+    inside shard_map XLA does not derive collectives).
     """
     batch, seq = x.shape[0], x.shape[1]
     attn_in = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -137,9 +169,9 @@ def _pp_layer(x, layer, cfg, k_layer, v_layer, table, positions,
         q = q + layer["bq"]
         k = k + layer["bk"]
         v = v + layer["bv"]
-    q = q.reshape(batch, seq, cfg.num_heads, cfg.head_dim)
-    k = k.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
-    v = v.reshape(batch, seq, cfg.num_kv_heads, cfg.head_dim)
+    q = q.reshape(batch, seq, -1, cfg.head_dim)
+    k = k.reshape(batch, seq, -1, cfg.head_dim)
+    v = v.reshape(batch, seq, -1, cfg.head_dim)
     if cfg.qk_norm:
         q = _rms_norm(q, layer["q_norm"], cfg.norm_eps)
         k = _rms_norm(k, layer["k_norm"], cfg.norm_eps)
@@ -150,10 +182,17 @@ def _pp_layer(x, layer, cfg, k_layer, v_layer, table, positions,
     attn = paged_attention(q, k_layer, v_layer, table, positions,
                            total_lens, sliding_window=window,
                            attention_sinks=cfg.attention_sinks or None)
-    x = x + attn.reshape(batch, seq, -1) @ layer["wo"]
+    attn_out = attn.reshape(batch, seq, -1) @ layer["wo"]
+    if tp_axis is not None:
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    x = x + attn_out
     mlp_in = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    x = x + _mlp(mlp_in, layer, cfg)
-    return x, k_layer, v_layer
+    # _mlp's dense branch on the local column shards; the row-parallel
+    # w_down product is a partial sum under tp, fixed by one psum.
+    down = _mlp(mlp_in, layer, cfg)
+    if tp_axis is not None:
+        down = jax.lax.psum(down, tp_axis)
+    return x + down, k_layer, v_layer
 
 
 def make_pp_serve_forward(mesh: Mesh, cfg: LlamaConfig,
@@ -175,7 +214,10 @@ def make_pp_serve_forward(mesh: Mesh, cfg: LlamaConfig,
     local_layers = cfg.num_layers // P_size
     perm = [(i, i + 1) for i in range(P_size - 1)]
     window = _uniform_window(cfg)
-    param_specs = pp_param_pspecs(stacked_params)
+    tp = mesh.shape.get("tp", 1) > 1
+    tp_axis = "tp" if tp else None
+    param_specs = pp_param_pspecs(stacked_params, tp)
+    kv_axes = kv_pp_axes(tp)
 
     def staged(sp, k_all, v_all, tokens, table, ctx_lens, new_lens):
         # Everything except the cache slabs and layer stack is replicated.
@@ -219,7 +261,7 @@ def make_pp_serve_forward(mesh: Mesh, cfg: LlamaConfig,
                 layer = jax.tree.map(lambda a: a[j], layers)
                 x, k_j, v_j = _pp_layer(
                     x, layer, cfg, k_all[j], v_all[j], tab, pos, tot, val,
-                    window)
+                    window, tp_axis=tp_axis)
                 k_all = k_all.at[j].set(k_j)
                 v_all = v_all.at[j].set(v_j)
             x_buf = x
@@ -244,9 +286,9 @@ def make_pp_serve_forward(mesh: Mesh, cfg: LlamaConfig,
     mapped = shard_map(
         staged,
         mesh=mesh,
-        in_specs=(param_specs, KV_PP_AXES, KV_PP_AXES,
+        in_specs=(param_specs, kv_axes, kv_axes,
                   P(), P(), P(), P()),
-        out_specs=(P(), KV_PP_AXES, KV_PP_AXES),
+        out_specs=(P(), kv_axes, kv_axes),
         check_vma=False,
     )
 

@@ -204,3 +204,37 @@ def test_pp_offload_store_restore_cycle(tmp_path, prompts):
     assert req2.output == out_a
     assert c.k_cache.sharding.shard_shape(c.k_cache.shape)[0] == \
         cfg.num_layers // 2
+
+    # pp x tp: the restore scatter must preserve BOTH the layer split
+    # and the kv-head split (parallel_agnostic store, so the pp-only
+    # pods' files restore into the composed layout).
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    d = MiniEngine(
+        EngineConfig(model=cfg, num_pages=128, max_pages_per_seq=16,
+                     max_batch=4, model_name="t", pod_identifier="pod-d"),
+        seed=0, mesh=Mesh(devs, ("pp", "tp")), offload_spec=spec())
+    req3 = d.add_request("r4", prompt, max_new_tokens=4)
+    assert req3.cached_len == len(prompt)
+    while not req3.done:
+        d.step()
+    assert req3.output == out_a
+    shard = d.k_cache.sharding.shard_shape(d.k_cache.shape)
+    assert shard[0] == cfg.num_layers // 2
+    assert shard[2] == cfg.num_kv_heads // 2
+
+
+def test_pp_tp_composed_serving_matches(prompts, single_tokens):
+    """pp x tp on one mesh: layer blocks over pp, Megatron column/row
+    shards + kv-head-sharded cache slabs within each stage (explicit
+    psums inside shard_map). Tokens must match single-device."""
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("pp", "tp"))
+    eng = MiniEngine(EngineConfig(
+        model=cfg4(), num_pages=128, max_pages_per_seq=16, max_batch=4,
+        model_name="t", pod_identifier="p"), seed=0, mesh=mesh)
+    k = eng.k_cache
+    shard = k.sharding.shard_shape(k.shape)
+    assert shard[0] == cfg4().num_layers // 2  # layer axis over pp
+    assert shard[2] == cfg4().num_kv_heads // 2  # kv heads over tp
+    got = serve(eng, prompts)
+    assert got == single_tokens
