@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Summarize the decode-bandwidth sweep lines from the TPU ladder log.
+
+Parses ``decode b<batch> ctx<ctx> rows=<r> kpb=<k> ... ms/step ... GB/s``
+lines out of benchmarking/r5-tpu/tpu_validation.log (or a given file) and
+prints, per (batch, ctx) shape: the rows=1/kpb=auto baseline, the best
+point, and the speedup — the evidence behind EngineConfig.decode_batch_rows'
+default (VERDICT r4 #1).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import defaultdict
+
+PAT = re.compile(
+    r"decode b(\d+)\s+ctx(\d+)\s+rows=(\d+)\s+kpb=(auto|\d+)\s+"
+    r"([\d.]+) ms/step\s+([\d.]+) GB/s")
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else (
+        "benchmarking/r5-tpu/tpu_validation.log")
+    shapes: dict[tuple[int, int], list] = defaultdict(list)
+    for line in open(path):
+        m = PAT.search(line)
+        if m:
+            b, ctx, rows, kpb, ms, gbs = m.groups()
+            shapes[(int(b), int(ctx))].append(
+                (int(rows), kpb, float(ms), float(gbs)))
+    if not shapes:
+        print(f"no decode sweep lines in {path}")
+        return
+    for (b, ctx), pts in sorted(shapes.items()):
+        base = next((p for p in pts if p[0] == 1 and p[1] == "auto"), pts[0])
+        best = min(pts, key=lambda p: p[2])
+        print(f"b{b} ctx{ctx}: baseline rows=1/auto {base[2]:.3f} ms "
+              f"({base[3]:.0f} GB/s) -> best rows={best[0]} kpb={best[1]} "
+              f"{best[2]:.3f} ms ({best[3]:.0f} GB/s), "
+              f"{base[2] / best[2]:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

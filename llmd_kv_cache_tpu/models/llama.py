@@ -94,6 +94,12 @@ class LlamaConfig:
     # attention math is invariant to it up to fp rounding of the scale
     # factor (zero key dims score zero, value reads slice [:rank]).
     latent_pad: int = 0
+    # How MLA flash-decode feeds the shared latent to its two matmuls:
+    # "copy" (default) DMAs each page once and mirrors it VMEM->VMEM so
+    # score and output matmuls get independent buffers; "reuse" aliases
+    # them (half the VMEM, but measured 2x slower at b8/ctx4k on v5e —
+    # benchmarking/r5-tpu --mla probe). Pallas decode path only.
+    mla_decode_stream: str = "copy"
     # RoPE scaling: () = plain RoPE; ("llama3", factor, low_freq_factor,
     # high_freq_factor, original_max_position_embeddings) — Llama-3.1's
     # frequency-band NTK scheme; or ("yarn", factor, beta_fast, beta_slow,
@@ -170,6 +176,12 @@ class LlamaConfig:
         if self.softmax_scale_mult != 1.0 and not self.is_mla:
             raise ValueError(
                 "softmax_scale_mult is a DeepSeek-yarn (MLA) knob")
+        if self.mla_decode_stream not in ("copy", "reuse"):
+            raise ValueError(
+                "mla_decode_stream must be 'copy' or 'reuse', got "
+                f"{self.mla_decode_stream!r}")
+        if self.mla_decode_stream != "copy" and not self.is_mla:
+            raise ValueError("mla_decode_stream is an MLA knob")
         if self.latent_pad:
             if not self.is_mla:
                 raise ValueError("latent_pad only applies to MLA configs")
@@ -1086,12 +1098,14 @@ def forward_decode_pallas(
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, total_lens,
                 sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
+                shared_stream=cfg.mla_decode_stream,
                 layer_idx=layer_idx, interpret=interpret,
             )
         else:
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, total_lens,
                 sliding_window=window, sinks=sinks, shared_kv=cfg.is_mla,
+                shared_stream=cfg.mla_decode_stream,
                 layer_idx=layer_idx, batch_rows=batch_rows,
                 interpret=interpret,
             )
@@ -1106,6 +1120,7 @@ def forward_decode_pallas(
 def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
                            sinks: int | None = None,
                            shared_kv: bool = False,
+                           shared_stream: str = "copy",
                            batch_rows: int = 1):
     """Attention closure for fused decode bodies — one implementation for
     the single-pool and hybrid two-pool scans (the grouped forward hands
@@ -1133,6 +1148,7 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
             out = sharded_paged_decode_attention(
                 mesh, q[:, 0], k_l, v_l, table, base_lens,
                 sliding_window=window, sinks=sinks, shared_kv=shared_kv,
+                shared_stream=shared_stream,
                 tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
                 layer_idx=layer_idx, interpret=interpret,
             )
@@ -1141,6 +1157,7 @@ def _decode_step_attention(use_pallas: bool, interpret: bool, mesh,
             out = pallas_paged_decode_attention(
                 q[:, 0], k_l, v_l, table, base_lens,
                 sliding_window=window, sinks=sinks, shared_kv=shared_kv,
+                shared_stream=shared_stream,
                 tail_k=tail_k, tail_v=tail_v, tail_lens=tail_lens,
                 layer_idx=layer_idx, batch_rows=batch_rows,
                 interpret=interpret,
@@ -1213,6 +1230,7 @@ def forward_decode_steps(
         _decode_step_attention(use_pallas, interpret, mesh,
                                sinks=cfg.attention_sinks or None,
                                shared_kv=cfg.is_mla,
+                               shared_stream=cfg.mla_decode_stream,
                                batch_rows=batch_rows),
     )
     return toks, ks[0], vs[0]
@@ -1318,6 +1336,7 @@ def forward_decode_steps_hybrid(
         _decode_step_attention(use_pallas, interpret, mesh,
                                sinks=cfg.attention_sinks or None,
                                shared_kv=cfg.is_mla,
+                               shared_stream=cfg.mla_decode_stream,
                                batch_rows=batch_rows),
     )
     return toks, ks[0], vs[0], ks[1], vs[1]

@@ -255,6 +255,7 @@ def _decode_kernel(
     sinks: int,
     pages_per_block: int,
     shared_kv: bool,
+    shared_copy: bool,
     has_tail: bool,
     layer_idx: int | None,
 ):
@@ -313,8 +314,22 @@ def _decode_kernel(
             c.wait()
 
         k = k_scratch[slot].reshape(kpb * page_size, head_dim)
-        v = k if shared_kv else v_scratch[slot].reshape(
-            kpb * page_size, head_dim)
+        if shared_kv and shared_copy:
+            # Absorbed MLA measured 2x SLOWER with v aliased to k at
+            # b8/ctx4k (benchmarking/r5-tpu, --mla probe): one buffer
+            # feeding both matmuls — head_dim-contraction for scores,
+            # key-contraction for the output — forces Mosaic into
+            # per-round relayouts. A local VMEM->VMEM copy gives each
+            # matmul its own buffer while HBM still sees ONE latent
+            # read (the point of caching only the latent).
+            cp = pltpu.make_async_copy(
+                k_scratch.at[slot], v_scratch.at[slot], sem.at[slot, 0, 1])
+            cp.start()
+            cp.wait()
+            v = v_scratch[slot].reshape(kpb * page_size, head_dim)
+        else:
+            v = k if shared_kv else v_scratch[slot].reshape(
+                kpb * page_size, head_dim)
 
         scores = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -381,6 +396,7 @@ def _decode_kernel_merged(
     sinks: int,
     pages_per_block: int,
     shared_kv: bool,
+    shared_copy: bool,
     has_tail: bool,
     layer_idx: int | None,
 ):
@@ -469,6 +485,19 @@ def _decode_kernel_merged(
             def _(r=r):
                 for c in streamers[r][1](slot, sb):
                     c.wait()
+                if shared_copy:
+                    # Same rationale as _decode_kernel: mirror the row's
+                    # K superblock into the V scratch locally so each
+                    # matmul gets its own buffer (one HBM read).
+                    cp = pltpu.make_async_copy(
+                        k_scratch.at[slot] if rows == 1
+                        else k_scratch.at[slot, r],
+                        v_scratch.at[slot] if rows == 1
+                        else v_scratch.at[slot, r],
+                        sem.at[slot, 0, 1] if rows == 1
+                        else sem.at[slot, r, 0, 1])
+                    cp.start()
+                    cp.wait()
 
             # Shared mask for every head: positions depend only on the
             # row's pages — the per-head grid recomputed this kv_heads×.
@@ -486,7 +515,7 @@ def _decode_kernel_merged(
                 ks = k_scratch[slot, :, h] if rows == 1 else \
                     k_scratch[slot, r, :, h]
                 k = ks.reshape(kpb * page_size, head_dim)
-                if shared_kv:
+                if shared_kv and not shared_copy:
                     v = k
                 else:
                     vs = v_scratch[slot, :, h] if rows == 1 else \
@@ -792,8 +821,8 @@ def pallas_paged_prefill_attention(
 @functools.partial(jax.jit,
                    static_argnames=("interpret", "sliding_window", "sinks",
                                     "pages_per_block", "shared_kv",
-                                    "merge_heads", "layer_idx",
-                                    "batch_rows"))
+                                    "shared_stream", "merge_heads",
+                                    "layer_idx", "batch_rows"))
 def pallas_paged_decode_attention(
     q: jax.Array,  # [batch, q_heads, head_dim]
     k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
@@ -805,6 +834,7 @@ def pallas_paged_decode_attention(
     sinks: int | None = None,
     pages_per_block: int | None = None,
     shared_kv: bool = False,
+    shared_stream: str = "copy",
     merge_heads: bool | None = None,
     tail_k: jax.Array | None = None,  # [batch, T, kv_heads, head_dim]
     tail_v: jax.Array | None = None,
@@ -821,6 +851,14 @@ def pallas_paged_decode_attention(
     the sliding window; their pages are streamed in addition to the
     window's. MLA's absorbed multi-query form is the ``kv_heads == 1``
     case: one shared latent 'head' serves every query head as one group.
+
+    ``shared_stream`` picks how the ``shared_kv`` latent feeds the two
+    matmuls: ``"copy"`` (default) DMAs each page from HBM once and
+    locally mirrors it into the V scratch — HBM traffic stays halved
+    but each matmul gets its own buffer; ``"reuse"`` aliases V to the K
+    scratch (no copy, but the one buffer serves a head_dim-contraction
+    and a key-contraction, which measured 2x slower at b8/ctx4k on a
+    real v5e — see benchmarking/r5-tpu). Ignored without ``shared_kv``.
 
     ``merge_heads`` (default: on when ``kv_heads > 1``) runs every kv
     head of a batch item in one program — whole-page DMAs carry all
@@ -847,6 +885,9 @@ def pallas_paged_decode_attention(
     _check_head_dim_alignment(head_dim, interpret)
     if merge_heads is None:
         merge_heads = kv_heads > 1
+    if shared_stream not in ("copy", "reuse"):
+        raise ValueError(
+            f"shared_stream must be 'copy' or 'reuse', got {shared_stream!r}")
     if batch_rows > 1 and not merge_heads:
         raise ValueError("batch_rows > 1 requires the merged-heads kernel")
     batch_rows = max(1, min(batch_rows, batch))
@@ -916,12 +957,15 @@ def pallas_paged_decode_attention(
             _decode_kernel_merged, page_size=page_size,
             scale=head_dim ** -0.5, sliding_window=sliding_window,
             sinks=int(sinks or 0), pages_per_block=pages_per_block,
-            shared_kv=shared_kv, has_tail=has_tail, layer_idx=layer_idx,
+            shared_kv=shared_kv,
+            shared_copy=shared_kv and shared_stream == "copy",
+            has_tail=has_tail, layer_idx=layer_idx,
         )
         k_scr = ((2, pages_per_block, kv_heads, page_size, head_dim)
                  if rr == 1 else
                  (2, rr, pages_per_block, kv_heads, page_size, head_dim))
-        v_scr = ((1,) * (5 if rr == 1 else 6)) if shared_kv else k_scr
+        v_scr = (((1,) * (5 if rr == 1 else 6))
+                 if shared_kv and shared_stream != "copy" else k_scr)
         sem_shape = ((2, pages_per_block, 2) if rr == 1
                      else (2, rr, pages_per_block, 2))
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -965,6 +1009,7 @@ def pallas_paged_decode_attention(
             _decode_kernel, page_size=page_size, scale=head_dim ** -0.5,
             sliding_window=sliding_window, sinks=int(sinks or 0),
             pages_per_block=pages_per_block, shared_kv=shared_kv,
+            shared_copy=shared_kv and shared_stream == "copy",
             has_tail=has_tail, layer_idx=layer_idx,
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -995,8 +1040,11 @@ def pallas_paged_decode_attention(
                 # DMA staging must match the cache dtype; upcast after load.
                 pltpu.VMEM((2, pages_per_block, page_size, head_dim),
                            k_cache.dtype),
-                # shared_kv (absorbed MLA): V stream skipped, placeholder.
-                pltpu.VMEM((1, 1, 1, 1) if shared_kv else
+                # shared_kv: V stream skipped. "copy" mirrors K into a
+                # full V scratch locally (one HBM read, two buffers);
+                # "reuse" needs only a placeholder.
+                pltpu.VMEM((1, 1, 1, 1)
+                           if shared_kv and shared_stream != "copy" else
                            (2, pages_per_block, page_size, head_dim),
                            k_cache.dtype),
                 pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
@@ -1039,8 +1087,8 @@ def _kv_pool_spec(k_cache, stacked=False):
 def sharded_paged_decode_attention(
     mesh, q, k_cache, v_cache, page_table, ctx_lens, *,
     sliding_window=None, sinks=None, pages_per_block=None, shared_kv=False,
-    merge_heads=None, tail_k=None, tail_v=None, tail_lens=None,
-    layer_idx=None, interpret=False,
+    shared_stream="copy", merge_heads=None, tail_k=None, tail_v=None,
+    tail_lens=None, layer_idx=None, interpret=False,
 ):
     """Flash-decode over a tp-sharded paged KV cache.
 
@@ -1068,7 +1116,7 @@ def sharded_paged_decode_attention(
         return pallas_paged_decode_attention(
             q_, k_, v_, t_, l_, sliding_window=sliding_window, sinks=sinks,
             pages_per_block=pages_per_block, shared_kv=shared_kv,
-            merge_heads=merge_heads,
+            shared_stream=shared_stream, merge_heads=merge_heads,
             tail_k=tk_ if has_tail else None,
             tail_v=tv_ if has_tail else None,
             tail_lens=tl_ if has_tail else None,

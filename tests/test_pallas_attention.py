@@ -199,10 +199,14 @@ def test_multi_query_shared_kv_operand():
 
 
 @pytest.mark.parametrize("kpb", [1, 3])
-def test_shared_kv_single_stream(kpb):
-    """shared_kv=True streams each page once (no V DMA) and reuses the K
-    scratch as values — bit-identical to the double-stream aliased path.
-    This is absorbed MLA's decode fast path: half the HBM traffic."""
+@pytest.mark.parametrize("stream", ["reuse", "copy"])
+def test_shared_kv_single_stream(kpb, stream):
+    """shared_kv=True streams each page once (no V DMA) — bit-identical
+    to the double-stream aliased path in both latent feeds: "reuse"
+    (V aliased to the K scratch) and "copy" (local VMEM mirror, the
+    engine default after the r5 on-chip probe measured reuse 2x slower
+    at b8/ctx4k). This is absorbed MLA's decode fast path: half the
+    HBM traffic either way."""
     q, k_cache, _v, table, ctx_lens = build_case(
         q_heads=8, kv_heads=1, head_dim=24)
     ref = pallas_paged_decode_attention(
@@ -210,7 +214,7 @@ def test_shared_kv_single_stream(kpb):
         interpret=True)
     out = pallas_paged_decode_attention(
         q, k_cache, k_cache, table, ctx_lens, pages_per_block=kpb,
-        shared_kv=True, interpret=True)
+        shared_kv=True, shared_stream=stream, interpret=True)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
