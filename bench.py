@@ -53,15 +53,17 @@ def make_pods(n_pods, model_cfg, engine_mod, indexer, params=None,
 
     from llmd_kv_cache_tpu.events.model import EventBatch
     from llmd_kv_cache_tpu.events.pool import Pool, PoolConfig
-    from llmd_kv_cache_tpu.models.llama import fuse_params, init_params
+    from llmd_kv_cache_tpu.models.llama import init_params, maybe_fuse_params
 
     if params is None:
         params = init_params(jax.random.PRNGKey(0), model_cfg)
-    # Fuse ONCE before sharing: each engine fuses by default, and fusing
-    # a shared unfused tree per pod would materialize n_pods private
-    # weight copies (~1 GiB each at the TPU bench shape). fuse_params is
-    # a no-op on an already-fused tree, so the engines just adopt it.
-    params = fuse_params(params, model_cfg)
+    # Fuse ONCE before sharing — but only when the shape profits
+    # (fuse_profitable: the 0.9B bench model's hidden 2048 measured ~8%
+    # SLOWER fused on the v5e, benchmarking/r5-tpu). Fusing a shared
+    # unfused tree per pod would materialize n_pods private weight
+    # copies (~1 GiB each at the TPU bench shape); fuse_params is a
+    # no-op on an already-fused tree, so the engines just adopt it.
+    params = maybe_fuse_params(params, model_cfg)
     # Capacity-constrained page pool (the regime where routing matters:
     # each pod can hold a few of the workload's shared prefixes, like the
     # reference's 73%-capacity setup). Round-robin thrashes the prefix
@@ -623,11 +625,14 @@ def main(queued: bool = True) -> None:
     # pollute TTFT for either arm.
     import sys as _sys
     _t0 = time.perf_counter()
-    from llmd_kv_cache_tpu.models.llama import fuse_params as _fuse_params
     from llmd_kv_cache_tpu.models.llama import init_params as _init_params
-    # Fused once here; every fleet shares this single tree (make_pods's
-    # fuse and the engines' are no-ops on it).
-    shared_params = _fuse_params(
+    from llmd_kv_cache_tpu.models.llama import (
+        maybe_fuse_params as _maybe_fuse_params)
+    # Fused once here when the shape profits (fuse_profitable; the 0.9B
+    # bench shape measured faster UNFUSED on the v5e); every fleet
+    # shares this single tree (make_pods's fuse and the engines' are
+    # no-ops on it).
+    shared_params = _maybe_fuse_params(
         _init_params(jax.random.PRNGKey(0), model_cfg), model_cfg)
     warm_indexer = fresh_indexer()
     warm = make_pods(1, model_cfg, engine_mod, warm_indexer,

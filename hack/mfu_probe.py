@@ -83,25 +83,33 @@ def timed_threaded(label, fn, state, iters=8, flops=None):
     return dt
 
 
-def timed_scanned(op, operand, reps=16, iters=4):
+def timed_scanned(op, operand, *big_operands, reps=16, iters=4):
     """Steady-state seconds per op via a jit'd ``lax.scan`` of ``reps``
     applications with a carry-dependent operand (defeats CSE/hoisting;
     the multiplier casts back to the operand dtype so the timed op runs
     the production bf16 path). One definition for every in-jit probe so
-    the methodology cannot drift between stages (review r5)."""
+    the methodology cannot drift between stages (review r5).
+
+    Any large array (KV caches, expert weights) MUST ride in
+    ``big_operands`` — ``op`` receives them as extra positional args.
+    Closure-captured concrete arrays become jaxpr constants that are
+    serialized into the remote-compile request body, and the tunnel's
+    compile endpoint rejects oversized bodies (HTTP 413 — the failure
+    mode that ate the first b8/b32-ctx2048 decode sweeps and the MoE
+    probe's 20-minute "compile")."""
     @jax.jit
-    def scanned(x):
+    def scanned(x, *rest):
         def body(c, _):
-            o = op(x * (1 + c * 0).astype(x.dtype))
+            o = op(x * (1 + c * 0).astype(x.dtype), *rest)
             return o.ravel()[0].astype(jnp.float32), None
         out, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
         return out
 
-    out = scanned(operand)
+    out = scanned(operand, *big_operands)
     _sync(out)
     start = time.perf_counter()
     for _ in range(iters):
-        out = scanned(operand)
+        out = scanned(operand, *big_operands)
     _sync(out)
     return (time.perf_counter() - start) / iters / reps
 
@@ -255,15 +263,16 @@ def main():
 
     for fwd, label in ((forward, "4096-tok prefill, 2x2048 chunks in-jit"),
                        (forward_prefill_pallas,
-                        "same, flash prefill (unfused)")):
+                        "same, flash prefill (engine default, unfused)")):
         timed_chunked_prefill(label, fwd, CFG, params, table, full_tokens,
                               NUM_PAGES, prefill_flops, iters=4)
-    # The engine fuses QKV and gate+up into single wider matmuls by
-    # default on single-shard serving (fuse_params); the forward fns
-    # dispatch on the fused keys, so the same chunked-prefill harness
-    # times the production tree directly.
+    # Fused QKV/gate+up variant: at this hidden-2048 shape it measured
+    # ~8% SLOWER on the v5e, which is why llama.fuse_profitable gates
+    # the engine's auto default OFF here (fused is the default only at
+    # hidden >= 4096 — see --big). Kept in the probe to re-check the
+    # crossover whenever kernels or XLA change.
     timed_chunked_prefill(
-        "same, flash + fused QKV/gateup (engine TPU default)",
+        "same, flash + fused QKV/gateup (off by default)",
         forward_prefill_pallas, CFG, fuse_params(params, CFG), table,
         full_tokens, NUM_PAGES, prefill_flops, iters=4)
 
@@ -391,10 +400,10 @@ def main_decode():
         lens = jnp.full((batch,), ctx, jnp.int32)
         kv_bytes = batch * ctx * kvh * hd * 2 * 2
         dt = timed_scanned(
-            lambda q_op: pallas_paged_decode_attention(
-                q_op, kc, vc, table, lens, pages_per_block=kpb,
+            lambda q_op, kc_op, vc_op: pallas_paged_decode_attention(
+                q_op, kc_op, vc_op, table, lens, pages_per_block=kpb,
                 batch_rows=rows),
-            q)
+            q, kc, vc)
         gbs = kv_bytes / dt / 1e9
         print(f"decode b{batch:<3d} ctx{ctx:<5d} rows={rows:<2d} "
               f"kpb={'auto' if kpb is None else kpb:<4} "
@@ -488,7 +497,8 @@ def main_moe():
         for cf, cfg in cfgs.items():
             with deadline(420, f"moe {name} cf={cf}"):
                 dts[cf] = timed_scanned(
-                    lambda x_op, cfg=cfg: _mlp(x_op, layer, cfg), x, reps=8)
+                    lambda x_op, layer_op, cfg=cfg: _mlp(x_op, layer_op, cfg),
+                    x, layer, reps=8)
         if 1.0 in dts:
             dt = dts[1.0]
             print(f"moe {name:<18s} {tokens} tok cf=1: {dt * 1e3:8.2f} ms  "
@@ -509,7 +519,8 @@ def main_moe():
         dlayer = dparams["layers"][0]
         with deadline(420, f"moe {name} dense-baseline"):
             ddt = timed_scanned(
-                lambda x_op: _mlp(x_op, dlayer, dcfg), x, reps=8)
+                lambda x_op, dlayer_op: _mlp(x_op, dlayer_op, dcfg),
+                x, dlayer, reps=8)
             if 1.0 in dts:
                 print(f"    dense same-active-FLOPs MLP:   {ddt * 1e3:8.2f} ms"
                       f"  (dispatch overhead {dts[1.0] / ddt:.2f}x at cf=1)",
@@ -550,9 +561,9 @@ def main_mla():
         for name, kw, streams in variants:
             kv_bytes = batch * ctx * width * streams * 2
             dt = timed_scanned(
-                lambda q_op, kw=kw: pallas_paged_decode_attention(
-                    q_op, latent, latent, table, lens, **kw),
-                q)
+                lambda q_op, lat_op, kw=kw: pallas_paged_decode_attention(
+                    q_op, lat_op, lat_op, table, lens, **kw),
+                q, latent)
             print(f"mla decode b{batch:<3d} ctx{ctx:<5d} "
                   f"{name} "
                   f"{dt * 1e3:8.3f} ms/step  "

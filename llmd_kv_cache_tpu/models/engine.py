@@ -99,12 +99,15 @@ class EngineConfig:
     use_pallas_prefill: Optional[bool] = None
     # Fuse QKV (and gate+up, MLA input) projections into single wider
     # matmuls at startup (models.llama.fuse_params). None = auto: fused
-    # on single-shard engines, unfused under a mesh (the fused column
-    # blocks shard non-uniformly across tp). When sharing one params
-    # tree across pods, pass it through fuse_params FIRST (fusing is a
-    # no-op on a fused tree) — otherwise each engine materializes its
-    # own fused weight copy. Checkpoints store the canonical unfused
-    # layout either way (models.checkpoint unfuses on save).
+    # on single-shard engines whose shape profits (llama.fuse_profitable
+    # — measured v5e crossover: hidden 4096 gains ~7% prefill MFU,
+    # hidden 2048 loses ~8%; benchmarking/r5-tpu), unfused under a mesh
+    # (the fused column blocks shard non-uniformly across tp). When
+    # sharing one params tree across pods, pass it through
+    # llama.maybe_fuse_params FIRST (profit-gated; a no-op on a fused
+    # tree) — otherwise each engine materializes its own fused weight
+    # copy. Checkpoints store the canonical unfused layout either way
+    # (models.checkpoint unfuses on save).
     fuse_projections: Optional[bool] = None
     # Batch rows co-scheduled per flash-decode program (merged-heads
     # kernel): each round issues every row's page DMAs together and the
@@ -518,7 +521,9 @@ class MiniEngine:
 
         fuse = self.cfg.fuse_projections
         if fuse is None:
-            fuse = mesh is None
+            from .llama import fuse_profitable
+
+            fuse = mesh is None and fuse_profitable(mcfg)
         if fuse and mesh is not None:
             raise ValueError(
                 "fuse_projections=True is incompatible with a mesh: fused "

@@ -394,12 +394,16 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
 def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
     """Fuse per-layer projections that share an input into wider matmuls.
 
-    Serving-time transform (applied once at engine startup): the hidden
-    size is the matmuls' K dimension, and at K=2048 the MXU spends a
-    larger share of each narrow-N matmul on pipeline fill — one
-    [h, Nq+Nk+Nv] product reads the activations once and keeps the
-    systolic array busier than three back-to-back [h, N] products
-    (measured lever from the round-4 MFU roofline, benchmarking/r4-mfu).
+    Serving-time transform (applied once at engine startup): one
+    [h, Nq+Nk+Nv] product reads the activations once and replaces three
+    back-to-back [h, N] products. Measured on a real v5e
+    (benchmarking/r5-tpu/tpu_validation.log), the trade is
+    shape-dependent: at hidden 4096 (3.1B model) the fused 4k prefill is
+    ~7% faster (210 ms / 64.0% MFU vs 227 ms / 59.4%), while at hidden
+    2048 (the 0.9B bench model) it is ~8% SLOWER (112 ms vs 103 ms) —
+    XLA already overlaps the narrow products there and the fused wide-N
+    output only adds slice boundaries. ``fuse_profitable`` encodes the
+    measured crossover; the engine's auto default consults it.
 
     - ``wq/wk/wv`` (+ ``bq/bk/bv``) → ``w_qkv`` (+ ``b_qkv``)
     - MLA: ``wq|w_dq`` + ``w_dkv`` + ``w_kr`` → ``w_mla_in``
@@ -437,6 +441,27 @@ def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
         fused_layers.append(lyr)
     out["layers"] = fused_layers
     return out
+
+
+def maybe_fuse_params(params: Params, cfg: LlamaConfig) -> Params:
+    """``fuse_params`` iff ``fuse_profitable(cfg)`` — the one place the
+    profit gate composes with the transform, shared by the engine's
+    auto default and the bench's shared-tree path."""
+    return fuse_params(params, cfg) if fuse_profitable(cfg) else params
+
+
+def fuse_profitable(cfg: LlamaConfig) -> bool:
+    """Whether ``fuse_params`` is expected to help this model on TPU.
+
+    The measured crossover (real v5e, 4k flash prefill,
+    benchmarking/r5-tpu/tpu_validation.log): hidden 4096 gains ~7%
+    (59.4% → 64.0% MFU), hidden 2048 loses ~8% (38.4% → 35.5%). The
+    boundary sits somewhere in (2048, 4096]; models below it keep the
+    unfused layout so narrow-hidden serving never regresses. Engines
+    with ``fuse_projections=None`` and the bench's shared-tree path both
+    consult this.
+    """
+    return cfg.hidden_size >= 4096
 
 
 def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:

@@ -17,9 +17,13 @@ def make_spec(tmp_path):
 
 
 def make_engine(tmp_path, pod="pod-0"):
+    # fuse_projections=True: keeps the FUSED serving layout covered
+    # through offload/restore round-trips now that the shape-aware auto
+    # leaves tiny models unfused (r5 review).
     return MiniEngine(
         EngineConfig(model=LlamaConfig.tiny(), num_pages=64, max_pages_per_seq=16,
-                     model_name="tiny", pod_identifier=pod),
+                     model_name="tiny", pod_identifier=pod,
+                     fuse_projections=True),
         offload_spec=make_spec(tmp_path),
     )
 

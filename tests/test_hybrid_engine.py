@@ -45,6 +45,12 @@ def make_engine(events=None, num_pages=64, num_swa_pages=None, cfg=None):
             max_pages_per_seq=16,
             model_name="tiny-hybrid",
             pod_identifier="pod-h",
+            # The shape-aware auto leaves tiny models unfused, which
+            # most suites now exercise; this suite pins the FUSED
+            # serving layout through the hybrid paging paths so the
+            # production hidden>=4096 default keeps integration
+            # coverage (r5 review).
+            fuse_projections=True,
         ),
         event_sink=sink_batch if events is not None else None,
     )
