@@ -4,10 +4,24 @@ sizing (0.46 B params) — the evidence behind the decode tables in
 benchmarking/r4-mfu/README.md ("engine decode, burst 32").
 
 Serves each (batch, ctx) point end-to-end through MiniEngine: admit
-`batch` requests of `ctx` prompt tokens, then time decoding 128 tokens
-each in fused 32-token bursts. Throughput counts decoded tokens only,
-but the timed window includes whatever prefill interleaves after the
-first step — run on an idle chip for clean numbers.
+`batch` requests of `ctx` prompt tokens, decode 128 tokens each in
+fused 32-token bursts. Two timed windows per point (r5 methodology
+fix — the r4 single window started after ONE step, so at batch 32 the
+other 31 interleaved prefills dominated it and the "decode tok/s"
+number mostly measured prefill):
+
+- e2e: first step -> all done (prefill interleave included; the
+  serving-throughput view, comparable to the r4 numbers), and
+- decode-only: clock starts once EVERY request has emitted its first
+  token, so the window holds nothing but full-batch decode bursts —
+  the number the kernel-level GB/s sweeps (mfu_probe --decode)
+  predict.
+
+`add_request` prefills synchronously at admission (unlike `enqueue`,
+whose prefills are chunk-interleaved one request per step), so in
+practice every request is prefilled before the first step() and the
+two windows coincide — the printed live/done split at the decode-clock
+start makes the window composition checkable from the log.
 
 Usage: env PYTHONPATH=/root/.axon_site:. python hack/decode_batch_sweep.py
 """
@@ -50,12 +64,28 @@ def main():
         eng.step()  # compile + first prefills outside the timed window
         start = time.perf_counter()
         before = sum(len(r.output) for r in reqs)
+        # Phase 1: run until every request has its first token — the
+        # remaining prefills (and the decode bursts interleaving with
+        # them) stay inside the e2e window only.
+        while any(len(r.output) == 0 for r in reqs):
+            eng.step()
+        dec_start = time.perf_counter()
+        dec_before = sum(len(r.output) for r in reqs)
+        live = sum(1 for r in reqs if not r.done)
+        # Phase 2: pure full-batch decode to completion.
         while not all(r.done for r in reqs):
             eng.step()
-        elapsed = time.perf_counter() - start
+        end = time.perf_counter()
         toks = sum(len(r.output) for r in reqs) - before
+        dec_toks = sum(len(r.output) for r in reqs) - dec_before
+        dec_dt = end - dec_start
         print(f"0.46B decode b{batch:<3d} ctx{ctx:<5d} burst32: "
-              f"{toks / elapsed:7.0f} tok/s ({toks} toks in {elapsed:.2f}s)",
+              f"e2e {toks / (end - start):7.0f} tok/s "
+              f"({toks} toks in {end - start:.2f}s)   decode-only "
+              f"{dec_toks / dec_dt:7.0f} tok/s "
+              f"({dec_toks} toks in {dec_dt:.2f}s, "
+              f"{dec_dt / (dec_toks / live) * 1e3:.2f} ms/step, "
+              f"{live}/{batch} rows live at clock start)",
               flush=True)
 
 

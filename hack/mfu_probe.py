@@ -570,6 +570,67 @@ def main_mla():
                   f"{kv_bytes / dt / 1e9:7.1f} GB/s eff", flush=True)
 
 
+def main_burst():
+    """Fused-burst decomposition (`--burst`): the engine's b32/ctx2048
+    decode measured 17 ms/step end-to-end (hack/decode_batch_sweep) while
+    the kernel-level sweeps predict ~5 ms (1.6 ms attention + ~3 ms
+    weight reads at measured GB/s). Time `forward_decode_steps` — the
+    exact burst program the engine dispatches — in isolation at the
+    sweep's shapes to split program cost from engine/dispatch overhead,
+    across backends and batch, plus a no-tail single-step scan as the
+    floor."""
+    from llmd_kv_cache_tpu.models.llama import (forward_decode_pallas,
+                                                forward_decode_steps)
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, num_layers=16,
+                      num_heads=16, num_kv_heads=8, head_dim=128,
+                      intermediate_size=5632, page_size=16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    steps = 32
+    for batch, ctx in ((32, 2048), (8, 2048), (32, 64)):
+        pps = (ctx + 128) // 16 + 2
+        num_pages = batch * pps + 64
+        table = jnp.asarray(
+            1 + np.arange(batch * pps).reshape(batch, pps), jnp.int32)
+        ctx_lens = jnp.full((batch,), ctx, jnp.int32)
+        active = jnp.full((batch,), 10 ** 9, jnp.int32)
+        last = jnp.asarray(rng.integers(1, 30000, (batch,)), jnp.int32)
+
+        for use_pallas, tag in ((True, "pallas"), (False, "xla   ")):
+            k, v = init_kv_cache(cfg, num_pages)
+
+            def burst(state, up=use_pallas):
+                k, v = state
+                toks, k, v = forward_decode_steps(
+                    params, cfg, last, k, v, table, ctx_lens, active,
+                    steps=steps, use_pallas=up)
+                return (k, v)
+
+            dt = timed_threaded(
+                f"burst32 b{batch:<3d} ctx{ctx:<5d} {tag} (per burst)",
+                burst, (k, v), iters=4)
+            print(f"    -> {dt / steps * 1e3:8.3f} ms/step", flush=True)
+
+        # Comparison point: the single-token decode program dispatched
+        # per step (timed_threaded — donation needs the jit boundary, so
+        # this one is NOT in-jit and includes ~one dispatch per step;
+        # subtract the burst's per-step cost to see what bursting saves,
+        # don't read it as an overhead-free floor).
+        k, v = init_kv_cache(cfg, num_pages)
+
+        def single(state):
+            k, v = state
+            logits, k, v = forward_decode_pallas(
+                params, cfg, last[:, None], k, v, table,
+                ctx_lens, jnp.ones((batch,), jnp.int32))
+            return (k, v)
+
+        dt = timed_threaded(
+            f"single-step b{batch:<3d} ctx{ctx:<5d} pallas (per step)",
+            single, (k, v), iters=8)
+
+
 def main_big():
     """3.1B-param scaling datapoint (`--big`): the bench model's MFU is
     bounded by its small matmul shapes (hidden 2048); at Llama-7B-like
@@ -617,5 +678,7 @@ if __name__ == "__main__":
         main_moe()
     elif "--mla" in sys.argv:
         main_mla()
+    elif "--burst" in sys.argv:
+        main_burst()
     else:
         main()
