@@ -631,6 +631,78 @@ def main_burst():
             single, (k, v), iters=8)
 
 
+def main_fp8():
+    """fp8 KV probe (`--fp8`): the quantized merged-decode kernel vs the
+    bf16 kernel at the bandwidth-bound serving shapes, plus the engine's
+    decode-only tok/s on an fp8 pool — the measured check on "half the
+    KV bytes ≈ double the attention-stream bandwidth"."""
+    rng = np.random.default_rng(0)
+    kvh, hd, ps = 8, 128, 16
+    num_pages = 16 * 1024 + 1
+    kb = jnp.asarray(rng.normal(size=(num_pages, kvh, ps, hd)), jnp.bfloat16)
+    vb = jnp.asarray(rng.normal(size=(num_pages, kvh, ps, hd)), jnp.bfloat16)
+    k8 = kb.astype(jnp.float8_e4m3fn)
+    v8 = vb.astype(jnp.float8_e4m3fn)
+
+    for batch, ctx in ((32, 2048), (32, 4096), (8, 4096)):
+        pps = ctx // ps
+        q = jnp.asarray(rng.normal(size=(batch, 16, hd)), jnp.bfloat16)
+        table = jnp.asarray(
+            1 + (np.arange(batch * pps, dtype=np.int64) * 2654435761
+                 % (num_pages - 1)).reshape(batch, pps).astype(np.int32))
+        lens = jnp.full((batch,), ctx, jnp.int32)
+        for name, kc, vc, streams_bytes in (
+                ("bf16", kb, vb, 2), ("fp8 ", k8, v8, 1)):
+            kv_bytes = batch * ctx * kvh * hd * 2 * streams_bytes
+            try:
+                dt = timed_scanned(
+                    lambda q_op, kc_op, vc_op: pallas_paged_decode_attention(
+                        q_op, kc_op, vc_op, table, lens), q, kc, vc)
+                print(f"decode b{batch:<3d} ctx{ctx:<5d} {name} "
+                      f"{dt * 1e3:8.3f} ms/step  "
+                      f"{kv_bytes / dt / 1e9:7.1f} GB/s eff (tok-bytes "
+                      f"{batch * ctx * kvh * hd * 2 * 2 / dt / 1e9:7.1f})",
+                      flush=True)
+            except Exception as e:
+                print(f"decode b{batch} ctx{ctx} {name}: "
+                      f"{type(e).__name__}: {str(e)[:110]}", flush=True)
+
+    # Engine-level: the decode-sweep b32/ctx2048 point on an fp8 pool.
+    import time as _time
+
+    from llmd_kv_cache_tpu.models import engine as engine_mod
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, num_layers=16,
+                      num_heads=16, num_kv_heads=8, head_dim=128,
+                      intermediate_size=5632, page_size=16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch, ctx, max_new = 32, 2048, 128
+    prompts = [rng.integers(1, 30000, ctx).tolist() for _ in range(batch)]
+    for dtype_name in ("bf16", "f8_e4m3"):
+        pages = batch * ((ctx + max_new) // 16 + 2)
+        eng = engine_mod.MiniEngine(
+            engine_mod.EngineConfig(
+                model=cfg, num_pages=pages + 64,
+                max_pages_per_seq=(ctx + max_new) // 16 + 2,
+                max_batch=batch, model_name="fp8-probe",
+                pod_identifier="p", decode_burst=32,
+                max_prefill_tokens=2048, kv_cache_dtype=dtype_name),
+            params=params, seed=0)
+        reqs = [eng.add_request(f"r{i}", p, max_new_tokens=max_new)
+                for i, p in enumerate(prompts)]
+        eng.step()
+        start = _time.perf_counter()
+        before = sum(len(r.output) for r in reqs)
+        while not all(r.done for r in reqs):
+            eng.step()
+        dt = _time.perf_counter() - start
+        toks = sum(len(r.output) for r in reqs) - before
+        print(f"0.46B engine decode b32 ctx2048 {dtype_name}: "
+              f"{toks / dt:7.0f} tok/s ({toks} toks in {dt:.2f}s, "
+              f"{dt / (toks / batch) * 1e3:.2f} ms/step)", flush=True)
+        del eng
+
+
 def main_big():
     """3.1B-param scaling datapoint (`--big`): the bench model's MFU is
     bounded by its small matmul shapes (hidden 2048); at Llama-7B-like
@@ -680,5 +752,7 @@ if __name__ == "__main__":
         main_mla()
     elif "--burst" in sys.argv:
         main_burst()
+    elif "--fp8" in sys.argv:
+        main_fp8()
     else:
         main()

@@ -218,6 +218,12 @@ sys.argv = ['mfu_probe', '--burst']
 runpy.run_path('hack/mfu_probe.py', run_name='__main__')
 "
 
+  stage fp8_decode_probe 1800 "
+import runpy, sys
+sys.argv = ['mfu_probe', '--fp8']
+runpy.run_path('hack/mfu_probe.py', run_name='__main__')
+"
+
   # One resumable sub-stage per shape: ~20 fresh kernel compiles each at
   # 20-40 s on the tunnel; a monolithic 80-compile stage would blow any
   # reasonable time box and restart from zero on every attempt. Failed

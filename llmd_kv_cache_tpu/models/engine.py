@@ -62,6 +62,18 @@ logger = get_logger("models.engine")
 EventSink = Callable[[list[GenericEvent]], None]
 
 
+def _resolve_kv_dtype(name: str):
+    """EngineConfig.kv_cache_dtype string → jnp dtype (loud on typos)."""
+    table = {
+        "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+        "f8_e4m3": jnp.float8_e4m3fn, "float8_e4m3fn": jnp.float8_e4m3fn,
+    }
+    if name not in table:
+        raise ValueError(
+            f"kv_cache_dtype must be one of {sorted(table)}, got {name!r}")
+    return table[name]
+
+
 @dataclass
 class EngineConfig:
     model: LlamaConfig = field(default_factory=LlamaConfig.tiny)
@@ -109,6 +121,21 @@ class EngineConfig:
     # copy. Checkpoints store the canonical unfused layout either way
     # (models.checkpoint unfuses on save).
     fuse_projections: Optional[bool] = None
+    # Paged KV pool element type: None (default — the model's dtype),
+    # "bf16", or "f8_e4m3" (float8_e4m3fn). fp8 halves KV HBM traffic
+    # and capacity — the decode-bandwidth lever at long context
+    # (b32/ctx2048 decode is attention-bandwidth bound,
+    # benchmarking/r5-tpu) — with ~2^-3 relative quantization error per
+    # element (the established fp8-KV serving trade). e4m3's per-element
+    # exponent needs no scale arrays: the cache keeps its layout,
+    # scatter casts on write, attention upcasts on read,
+    # offload/checkpoint move 1-byte elements (the store fingerprint's
+    # dtype field separates fp8 stores from bf16). fp8 decode rides the
+    # merged flash kernel's quantized arm (flat whole-page 1-byte DMAs,
+    # needs kv_heads*page_size % 32 == 0); fp8 prefill runs XLA
+    # attention — TTFT-bound deployments should keep bf16. MLA latents
+    # and mesh-sharded engines refuse fp8 for now.
+    kv_cache_dtype: Optional[str] = None
     # Batch rows co-scheduled per flash-decode program (merged-heads
     # kernel): each round issues every row's page DMAs together and the
     # pipeline fills once per program instead of once per batch item —
@@ -501,6 +528,20 @@ class MiniEngine:
         self._running: list[str] = []
         self.swa_manager: Optional[BlockManager] = None
         self.k_swa = self.v_swa = None
+        kv_dtype = (mcfg.dtype if self.cfg.kv_cache_dtype is None
+                    else _resolve_kv_dtype(self.cfg.kv_cache_dtype))
+        self._kv_dtype = kv_dtype
+        self._fp8_cache = jnp.dtype(kv_dtype).itemsize == 1
+        if self._fp8_cache:
+            if mcfg.is_mla:
+                raise ValueError(
+                    "kv_cache_dtype=f8_e4m3 does not support MLA latent "
+                    "pools yet (absorbed-attention latents are more "
+                    "quantization-sensitive; keep bf16)")
+            if mesh is not None:
+                raise ValueError(
+                    "kv_cache_dtype=f8_e4m3 does not support mesh-sharded "
+                    "engines yet; keep bf16 under tp/pp/sp")
         if self.hybrid:
             num_swa = self.cfg.num_swa_pages or self.cfg.num_pages
             self.block_manager = BlockManager(
@@ -513,11 +554,13 @@ class MiniEngine:
                 spec_window=mcfg.sliding_window,
             )
             self.k_cache, self.v_cache, self.k_swa, self.v_swa = (
-                init_kv_cache_hybrid(mcfg, self.cfg.num_pages, num_swa)
+                init_kv_cache_hybrid(mcfg, self.cfg.num_pages, num_swa,
+                                     dtype=kv_dtype)
             )
         else:
             self.block_manager = BlockManager(self.cfg, self.processor, event_sink)
-            self.k_cache, self.v_cache = init_kv_cache(mcfg, self.cfg.num_pages)
+            self.k_cache, self.v_cache = init_kv_cache(
+                mcfg, self.cfg.num_pages, dtype=kv_dtype)
 
         fuse = self.cfg.fuse_projections
         if fuse is None:
@@ -581,6 +624,21 @@ class MiniEngine:
                     "paged attention cannot compile on TPU, using XLA "
                     "paged attention%s", kernel_width, hint)
             use_pallas = False
+        fp8_cache = self._fp8_cache
+        if fp8_cache and use_pallas:
+            # fp8 rides the merged-heads decode kernel's quant arm (flat
+            # whole-page [kvh*ps, hd] DMAs + in-VMEM upcast), which needs
+            # kv_heads > 1 and kv_heads*page_size % 32 == 0 for Mosaic's
+            # 8-bit tiling; other shapes fall back to XLA attention.
+            if mcfg.kv_cache_heads <= 1 or (
+                    mcfg.kv_cache_heads * mcfg.page_size) % 32:
+                if self.cfg.use_pallas_decode:
+                    logger.warning(
+                        "fp8 cache shape (kv_heads=%d, page_size=%d) "
+                        "cannot ride the quantized flash-decode kernel; "
+                        "using XLA attention",
+                        mcfg.kv_cache_heads, mcfg.page_size)
+                use_pallas = False
         # Hybrid: fused bursts run the grouped two-pool scan
         # (forward_decode_steps_hybrid) with freeze-and-reclaim SWA paging,
         # and the flash-decode kernel applies there per layer (each layer
@@ -633,6 +691,17 @@ class MiniEngine:
         prefill_pallas = (use_pallas and on_tpu
                           if self.cfg.use_pallas_prefill is None
                           else self.cfg.use_pallas_prefill)
+        if fp8_cache and prefill_pallas:
+            # The prefill kernel's per-head grid DMAs [page_size, hd]
+            # sub-slices, misaligned for 8-bit tiling — fp8 prefill runs
+            # XLA attention (gathers 1-byte pages, upcasts on read). fp8
+            # trades prefill kernel speed for decode bandwidth + 2x KV
+            # capacity; TTFT-bound deployments should keep bf16.
+            if self.cfg.use_pallas_prefill:
+                logger.warning(
+                    "kv_cache_dtype=f8_e4m3: flash prefill unavailable "
+                    "(8-bit DMA tiling); using XLA prefill")
+            prefill_pallas = False
         if prefill_pallas and use_pallas:
             self._prefill_forward = functools.partial(
                 forward_prefill_pallas, interpret=not on_tpu, mesh=pallas_mesh
@@ -730,6 +799,16 @@ class MiniEngine:
                     f"offload spec attention_sinks="
                     f"{getattr(offload_spec, 'attention_sinks', 0)} does "
                     f"not match the model's {mcfg.attention_sinks}")
+            spec_dtype = getattr(offload_spec, "dtype", "bfloat16")
+            cache_dtype_name = jnp.dtype(self._kv_dtype).name
+            if spec_dtype != cache_dtype_name:
+                # The dtype is a fingerprint field: a mismatched spec
+                # would resume stores whose bytes are a different element
+                # type (e.g. bf16 blocks into an fp8 pool).
+                raise ValueError(
+                    f"offload spec dtype={spec_dtype!r} does not match "
+                    f"the engine's KV cache dtype {cache_dtype_name!r} "
+                    f"(set OffloadSpec dtype accordingly)")
             self.offload_manager = offload_spec.get_manager()
             self.offload_handlers = offload_spec.get_handlers(
                 self.k_cache, self.v_cache

@@ -505,39 +505,50 @@ def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
     return out
 
 
-def init_kv_cache(cfg: LlamaConfig, num_pages: int) -> tuple[jax.Array, jax.Array]:
+def init_kv_cache(cfg: LlamaConfig, num_pages: int,
+                  dtype=None) -> tuple[jax.Array, jax.Array]:
     """Allocate the paged K and V pools: ``[layers, pages, kvh, page, hd]``.
 
     MLA: the K pool holds the per-token latent (+rope key) as one shared
     head; the V pool is width-0 — attention reads values from the same
     latent, so a separate V cache would double the memory MLA exists to
     save. The zero-width array keeps every donation/offload seam shaped.
+
+    ``dtype`` overrides the pool element type (serving-time choice —
+    ``float8_e4m3fn`` halves KV HBM traffic and capacity; e4m3's
+    per-element exponent needs no scale arrays, so the cache layout and
+    every scatter/gather/offload seam are unchanged). The compute path
+    stays bf16: ``scatter_kv_pages`` casts on write, the attention
+    backends upcast on read.
     """
+    dtype = cfg.dtype if dtype is None else dtype
     shape = (cfg.num_layers, num_pages, cfg.kv_cache_heads, cfg.page_size,
              cfg.kv_cache_head_dim)
     v_width = 0 if cfg.is_mla else cfg.kv_cache_head_dim
-    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape[:-1] + (v_width,), cfg.dtype)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape[:-1] + (v_width,), dtype)
 
 
 def init_kv_cache_hybrid(
-    cfg: LlamaConfig, num_pages: int, num_swa_pages: int
+    cfg: LlamaConfig, num_pages: int, num_swa_pages: int, dtype=None
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Allocate separate page pools for a hybrid model's two cache groups:
     ``(k0, v0, k1, v1)`` with group 0 = full-attention layers (num_pages)
     and group 1 = SWA layers (num_swa_pages — window-bounded, so typically
-    much smaller; this is the memory win of hybrid attention)."""
+    much smaller; this is the memory win of hybrid attention).
+    ``dtype`` as in ``init_kv_cache``."""
     if not cfg.is_hybrid:
         raise ValueError("init_kv_cache_hybrid needs a hybrid config")
+    dtype = cfg.dtype if dtype is None else dtype
 
     def shape(group, pages):
         return (len(cfg.group_layers(group)), pages, cfg.num_kv_heads,
                 cfg.page_size, cfg.head_dim)
 
     return (
-        jnp.zeros(shape(0, num_pages), cfg.dtype),
-        jnp.zeros(shape(0, num_pages), cfg.dtype),
-        jnp.zeros(shape(1, num_swa_pages), cfg.dtype),
-        jnp.zeros(shape(1, num_swa_pages), cfg.dtype),
+        jnp.zeros(shape(0, num_pages), dtype),
+        jnp.zeros(shape(0, num_pages), dtype),
+        jnp.zeros(shape(1, num_swa_pages), dtype),
+        jnp.zeros(shape(1, num_swa_pages), dtype),
     )
 
 
@@ -832,7 +843,12 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
 
         def write_tail(buf, new_kv):
             # buf [b, T, kvh, w]; new_kv [b, 1, kvh, w] broadcasts over T.
-            return jnp.where(tmask[:, :, None, None], new_kv, buf)
+            # Explicit cast: a quantized (fp8) cache makes the tail buffer
+            # fp8 too, and 8-bit floats refuse implicit promotion — the
+            # cast is also the semantics (tail tokens quantize exactly
+            # like their eventual scatter into the cache).
+            return jnp.where(tmask[:, :, None, None],
+                             new_kv.astype(buf.dtype), buf)
 
         def tail_kwargs(tk_l, tv_l):
             return dict(tail_k=tk_l, tail_v=tv_l, tail_lens=tail_lens,

@@ -62,6 +62,14 @@ def paged_attention(
 
     k = gather_kv_pages(k_cache, page_table)  # [b, kv_len, kvh, hd]
     v = gather_kv_pages(v_cache, page_table)
+    if k.dtype.itemsize == 1:
+        # Quantized (fp8 e4m3) cache: the HBM read above moved 1-byte
+        # elements — the bandwidth/capacity win — and the upcast to the
+        # query dtype happens on the gathered values so the matmuls run
+        # the same bf16 MXU path as an unquantized cache. (bf16 caches
+        # deliberately skip this: see the numerics note below.)
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     kv_len = k.shape[1]
 
     k_pos = jnp.broadcast_to(jnp.arange(kv_len)[None], (batch, kv_len))

@@ -399,9 +399,16 @@ def _decode_kernel_merged(
     shared_copy: bool,
     has_tail: bool,
     layer_idx: int | None,
+    quant: bool = False,
 ):
     """Decode with every kv head — and up to ``batch_rows`` batch items —
     in ONE program.
+
+    ``quant``: the cache operands/scratch hold 1-byte (fp8 e4m3) pages
+    in the flat whole-page layout ``[.., kv_heads*page_size, head_dim]``
+    (see the wrapper's quant arm); each round upcasts the staged
+    superblock to the query dtype once and the head loop slices the
+    upcast value — HBM moved half the bytes, the MXU still sees bf16.
 
     The per-head grid (``_decode_kernel``) pays pipeline fill/drain and
     per-page 4 KB DMAs once per (batch, head) program — measured on a
@@ -509,15 +516,30 @@ def _decode_kernel_merged(
             # -inf would turn exp(scores - m) into exp(0) garbage).
             live = sb * kpb < num_iters[r]
 
+            if quant:
+                # One upcast of the whole staged superblock (the fp8→bf16
+                # convert is exact); every head slices the same value.
+                kq = (k_scratch[slot] if rows == 1
+                      else k_scratch[slot, r]).astype(q_ref.dtype)
+                vq = (v_scratch[slot] if rows == 1
+                      else v_scratch[slot, r]).astype(q_ref.dtype)
+
             for h in range(kv_heads):
                 # [kpb, page_size, head_dim] slice of this head's keys →
                 # leading-collapse reshape (lane dim unchanged).
-                ks = k_scratch[slot, :, h] if rows == 1 else \
-                    k_scratch[slot, r, :, h]
-                k = ks.reshape(kpb * page_size, head_dim)
-                if shared_kv and not shared_copy:
-                    v = k
+                if quant:
+                    k = kq[:, h * page_size:(h + 1) * page_size, :].reshape(
+                        kpb * page_size, head_dim)
+                    v = vq[:, h * page_size:(h + 1) * page_size, :].reshape(
+                        kpb * page_size, head_dim)
+                elif shared_kv and not shared_copy:
+                    ks = k_scratch[slot, :, h] if rows == 1 else \
+                        k_scratch[slot, r, :, h]
+                    k = v = ks.reshape(kpb * page_size, head_dim)
                 else:
+                    ks = k_scratch[slot, :, h] if rows == 1 else \
+                        k_scratch[slot, r, :, h]
+                    k = ks.reshape(kpb * page_size, head_dim)
                     vs = v_scratch[slot, :, h] if rows == 1 else \
                         v_scratch[slot, r, :, h]
                     v = vs.reshape(kpb * page_size, head_dim)
@@ -904,9 +926,13 @@ def pallas_paged_decode_attention(
         keys = 1024
         if merge_heads:
             kv_streams = 1 if shared_kv else 2
+            # Quantized caches stage 1-byte pages but the per-round
+            # upcast materializes bf16 values of the same superblock, so
+            # budget as if 2-byte — the explicit pages_per_block knob
+            # (and the on-chip sweep) can still push wider.
             budget = (8 * 2 ** 20) // (
                 2 * batch_rows * kv_heads * head_dim
-                * k_cache.dtype.itemsize * kv_streams)
+                * max(k_cache.dtype.itemsize, 2) * kv_streams)
             keys = min(keys, max(page_size, budget))
         pages_per_block = max(1, min(keys // page_size,
                                      page_table.shape[1]))
@@ -951,6 +977,37 @@ def pallas_paged_decode_attention(
         tail_lens = jnp.pad(tail_lens, (0, pad))
         batch += pad
 
+    # Quantized (fp8 e4m3) cache arm: DMA the 1-byte pages — the whole
+    # point, half the HBM read bytes — and upcast in VMEM before the
+    # matmuls. Mosaic's 8-bit tiling is (32, 128), so the per-head
+    # [page_size, head_dim] sub-slices the bf16 path copies are
+    # misaligned at page_size 16; instead the cache is viewed as
+    # contiguous whole pages [.., kv_heads*page_size, head_dim] (a free
+    # reshape) and each DMA moves one full page for every head, which is
+    # aligned whenever kv_heads*page_size % 32 == 0. Merged-heads only
+    # (the per-head grid would need the misaligned sub-slice), and the
+    # burst tail rides as bf16 — its values were already quantized
+    # through the cache dtype when written, so the upcast is exact.
+    quant = k_cache.dtype.itemsize == 1
+    if quant:
+        if shared_kv:
+            raise ValueError(
+                "quantized (fp8) caches are not supported for shared-kv "
+                "(MLA latent) pools")
+        if not merge_heads:
+            raise ValueError(
+                "quantized (fp8) caches need the merged-heads decode "
+                "kernel (merge_heads=True)")
+        if (kv_heads * page_size) % 32 and not interpret:
+            raise ValueError(
+                f"fp8 pages need kv_heads*page_size % 32 == 0 for "
+                f"Mosaic's 8-bit tiling (got {kv_heads}*{page_size})")
+        flat = (kv_heads * page_size, head_dim)
+        k_cache = k_cache.reshape(k_cache.shape[:-3] + flat)
+        v_cache = v_cache.reshape(v_cache.shape[:-3] + flat)
+        tail_k = tail_k.astype(q.dtype)
+        tail_v = tail_v.astype(q.dtype)
+
     if merge_heads:
         rr = batch_rows
         kernel = functools.partial(
@@ -959,12 +1016,19 @@ def pallas_paged_decode_attention(
             sinks=int(sinks or 0), pages_per_block=pages_per_block,
             shared_kv=shared_kv,
             shared_copy=shared_kv and shared_stream == "copy",
-            has_tail=has_tail, layer_idx=layer_idx,
+            has_tail=has_tail, layer_idx=layer_idx, quant=quant,
         )
-        k_scr = ((2, pages_per_block, kv_heads, page_size, head_dim)
-                 if rr == 1 else
-                 (2, rr, pages_per_block, kv_heads, page_size, head_dim))
-        v_scr = (((1,) * (5 if rr == 1 else 6))
+        if quant:
+            k_scr = ((2, pages_per_block, kv_heads * page_size, head_dim)
+                     if rr == 1 else
+                     (2, rr, pages_per_block, kv_heads * page_size,
+                      head_dim))
+        else:
+            k_scr = ((2, pages_per_block, kv_heads, page_size, head_dim)
+                     if rr == 1 else
+                     (2, rr, pages_per_block, kv_heads, page_size,
+                      head_dim))
+        v_scr = (((1,) * len(k_scr))
                  if shared_kv and shared_stream != "copy" else k_scr)
         sem_shape = ((2, pages_per_block, 2) if rr == 1
                      else (2, rr, pages_per_block, 2))

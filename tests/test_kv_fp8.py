@@ -1,0 +1,262 @@
+"""fp8 (e4m3) KV cache: quantized paged pools behind the unchanged
+engine/offload seams.
+
+The serving-time ``EngineConfig.kv_cache_dtype="f8_e4m3"`` halves KV HBM
+traffic and pool capacity — the decode-bandwidth lever identified by the
+round-5 on-chip sweeps (b32/ctx2048 decode is attention-bandwidth bound,
+benchmarking/r5-tpu). e4m3's per-element exponent means no scale arrays:
+``scatter_kv_pages`` casts on write, the attention backends upcast on
+read, and the offload plane moves 1-byte elements under a
+dtype-fingerprinted store directory (reference analog: the fingerprint
+discipline of ``llmd_fs_backend/file_mapper.py`` — any field that changes
+the bytes changes the directory).
+
+Quantization error is bounded (2^-3 relative per element), so these tests
+pin closeness and internal consistency, not bit-parity with bf16: the
+fp8 engine must agree with ITSELF across serve paths (burst vs single
+step, restore vs recompute) bit-exactly, while the bf16 comparison is a
+bounded-error check.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+from llmd_kv_cache_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_kv_cache,
+    init_params,
+)
+
+
+def fp8_engine(tmp_path=None, offload_spec=None, seed=0, **kw):
+    cfg = EngineConfig(num_pages=64, max_pages_per_seq=16,
+                       kv_cache_dtype="f8_e4m3", model_name="tiny-fp8",
+                       pod_identifier="pod-q", **kw)
+    return MiniEngine(cfg, offload_spec=offload_spec, seed=seed)
+
+
+class TestForwardQuality:
+    def test_logits_close_to_bf16_cache(self):
+        """One prefill step over an fp8 pool vs a bf16 pool: same params,
+        same tokens — logits must stay within the quantization budget
+        (attention output error ~ fp8 relative step times value scale)."""
+        cfg = LlamaConfig.tiny()
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        rng = np.random.default_rng(5)
+        batch, seq = 2, 16
+        tokens = jnp.asarray(
+            rng.integers(1, cfg.vocab_size - 1, (batch, seq)), jnp.int32)
+        table = jnp.asarray(
+            rng.permutation(16)[: batch * 4].reshape(batch, 4), jnp.int32)
+        ctx = jnp.zeros((batch,), jnp.int32)
+        new = jnp.full((batch,), seq, jnp.int32)
+
+        outs = {}
+        for name, dtype in (("bf16", None), ("fp8", jnp.float8_e4m3fn)):
+            k, v = init_kv_cache(cfg, 16, dtype=dtype)
+            logits, _, _ = forward(params, cfg, tokens, k, v, table, ctx, new)
+            outs[name] = np.asarray(logits, np.float32)
+        err = np.max(np.abs(outs["fp8"] - outs["bf16"]))
+        spread = np.max(np.abs(outs["bf16"]))
+        # Quantization error must be small relative to the logit scale —
+        # loose enough to be seed-robust, tight enough that a broken
+        # upcast (garbage bytes) cannot pass.
+        assert err < 0.25 * spread, (err, spread)
+        # And the distributions must actually correlate head-on.
+        a, b = outs["fp8"].ravel(), outs["bf16"].ravel()
+        cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos > 0.999, cos
+
+    def test_cache_dtype_is_fp8(self):
+        eng = fp8_engine()
+        assert eng.k_cache.dtype == jnp.float8_e4m3fn
+        assert eng.v_cache.dtype == jnp.float8_e4m3fn
+
+
+class TestServeConsistency:
+    def test_burst_matches_single_step(self):
+        """The fused burst carries its tail in the cache dtype, so burst
+        and single-step serving quantize identically — token output must
+        be bit-equal between them (the same invariant the bf16 engine
+        pins)."""
+        prompt = np.random.default_rng(3).integers(1, 250, 48).tolist()
+        outs = []
+        for burst in (1, 8):
+            eng = fp8_engine(decode_burst=burst)
+            outs.append(eng.generate("r0", prompt, max_new_tokens=12))
+        assert outs[0] == outs[1], outs
+
+    def test_prefix_cache_hit_reuses_fp8_pages(self):
+        eng = fp8_engine()
+        prompt = list(range(30, 62))  # 2 pages worth
+        first = eng.generate("r1", prompt, max_new_tokens=4)
+        req = eng.add_request("r2", prompt, max_new_tokens=4)
+        assert req.cached_len > 0  # prefix served from the fp8 pool
+        while not req.done:
+            eng.step()
+        assert list(req.output) == first
+
+    def test_hybrid_fp8_serves(self):
+        cfg = LlamaConfig.sink_tiny()
+        eng = MiniEngine(EngineConfig(
+            model=cfg, num_pages=64, num_swa_pages=64, max_pages_per_seq=24,
+            kv_cache_dtype="f8_e4m3", model_name="hyb-fp8",
+            pod_identifier="pod-q"), seed=0)
+        prompt = np.random.default_rng(0).integers(1, 250, 64).tolist()
+        out = eng.generate("r0", prompt, max_new_tokens=8)
+        assert len(out) == 8
+        assert eng.k_swa is None or eng.k_swa.dtype == jnp.float8_e4m3fn
+
+
+class TestQuantKernelArm:
+    def test_pallas_decode_matches_xla_on_fp8_cache(self):
+        """The merged kernel's quant arm (flat whole-page 1-byte DMAs +
+        in-VMEM upcast) must reproduce the XLA reference over the SAME
+        fp8 cache — the quantization already happened at write, so the
+        two backends read identical bytes and must agree to float
+        tolerance."""
+        from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
+        from llmd_kv_cache_tpu.ops.pallas_paged_attention import (
+            pallas_paged_decode_attention)
+
+        rng = np.random.default_rng(0)
+        b, qh, kvh, hd, ps, npg, pps = 4, 8, 4, 128, 16, 64, 8
+        q = jnp.asarray(rng.normal(size=(b, qh, hd)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(npg, kvh, ps, hd)),
+                        jnp.float8_e4m3fn)
+        v = jnp.asarray(rng.normal(size=(npg, kvh, ps, hd)),
+                        jnp.float8_e4m3fn)
+        table = jnp.asarray(1 + np.arange(b * pps).reshape(b, pps) % (npg - 1),
+                            jnp.int32)
+        lens = jnp.asarray([120, 64, 37, 16], jnp.int32)
+        out = pallas_paged_decode_attention(q, k, v, table, lens,
+                                            interpret=True)
+        ref = paged_attention(q[:, None], k, v, table, (lens - 1)[:, None],
+                              lens)[:, 0]
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        assert err < 0.1, err
+
+    def test_quant_arm_multi_row(self):
+        from llmd_kv_cache_tpu.ops.paged_attention import paged_attention
+        from llmd_kv_cache_tpu.ops.pallas_paged_attention import (
+            pallas_paged_decode_attention)
+
+        rng = np.random.default_rng(1)
+        b, qh, kvh, hd, ps, npg, pps = 4, 8, 4, 128, 16, 64, 8
+        q = jnp.asarray(rng.normal(size=(b, qh, hd)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(npg, kvh, ps, hd)),
+                        jnp.float8_e4m3fn)
+        v = jnp.asarray(rng.normal(size=(npg, kvh, ps, hd)),
+                        jnp.float8_e4m3fn)
+        table = jnp.asarray(1 + np.arange(b * pps).reshape(b, pps) % (npg - 1),
+                            jnp.int32)
+        lens = jnp.asarray([128, 99, 64, 3], jnp.int32)
+        out = pallas_paged_decode_attention(q, k, v, table, lens,
+                                            batch_rows=2, interpret=True)
+        ref = paged_attention(q[:, None], k, v, table, (lens - 1)[:, None],
+                              lens)[:, 0]
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        assert err < 0.1, err
+
+    def test_mla_fp8_kernel_refused(self):
+        from llmd_kv_cache_tpu.ops.pallas_paged_attention import (
+            pallas_paged_decode_attention)
+
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.normal(size=(2, 8, 128)), jnp.bfloat16)
+        lat = jnp.asarray(rng.normal(size=(16, 1, 16, 128)),
+                          jnp.float8_e4m3fn)
+        table = jnp.asarray(np.ones((2, 4)), jnp.int32)
+        lens = jnp.asarray([16, 16], jnp.int32)
+        with pytest.raises(ValueError, match="shared-kv"):
+            pallas_paged_decode_attention(q, lat, lat, table, lens,
+                                          shared_kv=True, interpret=True)
+
+    def test_engine_pallas_fp8_matches_xla_fp8(self):
+        """End-to-end: fp8 engine on the interpret-mode Pallas decode
+        backend vs the fp8 XLA backend — identical cache bytes, token
+        output must match (same invariant the bf16 engines pin)."""
+        prompt = np.random.default_rng(9).integers(1, 250, 48).tolist()
+        outs = {}
+        for pallas in (False, True):
+            eng = MiniEngine(EngineConfig(
+                num_pages=64, max_pages_per_seq=16,
+                kv_cache_dtype="f8_e4m3", model_name="t",
+                pod_identifier="p", decode_burst=8,
+                use_pallas_decode=pallas), seed=0)
+            outs[pallas] = eng.generate("r0", prompt, max_new_tokens=8)
+        assert outs[False] == outs[True], outs
+
+
+class TestGates:
+    def test_bad_dtype_string_refused(self):
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            MiniEngine(EngineConfig(num_pages=16, max_pages_per_seq=4,
+                                    kv_cache_dtype="int8"))
+
+    def test_mla_refused(self):
+        cfg = LlamaConfig.deepseek_tiny()
+        with pytest.raises(ValueError, match="MLA"):
+            MiniEngine(EngineConfig(model=cfg, num_pages=16,
+                                    max_pages_per_seq=4,
+                                    kv_cache_dtype="f8_e4m3"))
+
+    def test_spec_dtype_mismatch_refused(self, tmp_path):
+        from llmd_kv_cache_tpu.offload import SharedStorageOffloadSpec
+
+        tiny = LlamaConfig.tiny()
+        spec = SharedStorageOffloadSpec(
+            root=str(tmp_path), model_name="tiny", page_size=tiny.page_size,
+            num_layers=tiny.num_layers, kv_heads=tiny.num_kv_heads,
+            head_dim=tiny.head_dim, io_threads=2, parallel_agnostic=True,
+        )  # dtype left at the bf16 default
+        with pytest.raises(ValueError, match="dtype"):
+            fp8_engine(offload_spec=spec)
+
+
+class TestOffload:
+    def _spec(self, tmp_path):
+        from llmd_kv_cache_tpu.offload import SharedStorageOffloadSpec
+
+        tiny = LlamaConfig.tiny()
+        return SharedStorageOffloadSpec(
+            root=str(tmp_path), model_name="tiny", page_size=tiny.page_size,
+            num_layers=tiny.num_layers, kv_heads=tiny.num_kv_heads,
+            head_dim=tiny.head_dim, dtype="float8_e4m3fn", io_threads=2,
+            parallel_agnostic=True,
+        )
+
+    def test_fp8_store_restore_bit_exact(self, tmp_path):
+        prompt = list(range(70, 102))  # 2 pages
+        a = fp8_engine(offload_spec=self._spec(tmp_path))
+        out_a = a.generate("r1", prompt, max_new_tokens=4)
+        a.flush_offload()
+
+        b = MiniEngine(EngineConfig(
+            num_pages=64, max_pages_per_seq=16, kv_cache_dtype="f8_e4m3",
+            model_name="tiny-fp8", pod_identifier="pod-b"),
+            offload_spec=self._spec(tmp_path), seed=0)
+        req = b.add_request("r2", prompt, max_new_tokens=4)
+        assert req.cached_len == len(prompt)
+        while not req.done:
+            b.step()
+        # fp8 bytes restored into an fp8 pool are the SAME bytes → the
+        # resumed decode is bit-exact vs the engine that wrote them.
+        assert list(req.output) == out_a
+
+    def test_fingerprint_separates_fp8_from_bf16(self):
+        from llmd_kv_cache_tpu.offload.file_mapper import (
+            FileMapper, FileMapperConfig)
+
+        base = dict(root="/tmp/x", model_name="m")
+        bf = FileMapper(FileMapperConfig(**base, dtype="bfloat16"))
+        f8 = FileMapper(FileMapperConfig(**base, dtype="float8_e4m3fn"))
+        assert bf.fingerprint != f8.fingerprint
