@@ -48,6 +48,7 @@ verify-examples: native
 	$(CPU_ENV) $(PY) examples/long_context_sp.py
 	$(CPU_ENV) $(PY) examples/serve_hf_checkpoint.py
 	$(CPU_ENV) $(PY) examples/redis_indexer.py
+	$(CPU_ENV) $(PY) examples/fp8_kv_serving.py
 
 # Developer check on the CPU backend (the driver separately compile-checks
 # entry() on the real chip).
