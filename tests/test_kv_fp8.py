@@ -180,6 +180,22 @@ class TestQuantKernelArm:
             pallas_paged_decode_attention(q, lat, lat, table, lens,
                                           shared_kv=True, interpret=True)
 
+    def test_hybrid_engine_pallas_fp8_matches_xla_fp8(self):
+        """Hybrid fused bursts route each cache group through the quant
+        kernel arm (per-layer group pools); pallas and XLA backends over
+        the same fp8 groups must emit identical tokens."""
+        cfg = LlamaConfig.sink_tiny()
+        prompt = np.random.default_rng(4).integers(1, 250, 64).tolist()
+        outs = {}
+        for pallas in (False, True):
+            eng = MiniEngine(EngineConfig(
+                model=cfg, num_pages=64, num_swa_pages=64,
+                max_pages_per_seq=24, kv_cache_dtype="f8_e4m3",
+                model_name="hyb-fp8", pod_identifier="p", decode_burst=8,
+                use_pallas_decode=pallas), seed=0)
+            outs[pallas] = eng.generate("r0", prompt, max_new_tokens=8)
+        assert outs[False] == outs[True], outs
+
     def test_engine_pallas_fp8_matches_xla_fp8(self):
         """End-to-end: fp8 engine on the interpret-mode Pallas decode
         backend vs the fp8 XLA backend — identical cache bytes, token
