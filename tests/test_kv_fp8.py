@@ -102,6 +102,23 @@ class TestServeConsistency:
             eng.step()
         assert list(req.output) == first
 
+    def test_qwen_bias_family_fp8_serves(self):
+        """QKV-bias + qk-norm family (Qwen lineage) over an fp8 pool:
+        burst==single-step stays bit-equal — the family's extra
+        projection terms change nothing about where quantization
+        happens (scatter/tail writes)."""
+        cfg = LlamaConfig.qwen3_tiny()
+        prompt = np.random.default_rng(11).integers(
+            1, cfg.vocab_size - 1, 48).tolist()
+        outs = []
+        for burst in (1, 8):
+            eng = MiniEngine(EngineConfig(
+                model=cfg, num_pages=64, max_pages_per_seq=16,
+                kv_cache_dtype="f8_e4m3", model_name="qwen-fp8",
+                pod_identifier="p", decode_burst=burst), seed=0)
+            outs.append(eng.generate("r0", prompt, max_new_tokens=10))
+        assert outs[0] == outs[1], outs
+
     def test_hybrid_fp8_serves(self):
         cfg = LlamaConfig.sink_tiny()
         eng = MiniEngine(EngineConfig(
