@@ -9,14 +9,14 @@ mkdir -p benchmarking/r5-tpu
 OUT=${OUT:-benchmarking/r5-tpu/tpu_validation.log}
 
 probe() {
-  timeout 90 python -c "import jax, jax.numpy as jnp; (jnp.ones((64,64))@jnp.ones((64,64))).block_until_ready(); print('ok')" 2>/dev/null | grep -q ok
+  timeout -k 30 90 python -c "import jax, jax.numpy as jnp; (jnp.ones((64,64))@jnp.ones((64,64))).block_until_ready(); print('ok')" 2>/dev/null | grep -q ok
 }
 
 stage() {  # stage <name> <timeout_s> <python-code>
   local name=$1 tmo=$2 code=$3
   if grep -q "^PASS $name" "$OUT" 2>/dev/null; then return 0; fi
   echo "RUN  $name $(date +%T)" >> "$OUT"
-  if timeout "$tmo" python -c "$code" >> "$OUT" 2>&1; then
+  if timeout -k 30 "$tmo" python -c "$code" >> "$OUT" 2>&1; then
     echo "PASS $name $(date +%T)" >> "$OUT"
   else
     echo "FAIL $name (or tunnel drop) $(date +%T)" >> "$OUT"
