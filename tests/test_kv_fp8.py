@@ -242,6 +242,18 @@ class TestGates:
                                     max_pages_per_seq=4,
                                     kv_cache_dtype="f8_e4m3"))
 
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs the 8-device virtual CPU mesh "
+                               "(tests/conftest.py)")
+    def test_mesh_sharded_refused(self):
+        from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+        with pytest.raises(ValueError, match="mesh-sharded"):
+            MiniEngine(EngineConfig(num_pages=16, max_pages_per_seq=4,
+                                    kv_cache_dtype="f8_e4m3"),
+                       mesh=mesh)
+
     def test_spec_dtype_mismatch_refused(self, tmp_path):
         from llmd_kv_cache_tpu.offload import SharedStorageOffloadSpec
 
