@@ -133,8 +133,11 @@ class EngineConfig:
     # dtype field separates fp8 stores from bf16). fp8 decode rides the
     # merged flash kernel's quantized arm (flat whole-page 1-byte DMAs,
     # needs kv_heads*page_size % 32 == 0); fp8 prefill runs XLA
-    # attention — TTFT-bound deployments should keep bf16. MLA latents
-    # and mesh-sharded engines refuse fp8 for now.
+    # attention — TTFT-bound deployments should keep bf16. Composes
+    # with mesh-sharded serving (tp/dp/sp/pp: the cast is elementwise
+    # and pools shard exactly like bf16 — token-identity pinned in
+    # tests/test_kv_fp8.py); MLA latents refuse fp8 (absorbed-attention
+    # latents are more quantization-sensitive).
     kv_cache_dtype: Optional[str] = None
     # Batch rows co-scheduled per flash-decode program (merged-heads
     # kernel): each round issues every row's page DMAs together and the
@@ -538,10 +541,6 @@ class MiniEngine:
                     "kv_cache_dtype=f8_e4m3 does not support MLA latent "
                     "pools yet (absorbed-attention latents are more "
                     "quantization-sensitive; keep bf16)")
-            if mesh is not None:
-                raise ValueError(
-                    "kv_cache_dtype=f8_e4m3 does not support mesh-sharded "
-                    "engines yet; keep bf16 under tp/pp/sp")
         if self.hybrid:
             num_swa = self.cfg.num_swa_pages or self.cfg.num_pages
             self.block_manager = BlockManager(
@@ -630,14 +629,18 @@ class MiniEngine:
             # whole-page [kvh*ps, hd] DMAs + in-VMEM upcast), which needs
             # kv_heads > 1 and kv_heads*page_size % 32 == 0 for Mosaic's
             # 8-bit tiling; other shapes fall back to XLA attention.
-            if mcfg.kv_cache_heads <= 1 or (
-                    mcfg.kv_cache_heads * mcfg.page_size) % 32:
+            # Under tp the kernel runs per shard on kv_heads/tp local
+            # heads (validate_tp_config guarantees divisibility), so the
+            # gate must check the LOCAL shape — the kernel re-validates
+            # per shard and would raise at serve time otherwise.
+            local_kvh = mcfg.kv_cache_heads // self._tp
+            if local_kvh <= 1 or (local_kvh * mcfg.page_size) % 32:
                 if self.cfg.use_pallas_decode:
                     logger.warning(
-                        "fp8 cache shape (kv_heads=%d, page_size=%d) "
-                        "cannot ride the quantized flash-decode kernel; "
+                        "fp8 cache shape (kv_heads=%d/tp=%d, page_size=%d)"
+                        " cannot ride the quantized flash-decode kernel; "
                         "using XLA attention",
-                        mcfg.kv_cache_heads, mcfg.page_size)
+                        mcfg.kv_cache_heads, self._tp, mcfg.page_size)
                 use_pallas = False
         # Hybrid: fused bursts run the grouped two-pool scan
         # (forward_decode_steps_hybrid) with freeze-and-reclaim SWA paging,

@@ -242,18 +242,6 @@ class TestGates:
                                     max_pages_per_seq=4,
                                     kv_cache_dtype="f8_e4m3"))
 
-    @pytest.mark.skipif(len(jax.devices()) < 2,
-                        reason="needs the 8-device virtual CPU mesh "
-                               "(tests/conftest.py)")
-    def test_mesh_sharded_refused(self):
-        from llmd_kv_cache_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh({"tp": 2}, jax.devices()[:2])
-        with pytest.raises(ValueError, match="mesh-sharded"):
-            MiniEngine(EngineConfig(num_pages=16, max_pages_per_seq=4,
-                                    kv_cache_dtype="f8_e4m3"),
-                       mesh=mesh)
-
     def test_spec_dtype_mismatch_refused(self, tmp_path):
         from llmd_kv_cache_tpu.offload import SharedStorageOffloadSpec
 
@@ -265,6 +253,120 @@ class TestGates:
         )  # dtype left at the bf16 default
         with pytest.raises(ValueError, match="dtype"):
             fp8_engine(offload_spec=spec)
+
+
+class TestMeshComposition:
+    """fp8 pools under mesh-sharded serving: the cast is elementwise and
+    the pools shard exactly like bf16 (kv-heads under tp, layers under
+    pp), so every mesh mode must serve token-identically to the
+    single-device fp8 engine."""
+
+    pytestmark = pytest.mark.skipif(
+        len(jax.devices()) < 8,
+        reason="needs the 8-device virtual CPU mesh (tests/conftest.py)",
+    )
+
+    def _mesh(self, axes):
+        from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+
+        n = 1
+        for v in axes.values():
+            n *= v
+        return make_mesh(axes, jax.devices()[:n])
+
+    def _gen(self, mesh=None, cfg=None, seed_params=None, **kw):
+        if cfg is not None:
+            kw["model"] = cfg
+        e = MiniEngine(EngineConfig(num_pages=64,
+                                    max_pages_per_seq=16,
+                                    kv_cache_dtype="f8_e4m3",
+                                    model_name="fp8-mesh",
+                                    pod_identifier="p", **kw),
+                       params=seed_params, mesh=mesh, seed=0)
+        prompt = np.random.default_rng(0).integers(1, 250, 24).tolist()
+        return e, e.generate("r", prompt, max_new_tokens=8)
+
+    _ref_tokens = None
+
+    def _ref(self):
+        # One single-device fp8 reference run shared by the mesh tests
+        # (deterministic: fixed seeds, same default config).
+        if TestMeshComposition._ref_tokens is None:
+            TestMeshComposition._ref_tokens = self._gen()[1]
+        return TestMeshComposition._ref_tokens
+
+    def test_tp_matches_single_device(self):
+        ref = self._ref()
+        tp_eng, out = self._gen(mesh=self._mesh({"tp": 2}))
+        assert out == ref
+        # The pool really is fp8 AND really sharded (a silently
+        # replicated pool would still match tokens).
+        assert tp_eng.k_cache.dtype == jnp.float8_e4m3fn
+        kvh = tp_eng.k_cache.shape[2]
+        assert tp_eng.k_cache.sharding.shard_shape(
+            tp_eng.k_cache.shape)[2] == kvh // 2
+
+    def test_tp_burst_and_dp_axis(self):
+        ref = self._ref()
+        _, burst = self._gen(mesh=self._mesh({"tp": 2}), decode_burst=4)
+        assert burst == ref
+        _, dptp = self._gen(mesh=self._mesh({"dp": 4, "tp": 2}))
+        assert dptp == ref
+
+    def test_pp_and_sp_meshes(self):
+        ref = self._ref()
+        _, pp = self._gen(mesh=self._mesh({"pp": 2}))
+        assert pp == ref
+        _, sp = self._gen(mesh=self._mesh({"sp": 2}))
+        assert sp == ref
+
+    def test_hybrid_tp(self):
+        from llmd_kv_cache_tpu.models.llama import init_params
+
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2, head_dim=16,
+                          intermediate_size=128, page_size=4,
+                          sliding_window=8, swa_layers=(1,))
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        _, ref = self._gen(cfg=cfg, seed_params=params)
+        _, out = self._gen(mesh=self._mesh({"tp": 2}), cfg=cfg,
+                           seed_params=params)
+        assert out == ref
+
+    def test_tp_quant_kernel_arm(self):
+        """The quantized flash-decode arm under tp shard_map: shapes
+        chosen so the PER-SHARD cache qualifies (kv_heads=4/tp=2 → local
+        2, 2*16=32 % 32 == 0, head_dim 128) — the engine gate must judge
+        the local shape (a global-shape gate would admit configs whose
+        shards then raise inside the kernel), and the interpret-mode
+        kernel must reproduce the XLA tokens over the same fp8 bytes."""
+        from llmd_kv_cache_tpu.models.llama import (forward_decode_pallas,
+                                                    init_params)
+
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=4, head_dim=128,
+                          intermediate_size=128, page_size=16)
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        mesh = self._mesh({"tp": 2})
+        outs = {}
+        for pallas in (False, True):
+            e, outs[pallas] = self._gen(mesh=mesh, cfg=cfg,
+                                        seed_params=params,
+                                        use_pallas_decode=pallas)
+            if pallas:
+                fwd = getattr(e._decode_forward, "func", e._decode_forward)
+                assert fwd is forward_decode_pallas, \
+                    "quant kernel arm did not engage under tp"
+        assert outs[True] == outs[False]
+
+        # kv_heads=4 / tp=4 → local kv_heads=1: the merged-heads quant
+        # arm is unavailable per shard, so the engine must FALL BACK to
+        # XLA (not crash in the kernel's per-shard validation).
+        e, out = self._gen(mesh=self._mesh({"tp": 4}), cfg=cfg,
+                           seed_params=params, use_pallas_decode=True)
+        fwd = getattr(e._decode_forward, "func", e._decode_forward)
+        assert fwd is not forward_decode_pallas
+        assert out == outs[False]
 
 
 class TestOffload:
