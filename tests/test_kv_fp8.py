@@ -399,6 +399,42 @@ class TestOffload:
         # resumed decode is bit-exact vs the engine that wrote them.
         assert list(req.output) == out_a
 
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs the 8-device virtual CPU mesh "
+                               "(tests/conftest.py)")
+    def test_fp8_store_restore_through_tp_engine(self, tmp_path):
+        """Write-through from a tp-sharded fp8 engine, restore into a
+        FRESH tp-sharded fp8 engine: the copier's gather reads the
+        kv-head-sharded 1-byte pool and the restore scatter must land the
+        same bytes back under the same sharding — resumed decode
+        bit-exact, pool still fp8 and still sharded."""
+        from llmd_kv_cache_tpu.parallel.mesh import make_mesh
+
+        prompt = list(range(70, 102))  # 2 pages
+
+        def build(pod):
+            return MiniEngine(EngineConfig(
+                num_pages=64, max_pages_per_seq=16,
+                kv_cache_dtype="f8_e4m3", model_name="tiny-fp8",
+                pod_identifier=pod),
+                offload_spec=self._spec(tmp_path), seed=0,
+                mesh=make_mesh({"tp": 2}, jax.devices()[:2]))
+
+        a = build("pod-a")
+        out_a = a.generate("r1", prompt, max_new_tokens=4)
+        a.flush_offload()
+
+        b = build("pod-b")
+        req = b.add_request("r2", prompt, max_new_tokens=4)
+        assert req.cached_len == len(prompt)  # restored, not recomputed
+        while not req.done:
+            b.step()
+        assert list(req.output) == out_a
+        assert b.k_cache.dtype == jnp.float8_e4m3fn
+        kvh = b.k_cache.shape[2]
+        assert b.k_cache.sharding.shard_shape(
+            b.k_cache.shape)[2] == kvh // 2
+
     def test_fingerprint_separates_fp8_from_bf16(self):
         from llmd_kv_cache_tpu.offload.file_mapper import (
             FileMapper, FileMapperConfig)
