@@ -10,6 +10,7 @@ to its offload store and the indexer's entries stay valid).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from dataclasses import asdict, fields
@@ -40,6 +41,10 @@ def save_engine_checkpoint(path: str, params: Params, model_cfg: LlamaConfig,
 
         params = unstack_layer_params(params)
     params = unfuse_params(params, model_cfg)
+    # The saved tree is canonical; the persisted config says so
+    # (fused_interleave is a runtime serving-layout knob set by tp
+    # engines, consumed by the unfuse above).
+    model_cfg = dataclasses.replace(model_cfg, fused_interleave=1)
     with ocp.StandardCheckpointer() as ckptr:
         # force=True: periodic re-checkpointing to a fixed path overwrites.
         ckptr.save(os.path.join(path, "params"), params, force=True)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import time
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
@@ -111,14 +112,19 @@ class EngineConfig:
     use_pallas_prefill: Optional[bool] = None
     # Fuse QKV (and gate+up, MLA input) projections into single wider
     # matmuls at startup (models.llama.fuse_params). None = auto: fused
-    # on single-shard engines whose shape profits (llama.fuse_profitable
-    # — measured v5e crossover: hidden 4096 gains ~7% prefill MFU,
-    # hidden 2048 loses ~8%; benchmarking/r5-tpu), unfused under a mesh
-    # (the fused column blocks shard non-uniformly across tp). When
-    # sharing one params tree across pods, pass it through
-    # llama.maybe_fuse_params FIRST (profit-gated; a no-op on a fused
-    # tree) — otherwise each engine materializes its own fused weight
-    # copy. Checkpoints store the canonical unfused layout either way
+    # wherever the shape profits (llama.fuse_profitable — measured v5e
+    # crossover: hidden 4096 gains ~7% prefill MFU, hidden 2048 loses
+    # ~8%; benchmarking/r5-tpu). Under a tp mesh the engine fuses in
+    # the per-rank INTERLEAVED column order (LlamaConfig.fused_interleave
+    # = tp) so the fused leaves stay Megatron-column-shardable; auto
+    # additionally requires the projection widths to divide tp and
+    # skips MLA-under-mesh and pp serving (those stay unfused; explicit
+    # True raises there). When sharing one params tree across
+    # single-shard pods, pass it through llama.maybe_fuse_params FIRST
+    # (profit-gated; a no-op on a fused tree) — otherwise each engine
+    # materializes its own fused weight copy; a tp engine re-layouts a
+    # pre-fused canonical tree into its interleaved order itself.
+    # Checkpoints store the canonical unfused layout either way
     # (models.checkpoint unfuses on save).
     fuse_projections: Optional[bool] = None
     # Paged KV pool element type: None (default — the model's dtype),
@@ -562,17 +568,51 @@ class MiniEngine:
                 mcfg, self.cfg.num_pages, dtype=kv_dtype)
 
         fuse = self.cfg.fuse_projections
+        # Fusion composes with tp/dp/sp meshes via the per-rank
+        # interleaved column layout (fused_interleave = tp below). Two
+        # mesh modes stay unfused: MLA (the fused input block mixes
+        # head-sharded and replicated columns — no uniform interleave
+        # shards that) and pp (the stacked-layer pspec derivation only
+        # covers the canonical layout).
+        fuse_mesh_blocked = mesh is not None and (mcfg.is_mla
+                                                  or self._pp > 1)
         if fuse is None:
             from .llama import fuse_profitable
 
-            fuse = mesh is None and fuse_profitable(mcfg)
-        if fuse and mesh is not None:
+            # Width-divisibility for the interleave needs no extra gate
+            # here: validate_tp_config (above) already requires every
+            # projection width to divide tp — the unfused Megatron
+            # shards have the identical constraint.
+            fuse = fuse_profitable(mcfg) and not fuse_mesh_blocked
+        if fuse and fuse_mesh_blocked:
             raise ValueError(
-                "fuse_projections=True is incompatible with a mesh: fused "
-                "column blocks shard non-uniformly across tp")
+                "fuse_projections=True is incompatible with "
+                + ("MLA under a mesh (head-sharded and replicated "
+                   "columns cannot interleave uniformly)"
+                   if mcfg.is_mla else
+                   "pp serving (stacked layers keep the canonical "
+                   "layout)"))
         if fuse:
-            from .llama import fuse_params
+            from .llama import fuse_params, unfuse_params
 
+            if self._tp > 1:
+                # Interleave the fused columns per tp rank so the
+                # Megatron uniform column split hands each shard its
+                # local fused block; the forward's split sites consult
+                # cfg.fused_interleave (checkpoint save canonicalizes
+                # back to the unfused layout). A COPY of the engine
+                # config carries it — the caller's object is not
+                # mutated.
+                if "w_qkv" in self.params["layers"][0]:
+                    # A shared pre-fused tree (maybe_fuse_params) is in
+                    # CANONICAL column order; re-layout it into this
+                    # engine's interleaved order (fuse_params below is
+                    # a no-op on fused keys and would leave the split
+                    # sites silently permuting q/k/v).
+                    self.params = unfuse_params(self.params, mcfg)
+                mcfg = dataclasses.replace(mcfg,
+                                           fused_interleave=self._tp)
+                self.cfg = dataclasses.replace(self.cfg, model=mcfg)
             self.params = fuse_params(self.params, mcfg)
 
         if mesh is not None and self._pp > 1:

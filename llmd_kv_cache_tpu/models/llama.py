@@ -100,6 +100,16 @@ class LlamaConfig:
     # them (half the VMEM, but measured 2x slower at b8/ctx4k on v5e —
     # benchmarking/r5-tpu --mla probe). Pallas decode path only.
     mla_decode_stream: str = "copy"
+    # Fused-projection column layout (serving-time, set by the engine —
+    # not a checkpoint property; save canonicalizes it back to 1). 1 =
+    # canonical [q|k|v] / [gate|up] column order. t > 1 = per-rank
+    # interleaved order [q_0|k_0|v_0 | q_1|k_1|v_1 | ...] where part_i
+    # is rank i's contiguous column slice: a uniform tp split of the
+    # fused axis then hands every shard exactly its own fused block, so
+    # fused projections compose with Megatron column sharding (the
+    # canonical order cannot — uniform chunks straddle the q/k/v
+    # boundaries). The forward's split sites consult this.
+    fused_interleave: int = 1
     # RoPE scaling: () = plain RoPE; ("llama3", factor, low_freq_factor,
     # high_freq_factor, original_max_position_embeddings) — Llama-3.1's
     # frequency-band NTK scheme; or ("yarn", factor, beta_fast, beta_slow,
@@ -187,6 +197,14 @@ class LlamaConfig:
                 raise ValueError("latent_pad only applies to MLA configs")
             if self.latent_pad < 0:
                 raise ValueError("latent_pad must be >= 0")
+        if self.fused_interleave < 1:
+            raise ValueError("fused_interleave must be >= 1")
+        if self.fused_interleave > 1 and self.is_mla:
+            # The MLA fused block mixes head-sharded (wq/w_dq) and
+            # replicated (w_dkv/w_kr) columns — no uniform interleave
+            # makes that shardable; MLA serves unfused under tp.
+            raise ValueError(
+                "fused_interleave > 1 is not supported for MLA configs")
         if self.attention_sinks:
             if self.sliding_window is None:
                 raise ValueError("attention_sinks requires sliding_window")
@@ -391,6 +409,79 @@ def _init_params_jit(key: jax.Array, cfg: LlamaConfig) -> Params:
     }
 
 
+def _interleave_concat(parts: list, t: int, axis: int = 1) -> jax.Array:
+    """Concatenate projection blocks in per-rank interleaved column order.
+
+    t == 1 reproduces the canonical order. For t > 1 every part's fused
+    axis must divide by t (the engine only requests an interleave the tp
+    validation already guarantees); rank i's slice of each part lands
+    contiguously, so a uniform t-way split of the result gives rank i
+    exactly ``[part0_i | part1_i | ...]`` — its local fused block."""
+    if t == 1:
+        return jnp.concatenate(parts, axis=axis)
+    for p in parts:
+        if p.shape[axis] % t:
+            raise ValueError(
+                f"fused_interleave={t} does not divide projection width "
+                f"{p.shape[axis]}")
+    chunks = []
+    for i in range(t):
+        for p in parts:
+            n = p.shape[axis] // t
+            chunks.append(
+                jax.lax.slice_in_dim(p, i * n, (i + 1) * n, axis=axis))
+    return jnp.concatenate(chunks, axis=axis)
+
+
+def _deinterleave_split(w: jax.Array, widths: tuple, t: int,
+                        axis: int = 1) -> list:
+    """Inverse of :func:`_interleave_concat`: recover the canonical
+    per-projection blocks from a (possibly interleaved) fused array."""
+    if t == 1:
+        outs, off = [], 0
+        for n in widths:
+            outs.append(jax.lax.slice_in_dim(w, off, off + n, axis=axis))
+            off += n
+        return outs
+    blk = sum(widths) // t
+    ranks = [jax.lax.slice_in_dim(w, i * blk, (i + 1) * blk, axis=axis)
+             for i in range(t)]
+    outs = []
+    off = 0
+    for n in widths:
+        outs.append(jnp.concatenate(
+            [jax.lax.slice_in_dim(r, off, off + n // t, axis=axis)
+             for r in ranks], axis=axis))
+        off += n // t
+    return outs
+
+
+def split_fused_out(y: jax.Array, widths: tuple, t: int) -> list:
+    """Split a fused projection's OUTPUT activations back into the
+    per-projection tensors, honoring the interleaved layout.
+
+    For t == 1 these are the canonical static slices. For t > 1 the
+    last dim is reshaped ``[t, blk]`` (a shard-boundary split under the
+    Megatron column sharding, so GSPMD keeps it local), each part's
+    per-rank columns sliced, and the rank axis merged back — rank-major
+    order IS canonical order, since rank i's slice was the i-th
+    contiguous chunk of the canonical projection."""
+    if t == 1:
+        outs, off = [], 0
+        for n in widths:
+            outs.append(y[..., off:off + n])
+            off += n
+        return outs
+    blk = sum(widths) // t
+    yb = y.reshape(*y.shape[:-1], t, blk)
+    outs, off = [], 0
+    for n in widths:
+        part = yb[..., off:off + n // t]
+        outs.append(part.reshape(*y.shape[:-1], n))
+        off += n // t
+    return outs
+
+
 def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
     """Fuse per-layer projections that share an input into wider matmuls.
 
@@ -413,31 +504,38 @@ def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
     - DeepSeek shared experts: ``w_gate_sh/w_up_sh`` → ``w_gate_up_sh``
 
     Originals are dropped (no weight memory doubling). The forward
-    accepts both layouts. TP-sharded serving keeps the unfused layout:
-    the fused column blocks (q vs kv heads, gate vs up) would shard
-    non-uniformly across the tp axis.
+    accepts both layouts. TP-sharded serving fuses in the per-rank
+    INTERLEAVED column order (``cfg.fused_interleave`` = tp, set by the
+    engine): the canonical column order cannot shard uniformly across
+    tp (chunks would straddle the q/k/v and gate/up boundaries), but
+    interleaving each rank's slices makes the uniform Megatron column
+    split hand every shard exactly its local fused block. MLA keeps the
+    canonical order only (``fused_interleave > 1`` is refused by the
+    config: its fused block mixes head-sharded and replicated columns).
     """
+    t = cfg.fused_interleave
     out = dict(params)
     fused_layers = []
     for layer in params["layers"]:
         lyr = dict(layer)
         if "wk" in lyr:  # standard / GQA attention
-            lyr["w_qkv"] = jnp.concatenate(
-                [lyr.pop("wq"), lyr.pop("wk"), lyr.pop("wv")], axis=1)
+            lyr["w_qkv"] = _interleave_concat(
+                [lyr.pop("wq"), lyr.pop("wk"), lyr.pop("wv")], t)
             if "bq" in lyr:
-                lyr["b_qkv"] = jnp.concatenate(
-                    [lyr.pop("bq"), lyr.pop("bk"), lyr.pop("bv")])
-        elif "w_dkv" in lyr:  # absorbed MLA
+                lyr["b_qkv"] = _interleave_concat(
+                    [lyr.pop("bq"), lyr.pop("bk"), lyr.pop("bv")], t,
+                    axis=0)
+        elif "w_dkv" in lyr:  # absorbed MLA (canonical order; t == 1)
             head_in = (lyr.pop("w_dq") if "w_dq" in lyr
                        else lyr.pop("wq"))
             lyr["w_mla_in"] = jnp.concatenate(
                 [head_in, lyr.pop("w_dkv"), lyr.pop("w_kr")], axis=1)
         if "w_gate" in lyr and lyr["w_gate"].ndim == 2:  # dense SwiGLU
-            lyr["w_gate_up"] = jnp.concatenate(
-                [lyr.pop("w_gate"), lyr.pop("w_up")], axis=1)
+            lyr["w_gate_up"] = _interleave_concat(
+                [lyr.pop("w_gate"), lyr.pop("w_up")], t)
         if "w_gate_sh" in lyr:
-            lyr["w_gate_up_sh"] = jnp.concatenate(
-                [lyr.pop("w_gate_sh"), lyr.pop("w_up_sh")], axis=1)
+            lyr["w_gate_up_sh"] = _interleave_concat(
+                [lyr.pop("w_gate_sh"), lyr.pop("w_up_sh")], t)
         fused_layers.append(lyr)
     out["layers"] = fused_layers
     return out
@@ -470,6 +568,7 @@ def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
     canonical layout (portable across fused/unfused engines, TP sharding,
     and the trainer); a fused serving tree is unfused on save. No-op on
     an already-canonical tree."""
+    t = cfg.fused_interleave
     out = dict(params)
     layers = []
     for layer in params["layers"]:
@@ -478,13 +577,14 @@ def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
             nq = cfg.num_heads * cfg.head_dim
             nk = cfg.num_kv_heads * cfg.head_dim
             w = lyr.pop("w_qkv")
-            lyr["wq"], lyr["wk"], lyr["wv"] = (
-                w[:, :nq], w[:, nq:nq + nk], w[:, nq + nk:])
+            nv = w.shape[1] - nq - nk
+            lyr["wq"], lyr["wk"], lyr["wv"] = _deinterleave_split(
+                w, (nq, nk, nv), t)
             if "b_qkv" in lyr:
                 b = lyr.pop("b_qkv")
-                lyr["bq"], lyr["bk"], lyr["bv"] = (
-                    b[:nq], b[nq:nq + nk], b[nq + nk:])
-        if "w_mla_in" in lyr:
+                lyr["bq"], lyr["bk"], lyr["bv"] = _deinterleave_split(
+                    b, (nq, nk, nv), t, axis=0)
+        if "w_mla_in" in lyr:  # canonical order only (t == 1)
             r, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
             w = lyr.pop("w_mla_in")
             qc = w.shape[1] - r - dr
@@ -495,11 +595,13 @@ def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
         if "w_gate_up" in lyr:
             w = lyr.pop("w_gate_up")
             inter = w.shape[1] // 2
-            lyr["w_gate"], lyr["w_up"] = w[:, :inter], w[:, inter:]
+            lyr["w_gate"], lyr["w_up"] = _deinterleave_split(
+                w, (inter, inter), t)
         if "w_gate_up_sh" in lyr:
             w = lyr.pop("w_gate_up_sh")
             sh = w.shape[1] // 2
-            lyr["w_gate_sh"], lyr["w_up_sh"] = w[:, :sh], w[:, sh:]
+            lyr["w_gate_sh"], lyr["w_up_sh"] = _deinterleave_split(
+                w, (sh, sh), t)
         layers.append(lyr)
     out["layers"] = layers
     return out
@@ -698,8 +800,9 @@ def _moe_deepseek(mlp_in, layer, cfg):
     if "w_gate_up_sh" in layer:  # fused serving layout (fuse_params)
         sh_gu = (x @ layer["w_gate_up_sh"]).astype(jnp.float32)
         sh_i = sh_gu.shape[-1] // 2
-        sh_gate = jax.nn.silu(sh_gu[..., :sh_i])
-        sh_up = sh_gu[..., sh_i:]
+        sh_gate_out, sh_up = split_fused_out(sh_gu, (sh_i, sh_i),
+                                             cfg.fused_interleave)
+        sh_gate = jax.nn.silu(sh_gate_out)
     else:
         sh_gate = jax.nn.silu((x @ layer["w_gate_sh"]).astype(jnp.float32))
         sh_up = (x @ layer["w_up_sh"]).astype(jnp.float32)
@@ -731,8 +834,9 @@ def _mlp(mlp_in: jax.Array, layer: dict, cfg: "LlamaConfig",
     if "w_gate_up" in layer:  # fused serving layout (fuse_params)
         gu = (mlp_in @ layer["w_gate_up"]).astype(jnp.float32)
         inter = gu.shape[-1] // 2
-        gate = jax.nn.silu(gu[..., :inter])
-        up = gu[..., inter:]
+        gate_out, up = split_fused_out(gu, (inter, inter),
+                                       cfg.fused_interleave)
+        gate = jax.nn.silu(gate_out)
     else:
         gate = jax.nn.silu((mlp_in @ layer["w_gate"]).astype(jnp.float32))
         up = (mlp_in @ layer["w_up"]).astype(jnp.float32)
@@ -962,9 +1066,9 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                     qkv = qkv + layer["b_qkv"]
                 nq = cfg.num_heads * cfg.head_dim
                 nk = cfg.num_kv_heads * cfg.head_dim
-                q = qkv[..., :nq]
-                k = qkv[..., nq:nq + nk]
-                v = qkv[..., nq + nk:]
+                nv = qkv.shape[-1] - nq - nk
+                q, k, v = split_fused_out(qkv, (nq, nk, nv),
+                                          cfg.fused_interleave)
             else:
                 q = attn_in @ layer["wq"]
                 k = attn_in @ layer["wk"]

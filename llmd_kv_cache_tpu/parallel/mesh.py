@@ -48,7 +48,8 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
                  mla_layer: bool = False, qkv_bias: bool = False,
                  latent_norm: bool = False, q_lora: bool = False,
                  shared_expert: bool = False,
-                 router_bias: bool = False) -> dict:
+                 router_bias: bool = False,
+                 fused: bool = False) -> dict:
     """PartitionSpecs for one Llama layer family.
 
     Column-parallel QKV/gate/up (output features over ``tp``),
@@ -86,6 +87,16 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
             layer["latent_norm"] = P()
         if q_lora:  # DeepSeek q-LoRA: compressed-q path, replicated
             layer.update({"w_dq": P(), "q_latent_norm": P()})
+    elif fused:
+        # Fused serving layout (llama.fuse_params with the per-rank
+        # interleaved column order, fused_interleave = tp): one fused
+        # leaf replaces the three projections; a uniform column split
+        # hands each shard its local [q_i|k_i|v_i] block, so the fused
+        # leaf shards column-parallel exactly like its parts did.
+        del layer["wq"]
+        layer["w_qkv"] = P(None, tp)
+        if qkv_bias:
+            layer["b_qkv"] = P(tp)
     else:
         layer.update({"wk": P(None, tp), "wv": P(None, tp)})
         if qkv_bias:  # column-parallel bias shards with its output dim
@@ -102,11 +113,22 @@ def param_pspecs(has_tp: bool = True, has_ep: bool = False,
         if router_bias:  # DeepSeek e_score_correction: replicated vector
             layer["router_bias"] = P()
         if shared_expert:  # always-on shared expert: dense Megatron layout
-            layer.update({
-                "w_gate_sh": P(None, tp),
-                "w_up_sh": P(None, tp),
-                "w_down_sh": P(tp, None),
-            })
+            if fused:
+                layer.update({
+                    "w_gate_up_sh": P(None, tp),
+                    "w_down_sh": P(tp, None),
+                })
+            else:
+                layer.update({
+                    "w_gate_sh": P(None, tp),
+                    "w_up_sh": P(None, tp),
+                    "w_down_sh": P(tp, None),
+                })
+    elif fused:
+        layer.update({
+            "w_gate_up": P(None, tp),
+            "w_down": P(tp, None),
+        })
     else:
         layer.update({
             "w_gate": P(None, tp),
@@ -129,11 +151,12 @@ def _layer_flags(layer: dict) -> dict:
         moe_layer="router" in layer,
         qk_norm="q_norm" in layer,
         mla_layer="w_uk" in layer,
-        qkv_bias="bq" in layer,
+        qkv_bias="bq" in layer or "b_qkv" in layer,
         latent_norm="latent_norm" in layer,
         q_lora="w_dq" in layer,
-        shared_expert="w_gate_sh" in layer,
+        shared_expert="w_gate_sh" in layer or "w_gate_up_sh" in layer,
         router_bias="router_bias" in layer,
+        fused="w_qkv" in layer,
     )
 
 

@@ -57,6 +57,24 @@ def validate_tp_config(cfg: LlamaConfig, mesh: Mesh) -> None:
         raise ValueError(
             f"num_experts ({cfg.num_experts}) must divide by the ep "
             f"axis ({ep})")
+    # Width divisibility: the Megatron column/row splits place exact
+    # uniform shards (jax.device_put refuses uneven NamedShardings with
+    # a cryptic late error), so surface the constraint here. The fused
+    # interleaved layout needs the same divisibility — no extra
+    # constraint beyond the unfused one.
+    if tp > 1:
+        widths = {"intermediate_size": cfg.intermediate_size}
+        if cfg.moe_intermediate_size:
+            widths["moe_intermediate_size"] = cfg.moe_intermediate_size
+        if not cfg.is_mla:
+            widths["num_heads*head_dim"] = cfg.num_heads * cfg.head_dim
+            widths["num_kv_heads*head_dim"] = (
+                cfg.num_kv_heads * cfg.head_dim)
+        for name, width in widths.items():
+            if width % tp:
+                raise ValueError(
+                    f"{name} ({width}) must divide by the tp axis "
+                    f"({tp}): Megatron shards are uniform")
 
 
 def shard_engine_params(mesh: Mesh, params: Params) -> Params:
