@@ -416,3 +416,30 @@ class TestInterleavedTP:
                                     model_name="nondiv",
                                     pod_identifier="p"),
                        mesh=self._mesh({"tp": 8}), seed=0)
+
+    def test_fused_tp_composes_with_fp8_cache(self):
+        """Weights-side fusion and cache-side fp8 are orthogonal; the
+        triple (fused interleave + fp8 pool + tp mesh) is the realistic
+        wide-model deployment and must match the unfused single-device
+        fp8 engine token-for-token."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        prompt = np.random.default_rng(0).integers(1, 250, 24).tolist()
+
+        def gen(mesh=None, fuse=None):
+            e = MiniEngine(EngineConfig(model=cfg, num_pages=64,
+                                        max_pages_per_seq=16,
+                                        fuse_projections=fuse,
+                                        kv_cache_dtype="f8_e4m3",
+                                        model_name="fuse-fp8",
+                                        pod_identifier="p"),
+                           params=params, mesh=mesh, seed=0)
+            return e, e.generate("r", prompt, max_new_tokens=8)
+
+        _, ref = gen()
+        e, out = gen(mesh=self._mesh({"tp": 2}), fuse=True)
+        assert out == ref
+        assert e.k_cache.dtype == jnp.float8_e4m3fn
+        assert "w_qkv" in e.params["layers"][0]
