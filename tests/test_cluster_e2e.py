@@ -60,7 +60,11 @@ def start_pod(pod_id, control, store):
     ])
 
 
-def serve_on(control, pod_id, name, prompt, timeout=30.0):
+def serve_on(control, pod_id, name, prompt, timeout=90.0):
+    # 90 s: a pod's FIRST serve includes its prefill jit compile, which
+    # under full-suite CPU contention (3 engine pods + indexer + evictor
+    # as OS processes) has been observed to exceed 30 s; wait_until
+    # returns the moment the reply lands, so the slack is free.
     req = control / f"{pod_id}.{name}.req.json"
     out = control / f"{pod_id}.{name}.out.json"
     req.write_text(json.dumps({
