@@ -443,3 +443,27 @@ class TestInterleavedTP:
         assert out == ref
         assert e.k_cache.dtype == jnp.float8_e4m3fn
         assert "w_qkv" in e.params["layers"][0]
+
+    def test_fused_tp_composes_with_sp_prefill(self):
+        """Sequence-parallel prefill shards the chunk tokens; the fused
+        interleaved matmul consumes the sharded activations like the
+        unfused ones (same contraction dim) — tp x sp fused must match
+        single-device."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        cfg = LlamaConfig.tiny()
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        prompt = np.random.default_rng(0).integers(1, 250, 24).tolist()
+
+        def gen(mesh=None, fuse=None):
+            e = MiniEngine(EngineConfig(model=cfg, num_pages=64,
+                                        max_pages_per_seq=16,
+                                        fuse_projections=fuse,
+                                        model_name="fuse-sp",
+                                        pod_identifier="p"),
+                           params=params, mesh=mesh, seed=0)
+            return e.generate("r", prompt, max_new_tokens=8)
+
+        ref = gen()
+        out = gen(mesh=self._mesh({"tp": 2, "sp": 2}), fuse=True)
+        assert out == ref
