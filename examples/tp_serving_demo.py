@@ -56,6 +56,16 @@ def main() -> None:
         engine(cfg, params, mesh=mesh).k_cache.addressable_shards))
     print(f"KV pool shard shape (kv-heads axis halved): {shard.data.shape}")
 
+    # 1b) Fused projections under tp: the engine re-layouts the fused
+    #     columns per rank (LlamaConfig.fused_interleave) so the wider
+    #     matmuls stay Megatron-column-shardable — same tokens again.
+    fused_eng = engine(cfg, params, mesh=mesh, fuse_projections=True)
+    fused = fused_eng.generate("r", prompt, max_new_tokens=8)
+    w = fused_eng.params["layers"][0]["w_qkv"]
+    print(f"fused tp=2 tokens:    {fused}  "
+          f"(w_qkv {w.shape} sharded {w.sharding.shard_shape(w.shape)})")
+    assert fused == ref
+
     # 2) Continuous batching: a long enqueue()d prompt prefills in chunks
     #    while a short request keeps decoding.
     eng = engine(cfg, params, max_prefill_tokens=8)
