@@ -467,3 +467,35 @@ class TestInterleavedTP:
         ref = gen()
         out = gen(mesh=self._mesh({"tp": 2, "sp": 2}), fuse=True)
         assert out == ref
+
+    def test_fused_and_fp8_compose_with_ep_moe(self):
+        """Expert-parallel MoE serving with fused attention (experts
+        stay 3-D unfused; only w_qkv/w_gate_up_sh fuse) and an fp8 pool:
+        both must match the single-device engine."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+
+        cfg = LlamaConfig.mixtral_tiny()
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        prompt = np.random.default_rng(0).integers(1, 250, 24).tolist()
+
+        def gen(mesh=None, fuse=None, dtype=None):
+            e = MiniEngine(EngineConfig(model=cfg, num_pages=64,
+                                        max_pages_per_seq=16,
+                                        fuse_projections=fuse,
+                                        kv_cache_dtype=dtype,
+                                        model_name="ep-moe",
+                                        pod_identifier="p"),
+                           params=params, mesh=mesh, seed=0)
+            return e, e.generate("r", prompt, max_new_tokens=8)
+
+        _, ref = gen()
+        ep = self._mesh({"ep": 2})
+        e, out = gen(mesh=ep, fuse=True)
+        assert out == ref
+        assert "w_qkv" in e.params["layers"][0]
+        assert e.params["layers"][0]["w_gate"].ndim == 3  # experts unfused
+        _, ref8 = gen(dtype="f8_e4m3")
+        _, out8 = gen(mesh=ep, dtype="f8_e4m3")
+        assert out8 == ref8
+        _, eptp = gen(mesh=self._mesh({"ep": 2, "tp": 2}), fuse=True)
+        assert eptp == ref
