@@ -190,6 +190,32 @@ class TestMLAEngine:
         assert next(iter(eng.k_cache.addressable_shards)).data.shape == \
             eng.k_cache.shape
 
+    def test_dp_and_sp_meshes_token_identical(self):
+        """MLA under dp (replicated) and sp (sequence-sharded prefill)
+        meshes: the absorbed forward is token-parallel, so both must
+        match single-device token-for-token (the architecture doc's
+        composition matrix cites this test)."""
+        import pytest
+
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("needs >= 4 devices")
+        from jax.sharding import Mesh
+
+        prompt = np.random.default_rng(0).integers(1, 250, 24).tolist()
+
+        def gen(mesh=None):
+            return MiniEngine(
+                EngineConfig(model=CFG, num_pages=64, max_pages_per_seq=16,
+                             model_name="ds", pod_identifier="p"),
+                seed=0, mesh=mesh).generate("r", prompt, max_new_tokens=8)
+
+        ref = gen()
+        assert gen(Mesh(np.array(devs[:2]), ("dp",))) == ref
+        assert gen(Mesh(np.array(devs[:2]), ("sp",))) == ref
+        assert gen(Mesh(np.array(devs[:4]).reshape(2, 2),
+                        ("dp", "sp"))) == ref
+
 
 class TestMLAOffload:
     def test_misdeclared_spec_rejected(self, tmp_path):
