@@ -245,3 +245,34 @@ def test_ep_serve_moe_matches_single_device():
     assert got_ep == ref
     got_ep_tp = run(make_mesh({"ep": 2, "tp": 2}, jax.devices()[:4]))
     assert got_ep_tp == ref
+
+
+def test_decode_burst_under_sp_and_ep_meshes(setup):
+    """Fused decode bursts under sp (prefill-sharding only; decode is
+    seq=1) and ep (MoE-less model: axis present but unused) meshes —
+    the architecture doc's composition matrix cites this test."""
+    cfg, params = setup
+    prompt = np.random.default_rng(5).integers(1, 250, 16).tolist()
+    ref = _engine(cfg, params, decode_burst=4).generate(
+        "r", prompt, max_new_tokens=8)
+    for axis in ("sp", "ep"):
+        mesh = make_mesh({axis: 2}, jax.devices()[:2])
+        out = _engine(cfg, params, mesh=mesh, decode_burst=4).generate(
+            "r", prompt, max_new_tokens=8)
+        assert out == ref, axis
+
+
+def test_decode_burst_under_ep_moe(setup):
+    """Bursts through a REAL expert-parallel MoE engine (experts
+    sharded over ep) match single-device."""
+    from llmd_kv_cache_tpu.models.llama import init_params as _init
+
+    cfg = LlamaConfig.mixtral_tiny()
+    params = _init(jax.random.PRNGKey(7), cfg)
+    prompt = np.random.default_rng(6).integers(1, 250, 16).tolist()
+    ref = _engine(cfg, params, decode_burst=4).generate(
+        "r", prompt, max_new_tokens=8)
+    mesh = make_mesh({"ep": 2}, jax.devices()[:2])
+    out = _engine(cfg, params, mesh=mesh, decode_burst=4).generate(
+        "r", prompt, max_new_tokens=8)
+    assert out == ref
