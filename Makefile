@@ -9,10 +9,20 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench graft-check verify-examples lint clean
+.PHONY: test unit-test-race tsan native bench graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+# Fault-injection suite (resilience layer): fixed failpoint seed so a
+# chaos failure reproduces byte-for-byte on a rerun.
+chaos: native
+	$(CPU_ENV) KVTPU_FAILPOINT_SEED=1337 $(PY) -m pytest tests/ -q -m chaos
+
+# Resilience lint: no bare `except:` / silently-swallowed exceptions in
+# the library (hack/lint_resilience.py).
+lint:
+	$(PY) hack/lint_resilience.py llmd_kv_cache_tpu
 
 # Concurrency-focused pass (the reference runs `go test -race` nightly;
 # Python has no race detector, so the thread-heavy suites are repeated —

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Resilience lint: forbid silently-dropped errors in the library.
+
+Two AST checks over every ``.py`` file under the given roots (default
+``llmd_kv_cache_tpu``):
+
+1. **bare except** — ``except:`` catches ``KeyboardInterrupt`` and
+   ``SystemExit`` too; name the exception.
+2. **swallowed exception** — a handler whose body is only ``pass``/``...``
+   silently erases the failure. Either handle it, log it, or re-raise.
+
+A handler that is intentionally fire-and-forget (e.g. best-effort cleanup
+in a ``__del__``) may carry the explicit marker comment
+
+    except Exception:  # lint: allow-swallow (why)
+
+on the ``except`` line; the marker documents the decision where the next
+reader will look for it.
+
+Exit status 1 when any violation is found (CI-friendly; see Makefile
+``lint`` target).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ALLOW_MARKER = "lint: allow-swallow"
+
+
+def _is_swallow(handler: ast.ExceptHandler) -> bool:
+    """Body is nothing but ``pass`` / ``...`` — the exception vanishes."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring or `...`
+        return False
+    return True
+
+
+def lint_file(path: Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if node.type is None:
+            problems.append(
+                f"{path}:{node.lineno}: bare `except:` — name the "
+                "exception (bare except also catches KeyboardInterrupt)"
+            )
+            continue
+        if _is_swallow(node) and ALLOW_MARKER not in line:
+            problems.append(
+                f"{path}:{node.lineno}: swallowed exception — handle, "
+                f"log, or re-raise (or mark `# {ALLOW_MARKER} (why)`)"
+            )
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
+    problems: list[str] = []
+    n_files = 0
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            n_files += 1
+            problems.extend(lint_file(f))
+    for p in problems:
+        print(p)
+    print(
+        f"lint_resilience: {n_files} file(s), {len(problems)} problem(s)",
+        file=sys.stderr,
+    )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -26,6 +26,7 @@ from ..core.hma import GroupCatalog, GroupMetadata
 from ..core.keys import EMPTY_BLOCK_HASH, TIER_TPU_HBM, BlockHash, KeyType, PodEntry
 from ..core.token_processor import ChunkedTokenDatabase
 from ..index.base import Index
+from ..resilience.liveness import PodLivenessTracker
 from ..utils.fnv import fnv1a_32
 from ..utils.logging import get_logger
 from .adapters import create_adapter
@@ -69,6 +70,11 @@ class PoolConfig:
     # identifiers become "<pod>|dp<rank>" for events tagged with a
     # data-parallel rank, so routing can target a specific rank.
     track_dp_rank: bool = False
+    # Pod-liveness degradation (resilience.liveness): a pod whose last
+    # event is older than liveness_stale_after_s starts losing score
+    # weight, reaching zero at liveness_drop_after_s. 0 disables tracking.
+    liveness_stale_after_s: float = 30.0
+    liveness_drop_after_s: float = 120.0
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PoolConfig":
@@ -81,6 +87,14 @@ class PoolConfig:
             engine_type=d.get("engineType", d.get("engine_type", "vllm")) or "vllm",
             discover_pods=d.get("discoverPods", d.get("discover_pods", False)),
             track_dp_rank=d.get("trackDPRank", d.get("track_dp_rank", False)),
+            liveness_stale_after_s=d.get(
+                "livenessStaleAfterSeconds",
+                d.get("liveness_stale_after_s", 30.0),
+            ),
+            liveness_drop_after_s=d.get(
+                "livenessDropAfterSeconds",
+                d.get("liveness_drop_after_s", 120.0),
+            ),
         )
         pdc = d.get("podDiscoveryConfig", d.get("pod_discovery_config"))
         if pdc:
@@ -114,6 +128,15 @@ class Pool:
         self.token_processor = token_processor
         self.adapter = adapter if adapter is not None else create_adapter(self.cfg.engine_type)
         self.group_catalog = GroupCatalog()
+        # Per-pod last-event tracking; scorers attached to this pool (via
+        # Indexer.attach_liveness) demote pods whose index view went stale.
+        self.liveness: Optional[PodLivenessTracker] = None
+        if self.cfg.liveness_stale_after_s > 0:
+            self.liveness = PodLivenessTracker(
+                stale_after_s=self.cfg.liveness_stale_after_s,
+                drop_after_s=max(self.cfg.liveness_drop_after_s,
+                                 self.cfg.liveness_stale_after_s * 2),
+            )
         self._queues: list[queue.Queue] = [
             queue.Queue() for _ in range(self.cfg.concurrency)
         ]
@@ -196,6 +219,12 @@ class Pool:
             and batch.data_parallel_rank >= 0
         ):
             pod_identifier = f"{pod_identifier}|dp{batch.data_parallel_rank}"
+
+        # Any event from a pod proves its publisher (and thus our view of
+        # it) is alive; touch AFTER dp-rank suffixing so routing-visible
+        # identifiers are the ones tracked.
+        if self.liveness is not None:
+            self.liveness.touch(pod_identifier)
 
         for event in batch.events:
             if isinstance(event, BlockStoredEvent):

@@ -8,9 +8,13 @@ three frames ``[topic, 8-byte big-endian sequence, msgpack payload]``
   connects its PUB to it
 - **pod-discovery**: one subscriber per pod *dials* the pod's PUB endpoint
 
-Crash-only: an outer retry loop re-establishes the socket every 5 s forever
-(``zmq_subscriber.go:54-76``); a dead pod's subscriber just keeps retrying
-until the reconciler removes it.
+Crash-only: an outer retry loop re-establishes the socket forever; a dead
+pod's subscriber just keeps retrying until the reconciler removes it. The
+reference retries on a fixed 5 s cadence (``zmq_subscriber.go:54-76``);
+here the delay is jittered exponential (fast first reconnect after a
+transient blip, capped for a truly dead peer, reset after a successful
+receive) so a restarted fleet neither hammers a recovering indexer nor
+waits 5 s to heal a 50 ms hiccup.
 """
 
 from __future__ import annotations
@@ -21,13 +25,28 @@ from typing import Callable, Optional
 
 import zmq
 
+from ..resilience.failpoints import failpoints
+from ..resilience.policy import RetryPolicy
 from ..utils.logging import get_logger
 from .model import RawMessage
 
 logger = get_logger("events.zmq")
 
+# Backoff cap; kept as the historical name — stop() joins against it and
+# external tooling references it as the worst-case reconnect cadence.
 RETRY_INTERVAL_S = 5.0
 _POLL_INTERVAL_MS = 200
+
+# Error-mode fires inside the subscriber loop right after the socket is
+# established, forcing a teardown/reconnect cycle (chaos: flapping peer).
+FP_ZMQ_CONNECT = "events.zmq.connect"
+
+# max_attempts is a per-call concept; the subscriber loop retries forever
+# and only uses delay(attempt) with the attempt counter it maintains.
+DEFAULT_RECONNECT_POLICY = RetryPolicy(
+    max_attempts=1, base_delay_s=0.25, max_delay_s=RETRY_INTERVAL_S,
+    multiplier=2.0, jitter=True,
+)
 
 
 class ZMQSubscriber:
@@ -40,14 +59,22 @@ class ZMQSubscriber:
         on_message: Callable[[RawMessage], None],
         bind: bool = False,
         context: Optional[zmq.Context] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.endpoint = endpoint
         self.topic_filter = topic_filter
         self.on_message = on_message
         self.bind = bind
+        self.retry_policy = retry_policy or DEFAULT_RECONNECT_POLICY
         self._ctx = context or zmq.Context.instance()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Consecutive failed connection cycles since the last successful
+        # receive; drives the backoff exponent.
+        self._consecutive_failures = 0
+        # Total reconnect cycles over the subscriber's lifetime
+        # (observability/chaos-test hook).
+        self.reconnects = 0
 
     def start(self) -> None:
         """Start the subscriber loop in a daemon thread (idempotent)."""
@@ -65,14 +92,24 @@ class ZMQSubscriber:
             self._thread.join(timeout=2 * RETRY_INTERVAL_S)
             self._thread = None
 
+    def next_delay(self) -> float:
+        """Backoff before the next reconnect, from the failure streak."""
+        return self.retry_policy.delay(self._consecutive_failures)
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
                 self._run_subscriber()
             except Exception:
-                logger.exception("subscriber error for %s; retrying in %ss",
-                                 self.endpoint, RETRY_INTERVAL_S)
-            if self._stop.wait(RETRY_INTERVAL_S):
+                logger.exception("subscriber error for %s", self.endpoint)
+            if self._stop.is_set():
+                return
+            delay = self.next_delay()
+            self._consecutive_failures += 1
+            self.reconnects += 1
+            logger.info("reconnecting to %s in %.2fs (streak=%d)",
+                        self.endpoint, delay, self._consecutive_failures)
+            if self._stop.wait(delay):
                 return
 
     def _run_subscriber(self) -> None:
@@ -86,11 +123,16 @@ class ZMQSubscriber:
                 sock.connect(self.endpoint)
             logger.info("subscribed to %s (%s, filter=%r)",
                         self.endpoint, "bind" if self.bind else "connect", self.topic_filter)
+            failpoints.hit(FP_ZMQ_CONNECT)
 
             while not self._stop.is_set():
+                failpoints.hit(FP_ZMQ_CONNECT)
                 if not sock.poll(_POLL_INTERVAL_MS):
                     continue
                 frames = sock.recv_multipart()
+                # A delivered message proves the link: reset the backoff so
+                # the next outage starts from the fast end again.
+                self._consecutive_failures = 0
                 msg = self._parse_frames(frames)
                 if msg is not None:
                     self.on_message(msg)

@@ -95,7 +95,12 @@ def crawl_candidates(
                     top_path = os.path.join(model_dir, top)
                     for dirpath, _dirs, files in os.walk(top_path):
                         for name in files:
-                            if not (name.endswith(".bin") or ".tmp." in name):
+                            # Live blocks, orphaned tmp files from crashed
+                            # writers, and checksum-quarantined files (held
+                            # briefly for post-mortem, reclaimed by the same
+                            # age sweep) are all evictable.
+                            if not (name.endswith(".bin") or ".tmp." in name
+                                    or name.endswith(".quarantine")):
                                 continue
                             path = os.path.join(dirpath, name)
                             try:

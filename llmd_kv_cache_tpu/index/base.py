@@ -121,6 +121,11 @@ class IndexConfig:
     # lookup hot path.
     enable_tracing: bool = False
     metrics_logging_interval_s: float = 0.0
+    # Wrap a remote backend (Redis/Valkey) in a FailoverIndex: ops run
+    # under retry + circuit breaker, and trip to a warm in-memory replica
+    # while the primary is down (docs/resilience.md). No-op for backends
+    # that are already in-process.
+    failover_to_memory: bool = False
 
     @classmethod
     def default(cls) -> "IndexConfig":
@@ -132,7 +137,7 @@ class IndexConfig:
 
             if native.native_available():
                 return cls(native_config=native.NativeIndexConfig())
-        except Exception:  # pragma: no cover - toolchain-less envs
+        except Exception:  # pragma: no cover - toolchain-less envs  # lint: allow-swallow (fall through to in-memory index)
             pass
         from .in_memory import InMemoryIndexConfig
 
@@ -159,6 +164,10 @@ def create_index(cfg: Optional[IndexConfig] = None) -> Index:
         from .redis_index import RedisIndex
 
         idx = RedisIndex(cfg.redis_config)
+        if cfg.failover_to_memory:
+            from ..resilience.failover import FailoverIndex
+
+            idx = FailoverIndex(idx, InMemoryIndex(InMemoryIndexConfig()))
     elif cfg.in_memory_config is not None:
         idx = InMemoryIndex(cfg.in_memory_config)
     else:

@@ -377,5 +377,5 @@ class NativeIndex(Index):
     def __del__(self):  # pragma: no cover - gc timing
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-swallow (best-effort __del__ cleanup)
             pass

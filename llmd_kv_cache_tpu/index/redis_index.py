@@ -25,10 +25,15 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from ..core.keys import BlockHash, KeyType, PodEntry
+from ..resilience.failpoints import failpoints
 from ..utils.logging import get_logger
 from .base import Index, infer_engine_mappings
 
 logger = get_logger("index.redis")
+
+# Failpoint guarding every Redis round-trip; armed by chaos tests to
+# simulate a down/flapping server (see docs/resilience.md).
+FP_REDIS_OP = "index.redis.op"
 
 
 @dataclass
@@ -175,6 +180,7 @@ class RedisIndex(Index):
     ) -> dict[BlockHash, list[PodEntry]]:
         if not request_keys:
             raise ValueError("no request_keys provided for lookup")
+        failpoints.hit(FP_REDIS_OP)
 
         pipe = self._client.pipeline()
         for key in request_keys:
@@ -204,6 +210,7 @@ class RedisIndex(Index):
     ) -> None:
         if not request_keys or not entries:
             raise ValueError("no keys or entries provided for adding to index")
+        failpoints.hit(FP_REDIS_OP)
 
         pipe = self._client.pipeline()
         if engine_keys is not None:
@@ -223,6 +230,7 @@ class RedisIndex(Index):
     ) -> None:
         if not entries:
             raise ValueError("no entries provided for eviction from index")
+        failpoints.hit(FP_REDIS_OP)
 
         if key_type is KeyType.ENGINE:
             rks = self._get_request_keys(key)
@@ -253,12 +261,14 @@ class RedisIndex(Index):
         return [v.decode("utf-8") if isinstance(v, bytes) else v for v in vals]
 
     def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
+        failpoints.hit(FP_REDIS_OP)
         rks = self._get_request_keys(engine_key)
         if not rks:
             return None
         return int(rks[-1])
 
     def clear(self, pod_identifier: str) -> None:
+        failpoints.hit(FP_REDIS_OP)
         # SCAN in batches; fields are JSON pod entries, so match by decoding
         # and comparing PodIdentifier — catches every tier/group/speculative
         # variant (redis.go:411-445).

@@ -114,7 +114,10 @@ class EngineConfig:
     # matmuls at startup (models.llama.fuse_params). None = auto: fused
     # wherever the shape profits (llama.fuse_profitable — measured v5e
     # crossover: hidden 4096 gains ~7% prefill MFU, hidden 2048 loses
-    # ~8%; benchmarking/r5-tpu). Under a tp mesh the engine fuses in
+    # ~8%; benchmarking/r5-tpu). The gate evaluates PER-SHARD widths
+    # (hidden_size / tp): tp narrows each rank's matmul columns, so
+    # hidden 4096 at tp=2 is gated off like the regressing hidden-2048
+    # single-shard case. Under a tp mesh the engine fuses in
     # the per-rank INTERLEAVED column order (LlamaConfig.fused_interleave
     # = tp) so the fused leaves stay Megatron-column-shardable; auto
     # additionally requires the projection widths to divide tp and
@@ -582,8 +585,11 @@ class MiniEngine:
             # Width-divisibility for the interleave needs no extra gate
             # here: validate_tp_config (above) already requires every
             # projection width to divide tp — the unfused Megatron
-            # shards have the identical constraint.
-            fuse = fuse_profitable(mcfg) and not fuse_mesh_blocked
+            # shards have the identical constraint. The profit gate sees
+            # per-shard widths: tp divides each rank's matmul columns, so
+            # a model above the crossover at tp=1 can sit below it here.
+            fuse = (fuse_profitable(mcfg, tp=self._tp)
+                    and not fuse_mesh_blocked)
         if fuse and fuse_mesh_blocked:
             raise ValueError(
                 "fuse_projections=True is incompatible with "
@@ -1608,6 +1614,11 @@ class MiniEngine:
                             self.offload_manager.complete_store(stored)
                     else:
                         logger.warning("write-through store job %d failed", res.job_id)
+                if res.corrupt_hashes and self.offload_manager is not None:
+                    # Checksum-failed files are already quarantined by the
+                    # worker; de-advertise the blocks so no index view keeps
+                    # routing to the storage tier for them.
+                    self.offload_manager.complete_load_failure(res.corrupt_hashes)
                 if res.job_id in targets:
                     results[res.job_id] = res
                 elif res.job_id in self._restore_job_ids:

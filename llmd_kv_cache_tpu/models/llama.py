@@ -516,6 +516,7 @@ def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
     t = cfg.fused_interleave
     out = dict(params)
     fused_layers = []
+    fused_any = False
     for layer in params["layers"]:
         lyr = dict(layer)
         if "wk" in lyr:  # standard / GQA attention
@@ -525,19 +526,29 @@ def fuse_params(params: Params, cfg: LlamaConfig) -> Params:
                 lyr["b_qkv"] = _interleave_concat(
                     [lyr.pop("bq"), lyr.pop("bk"), lyr.pop("bv")], t,
                     axis=0)
+            fused_any = True
         elif "w_dkv" in lyr:  # absorbed MLA (canonical order; t == 1)
             head_in = (lyr.pop("w_dq") if "w_dq" in lyr
                        else lyr.pop("wq"))
             lyr["w_mla_in"] = jnp.concatenate(
                 [head_in, lyr.pop("w_dkv"), lyr.pop("w_kr")], axis=1)
+            fused_any = True
         if "w_gate" in lyr and lyr["w_gate"].ndim == 2:  # dense SwiGLU
             lyr["w_gate_up"] = _interleave_concat(
                 [lyr.pop("w_gate"), lyr.pop("w_up")], t)
+            fused_any = True
         if "w_gate_sh" in lyr:
             lyr["w_gate_up_sh"] = _interleave_concat(
                 [lyr.pop("w_gate_sh"), lyr.pop("w_up_sh")], t)
+            fused_any = True
         fused_layers.append(lyr)
     out["layers"] = fused_layers
+    if fused_any:
+        # Record the interleave the tree was ACTUALLY fused with, so
+        # unfuse_params can refuse a mismatched config instead of silently
+        # de-interleaving into scrambled wq/wk/wv. A no-op call on an
+        # already-fused tree keeps the original marker.
+        out["fused_interleave"] = t
     return out
 
 
@@ -548,7 +559,7 @@ def maybe_fuse_params(params: Params, cfg: LlamaConfig) -> Params:
     return fuse_params(params, cfg) if fuse_profitable(cfg) else params
 
 
-def fuse_profitable(cfg: LlamaConfig) -> bool:
+def fuse_profitable(cfg: LlamaConfig, tp: int = 1) -> bool:
     """Whether ``fuse_params`` is expected to help this model on TPU.
 
     The measured crossover (real v5e, 4k flash prefill,
@@ -558,8 +569,14 @@ def fuse_profitable(cfg: LlamaConfig) -> bool:
     unfused layout so narrow-hidden serving never regresses. Engines
     with ``fuse_projections=None`` and the bench's shared-tree path both
     consult this.
+
+    ``tp`` scales the gate to PER-SHARD widths: under Megatron column
+    sharding each rank multiplies into 1/tp of the fused output columns,
+    so a hidden-4096 model at tp=2 runs the same narrow per-core products
+    the hidden-2048 measurement showed REGRESSING. The profit boundary
+    therefore applies to ``hidden_size / tp``, not the full-model width.
     """
-    return cfg.hidden_size >= 4096
+    return cfg.hidden_size // max(1, tp) >= 4096
 
 
 def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
@@ -567,9 +584,29 @@ def unfuse_params(params: Params, cfg: LlamaConfig) -> Params:
     the canonical per-projection layout. Checkpoints always store the
     canonical layout (portable across fused/unfused engines, TP sharding,
     and the trainer); a fused serving tree is unfused on save. No-op on
-    an already-canonical tree."""
-    t = cfg.fused_interleave
+    an already-canonical tree.
+
+    The interleave is read from the ``fused_interleave`` marker that
+    :func:`fuse_params` stamped on the tree. A fused tree without the
+    marker, or one whose marker disagrees with ``cfg.fused_interleave``,
+    raises: de-interleaving with the wrong ``t`` would silently scramble
+    ``wq/wk/wv`` column order (a checkpoint saved from such a tree is
+    corrupt with no error anywhere downstream)."""
     out = dict(params)
+    marker = out.pop("fused_interleave", None)
+    fused_keys = ("w_qkv", "b_qkv", "w_mla_in", "w_gate_up", "w_gate_up_sh")
+    if not any(k in lyr for lyr in params["layers"] for k in fused_keys):
+        return out  # already canonical
+    if marker is None:
+        raise ValueError(
+            "cannot unfuse: tree has fused projections but no "
+            "fused_interleave marker (was it fused by fuse_params?)")
+    t = int(marker)
+    if t != cfg.fused_interleave:
+        raise ValueError(
+            f"fused_interleave mismatch: tree was fused with t={t} but "
+            f"cfg.fused_interleave={cfg.fused_interleave}; unfusing with "
+            "the wrong interleave would scramble the q/k/v column order")
     layers = []
     for layer in params["layers"]:
         lyr = dict(layer)

@@ -49,6 +49,11 @@ class FileMapperConfig:
     # positions past the window, so stores written with and without sinks
     # are byte-incompatible and must not share a directory.
     attention_sinks: int = 0
+    # End-to-end integrity of the file payload: "crc32" appends a per-slot
+    # CRC32 footer (resilience.integrity) verified on load; "none" writes
+    # the bare payload. Fingerprinted: footer-bearing and bare files must
+    # never share a directory, or readers would mis-size every load.
+    integrity: str = "crc32"
     engine: str = "kvtpu"
     mesh_sizes: dict[str, int] = field(
         default_factory=lambda: {"tp_size": 1, "pp_size": 1, "dp_size": 1, "sp_size": 1}
@@ -100,6 +105,10 @@ class FileMapper:
             **({"kv_streams": c.kv_streams} if c.kv_streams != 2 else {}),
             **({"attention_sinks": c.attention_sinks}
                if c.attention_sinks else {}),
+            # Only when enabled (the default): checksummed and bare formats
+            # differ in file size, so they must hash apart; "none" keeps
+            # resolving wherever pre-integrity deployments wrote.
+            **({"integrity": c.integrity} if c.integrity != "none" else {}),
             "engine": c.engine,
             **({k: v for k, v in sorted(c.mesh_sizes.items())}
                if not c.parallel_agnostic else {}),
@@ -141,6 +150,7 @@ class FileMapper:
                     "kv_layout": "nkpd",
                     "kv_streams": c.kv_streams,
                     "attention_sinks": c.attention_sinks,
+                    "integrity": c.integrity,
                     "engine": c.engine,
                     "mesh_sizes": c.mesh_sizes,
                     "fingerprint": self._fingerprint,

@@ -68,3 +68,20 @@ class SharedStorageOffloadManager:
 
     def complete_load(self, block_hashes: Sequence[int]) -> None:
         """Loads don't change global state (files remain)."""
+
+    def complete_load_failure(self, corrupt_hashes: Sequence[int]) -> None:
+        """De-advertise blocks whose files failed checksum verification.
+
+        The worker has already quarantined the files (renamed out of the
+        content-addressed namespace), so ``lookup`` misses immediately;
+        this publishes BlockRemoved so remote index views stop routing to
+        the storage tier for these blocks too.
+        """
+        if corrupt_hashes:
+            logger.warning(
+                "de-advertising %d corrupt block(s): %s",
+                len(corrupt_hashes),
+                ", ".join(f"{h:#x}" for h in list(corrupt_hashes)[:8]),
+            )
+        if self.event_publisher is not None and corrupt_hashes:
+            self.event_publisher.publish_block_removed(list(corrupt_hashes))

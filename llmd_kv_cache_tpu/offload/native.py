@@ -13,9 +13,19 @@ import threading
 from pathlib import Path
 from typing import Optional
 
+from ..resilience.failpoints import failpoints
 from ..utils.logging import get_logger
 
 logger = get_logger("offload.native")
+
+# Failpoints at the native submission boundary (docs/resilience.md):
+# error-mode raises FaultInjected before the op reaches the C++ pool
+# (callers in offload.worker translate this into a failed, retryable
+# job); delay-mode simulates a slow disk. ``file_exists`` is custom-mode:
+# firing makes the probe report "missing", shrinking lookup prefixes.
+FP_SUBMIT_WRITE = "offload.native.submit_write"
+FP_SUBMIT_READ = "offload.native.submit_read"
+FP_FILE_EXISTS = "offload.native.file_exists"
 
 _CSRC_DIR = Path(__file__).resolve().parent.parent.parent / "csrc" / "kvio"
 _LIB_PATH = _CSRC_DIR / "libkvio.so"
@@ -173,6 +183,7 @@ class NativeIOEngine:
                      buffer, skip_if_exists: bool = True) -> bool:
         """Queue a write of ``buffer`` (numpy array or bytes; caller must
         keep it alive until the job completes). Returns False when shed."""
+        failpoints.hit(FP_SUBMIT_WRITE)
         address, nbytes = self._buffer_address(buffer, writable=False)
         return bool(self._lib.kvio_submit_write(
             self._handle, job_id, path.encode(), tmp_path.encode(),
@@ -191,6 +202,7 @@ class NativeIOEngine:
         ))
 
     def submit_read(self, job_id: int, path: str, buffer, offset: int = 0) -> None:
+        failpoints.hit(FP_SUBMIT_READ)
         address, nbytes = self._buffer_address(buffer, writable=True)
         self._lib.kvio_submit_read(
             self._handle, job_id, path.encode(), address, nbytes, offset,
@@ -241,11 +253,13 @@ class NativeIOEngine:
     def __del__(self):  # pragma: no cover - gc timing
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-swallow (best-effort __del__ cleanup)
             pass
 
 
 def file_exists(path: str, touch_atime: bool = False) -> bool:
+    if failpoints.should_fire(FP_FILE_EXISTS):
+        return False
     return bool(load_library().kvio_file_exists(path.encode(), int(touch_atime)))
 
 

@@ -650,7 +650,7 @@ class ObjectStoreOffloadHandlers:
                 f.exception(timeout=remaining)
             except futures.TimeoutError:
                 return -1
-            except Exception:
+            except Exception:  # lint: allow-swallow (failure reported via job status)
                 pass
         with self._lock:
             self._jobs.pop(job_id, None)

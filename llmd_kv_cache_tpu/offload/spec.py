@@ -54,6 +54,14 @@ class SharedStorageOffloadSpec:
     # StreamingLLM sinks (enters the store fingerprint: sink and
     # sink-free KV of the same model are byte-incompatible).
     attention_sinks: int = 0
+    # End-to-end payload integrity: "crc32" (default) appends the per-slot
+    # checksum footer verified on load; "none" for raw-throughput setups
+    # that accept silent corruption. Fingerprinted either way.
+    integrity: str = "crc32"
+    # Transient-failure retry: attempts per offload job (1 disables retry)
+    # and the base backoff delay (jittered exponential, resilience.policy).
+    retry_attempts: int = 2
+    retry_base_delay_s: float = 0.05
     rank: int = 0
     parallel_agnostic: bool = False
     events_endpoint: Optional[str] = None
@@ -104,6 +112,11 @@ class SharedStorageOffloadSpec:
             kv_streams=get("kvStreams", "kv_streams", default=2),
             attention_sinks=get("attentionSinks", "attention_sinks",
                                 default=0),
+            integrity=get("integrity", default="crc32"),
+            retry_attempts=get("retryAttempts", "retry_attempts", default=2),
+            retry_base_delay_s=get(
+                "retryBaseDelaySeconds", "retry_base_delay_s", default=0.05
+            ),
             rank=get("rank", default=0),
             parallel_agnostic=get(
                 "parallelAgnostic", "parallel_agnostic", default=False
@@ -128,6 +141,7 @@ class SharedStorageOffloadSpec:
                 swa_layers=tuple(self.swa_layers),
                 kv_streams=self.kv_streams,
                 attention_sinks=self.attention_sinks,
+                integrity=self.integrity,
                 mesh_sizes=mesh_fingerprint_fields(self.mesh),
                 rank=self.rank,
                 parallel_agnostic=self.parallel_agnostic,
@@ -204,6 +218,8 @@ class SharedStorageOffloadSpec:
                 blocks_per_file=self.blocks_per_file,
                 pages_per_block=self.pages_per_block,
             )
+        from ..resilience.policy import RetryPolicy
+
         return OffloadHandlers(
             copier,
             self.build_mapper(),
@@ -212,4 +228,9 @@ class SharedStorageOffloadSpec:
             max_write_queued_seconds=self.max_write_queued_seconds,
             blocks_per_file=self.blocks_per_file,
             pages_per_block=self.pages_per_block,
+            retry_policy=RetryPolicy(
+                max_attempts=max(1, self.retry_attempts),
+                base_delay_s=self.retry_base_delay_s,
+                max_delay_s=max(0.5, self.retry_base_delay_s * 10),
+            ),
         )

@@ -300,7 +300,7 @@ class TPUStorageOffloadingSpec(OffloadingSpec):
     def __init__(self, vllm_config, kv_cache_config):
         try:
             super().__init__(vllm_config, kv_cache_config)
-        except TypeError:  # minimal stubs whose base takes no args
+        except TypeError:  # minimal stubs whose base takes no args  # lint: allow-swallow
             pass
         self.vllm_config = vllm_config
         self.kv_cache_config = kv_cache_config

@@ -15,6 +15,7 @@ cache misses rather than latency.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -23,12 +24,39 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..resilience.failpoints import FaultInjected, failpoints
+from ..resilience.integrity import (
+    IntegrityError,
+    build_footer,
+    footer_size,
+    parse_footer,
+    slot_crcs,
+)
+from ..resilience.policy import RetryPolicy
 from ..utils.logging import get_logger
 from .file_mapper import FileMapper
-from .native import STATUS_OK, STATUS_PENDING, NativeIOEngine
+from .native import (
+    STATUS_CANCELLED,
+    STATUS_IO_ERROR,
+    STATUS_OK,
+    STATUS_PENDING,
+    NativeIOEngine,
+)
 from .tpu_copier import TPUBlockCopier
 
 logger = get_logger("offload.worker")
+
+# Failpoints on the offload data plane (docs/resilience.md):
+#   - io_error pair: force a completed job's status to IO_ERROR, exercising
+#     the retry/backoff path without touching the native pool;
+#   - torn: corrupt the written payload AFTER its checksums are computed,
+#     simulating a torn write / bitrot that only load-time verification
+#     can catch.
+FP_STORE_IO_ERROR = "offload.store.io_error"
+FP_LOAD_IO_ERROR = "offload.load.io_error"
+FP_STORE_TORN = "offload.store.torn"
+
+QUARANTINE_SUFFIX = ".quarantine"
 
 
 @dataclass
@@ -41,6 +69,11 @@ class TransferResult:
     # Block hashes whose writes were shed by the EMA queue limit (stores
     # only): these blocks are NOT on disk and must not be advertised.
     shed_hashes: list = field(default_factory=list)
+    # Loads: file keys whose checksum verification failed. The files have
+    # been quarantined on disk; the caller must de-advertise the blocks.
+    corrupt_hashes: list = field(default_factory=list)
+    # Submission rounds the job took (1 = no retry).
+    attempts: int = 1
 
     @property
     def shed_blocks(self) -> int:
@@ -54,17 +87,49 @@ class TransferResult:
 
 
 @dataclass
+class _StoreUnit:
+    """One file write of a store job (payload with footer pre-appended)."""
+
+    key: int
+    buf: "np.ndarray"
+
+
+@dataclass
+class _LoadUnit:
+    """One file's reads within a load job.
+
+    ``payload`` covers file slots ``[slot_lo, slot_lo + covered)`` of a
+    file with ``num_slots`` total slots; ``footer`` (when integrity is on)
+    receives the checksum footer read from the file tail.
+    """
+
+    key: int
+    payload: "np.ndarray"
+    footer: Optional["np.ndarray"]
+    slot_lo: int
+    covered: int
+    num_slots: int
+    # (buffer_slice, page_ids) pairs to scatter once verified.
+    scatters: list = field(default_factory=list)
+
+
+@dataclass
 class _PendingJob:
-    job_id: int
+    job_id: int  # current native job id (changes across retries)
+    report_id: int  # job id the caller polls/waits on (first native id)
     is_store: bool
     started: float
     nbytes: int
+    attempt: int = 1
     shed_hashes: list = field(default_factory=list)
     # Keep host buffers alive until the native engine is done with them.
     buffers: list = field(default_factory=list)
-    # Loads: (buffer, page_ids) to scatter on completion.
-    scatters: list = field(default_factory=list)
+    store_units: list = field(default_factory=list)
+    load_units: list = field(default_factory=list)
     group_idx: int = 0  # cache group the job's pages belong to
+    # An injected submission fault left part of the job unqueued; the job
+    # must complete as failed even if every queued op succeeded.
+    submit_failed: bool = False
 
 
 @dataclass
@@ -208,6 +273,7 @@ class OffloadHandlers:
         blocks_per_file: int = 1,
         pages_per_block: int = 1,
         copiers: Optional[dict[int, TPUBlockCopier]] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.copier = copier
         # Per-cache-group copiers (hybrid models: group 0 full-attention
@@ -253,6 +319,42 @@ class OffloadHandlers:
         )
         self._pending: dict[int, _PendingJob] = {}
         self._lock = threading.Lock()
+        # Integrity: when the mapper's format carries a CRC footer, stores
+        # append it and loads verify it (docs/resilience.md).
+        self.integrity = getattr(mapper.cfg, "integrity", "none") == "crc32"
+        # Transient I/O failures are retried with jittered backoff; the
+        # default is deliberately short — offload is a cache, so a job that
+        # keeps failing should fail fast and let the request path move on.
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=2, base_delay_s=0.05, max_delay_s=0.5
+        )
+        # Jobs awaiting resubmission: (due_monotonic, job). Flushed at the
+        # top of get_finished; report_id maps to -1 while a job sits here.
+        self._retry_q: list[tuple[float, _PendingJob]] = []
+        self._by_report: dict[int, int] = {}
+
+    def footer_bytes(self, num_slots: Optional[int] = None) -> int:
+        """On-disk footer overhead per file (0 when integrity is off)."""
+        if not self.integrity:
+            return 0
+        return footer_size(self.blocks_per_file if num_slots is None else num_slots)
+
+    def _with_footer(self, payload: "np.ndarray", num_slots: int) -> "np.ndarray":
+        """Append the CRC footer to a file payload (one host copy).
+
+        The native writer needs one contiguous buffer for the atomic
+        tmp+rename write, so payload and footer are concatenated; the
+        ``offload.store.torn`` failpoint corrupts a payload byte *after*
+        checksumming to stage a torn-write for load-time verification.
+        """
+        flat = payload.view(np.uint8).reshape(-1)
+        slot = flat.nbytes // num_slots
+        crcs = slot_crcs([flat[i * slot:(i + 1) * slot] for i in range(num_slots)])
+        buf = np.concatenate([flat, np.frombuffer(build_footer(crcs), np.uint8)])
+        if failpoints.should_fire(FP_STORE_TORN):
+            buf[flat.nbytes // 2] ^= 0xFF
+            logger.warning("failpoint %s tore a store payload", FP_STORE_TORN)
+        return buf
 
     # -- store path --
 
@@ -270,28 +372,36 @@ class OffloadHandlers:
         """
         copier = self.copiers[group_idx]
         job_id = self.io.begin_job()
-        job = _PendingJob(job_id=job_id, is_store=True, started=time.perf_counter(),
-                          nbytes=0, group_idx=group_idx)
+        job = _PendingJob(job_id=job_id, report_id=job_id, is_store=True,
+                          started=time.perf_counter(), nbytes=0,
+                          group_idx=group_idx)
         suffix = uuid.uuid4().hex[:8]
         # One device program + one D2H transfer for the whole job.
         slabs = copier.gather_many_to_host(
             [list(page_ids) for _, page_ids in transfers]
         )
         for (block_hash, _page_ids), slab in zip(transfers, slabs):
-            queued = self.io.submit_write(
-                job_id,
-                self.mapper.block_path(block_hash, group_idx),
-                self.mapper.tmp_path(block_hash, group_idx, unique_suffix=suffix),
-                slab,
-            )
+            # Block-mode files hold exactly one block: one checksum slot.
+            buf = self._with_footer(slab, 1) if self.integrity else slab
+            try:
+                queued = self.io.submit_write(
+                    job_id,
+                    self.mapper.block_path(block_hash, group_idx),
+                    self.mapper.tmp_path(block_hash, group_idx, unique_suffix=suffix),
+                    buf,
+                )
+            except FaultInjected:
+                job.submit_failed = True
+                job.store_units.append(_StoreUnit(key=block_hash, buf=buf))
+                continue
             if queued:
-                job.buffers.append(slab)
+                job.buffers.append(buf)
+                job.store_units.append(_StoreUnit(key=block_hash, buf=buf))
                 job.nbytes += slab.nbytes
             else:
                 job.shed_hashes.append(block_hash)
         self.io.seal_job(job_id)
-        with self._lock:
-            self._pending[job_id] = job
+        self._register(job)
         return job_id
 
     # -- load path --
@@ -309,20 +419,44 @@ class OffloadHandlers:
         """
         copier = self.copiers[group_idx]
         job_id = self.io.begin_job()
-        job = _PendingJob(job_id=job_id, is_store=False, started=time.perf_counter(),
-                          nbytes=0, group_idx=group_idx)
+        job = _PendingJob(job_id=job_id, report_id=job_id, is_store=False,
+                          started=time.perf_counter(), nbytes=0,
+                          group_idx=group_idx)
         for block_hash, page_ids in transfers:
             buf = self.staging.acquire(copier.slab_nbytes(len(page_ids)))
-            self.io.submit_read(
-                job_id, self.mapper.block_path(block_hash, group_idx), buf
-            )
+            footer = None
+            if self.integrity:
+                footer = self.staging.acquire(footer_size(1))
+            unit = _LoadUnit(key=block_hash, payload=buf, footer=footer,
+                             slot_lo=0, covered=1, num_slots=1,
+                             scatters=[(buf, list(page_ids))])
             job.buffers.append(buf)
-            job.scatters.append((buf, list(page_ids)))
+            if footer is not None:
+                job.buffers.append(footer)
+            job.load_units.append(unit)
             job.nbytes += buf.nbytes
+            self._submit_load_unit(job, unit, group_idx)
         self.io.seal_job(job_id)
-        with self._lock:
-            self._pending[job_id] = job
+        self._register(job)
         return job_id
+
+    def _submit_load_unit(self, job: _PendingJob, unit: _LoadUnit,
+                          group_idx: int) -> None:
+        """Queue one file's payload (+footer) reads on the current job."""
+        path = self.mapper.block_path(unit.key, group_idx)
+        slot_bytes = unit.payload.nbytes // unit.covered
+        try:
+            self.io.submit_read(
+                job.job_id, path, unit.payload,
+                offset=unit.slot_lo * slot_bytes,
+            )
+            if unit.footer is not None:
+                self.io.submit_read(
+                    job.job_id, path, unit.footer,
+                    offset=unit.num_slots * slot_bytes,
+                )
+        except FaultInjected:
+            job.submit_failed = True
 
     # -- multi-block file spans (unaligned head/tail) --
 
@@ -348,7 +482,7 @@ class OffloadHandlers:
         copier = self.copiers[group_idx]
         file_bytes = copier.slab_nbytes(self.pages_per_block) * self.blocks_per_file
         job_id = self.io.begin_job()
-        job = _PendingJob(job_id=job_id, is_store=True,
+        job = _PendingJob(job_id=job_id, report_id=job_id, is_store=True,
                           started=time.perf_counter(), nbytes=0,
                           group_idx=group_idx)
         suffix = uuid.uuid4().hex[:8]
@@ -358,22 +492,31 @@ class OffloadHandlers:
         all_slabs = copier.gather_many_to_host(
             [list(b) for span in spans for b in span.blocks]
         )
-        for file_key, buf in assemble_file_buffers(
+        for file_key, payload in assemble_file_buffers(
                 spans, all_slabs, file_bytes).items():
-            queued = self.io.submit_write(
-                job_id,
-                self.mapper.block_path(file_key, group_idx),
-                self.mapper.tmp_path(file_key, group_idx, unique_suffix=suffix),
-                buf,
-            )
+            # Span-mode files checksum per slot so partial (head-offset)
+            # loads can verify just the slots they read.
+            buf = (self._with_footer(payload, self.blocks_per_file)
+                   if self.integrity else payload)
+            try:
+                queued = self.io.submit_write(
+                    job_id,
+                    self.mapper.block_path(file_key, group_idx),
+                    self.mapper.tmp_path(file_key, group_idx, unique_suffix=suffix),
+                    buf,
+                )
+            except FaultInjected:
+                job.submit_failed = True
+                job.store_units.append(_StoreUnit(key=file_key, buf=buf))
+                continue
             if queued:
                 job.buffers.append(buf)
-                job.nbytes += buf.nbytes
+                job.store_units.append(_StoreUnit(key=file_key, buf=buf))
+                job.nbytes += payload.nbytes
             else:
                 job.shed_hashes.append(file_key)
         self.io.seal_job(job_id)
-        with self._lock:
-            self._pending[job_id] = job
+        self._register(job)
         return job_id
 
     def async_load_spans(self, spans: Sequence[FileSpan],
@@ -385,38 +528,149 @@ class OffloadHandlers:
         copier = self.copiers[group_idx]
         slot_bytes = copier.slab_nbytes(self.pages_per_block)
         job_id = self.io.begin_job()
-        job = _PendingJob(job_id=job_id, is_store=False,
+        job = _PendingJob(job_id=job_id, report_id=job_id, is_store=False,
                           started=time.perf_counter(), nbytes=0,
                           group_idx=group_idx)
         for span in spans:
             buf = self.staging.acquire(len(span.blocks) * slot_bytes)
-            self.io.submit_read(
-                job_id, self.mapper.block_path(span.file_key, group_idx),
-                buf, offset=span.head_offset * slot_bytes,
+            footer = None
+            if self.integrity:
+                footer = self.staging.acquire(footer_size(self.blocks_per_file))
+            unit = _LoadUnit(
+                key=span.file_key, payload=buf, footer=footer,
+                slot_lo=span.head_offset, covered=len(span.blocks),
+                num_slots=self.blocks_per_file,
+                scatters=[
+                    (buf[k * slot_bytes:(k + 1) * slot_bytes], list(page_ids))
+                    for k, page_ids in enumerate(span.blocks)
+                ],
             )
             job.buffers.append(buf)
-            for k, page_ids in enumerate(span.blocks):
-                job.scatters.append((
-                    buf[k * slot_bytes:(k + 1) * slot_bytes],
-                    list(page_ids),
-                ))
+            if footer is not None:
+                job.buffers.append(footer)
+            job.load_units.append(unit)
             job.nbytes += buf.nbytes
+            self._submit_load_unit(job, unit, group_idx)
         self.io.seal_job(job_id)
-        with self._lock:
-            self._pending[job_id] = job
+        self._register(job)
         return job_id
 
     # -- completion --
 
+    def _register(self, job: _PendingJob) -> None:
+        with self._lock:
+            self._pending[job.job_id] = job
+            self._by_report[job.report_id] = job.job_id
+
+    def _quarantine(self, key: int, group_idx: int) -> None:
+        """Move a checksum-failed file out of the content-addressed
+        namespace so lookups stop advertising it; the evictor reclaims
+        ``*.quarantine`` files on its age sweep."""
+        path = self.mapper.block_path(key, group_idx)
+        try:
+            os.replace(path, path + QUARANTINE_SUFFIX)
+            logger.error("quarantined corrupt offload file %s", path)
+        except OSError as e:
+            logger.warning("could not quarantine %s: %s", path, e)
+
+    def _verify_load(self, job: _PendingJob) -> list[int]:
+        """Checksum every read unit; quarantine and report corrupt files."""
+        corrupt: list[int] = []
+        for unit in job.load_units:
+            if unit.footer is None:
+                continue
+            flat = unit.payload.view(np.uint8).reshape(-1)
+            slot = flat.nbytes // unit.covered
+            try:
+                crcs = parse_footer(bytes(unit.footer), unit.num_slots)
+                got = slot_crcs(
+                    [flat[i * slot:(i + 1) * slot] for i in range(unit.covered)]
+                )
+                for i, crc in enumerate(got):
+                    if crc != crcs[unit.slot_lo + i]:
+                        raise IntegrityError(
+                            f"slot {unit.slot_lo + i} crc mismatch: "
+                            f"footer={crcs[unit.slot_lo + i]:#010x} data={crc:#010x}"
+                        )
+            except IntegrityError as e:
+                logger.error("load of %#x failed verification: %s", unit.key, e)
+                self._quarantine(unit.key, job.group_idx)
+                corrupt.append(unit.key)
+        return corrupt
+
+    def _schedule_retry(self, job: _PendingJob) -> None:
+        delay = self.retry_policy.delay(job.attempt - 1)
+        logger.warning(
+            "job %d (%s) attempt %d failed; retrying in %.3fs",
+            job.report_id, "store" if job.is_store else "load",
+            job.attempt, delay,
+        )
+        with self._lock:
+            self._retry_q.append((time.monotonic() + delay, job))
+            self._by_report[job.report_id] = -1
+
+    def _resubmit(self, job: _PendingJob) -> None:
+        job.attempt += 1
+        job.submit_failed = False
+        job.job_id = self.io.begin_job()
+        if job.is_store:
+            suffix = uuid.uuid4().hex[:8]
+            kept = []
+            for unit in job.store_units:
+                try:
+                    queued = self.io.submit_write(
+                        job.job_id,
+                        self.mapper.block_path(unit.key, job.group_idx),
+                        self.mapper.tmp_path(unit.key, job.group_idx,
+                                             unique_suffix=suffix),
+                        unit.buf,
+                    )
+                except FaultInjected:
+                    job.submit_failed = True
+                    kept.append(unit)
+                    continue
+                if queued:
+                    kept.append(unit)
+                else:
+                    job.shed_hashes.append(unit.key)
+            job.store_units = kept
+        else:
+            for unit in job.load_units:
+                self._submit_load_unit(job, unit, job.group_idx)
+        self.io.seal_job(job.job_id)
+        self._register(job)
+
+    def _flush_retries(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            due = [j for t, j in self._retry_q if t <= now]
+            self._retry_q = [(t, j) for t, j in self._retry_q if t > now]
+        for job in due:
+            self._resubmit(job)
+
+    def _release_job_buffers(self, job: _PendingJob) -> None:
+        for buf in job.buffers:
+            self.staging.release(buf)
+
     def get_finished(self) -> list[TransferResult]:
-        """Poll completed jobs; apply load scatters; release buffers."""
+        """Poll completed jobs; verify + apply load scatters; retry or
+        report; release buffers."""
+        self._flush_retries()
         results = []
         for job_id, status in self.io.poll_finished():
             with self._lock:
                 job = self._pending.pop(job_id, None)
             if job is None:
                 continue
+            if status == STATUS_OK:
+                fp = FP_STORE_IO_ERROR if job.is_store else FP_LOAD_IO_ERROR
+                if job.submit_failed or failpoints.should_fire(fp):
+                    status = STATUS_IO_ERROR
             success = status == STATUS_OK
+            corrupt: list[int] = []
+            if success and not job.is_store:
+                corrupt = self._verify_load(job)
+                success = not corrupt
             if success and not job.is_store:
                 copier = self.copiers[job.group_idx]
                 copier.scatter_many_from_host([
@@ -426,41 +680,73 @@ class OffloadHandlers:
                         ),
                         page_ids,
                     )
-                    for buf, page_ids in job.scatters
+                    for unit in job.load_units
+                    for buf, page_ids in unit.scatters
                 ])
-            elif not success and not job.is_store:
-                logger.warning("load job %d failed (status %d)", job_id, status)
             elif not success:
-                logger.warning("store job %d failed (status %d)", job_id, status)
+                logger.warning(
+                    "%s job %d failed (status %d, attempt %d)",
+                    "store" if job.is_store else "load",
+                    job.report_id, status, job.attempt,
+                )
+            # Transient failures (IO error, injected fault) retry under the
+            # policy; checksum corruption is deterministic and cancellation
+            # is intentional — neither is worth a second attempt.
+            if (not success and not corrupt and status == STATUS_IO_ERROR
+                    and job.attempt < self.retry_policy.max_attempts):
+                self._schedule_retry(job)
+                continue
             if not job.is_store:
-                # Scatter has consumed the staged bytes: recycle the
-                # slots (release no-ops on non-pool buffers).
-                for buf in job.buffers:
-                    self.staging.release(buf)
+                # Scatter (or abandonment) has consumed the staged bytes:
+                # recycle the slots (release no-ops on non-pool buffers).
+                self._release_job_buffers(job)
+            with self._lock:
+                self._by_report.pop(job.report_id, None)
             results.append(
                 TransferResult(
-                    job_id=job_id,
+                    job_id=job.report_id,
                     success=success,
                     is_store=job.is_store,
                     bytes_transferred=job.nbytes if success else 0,
                     seconds=time.perf_counter() - job.started,
                     shed_hashes=job.shed_hashes,
+                    corrupt_hashes=corrupt,
+                    attempts=job.attempt,
                 )
             )
         return results
 
     def wait_job(self, job_id: int, timeout_s: float = 30.0) -> int:
-        """Cancel-and-wait for preemption (request aborted mid-transfer)."""
-        status = self.io.wait_job(job_id, timeout_s)
+        """Cancel-and-wait for preemption (request aborted mid-transfer).
+
+        ``job_id`` is the id the submit call returned; retries run under
+        fresh native ids, so resolve through the report map first.
+        """
+        with self._lock:
+            native_id = self._by_report.get(job_id, job_id)
+            if native_id == -1:
+                # Parked in the retry queue: nothing in flight natively —
+                # drop the pending retry and release its buffers.
+                job = None
+                for i, (_t, j) in enumerate(self._retry_q):
+                    if j.report_id == job_id:
+                        job = j
+                        del self._retry_q[i]
+                        break
+                self._by_report.pop(job_id, None)
+                if job is not None and not job.is_store:
+                    self._release_job_buffers(job)
+                return STATUS_CANCELLED
+        status = self.io.wait_job(native_id, timeout_s)
         if status != STATUS_PENDING:
             # Only release the host buffers once the native side has truly
             # drained: a timed-out job may still have an in-flight read
             # holding raw pointers into them.
             with self._lock:
-                job = self._pending.pop(job_id, None)
+                job = self._pending.pop(native_id, None)
+                self._by_report.pop(job_id, None)
             if job is not None and not job.is_store:
-                for buf in job.buffers:
-                    self.staging.release(buf)
+                self._release_job_buffers(job)
         else:
             logger.warning(
                 "job %d still in flight after cancel timeout; parking buffers",

@@ -179,8 +179,20 @@ def param_shardings(mesh: Mesh, params: Params) -> dict:
 
 
 def shard_params(mesh: Mesh, params: Params) -> Params:
-    """Place a parameter tree onto the mesh with TP shardings."""
-    return jax.device_put(params, param_shardings(mesh, params))
+    """Place a parameter tree onto the mesh with TP shardings.
+
+    The ``fused_interleave`` layout marker (llama.fuse_params) is a plain
+    int, not a weight: it is lifted out before device_put (the sharding
+    tree has no slot for it) and re-attached unchanged."""
+    marker = None
+    if "fused_interleave" in params:
+        params = dict(params)
+        marker = params.pop("fused_interleave")
+    out = jax.device_put(params, param_shardings(mesh, params))
+    if marker is not None:
+        out = dict(out)
+        out["fused_interleave"] = marker
+    return out
 
 
 def mesh_fingerprint_fields(mesh: Optional[Mesh]) -> dict[str, int]:

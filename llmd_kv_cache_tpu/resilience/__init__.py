@@ -1,0 +1,42 @@
+"""Fleet-grade resilience primitives.
+
+This package concentrates the cross-cutting machinery that keeps the
+serving path alive when individual components misbehave:
+
+- :mod:`failpoints` — a deterministic, seeded fault-injection registry
+  with named hooks planted at every I/O boundary (offload, Redis index,
+  ZMQ events, tokenizer RPCs).
+- :mod:`policy` — jittered exponential backoff with deadlines and a
+  per-target circuit breaker.
+- :mod:`integrity` — the per-slot CRC32 footer appended to offload
+  files, verified on load.
+- :mod:`failover` — an Index wrapper that trips Redis ops over to the
+  in-memory index when the primary's breaker opens.
+- :mod:`liveness` — per-pod last-event tracking feeding degraded-mode
+  scoring (stale pods demoted, then dropped).
+
+See docs/resilience.md for the failpoint catalog and defaults.
+"""
+
+from .failpoints import (  # noqa: F401
+    FailpointRegistry,
+    FaultInjected,
+    failpoints,
+)
+from .policy import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryExhausted,
+    RetryPolicy,
+    call_with_retry,
+)
+from .integrity import (  # noqa: F401
+    FOOTER_MAGIC,
+    IntegrityError,
+    build_footer,
+    footer_size,
+    parse_footer,
+    slot_crcs,
+)
+from .failover import FailoverIndex  # noqa: F401
+from .liveness import PodLivenessTracker  # noqa: F401

@@ -1,0 +1,185 @@
+"""Named, deterministic fault-injection points.
+
+A *failpoint* is a named hook planted at an I/O boundary.  Production
+code calls :meth:`FailpointRegistry.hit` (or :meth:`should_fire` for
+custom corruption-style faults); when the failpoint is disarmed this is
+a single dict lookup, so hooks are safe to leave in hot-ish paths.
+
+Failpoints are armed programmatically (chaos tests) or from the
+environment:
+
+    KVTPU_FAILPOINTS="offload.load.io_error=error:p=1:times=2,index.redis.op=error"
+    KVTPU_FAILPOINT_SEED=1234
+
+Spec grammar per failpoint: ``name=mode[:p=<prob>][:times=<n>][:delay=<s>]``
+with modes ``error`` (raise :class:`FaultInjected`), ``delay`` (sleep),
+and ``custom`` (``should_fire`` returns True; the call site decides what
+the fault looks like — e.g. flipping bytes to tear a file).
+
+Determinism: probabilistic firing draws from a registry-owned
+``random.Random`` seeded at construction (``KVTPU_FAILPOINT_SEED``,
+default 0), so a chaos run replays exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+ENV_FAILPOINTS = "KVTPU_FAILPOINTS"
+ENV_SEED = "KVTPU_FAILPOINT_SEED"
+
+MODE_ERROR = "error"
+MODE_DELAY = "delay"
+MODE_CUSTOM = "custom"
+
+_MODES = (MODE_ERROR, MODE_DELAY, MODE_CUSTOM)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``error``-mode failpoint.
+
+    Carries the failpoint name so retry policies can treat injected
+    faults like the real failures they stand in for.
+    """
+
+    def __init__(self, name: str):
+        super().__init__(f"fault injected at failpoint '{name}'")
+        self.failpoint = name
+
+
+@dataclass
+class _Failpoint:
+    name: str
+    mode: str = MODE_ERROR
+    probability: float = 1.0
+    times: int | None = None  # remaining firings; None = unlimited
+    delay_s: float = 0.0
+    hits: int = 0  # times the hook was reached
+    fired: int = 0  # times the fault actually triggered
+    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+
+class FailpointRegistry:
+    """Thread-safe registry of named failpoints with a seeded RNG."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._points: dict[str, _Failpoint] = {}
+        self._rng = random.Random(seed)
+        self._seed = seed
+
+    # -- configuration ----------------------------------------------------
+
+    def arm(
+        self,
+        name: str,
+        mode: str = MODE_ERROR,
+        probability: float = 1.0,
+        times: int | None = None,
+        delay_s: float = 0.0,
+    ) -> None:
+        if mode not in _MODES:
+            raise ValueError(f"unknown failpoint mode {mode!r}; expected one of {_MODES}")
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got {probability}")
+        with self._lock:
+            self._points[name] = _Failpoint(
+                name=name, mode=mode, probability=probability,
+                times=times, delay_s=delay_s,
+            )
+        logger.debug("armed failpoint %s mode=%s p=%s times=%s", name, mode, probability, times)
+
+    def disarm(self, name: str) -> None:
+        with self._lock:
+            self._points.pop(name, None)
+
+    def reset(self, seed: int | None = None) -> None:
+        """Disarm everything and reseed the RNG (chaos-test fixture hook)."""
+        with self._lock:
+            self._points.clear()
+            self._rng = random.Random(self._seed if seed is None else seed)
+            if seed is not None:
+                self._seed = seed
+
+    def configure_from_env(self, env: dict[str, str] | None = None) -> None:
+        env = os.environ if env is None else env
+        seed = env.get(ENV_SEED)
+        if seed is not None:
+            self.reset(seed=int(seed))
+        spec = env.get(ENV_FAILPOINTS, "")
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            self._arm_from_spec(part)
+
+    def _arm_from_spec(self, spec: str) -> None:
+        name, _, rest = spec.partition("=")
+        mode, probability, times, delay_s = MODE_ERROR, 1.0, None, 0.0
+        for tok in filter(None, rest.split(":")):
+            if tok in _MODES:
+                mode = tok
+            elif tok.startswith("p="):
+                probability = float(tok[2:])
+            elif tok.startswith("times="):
+                times = int(tok[6:])
+            elif tok.startswith("delay="):
+                delay_s = float(tok[6:])
+            else:
+                raise ValueError(f"bad failpoint spec token {tok!r} in {spec!r}")
+        self.arm(name, mode=mode, probability=probability, times=times, delay_s=delay_s)
+
+    # -- introspection ----------------------------------------------------
+
+    def is_armed(self, name: str) -> bool:
+        with self._lock:
+            return name in self._points
+
+    def stats(self, name: str) -> tuple[int, int]:
+        """Return ``(hits, fired)`` for a failpoint (0, 0 if never armed)."""
+        with self._lock:
+            fp = self._points.get(name)
+            return (fp.hits, fp.fired) if fp is not None else (0, 0)
+
+    # -- firing -----------------------------------------------------------
+
+    def _roll(self, name: str) -> _Failpoint | None:
+        """Decide whether the named failpoint fires; returns it if so."""
+        with self._lock:
+            fp = self._points.get(name)
+            if fp is None:
+                return None
+            fp.hits += 1
+            if fp.times is not None and fp.times <= 0:
+                return None
+            if fp.probability < 1.0 and self._rng.random() >= fp.probability:
+                return None
+            if fp.times is not None:
+                fp.times -= 1
+            fp.fired += 1
+            return fp
+
+    def should_fire(self, name: str) -> bool:
+        """Custom-mode check: True when the call site should inject its fault."""
+        return self._roll(name) is not None
+
+    def hit(self, name: str) -> None:
+        """Standard hook: raise/sleep per the armed mode, no-op otherwise."""
+        fp = self._roll(name)
+        if fp is None:
+            return
+        logger.warning("failpoint %s fired (mode=%s, count=%d)", name, fp.mode, fp.fired)
+        if fp.delay_s > 0.0:
+            time.sleep(fp.delay_s)
+        if fp.mode == MODE_ERROR:
+            raise FaultInjected(name)
+
+
+# Process-wide registry; chaos tests arm/reset it, prod leaves it empty.
+failpoints = FailpointRegistry(seed=int(os.environ.get(ENV_SEED, "0")))
+if os.environ.get(ENV_FAILPOINTS):
+    failpoints.configure_from_env()

@@ -1,0 +1,71 @@
+"""Pod liveness tracking for degraded-mode scoring.
+
+The event :class:`~llmd_kv_cache_tpu.events.pool.Pool` touches a pod
+every time it processes one of its events; scorers multiply each pod's
+score by :meth:`PodLivenessTracker.factor`.  A pod that stops emitting
+events (crashed, partitioned, wedged publisher) decays linearly from
+full weight at ``stale_after_s`` to zero at ``drop_after_s``, so the
+router shifts traffic away gradually and finally falls back to
+round-robin rather than routing to a corpse with a stale index view.
+
+Pods the tracker has never seen score at full weight: a fresh indexer
+(or one tracking pods discovered out-of-band) must not zero the fleet.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+
+class PodLivenessTracker:
+    def __init__(
+        self,
+        stale_after_s: float = 30.0,
+        drop_after_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if drop_after_s <= stale_after_s:
+            raise ValueError(
+                f"drop_after_s ({drop_after_s}) must exceed stale_after_s ({stale_after_s})"
+            )
+        self.stale_after_s = stale_after_s
+        self.drop_after_s = drop_after_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+
+    def touch(self, pod: str) -> None:
+        with self._lock:
+            self._last_seen[pod] = self._clock()
+
+    def mark_removed(self, pod: str) -> None:
+        with self._lock:
+            self._last_seen.pop(pod, None)
+
+    def last_seen(self, pod: str) -> float | None:
+        with self._lock:
+            return self._last_seen.get(pod)
+
+    def staleness(self, pod: str) -> float | None:
+        """Seconds since the pod's last event, or None if never seen."""
+        with self._lock:
+            ts = self._last_seen.get(pod)
+        return None if ts is None else max(0.0, self._clock() - ts)
+
+    def factor(self, pod: str) -> float:
+        """Score multiplier in [0, 1]: 1 fresh, linear decay, 0 dead."""
+        age = self.staleness(pod)
+        if age is None or age <= self.stale_after_s:
+            return 1.0
+        if age >= self.drop_after_s:
+            return 0.0
+        span = self.drop_after_s - self.stale_after_s
+        return 1.0 - (age - self.stale_after_s) / span
+
+    def snapshot(self) -> Dict[str, float]:
+        """Current factor per tracked pod (observability hook)."""
+        with self._lock:
+            pods = list(self._last_seen)
+        return {p: self.factor(p) for p in pods}

@@ -117,6 +117,15 @@ class Indexer:
         if hasattr(self.scorer, "group_catalog"):
             self.scorer.group_catalog = group_catalog
 
+    def attach_liveness(self, liveness) -> None:
+        """Wire the event pool's PodLivenessTracker into scoring: pods whose
+        event stream went silent are demoted (stale index views overstate
+        what the pod still holds) and eventually dropped, so routing decays
+        toward the picker's round-robin fallback instead of pinning traffic
+        on a corpse. Applied inside the Python scorers and post-hoc on the
+        native fused fast path."""
+        self.scorer.liveness = liveness
+
     def compute_block_keys(
         self,
         tokens: Sequence[int],
@@ -159,7 +168,9 @@ class Indexer:
                 )
                 span.set_attribute("block_hit_count", hit_count)
                 span.set_attribute("block_hit_ratio", hit_count / len(block_keys))
-                return scores
+                # The C++ fused path knows nothing about liveness; apply the
+                # same degraded-mode weighting the Python scorers use.
+                return self.scorer._apply_liveness(scores)
 
             key_to_pods = self.kv_block_index.lookup(block_keys, pod_identifiers)
             span.set_attribute("block_hit_count", len(key_to_pods))

@@ -81,10 +81,28 @@ class LongestPrefixScorer:
             if medium_weights is not None
             else {b.name: b.weight for b in default_backend_configs()}
         )
+        # Optional PodLivenessTracker (resilience.liveness), attached by
+        # the host (Indexer.attach_liveness): demotes pods whose event
+        # stream — and therefore whose index view — has gone stale.
+        self.liveness = None
 
     @property
     def strategy(self) -> str:
         return LONGEST_PREFIX_MATCH
+
+    def _apply_liveness(self, scores: dict[str, float]) -> dict[str, float]:
+        """Degraded-mode weighting: multiply each pod's score by its
+        liveness factor (1 fresh → 0 dead) and drop zeroed pods. With every
+        pod stale, scores empty out and the router falls back to
+        round-robin — degrading toward fairness, never toward a corpse."""
+        if self.liveness is None or not scores:
+            return scores
+        out = {}
+        for pod, s in scores.items():
+            f = self.liveness.factor(pod)
+            if s * f > 0.0:
+                out[pod] = s * f
+        return out
 
     def _fill_max_weights(
         self, entries: Sequence[PodEntry]
@@ -120,7 +138,7 @@ class LongestPrefixScorer:
                 else:
                     active.discard(pod)
 
-        return pod_scores
+        return self._apply_liveness(pod_scores)
 
 
 class HybridAwareScorer(LongestPrefixScorer):
@@ -259,7 +277,8 @@ class HybridAwareScorer(LongestPrefixScorer):
                     gv = self._window_value(blocks, len(keys), wb)
                 value = gv if value is None else min(value, gv)
             scores[pod] = value or 0.0
-        return {p: v for p, v in scores.items() if v > 0.0}
+        return self._apply_liveness(
+            {p: v for p, v in scores.items() if v > 0.0})
 
     @property
     def strategy(self) -> str:

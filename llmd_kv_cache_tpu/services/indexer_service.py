@@ -32,6 +32,8 @@ import msgpack
 from ..events.pool import Pool, PoolConfig
 from ..events.subscriber_manager import SubscriberManager
 from ..events.zmq_subscriber import ZMQSubscriber
+from ..resilience.failpoints import FaultInjected, failpoints
+from ..resilience.policy import RetryExhausted, RetryPolicy, call_with_retry
 from ..scoring.indexer import Indexer, IndexerConfig
 from ..utils.logging import get_logger
 from ..utils.net import grpc_target
@@ -40,6 +42,49 @@ logger = get_logger("services.indexer")
 
 SERVICE_NAME = "kvtpu.indexer.IndexerService"
 PROTO_SERVICE_NAME = "indexer.v1.IndexerService"
+
+# Error-mode fires at the entry of every outgoing scoring RPC (chaos:
+# flaky indexer deployment). Injected faults retry like transport errors.
+FP_INDEXER_RPC = "services.indexer.rpc"
+
+# Scoring sits on the scheduler hot path: one fast retry, then give up
+# and let the picker fall back to round-robin.
+DEFAULT_RPC_RETRY_POLICY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.05, max_delay_s=0.5, deadline_s=5.0
+)
+
+
+_RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
+
+
+def _retryable(exc: BaseException) -> bool:
+    """Transient transport failures only; application-level status codes
+    (FAILED_PRECONDITION, INVALID_ARGUMENT, …) are deterministic and must
+    surface to the caller untouched."""
+    if isinstance(exc, FaultInjected):
+        return True
+    if isinstance(exc, grpc.RpcError):
+        code = exc.code() if callable(getattr(exc, "code", None)) else None
+        return code in _RETRYABLE_CODES
+    return False
+
+
+def _call_rpc(rpc, request, timeout: float, policy: RetryPolicy):
+    """One unary scoring RPC under the retry policy. On exhaustion the
+    last underlying error is re-raised so callers keep the grpc.RpcError
+    contract (status code inspection, etc.)."""
+    def attempt():
+        failpoints.hit(FP_INDEXER_RPC)
+        return rpc(request, timeout=timeout)
+
+    try:
+        return call_with_retry(attempt, policy, retryable=_retryable)
+    except RetryExhausted as e:
+        raise e.__cause__
 
 
 @dataclass
@@ -108,6 +153,11 @@ class IndexerService:
         # Hybrid-aware scoring reads the pool's learned group catalog
         # (no-op for the default longest-prefix strategy).
         self.indexer.attach_group_catalog(self.pool.group_catalog)
+        # Degraded-mode scoring: pods whose event stream went silent are
+        # demoted, then dropped (resilience.liveness). None when the pool's
+        # liveness knobs are disabled.
+        if self.pool.liveness is not None:
+            self.indexer.attach_liveness(self.pool.liveness)
 
     def start(self) -> None:
         """Start the event plane: workers plus, in centralized mode, a
@@ -214,9 +264,11 @@ def serve(
 class IndexerServiceClient:
     """Scheduler-side client for GetPodScores."""
 
-    def __init__(self, address: str, timeout_s: float = 5.0):
+    def __init__(self, address: str, timeout_s: float = 5.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self._channel = grpc.insecure_channel(grpc_target(address))
         self._timeout = timeout_s
+        self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
         self._get_pod_scores = self._channel.unary_unary(
             f"/{SERVICE_NAME}/GetPodScores",
             request_serializer=lambda r: r.to_bytes(),
@@ -229,13 +281,15 @@ class IndexerServiceClient:
         model_name: str,
         pod_identifiers: Optional[list[str]] = None,
     ) -> dict[str, float]:
-        resp = self._get_pod_scores(
+        resp = _call_rpc(
+            self._get_pod_scores,
             ScoreRequest(
                 tokens=list(tokens),
                 model_name=model_name,
                 pod_identifiers=list(pod_identifiers or []),
             ),
-            timeout=self._timeout,
+            self._timeout,
+            self.retry_policy,
         )
         if resp.error:
             raise RuntimeError(f"GetPodScores failed: {resp.error}")
@@ -254,12 +308,14 @@ class IndexerPbClient:
     ``api/indexerpb/indexer.proto``.
     """
 
-    def __init__(self, address: str, timeout_s: float = 5.0):
+    def __init__(self, address: str, timeout_s: float = 5.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         from .indexerpb import indexer_pb2
 
         self._pb = indexer_pb2
         self._channel = grpc.insecure_channel(grpc_target(address))
         self._timeout = timeout_s
+        self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
         self._get_pod_scores = self._channel.unary_unary(
             f"/{PROTO_SERVICE_NAME}/GetPodScores",
             request_serializer=indexer_pb2.GetPodScoresRequest.SerializeToString,
@@ -272,13 +328,15 @@ class IndexerPbClient:
         model_name: str,
         pod_identifiers: Optional[list[str]] = None,
     ) -> dict[str, float]:
-        resp = self._get_pod_scores(
+        resp = _call_rpc(
+            self._get_pod_scores,
             self._pb.GetPodScoresRequest(
                 prompt=prompt,
                 model_name=model_name,
                 pod_identifiers=list(pod_identifiers or []),
             ),
-            timeout=self._timeout,
+            self._timeout,
+            self.retry_policy,
         )
         return {s.pod: s.score for s in resp.scores}
 

@@ -10,12 +10,13 @@ for ``Indexer.score_tokens``.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import grpc
 
 from ...core.extra_keys import BlockExtraFeatures, PlaceholderRange, compute_block_extra_features
+from ...resilience.failpoints import FaultInjected, failpoints
+from ...resilience.policy import RetryPolicy, RetryExhausted, call_with_retry
 from ...utils.logging import get_logger
 from ...utils.net import grpc_target
 from .messages import (
@@ -35,11 +36,49 @@ logger = get_logger("services.tokenizer.client")
 _INIT_RETRIES = 5
 _INIT_BACKOFF_S = 0.5
 
+# Error-mode fires at the entry of every outgoing RPC (chaos: flaky
+# tokenizer sidecar). Injected faults are retried like transport errors.
+FP_TOKENIZER_RPC = "services.tokenizer.rpc"
+
+# Data-path RPCs ride the request hot path, so the budget is tight: one
+# fast retry absorbs a transient blip, anything longer surfaces to the
+# caller. Init gets its own longer policy (server may still be starting).
+DEFAULT_RPC_RETRY_POLICY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.05, max_delay_s=0.5, deadline_s=5.0
+)
+_INIT_RETRY_POLICY = RetryPolicy(
+    max_attempts=_INIT_RETRIES, base_delay_s=_INIT_BACKOFF_S, max_delay_s=5.0
+)
+
+
+class _InitFailed(Exception):
+    """Application-level init failure (bad model name etc.): deterministic,
+    retrying cannot help."""
+
+
+_RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
+
+
+def _retryable(exc: BaseException) -> bool:
+    """Transient transport failures only; deterministic status codes
+    surface to the caller untouched."""
+    if isinstance(exc, FaultInjected):
+        return True
+    if isinstance(exc, grpc.RpcError):
+        code = exc.code() if callable(getattr(exc, "code", None)) else None
+        return code in _RETRYABLE_CODES
+    return False
+
 
 class UdsTokenizerClient:
     """Blocking client for the tokenizer sidecar."""
 
-    def __init__(self, address: str, timeout_s: float = 30.0):
+    def __init__(self, address: str, timeout_s: float = 30.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self._channel = grpc.insecure_channel(
             grpc_target(address),
             options=[
@@ -49,6 +88,7 @@ class UdsTokenizerClient:
             ],
         )
         self._timeout = timeout_s
+        self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
         self._initialized_models: set[str] = set()
 
         def unary(method, req_serializer, resp_deserializer):
@@ -73,32 +113,47 @@ class UdsTokenizerClient:
             "RenderChatCompletion", lambda r: r.to_bytes(), RenderChatResponse.from_bytes
         )
 
+    def _call(self, rpc, request):
+        """Issue one unary RPC under the retry policy; transient transport
+        errors and injected faults are retried. On exhaustion the last
+        underlying error is re-raised so callers keep the grpc.RpcError
+        contract."""
+        def attempt():
+            failpoints.hit(FP_TOKENIZER_RPC)
+            return rpc(request, timeout=self._timeout)
+
+        try:
+            return call_with_retry(
+                attempt, self.retry_policy, retryable=_retryable
+            )
+        except RetryExhausted as e:
+            raise e.__cause__
+
     def initialize(self, model_name: str) -> None:
         """Eager per-model init with bounded retry/backoff
-        (``uds_tokenizer.go:162-193``)."""
+        (``uds_tokenizer.go:162-193``). Transport failures (server still
+        starting) retry; application-level failures are deterministic and
+        fail fast."""
         if model_name in self._initialized_models:
             return
-        last_error = None
-        for attempt in range(_INIT_RETRIES):
-            try:
-                resp = self._init(
-                    InitializeTokenizerRequest(model_name), timeout=self._timeout
-                )
-                if resp.success:
-                    self._initialized_models.add(model_name)
-                    return
-                # Application-level failure (bad model name etc.) is
-                # deterministic: retrying cannot help.
-                last_error = resp.error
-                break
-            except grpc.RpcError as e:
-                # Transport failures (server still starting) are retryable.
-                last_error = str(e)
-                if attempt < _INIT_RETRIES - 1:
-                    time.sleep(_INIT_BACKOFF_S * (attempt + 1))
-        raise RuntimeError(
-            f"tokenizer init failed for {model_name}: {last_error}"
-        )
+
+        def attempt():
+            failpoints.hit(FP_TOKENIZER_RPC)
+            resp = self._init(
+                InitializeTokenizerRequest(model_name), timeout=self._timeout
+            )
+            if not resp.success:
+                raise _InitFailed(resp.error)
+            return resp
+
+        try:
+            call_with_retry(attempt, _INIT_RETRY_POLICY, retryable=_retryable)
+        except (_InitFailed, RetryExhausted, grpc.RpcError) as e:
+            cause = e.__cause__ if isinstance(e, RetryExhausted) else e
+            raise RuntimeError(
+                f"tokenizer init failed for {model_name}: {cause}"
+            ) from e
+        self._initialized_models.add(model_name)
 
     def encode(
         self,
@@ -107,14 +162,14 @@ class UdsTokenizerClient:
         add_special_tokens: bool = True,
         return_offsets: bool = False,
     ) -> TokenizeResponse:
-        resp = self._tokenize(
+        resp = self._call(
+            self._tokenize,
             TokenizeRequest(
                 model_name=model_name,
                 text=text,
                 add_special_tokens=add_special_tokens,
                 return_offsets=return_offsets,
             ),
-            timeout=self._timeout,
         )
         if resp.error:
             raise RuntimeError(f"tokenize failed: {resp.error}")
@@ -122,12 +177,12 @@ class UdsTokenizerClient:
 
     def render(self, model_name: str, prompt: str,
                add_special_tokens: bool = True) -> list[int]:
-        resp = self._render_completion(
+        resp = self._call(
+            self._render_completion,
             RenderCompletionRequest(
                 model_name=model_name, prompt=prompt,
                 add_special_tokens=add_special_tokens,
             ),
-            timeout=self._timeout,
         )
         if resp.error:
             raise RuntimeError(f"render failed: {resp.error}")
@@ -142,7 +197,8 @@ class UdsTokenizerClient:
         tools: Optional[list[dict]] = None,
         **template_kwargs,
     ) -> RenderChatResponse:
-        resp = self._render_chat(
+        resp = self._call(
+            self._render_chat,
             RenderChatRequest(
                 model_name=model_name,
                 messages=messages,
@@ -151,7 +207,6 @@ class UdsTokenizerClient:
                 tools=tools,
                 template_kwargs=template_kwargs,
             ),
-            timeout=self._timeout,
         )
         if resp.error:
             raise RuntimeError(f"render chat failed: {resp.error}")

@@ -85,10 +85,11 @@ class TestMultiBlockRoundTrip:
                             blocks=[[p] for p in pages])
             res = wait_results(handlers, handlers.async_store_spans([span]))
             assert res.success
-            # One file on disk holding all four slots.
+            # One file on disk holding all four slots plus the CRC footer.
             path = handlers.mapper.block_path(0xF11E, 0)
             import os
-            assert os.path.getsize(path) == handlers.file_bytes
+            assert os.path.getsize(path) == (
+                handlers.file_bytes + handlers.footer_bytes())
 
             handlers.copier.k_cache = handlers.copier.k_cache.at[:, pages].set(0)
             handlers.copier.v_cache = handlers.copier.v_cache.at[:, pages].set(0)

@@ -1,0 +1,384 @@
+"""Unit tests for the resilience primitives (docs/resilience.md).
+
+Each module gets a focused suite with injected clocks/RNGs — no sleeps,
+no sockets: failpoint registry semantics (env spec grammar, times /
+probability budgets, determinism), retry/backoff math and exhaustion,
+circuit-breaker state transitions, the CRC32 offload footer, pod
+liveness decay, and the FailoverIndex primary/fallback contract.
+The live end-to-end chaos paths are in test_failure_recovery.py.
+"""
+
+import random
+
+import pytest
+
+from llmd_kv_cache_tpu.core.keys import TIER_TPU_HBM, PodEntry
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    FailoverIndex,
+    FaultInjected,
+    IntegrityError,
+    PodLivenessTracker,
+    RetryExhausted,
+    RetryPolicy,
+    build_footer,
+    call_with_retry,
+    failpoints,
+    footer_size,
+    parse_footer,
+    slot_crcs,
+)
+from llmd_kv_cache_tpu.resilience.failpoints import FailpointRegistry
+from llmd_kv_cache_tpu.resilience.integrity import verify_slots
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset(seed=1337)
+    yield
+    failpoints.reset()
+
+
+class TestFailpointRegistry:
+    def test_disarmed_is_noop(self):
+        failpoints.hit("nope.never.armed")  # must not raise
+        assert not failpoints.should_fire("nope.never.armed")
+        assert failpoints.stats("nope.never.armed") == (0, 0)
+
+    def test_error_mode_raises_with_name(self):
+        failpoints.arm("x.y", mode="error")
+        with pytest.raises(FaultInjected) as ei:
+            failpoints.hit("x.y")
+        assert ei.value.failpoint == "x.y"
+
+    def test_times_budget(self):
+        failpoints.arm("x.y", times=2)
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                failpoints.hit("x.y")
+        failpoints.hit("x.y")  # budget spent: no-op
+        hits, fired = failpoints.stats("x.y")
+        assert (hits, fired) == (3, 2)
+
+    def test_probability_is_seed_deterministic(self):
+        def run(seed):
+            reg = FailpointRegistry(seed=seed)
+            reg.arm("p", probability=0.5)
+            return [reg.should_fire("p") for _ in range(32)]
+
+        seq = run(7)
+        assert run(7) == seq  # same seed replays exactly
+        assert any(seq) and not all(seq)
+
+    def test_custom_mode_should_fire(self):
+        failpoints.arm("c", mode="custom", times=1)
+        assert failpoints.should_fire("c")
+        assert not failpoints.should_fire("c")
+
+    def test_env_spec_grammar(self):
+        reg = FailpointRegistry()
+        reg.configure_from_env({
+            "KVTPU_FAILPOINTS":
+                "a.b=error:times=2, c.d=custom:p=0.5 ,e.f=delay:delay=0.01",
+            "KVTPU_FAILPOINT_SEED": "99",
+        })
+        for name in ("a.b", "c.d", "e.f"):
+            assert reg.is_armed(name)
+        with pytest.raises(FaultInjected):
+            reg.hit("a.b")
+
+    def test_bad_spec_rejected(self):
+        reg = FailpointRegistry()
+        with pytest.raises(ValueError):
+            reg._arm_from_spec("a.b=error:bogus=1")
+        with pytest.raises(ValueError):
+            reg.arm("x", mode="explode")
+        with pytest.raises(ValueError):
+            reg.arm("x", probability=1.5)
+
+    def test_reset_disarms(self):
+        failpoints.arm("x.y")
+        failpoints.reset()
+        failpoints.hit("x.y")  # no-op again
+
+
+class TestRetryPolicy:
+    def test_delay_grows_and_caps(self):
+        p = RetryPolicy(base_delay_s=0.1, max_delay_s=0.5, multiplier=2.0,
+                        jitter=False)
+        assert [p.delay(n) for n in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+    def test_jitter_stays_under_cap(self):
+        p = RetryPolicy(base_delay_s=0.1, max_delay_s=0.5, jitter=True)
+        rng = random.Random(3)
+        for n in range(6):
+            assert 0.0 <= p.delay(n, rng) <= 0.5
+
+    def test_retry_until_success(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("flaky")
+            return "ok"
+
+        out = call_with_retry(fn, RetryPolicy(max_attempts=5, jitter=False),
+                              sleep=lambda s: None)
+        assert out == "ok" and len(calls) == 3
+
+    def test_exhaustion_chains_last_error(self):
+        def fn():
+            raise OSError("down")
+
+        with pytest.raises(RetryExhausted) as ei:
+            call_with_retry(fn, RetryPolicy(max_attempts=2, jitter=False),
+                            sleep=lambda s: None)
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            call_with_retry(
+                fn, RetryPolicy(max_attempts=5),
+                retryable=lambda e: isinstance(e, OSError),
+                sleep=lambda s: None,
+            )
+        assert len(calls) == 1  # no second attempt for a non-transient error
+
+    def test_deadline_stops_early(self):
+        now = [0.0]
+
+        def fn():
+            raise OSError("slow outage")
+
+        with pytest.raises(RetryExhausted):
+            call_with_retry(
+                fn,
+                RetryPolicy(max_attempts=50, base_delay_s=1.0, jitter=False,
+                            deadline_s=2.5),
+                clock=lambda: now[0],
+                sleep=lambda s: now.__setitem__(0, now[0] + s),
+            )
+        assert now[0] <= 2.5  # gave up at the deadline, not after 50 tries
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock):
+        return CircuitBreaker(target="t", failure_threshold=3,
+                              reset_timeout_s=10.0, clock=clock)
+
+    def test_opens_after_threshold_then_recovers(self):
+        now = [0.0]
+        b = self._breaker(lambda: now[0])
+        assert b.state == "closed"
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+
+        now[0] = 10.0  # reset timeout elapsed: one probe allowed
+        assert b.state == "half_open"
+        assert b.allow()
+        assert not b.allow()  # probe slot already claimed
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_half_open_failure_reopens(self):
+        now = [0.0]
+        b = self._breaker(lambda: now[0])
+        for _ in range(3):
+            b.record_failure()
+        now[0] = 10.0
+        assert b.allow()
+        b.record_failure()  # probe failed
+        assert b.state == "open"
+        assert not b.allow()
+
+    def test_call_raises_circuit_open_with_retry_after(self):
+        now = [0.0]
+        b = self._breaker(lambda: now[0])
+        for _ in range(3):
+            with pytest.raises(OSError):
+                b.call(lambda: (_ for _ in ()).throw(OSError("x")))
+        now[0] = 4.0
+        with pytest.raises(CircuitOpenError) as ei:
+            b.call(lambda: "unreachable")
+        assert ei.value.retry_after_s == pytest.approx(6.0)
+
+    def test_success_resets_failure_streak(self):
+        b = self._breaker(lambda: 0.0)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"  # streak restarted, threshold not met
+
+
+class TestIntegrityFooter:
+    def test_roundtrip(self):
+        bufs = [b"hello", b"world!", bytes(range(64))]
+        footer = build_footer(slot_crcs(bufs))
+        assert len(footer) == footer_size(len(bufs))
+        assert parse_footer(footer, len(bufs)) == slot_crcs(bufs)
+        verify_slots(bufs, footer)  # no raise
+
+    def test_bit_flip_detected(self):
+        bufs = [bytearray(b"payload-a"), bytearray(b"payload-b")]
+        footer = build_footer(slot_crcs(bufs))
+        bufs[1][3] ^= 0x01
+        with pytest.raises(IntegrityError, match="slot 1"):
+            verify_slots(bufs, footer)
+
+    def test_bad_magic(self):
+        footer = bytearray(build_footer(slot_crcs([b"x"])))
+        footer[-8:-4] = b"XXXX"
+        with pytest.raises(IntegrityError, match="magic"):
+            parse_footer(bytes(footer), 1)
+
+    def test_wrong_slot_count_and_truncation(self):
+        footer = build_footer(slot_crcs([b"a", b"b"]))
+        with pytest.raises(IntegrityError):
+            parse_footer(footer, 3)  # length mismatch
+        with pytest.raises(IntegrityError):
+            parse_footer(footer[:-1], 2)  # truncated tail
+
+
+class TestPodLivenessTracker:
+    def _tracker(self, clock):
+        return PodLivenessTracker(stale_after_s=10.0, drop_after_s=30.0,
+                                  clock=lambda: clock[0])
+
+    def test_decay_curve(self):
+        clock = [0.0]
+        t = self._tracker(clock)
+        t.touch("p")
+        assert t.factor("p") == 1.0
+        clock[0] = 10.0
+        assert t.factor("p") == 1.0  # exactly at the stale edge
+        clock[0] = 20.0
+        assert t.factor("p") == pytest.approx(0.5)
+        clock[0] = 30.0
+        assert t.factor("p") == 0.0
+
+    def test_unknown_pod_scores_full(self):
+        t = self._tracker([0.0])
+        assert t.factor("never-seen") == 1.0
+        assert t.last_seen("never-seen") is None
+        assert t.staleness("never-seen") is None
+
+    def test_mark_removed_forgets(self):
+        clock = [0.0]
+        t = self._tracker(clock)
+        t.touch("p")
+        t.mark_removed("p")
+        clock[0] = 100.0
+        assert t.factor("p") == 1.0  # unknown again, not dead
+
+    def test_snapshot(self):
+        clock = [0.0]
+        t = self._tracker(clock)
+        t.touch("a")
+        clock[0] = 20.0
+        t.touch("b")
+        snap = t.snapshot()
+        assert snap["b"] == 1.0 and snap["a"] == pytest.approx(0.5)
+
+    def test_invalid_thresholds_rejected(self):
+        with pytest.raises(ValueError):
+            PodLivenessTracker(stale_after_s=30.0, drop_after_s=30.0)
+
+
+class _FlakyIndex:
+    """Index test double whose every op raises until healed."""
+
+    def __init__(self):
+        self.down = False
+        self.store = InMemoryIndex(InMemoryIndexConfig())
+
+    def _guard(self):
+        if self.down:
+            raise ConnectionError("primary down")
+
+    def lookup(self, request_keys, pod_identifier_set=None):
+        self._guard()
+        return self.store.lookup(request_keys, pod_identifier_set)
+
+    def add(self, engine_keys, request_keys, entries):
+        self._guard()
+        self.store.add(engine_keys, request_keys, entries)
+
+    def evict(self, key, key_type, entries):
+        self._guard()
+        self.store.evict(key, key_type, entries)
+
+    def get_request_key(self, engine_key):
+        self._guard()
+        return self.store.get_request_key(engine_key)
+
+    def clear(self, pod_identifier):
+        self._guard()
+        self.store.clear(pod_identifier)
+
+
+class TestFailoverIndex:
+    def _make(self, clock):
+        primary = _FlakyIndex()
+        idx = FailoverIndex(
+            primary,
+            InMemoryIndex(InMemoryIndexConfig()),
+            retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.001),
+            breaker=CircuitBreaker(target="t", failure_threshold=2,
+                                   reset_timeout_s=10.0,
+                                   clock=lambda: clock[0]),
+        )
+        return primary, idx
+
+    def test_writes_mirror_to_fallback(self):
+        clock = [0.0]
+        primary, idx = self._make(clock)
+        entry = PodEntry(pod_identifier="pod", device_tier=TIER_TPU_HBM)
+        idx.add(None, [1, 2], [entry])
+        assert set(idx.fallback.lookup([1, 2])) == {1, 2}
+        assert set(primary.store.lookup([1, 2])) == {1, 2}
+
+    def test_reads_fail_over_without_raising(self):
+        clock = [0.0]
+        primary, idx = self._make(clock)
+        entry = PodEntry(pod_identifier="pod", device_tier=TIER_TPU_HBM)
+        idx.add(None, [1], [entry])
+        primary.down = True
+        assert set(idx.lookup([1])) == {1}  # served by the fallback
+        assert idx.failovers == 1
+
+    def test_breaker_opens_and_write_is_absorbed(self):
+        clock = [0.0]
+        primary, idx = self._make(clock)
+        entry = PodEntry(pod_identifier="pod", device_tier=TIER_TPU_HBM)
+        primary.down = True
+        idx.lookup([1])
+        idx.lookup([2])
+        assert idx.breaker.state == "open"
+        idx.add(None, [3], [entry])  # no raise while the breaker is open
+        assert set(idx.lookup([3])) == {3}
+        assert 3 not in primary.store.lookup([3])  # primary missed the write
+
+    def test_probe_recloses_after_heal(self):
+        clock = [0.0]
+        primary, idx = self._make(clock)
+        primary.down = True
+        idx.lookup([1])
+        idx.lookup([1])
+        assert idx.breaker.state == "open"
+        primary.down = False
+        clock[0] = 10.0  # reset timeout elapsed: probe admitted
+        idx.lookup([1])
+        assert idx.breaker.state == "closed"
