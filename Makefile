@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -48,6 +48,12 @@ native:
 
 bench: native
 	$(PY) bench.py
+
+# Score/ingest hot-path microbenchmark (prefix cache, early-exit lookup,
+# batched ingestion) — pure CPU scheduling-path work, so it pins the CPU
+# backend unlike `make bench`.
+bench-hotpath: native
+	$(CPU_ENV) $(PY) hack/bench_hotpath.py
 
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).

@@ -283,18 +283,43 @@ def make_kv_router(indexer):
 
     This is the reference's "precise scheduling" strategy (the EPP
     scoring from this indexer, benchmarking/37-capacity README); the
-    factories below mirror its comparison strategies."""
+    factories below mirror its comparison strategies. Each score_tokens
+    call is timed into ``router.score_latencies`` so arms can report
+    scheduler overhead (see ``score_path_stats``)."""
     rr_counter = [0]
+    latencies: list = []
 
     def router(_i, prompt, names, loads=None):
+        t0 = time.perf_counter()
         scores = indexer.score_tokens(prompt, MODEL_NAME)
+        latencies.append(time.perf_counter() - t0)
         if scores:
             return max(scores.items(), key=lambda kv: kv[1])[0]
         pick = names[rr_counter[0] % len(names)]
         rr_counter[0] += 1
         return pick
 
+    router.score_latencies = latencies
     return router
+
+
+def score_path_stats(router, indexer) -> dict:
+    """Scheduler-overhead summary for a KV-routed arm: score_tokens
+    latency percentiles plus the token processor's prefix-cache hit
+    counters, so BENCH_r*.json tracks score-path cost over time."""
+    out = {}
+    lat = getattr(router, "score_latencies", None)
+    if lat:
+        out["score_tokens_p50_us"] = round(statistics.median(lat) * 1e6, 1)
+        out["score_tokens_p99_us"] = round(
+            float(np.quantile(lat, 0.99)) * 1e6, 1)
+        out["score_tokens_calls"] = len(lat)
+    pc = indexer.prefix_cache_stats()
+    if pc is not None:
+        out["prefix_cache_hit_rate"] = round(pc["block_hit_rate"], 4)
+        out["prefix_cache_hits"] = pc["hits"]
+        out["prefix_cache_misses"] = pc["misses"]
+    return out
 
 
 def make_rr_router(_indexer=None):
@@ -561,6 +586,11 @@ def bench_event_ingestion() -> dict:
         "value": round(n_msgs / elapsed),
         "unit": "events/s",
         "vs_baseline": 1.0,
+        # Batched-drain effectiveness (events/pool.py): messages per
+        # worker wakeup and index calls saved by digest coalescing.
+        "ingest_batches": pool.ingest_batches,
+        "ingest_messages": pool.ingest_messages,
+        "ingest_coalesced_ops": pool.coalesced_ops,
     }
 
 
@@ -697,8 +727,10 @@ def main(queued: bool = True) -> None:
     kv_indexer = fresh_indexer()
     kv_pods = make_pods(n_pods, model_cfg, engine_mod, kv_indexer,
                         params=shared_params, pod_kw=pod_kw)
+    kv_router = make_kv_router(kv_indexer)
     kv_svc, kv_chosen, kv_hit, _ = run_replay(
-        kv_pods, workload, router=make_kv_router(kv_indexer), tag="kv-aware")
+        kv_pods, workload, router=kv_router, tag="kv-aware")
+    score_path = score_path_stats(kv_router, kv_indexer)
     del kv_pods
 
     # Arm 3 (storage tier): prefixes live on shared storage (served once by
@@ -927,6 +959,9 @@ def main(queued: bool = True) -> None:
         "qps_sweep": sweep,
         "concurrent_sweep": conc_sweep,
         "strategy_comparison": strategy_comparison,
+        # Scheduler-side overhead of the serial replay's KV arm:
+        # score_tokens latency and prefix-cache effectiveness.
+        "score_path": score_path,
     }
     if decode_heavy:
         line["decode_heavy"] = decode_heavy

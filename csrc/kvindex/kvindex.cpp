@@ -262,10 +262,15 @@ class Index {
   // telemetry semantics and the LRU recency refresh of the lookup path.
   // Returns the number of (pod, score) pairs written, or -needed when
   // out_cap is too small (caller retries with a bigger buffer).
+  // early_exit != 0 stops the scan as soon as scoring is over (the prefix
+  // chain broke): scores are identical, but trailing resident blocks are
+  // neither counted in out_hits nor LRU-refreshed — the scheduler trades
+  // that for O(prefix) instead of O(prompt) scans.
   int Score(const uint64_t* keys, int n_keys, const int32_t* filter_pods,
             int n_filter, const int32_t* weight_tiers,
             const double* weight_values, int n_weights, int32_t* out_pods,
-            double* out_scores, int out_cap, int32_t* out_hits) {
+            double* out_scores, int out_cap, int32_t* out_hits,
+            int early_exit = 0) {
     std::lock_guard<std::mutex> lk(mu_);
 
     auto tier_weight = [&](int32_t tier) {
@@ -290,6 +295,7 @@ class Index {
     bool scoring = true;  // false once the prefix chain broke
     bool first = true;
     for (int ki = 0; ki < n_keys; ++ki) {
+      if (early_exit && !scoring) break;
       auto it = data_.find(keys[ki]);
       if (it == data_.end()) {
         // Absent key: the active prefix set empties (scoring over), but —
@@ -536,5 +542,18 @@ int kvidx_score(void* idx, const uint64_t* keys, int n_keys,
                                          weight_tiers, weight_values,
                                          n_weights, out_pods, out_scores,
                                          out_cap, out_hits);
+}
+
+// kvidx_score with an early-exit flag; kept as a separate symbol so older
+// callers of kvidx_score keep their ABI (full-scan semantics).
+int kvidx_score_ex(void* idx, const uint64_t* keys, int n_keys,
+                   const int32_t* filter_pods, int n_filter,
+                   const int32_t* weight_tiers, const double* weight_values,
+                   int n_weights, int32_t* out_pods, double* out_scores,
+                   int out_cap, int32_t* out_hits, int early_exit) {
+  return static_cast<Index*>(idx)->Score(keys, n_keys, filter_pods, n_filter,
+                                         weight_tiers, weight_values,
+                                         n_weights, out_pods, out_scores,
+                                         out_cap, out_hits, early_exit);
 }
 }

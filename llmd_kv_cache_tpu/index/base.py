@@ -79,6 +79,53 @@ class Index(abc.ABC):
         reset, e.g. after a weight rollout). O(N), off the hot path.
         """
 
+    def lookup_chunked(
+        self,
+        request_keys: Sequence[BlockHash],
+        pod_identifier_set: Optional[set[str]] = None,
+        chunk_size: int = 128,
+    ) -> dict[BlockHash, list[PodEntry]]:
+        """``lookup`` issued in chunks, stopping at the first chunk with
+        zero hits.
+
+        Sound for longest-prefix scoring only: the scorer counts
+        consecutive-from-0 runs, and an all-miss chunk proves the run ended
+        inside or before it, so later keys cannot contribute. The result
+        may therefore be a *subset* of a full ``lookup`` (hits after a gap
+        are skipped) — identical scores, fewer backend round-trips.
+        ``chunk_size <= 0`` degrades to a single full lookup.
+        """
+        n = len(request_keys)
+        if chunk_size <= 0 or n <= chunk_size:
+            return self.lookup(request_keys, pod_identifier_set)
+        result: dict[BlockHash, list[PodEntry]] = {}
+        for start in range(0, n, chunk_size):
+            chunk = request_keys[start:start + chunk_size]
+            found = self.lookup(chunk, pod_identifier_set)
+            if not found:
+                break
+            result.update(found)
+            # A partial chunk means some key in it missed, so the
+            # consecutive-from-0 run ends inside this chunk; later chunks
+            # cannot change any longest-prefix score.
+            if len(found) < len(chunk):
+                break
+        return result
+
+    def evict_batch(
+        self,
+        keys: Sequence[BlockHash],
+        key_type: KeyType,
+        entries: Sequence[PodEntry],
+    ) -> None:
+        """Evict the same pod entries from many keys.
+
+        Default loops ``evict``; backends override to amortize per-call
+        costs (one Redis pipeline, one native entry-packing pass).
+        """
+        for key in keys:
+            self.evict(key, key_type, entries)
+
 
 def infer_engine_mappings(
     engine_keys: Sequence[BlockHash], request_keys: Sequence[BlockHash]

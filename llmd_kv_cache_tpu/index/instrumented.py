@@ -54,6 +54,12 @@ class InstrumentedIndex(Index):
         self._inner.evict(key, key_type, entries)
         INDEX_EVICTIONS.inc()
 
+    def evict_batch(self, keys, key_type, entries):
+        # Delegate so the backend's batched implementation (pipelined
+        # Redis, packed-once native) isn't degraded to an evict loop.
+        self._inner.evict_batch(keys, key_type, entries)
+        INDEX_EVICTIONS.inc(len(keys))
+
     def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
         return self._inner.get_request_key(engine_key)
 
@@ -92,6 +98,13 @@ class TracedIndex(Index):
     def evict(self, key: BlockHash, key_type: KeyType, entries: Sequence[PodEntry]):
         with self._tracer.span("llm_d.kv_cache.index.evict", key_type=key_type.value):
             self._inner.evict(key, key_type, entries)
+
+    def evict_batch(self, keys, key_type: KeyType, entries: Sequence[PodEntry]):
+        with self._tracer.span(
+            "llm_d.kv_cache.index.evict_batch",
+            key_type=key_type.value, key_count=len(keys),
+        ):
+            self._inner.evict_batch(keys, key_type, entries)
 
     def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
         return self._inner.get_request_key(engine_key)

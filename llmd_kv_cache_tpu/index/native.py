@@ -93,6 +93,8 @@ def load_library() -> ctypes.CDLL:
             i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
             i32p, ctypes.POINTER(ctypes.c_double), ctypes.c_int, i32p,
         ]
+        lib.kvidx_score_ex.restype = ctypes.c_int
+        lib.kvidx_score_ex.argtypes = lib.kvidx_score.argtypes + [ctypes.c_int]
 
         _lib = lib
         return _lib
@@ -125,11 +127,21 @@ def hash_init(seed: str, model: str) -> int:
 
 def hash_chain(parent: int, tokens: Sequence[int], block_size: int) -> list[int]:
     """Chain-hash full text-only blocks natively."""
+    return hash_chain_with_array(parent, tokens, block_size)[0]
+
+
+def hash_chain_with_array(
+    parent: int, tokens: Sequence[int], block_size: int
+) -> tuple[list[int], np.ndarray]:
+    """Chain-hash natively, returning the keys both as a list and as the
+    ``uint64`` array the C++ call produced — callers that feed the keys
+    straight back into ``NativeIndex.score`` (the fused score path) keep
+    the array and skip a per-call ``asarray`` over thousands of keys."""
     lib = load_library()
     arr = np.asarray(tokens, np.uint32)
     n_blocks = len(arr) // block_size
     if n_blocks == 0:
-        return []
+        return [], np.empty(0, np.uint64)
     out = np.empty(n_blocks, np.uint64)
     n = lib.kvhash_chain(
         ctypes.c_uint64(parent & 0xFFFFFFFFFFFFFFFF),
@@ -137,7 +149,8 @@ def hash_chain(parent: int, tokens: Sequence[int], block_size: int) -> list[int]
         len(arr), block_size,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
     )
-    return [int(h) for h in out[:n]]
+    out = out[:n]
+    return [int(h) for h in out], out
 
 
 # -- native index -----------------------------------------------------------
@@ -295,23 +308,32 @@ class NativeIndex(Index):
     def evict(self, key, key_type, entries) -> None:
         if not entries:
             raise ValueError("no entries provided for eviction from index")
+        self.evict_batch([key], key_type, entries)
+
+    def evict_batch(self, keys, key_type, entries) -> None:
+        """Evict many keys with one entry-packing/interning pass."""
+        if not entries:
+            raise ValueError("no entries provided for eviction from index")
         pods, tiers, flags, groups = self._pack_entries(entries)
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        self._lib.kvidx_evict(
-            self._handle,
-            ctypes.c_uint64(key & 0xFFFFFFFFFFFFFFFF),
-            1 if key_type is KeyType.ENGINE else 0,
-            pods.ctypes.data_as(i32p), tiers.ctypes.data_as(i32p),
-            flags.ctypes.data_as(u8p), groups.ctypes.data_as(i32p),
-            len(entries),
-        )
+        is_engine = 1 if key_type is KeyType.ENGINE else 0
+        for key in keys:
+            self._lib.kvidx_evict(
+                self._handle,
+                ctypes.c_uint64(key & 0xFFFFFFFFFFFFFFFF),
+                is_engine,
+                pods.ctypes.data_as(i32p), tiers.ctypes.data_as(i32p),
+                flags.ctypes.data_as(u8p), groups.ctypes.data_as(i32p),
+                len(entries),
+            )
 
     def score(
         self,
         request_keys: Sequence[BlockHash],
         medium_weights: dict[str, float],
         pod_identifier_set=None,
+        early_exit: bool = False,
     ) -> tuple[dict[str, float], int]:
         """Fused lookup + longest-prefix tier-weighted scoring in C++.
 
@@ -320,8 +342,12 @@ class NativeIndex(Index):
         PodEntry objects. Returns ``(scores, hit_count)`` where hit_count
         is the Lookup-equivalent number of resident keys (telemetry).
         The scan also refreshes LRU recency like a lookup would.
+
+        ``early_exit=True`` stops the C++ scan once the prefix chain broke:
+        identical scores, but hit_count only covers the scanned prefix and
+        post-gap blocks are not LRU-refreshed.
         """
-        if not request_keys:
+        if len(request_keys) == 0:  # len() so ndarray keys are accepted
             return {}, 0
         keys = self._keys_array(request_keys)
         if pod_identifier_set:
@@ -338,13 +364,14 @@ class NativeIndex(Index):
         while True:
             out_pods = np.empty(cap, np.int32)
             out_scores = np.empty(cap, np.float64)
-            n = self._lib.kvidx_score(
+            n = self._lib.kvidx_score_ex(
                 self._handle,
                 keys.ctypes.data_as(u64p), len(keys),
                 filt.ctypes.data_as(i32p), len(filt),
                 wt.ctypes.data_as(i32p), wv.ctypes.data_as(f64p), len(wt),
                 out_pods.ctypes.data_as(i32p), out_scores.ctypes.data_as(f64p),
                 cap, hits.ctypes.data_as(i32p),
+                1 if early_exit else 0,
             )
             if n >= 0:
                 break

@@ -36,6 +36,46 @@ INDEX_LOOKUP_LATENCY = Histogram(
     buckets=(1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0),
 )
 
+# Score-path hot-loop families (docs/architecture.md "Score-path
+# performance"): the prefix-key cache and batched event ingestion are
+# invisible in the index families above, so they get their own counters.
+PREFIX_CACHE_HIT_BLOCKS = Counter(
+    f"{_NS}_prefix_cache_hit_blocks_total",
+    "Block keys served from the token-processor prefix cache",
+)
+PREFIX_CACHE_MISS_BLOCKS = Counter(
+    f"{_NS}_prefix_cache_miss_blocks_total",
+    "Block keys hashed because the prefix cache had no covering prefix",
+)
+EVENT_INGEST_BATCHES = Counter(
+    "kvcache_event_ingest_batches_total",
+    "Worker drain batches processed by the event pool",
+)
+EVENT_INGEST_MESSAGES = Counter(
+    "kvcache_event_ingest_messages_total",
+    "Raw event messages ingested by the event pool",
+)
+EVENT_INGEST_COALESCED_OPS = Counter(
+    "kvcache_event_ingest_coalesced_ops_total",
+    "Index write calls saved by coalescing consecutive same-pod digests",
+)
+
+
+def record_prefix_cache_delta(hit_blocks: int, miss_blocks: int) -> None:
+    if hit_blocks > 0:
+        PREFIX_CACHE_HIT_BLOCKS.inc(hit_blocks)
+    if miss_blocks > 0:
+        PREFIX_CACHE_MISS_BLOCKS.inc(miss_blocks)
+
+
+def record_ingest_batch(messages: int, coalesced_ops: int) -> None:
+    EVENT_INGEST_BATCHES.inc()
+    if messages > 0:
+        EVENT_INGEST_MESSAGES.inc(messages)
+    if coalesced_ops > 0:
+        EVENT_INGEST_COALESCED_OPS.inc(coalesced_ops)
+
+
 TOKENIZATION_LATENCY = Histogram(
     "kvcache_tokenization_latency_seconds",
     "Tokenization / render latency",

@@ -103,6 +103,20 @@ class FailoverIndex(Index):
         self.fallback.evict(key, key_type, entries)
         self._write("evict", lambda: self.primary.evict(key, key_type, entries))
 
+    def evict_batch(
+        self,
+        keys: Sequence[BlockHash],
+        key_type: KeyType,
+        entries: Sequence[PodEntry],
+    ) -> None:
+        # One mirrored batch instead of N wrapped evicts: the primary's
+        # pipelined implementation stays engaged and the breaker counts
+        # one op per digest.
+        self.fallback.evict_batch(keys, key_type, entries)
+        self._write(
+            "evict_batch", lambda: self.primary.evict_batch(keys, key_type, entries)
+        )
+
     def get_request_key(self, engine_key: BlockHash) -> Optional[BlockHash]:
         return self._read(
             "get_request_key",

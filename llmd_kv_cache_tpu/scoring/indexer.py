@@ -30,11 +30,18 @@ class IndexerConfig:
     token_processor_config: TokenProcessorConfig = field(default_factory=TokenProcessorConfig)
     index_config: Optional[IndexConfig] = None
     scorer_config: KVBlockScorerConfig = field(default_factory=KVBlockScorerConfig)
+    # Early-exit chunked lookup: score_tokens looks blocks up in chunks of
+    # this many keys and stops at the first chunk that breaks the prefix
+    # chain (0 disables — single full lookup / full native scan). Only
+    # engaged for the LongestPrefix strategy; hybrid-aware scoring values
+    # blocks at any position.
+    lookup_chunk_size: int = 128
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
         if not d:
             return cls()
+        chunk = d.get("lookupChunkSize", d.get("lookup_chunk_size"))
         cfg = cls(
             token_processor_config=TokenProcessorConfig.from_dict(
                 d.get("tokenProcessorConfig", d.get("token_processor_config"))
@@ -42,6 +49,7 @@ class IndexerConfig:
             scorer_config=KVBlockScorerConfig.from_dict(
                 d.get("kvBlockScorerConfig", d.get("scorer_config"))
             ),
+            lookup_chunk_size=128 if chunk is None else chunk,
         )
         index_dict = d.get("kvBlockIndexConfig", d.get("index_config"))
         if index_dict:
@@ -110,6 +118,34 @@ class Indexer:
             if self.scorer.strategy == LONGEST_PREFIX_MATCH
             else None
         )
+        # Early-exit is only sound for consecutive-from-0 prefix scoring.
+        self._early_exit = (
+            self.config.lookup_chunk_size > 0
+            and self.scorer.strategy == LONGEST_PREFIX_MATCH
+        )
+        # Last-published prefix-cache snapshot, so each score_tokens call
+        # records only its delta into the Prometheus counters.
+        self._pc_hit_snapshot = 0
+        self._pc_miss_snapshot = 0
+
+    def prefix_cache_stats(self) -> Optional[dict]:
+        """Token-processor prefix-cache counters (None when disabled)."""
+        return self.token_processor.prefix_cache_stats()
+
+    def _record_prefix_cache_metrics(self) -> None:
+        stats = self.token_processor.prefix_cache_stats()
+        if stats is None:
+            return
+        hit_d = stats["hit_blocks"] - self._pc_hit_snapshot
+        miss_d = stats["miss_blocks"] - self._pc_miss_snapshot
+        self._pc_hit_snapshot = stats["hit_blocks"]
+        self._pc_miss_snapshot = stats["miss_blocks"]
+        try:
+            from ..metrics.collector import record_prefix_cache_delta
+
+            record_prefix_cache_delta(hit_d, miss_d)
+        except Exception:  # pragma: no cover - metrics must never break scoring  # lint: allow-swallow
+            pass
 
     def attach_group_catalog(self, group_catalog) -> None:
         """Wire the event pool's GroupCatalog into hybrid-aware scoring
@@ -157,14 +193,19 @@ class Indexer:
             token_count=len(tokens),
             pod_count=len(pod_identifiers) if pod_identifiers else 0,
         ) as span:
-            block_keys = self.compute_block_keys(tokens, model_name, extra_features)
+            block_keys, keys_arr = (
+                self.token_processor.tokens_to_kv_block_keys_with_array(
+                    0, tokens, model_name, extra_features))
             span.set_attribute("block_count", len(block_keys))
+            self._record_prefix_cache_metrics()
             if not block_keys:
                 return {}
 
             if self._native_score is not None:
                 scores, hit_count = self._native_score(
-                    block_keys, self.scorer.medium_weights, pod_identifiers
+                    keys_arr if keys_arr is not None else block_keys,
+                    self.scorer.medium_weights, pod_identifiers,
+                    early_exit=self._early_exit,
                 )
                 span.set_attribute("block_hit_count", hit_count)
                 span.set_attribute("block_hit_ratio", hit_count / len(block_keys))
@@ -172,7 +213,13 @@ class Indexer:
                 # same degraded-mode weighting the Python scorers use.
                 return self.scorer._apply_liveness(scores)
 
-            key_to_pods = self.kv_block_index.lookup(block_keys, pod_identifiers)
+            if self._early_exit:
+                key_to_pods = self.kv_block_index.lookup_chunked(
+                    block_keys, pod_identifiers,
+                    chunk_size=self.config.lookup_chunk_size,
+                )
+            else:
+                key_to_pods = self.kv_block_index.lookup(block_keys, pod_identifiers)
             span.set_attribute("block_hit_count", len(key_to_pods))
             span.set_attribute("block_hit_ratio", len(key_to_pods) / len(block_keys))
 
