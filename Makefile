@@ -20,9 +20,11 @@ chaos: native
 	$(CPU_ENV) KVTPU_FAILPOINT_SEED=1337 $(PY) -m pytest tests/ -q -m chaos
 
 # Resilience lint: no bare `except:` / silently-swallowed exceptions in
-# the library (hack/lint_resilience.py).
+# the library (hack/lint_resilience.py). Observability lint: span/metric
+# naming conventions + docs coverage (hack/lint_observability.py).
 lint:
 	$(PY) hack/lint_resilience.py llmd_kv_cache_tpu
+	$(PY) hack/lint_observability.py llmd_kv_cache_tpu
 
 # Concurrency-focused pass (the reference runs `go test -race` nightly;
 # Python has no race detector, so the thread-heavy suites are repeated —

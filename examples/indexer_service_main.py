@@ -17,11 +17,15 @@ from llmd_kv_cache_tpu.events.pool import PoolConfig
 from llmd_kv_cache_tpu.events.reconciler import FileDiscovery, PodReconciler
 from llmd_kv_cache_tpu.scoring import IndexerConfig
 from llmd_kv_cache_tpu.services.indexer_service import IndexerService, serve
+from llmd_kv_cache_tpu.telemetry import install_signal_dump
 from llmd_kv_cache_tpu.utils.logging import configure_from_env
 
 
 def main() -> None:
     configure_from_env()
+    # kill -USR2 <pid> dumps the flight-recorder ring to the log (must be
+    # installed from the main thread, hence here and not in the service).
+    install_signal_dump()
     parser = argparse.ArgumentParser()
     parser.add_argument("--zmq-endpoint", default="tcp://0.0.0.0:5557")
     parser.add_argument("--grpc-address", default="0.0.0.0:50051")
@@ -62,6 +66,21 @@ def main() -> None:
     parser.add_argument("--discover-port", type=int, default=5557,
                         help="engine pods' ZMQ event port for k8s discovery")
     parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve Prometheus /metrics (+/healthz) on this port; "
+             "0 (default) disables the endpoint",
+    )
+    parser.add_argument(
+        "--admin-port", type=int, default=0,
+        help="serve the debug surface (/metrics, /healthz, /debug/*) on "
+             "this port; 0 (default) disables it",
+    )
+    parser.add_argument(
+        "--admin-host", default="127.0.0.1",
+        help="bind address for --metrics-port/--admin-port "
+             "(default localhost; 0.0.0.0 exposes beyond the pod)",
+    )
+    parser.add_argument(
         "--tokenizer-socket", default=None,
         help="UDS tokenizer sidecar socket for the protobuf prompt-scoring "
              "surface; without it prompts are tokenized in-process "
@@ -97,6 +116,9 @@ def main() -> None:
             "scoringStrategy": "HybridAware"
             if args.scoring_strategy == "HybridAware" else "LongestPrefix",
         },
+        "metricsPort": args.metrics_port,
+        "adminPort": args.admin_port,
+        "adminHost": args.admin_host,
     }
     if args.index_backend in ("redis", "valkey"):
         key = "valkeyConfig" if args.index_backend == "valkey" else "redisConfig"

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""kvdiag: one-shot diagnostic snapshot of a running indexer's admin endpoint.
+
+Scrapes the stdlib admin server (``services/admin.py``) and folds everything
+an on-call engineer needs into a single JSON report on stdout:
+
+- ``/healthz``                 — liveness
+- ``/debug/vars``              — flight-recorder ring + every registered
+                                 debug provider (per-pod event lag, the
+                                 cache-efficiency ledger, …)
+- ``/metrics`` (parsed)        — the ``kvcache_*`` / ``kv_offload_*``
+                                 Prometheus families as name → samples
+
+Usage:
+  python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
+
+Stdlib-only on purpose: this must run inside the most degraded pod
+imaginable (``kubectl exec`` + whatever python is present).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+METRIC_PREFIXES = ("kvcache_", "kv_offload_")
+
+
+def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def parse_metrics(text: str) -> dict:
+    """Prometheus text exposition → {family: [{labels, value}, ...]},
+    keeping only this project's metric families."""
+    families: dict[str, list[dict]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_and_labels, _, value = line.rpartition(" ")
+        if not name_and_labels:
+            continue
+        if "{" in name_and_labels:
+            name, _, raw_labels = name_and_labels.partition("{")
+            raw_labels = raw_labels.rstrip("}")
+            labels = {}
+            for pair in raw_labels.split(","):
+                if "=" in pair:
+                    k, _, v = pair.partition("=")
+                    labels[k] = v.strip('"')
+        else:
+            name, labels = name_and_labels, {}
+        if not name.startswith(METRIC_PREFIXES):
+            continue
+        try:
+            num = float(value)
+        except ValueError:
+            continue
+        families.setdefault(name, []).append({"labels": labels, "value": num})
+    return families
+
+
+def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
+    base = f"http://{host}:{port}"
+    report: dict = {"endpoint": base}
+
+    status, body = _fetch(f"{base}/healthz", timeout)
+    report["healthz"] = {
+        "status_code": status,
+        "body": json.loads(body) if status == 200 else body.decode("utf-8", "replace"),
+    }
+
+    status, body = _fetch(f"{base}/debug/vars", timeout)
+    if status == 200:
+        report["debug"] = json.loads(body)
+    else:
+        # metrics-only endpoint (metricsPort without adminPort): still a
+        # valid target, the report just lacks the debug surfaces.
+        report["debug"] = {"error": f"/debug/vars -> HTTP {status}"}
+
+    status, body = _fetch(f"{base}/metrics", timeout)
+    if status == 200:
+        report["metrics"] = parse_metrics(body.decode("utf-8", "replace"))
+    else:
+        report["metrics"] = {"error": f"/metrics -> HTTP {status}"}
+
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True,
+                        help="the indexer's --admin-port (or --metrics-port)")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--out", default=None,
+                        help="write the JSON report here instead of stdout")
+    args = parser.parse_args(argv)
+
+    try:
+        report = snapshot(args.host, args.port, args.timeout)
+    except OSError as e:
+        print(json.dumps({"error": f"cannot reach {args.host}:{args.port}: {e}"}),
+              file=sys.stderr)
+        return 2
+
+    payload = json.dumps(report, indent=2, default=repr)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Observability lint: naming conventions + docs coverage.
+
+Three AST checks over every ``.py`` file under the given roots (default
+``llmd_kv_cache_tpu``):
+
+1. **span names** — every ``tracer().span("...")`` / ``self._tracer.span``
+   name must start with ``llm_d.kv_cache.`` (the project's trace
+   namespace; f-strings are checked by their literal prefix).
+2. **metric names** — every ``Counter``/``Gauge``/``Histogram``/``Summary``
+   constructed in the library must start with ``kvcache_`` or
+   ``kv_offload_`` so dashboards can select the project's families with
+   one matcher.
+3. **docs coverage** — every metric name constructed in the library must
+   appear in ``docs/observability.md``; an undocumented metric is a
+   dashboard nobody will ever build.
+
+Exit status 1 when any violation is found (CI-friendly; see Makefile
+``lint`` target).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+SPAN_PREFIX = "llm_d.kv_cache."
+METRIC_PREFIXES = ("kvcache_", "kv_offload_")
+METRIC_CLASSES = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
+DOCS_PATH = Path("docs/observability.md")
+
+
+def _literal_prefix(node: ast.AST) -> tuple[str, bool]:
+    """(leading literal text, is_fully_literal) of a string expression.
+
+    For f-strings only the constant head is known statically; that is
+    enough to check a namespace prefix.
+    """
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.JoinedStr):
+        head = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                head.append(part.value)
+            else:
+                break
+        return "".join(head), False
+    return "", False
+
+
+def _is_span_call(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Attribute) and call.func.attr == "span"
+
+
+def _metric_class(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in METRIC_CLASSES:
+        return fn.id
+    if isinstance(fn, ast.Attribute) and fn.attr in METRIC_CLASSES:
+        return fn.attr
+    return ""
+
+
+def lint_file(path: Path) -> tuple[list[str], list[str]]:
+    """Returns (problems, metric_names_constructed)."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"], []
+    problems: list[str] = []
+    metric_names: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        first = node.args[0]
+        if _is_span_call(node):
+            prefix, full = _literal_prefix(first)
+            if not prefix and not full:
+                continue  # dynamic name; nothing to check statically
+            if not prefix.startswith(SPAN_PREFIX) and not SPAN_PREFIX.startswith(prefix):
+                problems.append(
+                    f"{path}:{node.lineno}: span name {prefix!r}… outside the "
+                    f"`{SPAN_PREFIX}*` namespace"
+                )
+        cls = _metric_class(node)
+        if cls and isinstance(first, ast.Constant) and isinstance(first.value, str):
+            name = first.value
+            metric_names.append(name)
+            if not name.startswith(METRIC_PREFIXES):
+                problems.append(
+                    f"{path}:{node.lineno}: {cls} {name!r} outside the "
+                    f"{'/'.join(METRIC_PREFIXES)} namespaces"
+                )
+    return problems, metric_names
+
+
+def check_docs(metric_names: list[str], docs_path: Path) -> list[str]:
+    if not docs_path.exists():
+        return [f"{docs_path}: missing — every metric must be documented there"]
+    text = docs_path.read_text()
+    return [
+        f"{docs_path}: metric `{name}` is not documented"
+        for name in sorted(set(metric_names))
+        if name not in text
+    ]
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
+    problems: list[str] = []
+    metric_names: list[str] = []
+    n_files = 0
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            n_files += 1
+            file_problems, file_metrics = lint_file(f)
+            problems.extend(file_problems)
+            metric_names.extend(file_metrics)
+    problems.extend(check_docs(metric_names, DOCS_PATH))
+    for p in problems:
+        print(p)
+    print(
+        f"lint_observability: {n_files} file(s), "
+        f"{len(set(metric_names))} metric(s), {len(problems)} problem(s)",
+        file=sys.stderr,
+    )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

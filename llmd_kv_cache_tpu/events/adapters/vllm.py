@@ -60,9 +60,17 @@ class VLLMAdapter:
         if len(decoded) > 2 and decoded[2] is not None:
             dp_rank = to_int(decoded[2])
 
+        # Wire element [3]: W3C traceparent (this repo's publishers only).
+        # Positional decoding with length guards keeps engines that never
+        # send it — and future appended fields — parseable.
+        traceparent = None
+        if len(decoded) > 3 and isinstance(decoded[3], str):
+            traceparent = decoded[3]
+
         events = [self._decode_event(raw) for raw in raw_events]
         return pod_id, model_name, EventBatch(
-            timestamp=ts, events=events, data_parallel_rank=dp_rank
+            timestamp=ts, events=events, data_parallel_rank=dp_rank,
+            traceparent=traceparent,
         )
 
     def _decode_event(self, raw: Any) -> GenericEvent:

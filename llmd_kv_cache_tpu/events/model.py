@@ -78,11 +78,18 @@ GenericEvent = BlockStoredEvent | BlockRemovedEvent | AllBlocksClearedEvent
 
 @dataclass
 class EventBatch:
-    """A batch of parsed events from one engine message."""
+    """A batch of parsed events from one engine message.
+
+    ``traceparent`` carries the publisher's W3C trace context across the
+    ZMQ hop (wire element [3], after dp_rank) so ingest spans parent into
+    the trace that caused the cache mutation; None when the publisher was
+    untraced or the engine predates the field.
+    """
 
     timestamp: float
     events: list[GenericEvent]
     data_parallel_rank: Optional[int] = None
+    traceparent: Optional[str] = None
 
 
 class EngineAdapter(Protocol):

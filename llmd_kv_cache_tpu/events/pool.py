@@ -16,8 +16,10 @@ the system's own "parallelism". Workers ingest parsed events into the index:
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -27,6 +29,8 @@ from ..core.keys import EMPTY_BLOCK_HASH, TIER_TPU_HBM, BlockHash, KeyType, PodE
 from ..core.token_processor import ChunkedTokenDatabase
 from ..index.base import Index
 from ..resilience.liveness import PodLivenessTracker
+from ..telemetry import flight_recorder, tracer
+from ..telemetry.flight_recorder import KIND_INGEST
 from ..utils.fnv import fnv1a_32
 from ..utils.logging import get_logger
 from .adapters import create_adapter
@@ -159,6 +163,17 @@ class Pool:
         self.ingest_batches = 0
         self.ingest_messages = 0
         self.coalesced_ops = 0
+        # Event-pipeline lag/staleness (ISSUE 3): per-pod last sequence +
+        # timestamps for gap detection and index-staleness estimation, and
+        # a bounded sample window for p50/p99 lag readouts (admin, bench).
+        self._lag_mu = threading.Lock()
+        self._pod_lag: dict[str, dict] = {}
+        self.lag_samples: collections.deque = collections.deque(maxlen=4096)
+        # Per-pod cache-efficiency ledger (Indexer owns it; the service
+        # wires the same object here so store/evict events attribute).
+        self.ledger = None
+        self._tracer = tracer()
+        self._recorder = flight_recorder()
 
     # -- lifecycle --
 
@@ -223,14 +238,14 @@ class Pool:
             try:
                 msgs = [t for t in batch if t is not self._shutdown]
                 if msgs:
-                    self._process_raw_batch(msgs)
+                    self._process_raw_batch(msgs, worker_index)
             finally:
                 for _ in batch:
                     q.task_done()
             if shutdown:
                 return
 
-    def _process_raw_batch(self, msgs: list[RawMessage]) -> None:
+    def _process_raw_batch(self, msgs: list[RawMessage], worker_index: int = 0) -> None:
         """Process one drained batch, write-combining through a coalescer."""
         sink = _IngestCoalescer(self.index) if len(msgs) > 1 else None
         for msg in msgs:
@@ -243,10 +258,22 @@ class Pool:
             self.ingest_batches += 1
             self.ingest_messages += len(msgs)
             self.coalesced_ops += coalesced
+        self._recorder.record(
+            KIND_INGEST,
+            {"shard": worker_index, "messages": len(msgs), "coalesced_ops": coalesced},
+        )
         try:
-            from ..metrics.collector import record_ingest_batch
+            from ..metrics.collector import (
+                EVENT_QUEUE_DEPTH,
+                INDEX_STALENESS,
+                record_ingest_batch,
+            )
 
             record_ingest_batch(len(msgs), coalesced)
+            EVENT_QUEUE_DEPTH.labels(str(worker_index)).set(
+                self._queues[worker_index].qsize()
+            )
+            INDEX_STALENESS.set(self.index_staleness_s())
         except Exception:  # pragma: no cover - metrics must never break ingestion  # lint: allow-swallow
             pass
 
@@ -256,12 +283,94 @@ class Pool:
         except Exception:
             logger.exception("failed to parse message on topic %s", msg.topic)
             return
+        self._track_lag(pod_id, msg.sequence, batch.timestamp)
         try:
-            self.process_event_batch(batch, pod_id, model_name, sink=sink)
+            with self._tracer.span(
+                "llm_d.kv_cache.events.ingest",
+                parent_traceparent=batch.traceparent,
+                pod=pod_id,
+                model=model_name,
+                event_count=len(batch.events),
+                sequence=msg.sequence,
+            ):
+                self.process_event_batch(batch, pod_id, model_name, sink=sink)
         except Exception:
             # Catch-all: a backend failure on one message must never kill
             # the shard's worker thread.
             logger.exception("failed to process event batch from %s", pod_id)
+
+    def _track_lag(self, pod_id: str, sequence: int, event_ts: float) -> None:
+        """Per-pod sequence-gap + publish→ingest lag bookkeeping.
+
+        Lag compares the publisher's wall clock against ours, so cross-host
+        skew leaks in; within one cluster (NTP-disciplined) it is still the
+        right staleness signal, and sequence gaps are skew-free.
+        """
+        now = time.time()
+        lag_s = max(0.0, now - event_ts)
+        with self._lag_mu:
+            st = self._pod_lag.get(pod_id)
+            if st is None:
+                st = self._pod_lag[pod_id] = {
+                    "last_seq": sequence,
+                    "last_event_ts": event_ts,
+                    "last_ingest_ts": now,
+                    "lag_s": lag_s,
+                    "seq_gaps": 0,
+                    "messages": 1,
+                }
+                gap = 0
+            else:
+                gap = max(0, sequence - st["last_seq"] - 1) if sequence > st["last_seq"] else 0
+                st["seq_gaps"] += gap
+                st["last_seq"] = max(st["last_seq"], sequence)
+                st["last_event_ts"] = max(st["last_event_ts"], event_ts)
+                st["last_ingest_ts"] = now
+                st["lag_s"] = lag_s
+                st["messages"] += 1
+            self.lag_samples.append(lag_s)
+        try:
+            from ..metrics.collector import record_event_lag
+
+            record_event_lag(pod_id, lag_s, gap)
+        except Exception:  # pragma: no cover - metrics must never break ingestion  # lint: allow-swallow
+            pass
+
+    def index_staleness_s(self, now: Optional[float] = None) -> float:
+        """Upper-bound age of the index's view of the slowest pod: the
+        oldest per-pod last-event timestamp, measured against now. 0 when
+        no events have been seen."""
+        now = time.time() if now is None else now
+        with self._lag_mu:
+            if not self._pod_lag:
+                return 0.0
+            oldest = min(st["last_event_ts"] for st in self._pod_lag.values())
+        return max(0.0, now - oldest)
+
+    def lag_stats(self) -> dict:
+        """Lag/staleness snapshot for the admin endpoint and kvdiag."""
+        with self._lag_mu:
+            pods = {
+                pod: {k: v for k, v in st.items()}
+                for pod, st in self._pod_lag.items()
+            }
+            samples = list(self.lag_samples)
+            # Inline (index_staleness_s re-takes the non-reentrant lock).
+            oldest = min(
+                (st["last_event_ts"] for st in self._pod_lag.values()),
+                default=None,
+            )
+        stats: dict = {
+            "pods": pods,
+            "staleness_s": 0.0 if oldest is None else max(0.0, time.time() - oldest),
+            "queue_depths": [q.qsize() for q in self._queues],
+        }
+        if samples:
+            samples.sort()
+            n = len(samples)
+            stats["lag_p50_s"] = samples[n // 2]
+            stats["lag_p99_s"] = samples[min(n - 1, (n * 99) // 100)]
+        return stats
 
     # -- event semantics --
 
@@ -301,6 +410,9 @@ class Pool:
                     ops.clear(pod_identifier)
                 except Exception:
                     logger.exception("failed to clear pod %s", pod_identifier)
+                else:
+                    if self.ledger is not None:
+                        self.ledger.record_clear(pod_identifier)
             else:  # pragma: no cover - adapter produces only known events
                 logger.debug("unknown event from pod %s: %r", pod_identifier, event)
 
@@ -385,6 +497,9 @@ class Pool:
             ops.add(engine_keys, request_keys, pod_entries)
         except Exception:
             logger.exception("failed to add event to index for pod %s", pod_identifier)
+        else:
+            if self.ledger is not None:
+                self.ledger.record_store(pod_identifier, len(request_keys))
 
     def _handle_device_tier_update(
         self,
@@ -451,6 +566,9 @@ class Pool:
                 "failed to evict %d engine keys from pod %s",
                 len(ev.block_hashes), pod_identifier,
             )
+        else:
+            if self.ledger is not None:
+                self.ledger.record_evict(pod_identifier, len(ev.block_hashes))
 
 
 class _IngestCoalescer:

@@ -25,6 +25,7 @@ from typing import Optional, Sequence
 import msgpack
 import zmq
 
+from ..telemetry import current_traceparent
 from ..utils.logging import get_logger
 from .model import AllBlocksClearedEvent, BlockRemovedEvent, BlockStoredEvent, GenericEvent
 
@@ -102,12 +103,22 @@ class KVEventPublisher:
         events: Sequence[GenericEvent],
         timestamp: Optional[float] = None,
         data_parallel_rank: Optional[int] = None,
+        traceparent: Optional[str] = None,
     ) -> int:
-        """Publish one batch; returns the sequence number used."""
+        """Publish one batch; returns the sequence number used.
+
+        The ambient W3C trace context (or an explicit ``traceparent``)
+        rides as wire element [3]; length-tolerant adapters on old
+        subscribers ignore it, so the wire stays engine-compatible.
+        """
         ts = timestamp if timestamp is not None else time.time()
+        if traceparent is None:
+            traceparent = current_traceparent()
         batch: list = [ts, [encode_event(e) for e in events]]
-        if data_parallel_rank is not None:
+        if data_parallel_rank is not None or traceparent is not None:
             batch.append(data_parallel_rank)
+        if traceparent is not None:
+            batch.append(traceparent)
         payload = msgpack.packb(batch, use_bin_type=True)
         with self._lock:
             seq = self._seq

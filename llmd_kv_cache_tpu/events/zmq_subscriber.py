@@ -27,6 +27,8 @@ import zmq
 
 from ..resilience.failpoints import failpoints
 from ..resilience.policy import RetryPolicy
+from ..telemetry import flight_recorder
+from ..telemetry.flight_recorder import KIND_RECONNECT
 from ..utils.logging import get_logger
 from .model import RawMessage
 
@@ -107,6 +109,14 @@ class ZMQSubscriber:
             delay = self.next_delay()
             self._consecutive_failures += 1
             self.reconnects += 1
+            flight_recorder().record(
+                KIND_RECONNECT,
+                {
+                    "endpoint": self.endpoint,
+                    "streak": self._consecutive_failures,
+                    "delay_s": delay,
+                },
+            )
             logger.info("reconnecting to %s in %.2fs (streak=%d)",
                         self.endpoint, delay, self._consecutive_failures)
             if self._stop.wait(delay):

@@ -7,6 +7,9 @@ from .collector import (
     INDEX_LOOKUP_LATENCY,
     INDEX_LOOKUP_REQUESTS,
     INDEX_MAX_POD_HIT_COUNT,
+    record_event_lag,
+    record_ingest_batch,
+    record_prefix_cache_delta,
     start_metrics_logging,
 )
 
@@ -17,5 +20,8 @@ __all__ = [
     "INDEX_LOOKUP_LATENCY",
     "INDEX_LOOKUP_REQUESTS",
     "INDEX_MAX_POD_HIT_COUNT",
+    "record_event_lag",
+    "record_ingest_batch",
+    "record_prefix_cache_delta",
     "start_metrics_logging",
 ]

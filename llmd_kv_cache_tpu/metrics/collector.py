@@ -76,6 +76,45 @@ def record_ingest_batch(messages: int, coalesced_ops: int) -> None:
         EVENT_INGEST_COALESCED_OPS.inc(coalesced_ops)
 
 
+# Event-pipeline lag & staleness (ISSUE 3): the paper's "near-real-time
+# global view" claim is only checkable if the publish→ingest delay and
+# per-pod sequence gaps are first-class metrics. Lag is measured as
+# ingest-time minus the engine's batch timestamp (clock-skew caveat in
+# docs/observability.md); sequence gaps count messages provably lost on
+# the PUB/SUB hop (ZMQ drops, not reorders, within one publisher).
+EVENT_LAG = Histogram(
+    "kvcache_event_lag_seconds",
+    "Publish-timestamp to ingest delay of event batches",
+    buckets=(1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+EVENT_POD_LAG = Gauge(
+    "kvcache_event_pod_lag_seconds",
+    "Most recent publish-to-ingest delay per pod",
+    ["pod"],
+)
+EVENT_SEQ_GAPS = Counter(
+    "kvcache_event_seq_gaps_total",
+    "Event messages lost per pod (holes in the per-topic sequence)",
+    ["pod"],
+)
+EVENT_QUEUE_DEPTH = Gauge(
+    "kvcache_event_queue_depth",
+    "Queued raw messages per event-pool shard",
+    ["shard"],
+)
+INDEX_STALENESS = Gauge(
+    "kvcache_index_staleness_seconds",
+    "Upper-bound age of the index's view of the slowest live pod",
+)
+
+
+def record_event_lag(pod: str, lag_s: float, seq_gap: int) -> None:
+    EVENT_LAG.observe(lag_s)
+    EVENT_POD_LAG.labels(pod).set(lag_s)
+    if seq_gap > 0:
+        EVENT_SEQ_GAPS.labels(pod).inc(seq_gap)
+
+
 TOKENIZATION_LATENCY = Histogram(
     "kvcache_tokenization_latency_seconds",
     "Tokenization / render latency",

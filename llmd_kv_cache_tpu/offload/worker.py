@@ -33,6 +33,8 @@ from ..resilience.integrity import (
     slot_crcs,
 )
 from ..resilience.policy import RetryPolicy
+from ..telemetry import current_traceparent, flight_recorder, tracer
+from ..telemetry.flight_recorder import KIND_OFFLOAD, KIND_RETRY
 from ..utils.logging import get_logger
 from .file_mapper import FileMapper
 from .native import (
@@ -130,6 +132,9 @@ class _PendingJob:
     # An injected submission fault left part of the job unqueued; the job
     # must complete as failed even if every queued op succeeded.
     submit_failed: bool = False
+    # Submitter's W3C trace context, captured at submission so the
+    # completion span joins the trace that caused the transfer.
+    traceparent: Optional[str] = None
 
 
 @dataclass
@@ -374,7 +379,8 @@ class OffloadHandlers:
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, report_id=job_id, is_store=True,
                           started=time.perf_counter(), nbytes=0,
-                          group_idx=group_idx)
+                          group_idx=group_idx,
+                          traceparent=current_traceparent())
         suffix = uuid.uuid4().hex[:8]
         # One device program + one D2H transfer for the whole job.
         slabs = copier.gather_many_to_host(
@@ -421,7 +427,8 @@ class OffloadHandlers:
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, report_id=job_id, is_store=False,
                           started=time.perf_counter(), nbytes=0,
-                          group_idx=group_idx)
+                          group_idx=group_idx,
+                          traceparent=current_traceparent())
         for block_hash, page_ids in transfers:
             buf = self.staging.acquire(copier.slab_nbytes(len(page_ids)))
             footer = None
@@ -484,7 +491,8 @@ class OffloadHandlers:
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, report_id=job_id, is_store=True,
                           started=time.perf_counter(), nbytes=0,
-                          group_idx=group_idx)
+                          group_idx=group_idx,
+                          traceparent=current_traceparent())
         suffix = uuid.uuid4().hex[:8]
         # One device program per job: per-block gathers keep slots
         # independently addressable in the file (a fused multi-block gather
@@ -530,7 +538,8 @@ class OffloadHandlers:
         job_id = self.io.begin_job()
         job = _PendingJob(job_id=job_id, report_id=job_id, is_store=False,
                           started=time.perf_counter(), nbytes=0,
-                          group_idx=group_idx)
+                          group_idx=group_idx,
+                          traceparent=current_traceparent())
         for span in spans:
             buf = self.staging.acquire(len(span.blocks) * slot_bytes)
             footer = None
@@ -600,6 +609,16 @@ class OffloadHandlers:
 
     def _schedule_retry(self, job: _PendingJob) -> None:
         delay = self.retry_policy.delay(job.attempt - 1)
+        flight_recorder().record(
+            KIND_RETRY,
+            {
+                "subsystem": "offload",
+                "job_id": job.report_id,
+                "direction": "store" if job.is_store else "load",
+                "attempt": job.attempt,
+                "delay_s": delay,
+            },
+        )
         logger.warning(
             "job %d (%s) attempt %d failed; retrying in %.3fs",
             job.report_id, "store" if job.is_store else "load",
@@ -702,18 +721,45 @@ class OffloadHandlers:
                 self._release_job_buffers(job)
             with self._lock:
                 self._by_report.pop(job.report_id, None)
-            results.append(
-                TransferResult(
-                    job_id=job.report_id,
-                    success=success,
-                    is_store=job.is_store,
-                    bytes_transferred=job.nbytes if success else 0,
-                    seconds=time.perf_counter() - job.started,
-                    shed_hashes=job.shed_hashes,
-                    corrupt_hashes=corrupt,
-                    attempts=job.attempt,
-                )
+            result = TransferResult(
+                job_id=job.report_id,
+                success=success,
+                is_store=job.is_store,
+                bytes_transferred=job.nbytes if success else 0,
+                seconds=time.perf_counter() - job.started,
+                shed_hashes=job.shed_hashes,
+                corrupt_hashes=corrupt,
+                attempts=job.attempt,
             )
+            # Completion marker span joining the submitter's trace, plus a
+            # flight record: "why did this block come back cold?" is
+            # answerable after the fact from either surface.
+            direction = "store" if job.is_store else "load"
+            with tracer().span(
+                "llm_d.kv_cache.offload.job",
+                parent_traceparent=job.traceparent,
+                direction=direction,
+                job_id=job.report_id,
+                success=success,
+                attempts=job.attempt,
+                bytes=result.bytes_transferred,
+                seconds=result.seconds,
+            ):
+                pass
+            flight_recorder().record(
+                KIND_OFFLOAD,
+                {
+                    "job_id": job.report_id,
+                    "direction": direction,
+                    "success": success,
+                    "bytes": result.bytes_transferred,
+                    "seconds": result.seconds,
+                    "attempts": job.attempt,
+                    "shed": len(job.shed_hashes),
+                    "corrupt": len(corrupt),
+                },
+            )
+            results.append(result)
         return results
 
     def wait_job(self, job_id: int, timeout_s: float = 30.0) -> int:

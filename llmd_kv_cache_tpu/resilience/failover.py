@@ -16,6 +16,8 @@ from typing import Optional, Sequence
 
 from ..core.keys import BlockHash, KeyType, PodEntry
 from ..index.base import Index
+from ..telemetry import flight_recorder, tracer
+from ..telemetry.flight_recorder import KIND_FAILOVER
 from ..utils.logging import get_logger
 from .policy import CircuitBreaker, CircuitOpenError, RetryPolicy, call_with_retry
 
@@ -50,14 +52,36 @@ class FailoverIndex(Index):
             lambda: call_with_retry(fn, self.retry_policy)
         )
 
+    def _record_failover(self, op_name: str, reason: str) -> None:
+        """Flight-record + span the failover decision so post-hoc debugging
+        can see when (and why) routing quality degraded to the fallback."""
+        flight_recorder().record(
+            KIND_FAILOVER,
+            {
+                "op": op_name,
+                "reason": reason,
+                "breaker_state": self.breaker.state,
+                "failovers": self.failovers,
+            },
+        )
+        with tracer().span(
+            "llm_d.kv_cache.resilience.failover",
+            op=op_name,
+            reason=reason,
+            breaker_state=self.breaker.state,
+        ):
+            pass
+
     def _read(self, op_name: str, primary_fn, fallback_fn):
         try:
             return self._primary_call(primary_fn)
         except CircuitOpenError:
             self.failovers += 1
+            self._record_failover(op_name, "breaker_open")
             return fallback_fn()
         except Exception as exc:
             self.failovers += 1
+            self._record_failover(op_name, f"error: {exc}")
             logger.warning("primary index %s failed (%s); serving fallback", op_name, exc)
             return fallback_fn()
 

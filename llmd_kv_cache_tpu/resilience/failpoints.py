@@ -74,6 +74,31 @@ class FailpointRegistry:
         self._points: dict[str, _Failpoint] = {}
         self._rng = random.Random(seed)
         self._seed = seed
+        # Fired-failpoint observers (flight recorder, tests). Called outside
+        # the registry lock with just the failpoint name; a listener that
+        # raises is dropped from the notification (never breaks injection).
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(name)`` to run on every fired failpoint (idempotent
+        by identity)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _notify(self, name: str) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(name)
+            except Exception:  # pragma: no cover - observers must not break injection  # lint: allow-swallow
+                pass
 
     # -- configuration ----------------------------------------------------
 
@@ -165,13 +190,17 @@ class FailpointRegistry:
 
     def should_fire(self, name: str) -> bool:
         """Custom-mode check: True when the call site should inject its fault."""
-        return self._roll(name) is not None
+        fired = self._roll(name) is not None
+        if fired:
+            self._notify(name)
+        return fired
 
     def hit(self, name: str) -> None:
         """Standard hook: raise/sleep per the armed mode, no-op otherwise."""
         fp = self._roll(name)
         if fp is None:
             return
+        self._notify(name)
         logger.warning("failpoint %s fired (mode=%s, count=%d)", name, fp.mode, fp.fired)
         if fp.delay_s > 0.0:
             time.sleep(fp.delay_s)

@@ -8,6 +8,7 @@ tokens, and how much of it" in a single in-process call.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -15,11 +16,84 @@ from ..core.extra_keys import BlockExtraFeatures
 from ..core.keys import BlockHash
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from ..index.base import Index, IndexConfig, create_index
-from ..telemetry import tracer
+from ..telemetry import flight_recorder, tracer
+from ..telemetry.flight_recorder import KIND_SCORE
 from ..utils.logging import get_logger
 from .scorer import KVBlockScorerConfig, LongestPrefixScorer, create_scorer
 
 logger = get_logger("indexer")
+
+
+class CacheEfficiencyLedger:
+    """Per-pod cache-efficiency attribution (ISSUE 3).
+
+    Answers "which pods actually earn their cache footprint?" after the
+    fact: per pod, how often it appeared in score results (and won), how
+    much weighted prefix score it accumulated, and how many blocks the
+    event stream stored/evicted on it. Misses are global per lookup —
+    a block no pod holds cannot be attributed to any one of them.
+
+    One small lock-guarded dict update per score call / ingest event;
+    cheap enough to stay always-on (bench.py budgets the whole
+    observability overhead at < 1% of the score hot path).
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._pods: dict[str, dict] = {}
+        self.score_calls = 0
+        self.lookup_blocks = 0
+        self.lookup_hit_blocks = 0
+
+    def _pod(self, pod: str) -> dict:
+        st = self._pods.get(pod)
+        if st is None:
+            st = self._pods[pod] = {
+                "appearances": 0,
+                "wins": 0,
+                "score_total": 0.0,
+                "stored_blocks": 0,
+                "evicted_blocks": 0,
+                "clears": 0,
+            }
+        return st
+
+    def record_score(
+        self, scores: dict[str, float], total_blocks: int, hit_blocks: int
+    ) -> None:
+        winner = max(scores, key=scores.get) if scores else None
+        with self._mu:
+            self.score_calls += 1
+            self.lookup_blocks += total_blocks
+            self.lookup_hit_blocks += hit_blocks
+            for pod, score in scores.items():
+                st = self._pod(pod)
+                st["appearances"] += 1
+                st["score_total"] += score
+            if winner is not None:
+                self._pods[winner]["wins"] += 1
+
+    def record_store(self, pod: str, blocks: int) -> None:
+        with self._mu:
+            self._pod(pod)["stored_blocks"] += blocks
+
+    def record_evict(self, pod: str, blocks: int) -> None:
+        with self._mu:
+            self._pod(pod)["evicted_blocks"] += blocks
+
+    def record_clear(self, pod: str) -> None:
+        with self._mu:
+            self._pod(pod)["clears"] += 1
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "score_calls": self.score_calls,
+                "lookup_blocks": self.lookup_blocks,
+                "lookup_hit_blocks": self.lookup_hit_blocks,
+                "lookup_miss_blocks": self.lookup_blocks - self.lookup_hit_blocks,
+                "pods": {pod: dict(st) for pod, st in self._pods.items()},
+            }
 
 
 @dataclass
@@ -36,6 +110,14 @@ class IndexerConfig:
     # engaged for the LongestPrefix strategy; hybrid-aware scoring values
     # blocks at any position.
     lookup_chunk_size: int = 128
+    # Observability endpoints (services.admin): 0 = disabled (default).
+    # metrics_port serves /metrics + /healthz only; admin_port additionally
+    # exposes the /debug/* surfaces (flight recorder, lag, ledger).
+    metrics_port: int = 0
+    admin_port: int = 0
+    # Bind address for both endpoints; localhost by default because the
+    # debug surface exposes pod names and score internals.
+    admin_host: str = "127.0.0.1"
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
@@ -50,6 +132,10 @@ class IndexerConfig:
                 d.get("kvBlockScorerConfig", d.get("scorer_config"))
             ),
             lookup_chunk_size=128 if chunk is None else chunk,
+            metrics_port=d.get("metricsPort", d.get("metrics_port", 0)) or 0,
+            admin_port=d.get("adminPort", d.get("admin_port", 0)) or 0,
+            admin_host=d.get("adminHost", d.get("admin_host", "127.0.0.1"))
+            or "127.0.0.1",
         )
         index_dict = d.get("kvBlockIndexConfig", d.get("index_config"))
         if index_dict:
@@ -127,6 +213,12 @@ class Indexer:
         # records only its delta into the Prometheus counters.
         self._pc_hit_snapshot = 0
         self._pc_miss_snapshot = 0
+        # Per-pod cache-efficiency attribution + score-decision flight
+        # records; the event pool shares this ledger (IndexerService wires
+        # ``pool.ledger = indexer.ledger``) so evict/store attribution and
+        # score attribution land in one place.
+        self.ledger = CacheEfficiencyLedger()
+        self._recorder = flight_recorder()
 
     def prefix_cache_stats(self) -> Optional[dict]:
         """Token-processor prefix-cache counters (None when disabled)."""
@@ -211,7 +303,11 @@ class Indexer:
                 span.set_attribute("block_hit_ratio", hit_count / len(block_keys))
                 # The C++ fused path knows nothing about liveness; apply the
                 # same degraded-mode weighting the Python scorers use.
-                return self.scorer._apply_liveness(scores)
+                scores = self.scorer._apply_liveness(scores)
+                self._record_score_decision(
+                    model_name, len(block_keys), hit_count, scores
+                )
+                return scores
 
             if self._early_exit:
                 key_to_pods = self.kv_block_index.lookup_chunked(
@@ -223,4 +319,32 @@ class Indexer:
             span.set_attribute("block_hit_count", len(key_to_pods))
             span.set_attribute("block_hit_ratio", len(key_to_pods) / len(block_keys))
 
-            return self.scorer.score(block_keys, key_to_pods)
+            scores = self.scorer.score(block_keys, key_to_pods)
+            self._record_score_decision(
+                model_name, len(block_keys), len(key_to_pods), scores
+            )
+            return scores
+
+    def _record_score_decision(
+        self,
+        model_name: str,
+        total_blocks: int,
+        hit_blocks: int,
+        scores: dict[str, float],
+    ) -> None:
+        """Ledger + flight-recorder attribution for one score call.
+
+        Kept lean — one ledger lock, one ring store; ``scores`` is handed
+        to the recorder by reference (diagnostic surface, treated as
+        frozen), so the hot-path cost is the dict literal below.
+        """
+        self.ledger.record_score(scores, total_blocks, hit_blocks)
+        self._recorder.record(
+            KIND_SCORE,
+            {
+                "model": model_name,
+                "blocks": total_blocks,
+                "hits": hit_blocks,
+                "scores": scores,
+            },
+        )

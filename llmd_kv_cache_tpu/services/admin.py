@@ -1,0 +1,181 @@
+"""Stdlib HTTP admin/debug endpoint: /metrics, /healthz, /debug/*.
+
+The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
+
+- ``/metrics``   — the process's Prometheus registry (text exposition)
+- ``/healthz``   — liveness probe (200 + ``{"status": "ok"}``)
+- ``/debug/flight-recorder`` — the in-process flight recorder ring
+- ``/debug/<name>``          — registered JSON providers (``lag``,
+  ``ledger``, …), whatever the owning service wires in
+- ``/debug/vars``            — every provider + the flight recorder in
+  one JSON document (what ``hack/kvdiag.py`` snapshots)
+
+Deliberately stdlib-only (``http.server``): the endpoint must work in the
+most degraded pod imaginable — that is exactly when it is needed. Disabled
+by default; the config knobs are ``metricsPort`` (metrics+health only) and
+``adminPort`` (adds ``/debug/*``), both 0 = off. Binds localhost by
+default: the debug surface exposes pod names and score internals, so
+exposure beyond the pod is an operator decision (``host="0.0.0.0"``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..telemetry import flight_recorder
+from ..utils.logging import get_logger
+
+logger = get_logger("services.admin")
+
+
+class AdminServer:
+    """Small threaded HTTP server for observability endpoints.
+
+    ``port=0`` binds an ephemeral port (tests); the *disabled-by-default*
+    semantics of the ``metricsPort``/``adminPort`` config knobs live in the
+    wiring (IndexerService skips construction when the knob is 0).
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        expose_debug: bool = True,
+    ):
+        self._host = host
+        self._requested_port = port
+        self._expose_debug = expose_debug
+        self._providers: dict[str, Callable[[], object]] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def register_debug(self, name: str, provider: Callable[[], object]) -> None:
+        """Expose ``provider()`` (a JSON-serializable callable) as
+        ``/debug/<name>`` and inside ``/debug/vars``."""
+        self._providers[name] = provider
+
+    @property
+    def port(self) -> int:
+        """The bound port (0 until started)."""
+        return self._httpd.server_port if self._httpd is not None else 0
+
+    # -- request handling --------------------------------------------------
+
+    def _metrics_payload(self) -> tuple[bytes, str]:
+        from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+        return generate_latest(), CONTENT_TYPE_LATEST
+
+    def _debug_vars(self) -> dict:
+        payload: dict = {
+            "flight_recorder": flight_recorder().snapshot(),
+        }
+        for name, provider in self._providers.items():
+            try:
+                payload[name] = provider()
+            except Exception as exc:
+                payload[name] = {"error": str(exc)}
+        return payload
+
+    def _handle(self, path: str) -> tuple[int, bytes, str]:
+        """Route one GET; returns (status, body, content_type)."""
+        if path == "/healthz":
+            return 200, b'{"status": "ok"}', "application/json"
+        if path == "/metrics":
+            body, ctype = self._metrics_payload()
+            return 200, body, ctype
+        if self._expose_debug:
+            if path == "/debug/flight-recorder":
+                body = flight_recorder().dump_json(indent=2).encode("utf-8")
+                return 200, body, "application/json"
+            if path == "/debug/vars":
+                body = json.dumps(self._debug_vars(), indent=2, default=repr)
+                return 200, body.encode("utf-8"), "application/json"
+            if path.startswith("/debug/"):
+                name = path[len("/debug/"):]
+                provider = self._providers.get(name)
+                if provider is not None:
+                    try:
+                        body = json.dumps(provider(), indent=2, default=repr)
+                    except Exception as exc:
+                        return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
+                    return 200, body.encode("utf-8"), "application/json"
+        return 404, b'{"error": "not found"}', "application/json"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind + serve in a daemon thread; returns the bound port."""
+        if self._httpd is not None:
+            return self.port
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                try:
+                    status, body, ctype = outer._handle(self.path.split("?", 1)[0])
+                except Exception as exc:  # a broken provider must not kill the server
+                    status = 500
+                    body = json.dumps({"error": str(exc)}).encode("utf-8")
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # route to our logger, DEBUG
+                logger.debug("admin http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._requested_port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"kvtpu-admin-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info(
+            "admin endpoint on http://%s:%d (debug=%s)",
+            self._host, self.port, self._expose_debug,
+        )
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._httpd = None
+
+
+def start_observability_servers(
+    metrics_port: int,
+    admin_port: int,
+    host: str = "127.0.0.1",
+    providers: Optional[dict[str, Callable[[], object]]] = None,
+) -> list[AdminServer]:
+    """Start the configured endpoint(s); 0 = disabled (the default).
+
+    When both knobs name the same port (or only ``admin_port`` is set),
+    one server does both jobs; distinct ports get a metrics-only server
+    plus a full admin server.
+    """
+    servers: list[AdminServer] = []
+    if admin_port > 0:
+        admin = AdminServer(port=admin_port, host=host, expose_debug=True)
+        for name, provider in (providers or {}).items():
+            admin.register_debug(name, provider)
+        admin.start()
+        servers.append(admin)
+    if metrics_port > 0 and metrics_port != admin_port:
+        metrics = AdminServer(port=metrics_port, host=host, expose_debug=False)
+        metrics.start()
+        servers.append(metrics)
+    return servers

@@ -35,8 +35,11 @@ from ..events.zmq_subscriber import ZMQSubscriber
 from ..resilience.failpoints import FaultInjected, failpoints
 from ..resilience.policy import RetryExhausted, RetryPolicy, call_with_retry
 from ..scoring.indexer import Indexer, IndexerConfig
+from ..telemetry import attach_failpoint_listener, current_traceparent, tracer
 from ..utils.logging import get_logger
 from ..utils.net import grpc_target
+from .admin import AdminServer, start_observability_servers
+from .tokenizer.service import extract_traceparent
 
 logger = get_logger("services.indexer")
 
@@ -76,10 +79,15 @@ def _retryable(exc: BaseException) -> bool:
 def _call_rpc(rpc, request, timeout: float, policy: RetryPolicy):
     """One unary scoring RPC under the retry policy. On exhaustion the
     last underlying error is re-raised so callers keep the grpc.RpcError
-    contract (status code inspection, etc.)."""
+    contract (status code inspection, etc.). Ambient W3C trace context
+    rides as ``traceparent`` metadata so the server span joins the
+    caller's trace."""
+    tp = current_traceparent()
+    metadata = (("traceparent", tp),) if tp else None
+
     def attempt():
         failpoints.hit(FP_INDEXER_RPC)
-        return rpc(request, timeout=timeout)
+        return rpc(request, timeout=timeout, metadata=metadata)
 
     try:
         return call_with_retry(attempt, policy, retryable=_retryable)
@@ -150,6 +158,11 @@ class IndexerService:
             self.pool.add_task, topic_filter=self.pool_config.topic_filter
         )
         self._central_subscriber: Optional[ZMQSubscriber] = None
+        self._observability_servers: list[AdminServer] = []
+        # Hit/miss/evict attribution flows from the scorer into the same
+        # ledger the event pool feeds store/evict events, giving one
+        # per-pod cache-efficiency view (/debug/ledger).
+        self.pool.ledger = self.indexer.ledger
         # Hybrid-aware scoring reads the pool's learned group catalog
         # (no-op for the default longest-prefix strategy).
         self.indexer.attach_group_catalog(self.pool.group_catalog)
@@ -171,8 +184,23 @@ class IndexerService:
                 bind=True,
             )
             self._central_subscriber.start()
+        # Failpoint trips land in the flight recorder so chaos runs leave
+        # a reconstructable decision trail.
+        attach_failpoint_listener()
+        self._observability_servers = start_observability_servers(
+            self.indexer.config.metrics_port,
+            self.indexer.config.admin_port,
+            host=self.indexer.config.admin_host,
+            providers={
+                "lag": self.pool.lag_stats,
+                "ledger": self.indexer.ledger.snapshot,
+            },
+        )
 
     def stop(self) -> None:
+        for server in self._observability_servers:
+            server.stop()
+        self._observability_servers = []
         if self._central_subscriber is not None:
             self._central_subscriber.stop()
         self.subscriber_manager.shutdown()
@@ -180,17 +208,26 @@ class IndexerService:
 
     # -- RPC --
 
-    def get_pod_scores(self, req: ScoreRequest) -> ScoreResponse:
-        try:
-            scores = self.indexer.score_tokens(
-                req.tokens,
-                req.model_name,
-                set(req.pod_identifiers) if req.pod_identifiers else None,
-            )
-            return ScoreResponse(scores=scores)
-        except Exception as e:
-            logger.exception("GetPodScores failed")
-            return ScoreResponse(error=str(e))
+    def get_pod_scores(self, req: ScoreRequest, context=None) -> ScoreResponse:
+        # Server-side half of the W3C hop: parent under the scheduler's
+        # traceparent metadata when present (ambient trace context then
+        # flows into the score_tokens child span).
+        with tracer().span(
+            "llm_d.kv_cache.indexer.GetPodScores",
+            parent_traceparent=extract_traceparent(context),
+            model=req.model_name,
+            tokens=len(req.tokens),
+        ):
+            try:
+                scores = self.indexer.score_tokens(
+                    req.tokens,
+                    req.model_name,
+                    set(req.pod_identifiers) if req.pod_identifiers else None,
+                )
+                return ScoreResponse(scores=scores)
+            except Exception as e:
+                logger.exception("GetPodScores failed")
+                return ScoreResponse(error=str(e))
 
     def get_pod_scores_pb(self, req, ctx):
         """Protobuf surface: prompt in, tokenize server-side, score.
@@ -209,12 +246,18 @@ class IndexerService:
                 f"({SERVICE_NAME})",
             )
         try:
-            tokens = list(self.tokenize(req.prompt, req.model_name))
-            scores = self.indexer.score_tokens(
-                tokens,
-                req.model_name,
-                set(req.pod_identifiers) if req.pod_identifiers else None,
-            )
+            with tracer().span(
+                "llm_d.kv_cache.indexer.GetPodScores",
+                parent_traceparent=extract_traceparent(ctx),
+                model=req.model_name,
+                wire="protobuf",
+            ):
+                tokens = list(self.tokenize(req.prompt, req.model_name))
+                scores = self.indexer.score_tokens(
+                    tokens,
+                    req.model_name,
+                    set(req.pod_identifiers) if req.pod_identifiers else None,
+                )
         except Exception as e:
             logger.exception("GetPodScores (pb) failed")
             ctx.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -235,7 +278,7 @@ def serve(
         SERVICE_NAME,
         {
             "GetPodScores": grpc.unary_unary_rpc_method_handler(
-                lambda req, _ctx: service.get_pod_scores(req),
+                lambda req, ctx: service.get_pod_scores(req, ctx),
                 request_deserializer=ScoreRequest.from_bytes,
                 response_serializer=lambda r: r.to_bytes(),
             )

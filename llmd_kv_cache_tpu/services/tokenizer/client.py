@@ -17,6 +17,7 @@ import grpc
 from ...core.extra_keys import BlockExtraFeatures, PlaceholderRange, compute_block_extra_features
 from ...resilience.failpoints import FaultInjected, failpoints
 from ...resilience.policy import RetryPolicy, RetryExhausted, call_with_retry
+from ...telemetry import current_traceparent, tracer
 from ...utils.logging import get_logger
 from ...utils.net import grpc_target
 from .messages import (
@@ -113,21 +114,30 @@ class UdsTokenizerClient:
             "RenderChatCompletion", lambda r: r.to_bytes(), RenderChatResponse.from_bytes
         )
 
-    def _call(self, rpc, request):
+    def _call(self, rpc, request, method: str = ""):
         """Issue one unary RPC under the retry policy; transient transport
         errors and injected faults are retried. On exhaustion the last
         underlying error is re-raised so callers keep the grpc.RpcError
-        contract."""
-        def attempt():
-            failpoints.hit(FP_TOKENIZER_RPC)
-            return rpc(request, timeout=self._timeout)
+        contract.
 
-        try:
-            return call_with_retry(
-                attempt, self.retry_policy, retryable=_retryable
-            )
-        except RetryExhausted as e:
-            raise e.__cause__
+        The ambient W3C trace context rides as ``traceparent`` gRPC
+        metadata (injected per attempt), so the server-side span parents
+        into the caller's trace across the UDS hop.
+        """
+        with tracer().span("llm_d.kv_cache.tokenizer.rpc", method=method):
+            tp = current_traceparent()
+            metadata = (("traceparent", tp),) if tp else None
+
+            def attempt():
+                failpoints.hit(FP_TOKENIZER_RPC)
+                return rpc(request, timeout=self._timeout, metadata=metadata)
+
+            try:
+                return call_with_retry(
+                    attempt, self.retry_policy, retryable=_retryable
+                )
+            except RetryExhausted as e:
+                raise e.__cause__
 
     def initialize(self, model_name: str) -> None:
         """Eager per-model init with bounded retry/backoff
@@ -164,7 +174,8 @@ class UdsTokenizerClient:
     ) -> TokenizeResponse:
         resp = self._call(
             self._tokenize,
-            TokenizeRequest(
+            method="Tokenize",
+            request=TokenizeRequest(
                 model_name=model_name,
                 text=text,
                 add_special_tokens=add_special_tokens,
@@ -179,7 +190,8 @@ class UdsTokenizerClient:
                add_special_tokens: bool = True) -> list[int]:
         resp = self._call(
             self._render_completion,
-            RenderCompletionRequest(
+            method="RenderCompletion",
+            request=RenderCompletionRequest(
                 model_name=model_name, prompt=prompt,
                 add_special_tokens=add_special_tokens,
             ),
@@ -199,7 +211,8 @@ class UdsTokenizerClient:
     ) -> RenderChatResponse:
         resp = self._call(
             self._render_chat,
-            RenderChatRequest(
+            method="RenderChatCompletion",
+            request=RenderChatRequest(
                 model_name=model_name,
                 messages=messages,
                 chat_template=chat_template,

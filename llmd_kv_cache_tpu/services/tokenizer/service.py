@@ -24,6 +24,7 @@ from typing import Optional
 
 import grpc
 
+from ...telemetry import tracer
 from ...utils.logging import get_logger
 from ...utils.net import grpc_target
 from .backends import TokenizerRegistry
@@ -220,9 +221,16 @@ def _make_grpc_handler(service: TokenizerService):
 
     method_handlers = {}
     for name, (fn, deserialize, serialize) in rpcs.items():
-        def make(fn=fn):
-            def handler(request, _context):
-                return fn(request)
+        def make(fn=fn, name=name):
+            def handler(request, context):
+                # Server-side half of the W3C hop: parent this span under
+                # the caller's traceparent metadata when present, so one
+                # trace covers client call + server work.
+                with tracer().span(
+                    f"llm_d.kv_cache.tokenizer.{name}",
+                    parent_traceparent=extract_traceparent(context),
+                ):
+                    return fn(request)
             return handler
 
         method_handlers[name] = grpc.unary_unary_rpc_method_handler(
@@ -231,6 +239,23 @@ def _make_grpc_handler(service: TokenizerService):
             response_serializer=serialize,
         )
     return grpc.method_handlers_generic_handler(SERVICE_NAME, method_handlers)
+
+
+def extract_traceparent(context) -> Optional[str]:
+    """Pull the W3C ``traceparent`` from gRPC invocation metadata (None
+    when absent or the context does not expose metadata)."""
+    if context is None:
+        return None
+    try:
+        metadata = context.invocation_metadata()
+    except Exception:  # pragma: no cover - non-grpc test doubles  # lint: allow-swallow
+        return None
+    if not metadata:
+        return None
+    for key, value in metadata:
+        if key == "traceparent" and isinstance(value, str):
+            return value
+    return None
 
 
 def serve_uds(

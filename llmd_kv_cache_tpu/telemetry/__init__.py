@@ -1,5 +1,37 @@
-"""Tracing (counterpart of ``pkg/telemetry/``)."""
+"""Tracing + flight recorder (counterpart of ``pkg/telemetry/``)."""
 
-from .tracing import init_tracing, tracer
+from .flight_recorder import (
+    FlightRecorder,
+    attach_failpoint_listener,
+    flight_recorder,
+    install_signal_dump,
+    set_flight_recorder,
+)
+from .tracing import (
+    InMemorySpanExporter,
+    current_traceparent,
+    format_traceparent,
+    init_tracing,
+    install_span_exporter,
+    parse_traceparent,
+    recording_tracing,
+    tracer,
+    uninstall_span_exporter,
+)
 
-__all__ = ["init_tracing", "tracer"]
+__all__ = [
+    "FlightRecorder",
+    "InMemorySpanExporter",
+    "attach_failpoint_listener",
+    "current_traceparent",
+    "flight_recorder",
+    "format_traceparent",
+    "init_tracing",
+    "install_signal_dump",
+    "install_span_exporter",
+    "parse_traceparent",
+    "recording_tracing",
+    "set_flight_recorder",
+    "tracer",
+    "uninstall_span_exporter",
+]

@@ -1,17 +1,39 @@
-"""OpenTelemetry tracing with a no-op fallback.
+"""OpenTelemetry tracing with a no-op fallback and a built-in recorder.
 
 Counterpart of reference ``pkg/telemetry/tracing.go``: spans are attached
-unconditionally throughout the read/write paths via decorator wrappers and
-no-op when no provider is configured (``indexer.go:90-103``). ``init_tracing``
+unconditionally throughout the read/write paths via a thin facade and no-op
+when no provider is configured (``indexer.go:90-103``). ``init_tracing``
 configures an OTLP exporter from the standard ``OTEL_*`` env vars when the
-optional exporter packages are importable; in library mode the host process's
-global provider is used untouched.
+optional exporter packages are importable; in library mode the host
+process's global provider is used untouched.
+
+Three operating modes, resolved per ``span()`` call in priority order:
+
+1. **recording** — an in-process :class:`InMemorySpanExporter` installed via
+   :func:`install_span_exporter`. Spans are plain Python objects with real
+   trace/span ids, parentage via ``contextvars`` plus explicit W3C
+   ``traceparent`` strings, and land in the exporter on exit. This needs
+   only the stdlib, so cross-hop trace assertions work even on images that
+   ship ``opentelemetry-api`` without the SDK.
+2. **otel** — a real TracerProvider is installed on the global OTel API
+   (either by :func:`init_tracing` or by the host process). Attributes are
+   passed at span start; exceptions are recorded with ERROR status.
+3. **noop** — neither of the above: a shared zero-allocation span that
+   accepts ``set_attribute`` chains and costs one identity check per call.
+
+W3C trace-context helpers (:func:`current_traceparent`,
+:func:`parse_traceparent`) are the single source of truth for propagation
+across the gRPC tokenizer hop and the ZMQ event wire.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import random
+import re
+import threading
+import time
 from typing import Iterator, Optional
 
 try:
@@ -19,34 +41,298 @@ try:
 except Exception:  # pragma: no cover - otel always present in this image
     _otel_trace = None
 
+import contextvars
+
 _SERVICE_NAME = "llmd-kv-cache-tpu"
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def format_traceparent(trace_id: int, span_id: int, sampled: bool = True) -> str:
+    """Render a W3C ``traceparent`` header value (version 00)."""
+    return f"00-{trace_id:032x}-{span_id:016x}-{0x01 if sampled else 0x00:02x}"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[tuple[int, int, int]]:
+    """Parse ``traceparent`` → ``(trace_id, span_id, flags)``; None if invalid.
+
+    Malformed values are dropped rather than raised: a bad header from a
+    remote peer must never break event ingestion or an RPC.
+    """
+    if not value or not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    trace_id = int(m.group(1), 16)
+    span_id = int(m.group(2), 16)
+    if trace_id == 0 or span_id == 0:
+        return None
+    return trace_id, span_id, int(m.group(3), 16)
 
 
 class _NoopSpan:
-    def set_attribute(self, *_args, **_kwargs) -> None:
-        pass
+    """Shared do-nothing span; every mutator chains so call sites can write
+    ``span.set_attribute(...).set_attribute(...)`` without mode checks."""
+
+    __slots__ = ()
+
+    def set_attribute(self, *_args, **_kwargs) -> "_NoopSpan":
+        return self
+
+    def set_attributes(self, *_args, **_kwargs) -> "_NoopSpan":
+        return self
+
+    def add_event(self, *_args, **_kwargs) -> "_NoopSpan":
+        return self
 
     def record_exception(self, *_args, **_kwargs) -> None:
         pass
 
+    def set_status(self, *_args, **_kwargs) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _NoopSpanCM:
+    """Reusable, allocation-free context manager for the no-op path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def __exit__(self, *_exc) -> bool:
+        return False
+
+
+_NOOP_CM = _NoopSpanCM()
+
+
+class RecordedSpan:
+    """A finished-or-active span in recording mode.
+
+    Mirrors the slice of the OTel Span API the library uses (set_attribute,
+    record_exception, set_status) plus the readback fields tests assert on.
+    """
+
+    __slots__ = (
+        "name",
+        "trace_id",
+        "span_id",
+        "parent_span_id",
+        "attributes",
+        "events",
+        "status",
+        "status_description",
+        "start_time",
+        "end_time",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: int,
+        span_id: int,
+        parent_span_id: Optional[int],
+        attributes: Optional[dict] = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.attributes = dict(attributes) if attributes else {}
+        self.events: list[tuple[str, dict]] = []
+        self.status = "UNSET"
+        self.status_description: Optional[str] = None
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+
+    def set_attribute(self, key: str, value) -> "RecordedSpan":
+        self.attributes[key] = value
+        return self
+
+    def set_attributes(self, attributes: dict) -> "RecordedSpan":
+        self.attributes.update(attributes)
+        return self
+
+    def add_event(self, name: str, attributes: Optional[dict] = None) -> "RecordedSpan":
+        self.events.append((name, attributes or {}))
+        return self
+
+    def record_exception(self, exc: BaseException) -> None:
+        self.events.append(
+            ("exception", {"exception.type": type(exc).__name__, "exception.message": str(exc)})
+        )
+
+    def set_status(self, status: str, description: Optional[str] = None) -> None:
+        self.status = status
+        self.status_description = description
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"RecordedSpan({self.name!r}, trace={self.trace_id:032x}, "
+            f"span={self.span_id:016x}, parent="
+            f"{'-' if self.parent_span_id is None else format(self.parent_span_id, '016x')})"
+        )
+
+
+class InMemorySpanExporter:
+    """Collects finished :class:`RecordedSpan` objects for test assertions.
+
+    Stand-in for ``opentelemetry.sdk``'s in-memory exporter on images where
+    only ``opentelemetry-api`` is installed.
+    """
+
+    def __init__(self, max_spans: int = 10_000):
+        self._lock = threading.Lock()
+        self._spans: list[RecordedSpan] = []
+        self._max_spans = max_spans
+
+    def export(self, span: RecordedSpan) -> None:
+        with self._lock:
+            if len(self._spans) < self._max_spans:
+                self._spans.append(span)
+
+    @property
+    def spans(self) -> list[RecordedSpan]:
+        with self._lock:
+            return list(self._spans)
+
+    def find(self, name: str) -> list[RecordedSpan]:
+        return [s for s in self.spans if s.name == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# Ambient current span for recording mode. contextvars gives correct
+# nesting per-thread / per-async-task; cross-thread and cross-process hops
+# must pass an explicit traceparent (which is what the wire formats do).
+_CURRENT_SPAN: contextvars.ContextVar[Optional[RecordedSpan]] = contextvars.ContextVar(
+    "kvtpu_current_span", default=None
+)
+
+_recording_exporter: Optional[InMemorySpanExporter] = None
+
+
+def _new_trace_id() -> int:
+    return random.getrandbits(128) or 1
+
+
+def _new_span_id() -> int:
+    return random.getrandbits(64) or 1
+
+
+def _otel_provider_configured() -> bool:
+    """True when a real (recording) TracerProvider is installed globally.
+
+    The api-only default providers live under ``opentelemetry.trace``; any
+    real SDK (or host-supplied) provider comes from another module.
+    """
+    if _otel_trace is None:
+        return False
+    provider = _otel_trace.get_tracer_provider()
+    return not type(provider).__module__.startswith("opentelemetry.trace")
+
 
 class _Tracer:
-    """Thin facade: OTel tracer when available, no-op otherwise."""
+    """Thin facade: recording exporter > OTel provider > no-op."""
 
     def __init__(self) -> None:
         self._otel_tracer = None
-        if _otel_trace is not None:
+        if _otel_trace is not None and _otel_provider_configured():
             self._otel_tracer = _otel_trace.get_tracer(_SERVICE_NAME)
 
+    def span(
+        self,
+        name: str,
+        parent_traceparent: Optional[str] = None,
+        **attributes,
+    ):
+        """Context manager yielding a span.
+
+        ``parent_traceparent`` (a W3C header value) links this span under a
+        remote parent — used on the server side of the gRPC hop and by the
+        event-pool ingest loop; when omitted the ambient current span (if
+        any) is the parent. Remaining kwargs become span attributes, set at
+        span start. On exception exit the exception is recorded on the span
+        with ERROR status and re-raised.
+        """
+        if _recording_exporter is not None:
+            return self._recording_span(name, parent_traceparent, attributes)
+        if self._otel_tracer is not None:
+            return self._otel_span(name, parent_traceparent, attributes)
+        return _NOOP_CM
+
     @contextlib.contextmanager
-    def span(self, name: str, **attributes) -> Iterator[object]:
-        if self._otel_tracer is None:
-            yield _NoopSpan()
-            return
-        with self._otel_tracer.start_as_current_span(name) as sp:
-            for k, v in attributes.items():
-                sp.set_attribute(k, v)
+    def _recording_span(
+        self, name: str, parent_traceparent: Optional[str], attributes: dict
+    ) -> Iterator[RecordedSpan]:
+        exporter = _recording_exporter
+        trace_id: Optional[int] = None
+        parent_id: Optional[int] = None
+        parsed = parse_traceparent(parent_traceparent)
+        if parsed is not None:
+            trace_id, parent_id, _flags = parsed
+        else:
+            cur = _CURRENT_SPAN.get()
+            if cur is not None:
+                trace_id, parent_id = cur.trace_id, cur.span_id
+        if trace_id is None:
+            trace_id = _new_trace_id()
+        sp = RecordedSpan(name, trace_id, _new_span_id(), parent_id, attributes)
+        token = _CURRENT_SPAN.set(sp)
+        try:
             yield sp
+        except BaseException as exc:
+            sp.record_exception(exc)
+            sp.set_status("ERROR", str(exc))
+            raise
+        finally:
+            _CURRENT_SPAN.reset(token)
+            sp.end_time = time.time()
+            if exporter is not None:
+                exporter.export(sp)
+
+    @contextlib.contextmanager
+    def _otel_span(
+        self, name: str, parent_traceparent: Optional[str], attributes: dict
+    ) -> Iterator[object]:
+        context = None
+        parsed = parse_traceparent(parent_traceparent)
+        if parsed is not None:
+            trace_id, span_id, flags = parsed
+            remote = _otel_trace.SpanContext(
+                trace_id=trace_id,
+                span_id=span_id,
+                is_remote=True,
+                trace_flags=_otel_trace.TraceFlags(flags),
+            )
+            context = _otel_trace.set_span_in_context(_otel_trace.NonRecordingSpan(remote))
+        with self._otel_tracer.start_as_current_span(
+            name, context=context, attributes=attributes or None, end_on_exit=True
+        ) as sp:
+            try:
+                yield sp
+            except BaseException as exc:
+                sp.record_exception(exc)
+                try:
+                    from opentelemetry.trace import Status, StatusCode
+
+                    sp.set_status(Status(StatusCode.ERROR, str(exc)))
+                except Exception:  # pragma: no cover - api drift  # lint: allow-swallow
+                    pass
+                raise
 
 
 _tracer: Optional[_Tracer] = None
@@ -57,6 +343,60 @@ def tracer() -> _Tracer:
     if _tracer is None:
         _tracer = _Tracer()
     return _tracer
+
+
+def current_traceparent() -> Optional[str]:
+    """The ambient span's W3C ``traceparent``, or None when untraced.
+
+    This is what gets injected into outbound gRPC metadata and onto the
+    ZMQ event wire.
+    """
+    if _recording_exporter is not None:
+        cur = _CURRENT_SPAN.get()
+        if cur is not None:
+            return cur.traceparent
+        return None
+    if _otel_trace is not None:
+        ctx = _otel_trace.get_current_span().get_span_context()
+        if ctx is not None and ctx.trace_id != 0 and ctx.span_id != 0:
+            return format_traceparent(
+                ctx.trace_id, ctx.span_id, bool(int(ctx.trace_flags) & 0x01)
+            )
+    return None
+
+
+def install_span_exporter(
+    exporter: Optional[InMemorySpanExporter] = None,
+) -> InMemorySpanExporter:
+    """Switch the facade into recording mode (tests, ``kvdiag`` deep-debug).
+
+    Returns the active exporter (created when not supplied). Call
+    :func:`uninstall_span_exporter` to restore the previous mode.
+    """
+    global _recording_exporter, _tracer
+    if exporter is None:
+        exporter = InMemorySpanExporter()
+    _recording_exporter = exporter
+    _tracer = None  # rebuild so mode resolution sees the exporter
+    return exporter
+
+
+def uninstall_span_exporter() -> None:
+    global _recording_exporter, _tracer
+    _recording_exporter = None
+    _tracer = None
+
+
+@contextlib.contextmanager
+def recording_tracing(
+    exporter: Optional[InMemorySpanExporter] = None,
+) -> Iterator[InMemorySpanExporter]:
+    """Scoped :func:`install_span_exporter` — the test-fixture form."""
+    installed = install_span_exporter(exporter)
+    try:
+        yield installed
+    finally:
+        uninstall_span_exporter()
 
 
 def init_tracing(service_name: Optional[str] = None) -> bool:

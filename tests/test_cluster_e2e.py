@@ -28,6 +28,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 MODEL = "tiny"
 ZMQ_PORT = 15910
 GRPC_PORT = 15911
+ADMIN_PORT = 15912
 
 
 def wait_until(cond, timeout=60.0, interval=0.1):
@@ -86,6 +87,7 @@ class TestClusterTopology:
                 "--zmq-endpoint", f"tcp://127.0.0.1:{ZMQ_PORT}",
                 "--grpc-address", f"127.0.0.1:{GRPC_PORT}",
                 "--block-size", "4",
+                "--admin-port", str(ADMIN_PORT),
             ])
             for pod in ("pod-0", "pod-1", "pod-2"):
                 procs[pod] = start_pod(pod, control, store)
@@ -115,6 +117,27 @@ class TestClusterTopology:
                             lambda s: s and max(s, key=s.get) == p
                         )(client.get_pod_scores(t, MODEL)),
                         timeout=20.0), f"scores never converged onto {pod}"
+
+                # Live-cluster diagnostic snapshot: kvdiag against the
+                # indexer's admin endpoint must surface the flight
+                # recorder, per-pod event lag, and the efficiency ledger.
+                diag = subprocess.run(
+                    [sys.executable, "hack/kvdiag.py",
+                     "--port", str(ADMIN_PORT)],
+                    cwd=str(REPO), capture_output=True, text=True, timeout=30)
+                assert diag.returncode == 0, diag.stderr
+                report = json.loads(diag.stdout)
+                assert report["healthz"]["body"] == {"status": "ok"}
+                records = report["debug"]["flight_recorder"]
+                assert any(r["kind"] == "score" for r in records)
+                lag_pods = report["debug"]["lag"]["pods"]
+                assert {"pod-0", "pod-1", "pod-2"} <= set(lag_pods)
+                assert all(p["messages"] > 0 for p in lag_pods.values())
+                ledger = report["debug"]["ledger"]
+                assert ledger["score_calls"] > 0
+                assert set(ledger["pods"]) & {"pod-0", "pod-1", "pod-2"}
+                assert any(name.startswith("kvcache_")
+                           for name in report["metrics"])
 
                 # Kill pod-1 mid-run (SIGKILL: crash, not graceful stop).
                 procs["pod-1"].kill()

@@ -1,31 +1,524 @@
-"""Telemetry init and span-facade tests."""
+"""Telemetry tests: span facade, W3C propagation, flight recorder,
+event-lag bookkeeping, admin endpoint, and the end-to-end trace.
 
+The cross-hop trace test exercises the full ISSUE-3 path with real
+transports: tokenizer gRPC (UDS/TCP) with ``traceparent`` metadata, the
+ZMQ event wire with the payload-embedded traceparent, and the pool's
+ingest span parenting — all captured by the in-repo recording exporter
+(no OpenTelemetry SDK needed).
+"""
+
+import json
 import os
+import signal
+import threading
+import time
+import urllib.request
 
-from llmd_kv_cache_tpu.telemetry import init_tracing, tracer
+import msgpack
+import pytest
+
+from llmd_kv_cache_tpu.telemetry import (
+    FlightRecorder,
+    attach_failpoint_listener,
+    current_traceparent,
+    flight_recorder,
+    format_traceparent,
+    init_tracing,
+    install_signal_dump,
+    parse_traceparent,
+    recording_tracing,
+    set_flight_recorder,
+    tracer,
+)
+from llmd_kv_cache_tpu.telemetry.flight_recorder import KIND_SCORE
 
 
-def test_spans_noop_without_provider():
-    with tracer().span("test.span", foo=1) as span:
-        span.set_attribute("bar", 2)  # must not raise
+def wait_until(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
 
 
-def test_init_tracing_none_exporter_disables(monkeypatch):
-    monkeypatch.setenv("OTEL_TRACES_EXPORTER", "none")
-    assert init_tracing() is False
+class TestSpanFacade:
+    def test_spans_noop_without_provider(self):
+        with tracer().span("test.span", foo=1) as span:
+            span.set_attribute("bar", 2)  # must not raise
+
+    def test_noop_span_chains_and_accepts_kwargs(self):
+        # Satellite: the no-op path must swallow attribute kwargs and
+        # support chained mutators without allocating per call.
+        cm1 = tracer().span("llm_d.kv_cache.a", model="m", tokens=7)
+        cm2 = tracer().span("llm_d.kv_cache.b")
+        assert cm1 is cm2  # shared allocation-free context manager
+        with cm1 as span:
+            assert span.set_attribute("k", 1).set_attribute("k2", 2) is span
+            assert span.add_event("e", {"a": 1}) is span
+
+    def test_noop_span_reraises(self):
+        with pytest.raises(ValueError):
+            with tracer().span("llm_d.kv_cache.err"):
+                raise ValueError("boom")
 
 
-def test_init_tracing_installs_provider(monkeypatch):
-    monkeypatch.delenv("OTEL_TRACES_EXPORTER", raising=False)
-    monkeypatch.setenv("OTEL_SERVICE_NAME", "kvtpu-test")
-    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://127.0.0.1:1")
-    installed = init_tracing()
-    if installed:  # exporter packages present in this image
-        from opentelemetry import trace
+class TestTraceparent:
+    def test_round_trip(self):
+        tp = format_traceparent(0xABC, 0xDEF)
+        assert tp == f"00-{0xABC:032x}-{0xDEF:016x}-01"
+        assert parse_traceparent(tp) == (0xABC, 0xDEF, 1)
 
-        provider = trace.get_tracer_provider()
-        assert type(provider).__name__ == "TracerProvider"
-        # spans now record through the facade without error (export to the
-        # dead endpoint is batched/async and harmless)
-        with tracer().span("test.live", x=1):
-            pass
+    def test_unsampled_flag(self):
+        tp = format_traceparent(1, 2, sampled=False)
+        assert tp.endswith("-00")
+        assert parse_traceparent(tp) == (1, 2, 0)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-zz-11-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+        "00-" + "1" * 31 + "-" + "1" * 16 + "-01",  # short trace id
+    ])
+    def test_malformed_dropped(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_current_traceparent_none_outside_span(self):
+        assert current_traceparent() is None
+
+
+class TestRecordingExporter:
+    def test_parentage_and_attributes(self):
+        with recording_tracing() as exporter:
+            with tracer().span("llm_d.kv_cache.outer", model="m") as outer:
+                outer.set_attribute("extra", 1)
+                with tracer().span("llm_d.kv_cache.inner"):
+                    pass
+            outer_rec = exporter.find("llm_d.kv_cache.outer")[0]
+            inner_rec = exporter.find("llm_d.kv_cache.inner")[0]
+            assert outer_rec.attributes == {"model": "m", "extra": 1}
+            assert outer_rec.parent_span_id is None
+            assert inner_rec.trace_id == outer_rec.trace_id
+            assert inner_rec.parent_span_id == outer_rec.span_id
+            assert outer_rec.end_time is not None
+
+    def test_explicit_parent_traceparent_wins(self):
+        with recording_tracing() as exporter:
+            tp = format_traceparent(0x1234, 0x5678)
+            with tracer().span("llm_d.kv_cache.remote_child",
+                               parent_traceparent=tp):
+                pass
+            rec = exporter.find("llm_d.kv_cache.remote_child")[0]
+            assert rec.trace_id == 0x1234
+            assert rec.parent_span_id == 0x5678
+
+    def test_exception_recorded_with_error_status(self):
+        # Satellite: error exits must record the exception, not drop it.
+        with recording_tracing() as exporter:
+            with pytest.raises(RuntimeError):
+                with tracer().span("llm_d.kv_cache.fails"):
+                    raise RuntimeError("kaput")
+            rec = exporter.find("llm_d.kv_cache.fails")[0]
+            assert rec.status == "ERROR"
+            assert "kaput" in (rec.status_description or "")
+            assert any(name == "exception" and attrs["exception.type"] == "RuntimeError"
+                       for name, attrs in rec.events)
+
+    def test_current_traceparent_inside_span(self):
+        with recording_tracing() as exporter:
+            with tracer().span("llm_d.kv_cache.ambient"):
+                tp = current_traceparent()
+            rec = exporter.find("llm_d.kv_cache.ambient")[0]
+            assert tp == rec.traceparent
+        assert current_traceparent() is None
+
+
+class TestInitTracing:
+    def test_init_tracing_none_exporter_disables(self, monkeypatch):
+        monkeypatch.setenv("OTEL_TRACES_EXPORTER", "none")
+        assert init_tracing() is False
+
+    def test_init_tracing_installs_provider(self, monkeypatch):
+        monkeypatch.delenv("OTEL_TRACES_EXPORTER", raising=False)
+        monkeypatch.setenv("OTEL_SERVICE_NAME", "kvtpu-test")
+        monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://127.0.0.1:1")
+        installed = init_tracing()
+        if installed:  # exporter packages present in this image
+            from opentelemetry import trace
+
+            provider = trace.get_tracer_provider()
+            assert type(provider).__name__ == "TracerProvider"
+            # spans now record through the facade without error (export to the
+            # dead endpoint is batched/async and harmless)
+            with tracer().span("test.live", x=1):
+                pass
+
+
+class TestFlightRecorder:
+    def test_wraparound_keeps_newest(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("score", {"i": i})
+        snap = rec.snapshot()
+        assert len(snap) == 8
+        assert [r["seq"] for r in snap] == list(range(12, 20))
+        assert snap[-1]["data"] == {"i": 19}
+        assert snap[0]["kind"] == "score"
+
+    def test_concurrent_writers_never_tear(self):
+        rec = FlightRecorder(capacity=64)
+        n_threads, per_thread = 8, 500
+
+        def writer(tid):
+            for i in range(per_thread):
+                rec.record("ingest", {"tid": tid, "i": i})
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        # Readers race the writers on purpose: every observed record must
+        # be whole (the ring stores immutable tuples, never torn state).
+        for _ in range(50):
+            for r in rec.snapshot():
+                assert set(r) == {"seq", "ts", "kind", "data"}
+                assert r["kind"] == "ingest"
+        for t in threads:
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap) == 64
+        seqs = [r["seq"] for r in snap]
+        assert seqs == sorted(seqs)
+        # All sequence numbers were claimed exactly once across threads.
+        assert rec.record("score") == n_threads * per_thread
+
+    def test_dump_json_and_clear(self):
+        rec = FlightRecorder(capacity=4)
+        rec.record("offload", {"job_id": 1, "unjsonable": object()})
+        doc = json.loads(rec.dump_json(indent=2))
+        assert doc["capacity"] == 4
+        assert doc["records"][0]["kind"] == "offload"
+        rec.clear()
+        assert rec.snapshot() == []
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+    def test_sigusr2_dump_to_file(self, tmp_path):
+        rec = FlightRecorder(capacity=16)
+        rec.record("failover", {"op": "lookup", "reason": "breaker_open"})
+        out = tmp_path / "ring.json"
+        previous = install_signal_dump(path=str(out), recorder=rec)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            assert wait_until(out.exists)
+            doc = json.loads(out.read_text())
+            assert doc["records"][0]["kind"] == "failover"
+        finally:
+            signal.signal(signal.SIGUSR2, previous)
+
+    def test_failpoint_trip_lands_in_ring(self):
+        from llmd_kv_cache_tpu.resilience.failpoints import FailpointRegistry
+
+        rec = FlightRecorder(capacity=16)
+        set_flight_recorder(rec)
+        try:
+            registry = FailpointRegistry(seed=1)
+            attach_failpoint_listener(registry)
+            registry.arm("test.fp", times=1)
+            assert registry.should_fire("test.fp") is True
+            kinds = [r["kind"] for r in rec.snapshot()]
+            assert "failpoint" in kinds
+            fp = [r for r in rec.snapshot() if r["kind"] == "failpoint"][0]
+            assert fp["data"] == {"name": "test.fp"}
+        finally:
+            set_flight_recorder(None)
+
+
+class TestEventLag:
+    def _msg(self, pod, seq, ts, tokens, block=4):
+        from llmd_kv_cache_tpu.events import RawMessage
+
+        ev = ["BlockStored", [seq + 1000], None, tokens, block]
+        return RawMessage(
+            topic=f"kv@{pod}@m", sequence=seq,
+            payload=msgpack.packb([ts, [ev]], use_bin_type=True),
+        )
+
+    @pytest.fixture
+    def pool(self):
+        from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+        from llmd_kv_cache_tpu.events import Pool, PoolConfig
+        from llmd_kv_cache_tpu.index.base import create_index
+
+        processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=4))
+        p = Pool(PoolConfig(concurrency=2), create_index(None), processor)
+        p.start()
+        yield p
+        p.shutdown()
+
+    def test_lag_and_seq_gap_tracking(self, pool):
+        base = time.time() - 1.0  # published one second ago
+        for seq in (0, 1, 3):  # hole at 2
+            pool.add_task(self._msg("pod-a", seq, base, [1, 2, 3, 4]))
+        pool.add_task(self._msg("pod-b", 0, base, [5, 6, 7, 8]))
+        pool.join()
+
+        stats = pool.lag_stats()
+        assert set(stats["pods"]) == {"pod-a", "pod-b"}
+        a = stats["pods"]["pod-a"]
+        assert a["messages"] == 3
+        assert a["seq_gaps"] == 1
+        assert a["last_seq"] == 3
+        assert a["lag_s"] == pytest.approx(1.0, abs=0.5)
+        assert stats["pods"]["pod-b"]["seq_gaps"] == 0
+        assert stats["staleness_s"] == pytest.approx(1.0, abs=0.5)
+        assert stats["lag_p50_s"] > 0.0
+        assert stats["lag_p99_s"] >= stats["lag_p50_s"]
+        assert len(stats["queue_depths"]) == 2
+        assert pool.index_staleness_s() == pytest.approx(1.0, abs=0.5)
+
+    def test_out_of_order_is_not_a_gap(self, pool):
+        now = time.time()
+        for seq in (1, 0, 2):  # reordered, not lost
+            pool.add_task(self._msg("pod-a", seq, now, [1, 2, 3, 4]))
+        pool.join()
+        assert pool.lag_stats()["pods"]["pod-a"]["seq_gaps"] == 0
+
+    def test_empty_pool_stats(self, pool):
+        stats = pool.lag_stats()
+        assert stats["pods"] == {}
+        assert stats["staleness_s"] == 0.0
+        assert "lag_p50_s" not in stats
+
+
+class TestCacheEfficiencyLedger:
+    def test_score_and_event_attribution(self):
+        from llmd_kv_cache_tpu.scoring.indexer import CacheEfficiencyLedger
+
+        ledger = CacheEfficiencyLedger()
+        ledger.record_score({"pod-a": 3.0, "pod-b": 1.0}, total_blocks=8, hit_blocks=4)
+        ledger.record_score({"pod-b": 2.0}, total_blocks=4, hit_blocks=2)
+        ledger.record_score({}, total_blocks=2, hit_blocks=0)
+        ledger.record_store("pod-a", 5)
+        ledger.record_evict("pod-a", 2)
+        ledger.record_clear("pod-b")
+
+        snap = ledger.snapshot()
+        assert snap["score_calls"] == 3
+        assert snap["lookup_blocks"] == 14
+        assert snap["lookup_hit_blocks"] == 6
+        assert snap["lookup_miss_blocks"] == 8
+        a, b = snap["pods"]["pod-a"], snap["pods"]["pod-b"]
+        assert a["appearances"] == 1 and a["wins"] == 1
+        assert a["score_total"] == 3.0
+        assert a["stored_blocks"] == 5 and a["evicted_blocks"] == 2
+        assert b["appearances"] == 2 and b["wins"] == 1
+        assert b["clears"] == 1
+
+    def test_indexer_feeds_ledger(self):
+        from llmd_kv_cache_tpu.core.keys import PodEntry
+        from llmd_kv_cache_tpu.scoring import Indexer
+
+        indexer = Indexer()
+        tokens = list(range(64))
+        keys = indexer.compute_block_keys(tokens, "m")
+        indexer.kv_block_index.add(None, keys, [PodEntry("pod-x", "gpu")])
+        scores = indexer.score_tokens(tokens, "m")
+        assert scores["pod-x"] > 0
+        snap = indexer.ledger.snapshot()
+        assert snap["score_calls"] == 1
+        assert snap["pods"]["pod-x"]["wins"] == 1
+
+
+class TestAdminServer:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read()
+
+    def test_endpoints(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        rec = FlightRecorder(capacity=16)
+        set_flight_recorder(rec)
+        server = AdminServer(port=0)
+        server.register_debug("lag", lambda: {"pods": {"pod-a": {"lag_s": 0.5}}})
+        server.register_debug("broken", lambda: 1 / 0)
+        try:
+            port = server.start()
+            assert port > 0
+            rec.record(KIND_SCORE, {"model": "m", "scores": {"pod-a": 1.0}})
+
+            status, body = self._get(port, "/healthz")
+            assert status == 200 and json.loads(body) == {"status": "ok"}
+
+            status, body = self._get(port, "/metrics")
+            assert status == 200 and b"kvcache_" in body
+
+            status, body = self._get(port, "/debug/flight-recorder")
+            doc = json.loads(body)
+            assert doc["records"][0]["kind"] == "score"
+
+            status, body = self._get(port, "/debug/lag")
+            assert json.loads(body)["pods"]["pod-a"]["lag_s"] == 0.5
+
+            status, body = self._get(port, "/debug/vars")
+            doc = json.loads(body)
+            assert doc["flight_recorder"][0]["kind"] == "score"
+            assert doc["lag"]["pods"]["pod-a"]["lag_s"] == 0.5
+            assert "error" in doc["broken"]  # broken provider isolated
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(port, "/nope")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+            set_flight_recorder(None)
+
+    def test_metrics_only_server_hides_debug(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        server = AdminServer(port=0, expose_debug=False)
+        try:
+            port = server.start()
+            status, _ = self._get(port, "/healthz")
+            assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(port, "/debug/vars")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_kvdiag_snapshot(self):
+        import importlib.util
+        from pathlib import Path
+
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        spec = importlib.util.spec_from_file_location(
+            "kvdiag", Path(__file__).resolve().parents[1] / "hack" / "kvdiag.py"
+        )
+        kvdiag = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(kvdiag)
+
+        rec = FlightRecorder(capacity=16)
+        set_flight_recorder(rec)
+        rec.record(KIND_SCORE, {"model": "m"})
+        server = AdminServer(port=0)
+        server.register_debug("lag", lambda: {"pods": {}, "staleness_s": 0.0})
+        server.register_debug("ledger", lambda: {"score_calls": 0, "pods": {}})
+        try:
+            port = server.start()
+            report = kvdiag.snapshot("127.0.0.1", port)
+            assert report["healthz"]["body"] == {"status": "ok"}
+            assert report["debug"]["flight_recorder"][0]["kind"] == "score"
+            assert "lag" in report["debug"] and "ledger" in report["debug"]
+            assert any(k.startswith("kvcache_") for k in report["metrics"])
+        finally:
+            server.stop()
+            set_flight_recorder(None)
+
+
+class TestEndToEndTrace:
+    """One trace across tokenize (gRPC) → score → publish (ZMQ) → ingest
+    → index add, asserted via the recording exporter."""
+
+    def test_full_request_trace(self, tmp_path):
+        from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+        from llmd_kv_cache_tpu.events import (
+            BlockStoredEvent,
+            Pool,
+            PoolConfig,
+            ZMQSubscriber,
+        )
+        from llmd_kv_cache_tpu.events.publisher import KVEventPublisher
+        from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+        from llmd_kv_cache_tpu.index.instrumented import TracedIndex
+        from llmd_kv_cache_tpu.scoring import Indexer
+        from llmd_kv_cache_tpu.services.tokenizer import (
+            UdsTokenizerClient,
+            serve_uds,
+        )
+
+        block = 4
+        with recording_tracing() as exporter:
+            sock = str(tmp_path / "tok.sock")
+            server = serve_uds(sock)
+            client = UdsTokenizerClient(sock, timeout_s=10.0)
+
+            processor = ChunkedTokenDatabase(
+                TokenProcessorConfig(block_size_tokens=block)
+            )
+            index = TracedIndex(InMemoryIndex(InMemoryIndexConfig(size=10_000)))
+            pool = Pool(PoolConfig(concurrency=1), index, processor)
+            pool.start()
+            endpoint = "tcp://127.0.0.1:15733"
+            pub = KVEventPublisher(
+                endpoint, pod_identifier="pod-a", model_name="m", bind=True
+            )
+            sub = ZMQSubscriber(endpoint, "kv@", pool.add_task, bind=False)
+            sub.start()
+            time.sleep(0.3)  # PUB/SUB slow-joiner settle
+
+            indexer = Indexer()
+            try:
+                with tracer().span("llm_d.kv_cache.request") as root_span:
+                    tokens = client.encode("simple", "hello traced world").token_ids
+                    indexer.score_tokens(tokens, "m")
+                    event = BlockStoredEvent(
+                        block_hashes=[11], tokens=tokens[:block],
+                        parent_hash=0, block_size=block,
+                    )
+                    # Republish until the slow-joiner window has passed;
+                    # every publish carries the ambient traceparent.
+                    assert wait_until(
+                        lambda: (
+                            pub.publish([event]) or
+                            exporter.find("llm_d.kv_cache.events.ingest")
+                        ),
+                        timeout=10.0, interval=0.2,
+                    ), "ingest span never arrived over the ZMQ hop"
+                assert wait_until(
+                    lambda: exporter.find("llm_d.kv_cache.index.add")
+                )
+            finally:
+                sub.stop()
+                pub.close()
+                pool.shutdown()
+                client.close()
+                server.stop(grace=None)
+
+            root = exporter.find("llm_d.kv_cache.request")[0]
+            assert root.parent_span_id is None
+
+            # gRPC hop: client span under root, server span under client.
+            rpc = exporter.find("llm_d.kv_cache.tokenizer.rpc")[0]
+            assert rpc.trace_id == root.trace_id
+            assert rpc.parent_span_id == root.span_id
+            assert rpc.attributes["method"] == "Tokenize"
+            served = exporter.find("llm_d.kv_cache.tokenizer.Tokenize")[0]
+            assert served.trace_id == root.trace_id
+            assert served.parent_span_id == rpc.span_id
+
+            # Score path joins the same trace ambiently.
+            score = exporter.find("llm_d.kv_cache.score_tokens")[0]
+            assert score.trace_id == root.trace_id
+            assert score.parent_span_id == root.span_id
+
+            # ZMQ hop: ingest parents under root via the wire traceparent;
+            # the index write parents under ingest inside the worker thread.
+            ingest = exporter.find("llm_d.kv_cache.events.ingest")[0]
+            assert ingest.trace_id == root.trace_id
+            assert ingest.parent_span_id == root.span_id
+            assert ingest.attributes["pod"] == "pod-a"
+            adds = [
+                s for s in exporter.find("llm_d.kv_cache.index.add")
+                if s.trace_id == root.trace_id
+            ]
+            assert adds, "index.add span did not join the request trace"
+            ingest_ids = {
+                s.span_id for s in exporter.find("llm_d.kv_cache.events.ingest")
+            }
+            assert adds[0].parent_span_id in ingest_ids
