@@ -253,6 +253,66 @@ class Index {
     return data_.size();
   }
 
+  uint64_t MapSize() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return mappings_.size();
+  }
+
+  // Snapshot dump of the request-key table under one lock hold: per key,
+  // its entries packed as 4 ints (pod, tier, flags, group). Returns the
+  // number of keys written, or -1 when either cap is too small (the
+  // caller sizes from Size() * pods_per_key and retries on growth races).
+  int Dump(uint64_t* out_keys, int32_t* out_counts, int key_cap,
+           int32_t* out_entries, int entry_cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (static_cast<int64_t>(data_.size()) > key_cap) return -1;
+    int nk = 0;
+    int total = 0;
+    for (auto& [key, slot] : data_) {
+      if (total + static_cast<int>(slot.entries.size()) > entry_cap) return -1;
+      out_keys[nk] = key;
+      out_counts[nk] = static_cast<int32_t>(slot.entries.size());
+      for (const Entry& e : slot.entries) {
+        int32_t* dst = out_entries + total * 4;
+        dst[0] = e.pod;
+        dst[1] = e.tier;
+        dst[2] = e.flags;
+        dst[3] = e.group;
+        ++total;
+      }
+      ++nk;
+    }
+    return nk;
+  }
+
+  // Snapshot dump of the engine→request mapping table. Returns mapping
+  // count, or -1 when a cap is too small.
+  int DumpMappings(uint64_t* out_keys, int32_t* out_counts, int key_cap,
+                   uint64_t* out_request_keys, int rk_cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (static_cast<int64_t>(mappings_.size()) > key_cap) return -1;
+    int nk = 0;
+    int total = 0;
+    for (auto& [key, slot] : mappings_) {
+      if (total + static_cast<int>(slot.request_keys.size()) > rk_cap) return -1;
+      out_keys[nk] = key;
+      out_counts[nk] = static_cast<int32_t>(slot.request_keys.size());
+      for (uint64_t rk : slot.request_keys) out_request_keys[total++] = rk;
+      ++nk;
+    }
+    return nk;
+  }
+
+  // Restore one engine→request mapping without touching the key table.
+  // Add with zero entries would TouchKey an empty PodSlot, and Lookup
+  // treats a known-but-empty key as a broken prefix chain — snapshot
+  // restore must not create those.
+  void SetMapping(uint64_t engine_key, const uint64_t* request_keys, int n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    MapSlot& slot = TouchMapping(engine_key, true);
+    slot.request_keys.assign(request_keys, request_keys + n);
+  }
+
   // Fused lookup + longest-prefix tier-weighted scoring (the whole
   // scheduler hot path in one native call; mirrors scoring/scorer.py's
   // LongestPrefixScorer semantics exactly).
@@ -532,6 +592,25 @@ void kvidx_clear(void* idx, int32_t pod) {
 }
 
 uint64_t kvidx_len(void* idx) { return static_cast<Index*>(idx)->Size(); }
+
+uint64_t kvidx_map_len(void* idx) { return static_cast<Index*>(idx)->MapSize(); }
+
+int kvidx_dump(void* idx, uint64_t* out_keys, int32_t* out_counts, int key_cap,
+               int32_t* out_entries, int entry_cap) {
+  return static_cast<Index*>(idx)->Dump(out_keys, out_counts, key_cap,
+                                        out_entries, entry_cap);
+}
+
+int kvidx_dump_mappings(void* idx, uint64_t* out_keys, int32_t* out_counts,
+                        int key_cap, uint64_t* out_request_keys, int rk_cap) {
+  return static_cast<Index*>(idx)->DumpMappings(out_keys, out_counts, key_cap,
+                                                out_request_keys, rk_cap);
+}
+
+void kvidx_set_mapping(void* idx, uint64_t engine_key,
+                       const uint64_t* request_keys, int n) {
+  static_cast<Index*>(idx)->SetMapping(engine_key, request_keys, n);
+}
 
 int kvidx_score(void* idx, const uint64_t* keys, int n_keys,
                 const int32_t* filter_pods, int n_filter,

@@ -81,6 +81,25 @@ def main() -> None:
              "(default localhost; 0.0.0.0 exposes beyond the pod)",
     )
     parser.add_argument(
+        "--snapshot-dir", default="",
+        help="directory for crash-recovery index snapshots + event journal "
+             "(docs/resilience.md); empty (default) disables the recovery "
+             "subsystem",
+    )
+    parser.add_argument(
+        "--snapshot-interval-s", type=float, default=30.0,
+        help="periodic snapshot cadence; 0 = only on shutdown/drain",
+    )
+    parser.add_argument(
+        "--warmup-staleness-bound-s", type=float, default=5.0,
+        help="post-restart readiness gate: /healthz stays 503 and scores "
+             "are flagged degraded until index staleness drops below this",
+    )
+    parser.add_argument(
+        "--drain-deadline-s", type=float, default=10.0,
+        help="total wall-clock budget for the SIGTERM graceful drain",
+    )
+    parser.add_argument(
         "--tokenizer-socket", default=None,
         help="UDS tokenizer sidecar socket for the protobuf prompt-scoring "
              "surface; without it prompts are tokenized in-process "
@@ -120,6 +139,13 @@ def main() -> None:
         "adminPort": args.admin_port,
         "adminHost": args.admin_host,
     }
+    if args.snapshot_dir:
+        indexer_cfg_dict["recoveryConfig"] = {
+            "snapshotDir": args.snapshot_dir,
+            "snapshotIntervalS": args.snapshot_interval_s,
+            "warmupStalenessBoundS": args.warmup_staleness_bound_s,
+            "drainDeadlineS": args.drain_deadline_s,
+        }
     if args.index_backend in ("redis", "valkey"):
         key = "valkeyConfig" if args.index_backend == "valkey" else "redisConfig"
         indexer_cfg_dict["kvBlockIndexConfig"] = {
@@ -153,6 +179,12 @@ def main() -> None:
         reconciler.start()
 
     server = serve(args.grpc_address, service)
+    if service.recovery is not None:
+        # SIGTERM → bounded graceful drain (stop intake, flush, final
+        # snapshot), then stop the gRPC server so wait_for_termination
+        # returns and the normal shutdown path below runs.
+        service.install_drain_handler(
+            on_complete=lambda: server.stop(grace=1.0))
     try:
         server.wait_for_termination()
     finally:

@@ -1,13 +1,25 @@
 #!/usr/bin/env python
 """Resilience lint: forbid silently-dropped errors in the library.
 
-Two AST checks over every ``.py`` file under the given roots (default
+AST checks over every ``.py`` file under the given roots (default
 ``llmd_kv_cache_tpu``):
 
 1. **bare except** — ``except:`` catches ``KeyboardInterrupt`` and
    ``SystemExit`` too; name the exception.
 2. **swallowed exception** — a handler whose body is only ``pass``/``...``
    silently erases the failure. Either handle it, log it, or re-raise.
+3. **non-atomic persistence** (``offload/`` and ``recovery/`` only) —
+   ``open(path, "w"/"wb"/...)`` publishes a file non-atomically: a crash
+   mid-write leaves a truncated file that later reads as corruption.
+   Durable state under those trees must go through
+   ``utils.atomic_io.atomic_write_bytes`` (tmp + fsync + rename).
+   Append mode (``"ab"``, the journal's framing-tolerant format) is
+   exempt; an intentional exception carries
+   ``# lint: allow-nonatomic (why)`` on the line.
+4. **recovery knobs documented** — every field of a ``*Config``
+   dataclass under ``recovery/`` must appear (camelCased) in
+   ``docs/configuration.md``; an undocumented knob is a default nobody
+   can change.
 
 A handler that is intentionally fire-and-forget (e.g. best-effort cleanup
 in a ``__del__``) may carry the explicit marker comment
@@ -28,6 +40,35 @@ import sys
 from pathlib import Path
 
 ALLOW_MARKER = "lint: allow-swallow"
+ALLOW_NONATOMIC = "lint: allow-nonatomic"
+ATOMIC_TREES = ("offload", "recovery")
+CONFIG_DOCS_PATH = Path("docs/configuration.md")
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _open_write_mode(call: ast.Call) -> str:
+    """The literal mode string iff this is ``open()`` in a write mode."""
+    fn = call.func
+    is_open = (isinstance(fn, ast.Name) and fn.id == "open") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "open"
+    )
+    if not is_open:
+        return ""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        m = mode.value
+        if "w" in m or "x" in m or "+" in m:
+            return m
+    return ""
 
 
 def _is_swallow(handler: ast.ExceptHandler) -> bool:
@@ -49,7 +90,18 @@ def lint_file(path: Path) -> list[str]:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
     lines = src.splitlines()
     problems = []
+    check_atomic = any(part in ATOMIC_TREES for part in path.parts)
     for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and check_atomic:
+            mode = _open_write_mode(node)
+            line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+            if mode and ALLOW_NONATOMIC not in line:
+                problems.append(
+                    f"{path}:{node.lineno}: non-atomic persistence — "
+                    f"open(..., {mode!r}) under {'/'.join(ATOMIC_TREES)} "
+                    "can tear on crash; use utils.atomic_io."
+                    f"atomic_write_bytes (or mark `# {ALLOW_NONATOMIC} (why)`)"
+                )
         if not isinstance(node, ast.ExceptHandler):
             continue
         line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
@@ -67,6 +119,43 @@ def lint_file(path: Path) -> list[str]:
     return problems
 
 
+def _config_fields(path: Path) -> list[tuple[int, str]]:
+    """(lineno, field_name) per annotated field of each ``*Config`` class."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) or not node.name.endswith("Config"):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                if not name.startswith("_"):
+                    out.append((stmt.lineno, name))
+    return out
+
+
+def check_recovery_knob_docs(root: Path) -> list[str]:
+    """Every recovery config knob must be documented in configuration.md."""
+    recovery_dir = root / "recovery" if root.is_dir() else None
+    if recovery_dir is None or not recovery_dir.is_dir():
+        return []
+    if not CONFIG_DOCS_PATH.exists():
+        return [f"{CONFIG_DOCS_PATH}: missing — recovery knobs must be documented there"]
+    text = CONFIG_DOCS_PATH.read_text()
+    problems = []
+    for f in sorted(recovery_dir.rglob("*.py")):
+        for lineno, name in _config_fields(f):
+            if _camel(name) not in text:
+                problems.append(
+                    f"{f}:{lineno}: config knob `{name}` "
+                    f"(`{_camel(name)}`) is not documented in {CONFIG_DOCS_PATH}"
+                )
+    return problems
+
+
 def main(argv: list[str]) -> int:
     roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
     problems: list[str] = []
@@ -76,6 +165,7 @@ def main(argv: list[str]) -> int:
         for f in files:
             n_files += 1
             problems.extend(lint_file(f))
+        problems.extend(check_recovery_knob_docs(root))
     for p in problems:
         print(p)
     print(

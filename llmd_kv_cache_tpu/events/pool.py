@@ -30,7 +30,7 @@ from ..core.token_processor import ChunkedTokenDatabase
 from ..index.base import Index
 from ..resilience.liveness import PodLivenessTracker
 from ..telemetry import flight_recorder, tracer
-from ..telemetry.flight_recorder import KIND_INGEST
+from ..telemetry.flight_recorder import KIND_INGEST, KIND_OVERFLOW
 from ..utils.fnv import fnv1a_32
 from ..utils.logging import get_logger
 from .adapters import create_adapter
@@ -84,12 +84,18 @@ class PoolConfig:
     # BlockRemoved digests into single index calls. 1 restores strict
     # one-message-at-a-time processing.
     ingest_batch_max: int = 64
+    # Per-shard queue bound. When a shard backs up to this depth, the
+    # *oldest* queued message is dropped to admit the newest (fresh events
+    # carry the current truth; anti-entropy repairs the hole). 0 restores
+    # the old unbounded behavior — and its unbounded-memory failure mode.
+    ingest_queue_max: int = 8192
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PoolConfig":
         if not d:
             return cls()
         batch_max = d.get("ingestBatchMax", d.get("ingest_batch_max"))
+        queue_max = d.get("ingestQueueMax", d.get("ingest_queue_max"))
         cfg = cls(
             zmq_endpoint=d.get("zmqEndpoint", d.get("zmq_endpoint", "")),
             topic_filter=d.get("topicFilter", d.get("topic_filter", "kv@")),
@@ -98,6 +104,7 @@ class PoolConfig:
             discover_pods=d.get("discoverPods", d.get("discover_pods", False)),
             track_dp_rank=d.get("trackDPRank", d.get("track_dp_rank", False)),
             ingest_batch_max=64 if batch_max is None else batch_max,
+            ingest_queue_max=8192 if queue_max is None else queue_max,
             liveness_stale_after_s=d.get(
                 "livenessStaleAfterSeconds",
                 d.get("liveness_stale_after_s", 30.0),
@@ -148,8 +155,11 @@ class Pool:
                 drop_after_s=max(self.cfg.liveness_drop_after_s,
                                  self.cfg.liveness_stale_after_s * 2),
             )
+        # maxsize=0 means unbounded (queue.Queue semantics); see
+        # PoolConfig.ingest_queue_max for the drop-oldest overflow policy.
         self._queues: list[queue.Queue] = [
-            queue.Queue() for _ in range(self.cfg.concurrency)
+            queue.Queue(maxsize=max(0, self.cfg.ingest_queue_max))
+            for _ in range(self.cfg.concurrency)
         ]
         self._threads: list[threading.Thread] = []
         self._started = False
@@ -172,6 +182,13 @@ class Pool:
         # Per-pod cache-efficiency ledger (Indexer owns it; the service
         # wires the same object here so store/evict events attribute).
         self.ledger = None
+        # Queue-overflow accounting (bounded shards drop the oldest
+        # message; recovery's anti-entropy repairs the resulting holes).
+        self.dropped_events = 0
+        # Optional journal hook (recovery.manager.attach_journal): called
+        # with (pod_id, sequence, topic, payload, event_ts) for every
+        # successfully parsed live message.
+        self.journal_sink = None
         self._tracer = tracer()
         self._recorder = flight_recorder()
 
@@ -217,7 +234,46 @@ class Pool:
                 self._shard_cache.clear()
             shard = fnv1a_32(key.encode("utf-8")) % self.cfg.concurrency
             self._shard_cache[key] = shard
-        self._queues[shard].put(task)
+        q = self._queues[shard]
+        dropped = 0
+        while True:
+            try:
+                q.put_nowait(task)
+                break
+            except queue.Full:
+                # Drop-oldest: the newest message carries the pod's current
+                # truth, so it must land; the evicted hole is repaired by
+                # anti-entropy (recovery.reconcile). task_done keeps the
+                # unfinished-task count balanced for Pool.join().
+                try:
+                    q.get_nowait()
+                    q.task_done()
+                    dropped += 1
+                except queue.Empty:  # lint: allow-swallow (worker drained the shard; retry the put)
+                    pass
+        if dropped:
+            first = self.dropped_events == 0
+            with self._stats_mu:
+                self.dropped_events += dropped
+            if first:
+                self._recorder.record(
+                    KIND_OVERFLOW,
+                    {
+                        "shard": shard,
+                        "queue_max": self.cfg.ingest_queue_max,
+                        "dropped": dropped,
+                    },
+                )
+                logger.warning(
+                    "event shard %d overflowed (ingestQueueMax=%d); dropping oldest",
+                    shard, self.cfg.ingest_queue_max,
+                )
+            try:
+                from ..metrics.collector import record_dropped_events
+
+                record_dropped_events(shard, dropped)
+            except Exception:  # pragma: no cover - metrics must never break intake  # lint: allow-swallow
+                pass
 
     def _worker(self, worker_index: int) -> None:
         q = self._queues[worker_index]
@@ -284,6 +340,15 @@ class Pool:
             logger.exception("failed to parse message on topic %s", msg.topic)
             return
         self._track_lag(pod_id, msg.sequence, batch.timestamp)
+        if self.journal_sink is not None:
+            try:
+                self.journal_sink(
+                    pod_id, msg.sequence, msg.topic, msg.payload, batch.timestamp
+                )
+            except Exception:
+                # Journaling is best-effort durability; it must never stall
+                # or kill live ingestion.
+                logger.exception("journal append failed for pod %s", pod_id)
         try:
             with self._tracer.span(
                 "llm_d.kv_cache.events.ingest",
@@ -335,6 +400,44 @@ class Pool:
             record_event_lag(pod_id, lag_s, gap)
         except Exception:  # pragma: no cover - metrics must never break ingestion  # lint: allow-swallow
             pass
+
+    def replay_record(self, topic: str, sequence: int, payload: bytes) -> None:
+        """Synchronously re-ingest one journaled message (warm restart).
+
+        Runs the normal parse → track-lag → process path on the caller's
+        thread, bypassing the shard queues; call before ``start()`` /
+        before live subscriptions so replay is ordered ahead of live
+        traffic. The journal sink must not be attached yet, or replayed
+        records would be re-journaled.
+        """
+        self._process_raw_message(RawMessage(topic=topic, sequence=sequence,
+                                             payload=payload))
+
+    def seed_sequences(self, pod_seqs: dict, event_ts: float) -> None:
+        """Seed per-pod watermarks from a snapshot (recovery.manager).
+
+        Lets sequence-gap detection span a restart, and makes
+        ``index_staleness_s`` reflect the snapshot's age until live events
+        catch up — which is the warmup readiness gate. Pods that already
+        progressed past the seed (journal replay, live traffic) keep their
+        newer watermark.
+        """
+        now = time.time()
+        with self._lag_mu:
+            for pod, seq in pod_seqs.items():
+                st = self._pod_lag.get(pod)
+                if st is None:
+                    self._pod_lag[pod] = {
+                        "last_seq": int(seq),
+                        "last_event_ts": float(event_ts),
+                        "last_ingest_ts": now,
+                        "lag_s": 0.0,
+                        "seq_gaps": 0,
+                        "messages": 0,
+                    }
+                elif int(seq) > st["last_seq"]:
+                    st["last_seq"] = int(seq)
+                    st["last_event_ts"] = max(st["last_event_ts"], float(event_ts))
 
     def index_staleness_s(self, now: Optional[float] = None) -> float:
         """Upper-bound age of the index's view of the slowest pod: the

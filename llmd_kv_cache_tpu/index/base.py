@@ -126,6 +126,32 @@ class Index(abc.ABC):
         for key in keys:
             self.evict(key, key_type, entries)
 
+    # -- snapshot capability (recovery/) ----------------------------------
+
+    def dump_state(self) -> Optional[dict]:
+        """Serialize the index contents for a crash-recovery snapshot.
+
+        Returns ``{"entries": [[request_key, [[pod, tier, flags,
+        group_idx], ...]], ...], "mappings": [[engine_key, [request_key,
+        ...]], ...]}`` — plain ints/strings/lists, directly
+        canonical-CBOR-encodable. ``flags`` packs bit0=speculative,
+        bit1=has_group (the native backend's wire layout).
+
+        Returns ``None`` for backends without snapshot support — e.g. the
+        Redis/Valkey backend, which is already durable on its own and
+        survives indexer restarts without our help.
+        """
+        return None
+
+    def restore_state(self, state: dict) -> int:
+        """Load a :meth:`dump_state` document; returns entries restored.
+
+        Restored state is soft: live events layered on top converge it,
+        so a restore into a non-empty index is additive, not destructive.
+        Backends without snapshot support return 0.
+        """
+        return 0
+
 
 def infer_engine_mappings(
     engine_keys: Sequence[BlockHash], request_keys: Sequence[BlockHash]

@@ -66,6 +66,12 @@ class InstrumentedIndex(Index):
     def clear(self, pod_identifier: str) -> None:
         self._inner.clear(pod_identifier)
 
+    def dump_state(self):
+        return self._inner.dump_state()
+
+    def restore_state(self, state: dict) -> int:
+        return self._inner.restore_state(state)
+
 
 class TracedIndex(Index):
     """OTel-span Index decorator (no-op without a provider)."""
@@ -112,3 +118,13 @@ class TracedIndex(Index):
     def clear(self, pod_identifier: str) -> None:
         with self._tracer.span("llm_d.kv_cache.index.clear", pod=pod_identifier):
             self._inner.clear(pod_identifier)
+
+    def dump_state(self):
+        with self._tracer.span("llm_d.kv_cache.index.dump_state"):
+            return self._inner.dump_state()
+
+    def restore_state(self, state: dict) -> int:
+        with self._tracer.span("llm_d.kv_cache.index.restore_state") as span:
+            restored = self._inner.restore_state(state)
+            span.set_attribute("restored_entries", restored)
+            return restored

@@ -95,6 +95,19 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.kvidx_score_ex.restype = ctypes.c_int
         lib.kvidx_score_ex.argtypes = lib.kvidx_score.argtypes + [ctypes.c_int]
+        lib.kvidx_map_len.restype = ctypes.c_uint64
+        lib.kvidx_map_len.argtypes = [ctypes.c_void_p]
+        lib.kvidx_dump.restype = ctypes.c_int
+        lib.kvidx_dump.argtypes = [
+            ctypes.c_void_p, u64p, i32p, ctypes.c_int, i32p, ctypes.c_int,
+        ]
+        lib.kvidx_dump_mappings.restype = ctypes.c_int
+        lib.kvidx_dump_mappings.argtypes = [
+            ctypes.c_void_p, u64p, i32p, ctypes.c_int, u64p, ctypes.c_int,
+        ]
+        lib.kvidx_set_mapping.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, u64p, ctypes.c_int,
+        ]
 
         _lib = lib
         return _lib
@@ -392,6 +405,117 @@ class NativeIndex(Index):
 
     def clear(self, pod_identifier: str) -> None:
         self._lib.kvidx_clear(self._handle, self._intern(pod_identifier))
+
+    # -- snapshot capability (recovery/) --
+
+    def dump_state(self) -> dict:
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        key_cap = max(int(self._lib.kvidx_len(self._handle)), 1) + 64
+        entry_cap = key_cap * 16
+        while True:
+            keys = np.empty(key_cap, np.uint64)
+            counts = np.empty(key_cap, np.int32)
+            packed = np.empty(entry_cap * 4, np.int32)
+            nk = self._lib.kvidx_dump(
+                self._handle,
+                keys.ctypes.data_as(u64p), counts.ctypes.data_as(i32p), key_cap,
+                packed.ctypes.data_as(i32p), entry_cap,
+            )
+            if nk >= 0:
+                break
+            # Concurrent growth between the len() sizing and the dump.
+            key_cap *= 2
+            entry_cap *= 2
+        entries: list = []
+        pos = 0
+        flat = packed.tolist()
+        for i in range(nk):
+            c = int(counts[i])
+            rows = [
+                [
+                    self._resolve(flat[j * 4]),
+                    self._resolve(flat[j * 4 + 1]),
+                    flat[j * 4 + 2],
+                    flat[j * 4 + 3],
+                ]
+                for j in range(pos, pos + c)
+            ]
+            entries.append([int(keys[i]), rows])
+            pos += c
+
+        map_cap = max(int(self._lib.kvidx_map_len(self._handle)), 1) + 64
+        rk_cap = map_cap * 8
+        while True:
+            eks = np.empty(map_cap, np.uint64)
+            mcounts = np.empty(map_cap, np.int32)
+            rks = np.empty(rk_cap, np.uint64)
+            nm = self._lib.kvidx_dump_mappings(
+                self._handle,
+                eks.ctypes.data_as(u64p), mcounts.ctypes.data_as(i32p), map_cap,
+                rks.ctypes.data_as(u64p), rk_cap,
+            )
+            if nm >= 0:
+                break
+            map_cap *= 2
+            rk_cap *= 2
+        mappings: list = []
+        pos = 0
+        for i in range(nm):
+            c = int(mcounts[i])
+            mappings.append(
+                [int(eks[i]), [int(rk) for rk in rks[pos:pos + c]]]
+            )
+            pos += c
+        return {"entries": entries, "mappings": mappings}
+
+    def restore_state(self, state: dict) -> int:
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        # Group request keys sharing an identical entry set so each group
+        # restores with one native call (the common case: thousands of
+        # keys all held by the same pod+tier).
+        groups: dict[tuple, list[int]] = {}
+        for request_key, rows in state.get("entries", []):
+            if rows:
+                groups.setdefault(
+                    tuple(tuple(r) for r in rows), []
+                ).append(request_key)
+        restored = 0
+        empty_ek = np.empty(0, np.uint64)
+        for rows, request_keys in groups.items():
+            n = len(rows)
+            pods = np.empty(n, np.int32)
+            tiers = np.empty(n, np.int32)
+            flags = np.empty(n, np.uint8)
+            group_idx = np.empty(n, np.int32)
+            for i, (pod, tier, fl, g) in enumerate(rows):
+                pods[i] = self._intern(pod)
+                tiers[i] = self._intern(tier)
+                flags[i] = fl
+                group_idx[i] = g
+            rka = self._keys_array(request_keys)
+            self._lib.kvidx_add(
+                self._handle,
+                empty_ek.ctypes.data_as(u64p), 0,
+                rka.ctypes.data_as(u64p), len(rka),
+                pods.ctypes.data_as(i32p), tiers.ctypes.data_as(i32p),
+                flags.ctypes.data_as(u8p), group_idx.ctypes.data_as(i32p),
+                n,
+            )
+            restored += n * len(request_keys)
+        # Mappings restore through the dedicated call: kvidx_add with no
+        # entries would create empty PodSlots, which Lookup treats as
+        # broken prefix chains.
+        for engine_key, rks in state.get("mappings", []):
+            rka = self._keys_array(rks)
+            self._lib.kvidx_set_mapping(
+                self._handle,
+                ctypes.c_uint64(engine_key & 0xFFFFFFFFFFFFFFFF),
+                rka.ctypes.data_as(u64p), len(rka),
+            )
+        return restored
 
     def __len__(self) -> int:
         return int(self._lib.kvidx_len(self._handle))

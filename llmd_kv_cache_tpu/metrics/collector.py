@@ -198,6 +198,90 @@ def record_offload_result(medium: str, result) -> None:
     if result.shed_hashes:
         OFFLOAD_SHED_BLOCKS.labels(medium).inc(len(result.shed_hashes))
 
+
+# Crash-tolerant state (recovery/): snapshot, journal replay, anti-entropy
+# and drain outcomes, plus the bounded-queue overflow counter — the signals
+# the docs/resilience.md "Crash recovery & drain" runbook keys off.
+EVENT_DROPPED = Counter(
+    "kvcache_event_dropped_events_total",
+    "Raw event messages dropped by the bounded shard queues (drop-oldest)",
+    ["shard"],
+)
+RECOVERY_SNAPSHOTS = Counter(
+    "kvcache_recovery_snapshots_total",
+    "Index snapshot attempts",
+    ["outcome"],  # written|failed
+)
+RECOVERY_SNAPSHOT_BYTES = Gauge(
+    "kvcache_recovery_snapshot_bytes",
+    "Size of the most recent index snapshot",
+)
+RECOVERY_SNAPSHOT_SECONDS = Histogram(
+    "kvcache_recovery_snapshot_persist_seconds",
+    "Dump + encode + durable-publish time of index snapshots",
+    buckets=(1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0),
+)
+RECOVERY_QUARANTINED = Counter(
+    "kvcache_recovery_snapshots_quarantined_total",
+    "Snapshots that failed verification and were quarantined",
+)
+RECOVERY_RESTORED_ENTRIES = Gauge(
+    "kvcache_recovery_restored_entries",
+    "Index entries restored from the snapshot at the last warm restart",
+)
+RECOVERY_REPLAYED_RECORDS = Gauge(
+    "kvcache_recovery_replayed_records",
+    "Journal records replayed at the last warm restart",
+)
+RECONCILE_RUNS = Counter(
+    "kvcache_recovery_reconcile_runs_total",
+    "Anti-entropy digest-exchange rounds",
+    ["outcome"],  # clean|divergent
+)
+RECONCILE_REPAIRED = Counter(
+    "kvcache_recovery_reconcile_repaired_total",
+    "Index entries repaired by anti-entropy reconciliation",
+    ["direction"],  # added|removed
+)
+DRAIN_SECONDS = Gauge(
+    "kvcache_recovery_drain_seconds",
+    "Wall time of the last graceful drain",
+)
+
+
+def record_dropped_events(shard: int, count: int) -> None:
+    if count > 0:
+        EVENT_DROPPED.labels(str(shard)).inc(count)
+
+
+def record_snapshot(outcome: str, size_bytes: int, seconds: float) -> None:
+    RECOVERY_SNAPSHOTS.labels(outcome).inc()
+    if outcome == "written":
+        RECOVERY_SNAPSHOT_BYTES.set(size_bytes)
+        RECOVERY_SNAPSHOT_SECONDS.observe(max(seconds, 0.0))
+
+
+def record_snapshot_quarantine() -> None:
+    RECOVERY_QUARANTINED.inc()
+
+
+def record_warm_restart(restored_entries: int, replayed_records: int) -> None:
+    RECOVERY_RESTORED_ENTRIES.set(restored_entries)
+    RECOVERY_REPLAYED_RECORDS.set(replayed_records)
+
+
+def record_reconcile(added: int, removed: int) -> None:
+    RECONCILE_RUNS.labels("divergent" if (added or removed) else "clean").inc()
+    if added > 0:
+        RECONCILE_REPAIRED.labels("added").inc(added)
+    if removed > 0:
+        RECONCILE_REPAIRED.labels("removed").inc(removed)
+
+
+def record_drain(seconds: float) -> None:
+    DRAIN_SECONDS.set(max(seconds, 0.0))
+
+
 _beat_thread: Optional[threading.Thread] = None
 _beat_stop = threading.Event()
 

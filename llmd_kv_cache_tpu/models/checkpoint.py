@@ -18,6 +18,7 @@ from dataclasses import asdict, fields
 import jax
 import orbax.checkpoint as ocp
 
+from ..utils.atomic_io import atomic_write_bytes
 from ..utils.logging import get_logger
 from .llama import LlamaConfig, Params, init_params, unfuse_params
 
@@ -40,7 +41,16 @@ def save_engine_checkpoint(path: str, params: Params, model_cfg: LlamaConfig,
         from ..parallel.pipeline import unstack_layer_params
 
         params = unstack_layer_params(params)
-    params = unfuse_params(params, model_cfg)
+    # The tree records the interleave it was ACTUALLY fused with
+    # (fuse_params stamps it); trust that over the caller's config. A
+    # pre-init config predates the engine's tp fusing decision, and
+    # unfuse_params would otherwise refuse the mismatch — correctly, but
+    # needlessly: the marker, not the config, is authoritative here.
+    marker = params.get("fused_interleave")
+    unfuse_cfg = model_cfg
+    if marker is not None and int(marker) != model_cfg.fused_interleave:
+        unfuse_cfg = dataclasses.replace(model_cfg, fused_interleave=int(marker))
+    params = unfuse_params(params, unfuse_cfg)
     # The saved tree is canonical; the persisted config says so
     # (fused_interleave is a runtime serving-layout knob set by tp
     # engines, consumed by the unfuse above).
@@ -59,10 +69,12 @@ def save_engine_checkpoint(path: str, params: Params, model_cfg: LlamaConfig,
         "dtype": str(model_cfg.dtype.__name__ if hasattr(model_cfg.dtype, "__name__")
                      else model_cfg.dtype),
     }
-    tmp = os.path.join(path, _META_FILE + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f, indent=2)
-    os.replace(tmp, os.path.join(path, _META_FILE))
+    # Durable publish (atomic_io): the meta file is the checkpoint's
+    # validity marker — a crash must not leave it renamed-but-empty.
+    atomic_write_bytes(
+        os.path.join(path, _META_FILE),
+        json.dumps(meta, indent=2).encode("utf-8"),
+    )
     logger.info("engine checkpoint saved to %s", path)
 
 

@@ -24,6 +24,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils.atomic_io import atomic_write_bytes
+
 
 @dataclass
 class FileMapperConfig:
@@ -136,8 +138,12 @@ class FileMapper:
         if os.path.exists(path):
             return
         c = self.cfg
-        with open(path + ".tmp", "w") as f:
-            json.dump(
+        # Durable publish (atomic_io): a crash right after os.replace must
+        # not leave a zero-length/partial config — loaders treat a corrupt
+        # config.json as a foreign store and refuse to serve it.
+        atomic_write_bytes(
+            path,
+            json.dumps(
                 {
                     "model": c.model_name,
                     "dtype": c.dtype,
@@ -155,9 +161,9 @@ class FileMapper:
                     "mesh_sizes": c.mesh_sizes,
                     "fingerprint": self._fingerprint,
                 },
-                f, indent=2,
-            )
-        os.replace(path + ".tmp", path)
+                indent=2,
+            ).encode("utf-8"),
+        )
 
     def block_path(self, block_hash: int, group_idx: int = 0) -> str:
         """Path of the file holding a block (hash masked to 64 bits).

@@ -33,6 +33,7 @@ from typing import Optional, Protocol, Sequence
 import numpy as np
 
 from ..events.publisher import StorageEventPublisher
+from ..utils.atomic_io import atomic_write_bytes
 from ..utils.logging import get_logger
 from .tpu_copier import TPUBlockCopier
 from .worker import (FileSpan, TransferResult, assemble_file_buffers,
@@ -88,10 +89,9 @@ class FSObjectStoreClient:
     def put(self, key: str, data: bytes) -> None:
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        # Durable publish (atomic_io): fsync file + dir before/after the
+        # rename so a crash can't surface a renamed-but-empty object.
+        atomic_write_bytes(path, data)
 
     def get(self, key: str) -> Optional[bytes]:
         try:

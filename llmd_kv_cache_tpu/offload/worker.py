@@ -800,5 +800,31 @@ class OffloadHandlers:
             )
         return status
 
+    def flush(self, deadline_s: float = 10.0) -> bool:
+        """Pump completions until no jobs are pending or queued for retry,
+        or ``deadline_s`` elapses (graceful drain, recovery.drain).
+
+        Completed results reach their engine reports (and store checksums
+        land on disk) instead of being abandoned by shutdown. Returns True
+        when fully flushed inside the budget.
+        """
+        t_end = time.monotonic() + deadline_s
+        while True:
+            self.get_finished()
+            with self._lock:
+                idle = not self._pending and not self._retry_q
+            if idle:
+                return True
+            if time.monotonic() >= t_end:
+                with self._lock:
+                    pending = len(self._pending)
+                    queued = len(self._retry_q)
+                logger.warning(
+                    "offload flush deadline: %d in flight, %d retry-queued "
+                    "abandoned", pending, queued,
+                )
+                return False
+            time.sleep(0.005)
+
     def shutdown(self) -> None:
         self.io.close()

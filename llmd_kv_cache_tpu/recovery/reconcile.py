@@ -1,0 +1,239 @@
+"""Anti-entropy reconciliation: digest exchange + incremental repair.
+
+The event stream is lossy (ZMQ PUB/SUB drops under backpressure, the
+bounded shard queues drop-oldest under overload, and a restart loses
+whatever was published while the process was down). Snapshots + journal
+replay bound the loss; this module closes the residual gap the way
+Dynamo-style systems do — by periodically comparing a cheap *digest* of
+each pod's indexed blocks against the pod's advertised truth and
+repairing only the divergent pods, incrementally.
+
+A pod digest is order-independent::
+
+    digest(pod) = XOR over blocks of fnv1a_64(cbor([request_key, row]))
+
+where ``row`` is the snapshot row ``[pod, tier, flags, group_idx]``.
+XOR-of-hashes makes the digest insensitive to iteration order and O(1)
+to compare; matching digests skip the pod entirely, so steady-state
+rounds touch no index state.
+
+The truth side is abstracted behind :class:`DigestSource` — in tests and
+single-host deployments an :class:`IndexDigestSource` wraps a live
+reference index; a cluster deployment implements the protocol over the
+pods' advertised state (events ``reconciler``/``subscriber_manager``
+discovery).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Protocol
+
+from ..core.keys import KeyType, PodEntry
+from ..telemetry import flight_recorder, tracer
+from ..telemetry.flight_recorder import KIND_RECOVERY
+from ..utils.cbor import canonical_cbor_encode
+from ..utils.logging import get_logger
+
+logger = get_logger("recovery.reconcile")
+
+
+def _fnv1a_64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _row_hash(request_key: int, row: list) -> int:
+    return _fnv1a_64(canonical_cbor_encode([request_key, list(row)]))
+
+
+def pod_blocks_from_state(state: Optional[dict], pod: str) -> dict:
+    """``{request_key: {row_tuple, ...}}`` for one pod, from a
+    ``dump_state()`` document."""
+    out: dict = {}
+    if not state:
+        return out
+    for request_key, rows in state.get("entries", []):
+        mine = {tuple(r) for r in rows if r[0] == pod}
+        if mine:
+            out[request_key] = mine
+    return out
+
+
+def digest_from_blocks(blocks: dict) -> dict:
+    """Order-independent ``{"count": n, "digest": x}`` over pod blocks."""
+    digest = 0
+    count = 0
+    for request_key, rows in blocks.items():
+        for row in rows:
+            digest ^= _row_hash(request_key, list(row))
+            count += 1
+    return {"count": count, "digest": digest}
+
+
+class DigestSource(Protocol):
+    """A pod's advertised cache truth, digest-first."""
+
+    def pods(self) -> list:
+        """Pods this source can answer for."""
+
+    def digest(self, pod: str) -> dict:
+        """``{"count", "digest"}`` of the pod's advertised blocks."""
+
+    def blocks(self, pod: str) -> dict:
+        """Full ``{request_key: {row_tuple,...}}`` — only fetched when the
+        digests already disagreed."""
+
+
+class IndexDigestSource:
+    """DigestSource over a live Index treated as ground truth (tests,
+    in-process replicas)."""
+
+    def __init__(self, index):
+        self.index = index
+
+    def _state(self) -> Optional[dict]:
+        return self.index.dump_state()
+
+    def pods(self) -> list:
+        state = self._state()
+        if not state:
+            return []
+        seen = set()
+        for _rk, rows in state.get("entries", []):
+            for row in rows:
+                seen.add(row[0])
+        return sorted(seen)
+
+    def digest(self, pod: str) -> dict:
+        return digest_from_blocks(pod_blocks_from_state(self._state(), pod))
+
+    def blocks(self, pod: str) -> dict:
+        return pod_blocks_from_state(self._state(), pod)
+
+
+def _entry_from_row(row) -> PodEntry:
+    pod, tier, flags, group_idx = row[0], row[1], int(row[2]), int(row[3])
+    return PodEntry(
+        pod_identifier=pod,
+        device_tier=tier,
+        speculative=bool(flags & 1),
+        has_group=bool(flags & 2),
+        group_idx=group_idx,
+    )
+
+
+class AntiEntropyReconciler:
+    """Background digest exchange + repair loop.
+
+    Modeled on :class:`~llmd_kv_cache_tpu.events.reconciler.PodReconciler`:
+    an Event-stopped daemon thread running ``reconcile_once()`` every
+    ``interval_s``. ``reconcile_once()`` is also callable directly
+    (tests, admin-triggered repair).
+    """
+
+    def __init__(self, index, source: DigestSource, interval_s: float = 30.0):
+        self.index = index
+        self.source = source
+        self.interval_s = interval_s
+        self.runs = 0
+        self.repaired_added = 0
+        self.repaired_removed = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one round -------------------------------------------------------
+
+    def _repair_pod(self, pod: str, local: dict, remote: dict) -> tuple[int, int]:
+        """Make the local index's view of ``pod`` match ``remote``."""
+        added = 0
+        removed = 0
+        for request_key, rows in remote.items():
+            missing = rows - local.get(request_key, set())
+            if missing:
+                entries = [_entry_from_row(r) for r in sorted(missing)]
+                self.index.add(None, [request_key], entries)
+                added += len(entries)
+        for request_key, rows in local.items():
+            extra = rows - remote.get(request_key, set())
+            if extra:
+                entries = [_entry_from_row(r) for r in sorted(extra)]
+                self.index.evict(request_key, KeyType.REQUEST, entries)
+                removed += len(entries)
+        return added, removed
+
+    def reconcile_once(self) -> dict:
+        """One digest-exchange round; returns its stats."""
+        self.runs += 1
+        added = 0
+        removed = 0
+        divergent: list = []
+        with tracer().span("llm_d.kv_cache.recovery.reconcile") as span:
+            state = self.index.dump_state()
+            pods = set(self.source.pods())
+            # Pods only we know about still need checking (the source may
+            # have cleared them entirely).
+            if state:
+                for _rk, rows in state.get("entries", []):
+                    for row in rows:
+                        pods.add(row[0])
+            for pod in sorted(pods):
+                local_blocks = pod_blocks_from_state(state, pod)
+                if digest_from_blocks(local_blocks) == self.source.digest(pod):
+                    continue
+                divergent.append(pod)
+                a, r = self._repair_pod(pod, local_blocks, self.source.blocks(pod))
+                added += a
+                removed += r
+            span.set_attribute("pods_checked", len(pods))
+            span.set_attribute("divergent", len(divergent))
+            span.set_attribute("repaired_added", added)
+            span.set_attribute("repaired_removed", removed)
+        self.repaired_added += added
+        self.repaired_removed += removed
+        stats = {
+            "pods_checked": len(pods),
+            "divergent": divergent,
+            "repaired_added": added,
+            "repaired_removed": removed,
+        }
+        if divergent:
+            logger.info(
+                "anti-entropy repaired %d pods (+%d/-%d entries): %s",
+                len(divergent), added, removed, divergent,
+            )
+            flight_recorder().record(KIND_RECOVERY, {"op": "reconcile", **stats})
+        try:
+            from ..metrics.collector import record_reconcile
+
+            record_reconcile(added, removed)
+        except Exception:  # pragma: no cover - metrics must never break repair  # lint: allow-swallow
+            pass
+        return stats
+
+    # -- background loop -------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def _loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    logger.exception("anti-entropy round failed; continuing")
+
+        self._thread = threading.Thread(
+            target=_loop, name="kvtpu-anti-entropy", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -151,3 +151,14 @@ class FailoverIndex(Index):
     def clear(self, pod_identifier: str) -> None:
         self.fallback.clear(pod_identifier)
         self._write("clear", lambda: self.primary.clear(pod_identifier))
+
+    def dump_state(self):
+        # The fallback mirrors every write this process made; the primary
+        # (Redis) is durable on its own, so the warm replica is the right
+        # thing to snapshot — and it works even while the breaker is open.
+        return self.fallback.dump_state()
+
+    def restore_state(self, state: dict) -> int:
+        restored = self.fallback.restore_state(state)
+        self._write("restore_state", lambda: self.primary.restore_state(state))
+        return restored

@@ -118,6 +118,9 @@ class IndexerConfig:
     # Bind address for both endpoints; localhost by default because the
     # debug surface exposes pod names and score internals.
     admin_host: str = "127.0.0.1"
+    # Crash-tolerant state (recovery/): None or snapshot_dir="" disables
+    # snapshots, journaled warm restart, and the warmup readiness gate.
+    recovery_config: Optional["RecoveryConfig"] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
@@ -137,6 +140,11 @@ class IndexerConfig:
             admin_host=d.get("adminHost", d.get("admin_host", "127.0.0.1"))
             or "127.0.0.1",
         )
+        recovery_dict = d.get("recoveryConfig", d.get("recovery_config"))
+        if recovery_dict:
+            from ..recovery.config import RecoveryConfig
+
+            cfg.recovery_config = RecoveryConfig.from_dict(recovery_dict)
         index_dict = d.get("kvBlockIndexConfig", d.get("index_config"))
         if index_dict:
             from ..index.cost_aware import CostAwareMemoryIndexConfig

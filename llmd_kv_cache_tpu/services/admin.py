@@ -44,11 +44,13 @@ class AdminServer:
         port: int = 0,
         host: str = "127.0.0.1",
         expose_debug: bool = True,
+        health: Optional[Callable[[], dict]] = None,
     ):
         self._host = host
         self._requested_port = port
         self._expose_debug = expose_debug
         self._providers: dict[str, Callable[[], object]] = {}
+        self._health = health
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -56,6 +58,13 @@ class AdminServer:
         """Expose ``provider()`` (a JSON-serializable callable) as
         ``/debug/<name>`` and inside ``/debug/vars``."""
         self._providers[name] = provider
+
+    def set_health_provider(self, provider: Callable[[], dict]) -> None:
+        """Make ``/healthz`` report ``provider()`` instead of the static
+        ok. A payload whose ``status`` is not ``"ok"`` is served with 503
+        so readiness probes gate traffic (e.g. ``warming`` after a warm
+        restart, recovery.manager)."""
+        self._health = provider
 
     @property
     def port(self) -> int:
@@ -83,7 +92,18 @@ class AdminServer:
     def _handle(self, path: str) -> tuple[int, bytes, str]:
         """Route one GET; returns (status, body, content_type)."""
         if path == "/healthz":
-            return 200, b'{"status": "ok"}', "application/json"
+            if self._health is None:
+                return 200, b'{"status": "ok"}', "application/json"
+            try:
+                payload = self._health()
+            except Exception as exc:  # health must answer even when broken
+                return (
+                    500,
+                    json.dumps({"status": "error", "error": str(exc)}).encode(),
+                    "application/json",
+                )
+            status = 200 if payload.get("status") == "ok" else 503
+            return status, json.dumps(payload, default=repr).encode(), "application/json"
         if path == "/metrics":
             body, ctype = self._metrics_payload()
             return 200, body, ctype
@@ -160,22 +180,27 @@ def start_observability_servers(
     admin_port: int,
     host: str = "127.0.0.1",
     providers: Optional[dict[str, Callable[[], object]]] = None,
+    health: Optional[Callable[[], dict]] = None,
 ) -> list[AdminServer]:
     """Start the configured endpoint(s); 0 = disabled (the default).
 
     When both knobs name the same port (or only ``admin_port`` is set),
     one server does both jobs; distinct ports get a metrics-only server
-    plus a full admin server.
+    plus a full admin server. ``health`` (optional) backs ``/healthz`` on
+    every started server — non-ok payloads serve as 503 for readiness
+    probes.
     """
     servers: list[AdminServer] = []
     if admin_port > 0:
-        admin = AdminServer(port=admin_port, host=host, expose_debug=True)
+        admin = AdminServer(port=admin_port, host=host, expose_debug=True,
+                            health=health)
         for name, provider in (providers or {}).items():
             admin.register_debug(name, provider)
         admin.start()
         servers.append(admin)
     if metrics_port > 0 and metrics_port != admin_port:
-        metrics = AdminServer(port=metrics_port, host=host, expose_debug=False)
+        metrics = AdminServer(port=metrics_port, host=host, expose_debug=False,
+                              health=health)
         metrics.start()
         servers.append(metrics)
     return servers

@@ -32,6 +32,9 @@ import msgpack
 from ..events.pool import Pool, PoolConfig
 from ..events.subscriber_manager import SubscriberManager
 from ..events.zmq_subscriber import ZMQSubscriber
+from ..recovery.drain import DrainCoordinator
+from ..recovery.manager import RecoveryManager
+from ..recovery.reconcile import AntiEntropyReconciler, DigestSource
 from ..resilience.failpoints import FaultInjected, failpoints
 from ..resilience.policy import RetryExhausted, RetryPolicy, call_with_retry
 from ..scoring.indexer import Indexer, IndexerConfig
@@ -125,15 +128,26 @@ class ScoreRequest:
 class ScoreResponse:
     scores: dict[str, float] = field(default_factory=dict)
     error: str = ""
+    # True while the serving index is still warming after a restart
+    # (recovery.manager): scores are best-effort (snapshot + partial
+    # replay) and routers should widen their fallback. Absent on the wire
+    # from older servers, so decoding defaults to False.
+    degraded: bool = False
 
     def to_bytes(self) -> bytes:
-        return msgpack.packb({"scores": self.scores, "error": self.error},
-                             use_bin_type=True)
+        return msgpack.packb(
+            {"scores": self.scores, "error": self.error, "degraded": self.degraded},
+            use_bin_type=True,
+        )
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "ScoreResponse":
         d = msgpack.unpackb(b, raw=False)
-        return cls(scores=dict(d.get("scores", {})), error=d.get("error", ""))
+        return cls(
+            scores=dict(d.get("scores", {})),
+            error=d.get("error", ""),
+            degraded=bool(d.get("degraded", False)),
+        )
 
 
 class IndexerService:
@@ -171,10 +185,43 @@ class IndexerService:
         # liveness knobs are disabled.
         if self.pool.liveness is not None:
             self.indexer.attach_liveness(self.pool.liveness)
+        # Crash-tolerant state (recovery/): snapshots + journaled warm
+        # restart + readiness gate, enabled by recoveryConfig.snapshotDir.
+        self.recovery: Optional[RecoveryManager] = None
+        rc = self.indexer.config.recovery_config
+        if rc is not None and rc.enabled:
+            self.recovery = RecoveryManager(
+                rc, self.indexer.kv_block_index, self.pool
+            )
+        self._reconciler: Optional[AntiEntropyReconciler] = None
+        self._drain_coordinator: Optional[DrainCoordinator] = None
+
+    def attach_digest_source(self, source: DigestSource) -> None:
+        """Enable anti-entropy reconciliation against ``source`` (a pod's
+        advertised truth, or a reference index via IndexDigestSource).
+        Runs on the recoveryConfig.reconcileIntervalS cadence once the
+        service starts; 0 keeps it manual (``reconcile_now``)."""
+        rc = self.indexer.config.recovery_config
+        interval = rc.reconcile_interval_s if rc is not None else 0.0
+        self._reconciler = AntiEntropyReconciler(
+            self.indexer.kv_block_index, source, interval_s=interval
+        )
+
+    def reconcile_now(self) -> dict:
+        """One manual anti-entropy round (admin/testing aid)."""
+        if self._reconciler is None:
+            raise RuntimeError("no digest source attached (attach_digest_source)")
+        return self._reconciler.reconcile_once()
 
     def start(self) -> None:
         """Start the event plane: workers plus, in centralized mode, a
         bound subscriber every engine connects to."""
+        # Warm restart strictly precedes live intake so replayed journal
+        # records are ordered ahead of (and never re-journaled with) live
+        # traffic; the readiness gate then holds scores degraded until the
+        # staleness estimate clears warmupStalenessBoundS.
+        if self.recovery is not None:
+            self.recovery.warm_restart()
         self.pool.start()
         if self.pool_config.zmq_endpoint:
             self._central_subscriber = ZMQSubscriber(
@@ -187,14 +234,23 @@ class IndexerService:
         # Failpoint trips land in the flight recorder so chaos runs leave
         # a reconstructable decision trail.
         attach_failpoint_listener()
+        providers = {
+            "lag": self.pool.lag_stats,
+            "ledger": self.indexer.ledger.snapshot,
+        }
+        health = None
+        if self.recovery is not None:
+            self.recovery.start()
+            providers["recovery"] = self.recovery.health
+            health = self.recovery.health
+        if self._reconciler is not None and self._reconciler.interval_s > 0:
+            self._reconciler.start()
         self._observability_servers = start_observability_servers(
             self.indexer.config.metrics_port,
             self.indexer.config.admin_port,
             host=self.indexer.config.admin_host,
-            providers={
-                "lag": self.pool.lag_stats,
-                "ledger": self.indexer.ledger.snapshot,
-            },
+            providers=providers,
+            health=health,
         )
 
     def stop(self) -> None:
@@ -203,8 +259,62 @@ class IndexerService:
         self._observability_servers = []
         if self._central_subscriber is not None:
             self._central_subscriber.stop()
+        if self._reconciler is not None:
+            self._reconciler.stop()
         self.subscriber_manager.shutdown()
+        if self.recovery is not None:
+            # Final snapshot happens before the pool stops so lag_stats
+            # still reflects the fully-ingested watermarks.
+            self.pool.join()
+            self.recovery.stop(final_snapshot=True)
         self.pool.shutdown()
+
+    # -- graceful drain ---------------------------------------------------
+
+    def drain(self, offload=None, on_complete: Optional[Callable[[], None]] = None) -> dict:
+        """Run the deadline-bounded graceful drain (recovery.drain):
+        stop intake, drain queues, flush ``offload`` (an OffloadHandlers,
+        optional), final snapshot. Returns the step report."""
+        rc = self.indexer.config.recovery_config
+        deadline = rc.drain_deadline_s if rc is not None else 10.0
+        coordinator = self._drain_coordinator
+        if coordinator is None:
+            stoppers = [self.subscriber_manager.shutdown]
+            if self._central_subscriber is not None:
+                stoppers.append(self._central_subscriber.stop)
+            if self._reconciler is not None:
+                stoppers.append(self._reconciler.stop)
+            coordinator = self._drain_coordinator = DrainCoordinator(
+                deadline_s=deadline,
+                intake_stoppers=stoppers,
+                pool=self.pool,
+                offload=offload,
+                manager=self.recovery,
+                on_complete=on_complete,
+            )
+        return coordinator.drain()
+
+    def install_drain_handler(self, offload=None,
+                              on_complete: Optional[Callable[[], None]] = None) -> DrainCoordinator:
+        """Install a SIGTERM handler running :meth:`drain`. Call from the
+        main thread before serving."""
+        rc = self.indexer.config.recovery_config
+        deadline = rc.drain_deadline_s if rc is not None else 10.0
+        stoppers = [self.subscriber_manager.shutdown]
+        if self._central_subscriber is not None:
+            stoppers.append(self._central_subscriber.stop)
+        if self._reconciler is not None:
+            stoppers.append(self._reconciler.stop)
+        self._drain_coordinator = DrainCoordinator(
+            deadline_s=deadline,
+            intake_stoppers=stoppers,
+            pool=self.pool,
+            offload=offload,
+            manager=self.recovery,
+            on_complete=on_complete,
+        )
+        self._drain_coordinator.install()
+        return self._drain_coordinator
 
     # -- RPC --
 
@@ -224,7 +334,11 @@ class IndexerService:
                     req.model_name,
                     set(req.pod_identifiers) if req.pod_identifiers else None,
                 )
-                return ScoreResponse(scores=scores)
+                # During post-restart warmup, serve best-effort scores but
+                # flag them so routers widen their fallback (the wire field
+                # decodes to False against older peers).
+                degraded = self.recovery is not None and not self.recovery.ready
+                return ScoreResponse(scores=scores, degraded=degraded)
             except Exception as e:
                 logger.exception("GetPodScores failed")
                 return ScoreResponse(error=str(e))

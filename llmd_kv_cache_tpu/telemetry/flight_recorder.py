@@ -45,6 +45,9 @@ KIND_RETRY = "retry"
 KIND_OFFLOAD = "offload"
 KIND_FAILPOINT = "failpoint"
 KIND_RECONNECT = "zmq_reconnect"
+KIND_RECOVERY = "recovery"
+KIND_DRAIN = "drain"
+KIND_OVERFLOW = "queue_overflow"
 
 
 class FlightRecorder:

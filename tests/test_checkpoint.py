@@ -54,6 +54,33 @@ def test_restarted_engine_resumes_identically(tmp_path):
     )
 
 
+def test_fused_tree_saves_with_preinit_config(tmp_path):
+    """A TP engine fuses with interleave t at startup, but periodic
+    re-checkpointing often passes the pre-init (canonical, t=1) config.
+    The tree's own ``fused_interleave`` marker is authoritative: the save
+    de-interleaves with the marker's t instead of refusing the mismatch,
+    and the stored tree is the exact canonical layout."""
+    import dataclasses
+
+    from llmd_kv_cache_tpu.models.llama import fuse_params
+
+    base_cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=8, intermediate_size=64, page_size=4,
+    )
+    params = init_params(jax.random.PRNGKey(5), base_cfg)
+    fused = fuse_params(
+        params, dataclasses.replace(base_cfg, fused_interleave=2))
+    assert fused["fused_interleave"] == 2
+
+    save_engine_checkpoint(str(tmp_path / "fz"), fused, base_cfg, "fz")
+    params2, cfg2, _name, _ = load_engine_checkpoint(str(tmp_path / "fz"))
+    assert cfg2.fused_interleave == 1
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
 def test_moe_and_swa_config_roundtrip(tmp_path):
     """Checkpoints preserve expert tensors and tuple config fields."""
     cfg = LlamaConfig(

@@ -453,6 +453,164 @@ class TestZMQReconnectBackoff:
 
 
 @pytest.mark.chaos
+class TestKillAndWarmRestart:
+    def test_kill_warm_restart_converges(self, tmp_path):
+        """Full crash-tolerance loop (docs/resilience.md §Crash recovery):
+        an indexer dies uncleanly mid-stream, a replacement boots from the
+        last snapshot, replays the journal tail, serves degraded scores
+        behind a 503 readiness gate, repairs crash-window losses via
+        anti-entropy, and goes ready once live events clear the staleness
+        bound."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from llmd_kv_cache_tpu.recovery import (
+            STATE_READY,
+            STATE_WARMING,
+            IndexDigestSource,
+            RecoveryConfig,
+        )
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerService,
+            ScoreRequest,
+        )
+
+        endpoint = "tcp://127.0.0.1:16104"
+        snapdir = str(tmp_path / "snaps")
+
+        def make_service():
+            return IndexerService(
+                IndexerConfig(
+                    token_processor_config=TokenProcessorConfig(
+                        block_size_tokens=BLOCK),
+                    recovery_config=RecoveryConfig(
+                        snapshot_dir=snapdir,
+                        snapshot_interval_s=0,  # snapshots manual in-test
+                        warmup_staleness_bound_s=1.0,
+                        drain_deadline_s=5.0,
+                    ),
+                ),
+                PoolConfig(concurrency=1),
+            )
+
+        def pub_until(publisher, hashes, tokens, index, rks):
+            for _ in range(20):
+                publisher.publish([BlockStoredEvent(
+                    block_hashes=hashes, tokens=tokens, parent_hash=0,
+                    block_size=BLOCK)])
+                if wait_until(lambda: len(index.lookup(rks)) == len(rks),
+                              timeout=0.5):
+                    return True
+            return False
+
+        def healthz(port):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        t1, t2, t3 = (list(range(8)), list(range(100, 108)),
+                      list(range(200, 208)))
+
+        svc1 = make_service()
+        svc1.start()
+        index1 = svc1.indexer.kv_block_index
+        processor = svc1.indexer.token_processor
+        rk1 = processor.tokens_to_kv_block_keys(0, t1, MODEL)
+        rk2 = processor.tokens_to_kv_block_keys(0, t2, MODEL)
+        rk3 = processor.tokens_to_kv_block_keys(0, t3, MODEL)
+        sub1 = ZMQSubscriber(endpoint, "kv@", svc1.pool.add_task, bind=False)
+        sub1.start()
+        pub = KVEventPublisher(endpoint, "pod-a", MODEL, bind=True)
+        time.sleep(0.3)
+        sub2 = None
+        admin = None
+        svc2 = None
+        try:
+            # Era 1: t1 lands, a snapshot captures it (and rotates the
+            # journal); t2 lands after — journal-only state.
+            assert pub_until(pub, [1, 2], t1, index1, rk1)
+            assert svc1.recovery.snapshot_now("test") is not None
+            assert pub_until(pub, [3, 4], t2, index1, rk2)
+
+            # Unclean death: no stop(), no final snapshot. Only the
+            # per-append-flushed journal and the earlier snapshot survive.
+            pub.close()
+            sub1.stop()
+
+            # The cluster's ground truth moved on while the indexer was
+            # dead: t3 was stored but its events are gone forever.
+            truth = InMemoryIndex(InMemoryIndexConfig())
+            truth.restore_state(index1.dump_state())
+            truth.add(None, rk3, [PodEntry(pod_identifier="pod-a",
+                                           device_tier=TIER_TPU_HBM)])
+
+            # Let the surviving state age past warmupStalenessBoundS so the
+            # replacement boots into WARMING rather than sliding straight
+            # to READY.
+            time.sleep(1.3)
+
+            svc2 = make_service()
+            svc2.attach_digest_source(IndexDigestSource(truth))
+            svc2.start()
+            index2 = svc2.indexer.kv_block_index
+
+            # Snapshot + journal replay restored everything ingested before
+            # the crash; the crash-window loss (t3) is still missing.
+            assert len(index2.lookup(rk1)) == len(rk1)
+            assert len(index2.lookup(rk2)) == len(rk2)
+            assert index2.lookup(rk3) == {}
+
+            # Readiness gate: warming state, degraded scores, 503 probe.
+            assert svc2.recovery.state == STATE_WARMING
+            resp = svc2.get_pod_scores(ScoreRequest(tokens=t1, model_name=MODEL))
+            assert resp.degraded is True
+            assert resp.scores == {"pod-a": float(len(rk1))}
+            admin = AdminServer(port=0, expose_debug=False,
+                                health=svc2.recovery.health)
+            port = admin.start()
+            status, body = healthz(port)
+            assert status == 503 and body["state"] == STATE_WARMING
+
+            # Anti-entropy repairs the crash window.
+            stats = svc2.reconcile_now()
+            assert stats["repaired_added"] >= len(rk3)
+            assert len(index2.lookup(rk3)) == len(rk3)
+
+            # The engine resumes publishing: fresh events pull the
+            # staleness estimate under the bound and the gate opens.
+            sub2 = ZMQSubscriber(endpoint, "kv@", svc2.pool.add_task,
+                                 bind=False)
+            sub2.start()
+            pub = KVEventPublisher(endpoint, "pod-a", MODEL, bind=True)
+            time.sleep(0.3)
+            fresh = list(range(300, 308))
+            rkf = processor.tokens_to_kv_block_keys(0, fresh, MODEL)
+            assert pub_until(pub, [7, 8], fresh, index2, rkf)
+            assert wait_until(lambda: svc2.recovery.ready)
+            assert svc2.recovery.state == STATE_READY
+            resp = svc2.get_pod_scores(ScoreRequest(tokens=t1, model_name=MODEL))
+            assert resp.degraded is False
+            status, body = healthz(port)
+            assert status == 200 and body["state"] == STATE_READY
+        finally:
+            pub.close()
+            if sub2 is not None:
+                sub2.stop()
+            if admin is not None:
+                admin.stop()
+            if svc2 is not None:
+                svc2.stop()
+            # svc1 was deliberately abandoned (daemon workers); release its
+            # queues so the process exits cleanly.
+            svc1.pool.shutdown()
+
+
+@pytest.mark.chaos
 class TestTokenizerRpcFaults:
     def test_injected_rpc_fault_is_retried(self, tmp_path):
         pytest.importorskip("grpc")

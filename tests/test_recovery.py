@@ -1,0 +1,436 @@
+"""Crash-tolerant state unit tests (recovery/).
+
+Covers the pieces individually — snapshot round-trip + quarantine,
+journal framing + torn tails, the warm-restart state machine, the
+bounded shard queues, anti-entropy repair, the /healthz readiness gate,
+and drain-deadline enforcement. The end-to-end kill-and-warm-restart
+scenario lives in tests/test_failure_recovery.py (chaos suite).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import msgpack
+import pytest
+
+from llmd_kv_cache_tpu.core import ChunkedTokenDatabase, TokenProcessorConfig
+from llmd_kv_cache_tpu.core.keys import TIER_TPU_HBM, PodEntry
+from llmd_kv_cache_tpu.events import Pool, PoolConfig
+from llmd_kv_cache_tpu.events.model import RawMessage
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.recovery import (
+    AntiEntropyReconciler,
+    DrainCoordinator,
+    EventJournal,
+    IndexDigestSource,
+    RecoveryConfig,
+    RecoveryManager,
+    SnapshotError,
+    SnapshotStore,
+    STATE_READY,
+    STATE_WARMING,
+    decode_snapshot,
+    encode_snapshot,
+)
+from llmd_kv_cache_tpu.services.admin import AdminServer
+
+BLOCK = 4
+MODEL = "m"
+
+
+def _entry(pod="pod-a", tier=TIER_TPU_HBM, **kw):
+    return PodEntry(pod_identifier=pod, device_tier=tier, **kw)
+
+
+def _raw(pod: str, seq: int, hashes, tokens, ts=None) -> RawMessage:
+    payload = msgpack.packb(
+        [ts if ts is not None else time.time(),
+         [["BlockStored", list(hashes), None, list(tokens), BLOCK, None]]],
+        use_bin_type=True,
+    )
+    return RawMessage(topic=f"kv@{pod}@{MODEL}", sequence=seq, payload=payload)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot format + store
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotFormat:
+    def test_round_trip(self):
+        doc = {"version": 1, "pod_seqs": {"pod-a": 7},
+               "index": {"entries": [[1, [["pod-a", "tier", 0, 0]]]],
+                         "mappings": []}}
+        assert decode_snapshot(encode_snapshot(doc)) == doc
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(SnapshotError, match="magic"):
+            decode_snapshot(b"NOTASNAPSHOT" + b"\x00" * 64)
+
+    def test_flipped_byte_rejected(self):
+        blob = bytearray(encode_snapshot({"version": 1}))
+        blob[len(blob) // 2] ^= 0xFF
+        with pytest.raises(SnapshotError):
+            decode_snapshot(bytes(blob))
+
+    def test_truncation_rejected(self):
+        blob = encode_snapshot({"version": 1, "pad": "x" * 64})
+        with pytest.raises(SnapshotError):
+            decode_snapshot(blob[: len(blob) - 5])
+
+
+class TestSnapshotStore:
+    def test_save_load_and_retention(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), keep=2)
+        for i in range(4):
+            store.save({"version": 1, "n": i})
+        names = sorted(os.listdir(tmp_path))
+        assert names == ["index-00000003.snap", "index-00000004.snap"]
+        doc, path = store.load_newest()
+        assert doc["n"] == 3 and path.endswith("index-00000004.snap")
+
+    def test_corrupt_newest_quarantined_falls_back(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), keep=3)
+        store.save({"version": 1, "n": 0})
+        newest = store.save({"version": 1, "n": 1})
+        with open(newest, "r+b") as f:
+            f.seek(20)
+            f.write(b"\xde\xad")
+        doc, path = store.load_newest()
+        assert doc["n"] == 0 and path.endswith("index-00000001.snap")
+        assert os.path.exists(newest + ".quarantine")
+        assert not os.path.exists(newest)
+        assert store.quarantined == 1
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), keep=3)
+        p = store.save({"version": 1})
+        with open(p, "wb") as f:
+            f.write(b"garbage")
+        assert store.load_newest() is None
+        assert store.quarantined == 1
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+
+class TestEventJournal:
+    def test_append_replay_with_watermarks(self, tmp_path):
+        path = str(tmp_path / "j")
+        j = EventJournal(path, sync_every=2)
+        j.append("pod-a", 1, "kv@pod-a@m", b"p1", 10.0)
+        j.append("pod-a", 2, "kv@pod-a@m", b"p2", 11.0)
+        j.append("pod-b", 1, "kv@pod-b@m", b"q1", 12.0)
+        j.close()
+        got = [(r.pod_id, r.sequence, r.payload)
+               for r in EventJournal(path).replay({"pod-a": 1})]
+        assert got == [("pod-a", 2, b"p2"), ("pod-b", 1, b"q1")]
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "j")
+        j = EventJournal(path)
+        j.append("pod-a", 1, "t", b"x", 1.0)
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b"\xff\xff\xff")  # partial header from a crash
+        assert len(list(EventJournal(path).replay())) == 1
+
+    def test_corrupt_record_stops_replay(self, tmp_path):
+        path = str(tmp_path / "j")
+        j = EventJournal(path)
+        j.append("pod-a", 1, "t", b"x", 1.0)
+        size_one = os.path.getsize(path)
+        j.append("pod-a", 2, "t", b"y", 2.0)
+        j.close()
+        with open(path, "r+b") as f:
+            f.seek(size_one + 10)
+            f.write(b"\xee")
+        recs = list(EventJournal(path).replay())
+        assert [r.sequence for r in recs] == [1]
+
+    def test_rotate_restarts_empty(self, tmp_path):
+        path = str(tmp_path / "j")
+        j = EventJournal(path)
+        j.append("pod-a", 1, "t", b"x", 1.0)
+        j.rotate()
+        assert list(j.replay()) == []
+        j.append("pod-a", 2, "t", b"y", 2.0)
+        j.close()
+        assert [r.sequence for r in EventJournal(path).replay()] == [2]
+
+
+# ---------------------------------------------------------------------------
+# Warm restart + readiness gate
+# ---------------------------------------------------------------------------
+
+
+def _stack(queue_max=0):
+    processor = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+    index = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+    pool = Pool(PoolConfig(concurrency=1, ingest_queue_max=queue_max),
+                index, processor)
+    return processor, index, pool
+
+
+class TestWarmRestart:
+    def test_cold_start_is_ready_immediately(self, tmp_path):
+        _p, index, pool = _stack()
+        mgr = RecoveryManager(
+            RecoveryConfig(snapshot_dir=str(tmp_path), snapshot_interval_s=0),
+            index, pool)
+        summary = mgr.warm_restart()
+        assert summary["restored_entries"] == 0
+        assert mgr.state == STATE_READY
+        mgr.stop(final_snapshot=False)
+
+    def test_snapshot_restore_replay_and_warmup(self, tmp_path):
+        cfg = RecoveryConfig(snapshot_dir=str(tmp_path), snapshot_interval_s=0,
+                             warmup_staleness_bound_s=1.0)
+        processor, index, pool = _stack()
+        pool.start()
+        mgr = RecoveryManager(cfg, index, pool)
+        mgr.attach_journal()
+        old_ts = time.time() - 30.0  # events "published" 30s ago
+        pool.add_task(_raw("pod-a", 1, [1, 2], list(range(8)), ts=old_ts))
+        pool.join()
+        rks = processor.tokens_to_kv_block_keys(0, list(range(8)), MODEL)
+        assert len(index.lookup(rks)) == 2
+        assert mgr.snapshot_now("test") is not None
+        # Past the snapshot: journal-only territory.
+        pool.add_task(_raw("pod-a", 2, [3, 4], list(range(100, 108)), ts=old_ts))
+        pool.join()
+        rks2 = processor.tokens_to_kv_block_keys(0, list(range(100, 108)), MODEL)
+        pool.shutdown()  # crash: no final snapshot
+
+        processor2, index2, pool2 = _stack()
+        mgr2 = RecoveryManager(cfg, index2, pool2)
+        summary = mgr2.warm_restart()
+        assert summary["restored_entries"] >= 2
+        assert summary["replayed_records"] == 1
+        assert len(index2.lookup(rks)) == 2   # from the snapshot
+        assert len(index2.lookup(rks2)) == 2  # from the journal
+        # The replayed events are 30s old: still warming under a 1s bound.
+        assert mgr2.state == STATE_WARMING
+        assert not mgr2.ready
+        # A fresh live event clears the staleness gate.
+        pool2.start()
+        pool2.add_task(_raw("pod-a", 3, [5], list(range(200, 204))))
+        pool2.join()
+        assert mgr2.state == STATE_READY and mgr2.ready
+        mgr2.stop(final_snapshot=False)
+        pool2.shutdown()
+
+    def test_stop_detaches_journal_sink(self, tmp_path):
+        _p, index, pool = _stack()
+        mgr = RecoveryManager(
+            RecoveryConfig(snapshot_dir=str(tmp_path), snapshot_interval_s=0),
+            index, pool)
+        mgr.attach_journal()
+        assert pool.journal_sink is not None
+        mgr.stop(final_snapshot=False)
+        assert pool.journal_sink is None
+
+    def test_sequence_watermark_survives_restart(self, tmp_path):
+        cfg = RecoveryConfig(snapshot_dir=str(tmp_path), snapshot_interval_s=0)
+        _p, index, pool = _stack()
+        pool.start()
+        mgr = RecoveryManager(cfg, index, pool)
+        mgr.attach_journal()
+        pool.add_task(_raw("pod-a", 9, [1], list(range(4))))
+        pool.join()
+        mgr.snapshot_now("test")
+        pool.shutdown()
+
+        _p2, index2, pool2 = _stack()
+        mgr2 = RecoveryManager(cfg, index2, pool2)
+        mgr2.warm_restart()
+        pool2.start()
+        # Sequences 10..14 were lost while down; the restarted pool must
+        # notice the hole against the seeded watermark (9 -> 15 = 5 gap).
+        pool2.add_task(_raw("pod-a", 15, [2], list(range(8))))
+        pool2.join()
+        assert pool2.lag_stats()["pods"]["pod-a"]["seq_gaps"] == 5
+        mgr2.stop(final_snapshot=False)
+        pool2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bounded shard queues
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedQueues:
+    def test_drop_oldest_overflow(self):
+        _p, _index, pool = _stack(queue_max=4)  # workers not started
+        for seq in range(10):
+            pool.add_task(_raw("pod-a", seq, [seq], list(range(4))))
+        assert pool.dropped_events == 6
+        q = pool._queues[0]
+        assert q.qsize() == 4
+        # The newest messages survived (drop-oldest, not drop-newest).
+        kept = [q.get_nowait().sequence for _ in range(4)]
+        assert kept == [6, 7, 8, 9]
+
+    def test_join_accounting_survives_drops(self):
+        _p, index, pool = _stack(queue_max=2)
+        for seq in range(6):
+            pool.add_task(_raw("pod-a", seq, [seq], list(range(4))))
+        pool.start()
+        pool.join()  # must not deadlock despite 4 dropped tasks
+        pool.shutdown()
+        assert pool.dropped_events == 4
+
+    def test_unbounded_when_zero(self):
+        _p, _index, pool = _stack(queue_max=0)
+        for seq in range(100):
+            pool.add_task(_raw("pod-a", seq, [seq], list(range(4))))
+        assert pool.dropped_events == 0
+        assert pool._queues[0].qsize() == 100
+
+
+# ---------------------------------------------------------------------------
+# Anti-entropy
+# ---------------------------------------------------------------------------
+
+
+class TestAntiEntropy:
+    def test_diverged_replica_converges_then_stays_clean(self):
+        truth = InMemoryIndex(InMemoryIndexConfig())
+        local = InMemoryIndex(InMemoryIndexConfig())
+        truth.add(None, [11, 12], [_entry("pod-a")])
+        truth.add(None, [12], [_entry("pod-b", speculative=True)])
+        local.add(None, [11], [_entry("pod-a")])   # missing 12
+        local.add(None, [99], [_entry("pod-a")])   # stale extra
+        rec = AntiEntropyReconciler(local, IndexDigestSource(truth))
+        stats = rec.reconcile_once()
+        assert sorted(stats["divergent"]) == ["pod-a", "pod-b"]
+        assert stats["repaired_added"] == 2 and stats["repaired_removed"] == 1
+        assert set(local.lookup([11, 12, 99])) == {11, 12}
+        assert local.lookup([12])[12] == truth.lookup([12])[12]
+        # Converged: the next round exchanges digests only.
+        assert AntiEntropyReconciler(
+            local, IndexDigestSource(truth)).reconcile_once()["divergent"] == []
+
+    def test_matching_digests_touch_nothing(self):
+        truth = InMemoryIndex(InMemoryIndexConfig())
+        local = InMemoryIndex(InMemoryIndexConfig())
+        for idx in (truth, local):
+            idx.add(None, [5], [_entry("pod-a")])
+        rec = AntiEntropyReconciler(local, IndexDigestSource(truth))
+        stats = rec.reconcile_once()
+        assert stats["divergent"] == []
+        assert stats["repaired_added"] == stats["repaired_removed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# /healthz readiness gate
+# ---------------------------------------------------------------------------
+
+
+class TestHealthzGate:
+    def _get(self, port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_warming_serves_503_then_ready_200(self):
+        health = {"status": "warming", "state": "warming"}
+        server = AdminServer(port=0, expose_debug=False,
+                             health=lambda: dict(health))
+        port = server.start()
+        try:
+            status, body = self._get(port)
+            assert status == 503 and body["state"] == "warming"
+            health["status"] = "ok"
+            health["state"] = "ready"
+            status, body = self._get(port)
+            assert status == 200 and body["state"] == "ready"
+        finally:
+            server.stop()
+
+    def test_default_health_unchanged(self):
+        server = AdminServer(port=0, expose_debug=False)
+        port = server.start()
+        try:
+            assert self._get(port) == (200, {"status": "ok"})
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drain deadline
+# ---------------------------------------------------------------------------
+
+
+class _SlowOffload:
+    def __init__(self, busy_for_s):
+        self._until = time.monotonic() + busy_for_s
+
+    def flush(self, deadline_s: float) -> bool:
+        t_end = time.monotonic() + deadline_s
+        while time.monotonic() < self._until:
+            if time.monotonic() >= t_end:
+                return False
+            time.sleep(0.01)
+        return True
+
+
+class TestDrainDeadline:
+    def test_fast_drain_completes_all_steps(self, tmp_path):
+        _p, index, pool = _stack()
+        pool.start()
+        mgr = RecoveryManager(
+            RecoveryConfig(snapshot_dir=str(tmp_path), snapshot_interval_s=0),
+            index, pool)
+        mgr.attach_journal()
+        stopped = []
+        coordinator = DrainCoordinator(
+            deadline_s=5.0,
+            intake_stoppers=[lambda: stopped.append(True)],
+            pool=pool,
+            offload=_SlowOffload(0.0),
+            manager=mgr,
+        )
+        report = coordinator.drain()
+        assert report["completed"] is True
+        assert stopped == [True]
+        assert report["steps"] == {
+            "stop_intake": True, "drain_pool": True,
+            "flush_offload": True, "final_snapshot": True,
+        }
+        # The final snapshot landed on disk.
+        assert any(n.endswith(".snap") for n in os.listdir(tmp_path))
+
+    def test_deadline_abandons_slow_steps(self, tmp_path):
+        _p, index, pool = _stack()
+        pool.start()
+        mgr = RecoveryManager(
+            RecoveryConfig(snapshot_dir=str(tmp_path), snapshot_interval_s=0,
+                           drain_deadline_s=0.3),
+            index, pool)
+        coordinator = DrainCoordinator(
+            deadline_s=0.3,
+            pool=pool,
+            offload=_SlowOffload(30.0),  # will never finish in budget
+            manager=mgr,
+        )
+        start = time.monotonic()
+        report = coordinator.drain()
+        elapsed = time.monotonic() - start
+        assert report["completed"] is False
+        assert report["steps"]["flush_offload"] is False
+        assert elapsed < 5.0  # deadline enforced, not the 30s flush
+
+    def test_drain_is_idempotent(self):
+        _p, _index, pool = _stack()
+        pool.start()
+        coordinator = DrainCoordinator(deadline_s=2.0, pool=pool)
+        first = coordinator.drain()
+        assert coordinator.drain() is first or coordinator.drain() == first
