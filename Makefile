@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -56,6 +56,11 @@ bench: native
 # backend unlike `make bench`.
 bench-hotpath: native
 	$(CPU_ENV) $(PY) hack/bench_hotpath.py
+
+# Engine-telemetry overhead gate: asserts the per-step hook cost stays
+# under 1% of the decode-step p50 (telemetry/engine_telemetry.py).
+bench-engine-telemetry: native
+	$(CPU_ENV) $(PY) bench.py --engine-telemetry
 
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).

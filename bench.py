@@ -761,7 +761,109 @@ def bench_snapshot_overhead() -> dict:
     }
 
 
-def main(queued: bool = True) -> None:
+def bench_engine_telemetry() -> dict:
+    """Engine-telemetry overhead gate: per-step hook cost as a share of the
+    decode-step p50 (<1% asserted — the hooks ride every ``step()``), plus
+    informational enabled-vs-disabled step p50s from real engine runs.
+
+    The assertion is analytic (hook-ns / step-p50-ns) like the
+    flight-recorder gate: two wall-clock arms of a sub-millisecond CPU
+    step differ by more than 1% from scheduler noise alone, so a direct
+    A/B assert would flap. Both arms still run and are reported."""
+    import time
+
+    import jax
+
+    from llmd_kv_cache_tpu.models import engine as engine_mod
+    from llmd_kv_cache_tpu.models.llama import LlamaConfig, init_params
+    from llmd_kv_cache_tpu.telemetry.engine_telemetry import (
+        EngineTelemetry,
+        EngineTelemetryConfig,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=8192, hidden_size=256, num_layers=2, num_heads=4,
+        num_kv_heads=2, head_dim=64, intermediate_size=704, page_size=16,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 8000, 64).tolist() for _ in range(4)]
+    max_new = 96
+
+    def step_p50_us(telemetry) -> float:
+        eng = engine_mod.MiniEngine(
+            engine_mod.EngineConfig(
+                model=cfg, num_pages=128, max_pages_per_seq=16,
+                model_name="bench-telemetry", pod_identifier="p",
+                decode_burst=8, telemetry=telemetry,
+            ),
+            params=params, seed=0,
+        )
+        for i, p in enumerate(prompts):
+            eng.enqueue(f"r{i}", p, max_new_tokens=max_new)
+        eng.step()  # compile the prefill/decode programs before timing
+        samples = []
+        while True:
+            t0 = time.perf_counter_ns()
+            alive = eng.step()
+            samples.append(time.perf_counter_ns() - t0)
+            if not alive:
+                break
+        samples.sort()
+        return samples[len(samples) // 2] / 1e3
+
+    off_p50_us = step_p50_us(None)
+    on_p50_us = step_p50_us(EngineTelemetryConfig())
+
+    # -- analytic hook cost: the exact per-step call shape ----------------
+    tel = EngineTelemetry(EngineTelemetryConfig())
+    pool_eng = engine_mod.MiniEngine(
+        engine_mod.EngineConfig(
+            model=cfg, num_pages=128, max_pages_per_seq=16,
+            model_name="bench-telemetry-pool", pod_identifier="p",
+        ),
+        params=params, seed=0,
+    )
+    pools = [("full", pool_eng.block_manager)]
+    n = 100_000
+    start = time.perf_counter_ns()
+    for _ in range(n):
+        tel.on_step(1e-3, True, pools)  # includes the 1-in-16 pool scrape
+    ns_on_step = (time.perf_counter_ns() - start) / n
+
+    tel.on_admitted("r0", 0)
+    tel.on_first_token("r0")
+    now = time.monotonic()
+    start = time.perf_counter_ns()
+    for i in range(n):
+        tel.on_decode_tokens("r0", 1, now + i * 1e-3)
+    ns_on_decode = (time.perf_counter_ns() - start) / n
+
+    # Per step the engine pays one on_step plus one on_decode_tokens per
+    # running request (batch of 4 here, matching the wall-clock arms).
+    hook_ns_per_step = ns_on_step + len(prompts) * ns_on_decode
+    overhead_pct = 100.0 * hook_ns_per_step / (off_p50_us * 1e3)
+    # Telemetry must stay invisible on the decode-step path.
+    assert overhead_pct < 1.0, (
+        f"engine telemetry costs {hook_ns_per_step:.0f} ns/step — "
+        f"{overhead_pct:.2f}% of the {off_p50_us:.0f} us decode-step p50"
+    )
+
+    return {
+        "metric": "engine-telemetry overhead on the decode-step path "
+                  "(batch 4, burst 8, pool scrape every 16 steps)",
+        "value": round(overhead_pct, 4),
+        "unit": "% of decode-step p50",
+        "vs_baseline": 1.0,
+        "hook_ns_per_step": round(hook_ns_per_step, 1),
+        "on_step_ns": round(ns_on_step, 1),
+        "on_decode_tokens_ns": round(ns_on_decode, 1),
+        "step_p50_off_us": round(off_p50_us, 1),
+        "step_p50_on_us": round(on_p50_us, 1),
+    }
+
+
+def main(queued: bool = True) -> dict:
     """TTFT routing benchmark: service-time replay + open-loop QPS sweep.
 
     ``queued`` is retained for CLI compatibility; the sweep always runs
@@ -1136,7 +1238,7 @@ def main(queued: bool = True) -> None:
         line["storage_restore_p50_s"] = round(st_p50, 4)
         line["storage_hit_rate"] = round(st_hit, 4)
         line["storage_restore_samples"] = st_n
-    print(json.dumps(line))
+    return line
 
 
 def _storage_arm(model_cfg, engine_mod, fresh_indexer, shared_params,
@@ -1286,8 +1388,8 @@ def _accelerator_healthy(timeout=90) -> bool:
         return False
 
 
-def guarded_main() -> None:
-    """The driver entry: always emits exactly one JSON result line.
+def guarded_main() -> str:
+    """The driver entry: returns exactly one JSON result line.
 
     Ladder: (1) accelerator healthy → TTFT routing benchmark on the real
     device; (2) tunnel down → the SAME headline routing metric on the CPU
@@ -1300,8 +1402,7 @@ def guarded_main() -> None:
     if _accelerator_healthy():
         line = _run_ttft_subprocess()
         if line is not None:
-            print(line)
-            return
+            return line
     # CPU fallback: strip the accelerator plugin (PYTHONPATH sitecustomize)
     # so jax cannot touch the wedged transport.
     cpu_env = dict(os.environ)
@@ -1309,36 +1410,53 @@ def guarded_main() -> None:
     cpu_env["JAX_PLATFORMS"] = "cpu"
     line = _run_ttft_subprocess(env=cpu_env)
     if line is not None:
-        print(line)
-        return
+        return line
     try:
-        print(json.dumps(bench_index_add()))
+        return json.dumps(bench_index_add())
     except Exception:
         # Toolchain-less host: fall back to the pure-Python backend so a
         # result line is always emitted.
-        print(json.dumps(bench_index_add(native=False)))
+        return json.dumps(bench_index_add(native=False))
+
+
+def _dispatch(argv: list) -> object:
+    """CLI mode → result (a dict, or an already-encoded JSON line)."""
+    if "--ttft-load" in argv:
+        return main(queued=True)
+    if "--ttft" in argv:
+        return main()
+    if "--index" in argv:
+        return bench_index_add()
+    if "--offload" in argv:
+        return bench_offload_throughput()
+    if "--decode-hybrid" in argv:
+        return bench_decode_throughput(hybrid=True)
+    if "--decode" in argv:
+        return bench_decode_throughput()
+    if "--events" in argv:
+        return bench_event_ingestion()
+    if "--flight-recorder" in argv:
+        return bench_flight_recorder()
+    if "--snapshot-overhead" in argv:
+        return bench_snapshot_overhead()
+    if "--engine-telemetry" in argv:
+        return bench_engine_telemetry()
+    return guarded_main()
 
 
 if __name__ == "__main__":
+    import contextlib
     import sys
 
-    if "--ttft-load" in sys.argv:
-        main(queued=True)
-    elif "--ttft" in sys.argv:
-        main()
-    elif "--index" in sys.argv:
-        print(json.dumps(bench_index_add()))
-    elif "--offload" in sys.argv:
-        print(json.dumps(bench_offload_throughput()))
-    elif "--decode-hybrid" in sys.argv:
-        print(json.dumps(bench_decode_throughput(hybrid=True)))
-    elif "--decode" in sys.argv:
-        print(json.dumps(bench_decode_throughput()))
-    elif "--events" in sys.argv:
-        print(json.dumps(bench_event_ingestion()))
-    elif "--flight-recorder" in sys.argv:
-        print(json.dumps(bench_flight_recorder()))
-    elif "--snapshot-overhead" in sys.argv:
-        print(json.dumps(bench_snapshot_overhead()))
-    else:
-        guarded_main()
+    # The driver contract (VERDICT #5): the result JSON must be the single
+    # LAST stdout line, with nothing after it. Benchmark code and the
+    # libraries it imports occasionally write to stdout, so the whole run
+    # executes with stdout aliased to stderr; only the final line touches
+    # the real stream. (The --ttft subprocess path is unaffected: the
+    # parent scans the child's stdout for the last JSON line, which is now
+    # the only one.)
+    _real_stdout = sys.stdout
+    with contextlib.redirect_stdout(sys.stderr):
+        _result = _dispatch(sys.argv)
+    _line = _result if isinstance(_result, str) else json.dumps(_result)
+    print(_line, file=_real_stdout, flush=True)

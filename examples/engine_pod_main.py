@@ -13,10 +13,18 @@ cluster test (tests/test_cluster_e2e.py) without an HTTP stack:
 
 The pod writes ``<control>/<pod-id>.ready`` once serving. SIGTERM exits.
 
+``--admin-port`` (off by default; ``auto`` = ephemeral) starts the stdlib
+admin endpoint with the engine-telemetry debug section (``/metrics``,
+``/debug/vars`` → ``engine``, and — when ``--profile-dir`` is set —
+``/debug/profile?duration_s=N``). The bound port is written to
+``<control>/<pod-id>.admin_port`` so tests and ``hack/kvdiag.py`` can find
+it.
+
 Usage:
   python examples/engine_pod_main.py --pod-id pod-0 \
       --zmq-endpoint tcp://127.0.0.1:5557 --control-dir /tmp/ctl \
-      [--offload-root /mnt/kv-store] [--model-name tiny]
+      [--offload-root /mnt/kv-store] [--model-name tiny] \
+      [--admin-port auto] [--profile-dir /tmp/xplane]
 """
 
 import argparse
@@ -30,6 +38,8 @@ from llmd_kv_cache_tpu.events.publisher import KVEventPublisher
 from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
 from llmd_kv_cache_tpu.models.llama import LlamaConfig
 from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+from llmd_kv_cache_tpu.services.admin import AdminServer
+from llmd_kv_cache_tpu.telemetry import EngineTelemetryConfig
 from llmd_kv_cache_tpu.utils.logging import configure_from_env
 
 
@@ -41,6 +51,12 @@ def main() -> None:
     parser.add_argument("--control-dir", required=True)
     parser.add_argument("--model-name", default="tiny")
     parser.add_argument("--offload-root", default=None)
+    parser.add_argument("--admin-port", default="0",
+                        help='admin/metrics endpoint: "0" = off (default), '
+                             '"auto" = ephemeral port, else a port number')
+    parser.add_argument("--profile-dir", default="",
+                        help="enable /debug/profile, writing jax.profiler "
+                             "xplane captures here")
     args = parser.parse_args()
 
     cfg = LlamaConfig.tiny()
@@ -60,6 +76,7 @@ def main() -> None:
         EngineConfig(
             model=cfg, num_pages=64, max_pages_per_seq=16,
             model_name=args.model_name, pod_identifier=args.pod_id,
+            telemetry=EngineTelemetryConfig(profile_dir=args.profile_dir),
         ),
         event_sink=publisher.publish,
         offload_spec=spec,
@@ -69,6 +86,15 @@ def main() -> None:
 
     control = pathlib.Path(args.control_dir)
     control.mkdir(parents=True, exist_ok=True)
+
+    admin = None
+    if args.admin_port != "0":
+        port = 0 if args.admin_port == "auto" else int(args.admin_port)
+        admin = AdminServer(port=port, expose_debug=True)
+        if engine.telemetry is not None:
+            engine.telemetry.attach_admin(admin)
+        admin.start()
+        (control / f"{args.pod_id}.admin_port").write_text(str(admin.port))
 
     running = [True]
     signal.signal(signal.SIGTERM, lambda *_: running.__setitem__(0, False))
@@ -98,6 +124,9 @@ def main() -> None:
                 {"request_id": req["request_id"], "output": out}))
             os.replace(tmp_file, out_file)
         time.sleep(0.05)
+
+    if admin is not None:
+        admin.stop()
 
 
 if __name__ == "__main__":

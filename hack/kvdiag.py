@@ -7,9 +7,14 @@ an on-call engineer needs into a single JSON report on stdout:
 - ``/healthz``                 — liveness
 - ``/debug/vars``              — flight-recorder ring + every registered
                                  debug provider (per-pod event lag, the
-                                 cache-efficiency ledger, …)
-- ``/metrics`` (parsed)        — the ``kvcache_*`` / ``kv_offload_*``
-                                 Prometheus families as name → samples
+                                 cache-efficiency ledger, engine telemetry, …)
+- ``/metrics`` (parsed)        — the ``kvcache_*`` / ``kv_offload_*`` /
+                                 ``kvtpu_engine_*`` Prometheus families as
+                                 name → samples
+- ``engine`` (summary)         — when the target is an engine pod: KV-pool
+                                 occupancy, request phase percentiles
+                                 (TTFT/ITL/TPOT/step), and the last
+                                 profiler-capture path
 
 Usage:
   python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
@@ -26,7 +31,7 @@ import sys
 import urllib.error
 import urllib.request
 
-METRIC_PREFIXES = ("kvcache_", "kv_offload_")
+METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -91,6 +96,17 @@ def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
         report["metrics"] = parse_metrics(body.decode("utf-8", "replace"))
     else:
         report["metrics"] = {"error": f"/metrics -> HTTP {status}"}
+
+    engine = report["debug"].get("engine") if isinstance(report["debug"], dict) else None
+    if isinstance(engine, dict) and "pool" in engine:
+        # Engine pods (telemetry.engine_telemetry): lift the bits an
+        # on-call engineer scans first into a top-level summary.
+        report["engine"] = {
+            "pool": engine.get("pool", {}),
+            "phases": engine.get("phases", {}),
+            "requests": engine.get("requests", {}),
+            "last_profile": (engine.get("last_profile") or {}).get("dir"),
+        }
 
     return report
 

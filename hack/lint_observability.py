@@ -8,12 +8,14 @@ Three AST checks over every ``.py`` file under the given roots (default
    name must start with ``llm_d.kv_cache.`` (the project's trace
    namespace; f-strings are checked by their literal prefix).
 2. **metric names** — every ``Counter``/``Gauge``/``Histogram``/``Summary``
-   constructed in the library must start with ``kvcache_`` or
-   ``kv_offload_`` so dashboards can select the project's families with
-   one matcher.
-3. **docs coverage** — every metric name constructed in the library must
-   appear in ``docs/observability.md``; an undocumented metric is a
-   dashboard nobody will ever build.
+   (and config-bucketed ``BucketHistogram`` / ``bucket_histogram``)
+   constructed in the library must start with ``kvcache_``,
+   ``kv_offload_``, or ``kvtpu_engine_`` so dashboards can select the
+   project's families with one matcher.
+3. **docs coverage** — every metric name constructed in the library, and
+   every fully-literal span name, must appear in
+   ``docs/observability.md``; an undocumented metric is a dashboard
+   nobody will ever build.
 
 Exit status 1 when any violation is found (CI-friendly; see Makefile
 ``lint`` target).
@@ -26,8 +28,13 @@ import sys
 from pathlib import Path
 
 SPAN_PREFIX = "llm_d.kv_cache."
-METRIC_PREFIXES = ("kvcache_", "kv_offload_")
-METRIC_CLASSES = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
+METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_")
+METRIC_CLASSES = frozenset({
+    "Counter", "Gauge", "Histogram", "Summary",
+    # The engine-telemetry histogram primitive with config-driven buckets
+    # (metrics/collector.py): both the class and its get-or-create helper.
+    "BucketHistogram", "bucket_histogram",
+})
 DOCS_PATH = Path("docs/observability.md")
 
 
@@ -63,15 +70,16 @@ def _metric_class(call: ast.Call) -> str:
     return ""
 
 
-def lint_file(path: Path) -> tuple[list[str], list[str]]:
-    """Returns (problems, metric_names_constructed)."""
+def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
+    """Returns (problems, metric_names_constructed, span_names)."""
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"], []
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"], [], []
     problems: list[str] = []
     metric_names: list[str] = []
+    span_names: list[str] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -85,6 +93,11 @@ def lint_file(path: Path) -> tuple[list[str], list[str]]:
                     f"{path}:{node.lineno}: span name {prefix!r}… outside the "
                     f"`{SPAN_PREFIX}*` namespace"
                 )
+            if full and prefix.startswith(SPAN_PREFIX):
+                # Fully-literal, in-namespace span names join the docs
+                # coverage check (f-string names like tokenizer.<Method>
+                # can only be documented as a pattern, so they're exempt).
+                span_names.append(prefix)
         cls = _metric_class(node)
         if cls and isinstance(first, ast.Constant) and isinstance(first.value, str):
             name = first.value
@@ -94,33 +107,42 @@ def lint_file(path: Path) -> tuple[list[str], list[str]]:
                     f"{path}:{node.lineno}: {cls} {name!r} outside the "
                     f"{'/'.join(METRIC_PREFIXES)} namespaces"
                 )
-    return problems, metric_names
+    return problems, metric_names, span_names
 
 
-def check_docs(metric_names: list[str], docs_path: Path) -> list[str]:
+def check_docs(metric_names: list[str], span_names: list[str],
+               docs_path: Path) -> list[str]:
     if not docs_path.exists():
         return [f"{docs_path}: missing — every metric must be documented there"]
     text = docs_path.read_text()
-    return [
+    problems = [
         f"{docs_path}: metric `{name}` is not documented"
         for name in sorted(set(metric_names))
         if name not in text
     ]
+    problems.extend(
+        f"{docs_path}: span `{name}` is not documented"
+        for name in sorted(set(span_names))
+        if name not in text
+    )
+    return problems
 
 
 def main(argv: list[str]) -> int:
     roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
     problems: list[str] = []
     metric_names: list[str] = []
+    span_names: list[str] = []
     n_files = 0
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
         for f in files:
             n_files += 1
-            file_problems, file_metrics = lint_file(f)
+            file_problems, file_metrics, file_spans = lint_file(f)
             problems.extend(file_problems)
             metric_names.extend(file_metrics)
-    problems.extend(check_docs(metric_names, DOCS_PATH))
+            span_names.extend(file_spans)
+    problems.extend(check_docs(metric_names, span_names, DOCS_PATH))
     for p in problems:
         print(p)
     print(

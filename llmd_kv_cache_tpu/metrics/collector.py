@@ -9,9 +9,10 @@ prometheus_client registry, plus an optional periodic "metrics beat" log line
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from bisect import bisect_left
+from typing import Dict, Iterable, Optional, Sequence, Tuple
 
-from prometheus_client import Counter, Gauge, Histogram
+from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
 from ..utils.logging import get_logger
 
@@ -280,6 +281,218 @@ def record_reconcile(added: int, removed: int) -> None:
 
 def record_drain(seconds: float) -> None:
     DRAIN_SECONDS.set(max(seconds, 0.0))
+
+
+# --------------------------------------------------------------------------
+# BucketHistogram: a histogram primitive with runtime-configurable buckets.
+#
+# prometheus_client Histograms fix their buckets at module import, which is
+# wrong for serving-latency families (TTFT/ITL/TPOT) whose useful resolution
+# depends on the deployment (CPU dev loop vs. a v5e pod differ by 100x).
+# BucketHistogram takes its buckets from config at construction, supports a
+# quantile readback (kvdiag phase percentiles — prometheus_client has no
+# read API), and is exported through a single custom collector on the
+# default registry so it appears in ``generate_latest()`` exactly like the
+# native families. ``observe()`` is allocation-free after construction: one
+# bisect into a preallocated bounds tuple plus three stores under a lock.
+# --------------------------------------------------------------------------
+
+
+class BucketHistogram:
+    __slots__ = ("name", "documentation", "bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, documentation: str, buckets: Sequence[float]):
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("BucketHistogram needs at least one bucket bound")
+        self.name = name
+        self.documentation = documentation
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # per-bucket, +inf last
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        idx = bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-quantile (q in [0, 1]) from bucket boundaries.
+
+        Linear interpolation inside the containing bucket; the open-ended
+        +inf bucket reports its lower bound (the estimate saturates there).
+        Returns 0.0 when empty.
+        """
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+        if total <= 0:
+            return 0.0
+        target = max(q, 0.0) * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                if i == len(self.bounds):  # +inf bucket
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (target - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return self.bounds[-1]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, acc = self._count, self._sum
+        cumulative, cum = [], 0
+        for c in counts:
+            cum += c
+            cumulative.append(cum)
+        les = [str(b) for b in self.bounds] + ["+Inf"]
+        return {
+            "count": total,
+            "sum": acc,
+            "buckets": dict(zip(les, cumulative)),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def _sample_buckets(self) -> Iterable[Tuple[str, int]]:
+        snap = self.snapshot()
+        return list(snap["buckets"].items())
+
+
+_BUCKET_HISTOGRAMS: Dict[str, BucketHistogram] = {}
+_bucket_hist_lock = threading.Lock()
+_bucket_collector_registered = False
+
+
+class _BucketHistogramCollector:
+    """Exports every BucketHistogram as a Prometheus histogram family."""
+
+    def collect(self):
+        from prometheus_client.core import HistogramMetricFamily
+
+        with _bucket_hist_lock:
+            hists = list(_BUCKET_HISTOGRAMS.values())
+        for h in hists:
+            snap = h.snapshot()
+            fam = HistogramMetricFamily(h.name, h.documentation)
+            fam.add_metric(
+                [], buckets=list(snap["buckets"].items()), sum_value=snap["sum"]
+            )
+            yield fam
+
+
+def bucket_histogram(
+    name: str, documentation: str, buckets: Sequence[float]
+) -> BucketHistogram:
+    """Get-or-create a named BucketHistogram on the default registry.
+
+    Deduped by name: several engines in one process share the instance
+    (the first caller's buckets win), mirroring prometheus_client's
+    process-global family semantics.
+    """
+    global _bucket_collector_registered
+    with _bucket_hist_lock:
+        hist = _BUCKET_HISTOGRAMS.get(name)
+        if hist is None:
+            hist = BucketHistogram(name, documentation, buckets)
+            _BUCKET_HISTOGRAMS[name] = hist
+        register_now = not _bucket_collector_registered
+        _bucket_collector_registered = True
+    if register_now:
+        # Outside the lock: REGISTRY.register() calls collect(), which
+        # takes _bucket_hist_lock itself.
+        REGISTRY.register(_BucketHistogramCollector())
+    return hist
+
+
+# --------------------------------------------------------------------------
+# Engine data-plane families (kvtpu_engine_*): KV-pool occupancy, restore
+# outcomes, and request lifecycle counters for the TPU serving engine.
+# TTFT/ITL/TPOT are BucketHistograms created by telemetry/engine_telemetry.py
+# because their buckets are config-driven; the fixed-shape families live
+# here with the rest of the registry.
+# --------------------------------------------------------------------------
+
+ENGINE_POOL_FREE_PAGES = Gauge(
+    "kvtpu_engine_kv_pool_free_pages",
+    "Free pages in the engine KV pool",
+    ["group"],
+)
+ENGINE_POOL_CACHED_BLOCKS = Gauge(
+    "kvtpu_engine_kv_pool_cached_blocks",
+    "Hashed prefix blocks resident in the engine KV pool",
+    ["group"],
+)
+ENGINE_POOL_ORPHAN_PAGES = Gauge(
+    "kvtpu_engine_kv_pool_orphan_pages",
+    "Pages held by in-flight requests, not yet hashed into reusable blocks",
+    ["group"],
+)
+ENGINE_POOL_EVICTIONS = Counter(
+    "kvtpu_engine_kv_pool_evictions_total",
+    "Cached blocks evicted from the engine KV pool to free pages",
+    ["group"],
+)
+ENGINE_RESTORE_JOBS = Counter(
+    "kvtpu_engine_restore_jobs_total",
+    "Storage-tier KV restore attempts by outcome",
+    ["outcome"],  # success|failure|timeout
+)
+ENGINE_RESTORE_LATENCY = Histogram(
+    "kvtpu_engine_restore_latency_seconds",
+    "Deferred storage-restore wall time (job start to commit/abandon)",
+    buckets=(1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+ENGINE_PREFIX_HIT_BLOCKS = Counter(
+    "kvtpu_engine_prefix_hit_blocks_total",
+    "HBM-resident prefix blocks reused at request admission",
+)
+ENGINE_REQUESTS = Counter(
+    "kvtpu_engine_requests_total",
+    "Requests finished by the engine",
+    ["outcome"],  # finished|aborted
+)
+ENGINE_DECODE_STEPS = Counter(
+    "kvtpu_engine_decode_steps_total",
+    "Engine step() calls that decoded at least one token",
+)
+ENGINE_PROFILE_CAPTURES = Counter(
+    "kvtpu_engine_profile_captures_total",
+    "On-demand jax.profiler captures by outcome",
+    ["outcome"],  # success|failure
+)
+
+
+def record_engine_restore(outcome: str, seconds: Optional[float] = None) -> None:
+    ENGINE_RESTORE_JOBS.labels(outcome).inc()
+    if seconds is not None:
+        ENGINE_RESTORE_LATENCY.observe(max(seconds, 0.0))
+
+
+def record_profile_capture(outcome: str) -> None:
+    ENGINE_PROFILE_CAPTURES.labels(outcome).inc()
 
 
 _beat_thread: Optional[threading.Thread] = None

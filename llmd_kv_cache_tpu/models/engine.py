@@ -44,6 +44,7 @@ from ..events.model import (
 from ..ops.pallas_paged_attention import (
     head_dim_supported as _pallas_head_dim_supported,
 )
+from ..telemetry.tracing import tracer
 from ..utils.logging import get_logger
 from .llama import (
     LlamaConfig,
@@ -166,6 +167,12 @@ class EngineConfig:
     # the cost of admitting new requests only between bursts. Bursts are
     # bucketed to powers of two so the jit cache stays O(log burst).
     decode_burst: int = 1
+    # Engine data-plane telemetry (telemetry/engine_telemetry.py): an
+    # EngineTelemetryConfig enables TTFT/ITL/TPOT histograms, KV-pool
+    # gauges, per-request flight-recorder events, and the on-demand
+    # jax.profiler capture surface. None (default) keeps the step path
+    # free of every hook — each site costs one attribute load + branch.
+    telemetry: Optional[Any] = None
 
 
 @dataclass
@@ -206,7 +213,12 @@ class Request:
     # enqueue() timestamp, cleared at first prefill schedule — feeds the
     # burst-admission-delay histogram.
     enqueued_at: Optional[float] = None
-    # (job_id, first_missing_block, hashes, pages, deadline) while loading.
+    # W3C traceparent carried from the scorer (ScoreResponse.traceparent →
+    # enqueue()): when set, the engine parents admission/prefill/decode
+    # spans under it so one trace covers score→serve. None = no spans.
+    traceparent: Optional[str] = None
+    # (job_id, first_missing_block, hashes, pages, deadline, started)
+    # while loading.
     restore_job: Optional[tuple] = None
     # Prompt blocks registered in the block manager on this request's
     # behalf (acquired prefix at admission, extended by
@@ -243,9 +255,13 @@ class BlockManager:
         self.event_sink = event_sink
         self.group_idx = group_idx
         pool = num_pages if num_pages is not None else cfg.num_pages
+        self.num_pages = pool
         self.free_pages: list[int] = list(range(1, pool))  # 0 reserved
         self.blocks: dict[int, _BlockInfo] = {}  # block_hash → info
         self.page_to_hash: dict[int, int] = {}
+        # Lifetime eviction count: a plain int (one add per eviction) that
+        # telemetry turns into kvtpu_engine_kv_pool_evictions_total deltas.
+        self.evictions = 0
         if spec_kind is not None:
             self.spec_kind = spec_kind
             self.spec_window = spec_window
@@ -282,6 +298,25 @@ class BlockManager:
 
     def num_cached_blocks(self) -> int:
         return len(self.blocks)
+
+    def pool_stats(self) -> dict:
+        """Occupancy snapshot for telemetry/kvdiag: cheap plain-int reads.
+
+        ``orphan_pages`` are pages neither free nor registered as hashed
+        blocks — held by in-flight requests (partial tails, decode room)
+        and not reusable as prefix cache until commit.
+        """
+        free = len(self.free_pages)
+        cached_pages = len(self.page_to_hash)
+        return {
+            "total_pages": self.num_pages,
+            "free_pages": free,
+            "cached_blocks": len(self.blocks),
+            "cached_pages": cached_pages,
+            # Page 0 is the reserved garbage page.
+            "orphan_pages": max((self.num_pages - 1) - free - cached_pages, 0),
+            "evictions": self.evictions,
+        }
 
     def _emit(self, events: list[GenericEvent]) -> None:
         if self.event_sink is not None and events:
@@ -342,6 +377,7 @@ class BlockManager:
         info = self.blocks.pop(victim_hash)
         self.page_to_hash.pop(info.page, None)
         self.free_pages.append(info.page)
+        self.evictions += 1
         # Must carry the same group tag as the BlockStored that created the
         # entry, or the index's entry-match eviction is a silent no-op.
         self._emit([
@@ -879,6 +915,24 @@ class MiniEngine:
             # Canonical medium label (matches KV-event medium strings).
             self._offload_medium = offload_spec.medium
 
+        # Engine data-plane telemetry: request-lifecycle histograms
+        # (TTFT/ITL/TPOT), decimated KV-pool gauge scrapes, per-request
+        # flight-recorder events. None when the config leaves it off —
+        # every hook site below guards on that, so the disabled step path
+        # pays one attribute load + branch per site.
+        self.telemetry = None
+        self._telemetry_pools: list[tuple[str, BlockManager]] = []
+        tcfg = self.cfg.telemetry
+        if tcfg is not None and getattr(tcfg, "enabled", True):
+            from ..telemetry.engine_telemetry import EngineTelemetry
+
+            self.telemetry = EngineTelemetry(
+                tcfg, group=self.cfg.pod_identifier)
+            self._telemetry_pools = [("full", self.block_manager)]
+            if self.hybrid:
+                self._telemetry_pools.append(("swa", self.swa_manager))
+            self.telemetry.scrape_pools(self._telemetry_pools)
+
     # -- admission --
 
     def add_request(self, request_id: str, prompt: Sequence[int],
@@ -892,7 +946,8 @@ class MiniEngine:
         return req
 
     def enqueue(self, request_id: str, prompt: Sequence[int],
-                max_new_tokens: int = 16) -> Request:
+                max_new_tokens: int = 16,
+                traceparent: Optional[str] = None) -> Request:
         """Admit a request for continuous batching: pages are acquired and
         the storage tier consulted from ``step()``, where prefill runs
         chunk-at-a-time interleaved with decode — a long prompt stalls
@@ -900,9 +955,31 @@ class MiniEngine:
         its whole prefill (vLLM chunked-prefill scheduling). The storage
         restore is likewise deferred and polled across steps, so a slow
         storage tier costs the restored request latency, never the
-        running decodes'."""
-        req = self._admit(request_id, prompt, max_new_tokens,
-                          defer_restore=True)
+        running decodes'.
+
+        ``traceparent`` (e.g. ``ScoreResponse.traceparent`` from the pod
+        that scored this request) parents the engine's admission/prefill/
+        decode-step spans under the scorer's trace — one trace covers
+        score→serve. Requests without one create no spans at all.
+        """
+        if traceparent is not None:
+            with tracer().span(
+                "llm_d.kv_cache.engine.admission",
+                parent_traceparent=traceparent,
+                request_id=request_id,
+                prompt_tokens=len(prompt),
+            ) as sp:
+                req = self._admit(request_id, prompt, max_new_tokens,
+                                  defer_restore=True)
+                sp.set_attribute(
+                    "prefix_hit_blocks",
+                    req.cached_len // self.cfg.model.page_size)
+            req.traceparent = traceparent
+            if self.telemetry is not None:
+                self.telemetry.set_traceparent(request_id, traceparent)
+        else:
+            req = self._admit(request_id, prompt, max_new_tokens,
+                              defer_restore=True)
         # Burst-admission latency: with decode_burst > 1 the first prefill
         # chunk can only run once the in-flight burst drains — observed at
         # first schedule (kvcache_engine_admission_delay_seconds).
@@ -1010,6 +1087,9 @@ class MiniEngine:
         req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
         self.requests[request_id] = req
         self._running.append(request_id)
+        if self.telemetry is not None:
+            self.telemetry.on_admitted(
+                request_id, req.cached_len // page_size)
         return req
 
     def _finish_prefill(self, req: Request) -> None:
@@ -1021,6 +1101,8 @@ class MiniEngine:
         self._commit_full_blocks(req)
         first_token = int(np.argmax(req.last_logits))
         req.output.append(first_token)
+        if self.telemetry is not None:
+            self.telemetry.on_first_token(req.request_id)
         if req.max_new_tokens <= 1:
             req.done = True
             self._finish(req)
@@ -1066,12 +1148,15 @@ class MiniEngine:
             return
         restore_hashes = restore_hashes[: len(pages)]
 
+        from ..metrics.collector import record_engine_restore
+
         self._sync_caches_to_copier()
+        started = time.monotonic()
         job = self.offload_handlers.async_load_blocks(
             [(h, [p]) for h, p in zip(restore_hashes, pages)]
         )
         result = None
-        deadline = time.monotonic() + 30.0
+        deadline = started + 30.0
         while result is None and time.monotonic() < deadline:
             result = self._drain_offload(target_job=job)
             if result is None:
@@ -1082,9 +1167,11 @@ class MiniEngine:
             # pages we are about to recycle.
             self.offload_handlers.wait_job(job, timeout_s=5.0)
         if result is None or not result.success:
+            record_engine_restore("timeout" if result is None else "failure")
             logger.warning("storage restore failed for %d blocks", len(pages))
             self.block_manager.free_pages.extend(pages)
             return
+        record_engine_restore("success", time.monotonic() - started)
 
         # Register restored blocks in the prefix cache (no re-store event:
         # the blocks are already on the storage tier; the HBM BlockStored
@@ -1139,14 +1226,17 @@ class MiniEngine:
             [(h, [p]) for h, p in zip(restore_hashes, pages)]
         )
         self._restore_job_ids.add(job)
+        started = time.monotonic()
         req.restore_job = (job, first_missing, restore_hashes, pages,
-                          time.monotonic() + 30.0)
+                           started + 30.0, started)
 
     def _poll_deferred_restore(self, req: Request) -> bool:
         """Advance an in-flight deferred restore. Returns True once settled
         (success, failure, or timeout) — prefill may proceed; False while
         the load is still in flight (the step goes on decoding)."""
-        job, first_missing, hashes, pages, deadline = req.restore_job
+        from ..metrics.collector import record_engine_restore
+
+        job, first_missing, hashes, pages, deadline, started = req.restore_job
         result = self._restore_results.pop(job, None)
         if result is None:
             result = self._drain_offload(target_job=job)
@@ -1163,12 +1253,15 @@ class MiniEngine:
             self._restore_job_ids.discard(job)
             self._restore_results.pop(job, None)
             req.restore_job = None
+            record_engine_restore("timeout")
             logger.warning("deferred storage restore timed out; recomputing")
             return True
         req.restore_job = None
         if not result.success:
+            record_engine_restore("failure", time.monotonic() - started)
             logger.warning("deferred storage restore failed; recomputing")
             return True
+        record_engine_restore("success", time.monotonic() - started)
         page_size = self.cfg.model.page_size
         canonical = self._commit_restored_blocks(
             req, first_missing, hashes, pages
@@ -1511,6 +1604,8 @@ class MiniEngine:
         chunk-at-a-time — a long prompt delays running decodes by one
         chunk per step, never its whole prefill.
         """
+        tel = self.telemetry
+        step_t0 = time.monotonic() if tel is not None else 0.0
         self.poll_offload()
         emitted: dict[str, int] = {}
         # Continuous batching: one prefill chunk for the oldest admitted-
@@ -1541,13 +1636,24 @@ class MiniEngine:
                     record_admission_delay(
                         time.monotonic() - req.enqueued_at)
                     req.enqueued_at = None
+                    if tel is not None:
+                        tel.on_first_schedule(rid)
                 # Deferred storage restore (enqueue path): started above on
                 # the request's first step, polled here across steps —
                 # decodes keep running below while the load is in flight.
                 if req.restore_job is not None:
                     if not self._poll_deferred_restore(req):
                         break
-                self._prefill_chunk(req)
+                if req.traceparent is not None:
+                    with tracer().span(
+                        "llm_d.kv_cache.engine.prefill_chunk",
+                        parent_traceparent=req.traceparent,
+                        request_id=rid,
+                        prefill_pos=req.prefill_pos,
+                    ):
+                        self._prefill_chunk(req)
+                else:
+                    self._prefill_chunk(req)
                 if req.prefill_pos is None:
                     self._finish_prefill(req)
                     if req.output:
@@ -1573,6 +1679,9 @@ class MiniEngine:
             req = self.requests[rid]
             if req.done:
                 self._finish(req)
+        if tel is not None:
+            tel.on_step(time.monotonic() - step_t0, bool(emitted),
+                        self._telemetry_pools)
         return emitted
 
     def _drain_offload(self, target_job: Optional[int] = None):
@@ -1640,7 +1749,9 @@ class MiniEngine:
             self.poll_offload()
             time.sleep(0.005)
 
-    def _finish(self, req: Request) -> None:
+    def _finish(self, req: Request, outcome: str = "finished") -> None:
+        if self.telemetry is not None:
+            self.telemetry.on_finish(req.request_id, outcome)
         if req.restore_job is not None:
             # Abort with a deferred restore in flight: non-blocking cancel —
             # kvio marks the job cancelled (never scatters) and parks its
@@ -1738,12 +1849,25 @@ class MiniEngine:
             )
         toks_host = np.asarray(toks)
         out = {}
+        tel = self.telemetry
+        now = time.monotonic() if tel is not None else 0.0
         for i, req in enumerate(chunk):
             taken = min(steps, int(budgets[i]))
             burst = [int(t) for t in toks_host[i, :taken]]
             req.output.extend(burst)
             req.computed_len += taken
             out[req.request_id] = burst[-1]
+            if tel is not None:
+                tel.on_decode_tokens(req.request_id, taken, now)
+            if req.traceparent is not None:
+                with tracer().span(
+                    "llm_d.kv_cache.engine.decode_step",
+                    parent_traceparent=req.traceparent,
+                    request_id=req.request_id,
+                    tokens=taken,
+                    computed_len=req.computed_len,
+                ):
+                    pass  # event-style span: marks the emission point
             if len(req.output) >= req.max_new_tokens:
                 req.done = True
             if self.hybrid:
@@ -1788,11 +1912,24 @@ class MiniEngine:
             )
         out = {}
         next_tokens = np.asarray(jnp.argmax(logits[:, 0], axis=-1))
+        tel = self.telemetry
+        now = time.monotonic() if tel is not None else 0.0
         for i, req in enumerate(chunk):
             req.computed_len += 1
             tok = int(next_tokens[i])
             req.output.append(tok)
             out[req.request_id] = tok
+            if tel is not None:
+                tel.on_decode_tokens(req.request_id, 1, now)
+            if req.traceparent is not None:
+                with tracer().span(
+                    "llm_d.kv_cache.engine.decode_step",
+                    parent_traceparent=req.traceparent,
+                    request_id=req.request_id,
+                    tokens=1,
+                    computed_len=req.computed_len,
+                ):
+                    pass  # event-style span: marks the emission point
             if len(req.output) >= req.max_new_tokens:
                 req.done = True
             if self.hybrid:
@@ -1841,7 +1978,7 @@ class MiniEngine:
         if req is None or req.done:
             return False
         req.done = True
-        self._finish(req)
+        self._finish(req, outcome="aborted")
         return True
 
     def reset_cache(self) -> None:
@@ -1854,7 +1991,7 @@ class MiniEngine:
         for rid in list(self._running):
             req = self.requests[rid]
             req.done = True
-            self._finish(req)
+            self._finish(req, outcome="aborted")
         self.block_manager.clear()
         if self.hybrid:
             self.swa_manager.clear(emit=False)

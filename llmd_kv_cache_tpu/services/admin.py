@@ -6,9 +6,12 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
 - ``/healthz``   — liveness probe (200 + ``{"status": "ok"}``)
 - ``/debug/flight-recorder`` — the in-process flight recorder ring
 - ``/debug/<name>``          — registered JSON providers (``lag``,
-  ``ledger``, …), whatever the owning service wires in
+  ``ledger``, ``engine``, …), whatever the owning service wires in
 - ``/debug/vars``            — every provider + the flight recorder in
   one JSON document (what ``hack/kvdiag.py`` snapshots)
+- ``/debug/profile?duration_s=N`` — on-demand ``jax.profiler`` capture
+  (guarded: 404 unless the owner registered a capture callable via
+  :meth:`AdminServer.register_profiler`; one capture at a time → 409)
 
 Deliberately stdlib-only (``http.server``): the endpoint must work in the
 most degraded pod imaginable — that is exactly when it is needed. Disabled
@@ -23,7 +26,8 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Callable, Mapping, Optional
+from urllib.parse import parse_qs
 
 from ..telemetry import flight_recorder
 from ..utils.logging import get_logger
@@ -51,6 +55,7 @@ class AdminServer:
         self._expose_debug = expose_debug
         self._providers: dict[str, Callable[[], object]] = {}
         self._health = health
+        self._profiler: Optional[Callable[[float], dict]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -58,6 +63,14 @@ class AdminServer:
         """Expose ``provider()`` (a JSON-serializable callable) as
         ``/debug/<name>`` and inside ``/debug/vars``."""
         self._providers[name] = provider
+
+    def register_profiler(self, capture: Callable[[float], dict]) -> None:
+        """Enable ``/debug/profile``: ``capture(duration_s)`` runs a
+        blocking profiler capture and returns a JSON-serializable summary
+        (``telemetry.engine_telemetry.ProfilerCapture.capture``). The
+        endpoint stays 404 until this is called — an unconfigured pod must
+        not let arbitrary HTTP clients spin up the profiler."""
+        self._profiler = capture
 
     def set_health_provider(self, provider: Callable[[], dict]) -> None:
         """Make ``/healthz`` report ``provider()`` instead of the static
@@ -89,7 +102,33 @@ class AdminServer:
                 payload[name] = {"error": str(exc)}
         return payload
 
-    def _handle(self, path: str) -> tuple[int, bytes, str]:
+    def _handle_profile(self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._profiler is None:
+            return (404, b'{"error": "profiler not configured"}',
+                    "application/json")
+        raw = query.get("duration_s", ["1.0"])[-1]
+        try:
+            duration_s = float(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad duration_s: {raw!r}"}).encode(),
+                "application/json")
+        try:
+            summary = self._profiler(duration_s)
+        except ValueError as exc:
+            return 400, json.dumps({"error": str(exc)}).encode(), "application/json"
+        except Exception as exc:
+            # ProfileInProgress (a RuntimeError subclass) → 409; any other
+            # capture failure (unsupported platform, profiler error) → 500.
+            from ..telemetry.engine_telemetry import ProfileInProgress
+
+            status = 409 if isinstance(exc, ProfileInProgress) else 500
+            return status, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(summary, indent=2, default=repr).encode(),
+                "application/json")
+
+    def _handle(self, path: str,
+                query: Optional[Mapping[str, list]] = None) -> tuple[int, bytes, str]:
         """Route one GET; returns (status, body, content_type)."""
         if path == "/healthz":
             if self._health is None:
@@ -108,6 +147,8 @@ class AdminServer:
             body, ctype = self._metrics_payload()
             return 200, body, ctype
         if self._expose_debug:
+            if path == "/debug/profile":
+                return self._handle_profile(query or {})
             if path == "/debug/flight-recorder":
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"
@@ -136,7 +177,9 @@ class AdminServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
                 try:
-                    status, body, ctype = outer._handle(self.path.split("?", 1)[0])
+                    path, _, raw_query = self.path.partition("?")
+                    status, body, ctype = outer._handle(
+                        path, parse_qs(raw_query))
                 except Exception as exc:  # a broken provider must not kill the server
                     status = 500
                     body = json.dumps({"error": str(exc)}).encode("utf-8")

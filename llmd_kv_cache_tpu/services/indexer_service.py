@@ -133,10 +133,17 @@ class ScoreResponse:
     # replay) and routers should widen their fallback. Absent on the wire
     # from older servers, so decoding defaults to False.
     degraded: bool = False
+    # W3C traceparent of the GetPodScores span that produced these scores.
+    # A scheduler that routes on them hands it to the chosen engine's
+    # ``enqueue(..., traceparent=...)`` so admission/prefill/decode spans
+    # join the scorer's trace — one trace covers score→serve. Empty when
+    # tracing is off; absent on the wire from older servers.
+    traceparent: str = ""
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
-            {"scores": self.scores, "error": self.error, "degraded": self.degraded},
+            {"scores": self.scores, "error": self.error,
+             "degraded": self.degraded, "traceparent": self.traceparent},
             use_bin_type=True,
         )
 
@@ -147,6 +154,7 @@ class ScoreResponse:
             scores=dict(d.get("scores", {})),
             error=d.get("error", ""),
             degraded=bool(d.get("degraded", False)),
+            traceparent=d.get("traceparent", "") or "",
         )
 
 
@@ -338,7 +346,11 @@ class IndexerService:
                 # flag them so routers widen their fallback (the wire field
                 # decodes to False against older peers).
                 degraded = self.recovery is not None and not self.recovery.ready
-                return ScoreResponse(scores=scores, degraded=degraded)
+                # Score→serve trace continuity: hand the scheduler this
+                # span's traceparent so the chosen engine's spans join the
+                # trace ("" when no tracer is active).
+                return ScoreResponse(scores=scores, degraded=degraded,
+                                     traceparent=current_traceparent() or "")
             except Exception as e:
                 logger.exception("GetPodScores failed")
                 return ScoreResponse(error=str(e))
@@ -438,6 +450,18 @@ class IndexerServiceClient:
         model_name: str,
         pod_identifiers: Optional[list[str]] = None,
     ) -> dict[str, float]:
+        return self.score(tokens, model_name, pod_identifiers).scores
+
+    def score(
+        self,
+        tokens: list[int],
+        model_name: str,
+        pod_identifiers: Optional[list[str]] = None,
+    ) -> ScoreResponse:
+        """Full-response variant of :meth:`get_pod_scores`: carries the
+        ``degraded`` flag and the scorer's ``traceparent`` (hand the
+        latter to the chosen engine's ``enqueue`` for score→serve trace
+        continuity)."""
         resp = _call_rpc(
             self._get_pod_scores,
             ScoreRequest(
@@ -450,7 +474,7 @@ class IndexerServiceClient:
         )
         if resp.error:
             raise RuntimeError(f"GetPodScores failed: {resp.error}")
-        return resp.scores
+        return resp
 
     def close(self) -> None:
         self._channel.close()

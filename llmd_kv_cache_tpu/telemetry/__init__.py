@@ -1,5 +1,11 @@
 """Tracing + flight recorder (counterpart of ``pkg/telemetry/``)."""
 
+from .engine_telemetry import (
+    EngineTelemetry,
+    EngineTelemetryConfig,
+    ProfileInProgress,
+    ProfilerCapture,
+)
 from .flight_recorder import (
     FlightRecorder,
     attach_failpoint_listener,
@@ -20,8 +26,12 @@ from .tracing import (
 )
 
 __all__ = [
+    "EngineTelemetry",
+    "EngineTelemetryConfig",
     "FlightRecorder",
     "InMemorySpanExporter",
+    "ProfileInProgress",
+    "ProfilerCapture",
     "attach_failpoint_listener",
     "current_traceparent",
     "flight_recorder",

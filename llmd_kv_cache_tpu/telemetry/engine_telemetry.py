@@ -1,0 +1,390 @@
+"""Engine data-plane telemetry: request lifecycle, KV-pool gauges, profiler.
+
+The serving engine (``models/engine.py``) is the half of the system the
+paper's latency claims rest on, and before this module it emitted nothing.
+``EngineTelemetry`` turns the engine's lifecycle into the three serving
+histograms operators actually watch — TTFT (enqueue to first token), ITL
+(inter-token latency), TPOT (time per output token) — plus KV-pool
+occupancy gauges and per-request flight-recorder events, without touching
+the step path's allocation budget (``bench.py --engine-telemetry`` asserts
+the per-step hook cost stays under 1% of the decode-step p50).
+
+Design constraints, in order:
+
+- **Allocation-light on the step path.** Hooks mutate a preallocated
+  ``_ReqState`` (``__slots__``), observe into :class:`BucketHistogram`
+  (one bisect + three stores), and scrape pool gauges only every
+  ``pool_gauge_every`` steps. No dicts are built per decode step.
+- **Config-driven buckets.** TTFT on a CPU dev loop and TTFT on a v5e pod
+  differ by two orders of magnitude; bucket bounds come from
+  :class:`EngineTelemetryConfig` (``engineTelemetry`` in config files),
+  not module constants.
+- **One trace from score to serve.** The engine itself creates spans
+  (gated on a request carrying a ``traceparent``); this module only keeps
+  the lifecycle clock. See ``docs/observability.md``.
+
+``ProfilerCapture`` wraps on-demand ``jax.profiler`` xplane captures for
+the admin endpoint's ``/debug/profile?duration_s=N`` (guarded: requires a
+configured ``profileDir``; one capture at a time).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..metrics import collector
+from ..utils.logging import get_logger
+from . import flight_recorder as fr
+
+logger = get_logger("engine_telemetry")
+
+# Default bucket bounds span CPU dev loops through TPU pods; deployments
+# with tighter SLOs override them via EngineTelemetryConfig.
+DEFAULT_TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+DEFAULT_ITL_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+DEFAULT_STEP_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0,
+)
+
+MAX_PROFILE_DURATION_S = 60.0
+
+
+class ProfileInProgress(RuntimeError):
+    """A jax.profiler capture is already running (admin maps this to 409)."""
+
+
+def _as_buckets(value, default: Tuple[float, ...]) -> Tuple[float, ...]:
+    if value is None:
+        return default
+    return tuple(float(v) for v in value)
+
+
+@dataclass
+class EngineTelemetryConfig:
+    """Knobs for the engine observability layer (``engineTelemetry``)."""
+
+    enabled: bool = True
+    ttft_buckets: Tuple[float, ...] = DEFAULT_TTFT_BUCKETS
+    itl_buckets: Tuple[float, ...] = DEFAULT_ITL_BUCKETS
+    tpot_buckets: Tuple[float, ...] = DEFAULT_ITL_BUCKETS
+    step_buckets: Tuple[float, ...] = DEFAULT_STEP_BUCKETS
+    # Pool gauges are scraped once every N steps: gauge label lookups are
+    # ~1us each and a tiny-model CPU decode step is sub-millisecond, so an
+    # every-step scrape alone could eat the 1% overhead budget.
+    pool_gauge_every: int = 16
+    # One flight-recorder record per request phase transition (admit,
+    # finish); decode steps never write to the ring.
+    flight_records: bool = True
+    # Directory for on-demand jax.profiler captures; empty disables the
+    # /debug/profile endpoint.
+    profile_dir: str = ""
+    # Ring of per-request lifecycle summaries kept for /debug/vars.
+    max_finished: int = 64
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "EngineTelemetryConfig":
+        if not d:
+            return cls()
+
+        def k(camel, snake, default):
+            return d.get(camel, d.get(snake, default))
+
+        return cls(
+            enabled=bool(k("enabled", "enabled", True)),
+            ttft_buckets=_as_buckets(
+                k("ttftBuckets", "ttft_buckets", None), DEFAULT_TTFT_BUCKETS),
+            itl_buckets=_as_buckets(
+                k("itlBuckets", "itl_buckets", None), DEFAULT_ITL_BUCKETS),
+            tpot_buckets=_as_buckets(
+                k("tpotBuckets", "tpot_buckets", None), DEFAULT_ITL_BUCKETS),
+            step_buckets=_as_buckets(
+                k("stepBuckets", "step_buckets", None), DEFAULT_STEP_BUCKETS),
+            pool_gauge_every=int(k("poolGaugeEvery", "pool_gauge_every", 16)),
+            flight_records=bool(k("flightRecords", "flight_records", True)),
+            profile_dir=str(k("profileDir", "profile_dir", "")),
+            max_finished=int(k("maxFinished", "max_finished", 64)),
+        )
+
+
+class _ReqState:
+    """Per-request lifecycle clock. Preallocated; mutated in place."""
+
+    __slots__ = (
+        "request_id", "traceparent", "enqueue_ts", "admit_ts",
+        "first_token_ts", "last_token_ts", "tokens", "prefix_hit_blocks",
+    )
+
+    def __init__(self, request_id: str, now: float, prefix_hit_blocks: int,
+                 traceparent: Optional[str]):
+        self.request_id = request_id
+        self.traceparent = traceparent
+        self.enqueue_ts = now
+        self.admit_ts: Optional[float] = None
+        self.first_token_ts: Optional[float] = None
+        self.last_token_ts: Optional[float] = None
+        self.tokens = 0
+        self.prefix_hit_blocks = prefix_hit_blocks
+
+    def summary(self, finish_ts: float, outcome: str) -> dict:
+        return {
+            "request_id": self.request_id,
+            "enqueue_ts": self.enqueue_ts,
+            "admit_ts": self.admit_ts,
+            "first_token_ts": self.first_token_ts,
+            "last_token_ts": self.last_token_ts,
+            "finish_ts": finish_ts,
+            "tokens": self.tokens,
+            "prefix_hit_blocks": self.prefix_hit_blocks,
+            "traced": self.traceparent is not None,
+            "outcome": outcome,
+        }
+
+
+class ProfilerCapture:
+    """On-demand ``jax.profiler`` xplane capture, one at a time."""
+
+    def __init__(self, profile_dir: str):
+        self.profile_dir = profile_dir
+        self._lock = threading.Lock()
+        self.last: Optional[dict] = None
+
+    def capture(self, duration_s: float = 1.0) -> dict:
+        """Run a blocking capture; returns ``{"dir", "duration_s", ...}``.
+
+        Raises ``ValueError`` on a bad duration, :class:`ProfileInProgress`
+        when a capture is already running, and ``RuntimeError`` when the
+        platform/profiler refuses (surfaced as HTTP 400/409/500 by
+        ``services/admin.py``).
+        """
+        duration_s = float(duration_s)
+        if not (0.0 < duration_s <= MAX_PROFILE_DURATION_S):
+            raise ValueError(
+                f"duration_s must be in (0, {MAX_PROFILE_DURATION_S}], "
+                f"got {duration_s}")
+        if not self.profile_dir:
+            raise RuntimeError("profiler capture disabled: no profileDir configured")
+        if not self._lock.acquire(blocking=False):
+            raise ProfileInProgress("a profiler capture is already running")
+        try:
+            import jax.profiler  # deferred: telemetry imports stay jax-free
+
+            os.makedirs(self.profile_dir, exist_ok=True)
+            started = time.time()
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                time.sleep(duration_s)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as exc:
+            collector.record_profile_capture("failure")
+            fr.record(fr.KIND_PROFILE, {"outcome": "failure", "error": str(exc)})
+            raise RuntimeError(f"jax.profiler capture failed: {exc}") from exc
+        finally:
+            self._lock.release()
+        self.last = {
+            "dir": self.profile_dir,
+            "duration_s": duration_s,
+            "started_ts": started,
+            "completed_ts": time.time(),
+        }
+        collector.record_profile_capture("success")
+        fr.record(fr.KIND_PROFILE, {"outcome": "success", "dir": self.profile_dir,
+                                    "duration_s": duration_s})
+        return dict(self.last)
+
+
+class EngineTelemetry:
+    """Request-lifecycle + KV-pool telemetry for one ``MiniEngine``.
+
+    Histograms are process-global (deduped by metric name), so several
+    engines in one process aggregate into the same families; per-request
+    state is per-instance. The engine calls the ``on_*`` hooks; everything
+    else (admin endpoint, kvdiag) reads :meth:`debug_vars`.
+    """
+
+    def __init__(self, config: Optional[EngineTelemetryConfig] = None,
+                 group: str = "0"):
+        self.cfg = config or EngineTelemetryConfig()
+        self.group = str(group)
+        self.ttft = collector.bucket_histogram(
+            "kvtpu_engine_ttft_seconds",
+            "Time from enqueue to first output token",
+            self.cfg.ttft_buckets)
+        self.itl = collector.bucket_histogram(
+            "kvtpu_engine_itl_seconds",
+            "Inter-token latency between decode emissions",
+            self.cfg.itl_buckets)
+        self.tpot = collector.bucket_histogram(
+            "kvtpu_engine_tpot_seconds",
+            "Time per output token after the first",
+            self.cfg.tpot_buckets)
+        self.step_seconds = collector.bucket_histogram(
+            "kvtpu_engine_decode_step_seconds",
+            "Engine step() wall time",
+            self.cfg.step_buckets)
+        self._requests: Dict[str, _ReqState] = {}
+        self.finished: deque = deque(maxlen=max(1, self.cfg.max_finished))
+        self._step_counter = 0
+        self._pool_stats: Dict[str, dict] = {}
+        self._pool_evictions_seen: Dict[str, int] = {}
+        self.profiler = ProfilerCapture(self.cfg.profile_dir)
+        # Label children resolved once; labels() does a dict lookup + tuple
+        # build per call, which the scrape path should not pay repeatedly.
+        self._gauge_cache: Dict[str, tuple] = {}
+
+    # -- lifecycle hooks (called by MiniEngine) ---------------------------
+
+    def on_admitted(self, request_id: str, prefix_hit_blocks: int,
+                    traceparent: Optional[str] = None) -> None:
+        now = time.monotonic()
+        self._requests[request_id] = _ReqState(
+            request_id, now, prefix_hit_blocks, traceparent)
+        if prefix_hit_blocks > 0:
+            collector.ENGINE_PREFIX_HIT_BLOCKS.inc(prefix_hit_blocks)
+        if self.cfg.flight_records:
+            fr.record(fr.KIND_ENGINE_REQUEST, {
+                "request_id": request_id, "phase": "admit",
+                "prefix_hit_blocks": prefix_hit_blocks})
+
+    def set_traceparent(self, request_id: str, traceparent: Optional[str]) -> None:
+        st = self._requests.get(request_id)
+        if st is not None:
+            st.traceparent = traceparent
+
+    def on_first_schedule(self, request_id: str) -> None:
+        st = self._requests.get(request_id)
+        if st is not None and st.admit_ts is None:
+            st.admit_ts = time.monotonic()
+
+    def on_first_token(self, request_id: str) -> None:
+        st = self._requests.get(request_id)
+        if st is None:
+            return
+        now = time.monotonic()
+        st.first_token_ts = now
+        st.last_token_ts = now
+        st.tokens = 1
+        if st.admit_ts is None:  # synchronous add_request path
+            st.admit_ts = st.enqueue_ts
+        self.ttft.observe(now - st.enqueue_ts)
+
+    def on_decode_tokens(self, request_id: str, n: int, now: float) -> None:
+        st = self._requests.get(request_id)
+        if st is None or n <= 0:
+            return
+        last = st.last_token_ts
+        if last is None:  # decode before a recorded first token: treat as first
+            st.first_token_ts = now
+            st.tokens = n
+            st.last_token_ts = now
+            return
+        gap = (now - last) / n
+        observe = self.itl.observe
+        for _ in range(n):
+            observe(gap)
+        st.tokens += n
+        st.last_token_ts = now
+
+    def on_finish(self, request_id: str, outcome: str = "finished") -> None:
+        st = self._requests.pop(request_id, None)
+        if st is None:
+            return
+        now = time.monotonic()
+        if st.tokens > 1 and st.first_token_ts is not None \
+                and st.last_token_ts is not None:
+            self.tpot.observe(
+                (st.last_token_ts - st.first_token_ts) / (st.tokens - 1))
+        collector.ENGINE_REQUESTS.labels(outcome).inc()
+        summary = st.summary(now, outcome)
+        self.finished.append(summary)
+        if self.cfg.flight_records:
+            fr.record(fr.KIND_ENGINE_REQUEST, {
+                "request_id": request_id, "phase": "finish",
+                "outcome": outcome, "tokens": st.tokens})
+
+    def on_step(self, duration_s: float, decoded: bool,
+                pools: Sequence[Tuple[str, Any]] = ()) -> None:
+        """Once per engine ``step()``: step timing + decimated pool scrape.
+
+        ``pools`` is ``[(group_name, block_manager), ...]``; each block
+        manager answers :meth:`~models.engine.BlockManager.pool_stats`.
+        """
+        self.step_seconds.observe(duration_s)
+        if decoded:
+            collector.ENGINE_DECODE_STEPS.inc()
+        self._step_counter += 1
+        if self._step_counter % max(1, self.cfg.pool_gauge_every) == 0:
+            self.scrape_pools(pools)
+
+    def scrape_pools(self, pools: Sequence[Tuple[str, Any]]) -> None:
+        for group, bm in pools:
+            stats = bm.pool_stats()
+            self._pool_stats[group] = stats
+            gauges = self._gauge_cache.get(group)
+            if gauges is None:
+                gauges = (
+                    collector.ENGINE_POOL_FREE_PAGES.labels(group),
+                    collector.ENGINE_POOL_CACHED_BLOCKS.labels(group),
+                    collector.ENGINE_POOL_ORPHAN_PAGES.labels(group),
+                )
+                self._gauge_cache[group] = gauges
+            free_g, cached_g, orphan_g = gauges
+            free_g.set(stats["free_pages"])
+            cached_g.set(stats["cached_blocks"])
+            orphan_g.set(stats["orphan_pages"])
+            seen = self._pool_evictions_seen.get(group, 0)
+            delta = stats["evictions"] - seen
+            if delta > 0:
+                collector.ENGINE_POOL_EVICTIONS.labels(group).inc(delta)
+                self._pool_evictions_seen[group] = stats["evictions"]
+
+    def on_restore(self, outcome: str, seconds: Optional[float] = None) -> None:
+        collector.record_engine_restore(outcome, seconds)
+
+    # -- read side --------------------------------------------------------
+
+    def _phase_stats(self, hist) -> dict:
+        return {
+            "count": hist.count,
+            "p50": hist.percentile(0.50),
+            "p90": hist.percentile(0.90),
+            "p99": hist.percentile(0.99),
+        }
+
+    def debug_vars(self) -> dict:
+        """The ``engine`` section of ``/debug/vars`` (and kvdiag)."""
+        return {
+            "group": self.group,
+            "pool": {g: dict(s) for g, s in self._pool_stats.items()},
+            "requests": {
+                "active": len(self._requests),
+                "finished_window": len(self.finished),
+                "recent": list(self.finished)[-8:],
+            },
+            "phases": {
+                "ttft_seconds": self._phase_stats(self.ttft),
+                "itl_seconds": self._phase_stats(self.itl),
+                "tpot_seconds": self._phase_stats(self.tpot),
+                "step_seconds": self._phase_stats(self.step_seconds),
+            },
+            "steps": self._step_counter,
+            "last_profile": self.profiler.last,
+        }
+
+    def attach_admin(self, server) -> None:
+        """Register the debug provider and (if configured) the profiler."""
+        server.register_debug("engine", self.debug_vars)
+        if self.cfg.profile_dir:
+            server.register_profiler(self.profiler.capture)
+
+    def active_requests(self) -> List[str]:
+        return list(self._requests)

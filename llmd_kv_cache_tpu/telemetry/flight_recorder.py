@@ -48,6 +48,8 @@ KIND_RECONNECT = "zmq_reconnect"
 KIND_RECOVERY = "recovery"
 KIND_DRAIN = "drain"
 KIND_OVERFLOW = "queue_overflow"
+KIND_ENGINE_REQUEST = "engine_request"
+KIND_PROFILE = "profile_capture"
 
 
 class FlightRecorder:

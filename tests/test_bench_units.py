@@ -143,7 +143,7 @@ class TestGuardedLadder:
     """The driver entry's fallback ladder: probe -> device TTFT -> CPU-env
     TTFT -> index micro-bench."""
 
-    def test_cpu_rung_strips_accelerator_env(self, monkeypatch, capsys):
+    def test_cpu_rung_strips_accelerator_env(self, monkeypatch):
         import bench
 
         calls = []
@@ -157,14 +157,14 @@ class TestGuardedLadder:
         monkeypatch.setattr(bench, "_accelerator_healthy", lambda: True)
         monkeypatch.setattr(bench, "_run_ttft_subprocess", fake_ttft)
         monkeypatch.setenv("PYTHONPATH", "/some/plugin")
-        bench.guarded_main()
-        assert capsys.readouterr().out.strip().startswith('{"metric"')
+        line = bench.guarded_main()
+        assert line.startswith('{"metric"')
         assert calls[0] is None  # device rung ran first
         cpu_env = calls[1]
         assert "PYTHONPATH" not in cpu_env
         assert cpu_env["JAX_PLATFORMS"] == "cpu"
 
-    def test_unhealthy_probe_skips_device_rung(self, monkeypatch, capsys):
+    def test_unhealthy_probe_skips_device_rung(self, monkeypatch):
         import bench
 
         calls = []
@@ -178,7 +178,7 @@ class TestGuardedLadder:
         bench.guarded_main()
         assert len(calls) == 1 and calls[0] is not None  # straight to CPU
 
-    def test_all_ttft_rungs_failing_falls_to_index_bench(self, monkeypatch, capsys):
+    def test_all_ttft_rungs_failing_falls_to_index_bench(self, monkeypatch):
         import json
 
         import bench
@@ -186,6 +186,5 @@ class TestGuardedLadder:
         monkeypatch.setattr(bench, "_accelerator_healthy", lambda: False)
         monkeypatch.setattr(bench, "_run_ttft_subprocess",
                             lambda env=None, timeout=900: None)
-        bench.guarded_main()
-        out = json.loads(capsys.readouterr().out.strip())
+        out = json.loads(bench.guarded_main())
         assert "value" in out and "vs_baseline" in out

@@ -50,15 +50,18 @@ def spawn(argv, **kw):
         stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, **kw)
 
 
-def start_pod(pod_id, control, store):
-    return spawn([
+def start_pod(pod_id, control, store, admin=False):
+    argv = [
         sys.executable, "examples/engine_pod_main.py",
         "--pod-id", pod_id,
         "--zmq-endpoint", f"tcp://127.0.0.1:{ZMQ_PORT}",
         "--control-dir", str(control),
         "--model-name", MODEL,
         "--offload-root", str(store),
-    ])
+    ]
+    if admin:
+        argv += ["--admin-port", "auto"]
+    return spawn(argv)
 
 
 def serve_on(control, pod_id, name, prompt, timeout=90.0):
@@ -90,7 +93,10 @@ class TestClusterTopology:
                 "--admin-port", str(ADMIN_PORT),
             ])
             for pod in ("pod-0", "pod-1", "pod-2"):
-                procs[pod] = start_pod(pod, control, store)
+                # pod-0 gets the admin endpoint so kvdiag's engine section
+                # can be exercised against a live serving pod below.
+                procs[pod] = start_pod(pod, control, store,
+                                       admin=(pod == "pod-0"))
             assert wait_until(
                 lambda: all((control / f"pod-{i}.ready").exists()
                             for i in range(3)),
@@ -138,6 +144,26 @@ class TestClusterTopology:
                 assert set(ledger["pods"]) & {"pod-0", "pod-1", "pod-2"}
                 assert any(name.startswith("kvcache_")
                            for name in report["metrics"])
+
+                # kvdiag against an ENGINE pod's admin endpoint: the
+                # report grows a top-level engine summary (KV-pool
+                # occupancy + request phase percentiles) fed by the
+                # telemetry layer, and the kvtpu_engine_* families are
+                # exposed on /metrics.
+                pod0_admin = int(
+                    (control / "pod-0.admin_port").read_text())
+                diag = subprocess.run(
+                    [sys.executable, "hack/kvdiag.py",
+                     "--port", str(pod0_admin)],
+                    cwd=str(REPO), capture_output=True, text=True, timeout=30)
+                assert diag.returncode == 0, diag.stderr
+                engine_report = json.loads(diag.stdout)
+                eng = engine_report["engine"]
+                assert eng["pool"]["full"]["total_pages"] > 0
+                assert eng["phases"]["ttft_seconds"]["count"] > 0
+                assert eng["requests"]["finished_window"] > 0
+                assert any(name.startswith("kvtpu_engine_")
+                           for name in engine_report["metrics"])
 
                 # Kill pod-1 mid-run (SIGKILL: crash, not graceful stop).
                 procs["pod-1"].kill()
