@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -62,6 +62,12 @@ bench-hotpath: native
 bench-engine-telemetry: native
 	$(CPU_ENV) $(PY) bench.py --engine-telemetry
 
+# Sharded control-plane gate (cluster/): scatter-gather score p99 over a
+# 4-shard gRPC fleet at 4x aggregate index size must stay within 1.15x of
+# the single-shard baseline (bench_shard_fanout).
+bench-shard: native
+	$(CPU_ENV) $(PY) bench.py --shards 4
+
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).
 verify-examples: native
@@ -72,6 +78,7 @@ verify-examples: native
 	$(CPU_ENV) $(PY) examples/serve_hf_checkpoint.py
 	$(CPU_ENV) $(PY) examples/redis_indexer.py
 	$(CPU_ENV) $(PY) examples/fp8_kv_serving.py
+	$(CPU_ENV) $(PY) examples/sharded_cluster_demo.py
 
 # Developer check on the CPU backend (the driver separately compile-checks
 # entry() on the real chip).

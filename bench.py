@@ -863,6 +863,139 @@ def bench_engine_telemetry() -> dict:
     }
 
 
+def bench_shard_fanout(shards: int = 4) -> dict:
+    """Sharded control-plane overhead gate (``--shards N``, ISSUE 6).
+
+    Two arms over the real gRPC wire on localhost, both scored through
+    :class:`~llmd_kv_cache_tpu.cluster.router.ShardRouter` so the only
+    variable is the fan-out width:
+
+    - **baseline** — a single indexer replica (N=1 ring: one LookupBlocks
+      RPC per score).
+    - **sharded** — ``shards`` replicas holding ``shards``× the baseline
+      index size in aggregate (ownership-filtered ingest, rf=2), scored by
+      consistent-hash scatter-gather.
+
+    Gate: sharded score p99 must stay within **1.15x** of the baseline —
+    parallel fan-out, the ring-plan cache, and chunk early exit must hide
+    the partitioning rather than tax the score hot path.
+
+    The workload is the long-context regime sharding exists for (256
+    blocks = 4096 tokens per prompt): each shard looks up and serializes
+    ~1/N of the keys in parallel, so the big single-response tail the
+    baseline pays is split across small messages. Fan-out runs as one
+    chunk (``fanoutChunkBlocks: 0``) because every query is a full hit —
+    chunked early exit only pays off on misses and has its own unit
+    tests (tests/test_cluster_sharding.py).
+    """
+    from llmd_kv_cache_tpu.cluster.config import ClusterConfig
+    from llmd_kv_cache_tpu.core import (
+        ChunkedTokenDatabase,
+        PodEntry,
+        TokenProcessorConfig,
+    )
+    from llmd_kv_cache_tpu.cluster import ShardRouter
+    from llmd_kv_cache_tpu.scoring.indexer import IndexerConfig
+    from llmd_kv_cache_tpu.services.indexer_service import (
+        IndexerService,
+        serve,
+    )
+
+    BLOCKS, BSZ = 256, 16  # 4096-token prompts: 256 blocks of 16
+    BASE_PROMPTS, QUERIES, WARMUP = 300, 200, 30
+    rng = np.random.default_rng(7)
+
+    def run_arm(n_shards: int, n_prompts: int, base_port: int) -> dict:
+        addrs = [f"127.0.0.1:{base_port + i}" for i in range(n_shards)]
+        rf = min(2, n_shards)
+        tp = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BSZ))
+        # Unique leading token → every prompt owns a distinct key chain.
+        prompts = [
+            [base_port + j * 131071] + list(range(1, BLOCKS * BSZ))
+            for j in range(n_prompts)
+        ]
+        services, servers = [], []
+        try:
+            for addr in addrs:
+                cc = None
+                if n_shards > 1:
+                    cc = ClusterConfig(
+                        shard_addresses=addrs, shard_id=addr,
+                        replication_factor=rf,
+                    )
+                svc = IndexerService(IndexerConfig(
+                    token_processor_config=TokenProcessorConfig(
+                        block_size_tokens=BSZ),
+                    cluster_config=cc,
+                ))
+                services.append(svc)
+                servers.append(serve(addr, svc))
+            # Broadcast ingest (the event stream every replica sees);
+            # ShardFilterIndex keeps each replica at owned keys only.
+            total_keys = 0
+            for j, prompt in enumerate(prompts):
+                keys = tp.tokens_to_kv_block_keys(0, prompt, MODEL_NAME)
+                pod = [PodEntry(pod_identifier=f"pod-{j % 8}",
+                                device_tier="gpu")]
+                for svc in services:
+                    (svc.shard_index or svc.indexer.kv_block_index).add(
+                        None, keys, pod)
+                total_keys += len(keys)
+            router = ShardRouter(
+                ClusterConfig(shard_addresses=addrs, replication_factor=rf,
+                              fanout_chunk_blocks=0),
+                token_processor_config=TokenProcessorConfig(
+                    block_size_tokens=BSZ),
+            )
+            try:
+                picks = rng.integers(n_prompts, size=QUERIES + WARMUP)
+                lat, rpcs = [], 0
+                for i, j in enumerate(picks):
+                    t0 = time.perf_counter()
+                    res = router.score(prompts[int(j)], MODEL_NAME)
+                    dt = time.perf_counter() - t0
+                    assert res.hit_blocks == BLOCKS and not res.degraded
+                    if i >= WARMUP:
+                        lat.append(dt)
+                        rpcs += res.rpcs
+                plan = router.debug_view()["plan_cache"]
+            finally:
+                router.close()
+            return {
+                "index_keys_total": total_keys,
+                # Owned (post-filter) writes per replica: shows the ring
+                # spreading the 4x population, ~rf/N of the keys each.
+                "per_replica_owned_keys": [
+                    svc.shard_index.owned_writes for svc in services
+                ] if n_shards > 1 else [total_keys],
+                "score_p50_us": round(
+                    statistics.median(lat) * 1e6, 1),
+                "score_p99_us": round(
+                    float(np.quantile(lat, 0.99)) * 1e6, 1),
+                "rpcs_per_score": round(rpcs / QUERIES, 2),
+                "plan_cache_hit_rate": round(
+                    plan["hits"] / max(plan["hits"] + plan["misses"], 1), 4),
+            }
+        finally:
+            for server in servers:
+                server.stop(grace=0)
+
+    baseline = run_arm(1, BASE_PROMPTS, 15930)
+    sharded = run_arm(shards, shards * BASE_PROMPTS, 15940)
+    ratio = sharded["score_p99_us"] / max(baseline["score_p99_us"], 1e-9)
+    return {
+        "metric": f"scatter-gather score p99 vs single shard "
+                  f"({shards} shards, {shards}x index size, rf=2)",
+        "value": round(ratio, 3),
+        "unit": "x single-shard p99",
+        "vs_baseline": 1.15,
+        "gate_ok": bool(ratio <= 1.15),
+        "shards": shards,
+        "baseline": baseline,
+        "sharded": sharded,
+    }
+
+
 def main(queued: bool = True) -> dict:
     """TTFT routing benchmark: service-time replay + open-loop QPS sweep.
 
@@ -1441,6 +1574,15 @@ def _dispatch(argv: list) -> object:
         return bench_snapshot_overhead()
     if "--engine-telemetry" in argv:
         return bench_engine_telemetry()
+    if "--shards" in argv:
+        i = argv.index("--shards")
+        n = 4
+        if i + 1 < len(argv):
+            try:
+                n = int(argv[i + 1])
+            except ValueError:
+                pass
+        return bench_shard_fanout(shards=n)
     return guarded_main()
 
 

@@ -9,12 +9,16 @@ an on-call engineer needs into a single JSON report on stdout:
                                  debug provider (per-pod event lag, the
                                  cache-efficiency ledger, engine telemetry, …)
 - ``/metrics`` (parsed)        — the ``kvcache_*`` / ``kv_offload_*`` /
-                                 ``kvtpu_engine_*`` Prometheus families as
-                                 name → samples
+                                 ``kvtpu_engine_*`` / ``kvtpu_shard_*``
+                                 Prometheus families as name → samples
 - ``engine`` (summary)         — when the target is an engine pod: KV-pool
                                  occupancy, request phase percentiles
                                  (TTFT/ITL/TPOT/step), and the last
                                  profiler-capture path
+- ``shard`` (summary)          — when the target is a shard replica of the
+                                 sharded control plane: shard identity,
+                                 owned/filtered write counters, and the
+                                 consistent-hash ring view
 
 Usage:
   python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
@@ -31,7 +35,7 @@ import sys
 import urllib.error
 import urllib.request
 
-METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_")
+METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -106,6 +110,22 @@ def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
             "phases": engine.get("phases", {}),
             "requests": engine.get("requests", {}),
             "last_profile": (engine.get("last_profile") or {}).get("dir"),
+        }
+
+    shard = report["debug"].get("shard") if isinstance(report["debug"], dict) else None
+    if isinstance(shard, dict):
+        # Shard replicas (cluster/ ShardFilterIndex debug provider): the
+        # identity + ring balance an on-call engineer checks before
+        # blaming the router for skewed or degraded scores.
+        ring = shard.get("ring") or {}
+        report["shard"] = {
+            "shard_id": shard.get("shard_id"),
+            "replication_factor": shard.get("replication_factor"),
+            "owned_writes": shard.get("owned_writes"),
+            "filtered_writes": shard.get("filtered_writes"),
+            "ring_members": ring.get("shards"),
+            "ring_version": ring.get("version"),
+            "ring_load": ring.get("load"),
         }
 
     return report

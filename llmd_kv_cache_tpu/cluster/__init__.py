@@ -1,0 +1,21 @@
+"""Sharded indexer control plane (docs/architecture.md "Sharded control
+plane"): consistent-hash partitioning of the block index across N
+indexer shard replicas, scatter-gather scoring, and replica failover."""
+
+from .config import ClusterConfig
+from .ring import HashRing, assignment_fingerprint, moved_partitions, plan_owners
+from .router import DegradedShardError, RouterScore, ShardRouter
+from .sharded_index import ShardedIndex, ShardFilterIndex
+
+__all__ = [
+    "ClusterConfig",
+    "DegradedShardError",
+    "HashRing",
+    "RouterScore",
+    "ShardRouter",
+    "ShardedIndex",
+    "ShardFilterIndex",
+    "assignment_fingerprint",
+    "moved_partitions",
+    "plan_owners",
+]

@@ -1,0 +1,186 @@
+"""Inter-shard RPC client + cross-replica anti-entropy digest source.
+
+:class:`ShardClient` speaks the msgpack-over-gRPC shard surface of
+``services.indexer_service`` (``LookupBlocks`` for scatter-gather,
+``ListPods``/``GetPodDigest``/``GetPodBlocks`` for repair), over the
+shared channel pool and under the same retry policy as scoring RPCs.
+
+:class:`RemoteShardDigestSource` lifts the PR 4 intra-process
+anti-entropy reconciler to inter-node repair: it implements the
+``recovery.reconcile.DigestSource`` protocol over the *other* replicas
+of a shard's key range. A restarted shard bootstraps from its own
+snapshot+journal, then reconciles against its peers — every key it owns
+with ``replication_factor >= 2`` has at least one other live owner, so
+the union of peer views (filtered to locally-owned keys) is the truth
+to converge to.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import msgpack
+
+from ..core.keys import BlockHash, PodEntry
+from ..recovery.reconcile import digest_from_blocks
+from ..resilience.policy import RetryPolicy
+from ..utils.logging import get_logger
+from .ring import HashRing
+
+logger = get_logger("cluster.remote")
+
+
+def entry_from_row(row: Sequence) -> PodEntry:
+    """Snapshot wire row ``[pod, tier, flags, group_idx]`` → PodEntry."""
+    return PodEntry(
+        pod_identifier=row[0],
+        device_tier=row[1],
+        speculative=bool(int(row[2]) & 1),
+        has_group=bool(int(row[2]) & 2),
+        group_idx=row[3],
+    )
+
+
+def _pack(d: dict) -> bytes:
+    return msgpack.packb(d, use_bin_type=True)
+
+
+def _unpack(b: bytes) -> dict:
+    return msgpack.unpackb(b, raw=False, strict_map_key=False)
+
+
+class ShardClient:
+    """Router/peer-side client for one indexer shard replica."""
+
+    def __init__(self, address: str, timeout_s: float = 2.0,
+                 retry_policy: Optional[RetryPolicy] = None):
+        # Deferred to call time elsewhere would hide config typos; the
+        # shared pool makes construction cheap enough to do eagerly.
+        from ..services import channel_pool
+        from ..services.indexer_service import DEFAULT_RPC_RETRY_POLICY, SERVICE_NAME
+
+        self.address = address
+        self._channel = channel_pool.acquire(address)
+        self._timeout = timeout_s
+        self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
+
+        def method(name: str):
+            return self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=_pack,
+                response_deserializer=_unpack,
+            )
+
+        self._lookup_blocks = method("LookupBlocks")
+        self._list_pods = method("ListPods")
+        self._pod_digest = method("GetPodDigest")
+        self._pod_blocks = method("GetPodBlocks")
+
+    def lookup_blocks(
+        self,
+        keys: Sequence[BlockHash],
+        pods: Optional[Sequence[str]] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """Raw lookup: ``{"hits": {key: [PodEntry,...]}, "degraded": bool,
+        "shard": str}``. Raises grpc.RpcError on transport failure (the
+        router's breaker/failover logic owns error handling)."""
+        from ..services.indexer_service import _call_rpc
+
+        resp = _call_rpc(
+            self._lookup_blocks,
+            {"keys": [int(k) for k in keys], "pods": list(pods or [])},
+            timeout if timeout is not None else self._timeout,
+            self.retry_policy,
+        )
+        hits: dict[BlockHash, list[PodEntry]] = {}
+        for key, rows in resp.get("hits", []):
+            hits[int(key)] = [entry_from_row(r) for r in rows]
+        return {
+            "hits": hits,
+            "degraded": bool(resp.get("degraded", False)),
+            "shard": resp.get("shard", "") or "",
+        }
+
+    def list_pods(self, timeout: Optional[float] = None) -> list[str]:
+        from ..services.indexer_service import _call_rpc
+
+        resp = _call_rpc(self._list_pods, {},
+                         timeout if timeout is not None else self._timeout,
+                         self.retry_policy)
+        return list(resp.get("pods", []))
+
+    def pod_digest(self, pod: str, timeout: Optional[float] = None) -> dict:
+        from ..services.indexer_service import _call_rpc
+
+        resp = _call_rpc(self._pod_digest, {"pod": pod},
+                         timeout if timeout is not None else self._timeout,
+                         self.retry_policy)
+        return {"count": int(resp.get("count", 0)),
+                "digest": int(resp.get("digest", 0))}
+
+    def pod_blocks(self, pod: str, timeout: Optional[float] = None) -> dict:
+        """``{request_key: {row_tuple, ...}}`` — the reconcile wire shape."""
+        from ..services.indexer_service import _call_rpc
+
+        resp = _call_rpc(self._pod_blocks, {"pod": pod},
+                         timeout if timeout is not None else self._timeout,
+                         self.retry_policy)
+        return {
+            int(key): {tuple(r) for r in rows}
+            for key, rows in resp.get("blocks", [])
+        }
+
+    def close(self) -> None:
+        from ..services import channel_pool
+
+        channel_pool.release(self.address)
+
+
+class RemoteShardDigestSource:
+    """``DigestSource`` over the union of a shard's replica peers.
+
+    ``blocks(pod)`` merges every reachable peer's advertised blocks,
+    filtered to the keys ``shard_id`` owns — exactly the set the local
+    index should converge to. ``digest(pod)`` is computed client-side
+    from that merged view (peers answer with their *own* key ranges, so
+    their server-side digests are not directly comparable); this trades
+    a full fetch per round for correctness, which is fine at repair
+    cadence. Unreachable peers are skipped — repair proceeds on the
+    replicas that are up.
+    """
+
+    def __init__(self, peers: Sequence[ShardClient], ring: HashRing,
+                 shard_id: str, replication_factor: int = 2):
+        self.peers = list(peers)
+        self.ring = ring
+        self.shard_id = shard_id
+        self.replication_factor = max(1, replication_factor)
+
+    def _owns(self, key: BlockHash) -> bool:
+        return self.shard_id in self.ring.owners(key, self.replication_factor)
+
+    def pods(self) -> list:
+        seen: set[str] = set()
+        for peer in self.peers:
+            try:
+                seen.update(peer.list_pods())
+            except Exception:  # lint: allow-swallow (dead peer; repair on the rest)
+                logger.warning("digest peer %s unreachable (ListPods)", peer.address)
+        return sorted(seen)
+
+    def blocks(self, pod: str) -> dict:
+        merged: dict = {}
+        for peer in self.peers:
+            try:
+                view = peer.pod_blocks(pod)
+            except Exception:  # lint: allow-swallow (dead peer; repair on the rest)
+                logger.warning("digest peer %s unreachable (GetPodBlocks)", peer.address)
+                continue
+            for key, rows in view.items():
+                if self._owns(key):
+                    merged.setdefault(key, set()).update(rows)
+        return merged
+
+    def digest(self, pod: str) -> dict:
+        return digest_from_blocks(self.blocks(pod))

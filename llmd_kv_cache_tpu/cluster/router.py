@@ -1,0 +1,355 @@
+"""Scatter-gather scoring router over the sharded indexer fleet.
+
+The scheduler-side counterpart of the shard replicas: tokens are
+content-addressed locally (same ``ChunkedTokenDatabase`` + prefix-key
+cache as an embedded indexer), block keys are partitioned by the
+consistent-hash ring, and ``LookupBlocks`` RPCs fan out per owning
+shard. Scoring then runs locally with the ordinary
+``LongestPrefixScorer`` over the merged hit map.
+
+Early exit generalizes PR 2's chunked lookup to cross-shard fan-out:
+keys are processed in chain order, ``fanoutChunkBlocks`` at a time, and
+fanning stops at the first chunk that breaks the longest-prefix chain —
+deep misses never pay cross-shard round trips.
+
+Failure policy lifts the PR 1 primitives to inter-node scope: every
+shard sits behind a :class:`~llmd_kv_cache_tpu.resilience.policy.
+CircuitBreaker`; a broken or unreachable shard is skipped, its keys
+retried on their replica owners (``replicationFactor``), and only if no
+owner is reachable are the keys served *degraded* — treated as index
+misses under ``degradedServeMode: skip`` (the default), so scoring
+never blocks on a dead shard.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..core.keys import BlockHash, PodEntry
+from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
+from ..resilience.policy import CircuitBreaker
+from ..scoring.scorer import KVBlockScorerConfig, create_scorer
+from ..telemetry import tracer
+from ..utils.logging import get_logger
+from ..utils.lru import LRUCache
+from .config import DEGRADED_SERVE_FAIL, ClusterConfig
+from .remote import ShardClient
+from .ring import HashRing
+
+logger = get_logger("cluster.router")
+
+
+class DegradedShardError(RuntimeError):
+    """Raised under ``degradedServeMode: fail`` when owners of some keys
+    are all unreachable."""
+
+    def __init__(self, shards: Sequence[str]):
+        super().__init__(f"shards unreachable: {sorted(shards)}")
+        self.shards = sorted(shards)
+
+
+@dataclass
+class RouterScore:
+    """One scatter-gather scoring result."""
+
+    scores: dict[str, float] = field(default_factory=dict)
+    # Unreachable shards whose keys no replica owner could serve either.
+    # Non-empty means the prefix view was incomplete and scores are a
+    # lower bound. A failed shard fully covered by replica failover is
+    # NOT listed (scores stayed exact).
+    degraded_shards: list[str] = field(default_factory=list)
+    # True when any serving shard was itself warming (post-restart) or
+    # any shard was skipped — routers should widen their fallback.
+    degraded: bool = False
+    # Fan-out accounting (bench/debug).
+    blocks: int = 0
+    hit_blocks: int = 0
+    rpcs: int = 0
+
+
+class ShardRouter:
+    """Client-side scatter-gather scorer for a sharded indexer fleet."""
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        token_processor_config: Optional[TokenProcessorConfig] = None,
+        scorer_config: Optional[KVBlockScorerConfig] = None,
+        clients: Optional[dict[str, ShardClient]] = None,
+    ):
+        if not config.enabled:
+            raise ValueError("ClusterConfig has no shardAddresses")
+        self.cfg = config
+        self.ring: HashRing = config.build_ring()
+        self.token_processor = ChunkedTokenDatabase(
+            token_processor_config or TokenProcessorConfig()
+        )
+        self.scorer = create_scorer(
+            scorer_config or KVBlockScorerConfig(),
+            block_size_tokens=self.token_processor.block_size,
+        )
+        members = config.membership()
+        self.clients = clients if clients is not None else {
+            sid: ShardClient(config.address_of(sid),
+                             timeout_s=config.fanout_timeout_s)
+            for sid in members
+        }
+        self.breakers = {
+            sid: CircuitBreaker(
+                target=f"shard:{sid}",
+                failure_threshold=config.breaker_failure_threshold,
+                reset_timeout_s=config.breaker_reset_timeout_s,
+            )
+            for sid in members
+        }
+        # Ring-plan prefix cache: block keys are chained FNV hashes, so
+        # keys[-1] fingerprints the entire chain — (ring version, chain
+        # length, last key) uniquely identifies the per-key owner plan at
+        # the same trust level as the token-processor's prefix-key cache.
+        self._plan_cache: Optional[LRUCache] = (
+            LRUCache(config.plan_cache_size) if config.plan_cache_size > 0 else None
+        )
+        self.plan_hits = 0
+        self.plan_misses = 0
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(members)),
+            thread_name_prefix="kvtpu-shard-fanout",
+        )
+        self._publish_ring_metrics()
+
+    # -- plan cache -------------------------------------------------------
+
+    def plan(self, keys: Sequence[BlockHash]) -> tuple[str, ...]:
+        """Primary owner per key, via the chained-fingerprint plan cache."""
+        if not keys:
+            return ()
+        cache = self._plan_cache
+        if cache is None:
+            return tuple(self.ring.owner(k) for k in keys)
+        cache_key = (self.ring.version, len(keys), keys[-1])
+        plan = cache.get(cache_key)
+        hit = plan is not None
+        if hit:
+            self.plan_hits += 1
+        else:
+            self.plan_misses += 1
+            plan = tuple(self.ring.owner(k) for k in keys)
+            cache.add(cache_key, plan)
+        try:
+            from ..metrics.collector import record_shard_plan_cache
+
+            record_shard_plan_cache(hit)
+        except Exception:  # pragma: no cover - metrics must never break scoring  # lint: allow-swallow
+            pass
+        return plan
+
+    # -- fan-out ----------------------------------------------------------
+
+    def _shard_rpc(
+        self, shard: str, keys: list[BlockHash], pods: Optional[Sequence[str]]
+    ) -> dict:
+        """One breaker-guarded LookupBlocks against one shard."""
+        breaker = self.breakers[shard]
+        if not breaker.allow():
+            self._record_rpc(shard, "skipped")
+            raise ConnectionError(f"breaker open for shard {shard}")
+        try:
+            res = self.clients[shard].lookup_blocks(
+                keys, pods, timeout=self.cfg.fanout_timeout_s
+            )
+        except Exception:
+            breaker.record_failure()
+            self._record_rpc(shard, "failure")
+            raise
+        breaker.record_success()
+        self._record_rpc(shard, "success")
+        return res
+
+    def _fanout_chunk(
+        self,
+        keys: Sequence[BlockHash],
+        pods: Optional[Sequence[str]],
+        plan: Sequence[str],
+        stats: RouterScore,
+    ) -> dict[BlockHash, list[PodEntry]]:
+        """Scatter one chunk across its owning shards, failing keys over
+        to replica owners; returns the merged hit map."""
+        remaining: dict[str, list[BlockHash]] = {}
+        for key, owner in zip(keys, plan):
+            remaining.setdefault(owner, []).append(key)
+
+        merged: dict[BlockHash, list[PodEntry]] = {}
+        excluded: set[str] = set()
+        dropped = False
+        for _attempt in range(max(1, self.cfg.replication_factor)):
+            if not remaining:
+                break
+            futures = {
+                shard: self._executor.submit(
+                    self._shard_rpc, shard, skeys, pods
+                )
+                for shard, skeys in remaining.items()
+            }
+            stats.rpcs += len(futures)
+            failed: dict[str, list[BlockHash]] = {}
+            for shard, fut in futures.items():
+                try:
+                    res = fut.result(timeout=self.cfg.fanout_timeout_s * 2)
+                except Exception:
+                    failed[shard] = remaining[shard]
+                    continue
+                merged.update(res["hits"])
+                if res["degraded"]:
+                    stats.degraded = True
+            if not failed:
+                remaining = {}
+                break
+            excluded.update(failed)
+            # Re-route each failed shard's keys to their next distinct
+            # owner; keys whose owners are all excluded go unserved.
+            remaining = {}
+            dead_keys = 0
+            for skeys in failed.values():
+                for key in skeys:
+                    nxt = next(
+                        (s for s in self.ring.owners(
+                            key, self.cfg.replication_factor)
+                         if s not in excluded),
+                        None,
+                    )
+                    if nxt is None:
+                        dead_keys += 1
+                    else:
+                        remaining.setdefault(nxt, []).append(key)
+            if dead_keys:
+                dropped = True
+                break
+        # A failed shard whose keys a replica fully served does NOT
+        # degrade the result (scores are exact; the failure still shows
+        # in breaker state and kvtpu_shard_rpcs_total). Only keys no
+        # reachable owner could serve make scores a lower bound.
+        if remaining:
+            dropped = True
+        if dropped and excluded:
+            stats.degraded = True
+            stats.degraded_shards = sorted(
+                set(stats.degraded_shards) | excluded
+            )
+            self._record_degraded(len(excluded))
+        return merged
+
+    # -- scoring ----------------------------------------------------------
+
+    def score(
+        self,
+        tokens: Sequence[int],
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+    ) -> RouterScore:
+        """Scatter-gather GetPodScores: returns scores plus degradation
+        detail (shard metadata mirrors the ScoreResponse wire fields)."""
+        started = time.perf_counter()
+        result = RouterScore()
+        with tracer().span(
+            "llm_d.kv_cache.cluster.fanout",
+            model=model_name,
+            token_count=len(tokens),
+            shard_count=len(self.ring.shards),
+        ) as span:
+            keys = self.token_processor.tokens_to_kv_block_keys(
+                0, list(tokens), model_name
+            )
+            result.blocks = len(keys)
+            if not keys:
+                return result
+            plan = self.plan(keys)
+            merged: dict[BlockHash, list[PodEntry]] = {}
+            chunk = self.cfg.fanout_chunk_blocks
+            if chunk <= 0:
+                chunk = len(keys)
+            for start in range(0, len(keys), chunk):
+                ckeys = keys[start:start + chunk]
+                found = self._fanout_chunk(
+                    ckeys, pod_identifiers, plan[start:start + chunk], result
+                )
+                if not found:
+                    break
+                merged.update(found)
+                # Same soundness argument as Index.lookup_chunked: a
+                # partial chunk proves the consecutive-from-0 run ended
+                # inside it, so later chunks cannot change any score.
+                if len(found) < len(ckeys):
+                    break
+            if result.degraded_shards and (
+                self.cfg.degraded_serve_mode == DEGRADED_SERVE_FAIL
+            ):
+                raise DegradedShardError(result.degraded_shards)
+            result.hit_blocks = len(merged)
+            result.scores = self.scorer.score(keys, merged)
+            span.set_attribute("block_count", len(keys))
+            span.set_attribute("block_hit_count", len(merged))
+            span.set_attribute("rpcs", result.rpcs)
+            span.set_attribute("degraded_shards", len(result.degraded_shards))
+        self._record_fanout(time.perf_counter() - started)
+        return result
+
+    def get_pod_scores(
+        self,
+        tokens: Sequence[int],
+        model_name: str,
+        pod_identifiers: Optional[Sequence[str]] = None,
+    ) -> dict[str, float]:
+        return self.score(tokens, model_name, pod_identifiers).scores
+
+    # -- telemetry --------------------------------------------------------
+
+    def _record_rpc(self, shard: str, outcome: str) -> None:
+        try:
+            from ..metrics.collector import record_shard_rpc
+
+            record_shard_rpc(shard, outcome)
+        except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
+            pass
+
+    def _record_degraded(self, shards: int) -> None:
+        try:
+            from ..metrics.collector import record_shard_degraded_lookup
+
+            record_shard_degraded_lookup(shards)
+        except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
+            pass
+
+    def _record_fanout(self, seconds: float) -> None:
+        try:
+            from ..metrics.collector import record_shard_fanout
+
+            record_shard_fanout(seconds)
+        except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
+            pass
+
+    def _publish_ring_metrics(self) -> None:
+        try:
+            from ..metrics.collector import record_ring_load
+
+            record_ring_load(self.ring.load())
+        except Exception:  # pragma: no cover - metrics must never break startup  # lint: allow-swallow
+            pass
+
+    def debug_view(self) -> dict:
+        return {
+            "ring": self.ring.describe(),
+            "breakers": {s: b.state for s, b in self.breakers.items()},
+            "plan_cache": {
+                "hits": self.plan_hits,
+                "misses": self.plan_misses,
+                "size": len(self._plan_cache) if self._plan_cache else 0,
+            },
+        }
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False)
+        for client in self.clients.values():
+            client.close()

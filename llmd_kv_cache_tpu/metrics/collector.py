@@ -495,6 +495,61 @@ def record_profile_capture(outcome: str) -> None:
     ENGINE_PROFILE_CAPTURES.labels(outcome).inc()
 
 
+# --------------------------------------------------------------------------
+# Sharded control-plane families (kvtpu_shard_*): the scatter-gather
+# router's fan-out latency, per-shard RPC outcomes, degraded lookups,
+# the consistent-hash ring's primary-partition balance, and the ring-plan
+# prefix cache (docs/architecture.md "Sharded control plane").
+# --------------------------------------------------------------------------
+
+SHARD_FANOUT_LATENCY = Histogram(
+    "kvtpu_shard_fanout_latency_seconds",
+    "Scatter-gather score latency (keys to merged scores, all shards)",
+    buckets=(1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0),
+)
+SHARD_RPCS = Counter(
+    "kvtpu_shard_rpcs_total",
+    "LookupBlocks RPCs issued by the router, per shard and outcome",
+    ["shard", "outcome"],  # outcome: success|failure|skipped (breaker open)
+)
+SHARD_DEGRADED_LOOKUPS = Counter(
+    "kvtpu_shard_degraded_lookups_total",
+    "Score calls that served with at least one unreachable shard",
+)
+SHARD_RING_PARTITIONS = Gauge(
+    "kvtpu_shard_ring_partitions",
+    "Primary partitions assigned per shard by the consistent-hash ring",
+    ["shard"],
+)
+SHARD_PLAN_CACHE = Counter(
+    "kvtpu_shard_plan_cache_total",
+    "Ring-plan prefix-cache lookups by outcome",
+    ["outcome"],  # hit|miss
+)
+
+
+def record_shard_fanout(seconds: float) -> None:
+    SHARD_FANOUT_LATENCY.observe(max(seconds, 0.0))
+
+
+def record_shard_rpc(shard: str, outcome: str) -> None:
+    SHARD_RPCS.labels(shard, outcome).inc()
+
+
+def record_shard_degraded_lookup(shards: int) -> None:
+    if shards > 0:
+        SHARD_DEGRADED_LOOKUPS.inc()
+
+
+def record_shard_plan_cache(hit: bool) -> None:
+    SHARD_PLAN_CACHE.labels("hit" if hit else "miss").inc()
+
+
+def record_ring_load(load: Dict[str, int]) -> None:
+    for shard, partitions in load.items():
+        SHARD_RING_PARTITIONS.labels(shard).set(partitions)
+
+
 _beat_thread: Optional[threading.Thread] = None
 _beat_stop = threading.Event()
 

@@ -121,6 +121,10 @@ class IndexerConfig:
     # Crash-tolerant state (recovery/): None or snapshot_dir="" disables
     # snapshots, journaled warm restart, and the warmup readiness gate.
     recovery_config: Optional["RecoveryConfig"] = None
+    # Sharded control plane (cluster/): None disables. With shardId set,
+    # a service built from this config ingests as one shard replica
+    # (ShardFilterIndex); routers use the same config to fan out.
+    cluster_config: Optional["ClusterConfig"] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
@@ -145,6 +149,11 @@ class IndexerConfig:
             from ..recovery.config import RecoveryConfig
 
             cfg.recovery_config = RecoveryConfig.from_dict(recovery_dict)
+        cluster_dict = d.get("clusterConfig", d.get("cluster_config"))
+        if cluster_dict:
+            from ..cluster.config import ClusterConfig
+
+            cfg.cluster_config = ClusterConfig.from_dict(cluster_dict)
         index_dict = d.get("kvBlockIndexConfig", d.get("index_config"))
         if index_dict:
             from ..index.cost_aware import CostAwareMemoryIndexConfig

@@ -1,0 +1,64 @@
+"""Process-wide shared gRPC channel pool.
+
+Every client used to open its own ``grpc.insecure_channel`` per
+construction — harmless for one scheduler talking to one indexer, but
+the sharded scatter-gather path constructs a client per shard (and
+benches/tests construct many), so per-construction channels meant
+per-construction TCP+HTTP/2 setup on the hot path. Channels are safe to
+share across threads and multiplex RPCs, so the pool hands out one
+refcounted channel per normalized target.
+
+``acquire`` / ``release`` pair with client construction / ``close()``;
+the underlying channel closes when its last user releases it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from ..utils.logging import get_logger
+from ..utils.net import grpc_target
+
+logger = get_logger("services.channel_pool")
+
+_lock = threading.Lock()
+_channels: dict[str, tuple[grpc.Channel, int]] = {}
+
+
+def acquire(address: str) -> grpc.Channel:
+    """Shared insecure channel for ``address`` (refcount +1)."""
+    target = grpc_target(address)
+    with _lock:
+        entry = _channels.get(target)
+        if entry is not None:
+            channel, refs = entry
+            _channels[target] = (channel, refs + 1)
+            return channel
+        channel = grpc.insecure_channel(target)
+        _channels[target] = (channel, 1)
+        return channel
+
+
+def release(address: str) -> None:
+    """Refcount -1; closes the channel when the last user releases.
+
+    Releasing an unknown target is a no-op (idempotent ``close()``)."""
+    target = grpc_target(address)
+    with _lock:
+        entry = _channels.get(target)
+        if entry is None:
+            return
+        channel, refs = entry
+        if refs > 1:
+            _channels[target] = (channel, refs - 1)
+            return
+        del _channels[target]
+    channel.close()
+
+
+def stats() -> dict:
+    """{target: refcount} snapshot (debug surface, tests)."""
+    with _lock:
+        return {t: refs for t, (_, refs) in _channels.items()}

@@ -34,13 +34,20 @@ from ..events.subscriber_manager import SubscriberManager
 from ..events.zmq_subscriber import ZMQSubscriber
 from ..recovery.drain import DrainCoordinator
 from ..recovery.manager import RecoveryManager
-from ..recovery.reconcile import AntiEntropyReconciler, DigestSource
+from ..recovery.reconcile import (
+    AntiEntropyReconciler,
+    DigestSource,
+    IndexDigestSource,
+    digest_from_blocks,
+    pod_blocks_from_state,
+)
 from ..resilience.failpoints import FaultInjected, failpoints
 from ..resilience.policy import RetryExhausted, RetryPolicy, call_with_retry
 from ..scoring.indexer import Indexer, IndexerConfig
 from ..telemetry import attach_failpoint_listener, current_traceparent, tracer
 from ..utils.logging import get_logger
 from ..utils.net import grpc_target
+from . import channel_pool
 from .admin import AdminServer, start_observability_servers
 from .tokenizer.service import extract_traceparent
 
@@ -98,11 +105,35 @@ def _call_rpc(rpc, request, timeout: float, policy: RetryPolicy):
         raise e.__cause__
 
 
+def _pack_dict(d: dict) -> bytes:
+    return msgpack.packb(d, use_bin_type=True)
+
+
+def _unpack_dict(b: bytes) -> dict:
+    return msgpack.unpackb(b, raw=False, strict_map_key=False)
+
+
+def _row_from_entry(e) -> list:
+    """PodEntry → snapshot wire row ``[pod, tier, flags, group_idx]``
+    (the dump_state/journal layout; cluster.remote.entry_from_row is the
+    inverse)."""
+    return [
+        e.pod_identifier,
+        e.device_tier,
+        (1 if e.speculative else 0) | (2 if e.has_group else 0),
+        e.group_idx,
+    ]
+
+
 @dataclass
 class ScoreRequest:
     tokens: list[int]
     model_name: str
     pod_identifiers: list[str] = field(default_factory=list)
+    # Shard metadata (cluster/): the sender's intended owner shard id for
+    # a shard-targeted request, "" for an unsharded call. Tolerant like
+    # ``traceparent``: old peers omit it, old servers ignore it.
+    shard: str = ""
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
@@ -110,6 +141,7 @@ class ScoreRequest:
                 "tokens": self.tokens,
                 "model_name": self.model_name,
                 "pod_identifiers": self.pod_identifiers,
+                "shard": self.shard,
             },
             use_bin_type=True,
         )
@@ -121,6 +153,7 @@ class ScoreRequest:
             tokens=list(d.get("tokens", [])),
             model_name=d.get("model_name", ""),
             pod_identifiers=list(d.get("pod_identifiers", [])),
+            shard=d.get("shard", "") or "",
         )
 
 
@@ -139,11 +172,19 @@ class ScoreResponse:
     # join the scorer's trace — one trace covers score→serve. Empty when
     # tracing is off; absent on the wire from older servers.
     traceparent: str = ""
+    # Shard metadata (cluster/): the answering replica's shard id ("" for
+    # an unsharded indexer) and the shards a router could not reach while
+    # assembling these scores (scores are a lower bound when non-empty).
+    # Both follow the ``traceparent`` tolerance pattern — absent on the
+    # wire from older peers, ignored by them on receive.
+    shard: str = ""
+    degraded_shards: list[str] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
             {"scores": self.scores, "error": self.error,
-             "degraded": self.degraded, "traceparent": self.traceparent},
+             "degraded": self.degraded, "traceparent": self.traceparent,
+             "shard": self.shard, "degraded_shards": self.degraded_shards},
             use_bin_type=True,
         )
 
@@ -155,6 +196,8 @@ class ScoreResponse:
             error=d.get("error", ""),
             degraded=bool(d.get("degraded", False)),
             traceparent=d.get("traceparent", "") or "",
+            shard=d.get("shard", "") or "",
+            degraded_shards=[str(s) for s in d.get("degraded_shards", [])],
         )
 
 
@@ -173,8 +216,27 @@ class IndexerService:
         self.indexer = Indexer(indexer_config)
         self.tokenize = tokenize
         self.pool_config = pool_config or PoolConfig()
+        # Sharded control plane (cluster/): when this replica has a shard
+        # identity, ingestion goes through a ShardFilterIndex so the full
+        # broadcast event stream is filtered to the keys this shard owns.
+        # Scoring/lookup still read the inner index directly (the filter
+        # only gates writes), and snapshots/journal/recovery see only
+        # owned state, so a restart rebuilds exactly this shard's range.
+        self.shard_index = None
+        cc = self.indexer.config.cluster_config
+        if cc is not None and cc.enabled and cc.shard_id:
+            from ..cluster.sharded_index import ShardFilterIndex
+
+            self.shard_index = ShardFilterIndex(
+                self.indexer.kv_block_index,
+                cc.build_ring(),
+                cc.shard_id,
+                replication_factor=cc.replication_factor,
+            )
         self.pool = Pool(
-            self.pool_config, self.indexer.kv_block_index, self.indexer.token_processor
+            self.pool_config,
+            self.shard_index or self.indexer.kv_block_index,
+            self.indexer.token_processor,
         )
         self.subscriber_manager = SubscriberManager(
             self.pool.add_task, topic_filter=self.pool_config.topic_filter
@@ -203,6 +265,38 @@ class IndexerService:
             )
         self._reconciler: Optional[AntiEntropyReconciler] = None
         self._drain_coordinator: Optional[DrainCoordinator] = None
+
+    @property
+    def shard_id(self) -> str:
+        """This replica's shard identity; "" for an unsharded indexer."""
+        cc = self.indexer.config.cluster_config
+        return cc.shard_id if cc is not None else ""
+
+    def attach_peer_digest_source(self) -> None:
+        """Cross-replica anti-entropy: reconcile the locally-owned key
+        range against the union of the other replicas' advertised views
+        (cluster.remote.RemoteShardDigestSource). A restarted shard calls
+        this after snapshot bootstrap so residual event loss converges."""
+        cc = self.indexer.config.cluster_config
+        if cc is None or not cc.enabled or not cc.shard_id:
+            raise RuntimeError(
+                "peer reconciliation needs clusterConfig.shardId"
+            )
+        from ..cluster.remote import RemoteShardDigestSource, ShardClient
+
+        peers = [
+            ShardClient(cc.address_of(sid), timeout_s=cc.fanout_timeout_s)
+            for sid in cc.membership()
+            if sid != cc.shard_id
+        ]
+        self.attach_digest_source(
+            RemoteShardDigestSource(
+                peers,
+                cc.build_ring(),
+                cc.shard_id,
+                replication_factor=cc.replication_factor,
+            )
+        )
 
     def attach_digest_source(self, source: DigestSource) -> None:
         """Enable anti-entropy reconciliation against ``source`` (a pod's
@@ -246,6 +340,8 @@ class IndexerService:
             "lag": self.pool.lag_stats,
             "ledger": self.indexer.ledger.snapshot,
         }
+        if self.shard_index is not None:
+            providers["shard"] = self.shard_index.debug_view
         health = None
         if self.recovery is not None:
             self.recovery.start()
@@ -350,10 +446,61 @@ class IndexerService:
                 # span's traceparent so the chosen engine's spans join the
                 # trace ("" when no tracer is active).
                 return ScoreResponse(scores=scores, degraded=degraded,
-                                     traceparent=current_traceparent() or "")
+                                     traceparent=current_traceparent() or "",
+                                     shard=self.shard_id)
             except Exception as e:
                 logger.exception("GetPodScores failed")
                 return ScoreResponse(error=str(e))
+
+    # -- shard surface (cluster/) --
+    #
+    # Raw dict-in/dict-out msgpack RPCs the scatter-gather router and
+    # replica peers speak. Lookup answers from the local index only (the
+    # caller owns routing/merging); the repair trio exposes the same
+    # digest-first views IndexDigestSource derives from ``dump_state``.
+
+    def lookup_blocks_rpc(self, req: dict, context=None) -> dict:
+        keys = [int(k) for k in req.get("keys", [])]
+        pods = req.get("pods") or []
+        with tracer().span(
+            "llm_d.kv_cache.indexer.LookupBlocks",
+            parent_traceparent=extract_traceparent(context),
+            keys=len(keys),
+        ):
+            hits: list = []
+            if keys:
+                found = self.indexer.kv_block_index.lookup(
+                    keys, set(pods) if pods else None
+                )
+                hits = [
+                    [int(k), [_row_from_entry(e) for e in entries]]
+                    for k, entries in found.items()
+                ]
+            degraded = self.recovery is not None and not self.recovery.ready
+            return {"hits": hits, "degraded": degraded, "shard": self.shard_id}
+
+    def list_pods_rpc(self, req: dict, context=None) -> dict:
+        return {
+            "pods": IndexDigestSource(self.indexer.kv_block_index).pods(),
+            "shard": self.shard_id,
+        }
+
+    def pod_digest_rpc(self, req: dict, context=None) -> dict:
+        state = self.indexer.kv_block_index.dump_state()
+        d = digest_from_blocks(pod_blocks_from_state(state, req.get("pod", "")))
+        d["shard"] = self.shard_id
+        return d
+
+    def pod_blocks_rpc(self, req: dict, context=None) -> dict:
+        state = self.indexer.kv_block_index.dump_state()
+        blocks = pod_blocks_from_state(state, req.get("pod", ""))
+        return {
+            "blocks": [
+                [int(k), [list(r) for r in sorted(rows)]]
+                for k, rows in blocks.items()
+            ],
+            "shard": self.shard_id,
+        }
 
     def get_pod_scores_pb(self, req, ctx):
         """Protobuf surface: prompt in, tokenize server-side, score.
@@ -400,6 +547,13 @@ def serve(
 ) -> grpc.Server:
     """Serve GetPodScores on ``address`` (host:port or unix:path), on both
     the msgpack (token IDs) and protobuf (prompt) wires."""
+    def _dict_handler(method):
+        return grpc.unary_unary_rpc_method_handler(
+            method,
+            request_deserializer=_unpack_dict,
+            response_serializer=_pack_dict,
+        )
+
     handler = grpc.method_handlers_generic_handler(
         SERVICE_NAME,
         {
@@ -407,7 +561,21 @@ def serve(
                 lambda req, ctx: service.get_pod_scores(req, ctx),
                 request_deserializer=ScoreRequest.from_bytes,
                 response_serializer=lambda r: r.to_bytes(),
-            )
+            ),
+            # Shard surface (cluster/): scatter-gather lookup + the
+            # anti-entropy repair trio, all raw msgpack dicts.
+            "LookupBlocks": _dict_handler(
+                lambda req, ctx: service.lookup_blocks_rpc(req, ctx)
+            ),
+            "ListPods": _dict_handler(
+                lambda req, ctx: service.list_pods_rpc(req, ctx)
+            ),
+            "GetPodDigest": _dict_handler(
+                lambda req, ctx: service.pod_digest_rpc(req, ctx)
+            ),
+            "GetPodBlocks": _dict_handler(
+                lambda req, ctx: service.pod_blocks_rpc(req, ctx)
+            ),
         },
     )
     from .indexerpb import indexer_pb2
@@ -435,7 +603,11 @@ class IndexerServiceClient:
 
     def __init__(self, address: str, timeout_s: float = 5.0,
                  retry_policy: Optional[RetryPolicy] = None):
-        self._channel = grpc.insecure_channel(grpc_target(address))
+        # Shared refcounted channel (services.channel_pool): constructing
+        # many clients against the same indexer no longer pays per-client
+        # TCP+HTTP/2 setup.
+        self.address = address
+        self._channel = channel_pool.acquire(address)
         self._timeout = timeout_s
         self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
         self._get_pod_scores = self._channel.unary_unary(
@@ -477,7 +649,7 @@ class IndexerServiceClient:
         return resp
 
     def close(self) -> None:
-        self._channel.close()
+        channel_pool.release(self.address)
 
 
 class IndexerPbClient:
@@ -494,7 +666,8 @@ class IndexerPbClient:
         from .indexerpb import indexer_pb2
 
         self._pb = indexer_pb2
-        self._channel = grpc.insecure_channel(grpc_target(address))
+        self.address = address
+        self._channel = channel_pool.acquire(address)
         self._timeout = timeout_s
         self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
         self._get_pod_scores = self._channel.unary_unary(
@@ -522,4 +695,4 @@ class IndexerPbClient:
         return {s.pod: s.score for s in resp.scores}
 
     def close(self) -> None:
-        self._channel.close()
+        channel_pool.release(self.address)

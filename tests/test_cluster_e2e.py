@@ -9,7 +9,12 @@ Scores are read over the gRPC wire; one pod is SIGKILLed mid-run and a
 replacement restores a previously-served prefix bit-exactly from the
 shared storage tier.
 
-Marked slow: three subprocess engine inits (~15 s each on first jit).
+``TestClusterTopology`` is marked slow (three subprocess engine inits,
+~15 s each on first jit). ``TestShardedClusterE2E`` is the fast tier-1
+counterpart for the sharded control plane: four in-process indexer shard
+replicas behind real gRPC servers, scatter-gather scoring through
+``ShardRouter``, one shard killed mid-run with zero scoring outage, then
+rejoined via snapshot bootstrap + cross-replica anti-entropy.
 """
 
 import json
@@ -21,8 +26,6 @@ import sys
 import time
 
 import pytest
-
-pytestmark = pytest.mark.slow
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 MODEL = "tiny"
@@ -77,6 +80,7 @@ def serve_on(control, pod_id, name, prompt, timeout=90.0):
     return json.loads(out.read_text())["output"]
 
 
+@pytest.mark.slow
 class TestClusterTopology:
     def test_cluster_scores_converge_and_survive_pod_restart(self, tmp_path):
         control = tmp_path / "ctl"
@@ -221,3 +225,179 @@ class TestClusterTopology:
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+
+
+SHARD_PORTS = range(15920, 15924)  # clear of the slow-test ports above
+MODEL = "m"
+BLOCK = 4
+
+
+class TestShardedClusterE2E:
+    """Fast 4-shard toy cluster: in-process replicas, real gRPC wire.
+
+    Acceptance shape from the ISSUE: kill one shard with zero scoring
+    outage (replica failover keeps scores exact, not merely degraded),
+    then rejoin it via snapshot bootstrap and converge the event loss
+    through peer anti-entropy.
+    """
+
+    def _make_service(self, addr, addrs, snap_root):
+        from llmd_kv_cache_tpu.cluster.config import ClusterConfig
+        from llmd_kv_cache_tpu.core import TokenProcessorConfig
+        from llmd_kv_cache_tpu.events import PoolConfig
+        from llmd_kv_cache_tpu.recovery import RecoveryConfig
+        from llmd_kv_cache_tpu.scoring.indexer import IndexerConfig
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerService,
+            serve,
+        )
+
+        cfg = IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK),
+            recovery_config=RecoveryConfig(
+                snapshot_dir=str(snap_root / addr.replace(":", "_")),
+                snapshot_interval_s=0.0,  # manual snapshots only
+                warmup_staleness_bound_s=1e9,  # no warmup gate in-test
+            ),
+            cluster_config=ClusterConfig(
+                shard_addresses=list(addrs),
+                shard_id=addr,
+                replication_factor=2,
+                breaker_reset_timeout_s=0.2,
+            ),
+        )
+        svc = IndexerService(cfg, PoolConfig(concurrency=1))
+        svc.start()
+        return svc, serve(addr, svc)
+
+    def _ingest(self, services, pod, tokens, engine_base):
+        """Broadcast one root-parent BlockStored batch to every replica's
+        pool (the full-stream broadcast each ShardFilterIndex filters)."""
+        from llmd_kv_cache_tpu.events.model import BlockStoredEvent, EventBatch
+
+        n = len(tokens) // BLOCK
+        batch = EventBatch(
+            timestamp=time.time(),
+            events=[BlockStoredEvent(
+                block_hashes=list(range(engine_base, engine_base + n)),
+                tokens=list(tokens), parent_hash=0, block_size=BLOCK,
+                device_tier="gpu",
+            )],
+        )
+        for svc in services:
+            svc.pool.process_event_batch(batch, pod, MODEL)
+
+    def test_four_shard_kill_and_rejoin(self, tmp_path):
+        from llmd_kv_cache_tpu.cluster import ShardRouter
+        from llmd_kv_cache_tpu.cluster.config import ClusterConfig
+        from llmd_kv_cache_tpu.cluster.remote import ShardClient
+        from llmd_kv_cache_tpu.core import TokenProcessorConfig
+
+        addrs = [f"127.0.0.1:{p}" for p in SHARD_PORTS]
+        services, servers = {}, {}
+        router = None
+        try:
+            for addr in addrs:
+                services[addr], servers[addr] = self._make_service(
+                    addr, addrs, tmp_path)
+
+            # pod-a holds the full 32-block prefix, pod-b the first half.
+            t1 = list(range(1, 1 + 32 * BLOCK))
+            self._ingest(services.values(), "pod-a", t1, 1000)
+            self._ingest(services.values(), "pod-b", t1[:16 * BLOCK], 2000)
+
+            router = ShardRouter(
+                ClusterConfig(
+                    shard_addresses=addrs,
+                    replication_factor=2,
+                    fanout_chunk_blocks=8,
+                    breaker_reset_timeout_s=0.2,
+                ),
+                token_processor_config=TokenProcessorConfig(
+                    block_size_tokens=BLOCK),
+            )
+            res = router.score(t1, MODEL)
+            assert res.scores["pod-a"] == pytest.approx(32.0)
+            assert res.scores["pod-b"] == pytest.approx(16.0)
+            assert not res.degraded and res.degraded_shards == []
+            keys1 = router.token_processor.tokens_to_kv_block_keys(
+                0, t1, MODEL)
+            assert res.hit_blocks == len(keys1)
+
+            # Snapshot, then take down the shard that primaries block 0 —
+            # the worst case for the longest-prefix chain.
+            victim = router.ring.owner(keys1[0])
+            assert services[victim].recovery.snapshot_now(reason="test")
+            servers[victim].stop(grace=0)
+            services[victim].stop()
+
+            # Zero scoring outage: replica owners (rf=2) serve the dead
+            # shard's keys, scores stay exact and are NOT degraded.
+            res2 = router.score(t1, MODEL)
+            assert res2.scores == res.scores
+            assert res2.degraded_shards == []
+
+            # Events the dead shard misses while down.
+            survivors = [services[a] for a in addrs if a != victim]
+            t2 = list(range(501, 501 + 32 * BLOCK))
+            self._ingest(survivors, "pod-c", t2, 3000)
+            res3 = router.score(t2, MODEL)
+            assert res3.scores["pod-c"] == pytest.approx(32.0)
+            assert res3.degraded_shards == []
+
+            # Rejoin: fresh service on the same identity bootstraps the
+            # owned key range from its snapshot...
+            svc2, server2 = self._make_service(victim, addrs, tmp_path)
+            services[victim], servers[victim] = svc2, server2
+            owned1 = [k for k in keys1
+                      if victim in router.ring.owners(k, 2)]
+            assert owned1, "sample too small to exercise the victim"
+            assert set(svc2.indexer.kv_block_index.lookup(owned1)) \
+                == set(owned1)
+            # ...while the outage window's events are genuinely absent...
+            keys2 = router.token_processor.tokens_to_kv_block_keys(
+                0, t2, MODEL)
+            owned2 = [k for k in keys2
+                      if victim in router.ring.owners(k, 2)]
+            assert owned2
+            assert svc2.indexer.kv_block_index.lookup(owned2) == {}
+            # ...until one peer anti-entropy round repairs them.
+            svc2.attach_peer_digest_source()
+            stats = svc2.reconcile_now()
+            assert stats["repaired_added"] >= len(owned2), stats
+            assert set(svc2.indexer.kv_block_index.lookup(owned2)) \
+                == set(owned2)
+
+            # The rejoined shard answers its range over the real wire...
+            peer = ShardClient(victim)
+            try:
+                def _served():
+                    try:
+                        hits = peer.lookup_blocks(owned2)["hits"]
+                    except Exception:
+                        return False
+                    return set(hits) == set(owned2)
+
+                assert wait_until(_served, timeout=15.0)
+            finally:
+                peer.close()
+
+            # ...and the router's breaker re-admits it after the reset
+            # window, with scores still exact.
+            def _healed():
+                r = router.score(t2, MODEL)
+                return (r.scores.get("pod-c") == pytest.approx(32.0)
+                        and not r.degraded_shards)
+
+            assert wait_until(_healed, timeout=15.0, interval=0.25)
+        finally:
+            if router is not None:
+                router.close()
+            for server in servers.values():
+                server.stop(grace=0)
+            for svc in services.values():
+                try:
+                    svc.stop()
+                except Exception:
+                    pass  # victim's first incarnation is already stopped

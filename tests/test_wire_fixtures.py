@@ -149,6 +149,61 @@ class TestSGLangForeignDecode:
         assert ev.kv_cache_spec_sliding_window is None
 
 
+class TestScoreWireCompat:
+    """ScoreRequest/ScoreResponse shard-metadata tolerance: old peers'
+    bytes decode with defaults, new fields round-trip, unknown future
+    keys are ignored (the ``degraded``/``traceparent`` arrival pattern)."""
+
+    def test_legacy_request_decodes_with_empty_shard(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_legacy.bin"))
+        assert req.tokens == [1, 2, 3]
+        assert req.model_name == "llama-2-7b"
+        assert req.pod_identifiers == ["pod-1", "pod-2"]
+        assert req.shard == ""
+
+    def test_shard_request_decodes_and_ignores_future_keys(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_shard.bin"))
+        assert req.tokens == [7, 8]
+        assert req.shard == "shard-1"  # future_hint silently ignored
+
+    def test_legacy_response_decodes_with_shard_defaults(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_legacy.bin"))
+        assert resp.scores == {"pod-1": 0.5}
+        assert resp.error == ""
+        assert resp.degraded is False
+        assert resp.shard == ""
+        assert resp.degraded_shards == []
+
+    def test_shard_response_round_trips(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_shard.bin"))
+        assert resp.scores == {"pod-1": 0.75, "pod-2": 0.25}
+        assert resp.degraded is True
+        assert resp.traceparent == wire_spec.TRACEPARENT
+        assert resp.shard == "shard-0"
+        assert resp.degraded_shards == ["shard-2"]
+        # Re-encode → re-decode keeps the shard metadata intact.
+        again = ScoreResponse.from_bytes(resp.to_bytes())
+        assert again == resp
+
+    def test_old_peer_view_of_new_bytes(self):
+        """What an old decoder does with new bytes: msgpack map decode via
+        ``.get`` means the extra keys are simply never read. Simulate by
+        decoding the new-style response and projecting the legacy keys."""
+        import msgpack
+
+        d = msgpack.unpackb(load("score_response_shard.bin"), raw=False)
+        assert d["scores"] == {"pod-1": 0.75, "pod-2": 0.25}
+        assert d["error"] == ""  # legacy fields present and well-typed
+
+
 class TestWireToIndex:
     def test_committed_bytes_through_zmq_pool_index(self):
         """The foreign payload rides a real ZMQ PUB/SUB hop, then

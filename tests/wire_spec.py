@@ -104,6 +104,20 @@ def arr(*items: bytes) -> bytes:
     raise ValueError("fixture arrays are short")
 
 
+def mp(*pairs: "tuple[bytes, bytes]") -> bytes:
+    if len(pairs) <= 15:
+        return bytes([0x80 | len(pairs)]) + b"".join(k + v for k, v in pairs)  # fixmap
+    raise ValueError("fixture maps are short")
+
+
+def tru() -> bytes:
+    return b"\xc3"
+
+
+def fal() -> bytes:
+    return b"\xc2"
+
+
 # --- golden fixtures ---
 
 TS = 1234567890.0
@@ -113,8 +127,56 @@ DIGEST_A = bytes(range(32))
 DIGEST_B = bytes(range(100, 132))
 
 
+TRACEPARENT = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+
+
+def score_fixtures() -> dict[str, bytes]:
+    """Scoring-RPC message bodies (the msgpack gRPC wire of
+    ``services.indexer_service``), spec-assembled like the event payloads.
+
+    Wire-compat contract for the sharded control plane: ScoreRequest/
+    ScoreResponse grew optional shard metadata (``shard``,
+    ``degraded_shards``) the same tolerant way ``degraded``/``traceparent``
+    arrived — the *legacy* fixtures prove an old peer's bytes still decode
+    (absent keys default), the *shard* fixtures prove the new fields
+    round-trip and that unknown future keys are ignored.
+    """
+    return {
+        # Old scheduler → new server: no shard/traceparent/degraded keys.
+        "score_request_legacy.bin": mp(
+            (s("tokens"), arr(u(1), u(2), u(3))),
+            (s("model_name"), s("llama-2-7b")),
+            (s("pod_identifiers"), arr(s("pod-1"), s("pod-2"))),
+        ),
+        # New-style request with shard metadata plus an unknown key a
+        # *future* peer might add — decoders must ignore it.
+        "score_request_shard.bin": mp(
+            (s("tokens"), arr(u(7), u(8))),
+            (s("model_name"), s("llama-2-7b")),
+            (s("pod_identifiers"), arr()),
+            (s("shard"), s("shard-1")),
+            (s("future_hint"), nil()),
+        ),
+        # Old server → new scheduler: scores + error only.
+        "score_response_legacy.bin": mp(
+            (s("scores"), mp((s("pod-1"), f64(0.5)))),
+            (s("error"), s("")),
+        ),
+        # New shard-aware response: degraded fan-out with shard metadata.
+        "score_response_shard.bin": mp(
+            (s("scores"), mp((s("pod-1"), f64(0.75)), (s("pod-2"), f64(0.25)))),
+            (s("error"), s("")),
+            (s("degraded"), tru()),
+            (s("traceparent"), s(TRACEPARENT)),
+            (s("shard"), s("shard-0")),
+            (s("degraded_shards"), arr(s("shard-2"))),
+        ),
+    }
+
+
 def fixtures() -> dict[str, bytes]:
-    """name → payload bytes for one ZMQ message (the third wire frame)."""
+    """name → committed payload bytes: ZMQ event payloads (the third wire
+    frame) plus the scoring-RPC bodies from :func:`score_fixtures`."""
     # Reference-mirroring full BlockStored (vllm_adapter_test.go:38-56):
     # 9 fields, parent present, medium "gpu", trailing lora_name/extra nil.
     full_stored = arr(
@@ -187,4 +249,5 @@ def fixtures() -> dict[str, bytes]:
             )),
             nil(),
         ),
+        **score_fixtures(),
     }
