@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -67,6 +67,17 @@ bench-engine-telemetry: native
 # the single-shard baseline (bench_shard_fanout).
 bench-shard: native
 	$(CPU_ENV) $(PY) bench.py --shards 4
+
+# Ragged single-kernel mixed prefill+decode dispatch vs the padded
+# two-kernel path: on CPU an interpret-mode equivalence smoke + padding
+# waste comparison; on a real TPU the >=1.5x decode-throughput gate.
+bench-ragged: native
+	$(CPU_ENV) $(PY) bench.py --ragged
+
+# fp8 vs bf16 decode KV-bandwidth probe (VERDICT r5 item 1); analytic
+# bytes/step + interpret smoke on CPU, measured ms/step on a real chip.
+bench-fp8: native
+	$(CPU_ENV) $(PY) bench.py --fp8-bandwidth
 
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).

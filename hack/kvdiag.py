@@ -109,6 +109,7 @@ def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
             "pool": engine.get("pool", {}),
             "phases": engine.get("phases", {}),
             "requests": engine.get("requests", {}),
+            "ragged": engine.get("ragged", {}),
             "last_profile": (engine.get("last_profile") or {}).get("dir"),
         }
 

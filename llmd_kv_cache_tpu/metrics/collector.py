@@ -483,6 +483,21 @@ ENGINE_PROFILE_CAPTURES = Counter(
     "On-demand jax.profiler captures by outcome",
     ["outcome"],  # success|failure
 )
+# Padding-waste pair (EngineTelemetry.on_dispatch_tokens): every device
+# dispatch reports its real token count against the padded program size —
+# the ragged single-kernel path and the padded two-kernel fallback feed
+# the same counters, so rate(padded - real) is the padding-FLOP burn and
+# the ratio compares the two schedulers directly.
+ENGINE_RAGGED_REAL_TOKENS = Counter(
+    "kvtpu_engine_ragged_real_tokens_total",
+    "Real (non-padding) tokens dispatched by the engine step path",
+    ["group"],
+)
+ENGINE_RAGGED_PADDED_TOKENS = Counter(
+    "kvtpu_engine_ragged_padded_tokens_total",
+    "Total padded program tokens dispatched by the engine step path",
+    ["group"],
+)
 
 
 def record_engine_restore(outcome: str, seconds: Optional[float] = None) -> None:
@@ -493,6 +508,11 @@ def record_engine_restore(outcome: str, seconds: Optional[float] = None) -> None
 
 def record_profile_capture(outcome: str) -> None:
     ENGINE_PROFILE_CAPTURES.labels(outcome).inc()
+
+
+def record_ragged_dispatch(group: str, real: int, padded: int) -> None:
+    ENGINE_RAGGED_REAL_TOKENS.labels(group).inc(max(real, 0))
+    ENGINE_RAGGED_PADDED_TOKENS.labels(group).inc(max(padded, 0))
 
 
 # --------------------------------------------------------------------------

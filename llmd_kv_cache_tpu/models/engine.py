@@ -54,6 +54,7 @@ from .llama import (
     forward_decode_steps_hybrid,
     forward_hybrid,
     forward_prefill_pallas,
+    forward_ragged,
     init_kv_cache,
     init_kv_cache_hybrid,
     init_params,
@@ -167,6 +168,19 @@ class EngineConfig:
     # the cost of admitting new requests only between bursts. Bursts are
     # bucketed to powers of two so the jit cache stays O(log burst).
     decode_burst: int = 1
+    # Ragged single-kernel attention: pack the step's admitted prefill
+    # chunk and every active decode row into ONE flat-token-axis dispatch
+    # (ops.pallas_paged_attention.pallas_paged_ragged_attention) instead
+    # of the batch-1 prefill call plus the pad-to-max_batch decode call.
+    # A decode row is a 1-token ragged row, a prefill chunk a longer one;
+    # per-sequence padding disappears (the flat axis pads only to a
+    # power-of-two token bucket) and mixed traffic stops paying two
+    # kernel pipelines' fill/drain per step. Single-shard, non-hybrid,
+    # decode_burst=1 only — other configurations warn once and keep the
+    # padded two-kernel path; the same fallback serves shapes the kernel
+    # cannot take (unaligned head_dim on real TPU, fp8 pages whose
+    # kv_heads*page_size is not a 32 multiple). Runs interpreted on CPU.
+    ragged_attention: bool = False
     # Engine data-plane telemetry (telemetry/engine_telemetry.py): an
     # EngineTelemetryConfig enables TTFT/ITL/TPOT histograms, KV-pool
     # gauges, per-request flight-recorder events, and the on-demand
@@ -859,6 +873,38 @@ class MiniEngine:
         # allocation retry.
         self._burst_degraded = False
 
+        # Ragged single-kernel scheduling (EngineConfig.ragged_attention):
+        # resolve eligibility ONCE — the blockers are all engine-lifetime
+        # facts, so the step path branches on a plain bool. Ineligible
+        # configurations warn here and keep the padded two-kernel path.
+        self._ragged = False
+        self._ragged_interpret = not on_tpu
+        if self.cfg.ragged_attention:
+            blockers = []
+            if self.hybrid:
+                blockers.append("hybrid attention groups (two page pools)")
+            if mesh is not None:
+                blockers.append("mesh-sharded serving (tp/sp/pp)")
+            if self._burst != 1:
+                blockers.append(
+                    f"decode_burst={self.cfg.decode_burst} (fused bursts "
+                    "scan the padded decode program)")
+            if on_tpu and not _pallas_head_dim_supported(kernel_width):
+                blockers.append(
+                    f"cache payload width {kernel_width} is not "
+                    "128-aligned")
+            if (self._fp8_cache and on_tpu
+                    and (mcfg.kv_cache_heads * mcfg.page_size) % 32):
+                blockers.append(
+                    "fp8 page shape (kv_heads*page_size % 32 != 0 breaks "
+                    "Mosaic's 8-bit tiling)")
+            if blockers:
+                logger.warning(
+                    "ragged_attention=True unavailable (%s): using the "
+                    "padded two-kernel path", "; ".join(blockers))
+            else:
+                self._ragged = True
+
         # Optional shared-storage offload tier (offload.SharedStorageOffloadSpec):
         # write-through on commit, restore on prefix miss at admission.
         self.offload_manager = None
@@ -1512,6 +1558,10 @@ class MiniEngine:
                 last_only=True,
             )
         req.computed_len = pos + len(chunk)
+        if self.telemetry is not None:
+            # Padding-waste accounting: len(chunk) real tokens rode a
+            # seq-token padded dispatch (the power-of-two page bucket).
+            self.telemetry.on_dispatch_tokens(len(chunk), seq)
         if pos + len(chunk) >= len(req.prompt):
             # last_only: logits row 0 is the chunk's final valid position.
             req.last_logits = np.asarray(logits[0, 0])
@@ -1622,6 +1672,7 @@ class MiniEngine:
             req = self.requests[rid]
             if req.prefill_pos is not None and req.restore_pending:
                 self._start_deferred_restore(req)
+        prefill_req: Optional[Request] = None
         for rid in list(self._running):
             req = self.requests[rid]
             if req.prefill_pos is not None:
@@ -1644,11 +1695,21 @@ class MiniEngine:
                 if req.restore_job is not None:
                     if not self._poll_deferred_restore(req):
                         break
+                prefill_req = req
+                break
+        if self._ragged:
+            # Ragged scheduling: the prefill chunk and every active decode
+            # row pack into one flat-axis dispatch (the prefill bootstrap
+            # token still lands next step, exactly as on the padded path).
+            emitted.update(self._ragged_step(prefill_req))
+        else:
+            if prefill_req is not None:
+                req = prefill_req
                 if req.traceparent is not None:
                     with tracer().span(
                         "llm_d.kv_cache.engine.prefill_chunk",
                         parent_traceparent=req.traceparent,
-                        request_id=rid,
+                        request_id=req.request_id,
                         prefill_pos=req.prefill_pos,
                     ):
                         self._prefill_chunk(req)
@@ -1662,19 +1723,18 @@ class MiniEngine:
                         # step's decode batch would overwrite the prefill
                         # bootstrap token just emitted (a streaming caller
                         # would lose one token).
-                        just_prefilled = rid
-                break
-        active = [self.requests[rid] for rid in self._running
-                  if not self.requests[rid].done
-                  and self.requests[rid].prefill_pos is None
-                  and rid != just_prefilled]
-        for chunk_start in range(0, len(active), self.cfg.max_batch):
-            chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
-            burst = self._burst
-            if burst > 1:
-                emitted.update(self._decode_chunk_burst(chunk, burst))
-            else:
-                emitted.update(self._decode_chunk(chunk))
+                        just_prefilled = req.request_id
+            active = [self.requests[rid] for rid in self._running
+                      if not self.requests[rid].done
+                      and self.requests[rid].prefill_pos is None
+                      and rid != just_prefilled]
+            for chunk_start in range(0, len(active), self.cfg.max_batch):
+                chunk = active[chunk_start:chunk_start + self.cfg.max_batch]
+                burst = self._burst
+                if burst > 1:
+                    emitted.update(self._decode_chunk_burst(chunk, burst))
+                else:
+                    emitted.update(self._decode_chunk(chunk))
         for rid in list(self._running):
             req = self.requests[rid]
             if req.done:
@@ -1768,13 +1828,163 @@ class MiniEngine:
         # host memory unboundedly on a serving pod.
         self.requests.pop(req.request_id, None)
 
-    def _decode_batch_arrays(self, chunk: list[Request]):
+    def _ragged_step(self, prefill_req: Optional[Request]) -> dict[str, int]:
+        """One scheduling step on the ragged single-kernel path.
+
+        Active decode rows still group into chunks of ``max_batch`` (the
+        same per-dispatch activation bound as the padded path); the FIFO
+        head's prefill chunk rides the first dispatch as one extra long
+        row. A request that finishes prefill here was assembled BEFORE
+        its bootstrap token existed, so it cannot also decode this step —
+        the padded path's ``just_prefilled`` exclusion, structurally.
+        """
+        emitted: dict[str, int] = {}
+        active = [self.requests[rid] for rid in self._running
+                  if not self.requests[rid].done
+                  and self.requests[rid].prefill_pos is None]
+        b = self.cfg.max_batch
+        chunks = [active[i:i + b] for i in range(0, len(active), b)]
+        if not chunks:
+            chunks = [[]]
+        for ci, chunk in enumerate(chunks):
+            p_req = prefill_req if ci == 0 else None
+            if not chunk and p_req is None:
+                continue
+            emitted.update(self._ragged_dispatch(chunk, p_req))
+        return emitted
+
+    def _ragged_dispatch(self, decode_rows: list[Request],
+                         prefill_req: Optional[Request]) -> dict[str, int]:
+        """One mixed prefill+decode dispatch over the flat ragged axis.
+
+        Decode rows are 1-token rows; the prefill chunk (when present) is
+        the last, longer row. The flat token axis buckets to a power of
+        two (min 8 — the ragged q tile) and the row axis to a power of
+        two, so the jit cache stays O(log max_batch · log tokens); padding
+        rows are empty (``row_starts[r] == row_starts[r+1]``) and never
+        enter the kernel's row loop — the per-token waste the pool
+        counters measure is the bucket tail, not ``max_batch`` dead rows.
+        """
+        page_size = self.cfg.model.page_size
+        q_lens: list[int] = []
+        ctxs: list[int] = []
+        tables_list: list[np.ndarray] = []
+        flat_tokens: list[int] = []
+        for req in decode_rows:
+            flat_tokens.append(
+                req.output[-1] if req.output else req.prompt[-1])
+            q_lens.append(1)
+            ctxs.append(req.computed_len)
+            tables_list.append(self._page_table_for(req))
+        p_chunk: list[int] = []
+        p_pos = 0
+        if prefill_req is not None:
+            chunk_cap = max(page_size, self.cfg.max_prefill_tokens
+                            // page_size * page_size)
+            p_pos = prefill_req.prefill_pos
+            p_chunk = list(prefill_req.prompt[p_pos:p_pos + chunk_cap])
+            flat_tokens.extend(p_chunk)
+            q_lens.append(len(p_chunk))
+            ctxs.append(p_pos)
+            tables_list.append(self._page_table_for(prefill_req))
+
+        rows = len(q_lens)
+        t_real = len(flat_tokens)
+        t_pad = 8
+        while t_pad < t_real:
+            t_pad *= 2
+        rows_pad = 1
+        while rows_pad < rows:
+            rows_pad *= 2
+
+        tokens = np.zeros((1, t_pad), np.int32)
+        tokens[0, :t_real] = flat_tokens
+        # Padding rows are empty: start == end == t_real, zero tables,
+        # ctx 0 — the kernel's block metadata never reaches them.
+        row_starts = np.full((rows_pad + 1,), t_real, np.int32)
+        row_starts[:rows + 1] = np.concatenate(
+            [[0], np.cumsum(q_lens)]).astype(np.int32)
+        ctx = np.zeros((rows_pad,), np.int32)
+        ctx[:rows] = ctxs
+        tables = np.zeros((rows_pad, self.cfg.max_pages_per_seq), np.int32)
+        for i, t in enumerate(tables_list):
+            tables[i] = t
+
+        span_cm = None
+        if prefill_req is not None and prefill_req.traceparent is not None:
+            span_cm = tracer().span(
+                "llm_d.kv_cache.engine.prefill_chunk",
+                parent_traceparent=prefill_req.traceparent,
+                request_id=prefill_req.request_id,
+                prefill_pos=p_pos,
+            )
+        try:
+            if span_cm is not None:
+                span_cm.__enter__()
+            logits, self.k_cache, self.v_cache = forward_ragged(
+                self.params, self.cfg.model,
+                jnp.asarray(tokens),
+                self.k_cache, self.v_cache,
+                jnp.asarray(tables),
+                jnp.asarray(row_starts),
+                jnp.asarray(ctx, jnp.int32),
+                interpret=self._ragged_interpret,
+            )
+        finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
+
+        tel = self.telemetry
+        if tel is not None:
+            tel.on_dispatch_tokens(t_real, t_pad)
+
+        out: dict[str, int] = {}
+        if decode_rows:
+            next_tokens = np.asarray(
+                jnp.argmax(logits[:len(decode_rows)], axis=-1))
+            now = time.monotonic() if tel is not None else 0.0
+            for i, req in enumerate(decode_rows):
+                req.computed_len += 1
+                tok = int(next_tokens[i])
+                req.output.append(tok)
+                out[req.request_id] = tok
+                if tel is not None:
+                    tel.on_decode_tokens(req.request_id, 1, now)
+                if req.traceparent is not None:
+                    with tracer().span(
+                        "llm_d.kv_cache.engine.decode_step",
+                        parent_traceparent=req.traceparent,
+                        request_id=req.request_id,
+                        tokens=1,
+                        computed_len=req.computed_len,
+                    ):
+                        pass  # event-style span: marks the emission point
+                if len(req.output) >= req.max_new_tokens:
+                    req.done = True
+
+        if prefill_req is not None:
+            req = prefill_req
+            req.computed_len = p_pos + len(p_chunk)
+            if p_pos + len(p_chunk) >= len(req.prompt):
+                # The prefill row's logit IS its final valid token's (the
+                # ragged forward returns one row per ragged row).
+                req.last_logits = np.asarray(logits[rows - 1])
+                req.prefill_pos = None
+                self._finish_prefill(req)
+                if req.output:
+                    out[req.request_id] = req.output[-1]
+            else:
+                req.prefill_pos = p_pos + len(p_chunk)
+        return out
+
+    def _decode_batch_arrays(self, chunk: list[Request], rows: int = 0):
         """Padded per-row decode inputs shared by the single-step and burst
         paths: (last tokens, computed context, page tables). The last
         token may have come from sampling with its KV not yet computed —
         that is why positions derive from ``computed_len``, and both paths
-        must keep doing so."""
-        b = self.cfg.max_batch
+        must keep doing so. ``rows`` overrides the ``max_batch`` padding
+        target (the unpipelined-pp decode bucket)."""
+        b = rows or self.cfg.max_batch
         last = np.zeros((b,), np.int32)
         ctx = np.zeros((b,), np.int32)
         tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
@@ -1879,7 +2089,18 @@ class MiniEngine:
         # active-request count; padded rows have new_lens=0 (all writes go
         # to the garbage page, logits ignored).
         b = self.cfg.max_batch
-        last, ctx, tables = self._decode_batch_arrays(chunk)
+        if self._pp > 1 and self._pp_decode_mb == 1:
+            # Unpipelined pp decode (max_batch % pp != 0 — warned at
+            # construction): the M=1 schedule accepts ANY batch size, so
+            # padding dead rows to max_batch only burns per-stage FLOPs.
+            # Pad to the power-of-two bucket instead (O(log max_batch)
+            # compiled shapes); the pipelined schedule keeps the fixed
+            # max_batch shape its microbatch split requires.
+            b = 1
+            while b < len(chunk):
+                b *= 2
+            b = min(b, self.cfg.max_batch)
+        last, ctx, tables = self._decode_batch_arrays(chunk, rows=b)
         tokens = last[:, None].copy()
         new_lens = np.zeros((b,), np.int32)
         swa_tables = np.zeros((b, self.cfg.max_pages_per_seq), np.int32)
@@ -1913,6 +2134,12 @@ class MiniEngine:
         out = {}
         next_tokens = np.asarray(jnp.argmax(logits[:, 0], axis=-1))
         tel = self.telemetry
+        if tel is not None:
+            # Padding-waste accounting for the padded path: len(chunk)
+            # real tokens ride a b-row dispatch. The same counters feed
+            # from the ragged path, so the waste ratio directly compares
+            # the two schedulers.
+            tel.on_dispatch_tokens(len(chunk), b)
         now = time.monotonic() if tel is not None else 0.0
         for i, req in enumerate(chunk):
             req.computed_len += 1

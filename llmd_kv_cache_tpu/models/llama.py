@@ -21,7 +21,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ..ops.kv_pages import scatter_kv_pages
+from ..ops.kv_pages import scatter_kv_pages, scatter_kv_pages_ragged
 from ..ops.paged_attention import paged_attention
 
 Params = dict[str, Any]
@@ -935,7 +935,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float,
 
 def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                           ctx_lens, new_lens, attention_fn, last_only=False,
-                          tails=None):
+                          tails=None, ragged=None):
     """Shared transformer body over grouped KV pools.
 
     ``k_caches[g]`` holds group g's layers stacked in ``cfg.group_layers(g)``
@@ -959,10 +959,41 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
     paged keys (ops-level ``tail_k/tail_v/tail_lens``). Returns
     ``(logits, tail_ks, tail_vs)`` in place of the caches; the caller
     scatters the accumulated tail into the caches once, outside the scan.
+
+    ``ragged=row_starts`` ([rows+1] flat-token prefix sums) is the ragged
+    mixed-batch mode: ``tokens`` is one flat axis [1, total_q] where row r
+    owns slots ``[row_starts[r], row_starts[r+1])`` at logical positions
+    ``ctx_lens[r] + i`` — ``ctx_lens``/``new_lens`` are per-ROW [rows],
+    ``tables[g]`` is [rows, pages_per_seq], and the attention backend must
+    understand the ragged layout (``pallas_paged_ragged_attention``).
+    ``last_only=True`` then returns one logit row per ragged row (each
+    row's final token) — logits [1, rows, vocab].
     """
     batch, seq = tokens.shape
-    positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
-    valid = jnp.arange(seq)[None, :] < new_lens[:, None]
+    if ragged is not None:
+        if tails is not None:
+            raise ValueError("ragged mode is scatter-then-attend; "
+                             "burst tails are not supported")
+        if batch != 1:
+            raise ValueError(
+                f"ragged mode takes one flat token axis [1, total_q], "
+                f"got batch={batch}")
+        rows = ctx_lens.shape[0]
+        flat = jnp.arange(seq)
+        row_of = jnp.clip(
+            jnp.searchsorted(ragged, flat, side="right") - 1, 0, rows - 1)
+        positions = (ctx_lens[row_of] + flat - ragged[row_of])[None, :]
+        valid = (flat < ragged[-1])[None, :]
+
+        def _scatter(cache, new_kv, table):
+            return scatter_kv_pages_ragged(
+                cache, new_kv[0], table, row_of, positions[0], valid[0])
+    else:
+        positions = ctx_lens[:, None] + jnp.arange(seq)[None, :]  # [b, s]
+        valid = jnp.arange(seq)[None, :] < new_lens[:, None]
+
+        def _scatter(cache, new_kv, table):
+            return scatter_kv_pages(cache, new_kv, table, positions, valid)
     total_lens = ctx_lens + new_lens
     if tails is not None:
         # The burst path is single-token-per-tick: tmask broadcasts
@@ -1085,8 +1116,7 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                 )
             else:
                 k_caches[g] = k_caches[g].at[lj].set(
-                    scatter_kv_pages(k_caches[g][lj], latent, table,
-                                     positions, valid)
+                    _scatter(k_caches[g][lj], latent, table)
                 )
                 # Values ARE the latent: pass the K pool as both K and V
                 # (the width-0 V pool is never read), then un-absorb W_UV.
@@ -1136,12 +1166,10 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
                 )
             else:
                 k_caches[g] = k_caches[g].at[lj].set(
-                    scatter_kv_pages(k_caches[g][lj], k, table, positions,
-                                     valid)
+                    _scatter(k_caches[g][lj], k, table)
                 )
                 v_caches[g] = v_caches[g].at[lj].set(
-                    scatter_kv_pages(v_caches[g][lj], v, table, positions,
-                                     valid)
+                    _scatter(v_caches[g][lj], v, table)
                 )
 
                 attn = attention_fn(
@@ -1156,8 +1184,15 @@ def _forward_impl_grouped(params, cfg, tokens, k_caches, v_caches, tables,
 
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     if last_only:
-        idx = jnp.maximum(new_lens - 1, 0)  # [b]
-        x = jnp.take_along_axis(x, idx[:, None, None], axis=1)  # [b, 1, h]
+        if ragged is not None:
+            # One logit row per ragged row: its final flat token
+            # (row_starts[r+1] - 1; empty rows clamp to slot 0 and the
+            # caller ignores them).
+            idx = jnp.maximum(ragged[1:] - 1, 0)[None, :]  # [1, rows]
+            x = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+        else:
+            idx = jnp.maximum(new_lens - 1, 0)  # [b]
+            x = jnp.take_along_axis(x, idx[:, None, None], axis=1)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if tails is not None:
         return logits, tuple(tail_ks), tuple(tail_vs)
@@ -1590,3 +1625,63 @@ def forward_prefill_pallas(
         params, cfg, tokens, k_cache, v_cache, page_table, ctx_lens, new_lens,
         attention_fn, last_only=last_only,
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "interpret"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def forward_ragged(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [1, total_q] int32 flat mixed batch (padded)
+    k_cache: jax.Array,  # [layers, pages, kvh, page_size, hd] (donated)
+    v_cache: jax.Array,  # same (donated)
+    page_table: jax.Array,  # [rows, pages_per_seq] int32
+    row_starts: jax.Array,  # [rows+1] int32 flat-token prefix sums
+    ctx_lens: jax.Array,  # [rows] tokens already cached per row
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One ragged mixed prefill+decode step via the single ragged kernel.
+
+    Row r's new tokens occupy flat slots ``[row_starts[r],
+    row_starts[r+1])`` of ``tokens`` at logical positions
+    ``ctx_lens[r] + i`` — a decode row is a 1-token row, a prefill chunk a
+    longer one; one dispatch serves the whole mixed batch with no
+    per-sequence padding (the flat axis pads only to the q-tile multiple;
+    slots at and past ``row_starts[-1]`` are inert). Returns
+    ``(logits [rows, vocab], k_cache, v_cache)`` — one logit row per
+    ragged row, its final token (the next-token logits for both decode
+    rows and a prefill chunk's last token). Single-shard only: the engine
+    gates the ragged path off under tp/sp meshes and pp pipelines.
+    """
+    from ..ops.pallas_paged_attention import pallas_paged_ragged_attention
+
+    total_q = tokens.shape[1]
+    new_lens = row_starts[1:] - row_starts[:-1]  # [rows]
+    # Ragged batches mix 1-token decode rows with long prefill chunks, so
+    # the tile stays small — a decode row straddles at most one tile and
+    # pays at most q_tile-1 dead query rows, while a chunk spans many
+    # tiles at full occupancy.
+    q_tile = math.gcd(total_q, 8)
+
+    sinks = cfg.attention_sinks or None
+
+    def attention_fn(q, k_l, v_l, table, positions, total_lens, window,
+                     k_stack=None, v_stack=None, layer_idx=None):
+        if k_stack is not None:
+            k_l, v_l = k_stack, v_stack
+        out = pallas_paged_ragged_attention(
+            q[0], k_l, v_l, table, row_starts, ctx_lens,
+            q_tile=q_tile, sliding_window=window, sinks=sinks,
+            shared_kv=cfg.is_mla, layer_idx=layer_idx, interpret=interpret,
+        )
+        return out[None]
+
+    logits, ks, vs = _forward_impl_grouped(
+        params, cfg, tokens, (k_cache,), (v_cache,), (page_table,),
+        ctx_lens, new_lens, attention_fn, last_only=True,
+        ragged=row_starts,
+    )
+    return logits[0], ks[0], vs[0]

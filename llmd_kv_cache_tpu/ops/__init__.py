@@ -5,6 +5,15 @@ run on the CPU backend in tests); Pallas kernels provide the TPU fast path.
 """
 
 from .paged_attention import paged_attention
-from .kv_pages import gather_kv_pages, scatter_kv_pages
+from .kv_pages import (
+    gather_kv_pages,
+    scatter_kv_pages,
+    scatter_kv_pages_ragged,
+)
 
-__all__ = ["paged_attention", "gather_kv_pages", "scatter_kv_pages"]
+__all__ = [
+    "paged_attention",
+    "gather_kv_pages",
+    "scatter_kv_pages",
+    "scatter_kv_pages_ragged",
+]

@@ -59,6 +59,35 @@ def scatter_kv_pages(
     )
 
 
+def scatter_kv_pages_ragged(
+    cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
+    new_kv: jax.Array,  # [total_q, kv_heads, head_dim] flat mixed batch
+    page_table: jax.Array,  # [rows, pages_per_seq] int32
+    row_of: jax.Array,  # [total_q] int32 owning row per flat token
+    positions: jax.Array,  # [total_q] int32 logical positions
+    valid: jax.Array,  # [total_q] bool
+) -> jax.Array:
+    """`scatter_kv_pages` over a ragged flat token axis.
+
+    The mixed prefill+decode batch is one flat axis where each token knows
+    its owning row (``row_of``) and logical position; the page lookup is
+    a 2-D gather on ``(row, logical_page)`` instead of a per-row
+    take_along_axis. Padded slots route to the garbage page exactly like
+    the padded scatter.
+    """
+    page_size = cache.shape[2]
+    logical_page = jnp.minimum(positions // page_size, page_table.shape[1] - 1)
+    slot = positions % page_size
+    row = jnp.clip(row_of, 0, page_table.shape[0] - 1)
+    phys_page = page_table[row, logical_page]
+    phys_page = jnp.where(valid, phys_page, GARBAGE_PAGE)
+    slot = jnp.where(valid, slot, 0)
+    vals = new_kv.astype(cache.dtype)
+    return cache.at[phys_page, :, slot, :].set(
+        vals, mode="drop", unique_indices=False
+    )
+
+
 def gather_kv_pages(
     cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
     page_table: jax.Array,  # [batch, pages_per_seq] int32

@@ -733,6 +733,448 @@ def _prefill_kernel(
     o_ref[0, 0, :, 0] = out.transpose(1, 0, 2).astype(o_ref.dtype)
 
 
+def _ragged_kernel(
+    # scalar prefetch
+    page_table_ref,  # [rows, pages_per_seq] int32 (SMEM)
+    row_starts_ref,  # [rows+1] int32: per-row flat-token prefix sums
+    ctx_lens_ref,  # [rows] int32 (tokens cached BEFORE each row's new ones)
+    block_first_ref,  # [num_q_blocks] int32: first row touching each block
+    block_rows_ref,  # [num_q_blocks] int32: rows touching each block
+    tail_lens_ref,  # [rows] int32 (zeros when has_tail=False)
+    # inputs
+    q_ref,  # [1, q_tile, kv_heads, group, head_dim] VMEM block for (g,)
+    k_hbm,  # [num_pages, kv_heads, page_size, head_dim] (ANY/HBM)
+    v_hbm,  # same
+    tail_k_ref,  # [rows, T, kv_heads, head_dim] whole-array VMEM; dummy if no tail
+    tail_v_ref,  # same (placeholder when shared_kv)
+    # output
+    o_ref,  # [1, q_tile, kv_heads, group, head_dim] VMEM block
+    # scratch
+    k_scratch,  # [2, pages_per_block, kv_heads, page_size, head_dim] VMEM
+    v_scratch,  # same
+    sem,  # DMA semaphores [2, pages_per_block, 2]
+    *,
+    page_size: int,
+    scale: float,
+    q_tile: int,
+    sliding_window: int | None,
+    sinks: int,
+    pages_per_block: int,
+    shared_kv: bool,
+    shared_copy: bool,
+    has_tail: bool,
+    layer_idx: int | None,
+    quant: bool = False,
+):
+    """One grid over a ragged mixed prefill+decode batch.
+
+    The batch is a FLAT token axis: row r's new tokens occupy flat slots
+    ``[row_starts[r], row_starts[r+1])`` at logical positions
+    ``ctx_lens[r] + i`` — a 1-token decode row and a 512-token prefill
+    chunk are just rows of different lengths, with zero per-sequence
+    padding (only the axis tail pads to a ``q_tile`` multiple). The grid
+    is BLOCK-centric — one program per aligned q block, all kv heads
+    merged (whole-page DMAs carry every head, as in
+    ``_decode_kernel_merged``) — so a block's output is owned by exactly
+    one program and rows straddling a block boundary cannot race. Rows
+    intersecting the block are walked by a dynamic ``fori_loop`` off the
+    prefix-summed metadata; each row streams its own page window through
+    ``_superblock_streamer`` with ``_decode_stream_bounds`` arithmetic
+    (``q_end`` = its first in-block query position + 1 reproduces the
+    prefill kernel's ``max(q_first - W + 1, 0) // page_size`` window
+    start), and its q rows are committed into the block state with a
+    per-row liveness select — the ragged analogue of the merged decode
+    kernel's live guard (a foreign row's all-masked scores would
+    otherwise poison m/l/acc).
+
+    ``quant``: fp8 (1-byte) pages in the flat whole-page layout with a
+    per-round upcast, exactly the merged decode kernel's operand mode.
+    ``has_tail``: burst-local dense KV tails folded per row via
+    ``_tail_fold`` — its mask puts every query at ``ctx + tail_len - 1``,
+    so tails are only valid for single-token (decode) rows; multi-token
+    rows must carry ``tail_lens == 0``.
+    """
+    g = pl.program_id(0)
+    kv_heads, group = q_ref.shape[2], q_ref.shape[3]
+    head_dim = q_ref.shape[4]
+    kpb = pages_per_block
+    blk_start = g * q_tile
+
+    first_row = block_first_ref[g]
+    n_rows = block_rows_ref[g]
+
+    # qs[h]: [group, q_tile, head_dim] (cache dtype; fp32 scores after the
+    # matmul — the MXU fast path, same numerics as the other kernels).
+    qs = [q_ref[0, :, h].transpose(1, 0, 2) for h in range(kv_heads)]
+    qi = jax.lax.broadcasted_iota(jnp.int32, (q_tile, 1), 0)
+
+    def row_body(ri, state):
+        r = first_row + ri
+        row_start = row_starts_ref[r]
+        row_end = row_starts_ref[r + 1]
+        ctx_len = ctx_lens_ref[r]
+
+        flat = blk_start + qi  # [q_tile, 1] flat token index of each q row
+        q_live = (flat >= row_start) & (flat < row_end)
+        # Logical query positions as if every q row belonged to row r —
+        # garbage for foreign rows, discarded by the liveness select.
+        q_pos = ctx_len + flat - row_start
+        # Keys this block needs from row r: up to its last in-block query
+        # (causal; the new tokens' KV is already scattered, so kv_limit
+        # includes them), starting from the first in-block query's window.
+        kv_limit = (ctx_len - row_start
+                    + jnp.minimum(row_end, blk_start + q_tile))
+        q_first = ctx_len + jnp.maximum(row_start, blk_start) - row_start
+        q_end = q_first + 1
+        tail_len = tail_lens_ref[r] if has_tail else jnp.int32(0)
+        if has_tail:
+            # A tail row (tail_len > 0 — a 1-token row by contract) keeps
+            # its new KV in the dense tail, not the pages: the paged scan
+            # covers [0, ctx_len) and the query sits at
+            # ctx_len + tail_len - 1 (the decode kernels' tail contract).
+            is_tail_row = tail_len > 0
+            kv_limit = jnp.where(is_tail_row, ctx_len, kv_limit)
+            q_end = jnp.where(is_tail_row, ctx_len + tail_len, q_end)
+            q_pos = jnp.where(is_tail_row, q_end - 1, q_pos)
+        fw, sp, ni = _decode_stream_bounds(
+            kv_limit, q_end, page_size, sliding_window, sinks)
+        num_sb = (ni + kpb - 1) // kpb
+        sb_positions, sb_dma = _superblock_streamer(
+            page_table_ref, r, None, k_hbm, v_hbm, k_scratch, v_scratch,
+            sem, kpb=kpb, num_iters=ni, first_window=fw, sink_pages=sp,
+            sinks=sinks, shared_kv=shared_kv, layer_idx=layer_idx)
+
+        @pl.when(num_sb > 0)
+        def _():
+            for c in sb_dma(0, 0):
+                c.start()
+
+        def body(sb, carry):
+            ms, ls, accs = carry
+            slot = sb % 2
+            next_slot = (sb + 1) % 2
+
+            @pl.when(sb + 1 < num_sb)
+            def _():
+                for c in sb_dma(next_slot, sb + 1):
+                    c.start()
+
+            for c in sb_dma(slot, sb):
+                c.wait()
+            if shared_copy:
+                # Same rationale as the decode kernels: mirror the K
+                # superblock into the V scratch locally so each matmul
+                # gets its own buffer (one HBM read).
+                cp = pltpu.make_async_copy(
+                    k_scratch.at[slot], v_scratch.at[slot],
+                    sem.at[slot, 0, 1])
+                cp.start()
+                cp.wait()
+
+            # Shared mask for every head; park at kv_limit so parked
+            # sub-pages are rejected by the in-bounds term.
+            k_pos = sb_positions(sb, kv_limit, page_size)  # [1, kpb*ps]
+            mask = (k_pos <= q_pos) & (k_pos < kv_limit)  # [q_tile, K]
+            if sliding_window is not None:
+                in_window = q_pos - k_pos < sliding_window
+                if sinks:
+                    in_window = in_window | (k_pos < sinks)
+                mask = mask & in_window
+
+            if quant:
+                # One upcast of the staged superblock (fp8→bf16 exact);
+                # every head slices the same value.
+                kq = k_scratch[slot].astype(q_ref.dtype)
+                vq = v_scratch[slot].astype(q_ref.dtype)
+
+            new_ms, new_ls, new_accs = [], [], []
+            for h in range(kv_heads):
+                if quant:
+                    k = kq[:, h * page_size:(h + 1) * page_size, :].reshape(
+                        kpb * page_size, head_dim)
+                    v = vq[:, h * page_size:(h + 1) * page_size, :].reshape(
+                        kpb * page_size, head_dim)
+                else:
+                    k = k_scratch[slot, :, h].reshape(
+                        kpb * page_size, head_dim)
+                    if shared_kv:
+                        v = (v_scratch[slot, :, h].reshape(
+                            kpb * page_size, head_dim) if shared_copy else k)
+                    else:
+                        v = v_scratch[slot, :, h].reshape(
+                            kpb * page_size, head_dim)
+                scores = jax.lax.dot_general(
+                    qs[h], k, dimension_numbers=(((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale  # [group, q_tile, kpb*page_size]
+                scores = jnp.where(mask[None], scores, _NEG_INF)
+
+                m_cur = jnp.max(scores, axis=-1, keepdims=True)
+                m_new = jnp.maximum(ms[h], m_cur)
+                p = jnp.exp(scores - m_new)
+                alpha = jnp.exp(ms[h] - m_new)
+                l_new = ls[h] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                acc_new = accs[h] * alpha + jax.lax.dot_general(
+                    p.astype(v.dtype), v,
+                    dimension_numbers=(((2,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                new_ms.append(m_new)
+                new_ls.append(l_new)
+                new_accs.append(acc_new)
+            return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+
+        m0 = tuple(jnp.full((group, q_tile, 1), _NEG_INF, jnp.float32)
+                   for _ in range(kv_heads))
+        l0 = tuple(jnp.zeros((group, q_tile, 1), jnp.float32)
+                   for _ in range(kv_heads))
+        a0 = tuple(jnp.zeros((group, q_tile, head_dim), jnp.float32)
+                   for _ in range(kv_heads))
+        m_r, l_r, acc_r = jax.lax.fori_loop(0, num_sb, body, (m0, l0, a0))
+        m_r, l_r, acc_r = list(m_r), list(l_r), list(acc_r)
+
+        if has_tail:
+            # _tail_fold's mask assumes every query sits at the tail end
+            # (ctx + tail_len - 1) — true for this row's single query when
+            # tail_lens[r] > 0 only on 1-token rows (the documented
+            # contract); the garbage it computes for foreign q rows is
+            # discarded by the liveness select below. The fold is
+            # row-wise over its leading axis, so the [group, q_tile, …]
+            # state folds as [group·q_tile, …].
+            for h in range(kv_heads):
+                k_t = tail_k_ref[r, :, h]  # [T, head_dim]
+                v_t = k_t if shared_kv else tail_v_ref[r, :, h]
+                mf, lf, af = _tail_fold(
+                    qs[h].reshape(group * q_tile, head_dim), k_t, v_t,
+                    tail_len, ctx_len,
+                    m_r[h].reshape(group * q_tile, 1),
+                    l_r[h].reshape(group * q_tile, 1),
+                    acc_r[h].reshape(group * q_tile, head_dim),
+                    scale=scale, sliding_window=sliding_window, sinks=sinks)
+                m_r[h] = mf.reshape(group, q_tile, 1)
+                l_r[h] = lf.reshape(group, q_tile, 1)
+                acc_r[h] = af.reshape(group, q_tile, head_dim)
+
+        # Commit row r's q rows into the block state; foreign rows keep
+        # theirs (the merged decode kernel's live guard, per q row).
+        ms, ls, accs = state
+        sel = q_live[None]  # [1, q_tile, 1] broadcasts over group/head_dim
+        return (
+            tuple(jnp.where(sel, m_r[h], ms[h]) for h in range(kv_heads)),
+            tuple(jnp.where(sel, l_r[h], ls[h]) for h in range(kv_heads)),
+            tuple(jnp.where(sel, acc_r[h], accs[h])
+                  for h in range(kv_heads)),
+        )
+
+    m0 = tuple(jnp.full((group, q_tile, 1), _NEG_INF, jnp.float32)
+               for _ in range(kv_heads))
+    l0 = tuple(jnp.zeros((group, q_tile, 1), jnp.float32)
+               for _ in range(kv_heads))
+    a0 = tuple(jnp.zeros((group, q_tile, head_dim), jnp.float32)
+               for _ in range(kv_heads))
+    ms, ls, accs = jax.lax.fori_loop(0, n_rows, row_body, (m0, l0, a0))
+    for h in range(kv_heads):
+        # Pure-padding blocks (n_rows == 0) write zeros (l stays 0).
+        out = accs[h] / jnp.maximum(ls[h], 1e-30)  # [group, q_tile, hd]
+        o_ref[0, :, h] = out.transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("q_tile", "sliding_window", "sinks",
+                                    "pages_per_block", "shared_kv",
+                                    "shared_stream", "layer_idx",
+                                    "interpret"))
+def pallas_paged_ragged_attention(
+    q: jax.Array,  # [total_q, q_heads, head_dim] flat mixed batch
+    k_cache: jax.Array,  # [num_pages, kv_heads, page_size, head_dim]
+    v_cache: jax.Array,
+    page_table: jax.Array,  # [rows, pages_per_seq] int32
+    row_starts: jax.Array,  # [rows+1] int32 flat-token prefix sums
+    ctx_lens: jax.Array,  # [rows] cached tokens before each row's new ones
+    *,
+    q_tile: int = 8,
+    sliding_window: int | None = None,
+    sinks: int | None = None,
+    pages_per_block: int | None = None,
+    shared_kv: bool = False,
+    shared_stream: str = "copy",
+    tail_k: jax.Array | None = None,  # [rows, T, kv_heads, head_dim]
+    tail_v: jax.Array | None = None,
+    tail_lens: jax.Array | None = None,  # [rows] valid tail tokens
+    layer_idx: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single-kernel flash attention over a ragged mixed batch.
+
+    Row r's new tokens occupy flat q slots ``[row_starts[r],
+    row_starts[r+1])`` at logical positions ``ctx_lens[r] + i`` and attend
+    causally over that row's paged KV (the new tokens' KV already
+    scattered, as in the prefill wrapper). Decode rows are length-1 rows;
+    prefill chunks are longer rows — one dispatch serves both with no
+    per-sequence padding (``total_q`` pads only to a ``q_tile`` multiple;
+    slots at and past ``row_starts[-1]`` return unspecified values).
+    Returns ``[total_q, q_heads, head_dim]``.
+
+    ``sliding_window``/``sinks`` follow the prefill wrapper's semantics;
+    ``shared_kv``/``shared_stream`` the decode wrapper's (absorbed MLA).
+    A 1-byte (fp8 e4m3) cache takes the merged decode kernel's quantized
+    operand mode: whole flat pages DMA'd at 1 byte/element and upcast
+    once per round (needs ``kv_heads * page_size % 32 == 0`` on real
+    TPU, merged layout only — same rules as decode). ``tail_*`` fold a
+    dense burst-local tail per row via ``_tail_fold``; its mask pins
+    every query to the tail end, so only 1-token rows may carry
+    ``tail_lens > 0``.
+    """
+    total_q, q_heads, head_dim = q.shape
+    # layer_idx: stacked caches, in-kernel layer indexing (see the other
+    # wrappers — no per-layer slice copy at the custom-call boundary).
+    cache_dims = k_cache.shape[1:] if layer_idx is not None else k_cache.shape
+    _, kv_heads, page_size, _ = cache_dims
+    group = q_heads // kv_heads
+    rows = page_table.shape[0]
+    assert total_q % q_tile == 0, "pad total_q to a q_tile multiple"
+    if sliding_window is None:
+        sinks = None  # no-op without a window (see the prefill wrapper)
+    _check_head_dim_alignment(head_dim, interpret)
+    if shared_stream not in ("copy", "reuse"):
+        raise ValueError(
+            f"shared_stream must be 'copy' or 'reuse', got {shared_stream!r}")
+
+    num_blocks = total_q // q_tile
+    row_starts = row_starts.astype(jnp.int32)
+    ctx_lens = ctx_lens.astype(jnp.int32)
+
+    # Block→row intersection metadata, prefix-sum arithmetic on the traced
+    # row_starts (searchsorted 'right' minus one lands on the covering row
+    # and naturally skips empty rows). Pure-padding blocks (start at or
+    # past row_starts[-1]) get zero rows; the kernel writes zeros there.
+    blk_starts = jnp.arange(num_blocks, dtype=jnp.int32) * q_tile
+    total_real = row_starts[-1]
+    first = jnp.clip(
+        jnp.searchsorted(row_starts, blk_starts, side="right") - 1,
+        0, rows - 1)
+    last_tok = jnp.minimum(blk_starts + q_tile, total_real) - 1
+    last = jnp.clip(
+        jnp.searchsorted(row_starts, last_tok, side="right") - 1,
+        0, rows - 1)
+    block_first = first.astype(jnp.int32)
+    block_rows = jnp.where(blk_starts < total_real,
+                           last - first + 1, 0).astype(jnp.int32)
+
+    if pages_per_block is None:
+        # Merged-heads VMEM budget (see the decode wrapper) combined with
+        # the prefill wrapper's fp32-scores clamp [group, q_tile, keys].
+        kv_streams = 1 if shared_kv else 2
+        budget = (8 * 2 ** 20) // (
+            2 * kv_heads * head_dim
+            * max(k_cache.dtype.itemsize, 2) * kv_streams)
+        max_keys = max(128, (4 * 2 ** 20) // (4 * group * q_tile))
+        keys = min(1024, max_keys, max(page_size, budget))
+        pages_per_block = max(1, min(keys // page_size,
+                                     page_table.shape[1]))
+
+    has_tail = tail_k is not None
+    if has_tail:
+        if tail_lens is None:
+            raise ValueError(
+                "tail_k requires tail_lens [rows] int32 (valid tail "
+                "tokens per row)")
+        if tail_v is None and not shared_kv:
+            raise ValueError(
+                "tail_k requires tail_v [rows, T, kv_heads, head_dim] "
+                "unless shared_kv=True (single-stream MLA)")
+    else:
+        # Structural placeholders (see the decode wrapper): the kernel
+        # always takes tail refs; has_tail=False makes the fold dead code.
+        tail_k = jnp.zeros((rows, 1, kv_heads, head_dim), q.dtype)
+        tail_lens = jnp.zeros((rows,), jnp.int32)
+    if shared_kv or not has_tail:
+        tail_v = jnp.zeros((rows, 1, kv_heads, head_dim), q.dtype)
+    t_len = tail_k.shape[1]
+
+    # Quantized (fp8 e4m3) cache arm — the merged decode kernel's operand
+    # mode carried over verbatim: flat whole-page view, 1-byte DMAs,
+    # per-round upcast; tails ride in the query dtype (their values were
+    # quantized through the cache when written, so the upcast is exact).
+    quant = k_cache.dtype.itemsize == 1
+    if quant:
+        if shared_kv:
+            raise ValueError(
+                "quantized (fp8) caches are not supported for shared-kv "
+                "(MLA latent) pools")
+        if (kv_heads * page_size) % 32 and not interpret:
+            raise ValueError(
+                f"fp8 pages need kv_heads*page_size % 32 == 0 for "
+                f"Mosaic's 8-bit tiling (got {kv_heads}*{page_size})")
+        flat = (kv_heads * page_size, head_dim)
+        k_cache = k_cache.reshape(k_cache.shape[:-3] + flat)
+        v_cache = v_cache.reshape(v_cache.shape[:-3] + flat)
+
+    q_blocked = q.reshape(num_blocks, q_tile, kv_heads, group, head_dim)
+
+    kernel = functools.partial(
+        _ragged_kernel, page_size=page_size, scale=head_dim ** -0.5,
+        q_tile=q_tile, sliding_window=sliding_window, sinks=int(sinks or 0),
+        pages_per_block=pages_per_block, shared_kv=shared_kv,
+        shared_copy=shared_kv and shared_stream == "copy",
+        has_tail=has_tail, layer_idx=layer_idx, quant=quant,
+    )
+
+    if quant:
+        k_scr = (2, pages_per_block, kv_heads * page_size, head_dim)
+    else:
+        k_scr = (2, pages_per_block, kv_heads, page_size, head_dim)
+    v_scr = (((1,) * len(k_scr))
+             if shared_kv and shared_stream != "copy" else k_scr)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, q_tile, kv_heads, group, head_dim),
+                lambda g, *_prefetch: (g, 0, 0, 0, 0),
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            # Tails ride as whole-array blocks: a q block can span several
+            # rows, so no per-row BlockSpec fits — the kernel indexes rows
+            # dynamically. Tail buffers are burst-sized (rows × steps).
+            pl.BlockSpec(
+                (rows, t_len, kv_heads, head_dim),
+                lambda g, *_prefetch: (0, 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (rows, tail_v.shape[1], kv_heads, head_dim),
+                lambda g, *_prefetch: (0, 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, q_tile, kv_heads, group, head_dim),
+            lambda g, *_prefetch: (g, 0, 0, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(k_scr, k_cache.dtype),
+            pltpu.VMEM(v_scr, k_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (num_blocks, q_tile, kv_heads, group, head_dim), q.dtype
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), row_starts, ctx_lens,
+      block_first, block_rows, tail_lens.astype(jnp.int32),
+      q_blocked, k_cache, v_cache,
+      tail_k.astype(q.dtype), tail_v.astype(q.dtype))
+
+    return out.reshape(total_q, q_heads, head_dim)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("q_tile", "sliding_window", "sinks",
                                     "pages_per_block", "shared_kv",

@@ -355,13 +355,19 @@ def make_pp_pipelined_train_step(mesh: Mesh, cfg: LlamaConfig, params: Params,
         x0 = jnp.zeros((b // M, S, cfg.hidden_size),
                        sp["embed"].dtype)
         ticks = jnp.arange(M + P_size - 1)
+        # The loss accumulator rides the scan carry as shape (1,), not a
+        # scalar: under value_and_grad, shard_map's partial-eval saves the
+        # carry output as a residual, and this jax release's scalar-residual
+        # promotion misses forwarded scan outputs — a float32[] residual
+        # then fails the {0: axes} out-spec rank check (_SpecError).
         (_, loss_sum), _ = jax.lax.scan(
-            tick, (x0, jnp.float32(0.0)), (ticks, in_stream, out_stream))
+            tick, (x0, jnp.zeros((1,), jnp.float32)),
+            (ticks, in_stream, out_stream))
         # Valid losses accumulated on the last stage only, for ticks
         # t >= P-1 … M+P-2 → exactly M microbatches. Average over M, then
         # across the pipeline (sum picks up the last stage's value) and
         # data shards.
-        loss = jax.lax.psum(loss_sum / M, "pp")
+        loss = jax.lax.psum(loss_sum[0] / M, "pp")
         # (Already replicated across tp: every shard computed the same
         # post-psum NLL, so no tp collective is needed here.)
         if dp is not None:

@@ -240,6 +240,13 @@ class EngineTelemetry:
         # Label children resolved once; labels() does a dict lookup + tuple
         # build per call, which the scrape path should not pay repeatedly.
         self._gauge_cache: Dict[str, tuple] = {}
+        # Padding-waste accumulators (on_dispatch_tokens): real vs padded
+        # tokens per device dispatch, fed by the ragged path AND the
+        # padded fallback so the waste ratio compares the schedulers.
+        self._dispatch_real = 0
+        self._dispatch_padded = 0
+        self._dispatches = 0
+        self._last_waste_ratio = 0.0
 
     # -- lifecycle hooks (called by MiniEngine) ---------------------------
 
@@ -350,6 +357,24 @@ class EngineTelemetry:
     def on_restore(self, outcome: str, seconds: Optional[float] = None) -> None:
         collector.record_engine_restore(outcome, seconds)
 
+    def on_dispatch_tokens(self, real: int, dispatched: int) -> None:
+        """Padding-waste accounting for one device dispatch.
+
+        ``real`` tokens of actual work rode a ``dispatched``-token padded
+        program — the gap is pure padding FLOPs. Both the ragged
+        single-kernel path and the padded fallback (prefill buckets,
+        pad-to-max_batch decode) report here, so the
+        ``kvtpu_engine_ragged_*_tokens_total`` counters directly compare
+        the two schedulers' waste.
+        """
+        if dispatched <= 0:
+            return
+        collector.record_ragged_dispatch(self.group, real, dispatched)
+        self._dispatch_real += real
+        self._dispatch_padded += dispatched
+        self._dispatches += 1
+        self._last_waste_ratio = 1.0 - real / dispatched
+
     # -- read side --------------------------------------------------------
 
     def _phase_stats(self, hist) -> dict:
@@ -377,6 +402,12 @@ class EngineTelemetry:
                 "step_seconds": self._phase_stats(self.step_seconds),
             },
             "steps": self._step_counter,
+            "ragged": {
+                "real_tokens_total": self._dispatch_real,
+                "padded_tokens_total": self._dispatch_padded,
+                "last_waste_ratio": self._last_waste_ratio,
+                "dispatches": self._dispatches,
+            },
             "last_profile": self.profiler.last,
         }
 

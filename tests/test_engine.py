@@ -534,3 +534,64 @@ class TestLongContext:
         prompt = np.random.default_rng(1).integers(1, 250, 4096).tolist()
         out = eng.generate("r", prompt, max_new_tokens=2)
         assert len(out) == 2
+
+
+class TestUnpipelinedDecodePadding:
+    """max_batch % pp != 0 runs decode unpipelined (M=1) — that schedule
+    accepts any batch size, so dead-row padding to max_batch only burns
+    per-stage FLOPs. Decode must pad to the power-of-two bucket instead."""
+
+    def _pp_engine(self, max_batch):
+        import jax
+        from jax.sharding import Mesh
+
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+        from llmd_kv_cache_tpu.telemetry.engine_telemetry import (
+            EngineTelemetryConfig,
+        )
+
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                          num_heads=4, num_kv_heads=2, head_dim=16,
+                          intermediate_size=128, page_size=4)
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+        return MiniEngine(EngineConfig(
+            model=cfg, num_pages=128, max_pages_per_seq=16,
+            max_batch=max_batch, model_name="t", pod_identifier="pp-pad",
+            telemetry=EngineTelemetryConfig()), seed=0, mesh=mesh)
+
+    def test_unpipelined_decode_pads_to_bucket_not_max_batch(self):
+        eng = self._pp_engine(max_batch=3)
+        assert eng._pp == 2 and eng._pp_decode_mb == 1
+        prompts = [list(range(10, 22)), list(range(30, 38))]
+        reqs = [eng.add_request(f"r{i}", p, max_new_tokens=2 + 2 * i)
+                for i, p in enumerate(prompts)]
+        dispatches = []
+        orig = eng.telemetry.on_dispatch_tokens
+        eng.telemetry.on_dispatch_tokens = (
+            lambda real, padded: (dispatches.append((real, padded)),
+                                  orig(real, padded)))
+        eng.step()  # both requests decode: one chunk of 2 rows
+        assert dispatches == [(2, 2)], (
+            f"2 active rows must dispatch a 2-row bucket, got {dispatches}")
+        # One request finishes; the lone survivor must ride a 1-row
+        # dispatch, not a max_batch=3 pad.
+        while not reqs[0].done:
+            eng.step()
+        dispatches.clear()
+        eng.step()
+        assert dispatches == [(1, 1)], dispatches
+
+    def test_pipelined_decode_keeps_fixed_shape(self):
+        """max_batch % pp == 0: the microbatch split requires the fixed
+        max_batch shape — padding stays at max_batch by design."""
+        eng = self._pp_engine(max_batch=4)
+        assert eng._pp_decode_mb == 2
+        eng.add_request("r0", list(range(10, 22)), max_new_tokens=2)
+        dispatches = []
+        orig = eng.telemetry.on_dispatch_tokens
+        eng.telemetry.on_dispatch_tokens = (
+            lambda real, padded: (dispatches.append((real, padded)),
+                                  orig(real, padded)))
+        eng.step()
+        assert dispatches == [(1, 4)], dispatches
