@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -78,6 +78,13 @@ bench-ragged: native
 # bytes/step + interpret smoke on CPU, measured ms/step on a real chip.
 bench-fp8: native
 	$(CPU_ENV) $(PY) bench.py --fp8-bandwidth
+
+# Prefill/decode disaggregation gate (offload/handoff): decode-heavy
+# replay where a prefill pod + decode pod pair hands KV off over the
+# transfer tier vs a monolithic baseline; on CPU a correctness + trace-
+# continuity smoke, on a real chip the out_tok/s-at-fixed-TTFT gate.
+bench-disagg: native
+	$(CPU_ENV) $(PY) bench.py --disagg
 
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).

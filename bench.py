@@ -1706,6 +1706,282 @@ def _storage_arm(model_cfg, engine_mod, fresh_indexer, shared_params,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_disagg() -> dict:
+    """Prefill/decode disaggregation vs a monolithic fleet (decode-heavy).
+
+    Two arms over the same decode-heavy replay (short shared-prefix
+    prompts, long generations — the regime where decode batching, not
+    prefill compute, bounds throughput):
+
+    - **baseline**: two monolithic (``role="both"``) pods behind the KV
+      router, served through ``run_concurrent`` — prefill chunks stall
+      the decode batch on every admission.
+    - **disagg**: one ``role="prefill"`` pod streaming chunk-granular
+      KV commits through a shared storage root, one ``role="decode"``
+      pod admitting with ``enqueue(handoff=True)`` — the transferred
+      prefix restores while earlier decodes keep batching, and the
+      decode pod never runs a full local prefill. Routing goes through
+      a real ``IndexerService.get_pod_scores`` call (``role="decode"``,
+      residency-aware), whose traceparent threads through
+      ``HandoffCoordinator.begin`` and both engines so one trace spans
+      GetPodScores → prefill commit → decode first token.
+
+    CPU = correctness smoke (every handoff completes without fallback,
+    transferred blocks actually restore, and the score→commit→decode
+    trace is a single trace id); TPU = the perf gate from the issue:
+    disagg must beat the monolithic baseline on out_tok/s while holding
+    TTFT p50 within 1.25x.
+    """
+    import math
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    import jax
+
+    from llmd_kv_cache_tpu.core import TokenProcessorConfig
+    from llmd_kv_cache_tpu.events.model import EventBatch
+    from llmd_kv_cache_tpu.models import engine as engine_mod
+    from llmd_kv_cache_tpu.models.llama import (LlamaConfig, init_params,
+                                                maybe_fuse_params)
+    from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
+    from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+    from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+    from llmd_kv_cache_tpu.scoring.residency import ResidencyTracker
+    from llmd_kv_cache_tpu.services.indexer_service import (IndexerService,
+                                                            ScoreRequest)
+    from llmd_kv_cache_tpu.telemetry.tracing import recording_tracing
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        model_cfg = LlamaConfig(
+            vocab_size=8192, hidden_size=512, num_layers=4, num_heads=8,
+            num_kv_heads=4, head_dim=128, intermediate_size=1408,
+            page_size=16,
+        )
+        wl_kw = dict(n_requests=24, n_prefixes=6, prefix_len=256,
+                     suffix_len=32, vocab=8000)
+        max_new = 64
+        pod_kw = dict(num_pages=1024, max_pages_per_seq=48,
+                      max_prefill_tokens=128)
+    else:
+        model_cfg = LlamaConfig.tiny()  # page_size 4
+        wl_kw = dict(n_requests=8, n_prefixes=4, prefix_len=8,
+                     suffix_len=4, vocab=4000)
+        max_new = 16
+        # Two prefill chunks per 12-token prompt (chunk cap 8) so the
+        # handoff actually streams; pool sized for every request decoding
+        # concurrently on the single decode pod.
+        pod_kw = dict(num_pages=128, max_pages_per_seq=16,
+                      max_prefill_tokens=2 * model_cfg.page_size)
+    page = model_cfg.page_size
+    workload = build_workload(np.random.default_rng(2026), **wl_kw)
+    n = len(workload)
+    params = maybe_fuse_params(
+        init_params(jax.random.PRNGKey(0), model_cfg), model_cfg)
+
+    def fresh_indexer_cfg():
+        return IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=page))
+
+    # --- baseline: 2 monolithic pods, KV-routed concurrent replay ---
+    base_indexer = Indexer(fresh_indexer_cfg())
+    base_pods = make_pods(2, model_cfg, engine_mod, base_indexer,
+                          params=params, pod_kw=pod_kw)
+    arrivals = [0.0] * n  # burst replay: decode batching under load
+    base_t, base_hit, base_tps, _ = run_concurrent(
+        base_pods, workload, make_kv_router(base_indexer), arrivals,
+        max_new_tokens=max_new, tag="disagg-base")
+    del base_pods
+    base_p50 = statistics.median(base_t)
+
+    # --- disagg: prefill pod → shared storage root → decode pod ---
+    root = tempfile.mkdtemp(prefix="bench-disagg-")
+
+    def spec():
+        return SharedStorageOffloadSpec(
+            root=root, model_name=MODEL_NAME, page_size=page,
+            num_layers=model_cfg.num_layers,
+            kv_heads=model_cfg.num_kv_heads,
+            head_dim=model_cfg.head_dim, io_threads=4,
+            parallel_agnostic=True, dtype="bfloat16",
+        )
+
+    try:
+        svc = IndexerService(fresh_indexer_cfg())
+        tracker = ResidencyTracker()
+        svc.indexer.attach_residency(tracker)
+        coord = HandoffCoordinator(residency=tracker)
+
+        def pod(name, role):
+            def sink(events, pod_name=name):
+                svc.pool.process_event_batch(
+                    EventBatch(timestamp=time.time(), events=list(events)),
+                    pod_name, MODEL_NAME)
+
+            eng = engine_mod.MiniEngine(
+                engine_mod.EngineConfig(
+                    model=model_cfg, model_name=MODEL_NAME,
+                    pod_identifier=name, role=role, handoff_wait_s=60.0,
+                    **pod_kw),
+                event_sink=sink, params=params, seed=0,
+                offload_spec=spec())
+            eng.attach_handoff(coord)
+            return eng
+
+        prefill, decode = pod("prefill-0", "prefill"), pod("decode-0", "decode")
+
+        # Virtual-time accounting as in run_concurrent: one clock per
+        # pod, every enqueue/step's wall time advances it, the pod at
+        # the minimum clock acts next. An admission lands on BOTH pods
+        # (prefill bootstraps and commits; decode waits on the handoff).
+        clocks = {"p": 0.0, "d": 0.0}
+        reqs: dict = {}
+        arr_of: dict = {}
+        ttfts: dict = {}
+        first_emit: dict = {}
+        last_emit: dict = {}
+        n_emitted: dict = {}
+        out_tokens = 0
+        i = 0
+        arm_start = time.perf_counter()
+
+        def p_busy():
+            return bool(prefill._running) or bool(prefill._pending_store_jobs)
+
+        def d_busy():
+            return bool(decode._running)
+
+        with recording_tracing() as exporter:
+            while i < n or p_busy() or d_busy():
+                t_arr = arrivals[i] if i < n else math.inf
+                t_pod, pick = math.inf, None
+                if p_busy():
+                    t_pod, pick = clocks["p"], "p"
+                if d_busy() and clocks["d"] < t_pod:
+                    t_pod, pick = clocks["d"], "d"
+                if t_arr <= t_pod:
+                    rid, prompt = f"r{i}", workload[i]
+                    # Score with the decode role: residency-aware ranks,
+                    # and the response traceparent threads the whole
+                    # handoff under the GetPodScores span.
+                    resp = svc.get_pod_scores(ScoreRequest(
+                        tokens=list(prompt), model_name=MODEL_NAME,
+                        pod_identifiers=["decode-0"], role="decode"))
+                    tp = resp.traceparent or None
+                    _, dpod = HandoffCoordinator.pick_pair(
+                        ["prefill-0"], ["decode-0"],
+                        decode_scores=resp.scores)
+                    coord.begin(rid, "prefill-0", dpod,
+                                total_blocks=len(prompt) // page,
+                                traceparent=tp)
+                    if not p_busy():
+                        clocks["p"] = max(clocks["p"], t_arr)
+                    t0 = time.perf_counter()
+                    prefill.enqueue(rid, prompt, max_new_tokens=1,
+                                    traceparent=tp)
+                    clocks["p"] += time.perf_counter() - t0
+                    if not d_busy():
+                        clocks["d"] = max(clocks["d"], t_arr)
+                    t0 = time.perf_counter()
+                    reqs[rid] = decode.enqueue(rid, prompt,
+                                               max_new_tokens=max_new,
+                                               traceparent=tp, handoff=True)
+                    clocks["d"] += time.perf_counter() - t0
+                    arr_of[rid] = t_arr
+                    i += 1
+                    continue
+                if pick == "p":
+                    t0 = time.perf_counter()
+                    if prefill._running:
+                        prefill.step()  # bootstrap tokens are discarded
+                    prefill.poll_offload()
+                    clocks["p"] += time.perf_counter() - t0
+                    continue
+                t0 = time.perf_counter()
+                emitted = decode.step()
+                clocks["d"] += time.perf_counter() - t0
+                out_tokens += len(emitted)
+                for rid in emitted:
+                    if rid not in first_emit:
+                        ttfts[rid] = clocks["d"] - arr_of[rid]
+                        first_emit[rid] = clocks["d"]
+                        n_emitted[rid] = 1
+                        if len(first_emit) % 8 == 0:
+                            print(f"[bench disagg] {len(first_emit)}/{n} "
+                                  f"first tokens, "
+                                  f"{time.perf_counter() - arm_start:.1f}s",
+                                  file=_sys.stderr, flush=True)
+                    else:
+                        n_emitted[rid] += 1
+                    last_emit[rid] = clocks["d"]
+
+        assert len(ttfts) == n, f"decoded {len(ttfts)} of {n}"
+        dbg = coord.debug()
+        restored = sum(min(r.cached_len, len(workload[int(rid[1:])]))
+                       for rid, r in reqs.items())
+        # Score→serve trace continuity: one trace id must cover the
+        # scorer's span, a prefill commit, and a decode step.
+        def trace_ids(name):
+            return {sp.trace_id for sp in exporter.find(name)}
+        joint = (trace_ids("llm_d.kv_cache.indexer.GetPodScores")
+                 & trace_ids("llm_d.kv_cache.handoff.prefill_commit")
+                 & trace_ids("llm_d.kv_cache.engine.decode_step"))
+        disagg_tps = out_tokens / max(max(clocks.values()), 1e-9)
+        disagg_p50 = statistics.median(ttfts.values())
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    ratio = disagg_tps / max(base_tps, 1e-9)
+    ttft_ratio = disagg_p50 / max(base_p50, 1e-9)
+    completed = int(dbg["completed"])
+    disagg_detail = {
+        "ttft_p50_s": round(disagg_p50, 4),
+        "out_tok_s": round(disagg_tps, 1),
+        "out_tok_s_ratio": round(ratio, 3),
+        "ttft_p50_ratio": round(ttft_ratio, 3),
+        "handoffs_completed": completed,
+        "handoff_fallbacks": int(dbg["failed"]),
+        "restored_tokens": int(restored),
+        "trace_continuity": bool(joint),
+    }
+    baseline_detail = {
+        "ttft_p50_s": round(base_p50, 4),
+        "out_tok_s": round(base_tps, 1),
+        "hit_rate": round(base_hit, 4),
+    }
+    if on_tpu:
+        # The issue's gate: more sustained decode throughput at fixed
+        # (within 1.25x) TTFT p50.
+        return {
+            "metric": "disaggregated handoff out_tok/s vs monolithic "
+                      "(decode-heavy, TTFT p50 held within 1.25x)",
+            "value": round(ratio, 3),
+            "unit": "x monolithic out_tok/s",
+            "vs_baseline": 1.0,
+            "gate_ok": bool(ratio > 1.0 and ttft_ratio <= 1.25),
+            "platform": platform,
+            "baseline": baseline_detail,
+            "disagg": disagg_detail,
+        }
+    # CPU smoke: the perf claim is TPU-only; here the gate is the
+    # correctness of the handoff plane end to end.
+    return {
+        "metric": "disaggregated handoff CPU smoke "
+                  "(completed handoffs, no fallbacks)",
+        "value": completed,
+        "unit": "handoffs",
+        "vs_baseline": n,
+        "gate_ok": bool(completed == n and dbg["failed"] == 0
+                        and restored > 0 and joint),
+        "platform": platform,
+        "baseline": baseline_detail,
+        "disagg": disagg_detail,
+    }
+
+
 def _run_ttft_subprocess(env=None, timeout=2400):
     """Run the TTFT arm in a watchdogged subprocess; returns the JSON
     result line or None. The budget covers the replay arms, the hardened
@@ -1811,6 +2087,8 @@ def _dispatch(argv: list) -> object:
         return bench_snapshot_overhead()
     if "--engine-telemetry" in argv:
         return bench_engine_telemetry()
+    if "--disagg" in argv:
+        return bench_disagg()
     if "--shards" in argv:
         i = argv.index("--shards")
         n = 4

@@ -51,6 +51,13 @@ def main() -> None:
     parser.add_argument("--control-dir", required=True)
     parser.add_argument("--model-name", default="tiny")
     parser.add_argument("--offload-root", default=None)
+    parser.add_argument("--role", default="both",
+                        choices=["both", "prefill", "decode"],
+                        help="disaggregated serving role: 'prefill' pods "
+                             "commit each chunk's KV to the shared store "
+                             "and stop at first token; 'decode' pods pull "
+                             "transferred prefixes via the restore path. "
+                             "Non-default roles require --offload-root.")
     parser.add_argument("--admin-port", default="0",
                         help='admin/metrics endpoint: "0" = off (default), '
                              '"auto" = ephemeral port, else a port number')
@@ -72,10 +79,14 @@ def main() -> None:
             kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
             io_threads=2, parallel_agnostic=True,
         )
+    if args.role != "both" and spec is None:
+        parser.error(f"--role {args.role} requires --offload-root (the "
+                     "handoff moves KV through the shared store)")
     engine = MiniEngine(
         EngineConfig(
             model=cfg, num_pages=64, max_pages_per_seq=16,
             model_name=args.model_name, pod_identifier=args.pod_id,
+            role=args.role,
             telemetry=EngineTelemetryConfig(profile_dir=args.profile_dir),
         ),
         event_sink=publisher.publish,
@@ -83,6 +94,14 @@ def main() -> None:
         seed=0,  # all pods share deterministic params: cross-pod
         #         storage restores must be bit-exact resumable
     )
+    if args.role != "both":
+        # Local coordinator: feeds the kvtpu_handoff_* metrics and, on a
+        # prefill pod, streams chunk commits. Cross-pod availability rides
+        # the store's own BlockStored advertisements in this file-driven
+        # deployment shim.
+        from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
+
+        engine.attach_handoff(HandoffCoordinator())
 
     control = pathlib.Path(args.control_dir)
     control.mkdir(parents=True, exist_ok=True)
@@ -110,9 +129,14 @@ def main() -> None:
                 continue
             served.add(req_file.name)
             req = json.loads(req_file.read_text())
+            max_new = req.get("max_new_tokens", 4)
+            if args.role == "prefill":
+                # Prefill pods never decode: the request ends at the
+                # bootstrap token, its KV committed to the shared store.
+                max_new = 1
             out = engine.generate(
                 req["request_id"], req["prompt"],
-                max_new_tokens=req.get("max_new_tokens", 4),
+                max_new_tokens=max_new,
             )
             if spec is not None:
                 engine.flush_offload()

@@ -9,7 +9,8 @@ an on-call engineer needs into a single JSON report on stdout:
                                  debug provider (per-pod event lag, the
                                  cache-efficiency ledger, engine telemetry, …)
 - ``/metrics`` (parsed)        — the ``kvcache_*`` / ``kv_offload_*`` /
-                                 ``kvtpu_engine_*`` / ``kvtpu_shard_*``
+                                 ``kvtpu_engine_*`` / ``kvtpu_shard_*`` /
+                                 ``kvtpu_handoff_*``
                                  Prometheus families as name → samples
 - ``engine`` (summary)         — when the target is an engine pod: KV-pool
                                  occupancy, request phase percentiles
@@ -19,6 +20,10 @@ an on-call engineer needs into a single JSON report on stdout:
                                  sharded control plane: shard identity,
                                  owned/filtered write counters, and the
                                  consistent-hash ring view
+- ``handoff`` (summary)        — when the pod participates in prefill/
+                                 decode disaggregation: transfer queue
+                                 depth, in-flight store jobs, and the last
+                                 handoff latency
 
 Usage:
   python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
@@ -35,7 +40,8 @@ import sys
 import urllib.error
 import urllib.request
 
-METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_")
+METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
+                   "kvtpu_handoff_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -127,6 +133,32 @@ def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
             "ring_members": ring.get("shards"),
             "ring_version": ring.get("version"),
             "ring_load": ring.get("load"),
+        }
+
+    handoff = report["debug"].get("handoff") if isinstance(report["debug"], dict) else None
+    metrics = report.get("metrics") or {}
+
+    def _gauge(name):
+        samples = metrics.get(name) if isinstance(metrics, dict) else None
+        return samples[0]["value"] if samples else None
+
+    if isinstance(handoff, dict):
+        # Disaggregated pods (offload.handoff debug provider): the live
+        # transfer ledger — is the decode side waiting because stores are
+        # queued, in flight, or failing?
+        report["handoff"] = {
+            "transfer_queue_depth": handoff.get("transfer_queue_depth"),
+            "in_flight_jobs": handoff.get("in_flight_jobs"),
+            "completed": handoff.get("completed"),
+            "failed": handoff.get("failed"),
+            "last_handoff_latency_s": handoff.get("last_handoff_latency_s"),
+        }
+    elif _gauge("kvtpu_handoff_transfer_queue_depth") is not None:
+        # No debug provider (metrics-only endpoint): fall back to the
+        # exported gauges so the section still answers the triage basics.
+        report["handoff"] = {
+            "transfer_queue_depth": _gauge("kvtpu_handoff_transfer_queue_depth"),
+            "in_flight_jobs": _gauge("kvtpu_handoff_in_flight_jobs"),
         }
 
     return report

@@ -118,7 +118,18 @@ class ShardRouter:
             max_workers=max(4, 2 * len(members)),
             thread_name_prefix="kvtpu-shard-fanout",
         )
+        # Residency-aware disaggregated routing (scoring.residency): when
+        # attached, ``score(role="decode")`` adds each decode pod's
+        # transferred-prefix bonus on top of the scatter-gathered prefix
+        # scores — the shards know nothing about in-flight handoffs, the
+        # tracker is router-local state fed by the handoff coordinator.
+        self.residency = None
         self._publish_ring_metrics()
+
+    def attach_residency(self, tracker) -> None:
+        """Wire a :class:`~..scoring.residency.ResidencyTracker` for
+        role-aware decode scoring."""
+        self.residency = tracker
 
     # -- plan cache -------------------------------------------------------
 
@@ -248,9 +259,15 @@ class ShardRouter:
         tokens: Sequence[int],
         model_name: str,
         pod_identifiers: Optional[Sequence[str]] = None,
+        role: str = "",
     ) -> RouterScore:
         """Scatter-gather GetPodScores: returns scores plus degradation
-        detail (shard metadata mirrors the ScoreResponse wire fields)."""
+        detail (shard metadata mirrors the ScoreResponse wire fields).
+
+        ``role="decode"`` adds transferred-prefix residency bonuses when
+        a tracker is attached (``attach_residency``) — same semantics as
+        the embedded indexer's role-aware scoring.
+        """
         started = time.perf_counter()
         result = RouterScore()
         with tracer().span(
@@ -258,6 +275,7 @@ class ShardRouter:
             model=model_name,
             token_count=len(tokens),
             shard_count=len(self.ring.shards),
+            role=role,
         ) as span:
             keys = self.token_processor.tokens_to_kv_block_keys(
                 0, list(tokens), model_name
@@ -289,6 +307,13 @@ class ShardRouter:
                 raise DegradedShardError(result.degraded_shards)
             result.hit_blocks = len(merged)
             result.scores = self.scorer.score(keys, merged)
+            if role == "decode" and self.residency is not None:
+                bonus = self.residency.bonus(
+                    keys,
+                    set(pod_identifiers) if pod_identifiers else None,
+                )
+                for pod, b in bonus.items():
+                    result.scores[pod] = result.scores.get(pod, 0.0) + b
             span.set_attribute("block_count", len(keys))
             span.set_attribute("block_hit_count", len(merged))
             span.set_attribute("rpcs", result.rpcs)

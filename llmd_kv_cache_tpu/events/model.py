@@ -12,6 +12,7 @@ from typing import Any, Optional, Protocol
 EVENT_TYPE_BLOCK_STORED = "BlockStored"
 EVENT_TYPE_BLOCK_REMOVED = "BlockRemoved"
 EVENT_TYPE_ALL_BLOCKS_CLEARED = "AllBlocksCleared"
+EVENT_TYPE_TRANSFER_AVAILABLE = "TransferBlocksAvailable"
 
 
 @dataclass
@@ -71,6 +72,30 @@ class AllBlocksClearedEvent:
     @property
     def type(self) -> str:
         return EVENT_TYPE_ALL_BLOCKS_CLEARED
+
+
+@dataclass
+class TransferBlocksAvailableEvent:
+    """Handoff transfer availability (prefill/decode disaggregation).
+
+    A prefill pod committed ``block_hashes`` for ``request_id`` to the
+    shared transfer tier; the targeted decode pod may pull them now.
+    ``done`` marks the final chunk (no more blocks will be published for
+    this request). Deliberately NOT part of :data:`GenericEvent` — the
+    index pool learns storage residency from the tier's own tokenless
+    BlockStored events; this event is the *streamed per-chunk completion*
+    a remote handoff coordinator forwards to the decode pod, so the pull
+    can start before the prefill tail finishes.
+    """
+
+    request_id: str
+    block_hashes: list[int]
+    decode_pod: str = ""
+    done: bool = False
+
+    @property
+    def type(self) -> str:
+        return EVENT_TYPE_TRANSFER_AVAILABLE
 
 
 GenericEvent = BlockStoredEvent | BlockRemovedEvent | AllBlocksClearedEvent

@@ -30,6 +30,15 @@ DEFAULT_MAX_COST = "2GiB"  # reference cost_aware_memory.go:47-51
 _KEY_COST = 8 + 48  # uint64 key + map slot overhead
 _ENTRY_BASE_COST = 64
 
+# Tier-latency discount hook (ROADMAP item 4 down payment): restore
+# latency is folded as an EMA per tier, and ``tier_discount`` maps it to a
+# multiplicative factor in (0, 1] — 1.0 for an unobserved/fast tier,
+# approaching 0 as observed restore latency dwarfs the baseline. Consumed
+# only by residency-aware scoring (scoring.residency wires it through
+# Indexer.attach_residency); the base prefix scores never see it.
+_TIER_LATENCY_ALPHA = 0.2
+_TIER_DISCOUNT_BASELINE_S = 0.05
+
 
 def _entry_cost(entry: PodEntry) -> int:
     return _ENTRY_BASE_COST + len(entry.pod_identifier) + len(entry.device_tier)
@@ -75,10 +84,38 @@ class CostAwareMemoryIndex(Index):
         self._engine_to_request: LRUCache[BlockHash, list[BlockHash]] = LRUCache(cfg.mapping_size)
         self._total_cost = 0
         self._mu = threading.Lock()
+        # Tier restore-latency EMAs feeding ``tier_discount`` (see module
+        # header); observed by whoever times restores against the tier
+        # (the engine's deferred-restore path via on_restore_latency).
+        self._tier_latency_ema: dict[str, float] = {}
 
     @property
     def total_cost(self) -> int:
         return self._total_cost
+
+    def observe_tier_latency(self, tier: str, seconds: float) -> None:
+        """Fold one restore-latency observation into the tier's EMA."""
+        seconds = max(float(seconds), 0.0)
+        with self._mu:
+            prev = self._tier_latency_ema.get(tier)
+            self._tier_latency_ema[tier] = (
+                seconds if prev is None
+                else prev + _TIER_LATENCY_ALPHA * (seconds - prev)
+            )
+
+    def tier_discount(self, tier: str) -> float:
+        """Restore-latency discount for ``tier`` in (0, 1].
+
+        ``baseline / (baseline + ema)``: 1.0 when the tier has never been
+        observed, ~0.5 at the baseline latency, and decaying toward 0 for
+        tiers whose restores are slow enough that recomputing locally
+        starts to win. Applied only when residency scoring is on.
+        """
+        with self._mu:
+            ema = self._tier_latency_ema.get(tier)
+        if ema is None:
+            return 1.0
+        return _TIER_DISCOUNT_BASELINE_S / (_TIER_DISCOUNT_BASELINE_S + ema)
 
     def lookup(
         self,

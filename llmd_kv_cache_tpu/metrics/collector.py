@@ -570,6 +570,55 @@ def record_ring_load(load: Dict[str, int]) -> None:
         SHARD_RING_PARTITIONS.labels(shard).set(partitions)
 
 
+# --------------------------------------------------------------------------
+# Disaggregated-handoff families (kvtpu_handoff_*): prefill→decode KV
+# transfers over the offload plane — queue depth, in-flight store jobs,
+# per-chunk outcomes, and end-to-end handoff latency (prefill begin to the
+# decode pod holding every transferable block). Fed by
+# offload.handoff.HandoffCoordinator; kvdiag's ``handoff`` section and the
+# docs/architecture.md "Prefill/decode disaggregation" runbook read them.
+# --------------------------------------------------------------------------
+
+HANDOFF_QUEUE_DEPTH = Gauge(
+    "kvtpu_handoff_transfer_queue_depth",
+    "Active prefill-to-decode handoffs not yet completed or failed",
+)
+HANDOFF_IN_FLIGHT_JOBS = Gauge(
+    "kvtpu_handoff_in_flight_jobs",
+    "Handoff store jobs issued to the offload plane and not yet landed",
+)
+HANDOFF_LATENCY = Histogram(
+    "kvtpu_handoff_latency_seconds",
+    "Prefill-begin to decode-resident handoff wall time",
+    buckets=(1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+HANDOFF_CHUNKS = Counter(
+    "kvtpu_handoff_chunks_total",
+    "Per-chunk handoff transfer completions by outcome",
+    ["outcome"],  # landed|failed
+)
+HANDOFF_REQUESTS = Counter(
+    "kvtpu_handoff_requests_total",
+    "Handoff requests by terminal outcome",
+    ["outcome"],  # complete|failed|timeout|fallback
+)
+
+
+def record_handoff_gauges(queue_depth: int, in_flight_jobs: int) -> None:
+    HANDOFF_QUEUE_DEPTH.set(max(queue_depth, 0))
+    HANDOFF_IN_FLIGHT_JOBS.set(max(in_flight_jobs, 0))
+
+
+def record_handoff_chunk(outcome: str) -> None:
+    HANDOFF_CHUNKS.labels(outcome).inc()
+
+
+def record_handoff_request(outcome: str, seconds: Optional[float] = None) -> None:
+    HANDOFF_REQUESTS.labels(outcome).inc()
+    if seconds is not None:
+        HANDOFF_LATENCY.observe(max(seconds, 0.0))
+
+
 _beat_thread: Optional[threading.Thread] = None
 _beat_stop = threading.Event()
 

@@ -187,6 +187,21 @@ class EngineConfig:
     # jax.profiler capture surface. None (default) keeps the step path
     # free of every hook — each site costs one attribute load + branch.
     telemetry: Optional[Any] = None
+    # Disaggregated serving role (offload.handoff): "both" (default —
+    # monolithic pod, prefill and decode), "prefill" (prefill-only pod:
+    # each chunk's full blocks commit write-through to the transfer tier
+    # as they are computed, the request finishes at first token and
+    # decoding happens elsewhere), or "decode" (decode-side pod:
+    # ``enqueue(handoff=True)`` requests wait up to ``handoff_wait_s``
+    # for transferred blocks before falling back to local prefill).
+    # Non-hybrid engines only — hybrid restores are all-or-nothing and
+    # cannot pull a transfer in chunk-granular rounds.
+    role: str = "both"
+    # Decode-side handoff patience: how long a ``handoff=True`` request
+    # waits for the prefill peer's blocks to land before recomputing the
+    # remainder locally. Decodes keep running the whole time (the wait
+    # costs only that request's TTFT, never the running batch).
+    handoff_wait_s: float = 10.0
 
 
 @dataclass
@@ -245,6 +260,11 @@ class Request:
     # fixed from admission until commit; each upload is a host→device
     # round trip). Cleared at prefill finish.
     table_dev: Any = None
+    # Decode-side handoff wait (enqueue(handoff=True) on a decode-role
+    # engine): monotonic deadline until which step() holds this request's
+    # local prefill, polling the transfer tier for the prefill peer's
+    # blocks in re-armed deferred-restore rounds. None once settled.
+    handoff_deadline: Optional[float] = None
 
     @property
     def total_len(self) -> int:
@@ -961,6 +981,32 @@ class MiniEngine:
             # Canonical medium label (matches KV-event medium strings).
             self._offload_medium = offload_spec.medium
 
+        # Disaggregated serving (offload.handoff): a coordinator attached
+        # via attach_handoff turns a "prefill"-role engine into the
+        # transfer's producer (per-chunk write-through commits notify it)
+        # and a "decode"-role engine into its consumer (handoff=True
+        # enqueues wait on it). on_restore_latency is an optional tap fed
+        # each successful deferred-restore's wall time — the serving
+        # assembly wires it into the index's observe_tier_latency so
+        # residency scoring learns the transfer tier's real restore cost.
+        if self.cfg.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"unknown engine role {self.cfg.role!r} "
+                "(expected 'both', 'prefill', or 'decode')")
+        if self.cfg.role != "both" and self.hybrid:
+            raise ValueError(
+                "prefill/decode disaggregation needs a non-hybrid model "
+                "(hybrid restores are all-or-nothing, not chunk-granular)")
+        if self.cfg.role != "both" and self.offload_manager is None:
+            raise ValueError(
+                f"role={self.cfg.role!r} needs an offload spec — the "
+                "handoff moves KV through the shared transfer tier")
+        self.handoff = None
+        # store job id → (request_id, block hashes) for jobs the handoff
+        # coordinator must hear about when they settle.
+        self._handoff_store_jobs: dict[int, tuple[str, list[int]]] = {}
+        self.on_restore_latency: Optional[Callable[[float], None]] = None
+
         # Engine data-plane telemetry: request-lifecycle histograms
         # (TTFT/ITL/TPOT), decimated KV-pool gauge scrapes, per-request
         # flight-recorder events. None when the config leaves it off —
@@ -981,6 +1027,16 @@ class MiniEngine:
 
     # -- admission --
 
+    def attach_handoff(self, coordinator) -> None:
+        """Wire a :class:`~..offload.handoff.HandoffCoordinator`.
+
+        On a "prefill"-role engine every chunk-commit store job reports
+        chunk start/landed/failed to it; on a "decode"-role engine
+        ``enqueue(handoff=True)`` requests consult it to decide between
+        waiting, pulling, and falling back to local prefill.
+        """
+        self.handoff = coordinator
+
     def add_request(self, request_id: str, prompt: Sequence[int],
                     max_new_tokens: int = 16) -> Request:
         """Admit a request: acquire cached prefix pages, allocate the rest,
@@ -993,7 +1049,8 @@ class MiniEngine:
 
     def enqueue(self, request_id: str, prompt: Sequence[int],
                 max_new_tokens: int = 16,
-                traceparent: Optional[str] = None) -> Request:
+                traceparent: Optional[str] = None,
+                handoff: bool = False) -> Request:
         """Admit a request for continuous batching: pages are acquired and
         the storage tier consulted from ``step()``, where prefill runs
         chunk-at-a-time interleaved with decode — a long prompt stalls
@@ -1007,6 +1064,14 @@ class MiniEngine:
         that scored this request) parents the engine's admission/prefill/
         decode-step spans under the scorer's trace — one trace covers
         score→serve. Requests without one create no spans at all.
+
+        ``handoff=True`` (decode-role engines) marks this request as the
+        receiving end of a prefill→decode handoff: ``step()`` holds its
+        local prefill for up to ``cfg.handoff_wait_s``, re-arming the
+        deferred-restore probe as the prefill peer's chunks land on the
+        transfer tier — the KV pull overlaps queueing and the running
+        decode batch. A failed or timed-out transfer falls back to local
+        prefill (the request is never lost).
         """
         if traceparent is not None:
             with tracer().span(
@@ -1030,6 +1095,12 @@ class MiniEngine:
         # chunk can only run once the in-flight burst drains — observed at
         # first schedule (kvcache_engine_admission_delay_seconds).
         req.enqueued_at = time.monotonic()
+        if handoff:
+            if self.hybrid or self.offload_manager is None:
+                raise ValueError(
+                    "handoff=True needs a non-hybrid engine with an "
+                    "offload spec (the transfer arrives via the tier)")
+            req.handoff_deadline = time.monotonic() + self.cfg.handoff_wait_s
         return req
 
     def _admit(self, request_id: str, prompt: Sequence[int],
@@ -1149,6 +1220,18 @@ class MiniEngine:
         req.output.append(first_token)
         if self.telemetry is not None:
             self.telemetry.on_first_token(req.request_id)
+        if self.cfg.role == "prefill" and self.handoff is not None:
+            # Prefill pod: the request's life here ends at first token —
+            # every full block is now committed (the final chunk's store
+            # job just entered the plane), the decode pod recomputes the
+            # partial tail and the bootstrap token itself, so this token
+            # is discarded. Mark the transfer complete-when-settled before
+            # finishing so the coordinator flips ``done`` as the last
+            # store job lands.
+            self.handoff.prefill_finished(req.request_id)
+            req.done = True
+            self._finish(req)
+            return
         if req.max_new_tokens <= 1:
             req.done = True
             self._finish(req)
@@ -1217,7 +1300,13 @@ class MiniEngine:
             logger.warning("storage restore failed for %d blocks", len(pages))
             self.block_manager.free_pages.extend(pages)
             return
-        record_engine_restore("success", time.monotonic() - started)
+        elapsed = time.monotonic() - started
+        record_engine_restore("success", elapsed)
+        if self.on_restore_latency is not None:
+            try:
+                self.on_restore_latency(elapsed)
+            except Exception:  # pragma: no cover  # lint: allow-swallow
+                pass
 
         # Register restored blocks in the prefix cache (no re-store event:
         # the blocks are already on the storage tier; the HBM BlockStored
@@ -1307,7 +1396,15 @@ class MiniEngine:
             record_engine_restore("failure", time.monotonic() - started)
             logger.warning("deferred storage restore failed; recomputing")
             return True
-        record_engine_restore("success", time.monotonic() - started)
+        elapsed = time.monotonic() - started
+        record_engine_restore("success", elapsed)
+        if self.on_restore_latency is not None:
+            # Residency scoring's tier-discount feed (index.cost_aware
+            # .observe_tier_latency when the serving assembly wired it).
+            try:
+                self.on_restore_latency(elapsed)
+            except Exception:  # pragma: no cover  # lint: allow-swallow
+                pass
         page_size = self.cfg.model.page_size
         canonical = self._commit_restored_blocks(
             req, first_missing, hashes, pages
@@ -1320,6 +1417,63 @@ class MiniEngine:
         req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
         req.table_dev = None  # pages may have swapped to canonical
         return True
+
+    def _commit_prefill_chunk(self, req: Request) -> None:
+        """Prefill-role mid-prefill commit: push the blocks this chunk
+        completed into the prefix cache and the transfer tier."""
+        before = req.committed_blocks
+        self._commit_full_blocks(
+            req, upto=req.computed_len // self.cfg.model.page_size)
+        if req.committed_blocks != before:
+            # commit_blocks may have swapped duplicate pages to canonical;
+            # the cached device table would keep scattering into the
+            # abandoned copies.
+            req.table_dev = None
+
+    def _handoff_gate(self, req: Request) -> bool:
+        """Decide whether a handoff-admitted request may prefill locally.
+
+        True once the handoff settled — transfer complete, peer failed
+        (fallback), or deadline hit (timeout) — and prefill proceeds from
+        whatever prefix is resident; False while the wait is live, in
+        which case this step skips the prefill and keeps decoding.
+        """
+        target = len(req.prompt) // self.cfg.model.page_size
+        if req.cached_len // self.cfg.model.page_size >= target:
+            # Every full prompt block is resident; only the partial tail
+            # and the last prompt token remain, and those always
+            # recompute locally.
+            self._handoff_settle(req, "complete")
+            return True
+        st = (self.handoff.state(req.request_id)
+              if self.handoff is not None else None)
+        if st is not None and st.failed:
+            # Prefill peer died mid-handoff (PR 4 recovery semantics):
+            # fall back to local prefill — landed blocks still count,
+            # the request is re-prefilled here, never lost.
+            self._handoff_settle(req, "fallback")
+            return True
+        if time.monotonic() >= req.handoff_deadline:
+            self._handoff_settle(req, "timeout")
+            return True
+        # Re-arm the transfer probe: more peer chunks may have landed
+        # since the last round. The lookup is cheap and a load job starts
+        # only when the stored prefix actually grew.
+        if req.restore_job is None:
+            self._start_deferred_restore(req)
+        if req.restore_job is not None:
+            return False  # pull in flight — polled next step
+        if st is not None and st.done:
+            # Transfer settled and everything restorable was pulled; any
+            # remainder (shed chunks) recomputes locally.
+            self._handoff_settle(req, "complete")
+            return True
+        return False
+
+    def _handoff_settle(self, req: Request, outcome: str) -> None:
+        req.handoff_deadline = None
+        if self.handoff is not None:
+            self.handoff.decode_settled(req.request_id, outcome)
 
     def _restore_from_storage_hybrid(self, req: Request) -> None:
         """Storage restore for hybrid models.
@@ -1569,11 +1723,24 @@ class MiniEngine:
         else:
             req.prefill_pos = pos + len(chunk)
 
-    def _commit_full_blocks(self, req: Request) -> None:
-        """Register newly computed full prompt blocks in the prefix cache."""
+    def _commit_full_blocks(self, req: Request,
+                            upto: Optional[int] = None) -> None:
+        """Register newly computed full prompt blocks in the prefix cache.
+
+        ``upto`` (prefill-role chunk commits) caps the commit at that many
+        leading blocks: each prefill chunk's full blocks enter the prefix
+        cache and the write-through store as they are computed instead of
+        at prefill end, so a decode peer can start pulling chunk 1 while
+        chunk 2 is still on the device.
+        """
         page_size = self.cfg.model.page_size
         n_full = len(req.prompt) // page_size
-        first_new = req.cached_len // page_size
+        if upto is not None:
+            n_full = min(n_full, upto)
+        # committed_blocks, not cached_len: incremental chunk commits
+        # advance it past the admission prefix (they never touch
+        # cached_len — prefill_pos still walks the raw prompt).
+        first_new = max(req.committed_blocks, req.cached_len // page_size)
         if n_full <= first_new:
             return
         new_hashes = req.block_hashes[first_new:n_full]
@@ -1621,6 +1788,12 @@ class MiniEngine:
                     [(h, [page_of[h]]) for h in to_store]
                 )
                 self._pending_store_jobs[job] = list(to_store)
+                if self.handoff is not None and self.cfg.role == "prefill":
+                    # One handoff chunk per store job: the coordinator
+                    # hears landed/failed from the drain that settles it.
+                    self._handoff_store_jobs[job] = (
+                        req.request_id, list(to_store))
+                    self.handoff.on_chunk_start(req.request_id, to_store)
             if self.hybrid and swa_first < n_full:
                 # Group 1: only the in-window committed blocks exist; they
                 # are exactly what a trailing-window restore needs.
@@ -1695,6 +1868,13 @@ class MiniEngine:
                 if req.restore_job is not None:
                     if not self._poll_deferred_restore(req):
                         break
+                # Handoff wait (decode role): hold this request's local
+                # prefill while the prefill peer's transfer is live,
+                # re-arming the restore probe as chunks land. Decodes
+                # below keep running the whole time.
+                if req.handoff_deadline is not None:
+                    if not self._handoff_gate(req):
+                        break
                 prefill_req = req
                 break
         if self._ragged:
@@ -1715,6 +1895,12 @@ class MiniEngine:
                         self._prefill_chunk(req)
                 else:
                     self._prefill_chunk(req)
+                if (req.prefill_pos is not None and self.handoff is not None
+                        and self.cfg.role == "prefill"):
+                    # Prefill pod: commit this chunk's full blocks NOW so
+                    # the transfer streams chunk-granular (the final
+                    # chunk commits in _finish_prefill as usual).
+                    self._commit_prefill_chunk(req)
                 if req.prefill_pos is None:
                     self._finish_prefill(req)
                     if req.output:
@@ -1783,6 +1969,23 @@ class MiniEngine:
                             self.offload_manager.complete_store(stored)
                     else:
                         logger.warning("write-through store job %d failed", res.job_id)
+                ho = self._handoff_store_jobs.pop(res.job_id, None)
+                if ho is not None and self.handoff is not None:
+                    # Prefill-role chunk commit settled: stream the chunk
+                    # completion (or its failure) to the coordinator so the
+                    # decode peer's next probe sees the landed blocks.
+                    ho_rid, ho_hashes = ho
+                    if res.success:
+                        shed = set(res.shed_hashes)
+                        landed = [h for h in ho_hashes if h not in shed]
+                        if landed:
+                            self.handoff.on_chunk_landed(
+                                ho_rid, landed,
+                                shed=[h for h in ho_hashes if h in shed])
+                        else:
+                            self.handoff.on_chunk_failed(ho_rid, ho_hashes)
+                    else:
+                        self.handoff.on_chunk_failed(ho_rid, ho_hashes)
                 if res.corrupt_hashes and self.offload_manager is not None:
                     # Checksum-failed files are already quarantined by the
                     # worker; de-advertise the blocks so no index view keeps
@@ -1812,6 +2015,16 @@ class MiniEngine:
     def _finish(self, req: Request, outcome: str = "finished") -> None:
         if self.telemetry is not None:
             self.telemetry.on_finish(req.request_id, outcome)
+        if req.handoff_deadline is not None:
+            # Aborted while waiting on a transfer: settle the ledger so
+            # the coordinator never holds a ghost entry.
+            self._handoff_settle(req, "failed")
+        if (self.handoff is not None and self.cfg.role == "prefill"
+                and req.prefill_pos is not None):
+            # Prefill-role death/abort mid-prefill: no more chunks will
+            # ever commit — flip the transfer failed so the decode peer
+            # stops waiting and re-prefills the remainder itself.
+            self.handoff.fail(req.request_id, outcome)
         if req.restore_job is not None:
             # Abort with a deferred restore in flight: non-blocking cancel —
             # kvio marks the job cancelled (never scatters) and parks its
@@ -1975,6 +2188,8 @@ class MiniEngine:
                     out[req.request_id] = req.output[-1]
             else:
                 req.prefill_pos = p_pos + len(p_chunk)
+                if self.handoff is not None and self.cfg.role == "prefill":
+                    self._commit_prefill_chunk(req)
         return out
 
     def _decode_batch_arrays(self, chunk: list[Request], rows: int = 0):

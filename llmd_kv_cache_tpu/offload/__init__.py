@@ -7,6 +7,7 @@ CUDA D2H/H2D copy path is replaced by JAX/XLA device→host transfers
 """
 
 from .file_mapper import FileMapper, FileMapperConfig
+from .handoff import HandoffCoordinator, HandoffState
 from .manager import SharedStorageOffloadManager
 from .spec import SharedStorageOffloadSpec
 from .worker import OffloadHandlers, TransferResult
@@ -14,6 +15,8 @@ from .worker import OffloadHandlers, TransferResult
 __all__ = [
     "FileMapper",
     "FileMapperConfig",
+    "HandoffCoordinator",
+    "HandoffState",
     "SharedStorageOffloadManager",
     "SharedStorageOffloadSpec",
     "OffloadHandlers",

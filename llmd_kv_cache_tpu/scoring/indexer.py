@@ -236,6 +236,10 @@ class Indexer:
         # score attribution land in one place.
         self.ledger = CacheEfficiencyLedger()
         self._recorder = flight_recorder()
+        # Residency-aware decode-pod scoring (prefill/decode
+        # disaggregation): None until attach_residency wires a
+        # scoring.residency.ResidencyTracker.
+        self.residency = None
 
     def prefix_cache_stats(self) -> Optional[dict]:
         """Token-processor prefix-cache counters (None when disabled)."""
@@ -261,6 +265,22 @@ class Indexer:
         (no-op for the default strategy)."""
         if hasattr(self.scorer, "group_catalog"):
             self.scorer.group_catalog = group_catalog
+
+    def attach_residency(self, tracker) -> None:
+        """Wire a scoring.residency.ResidencyTracker into role-aware
+        scoring: ``score_tokens(..., role="decode")`` adds each decode
+        pod's transferred-prefix residency bonus (landed blocks full
+        weight, in-flight discounted) on top of the base prefix score.
+        When the index exposes the cost-aware tier-discount hook, the
+        bonus is additionally scaled by the transfer tier's observed
+        restore latency — the discount engages ONLY through this path.
+        """
+        self.residency = tracker
+        fn = getattr(self.kv_block_index, "tier_discount", None)
+        if fn is not None and tracker.tier_discount_fn is None:
+            from ..core.keys import TIER_SHARED_STORAGE
+
+            tracker.tier_discount_fn = lambda: fn(TIER_SHARED_STORAGE)
 
     def attach_liveness(self, liveness) -> None:
         """Wire the event pool's PodLivenessTracker into scoring: pods whose
@@ -289,18 +309,28 @@ class Indexer:
         model_name: str,
         pod_identifiers: Optional[set[str]] = None,
         extra_features: Optional[Sequence[Optional[BlockExtraFeatures]]] = None,
+        role: str = "",
+        detail: Optional[dict] = None,
     ) -> dict[str, float]:
         """Score candidate pods for the given tokens
         (reference ``indexer.go:238-303``).
 
         Returns pod → tier-weighted consecutive-prefix score. Pods in
         ``pod_identifiers`` that hold nothing simply do not appear.
+
+        ``role`` is the requesting scheduler's target pod role ("" =
+        role-agnostic, the legacy behavior). For ``role="decode"`` with a
+        residency tracker attached, each pod's transferred-prefix
+        residency bonus is added on top; when ``detail`` is a dict, the
+        per-pod bonus is written into ``detail["residency"]`` so service
+        responses can surface it.
         """
         with self._tracer.span(
             "llm_d.kv_cache.score_tokens",
             model=model_name,
             token_count=len(tokens),
             pod_count=len(pod_identifiers) if pod_identifiers else 0,
+            role=role,
         ) as span:
             block_keys, keys_arr = (
                 self.token_processor.tokens_to_kv_block_keys_with_array(
@@ -321,6 +351,9 @@ class Indexer:
                 # The C++ fused path knows nothing about liveness; apply the
                 # same degraded-mode weighting the Python scorers use.
                 scores = self.scorer._apply_liveness(scores)
+                scores = self._apply_residency(
+                    scores, block_keys, pod_identifiers, role, detail
+                )
                 self._record_score_decision(
                     model_name, len(block_keys), hit_count, scores
                 )
@@ -337,10 +370,38 @@ class Indexer:
             span.set_attribute("block_hit_ratio", len(key_to_pods) / len(block_keys))
 
             scores = self.scorer.score(block_keys, key_to_pods)
+            scores = self._apply_residency(
+                scores, block_keys, pod_identifiers, role, detail
+            )
             self._record_score_decision(
                 model_name, len(block_keys), len(key_to_pods), scores
             )
             return scores
+
+    def _apply_residency(
+        self,
+        scores: dict[str, float],
+        block_keys: Sequence[BlockHash],
+        pod_identifiers: Optional[set[str]],
+        role: str,
+        detail: Optional[dict],
+    ) -> dict[str, float]:
+        """Add transferred-prefix residency bonuses for decode-role scoring.
+
+        No-op (and zero-cost) unless the request targets decode pods and a
+        residency tracker is attached; block keys are the same canonical
+        chunk keys the index uses, so the tracker's claims line up 1:1.
+        """
+        if role != "decode" or self.residency is None:
+            return scores
+        bonus = self.residency.bonus(block_keys, pod_identifiers)
+        if bonus:
+            scores = dict(scores)
+            for pod, b in bonus.items():
+                scores[pod] = scores.get(pod, 0.0) + b
+        if detail is not None:
+            detail["residency"] = bonus
+        return scores
 
     def _record_score_decision(
         self,

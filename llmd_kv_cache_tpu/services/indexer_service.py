@@ -134,6 +134,12 @@ class ScoreRequest:
     # a shard-targeted request, "" for an unsharded call. Tolerant like
     # ``traceparent``: old peers omit it, old servers ignore it.
     shard: str = ""
+    # Target pod role for disaggregated serving (offload/handoff): ""
+    # (role-agnostic, the legacy behavior), "prefill", or "decode".
+    # "decode" requests additionally earn transferred-prefix residency
+    # bonuses when the serving indexer tracks handoffs. Same tolerance
+    # pattern as ``shard``.
+    role: str = ""
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
@@ -142,6 +148,7 @@ class ScoreRequest:
                 "model_name": self.model_name,
                 "pod_identifiers": self.pod_identifiers,
                 "shard": self.shard,
+                "role": self.role,
             },
             use_bin_type=True,
         )
@@ -154,6 +161,7 @@ class ScoreRequest:
             model_name=d.get("model_name", ""),
             pod_identifiers=list(d.get("pod_identifiers", [])),
             shard=d.get("shard", "") or "",
+            role=d.get("role", "") or "",
         )
 
 
@@ -179,12 +187,19 @@ class ScoreResponse:
     # wire from older peers, ignored by them on receive.
     shard: str = ""
     degraded_shards: list[str] = field(default_factory=list)
+    # Per-pod transferred-prefix residency bonus already folded into
+    # ``scores`` — surfaced separately so a handoff coordinator can see
+    # how much of a decode pod's score is in-flight/landed transfer state
+    # vs indexed cache. Empty for role-agnostic requests and on the wire
+    # from older servers (same tolerance pattern as ``shard``).
+    residency: dict[str, float] = field(default_factory=dict)
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
             {"scores": self.scores, "error": self.error,
              "degraded": self.degraded, "traceparent": self.traceparent,
-             "shard": self.shard, "degraded_shards": self.degraded_shards},
+             "shard": self.shard, "degraded_shards": self.degraded_shards,
+             "residency": self.residency},
             use_bin_type=True,
         )
 
@@ -198,6 +213,7 @@ class ScoreResponse:
             traceparent=d.get("traceparent", "") or "",
             shard=d.get("shard", "") or "",
             degraded_shards=[str(s) for s in d.get("degraded_shards", [])],
+            residency=dict(d.get("residency", {})),
         )
 
 
@@ -431,12 +447,16 @@ class IndexerService:
             parent_traceparent=extract_traceparent(context),
             model=req.model_name,
             tokens=len(req.tokens),
+            role=req.role,
         ):
             try:
+                detail: dict = {}
                 scores = self.indexer.score_tokens(
                     req.tokens,
                     req.model_name,
                     set(req.pod_identifiers) if req.pod_identifiers else None,
+                    role=req.role,
+                    detail=detail,
                 )
                 # During post-restart warmup, serve best-effort scores but
                 # flag them so routers widen their fallback (the wire field
@@ -447,7 +467,8 @@ class IndexerService:
                 # trace ("" when no tracer is active).
                 return ScoreResponse(scores=scores, degraded=degraded,
                                      traceparent=current_traceparent() or "",
-                                     shard=self.shard_id)
+                                     shard=self.shard_id,
+                                     residency=detail.get("residency", {}))
             except Exception as e:
                 logger.exception("GetPodScores failed")
                 return ScoreResponse(error=str(e))
@@ -629,17 +650,20 @@ class IndexerServiceClient:
         tokens: list[int],
         model_name: str,
         pod_identifiers: Optional[list[str]] = None,
+        role: str = "",
     ) -> ScoreResponse:
         """Full-response variant of :meth:`get_pod_scores`: carries the
         ``degraded`` flag and the scorer's ``traceparent`` (hand the
         latter to the chosen engine's ``enqueue`` for score→serve trace
-        continuity)."""
+        continuity). ``role`` targets disaggregated scoring ("decode"
+        adds transferred-prefix residency bonuses on the server)."""
         resp = _call_rpc(
             self._get_pod_scores,
             ScoreRequest(
                 tokens=list(tokens),
                 model_name=model_name,
                 pod_identifiers=list(pod_identifiers or []),
+                role=role,
             ),
             self._timeout,
             self.retry_policy,

@@ -275,6 +275,158 @@ class TestOffloadFaultInjection:
 
 
 @pytest.mark.chaos
+class TestHandoffChaos:
+    """Prefill/decode disaggregation under fault injection: the decode
+    pod's deferred-restore poll stretches (not sinks) under a slow tier,
+    a prefill pod killed mid-transfer triggers local-fallback re-prefill,
+    and a torn transfer chunk is quarantined rather than admitted."""
+
+    def _pair(self, tmp_path, handoff_wait_s=30.0):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+        from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
+        from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+
+        tiny = LlamaConfig.tiny()
+
+        def spec():
+            return SharedStorageOffloadSpec(
+                root=str(tmp_path), model_name="tiny",
+                page_size=tiny.page_size, num_layers=tiny.num_layers,
+                kv_heads=tiny.num_kv_heads, head_dim=tiny.head_dim,
+                io_threads=2, parallel_agnostic=True)
+
+        coord = HandoffCoordinator()
+
+        def engine(pod, role):
+            e = MiniEngine(
+                EngineConfig(model=tiny, num_pages=64, max_pages_per_seq=16,
+                             model_name="tiny", pod_identifier=pod, role=role,
+                             max_prefill_tokens=tiny.page_size,
+                             handoff_wait_s=handoff_wait_s),
+                offload_spec=spec())
+            e.attach_handoff(coord)
+            return e
+
+        return (coord, engine("prefill-0", "prefill"),
+                engine("decode-0", "decode"), tiny.page_size)
+
+    def _reference_output(self, prompt, max_new_tokens):
+        """Monolithic single-pod output at the same prefill chunking (chunk
+        boundaries fix the reduction order, so this is the bit-exact
+        target for every disaggregated path)."""
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        tiny = LlamaConfig.tiny()
+        ref = MiniEngine(
+            EngineConfig(model=tiny, num_pages=64, max_pages_per_seq=16,
+                         model_name="tiny", pod_identifier="ref",
+                         max_prefill_tokens=tiny.page_size))
+        return ref.generate("ref", prompt, max_new_tokens=max_new_tokens)
+
+    def test_slow_tier_restore_overlaps_running_decode(self, tmp_path):
+        from llmd_kv_cache_tpu.offload.worker import FP_LOAD_IO_ERROR
+
+        coord, prefill, decode, page = self._pair(tmp_path)
+        prompt = list(range(70, 82))  # 3 full blocks
+        expected = self._reference_output(prompt, 4)
+
+        local = decode.add_request("local", list(range(10, 22)),
+                                   max_new_tokens=10)
+        coord.begin("h1", "prefill-0", "decode-0",
+                    total_blocks=len(prompt) // page)
+        # enqueue (not add_request): chunked prefill runs from step(), so
+        # commits stream out chunk-by-chunk like a serving pod's.
+        pref = prefill.enqueue("h1", prompt, max_new_tokens=1)
+        hreq = decode.enqueue("h1", prompt, max_new_tokens=4, handoff=True)
+        # Slow tier: the first restore pull hits an injected I/O error and
+        # is retried inside the offload worker — the transfer stretches,
+        # the handoff wait absorbs it.
+        failpoints.arm(FP_LOAD_IO_ERROR, mode="custom", times=1)
+
+        deadline = time.monotonic() + 120.0
+        while not hreq.done and time.monotonic() < deadline:
+            if not pref.done:
+                prefill.step()
+            prefill.poll_offload()  # drain chunk-store completions
+            emitted = decode.step()
+            if not local.done:
+                assert "local" in emitted  # never starved by the wait
+        assert hreq.done
+        assert hreq.output == expected
+        assert hreq.cached_len == len(prompt)  # transferred, not recomputed
+        assert coord.state("h1") is None  # ledger settled
+        assert coord.completed == 1
+
+    def test_prefill_death_mid_transfer_falls_back(self, tmp_path):
+        """Prefill pod killed after chunk 1 of 3: the decode pod keeps the
+        landed chunk, re-prefills the rest locally, and the request
+        completes with the exact monolithic output — never lost."""
+        coord, prefill, decode, page = self._pair(tmp_path)
+        prompt = list(range(130, 142))  # 3 full blocks
+        expected = self._reference_output(prompt, 4)
+
+        coord.begin("h2", "prefill-0", "decode-0", total_blocks=3)
+        prefill.enqueue("h2", prompt, max_new_tokens=1)
+        hreq = decode.enqueue("h2", prompt, max_new_tokens=4, handoff=True)
+
+        prefill.step()           # chunk 1 of 3 computed, store queued
+        prefill.flush_offload()  # ...and landed on the transfer tier
+        st = coord.state("h2")
+        assert st is not None and st.landed_blocks >= 1 and not st.done
+
+        # The decode pod pulls the landed chunk while the transfer is live.
+        deadline = time.monotonic() + 60.0
+        while hreq.cached_len < page and time.monotonic() < deadline:
+            decode.step()
+        assert hreq.cached_len >= page
+
+        prefill.abort_request("h2")  # the pod dies mid-handoff
+        assert coord.state("h2").failed
+
+        while not hreq.done:
+            decode.step()
+        assert hreq.output == expected
+        assert coord.state("h2") is None  # settled as fallback
+        assert coord.failed >= 1
+
+    def test_torn_transfer_chunk_quarantined_not_admitted(self, tmp_path):
+        from llmd_kv_cache_tpu.offload.worker import (
+            FP_STORE_TORN,
+            QUARANTINE_SUFFIX,
+        )
+
+        coord, prefill, decode, page = self._pair(tmp_path)
+        prompt = list(range(200, 212))  # 3 full blocks
+        expected = self._reference_output(prompt, 4)
+
+        failpoints.arm(FP_STORE_TORN, mode="custom", times=1)
+        coord.begin("h3", "prefill-0", "decode-0", total_blocks=3)
+        pref = prefill.enqueue("h3", prompt, max_new_tokens=1)
+        while not pref.done:
+            prefill.step()
+        prefill.flush_offload()
+        torn = pref.block_hashes[0]
+        assert prefill.offload_manager.lookup([torn]) == 1  # tear is silent
+
+        hreq = decode.enqueue("h3", prompt, max_new_tokens=4, handoff=True)
+        deadline = time.monotonic() + 120.0
+        while not hreq.done and time.monotonic() < deadline:
+            decode.step()
+        assert hreq.done
+        # CRC verification caught the tear on the pull: the block was
+        # quarantined + de-advertised, and the request recomputed its whole
+        # prefix locally — a corrupt block never entered the decode pod's
+        # KV (the fresh .bin that may exist now is the decode pod's own
+        # healthy write-through of the recomputed block).
+        assert hreq.output == expected
+        assert hreq.cached_len == 0  # nothing restored from the tier
+        path = decode.offload_handlers.mapper.block_path(torn, 0)
+        assert os.path.exists(path + QUARANTINE_SUFFIX)
+
+
+@pytest.mark.chaos
 class TestRedisFailover:
     def _failover_index(self):
         import sys

@@ -1,0 +1,311 @@
+"""Prefill→decode handoff control plane: coordinator ledger, residency-
+aware scoring, and the transfer-tier latency discount.
+
+The engine-integration and failure halves live in test_failure_recovery.py
+(TestHandoffChaos); this file covers the pure control-plane pieces —
+offload/handoff.py, scoring/residency.py, the index/cost_aware.py tier
+discount, and the role/residency threading through Indexer and the
+scoring service wire.
+"""
+
+import pytest
+
+from llmd_kv_cache_tpu.core import TokenProcessorConfig
+from llmd_kv_cache_tpu.core.keys import (
+    TIER_SHARED_STORAGE,
+    TIER_TPU_HBM,
+    PodEntry,
+)
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator, HandoffState
+from llmd_kv_cache_tpu.scoring import Indexer, IndexerConfig
+from llmd_kv_cache_tpu.scoring.residency import ResidencyTracker
+
+BLOCK = 4
+MODEL = "m"
+
+
+class TestHandoffCoordinator:
+    def test_chunk_streaming_lifecycle(self):
+        coord = HandoffCoordinator()
+        st = coord.begin("r1", "prefill-0", "decode-0", total_blocks=3)
+        assert isinstance(st, HandoffState)
+        assert coord.queue_depth() == 1 and coord.in_flight_jobs() == 0
+
+        coord.on_chunk_start("r1", [11])
+        coord.on_chunk_start("r1", [12, 13])
+        assert coord.in_flight_jobs() == 2
+        coord.on_chunk_landed("r1", [11])
+        st = coord.state("r1")
+        assert st.landed_blocks == 1 and st.in_flight_jobs == 1
+        assert not st.done
+
+        # Last chunk issued; transfer is done once the stores settle.
+        coord.prefill_finished("r1")
+        assert not coord.state("r1").done
+        coord.on_chunk_landed("r1", [12, 13])
+        st = coord.state("r1")
+        assert st.done and not st.failed and st.landed_blocks == 3
+        assert coord.queue_depth() == 0  # done transfers leave the queue
+
+        coord.decode_settled("r1", "complete")
+        assert coord.state("r1") is None  # terminal: ledger entry popped
+        assert coord.completed == 1 and coord.failed == 0
+        assert coord.last_latency_s is not None
+
+    def test_shed_blocks_within_landed_chunk(self):
+        """A store job that lands some blocks and sheds others settles the
+        whole job's in-flight claim exactly once."""
+        coord = HandoffCoordinator()
+        coord.begin("r1", "p", "d", total_blocks=3)
+        coord.on_chunk_start("r1", [1, 2, 3])
+        coord.on_chunk_landed("r1", [1, 2], shed=[3])
+        st = coord.state("r1")
+        assert st.landed_blocks == 2
+        assert st.in_flight_blocks == 0 and st.in_flight_jobs == 0
+
+    def test_failed_chunk_is_not_terminal(self):
+        coord = HandoffCoordinator()
+        coord.begin("r1", "p", "d", total_blocks=2)
+        coord.on_chunk_start("r1", [1])
+        coord.on_chunk_start("r1", [2])
+        coord.on_chunk_failed("r1", [1])
+        st = coord.state("r1")
+        assert not st.failed  # the decode side recomputes the gap
+        coord.prefill_finished("r1")
+        coord.on_chunk_landed("r1", [2])
+        assert coord.state("r1").done
+
+    def test_fail_flips_failed_and_done(self):
+        coord = HandoffCoordinator()
+        coord.begin("r1", "p", "d", total_blocks=3)
+        coord.on_chunk_start("r1", [1])
+        coord.fail("r1", "prefill pod died")
+        st = coord.state("r1")
+        assert st.failed and st.done and st.in_flight_jobs == 0
+        coord.decode_settled("r1", "fallback")
+        assert coord.failed == 1 and coord.completed == 0
+
+    def test_unknown_request_is_a_noop(self):
+        coord = HandoffCoordinator()
+        coord.on_chunk_start("ghost", [1])
+        coord.on_chunk_landed("ghost", [1])
+        coord.on_chunk_failed("ghost", [1])
+        coord.prefill_finished("ghost")
+        coord.fail("ghost")
+        coord.decode_settled("ghost", "complete")
+        assert coord.queue_depth() == 0
+
+    def test_publish_hook_streams_availability_events(self):
+        events = []
+        coord = HandoffCoordinator(publish=events.append)
+        coord.begin("r1", "p", "decode-0", total_blocks=2)
+        coord.on_chunk_start("r1", [1])
+        coord.on_chunk_landed("r1", [1])
+        coord.prefill_finished("r1")
+        coord.on_chunk_start("r1", [2])
+        coord.on_chunk_landed("r1", [2])
+        assert [e.block_hashes for e in events] == [[1], [2]]
+        assert [e.done for e in events] == [False, True]
+        assert all(e.decode_pod == "decode-0" for e in events)
+
+    def test_debug_snapshot(self):
+        coord = HandoffCoordinator()
+        coord.begin("r1", "p", "d", total_blocks=1)
+        coord.on_chunk_start("r1", [1])
+        dbg = coord.debug()
+        assert dbg["transfer_queue_depth"] == 1
+        assert dbg["in_flight_jobs"] == 1
+        assert dbg["completed"] == 0 and dbg["failed"] == 0
+        assert dbg["last_handoff_latency_s"] is None
+
+    def test_pick_pair_prefers_scores_then_list_order(self):
+        pick = HandoffCoordinator.pick_pair
+        assert pick(["p1", "p2"], ["d1", "d2"]) == ("p1", "d1")
+        assert pick(
+            ["p1", "p2"], ["d1", "d2"],
+            prefill_scores={"p2": 3.0},
+            decode_scores={"d1": 0.5, "d2": 2.0},
+        ) == ("p2", "d2")
+        with pytest.raises(ValueError):
+            pick([], ["d1"])
+
+
+class TestResidencyTracker:
+    def test_landed_vs_in_flight_weights(self):
+        tr = ResidencyTracker()
+        tr.on_transfer_started("d0", [1, 2])
+        assert tr.bonus([1, 2]) == {"d0": 1.0}  # 2 × 0.5 in-flight
+        tr.on_landed("d0", [1])
+        assert tr.bonus([1, 2]) == {"d0": 1.5}  # landed counts full
+        tr.on_landed("d0", [2])
+        assert tr.bonus([1, 2]) == {"d0": 2.0}
+
+    def test_bonus_is_consecutive_from_zero(self):
+        tr = ResidencyTracker()
+        tr.on_landed("d0", [1, 3])  # gap at block 2
+        assert tr.bonus([1, 2, 3]) == {"d0": 1.0}
+
+    def test_pod_filter_and_release(self):
+        tr = ResidencyTracker()
+        tr.on_landed("d0", [1])
+        tr.on_landed("d1", [1])
+        assert set(tr.bonus([1])) == {"d0", "d1"}
+        assert set(tr.bonus([1], {"d1"})) == {"d1"}
+        tr.on_released("d1", [1])
+        assert set(tr.bonus([1])) == {"d0"}
+        tr.release_pod_claims("d0")
+        assert tr.bonus([1]) == {}
+
+    def test_tier_discount_scales_bonus(self):
+        tr = ResidencyTracker()
+        tr.on_landed("d0", [1, 2])
+        tr.tier_discount_fn = lambda: 0.25
+        assert tr.bonus([1, 2]) == {"d0": 0.5}
+
+
+class TestTierDiscount:
+    def _index(self):
+        from llmd_kv_cache_tpu.index.cost_aware import CostAwareMemoryIndex
+
+        return CostAwareMemoryIndex()
+
+    def test_unobserved_tier_has_no_discount(self):
+        assert self._index().tier_discount(TIER_SHARED_STORAGE) == 1.0
+
+    def test_discount_decays_with_restore_latency(self):
+        idx = self._index()
+        idx.observe_tier_latency(TIER_SHARED_STORAGE, 0.05)
+        half = idx.tier_discount(TIER_SHARED_STORAGE)
+        assert half == pytest.approx(0.5)  # baseline latency → 0.5
+        slow = self._index()
+        slow.observe_tier_latency(TIER_SHARED_STORAGE, 5.0)
+        assert slow.tier_discount(TIER_SHARED_STORAGE) < 0.05
+        # The EMA folds new observations in instead of replacing: one slow
+        # restore moves the warm index's discount part way, not all the
+        # way, toward the slow tier's.
+        idx.observe_tier_latency(TIER_SHARED_STORAGE, 5.0)
+        folded = idx.tier_discount(TIER_SHARED_STORAGE)
+        assert slow.tier_discount(TIER_SHARED_STORAGE) < folded < half
+
+
+class TestIndexerResidencyScoring:
+    def _indexer(self, index=None):
+        return Indexer(
+            IndexerConfig(token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK)),
+            index=index if index is not None
+            else InMemoryIndex(InMemoryIndexConfig()),
+        )
+
+    def test_decode_role_adds_residency_bonus(self):
+        indexer = self._indexer()
+        tokens = list(range(8))
+        keys = indexer.compute_block_keys(tokens, MODEL)
+        indexer.kv_block_index.add(
+            None, keys,
+            [PodEntry(pod_identifier="pod-a", device_tier=TIER_TPU_HBM)])
+
+        tracker = ResidencyTracker()
+        tracker.on_landed("decode-0", keys)
+        indexer.attach_residency(tracker)
+
+        # Role-agnostic request: legacy scores, no residency applied.
+        assert indexer.score_tokens(tokens, MODEL) == {"pod-a": 2.0}
+        # Decode-role request: the in-transfer pod appears via its bonus,
+        # and the per-pod detail is surfaced for the service response.
+        detail: dict = {}
+        scores = indexer.score_tokens(tokens, MODEL, role="decode",
+                                      detail=detail)
+        assert scores == {"pod-a": 2.0, "decode-0": 2.0}
+        assert detail["residency"] == {"decode-0": 2.0}
+
+    def test_tier_discount_applies_only_with_residency_scoring(self):
+        from llmd_kv_cache_tpu.index.cost_aware import CostAwareMemoryIndex
+
+        index = CostAwareMemoryIndex()
+        indexer = self._indexer(index=index)
+        tokens = list(range(8))
+        keys = indexer.compute_block_keys(tokens, MODEL)
+        index.add(None, keys,
+                  [PodEntry(pod_identifier="pod-a", device_tier=TIER_TPU_HBM)])
+
+        tracker = ResidencyTracker()
+        tracker.on_landed("decode-0", keys)
+        indexer.attach_residency(tracker)
+        # attach_residency wired the index's tier_discount into the tracker.
+        assert tracker.tier_discount_fn is not None
+
+        index.observe_tier_latency(TIER_SHARED_STORAGE, 0.05)  # discount 0.5
+        scores = indexer.score_tokens(tokens, MODEL, role="decode")
+        assert scores["decode-0"] == pytest.approx(1.0)  # 2 blocks × 0.5
+        # The discount never touches base prefix scores — with residency
+        # scoring off (role-agnostic), the slow tier changes nothing.
+        assert scores["pod-a"] == 2.0
+        assert indexer.score_tokens(tokens, MODEL) == {"pod-a": 2.0}
+
+
+class TestServiceRoleThreading:
+    def test_get_pod_scores_threads_role_and_returns_residency(self):
+        from llmd_kv_cache_tpu.events import PoolConfig
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerService,
+            ScoreRequest,
+        )
+
+        svc = IndexerService(
+            IndexerConfig(token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK)),
+            PoolConfig(concurrency=1),
+        )
+        svc.start()
+        try:
+            tokens = list(range(8))
+            keys = svc.indexer.compute_block_keys(tokens, MODEL)
+            svc.indexer.kv_block_index.add(
+                None, keys,
+                [PodEntry(pod_identifier="pod-a", device_tier=TIER_TPU_HBM)])
+            tracker = ResidencyTracker()
+            tracker.on_landed("decode-0", keys[:1])
+            svc.indexer.attach_residency(tracker)
+
+            legacy = svc.get_pod_scores(
+                ScoreRequest(tokens=tokens, model_name=MODEL))
+            assert legacy.residency == {}
+
+            resp = svc.get_pod_scores(
+                ScoreRequest(tokens=tokens, model_name=MODEL, role="decode"))
+            assert resp.scores["decode-0"] == pytest.approx(1.0)
+            assert resp.residency == {"decode-0": pytest.approx(1.0)}
+        finally:
+            svc.stop()
+
+
+class TestEngineRoleValidation:
+    def test_non_both_role_requires_offload_spec(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        with pytest.raises(ValueError, match="offload"):
+            MiniEngine(EngineConfig(
+                model=LlamaConfig.tiny(), num_pages=16, max_pages_per_seq=8,
+                model_name="tiny", pod_identifier="p", role="prefill"))
+
+    def test_handoff_enqueue_requires_offload_spec(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        engine = MiniEngine(EngineConfig(
+            model=LlamaConfig.tiny(), num_pages=16, max_pages_per_seq=8,
+            model_name="tiny", pod_identifier="p"))
+        with pytest.raises(ValueError, match="handoff"):
+            engine.enqueue("r1", list(range(8)), handoff=True)
+
+    def test_unknown_role_rejected(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        with pytest.raises(ValueError, match="role"):
+            MiniEngine(EngineConfig(
+                model=LlamaConfig.tiny(), num_pages=16, max_pages_per_seq=8,
+                model_name="tiny", pod_identifier="p", role="mixed"))

@@ -193,6 +193,49 @@ class TestScoreWireCompat:
         again = ScoreResponse.from_bytes(resp.to_bytes())
         assert again == resp
 
+    def test_legacy_request_decodes_with_empty_role(self):
+        """Role-agnostic peers predate prefill/decode disaggregation —
+        their bytes must keep decoding with ``role=\"\"``."""
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_legacy.bin"))
+        assert req.role == ""
+
+    def test_role_request_decodes_and_ignores_future_keys(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_role.bin"))
+        assert req.tokens == [1, 2, 3, 4]
+        assert req.pod_identifiers == ["decode-1", "decode-2"]
+        assert req.role == "decode"  # handoff_hint silently ignored
+        # Re-encode → re-decode keeps the role.
+        assert ScoreRequest.from_bytes(req.to_bytes()).role == "decode"
+
+    def test_legacy_response_decodes_with_empty_residency(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_legacy.bin"))
+        assert resp.residency == {}
+
+    def test_residency_response_round_trips(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_residency.bin"))
+        assert resp.scores == {"decode-1": 1.5, "decode-2": 0.25}
+        assert resp.traceparent == wire_spec.TRACEPARENT
+        assert resp.residency == {"decode-1": 1.25}
+        again = ScoreResponse.from_bytes(resp.to_bytes())
+        assert again == resp
+
+    def test_old_peer_view_of_residency_bytes(self):
+        """An old decoder reading residency-bearing bytes simply never
+        looks at the new key — the legacy fields stay well-typed."""
+        import msgpack
+
+        d = msgpack.unpackb(load("score_response_residency.bin"), raw=False)
+        assert d["scores"] == {"decode-1": 1.5, "decode-2": 0.25}
+        assert d["error"] == ""
+
     def test_old_peer_view_of_new_bytes(self):
         """What an old decoder does with new bytes: msgpack map decode via
         ``.get`` means the extra keys are simply never read. Simulate by

@@ -171,6 +171,24 @@ def score_fixtures() -> dict[str, bytes]:
             (s("shard"), s("shard-0")),
             (s("degraded_shards"), arr(s("shard-2"))),
         ),
+        # Disaggregated decode pod asking for residency-aware scores: the
+        # ``role`` key arrives the same tolerant way ``shard`` did (plus an
+        # unknown future key decoders must ignore).
+        "score_request_role.bin": mp(
+            (s("tokens"), arr(u(1), u(2), u(3), u(4))),
+            (s("model_name"), s("llama-2-7b")),
+            (s("pod_identifiers"), arr(s("decode-1"), s("decode-2"))),
+            (s("role"), s("decode")),
+            (s("handoff_hint"), nil()),
+        ),
+        # Residency-aware response: per-pod residency bonus detail rides
+        # alongside the merged scores (handoff coordinator input).
+        "score_response_residency.bin": mp(
+            (s("scores"), mp((s("decode-1"), f64(1.5)), (s("decode-2"), f64(0.25)))),
+            (s("error"), s("")),
+            (s("traceparent"), s(TRACEPARENT)),
+            (s("residency"), mp((s("decode-1"), f64(1.25)))),
+        ),
     }
 
 
