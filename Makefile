@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -85,6 +85,13 @@ bench-fp8: native
 # continuity smoke, on a real chip the out_tok/s-at-fixed-TTFT gate.
 bench-disagg: native
 	$(CPU_ENV) $(PY) bench.py --disagg
+
+# Fleet-telemetry overhead gate (telemetry/ + services/telemetry_
+# collector): per-span export cost (identity stamp + seq + ring append)
+# must stay under 1% of the Python-path score p50; also reports
+# /debug/spans pull and trace-assembly round timings.
+bench-fleet: native
+	$(CPU_ENV) $(PY) bench.py --fleet-telemetry
 
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).

@@ -64,6 +64,11 @@ def main() -> None:
     parser.add_argument("--profile-dir", default="",
                         help="enable /debug/profile, writing jax.profiler "
                              "xplane captures here")
+    parser.add_argument("--span-export", action="store_true",
+                        help="fleet telemetry: record finished spans "
+                             "(process identity = the pod id) into a ring "
+                             "served at /debug/spans on --admin-port for "
+                             "the telemetry collector to pull")
     args = parser.parse_args()
 
     cfg = LlamaConfig.tiny()
@@ -112,6 +117,17 @@ def main() -> None:
         admin = AdminServer(port=port, expose_debug=True)
         if engine.telemetry is not None:
             engine.telemetry.attach_admin(admin)
+        if args.span_export:
+            from llmd_kv_cache_tpu.telemetry import (
+                FleetTelemetryConfig,
+                enable_span_export,
+            )
+
+            source = enable_span_export(
+                FleetTelemetryConfig(span_export=True),
+                default_identity=args.pod_id)
+            if source is not None:
+                admin.register_spans_source(source)
         admin.start()
         (control / f"{args.pod_id}.admin_port").write_text(str(admin.port))
 

@@ -100,6 +100,23 @@ def main() -> None:
         help="total wall-clock budget for the SIGTERM graceful drain",
     )
     parser.add_argument(
+        "--span-export", action="store_true",
+        help="fleet telemetry: record finished spans into an in-memory "
+             "ring served at /debug/spans?since=SEQ on --admin-port, for "
+             "the telemetry collector to pull",
+    )
+    parser.add_argument(
+        "--span-export-max-spans", type=int, default=10_000,
+        help="span ring depth; beyond it the oldest span is evicted "
+             "(counted in kvtpu_trace_dropped_spans_total)",
+    )
+    parser.add_argument(
+        "--process-identity", default="",
+        help="logical process name stamped on exported spans (what the "
+             "collector's critical-path attribution groups by); default: "
+             "the shard id, or \"indexer\"",
+    )
+    parser.add_argument(
         "--tokenizer-socket", default=None,
         help="UDS tokenizer sidecar socket for the protobuf prompt-scoring "
              "surface; without it prompts are tokenized in-process "
@@ -139,6 +156,12 @@ def main() -> None:
         "adminPort": args.admin_port,
         "adminHost": args.admin_host,
     }
+    if args.span_export:
+        indexer_cfg_dict["fleetTelemetry"] = {
+            "spanExport": True,
+            "maxSpans": args.span_export_max_spans,
+            "processIdentity": args.process_identity,
+        }
     if args.snapshot_dir:
         indexer_cfg_dict["recoveryConfig"] = {
             "snapshotDir": args.snapshot_dir,

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Deployment entry point: fleet telemetry collector.
+
+One collector per fleet. It polls every pod's admin endpoint
+(``/debug/spans?since=seq`` + ``/metrics``), assembles cross-process
+traces with critical-path attribution, rolls fleet percentiles up per
+role, and tracks multi-window SLO burn rates. Its own admin endpoint
+serves the results:
+
+- ``/debug/traces`` — retained traces (tail-sampled) with critical paths
+- ``/debug/rollup`` — fleet TTFT/ITL/score-latency percentiles per role
+- ``/debug/slo``    — burn rates, thresholds, alert state per SLO
+- ``/metrics``      — the ``kvtpu_fleet_*`` / ``kvtpu_slo_*`` families
+
+Targets come from ``--targets`` (``name=host:port[:role]`` items) or a
+JSON config file (``--config``, the ``fleetTelemetry.collector`` block,
+camelCase). ``hack/kvdiag.py --port <admin-port> --fleet`` snapshots the
+whole surface.
+
+Usage:
+  python examples/telemetry_collector_main.py \
+      --targets shard-0=127.0.0.1:9400:indexer-shard,pod-0=127.0.0.1:9401:decode \
+      --admin-port 9500 [--scrape-interval-s 5]
+  python examples/telemetry_collector_main.py --config collector.json
+"""
+
+import argparse
+import json
+import signal
+import threading
+
+from llmd_kv_cache_tpu.services.telemetry_collector import (
+    CollectorConfig,
+    ScrapeTarget,
+    TelemetryCollector,
+)
+from llmd_kv_cache_tpu.utils.logging import configure_from_env
+
+
+def parse_target(spec: str) -> ScrapeTarget:
+    """``name=host:port[:role]`` (name optional: ``host:port[:role]``)."""
+    name, eq, rest = spec.partition("=")
+    if not eq:
+        name, rest = "", spec
+    parts = rest.split(":")
+    if len(parts) == 3:
+        address, role = f"{parts[0]}:{parts[1]}", parts[2]
+    else:
+        address, role = rest, ""
+    return ScrapeTarget(name=name or address, address=address, role=role)
+
+
+def main() -> None:
+    configure_from_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--targets", default="",
+                        help="comma-separated name=host:port[:role] items")
+    parser.add_argument("--config", default=None,
+                        help="JSON file with the fleetTelemetry.collector "
+                             "block (camelCase; overrides other flags)")
+    parser.add_argument("--admin-port", type=int, default=9500)
+    parser.add_argument("--admin-host", default="127.0.0.1")
+    parser.add_argument("--scrape-interval-s", type=float, default=5.0)
+    parser.add_argument("--slo-latency-threshold-s", type=float, default=2.0,
+                        help="trace duration beyond which the tail sampler "
+                             "always retains the trace")
+    args = parser.parse_args()
+
+    if args.config:
+        with open(args.config, encoding="utf-8") as f:
+            cfg = CollectorConfig.from_dict(json.load(f))
+    else:
+        specs = [t.strip() for t in args.targets.split(",") if t.strip()]
+        if not specs:
+            parser.error("either --targets or --config is required")
+        cfg = CollectorConfig(
+            targets=tuple(parse_target(s) for s in specs),
+            scrape_interval_s=args.scrape_interval_s,
+            admin_port=args.admin_port,
+            host=args.admin_host,
+            slo_latency_threshold_s=args.slo_latency_threshold_s,
+        )
+
+    collector = TelemetryCollector(cfg)
+    collector.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        collector.stop()
+
+
+if __name__ == "__main__":
+    main()

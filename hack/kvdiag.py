@@ -24,9 +24,20 @@ an on-call engineer needs into a single JSON report on stdout:
                                  decode disaggregation: transfer queue
                                  depth, in-flight store jobs, and the last
                                  handoff latency
+- ``fleet`` (``--fleet``)      — when the target is the fleet telemetry
+                                 collector: assembled-trace summaries
+                                 (critical path + processes), per-role
+                                 rollup percentiles, and SLO burn-rate /
+                                 alert state
 
 Usage:
   python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
+  python hack/kvdiag.py --port 9500 --fleet          # collector target
+  python hack/kvdiag.py --targets 127.0.0.1:9400,127.0.0.1:9401
+
+Multi-target scrapes (``--targets``) degrade gracefully: an unreachable
+pod contributes an ``{"error": ...}`` stanza instead of aborting the
+whole report.
 
 Stdlib-only on purpose: this must run inside the most degraded pod
 imaginable (``kubectl exec`` + whatever python is present).
@@ -41,7 +52,8 @@ import urllib.error
 import urllib.request
 
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
-                   "kvtpu_handoff_")
+                   "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
+                   "kvtpu_fleet_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -53,12 +65,25 @@ def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
 
 
 def parse_metrics(text: str) -> dict:
-    """Prometheus text exposition → {family: [{labels, value}, ...]},
-    keeping only this project's metric families."""
-    families: dict[str, list[dict]] = {}
+    """Prometheus text exposition → {family: {"type": t, "samples": [...]}},
+    keeping only this project's metric families.
+
+    ``# TYPE`` lines are retained (previously every ``#`` line was
+    skipped, which threw the family type away): any consumer merging
+    across pods must know summable counters from gauges. Sample names are
+    mapped back to their TYPE'd family (``foo_total``/``foo_bucket`` →
+    family ``foo``) so histogram pieces stay grouped.
+    """
+    types: dict[str, str] = {}
+    families: dict[str, dict] = {}
     for line in text.splitlines():
         line = line.strip()
-        if not line or line.startswith("#"):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
             continue
         name_and_labels, _, value = line.rpartition(" ")
         if not name_and_labels:
@@ -79,11 +104,21 @@ def parse_metrics(text: str) -> dict:
             num = float(value)
         except ValueError:
             continue
-        families.setdefault(name, []).append({"labels": labels, "value": num})
+        family = name
+        for suffix in ("_total", "_bucket", "_sum", "_count", "_created"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                family = name[: -len(suffix)]
+                break
+        fam = families.setdefault(
+            family, {"type": types.get(family, "untyped"), "samples": []})
+        if fam["type"] == "untyped" and family in types:
+            fam["type"] = types[family]
+        fam["samples"].append({"name": name, "labels": labels, "value": num})
     return families
 
 
-def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
+def snapshot(host: str, port: int, timeout: float = 5.0,
+             fleet: bool = False) -> dict:
     base = f"http://{host}:{port}"
     report: dict = {"endpoint": base}
 
@@ -139,7 +174,8 @@ def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
     metrics = report.get("metrics") or {}
 
     def _gauge(name):
-        samples = metrics.get(name) if isinstance(metrics, dict) else None
+        fam = metrics.get(name) if isinstance(metrics, dict) else None
+        samples = fam.get("samples") if isinstance(fam, dict) else None
         return samples[0]["value"] if samples else None
 
     if isinstance(handoff, dict):
@@ -161,21 +197,127 @@ def snapshot(host: str, port: int, timeout: float = 5.0) -> dict:
             "in_flight_jobs": _gauge("kvtpu_handoff_in_flight_jobs"),
         }
 
+    debug = report["debug"] if isinstance(report["debug"], dict) else {}
+    if fleet or "rollup" in debug:
+        report["fleet"] = fleet_summary(debug)
+
+    return report
+
+
+def fleet_summary(debug: dict) -> dict:
+    """Condense the telemetry collector's debug providers (``traces``,
+    ``slo``, ``rollup``) into what an on-call engineer scans first:
+    which traces were kept and why, where the request time went
+    (critical-path head), fleet percentiles per role, and any burning
+    SLOs."""
+    traces = debug.get("traces") or {}
+    slo = debug.get("slo") or {}
+    rollup = debug.get("rollup") or {}
+    out: dict = {
+        "open_traces": traces.get("open_traces"),
+        "assembled_total": traces.get("assembled_total"),
+        "sampled_out_total": traces.get("sampled_out_total"),
+    }
+
+    kept = []
+    for t in traces.get("retained") or []:
+        path = t.get("critical_path") or []
+        head = max(path, key=lambda seg: seg.get("self_time_s", 0.0)) \
+            if path else None
+        kept.append({
+            "trace_id": t.get("trace_id"),
+            "reason": t.get("retained_reason"),
+            "duration_s": t.get("duration_s"),
+            "span_count": t.get("span_count"),
+            "processes": t.get("processes"),
+            "dominant_segment": None if head is None else {
+                "name": head.get("name"),
+                "process": head.get("process"),
+                "self_time_s": head.get("self_time_s"),
+            },
+        })
+    kept.sort(key=lambda t: -(t["duration_s"] or 0.0))
+    out["retained_traces"] = kept
+
+    out["rollup"] = {
+        role: fams for role, fams in rollup.items() if role != "targets"
+    }
+    out["targets"] = rollup.get("targets", {})
+
+    alerts = []
+    for name, view in (slo or {}).items():
+        if not isinstance(view, dict):
+            continue
+        severity = (view.get("alert") or {}).get("severity")
+        if severity:
+            alerts.append({
+                "slo": name,
+                "severity": severity,
+                "burn_rates": view.get("burn_rates"),
+                "error_budget_remaining": view.get("error_budget_remaining"),
+            })
+    out["alerts"] = alerts
+    out["slo"] = slo
+    return out
+
+
+def multi_snapshot(targets: list[str], timeout: float = 5.0,
+                   fleet: bool = False) -> dict:
+    """Snapshot several pods into one report; unreachable pods degrade to
+    an ``{"error": ...}`` stanza instead of aborting the whole report."""
+    report: dict = {"targets": {}}
+    reachable = 0
+    for spec in targets:
+        host, _, port_s = spec.rpartition(":")
+        host = host or "127.0.0.1"
+        try:
+            port = int(port_s)
+        except ValueError:
+            report["targets"][spec] = {"error": f"bad target spec {spec!r}"}
+            continue
+        try:
+            report["targets"][spec] = snapshot(host, port, timeout, fleet=fleet)
+            reachable += 1
+        except OSError as e:
+            report["targets"][spec] = {
+                "error": f"cannot reach {host}:{port}: {e}"}
+    report["reachable"] = reachable
+    report["unreachable"] = len(targets) - reachable
     return report
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--host", default="127.0.0.1")
-    parser.add_argument("--port", type=int, required=True,
+    parser.add_argument("--port", type=int, default=None,
                         help="the indexer's --admin-port (or --metrics-port)")
+    parser.add_argument("--targets", default=None,
+                        help="comma-separated host:port list; unreachable "
+                             "pods degrade to an error stanza per pod")
+    parser.add_argument("--fleet", action="store_true",
+                        help="summarise the telemetry collector's surfaces "
+                             "(retained traces, rollup percentiles, SLO "
+                             "burn state) into a top-level fleet section")
     parser.add_argument("--timeout", type=float, default=5.0)
     parser.add_argument("--out", default=None,
                         help="write the JSON report here instead of stdout")
     args = parser.parse_args(argv)
+    if (args.port is None) == (args.targets is None):
+        parser.error("exactly one of --port / --targets is required")
+
+    if args.targets is not None:
+        specs = [t.strip() for t in args.targets.split(",") if t.strip()]
+        report = multi_snapshot(specs, args.timeout, fleet=args.fleet)
+        payload = json.dumps(report, indent=2, default=repr)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(payload + "\n")
+        else:
+            print(payload)
+        return 0 if report["reachable"] else 2
 
     try:
-        report = snapshot(args.host, args.port, args.timeout)
+        report = snapshot(args.host, args.port, args.timeout, fleet=args.fleet)
     except OSError as e:
         print(json.dumps({"error": f"cannot reach {args.host}:{args.port}: {e}"}),
               file=sys.stderr)

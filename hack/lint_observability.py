@@ -10,8 +10,9 @@ Three AST checks over every ``.py`` file under the given roots (default
 2. **metric names** — every ``Counter``/``Gauge``/``Histogram``/``Summary``
    (and config-bucketed ``BucketHistogram`` / ``bucket_histogram``)
    constructed in the library must start with ``kvcache_``,
-   ``kv_offload_``, ``kvtpu_engine_``, ``kvtpu_shard_``, or
-   ``kvtpu_handoff_`` so dashboards can select the project's families
+   ``kv_offload_``, ``kvtpu_engine_``, ``kvtpu_shard_``,
+   ``kvtpu_handoff_``, ``kvtpu_slo_``, ``kvtpu_trace_``, or
+   ``kvtpu_fleet_`` so dashboards can select the project's families
    with one matcher.
 3. **docs coverage** — every metric name constructed in the library, and
    every fully-literal span name, must appear in
@@ -30,7 +31,8 @@ from pathlib import Path
 
 SPAN_PREFIX = "llm_d.kv_cache."
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
-                   "kvtpu_handoff_")
+                   "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
+                   "kvtpu_fleet_")
 METRIC_CLASSES = frozenset({
     "Counter", "Gauge", "Histogram", "Summary",
     # The engine-telemetry histogram primitive with config-driven buckets

@@ -276,6 +276,7 @@ class ShardRouter:
             token_count=len(tokens),
             shard_count=len(self.ring.shards),
             role=role,
+            process="router",
         ) as span:
             keys = self.token_processor.tokens_to_kv_block_keys(
                 0, list(tokens), model_name

@@ -9,6 +9,7 @@ prometheus_client registry, plus an optional periodic "metrics beat" log line
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
@@ -299,7 +300,16 @@ def record_drain(seconds: float) -> None:
 
 
 class BucketHistogram:
-    __slots__ = ("name", "documentation", "bounds", "_counts", "_sum", "_count", "_lock")
+    __slots__ = (
+        "name",
+        "documentation",
+        "bounds",
+        "_counts",
+        "_sum",
+        "_count",
+        "_exemplars",
+        "_lock",
+    )
 
     def __init__(self, name: str, documentation: str, buckets: Sequence[float]):
         bounds = tuple(sorted(float(b) for b in buckets))
@@ -311,14 +321,25 @@ class BucketHistogram:
         self._counts = [0] * (len(bounds) + 1)  # per-bucket, +inf last
         self._sum = 0.0
         self._count = 0
+        # Per-bucket last exemplar: (trace_id_hex, value, unix_ts) or None.
+        # Keeping only the latest per bucket bounds memory and matches the
+        # OpenMetrics intent: link a bucket to *a* representative trace.
+        self._exemplars: list = [None] * (len(bounds) + 1)
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         idx = bisect_left(self.bounds, value)
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if trace_id:
+                self._exemplars[idx] = (trace_id, float(value), time.time())
+
+    def exemplars(self) -> list:
+        """Per-bucket ``(trace_id, value, timestamp) | None``, +Inf last."""
+        with self._lock:
+            return list(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -375,6 +396,7 @@ class BucketHistogram:
             self._counts = [0] * (len(self.bounds) + 1)
             self._sum = 0.0
             self._count = 0
+            self._exemplars = [None] * (len(self.bounds) + 1)
 
     def _sample_buckets(self) -> Iterable[Tuple[str, int]]:
         snap = self.snapshot()
@@ -387,19 +409,35 @@ _bucket_collector_registered = False
 
 
 class _BucketHistogramCollector:
-    """Exports every BucketHistogram as a Prometheus histogram family."""
+    """Exports every BucketHistogram as a Prometheus histogram family.
+
+    Buckets carry their last trace-id exemplar (when one was observed) so
+    the OpenMetrics exposition (``/metrics?format=openmetrics``) renders
+    ``... # {trace_id="..."} value ts`` and a bad bucket links straight to
+    a retained trace in the fleet collector. The classic text format
+    silently drops exemplars — that path is unchanged.
+    """
 
     def collect(self):
-        from prometheus_client.core import HistogramMetricFamily
+        from prometheus_client.core import Exemplar, HistogramMetricFamily
 
         with _bucket_hist_lock:
             hists = list(_BUCKET_HISTOGRAMS.values())
         for h in hists:
             snap = h.snapshot()
+            exemplars = h.exemplars()
+            buckets = []
+            for i, (le, cum) in enumerate(snap["buckets"].items()):
+                ex = exemplars[i] if i < len(exemplars) else None
+                if ex is not None:
+                    trace_id, value, ts = ex
+                    buckets.append(
+                        (le, cum, Exemplar({"trace_id": trace_id}, value, ts))
+                    )
+                else:
+                    buckets.append((le, cum))
             fam = HistogramMetricFamily(h.name, h.documentation)
-            fam.add_metric(
-                [], buckets=list(snap["buckets"].items()), sum_value=snap["sum"]
-            )
+            fam.add_metric([], buckets=buckets, sum_value=snap["sum"])
             yield fam
 
 
@@ -617,6 +655,28 @@ def record_handoff_request(outcome: str, seconds: Optional[float] = None) -> Non
     HANDOFF_REQUESTS.labels(outcome).inc()
     if seconds is not None:
         HANDOFF_LATENCY.observe(max(seconds, 0.0))
+
+
+# --------------------------------------------------------------------------
+# Fleet observability (kvtpu_trace_*): local span-export health. The ring
+# exporter (telemetry/tracing.py) evicts oldest spans once full; every
+# eviction lands here so a collector whose pull cursor lags the ring can
+# tell "no spans" apart from "spans dropped before I pulled".
+# --------------------------------------------------------------------------
+
+TRACE_DROPPED_SPANS = Counter(
+    "kvtpu_trace_dropped_spans_total",
+    "Finished spans evicted from the in-memory ring exporter before export",
+)
+TRACE_EXPORTED_SPANS = Counter(
+    "kvtpu_trace_exported_spans_total",
+    "Finished spans handed to remote pullers via /debug/spans",
+)
+
+
+def record_spans_exported(count: int) -> None:
+    if count > 0:
+        TRACE_EXPORTED_SPANS.inc(count)
 
 
 _beat_thread: Optional[threading.Thread] = None

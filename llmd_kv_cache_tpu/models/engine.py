@@ -1079,6 +1079,7 @@ class MiniEngine:
                 parent_traceparent=traceparent,
                 request_id=request_id,
                 prompt_tokens=len(prompt),
+                process=self.cfg.pod_identifier,
             ) as sp:
                 req = self._admit(request_id, prompt, max_new_tokens,
                                   defer_restore=True)
@@ -1891,6 +1892,7 @@ class MiniEngine:
                         parent_traceparent=req.traceparent,
                         request_id=req.request_id,
                         prefill_pos=req.prefill_pos,
+                        process=self.cfg.pod_identifier,
                     ):
                         self._prefill_chunk(req)
                 else:
@@ -2130,6 +2132,7 @@ class MiniEngine:
                 parent_traceparent=prefill_req.traceparent,
                 request_id=prefill_req.request_id,
                 prefill_pos=p_pos,
+                process=self.cfg.pod_identifier,
             )
         try:
             if span_cm is not None:
@@ -2170,6 +2173,7 @@ class MiniEngine:
                         request_id=req.request_id,
                         tokens=1,
                         computed_len=req.computed_len,
+                        process=self.cfg.pod_identifier,
                     ):
                         pass  # event-style span: marks the emission point
                 if len(req.output) >= req.max_new_tokens:
@@ -2291,6 +2295,7 @@ class MiniEngine:
                     request_id=req.request_id,
                     tokens=taken,
                     computed_len=req.computed_len,
+                    process=self.cfg.pod_identifier,
                 ):
                     pass  # event-style span: marks the emission point
             if len(req.output) >= req.max_new_tokens:
@@ -2370,6 +2375,7 @@ class MiniEngine:
                     request_id=req.request_id,
                     tokens=1,
                     computed_len=req.computed_len,
+                    process=self.cfg.pod_identifier,
                 ):
                     pass  # event-style span: marks the emission point
             if len(req.output) >= req.max_new_tokens:

@@ -143,6 +143,7 @@ class HandoffCoordinator:
                 prefill_pod=prefill_pod,
                 decode_pod=decode_pod,
                 total_blocks=st.total_blocks,
+                process=prefill_pod,
             ):
                 pass  # event-style span: marks the pairing decision
         self._update_gauges()
@@ -183,6 +184,7 @@ class HandoffCoordinator:
                 st.done = True
             tp = st.traceparent
             decode_pod = st.decode_pod
+            prefill_pod = st.prefill_pod
             done = st.done
             landed = st.landed_blocks
             total = st.total_blocks
@@ -199,6 +201,7 @@ class HandoffCoordinator:
                 blocks=len(block_hashes),
                 landed_blocks=landed,
                 total_blocks=total,
+                process=prefill_pod,
             ):
                 pass  # event-style span: one per landed chunk
         if self.publish is not None:
@@ -295,6 +298,7 @@ class HandoffCoordinator:
                 outcome=outcome,
                 landed_blocks=st.landed_blocks,
                 total_blocks=st.total_blocks,
+                process=st.decode_pod,
             ):
                 pass  # event-style span: terminal handoff outcome
         if self.residency is not None:

@@ -9,6 +9,7 @@ tokens, and how much of it" in a single in-process call.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -125,6 +126,10 @@ class IndexerConfig:
     # a service built from this config ingests as one shard replica
     # (ShardFilterIndex); routers use the same config to fan out.
     cluster_config: Optional["ClusterConfig"] = None
+    # Fleet observability (telemetry/fleet.py): None disables span export;
+    # with spanExport set, the admin endpoint serves /debug/spans for the
+    # fleet telemetry collector.
+    fleet_telemetry: Optional["FleetTelemetryConfig"] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
@@ -154,6 +159,11 @@ class IndexerConfig:
             from ..cluster.config import ClusterConfig
 
             cfg.cluster_config = ClusterConfig.from_dict(cluster_dict)
+        fleet_dict = d.get("fleetTelemetry", d.get("fleet_telemetry"))
+        if fleet_dict:
+            from ..telemetry.fleet import FleetTelemetryConfig
+
+            cfg.fleet_telemetry = FleetTelemetryConfig.from_dict(fleet_dict)
         index_dict = d.get("kvBlockIndexConfig", d.get("index_config"))
         if index_dict:
             from ..index.cost_aware import CostAwareMemoryIndexConfig
@@ -211,6 +221,16 @@ class Indexer:
             block_size_tokens=self.token_processor.block_size,
         )
         self._tracer = tracer()
+        # Score-path latency histogram, exemplar-linked to the request's
+        # trace so a slow bucket on /metrics points at a retained trace in
+        # the fleet collector (docs/observability.md "Fleet observability").
+        from ..metrics.collector import bucket_histogram
+
+        self._score_latency = bucket_histogram(
+            "kvcache_score_latency_seconds",
+            "score_tokens wall time (keys to merged pod scores)",
+            (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0),
+        )
         # Fused native lookup+score fast path (NativeIndex only): the whole
         # scheduler hot loop stays in C++. Only the LongestPrefix strategy
         # has a native twin; other strategies take the Python path.
@@ -325,6 +345,30 @@ class Indexer:
         per-pod bonus is written into ``detail["residency"]`` so service
         responses can surface it.
         """
+        t0 = time.perf_counter()
+        trace_ref: list = [None]
+        try:
+            return self._score_tokens_traced(
+                tokens, model_name, pod_identifiers, extra_features,
+                role, detail, trace_ref,
+            )
+        finally:
+            tp = trace_ref[0]
+            self._score_latency.observe(
+                time.perf_counter() - t0,
+                trace_id=None if tp is None else tp[3:35],
+            )
+
+    def _score_tokens_traced(
+        self,
+        tokens: Sequence[int],
+        model_name: str,
+        pod_identifiers: Optional[set[str]],
+        extra_features: Optional[Sequence[Optional[BlockExtraFeatures]]],
+        role: str,
+        detail: Optional[dict],
+        trace_ref: list,
+    ) -> dict[str, float]:
         with self._tracer.span(
             "llm_d.kv_cache.score_tokens",
             model=model_name,
@@ -332,6 +376,9 @@ class Indexer:
             pod_count=len(pod_identifiers) if pod_identifiers else 0,
             role=role,
         ) as span:
+            # RecordedSpan exposes .traceparent; the no-op/otel spans do
+            # not — no exemplar in those modes (documented caveat).
+            trace_ref[0] = getattr(span, "traceparent", None)
             block_keys, keys_arr = (
                 self.token_processor.tokens_to_kv_block_keys_with_array(
                     0, tokens, model_name, extra_features))

@@ -12,6 +12,14 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
 - ``/debug/profile?duration_s=N`` — on-demand ``jax.profiler`` capture
   (guarded: 404 unless the owner registered a capture callable via
   :meth:`AdminServer.register_profiler`; one capture at a time → 409)
+- ``/debug/spans?since=SEQ`` — finished spans from the process's ring
+  exporter, newer than the puller's cursor (404 until the owner calls
+  :meth:`AdminServer.register_spans_source`). The fleet telemetry
+  collector polls this to assemble cross-process traces.
+
+``/metrics?format=openmetrics`` switches the exposition to OpenMetrics,
+the only text format that renders exemplars (trace-id links on
+``BucketHistogram`` buckets).
 
 Deliberately stdlib-only (``http.server``): the endpoint must work in the
 most degraded pod imaginable — that is exactly when it is needed. Disabled
@@ -56,6 +64,7 @@ class AdminServer:
         self._providers: dict[str, Callable[[], object]] = {}
         self._health = health
         self._profiler: Optional[Callable[[float], dict]] = None
+        self._spans_source: Optional[Callable[[int], dict]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -72,6 +81,13 @@ class AdminServer:
         not let arbitrary HTTP clients spin up the profiler."""
         self._profiler = capture
 
+    def register_spans_source(self, source: Callable[[int], dict]) -> None:
+        """Enable ``/debug/spans``: ``source(since_seq)`` returns the
+        ring exporter's ``export_since`` payload (spans + cursor + drops).
+        Typically ``InMemorySpanExporter.export_since``. 404 until set —
+        span export is opt-in per pod (``fleetTelemetry.spanExport``)."""
+        self._spans_source = source
+
     def set_health_provider(self, provider: Callable[[], dict]) -> None:
         """Make ``/healthz`` report ``provider()`` instead of the static
         ok. A payload whose ``status`` is not ``"ok"`` is served with 503
@@ -86,10 +102,35 @@ class AdminServer:
 
     # -- request handling --------------------------------------------------
 
-    def _metrics_payload(self) -> tuple[bytes, str]:
+    def _metrics_payload(self, fmt: str = "") -> tuple[bytes, str]:
+        if fmt == "openmetrics":
+            from prometheus_client import REGISTRY
+            from prometheus_client.openmetrics.exposition import (
+                CONTENT_TYPE_LATEST as OPENMETRICS_CONTENT_TYPE,
+                generate_latest as generate_openmetrics,
+            )
+
+            return generate_openmetrics(REGISTRY), OPENMETRICS_CONTENT_TYPE
         from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
 
         return generate_latest(), CONTENT_TYPE_LATEST
+
+    def _handle_spans(self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._spans_source is None:
+            return (404, b'{"error": "span export not configured"}',
+                    "application/json")
+        raw = query.get("since", ["-1"])[-1]
+        try:
+            since = int(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad since: {raw!r}"}).encode(), "application/json")
+        try:
+            payload = self._spans_source(since)
+        except Exception as exc:
+            return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(payload, default=repr).encode(),
+                "application/json")
 
     def _debug_vars(self) -> dict:
         payload: dict = {
@@ -144,11 +185,14 @@ class AdminServer:
             status = 200 if payload.get("status") == "ok" else 503
             return status, json.dumps(payload, default=repr).encode(), "application/json"
         if path == "/metrics":
-            body, ctype = self._metrics_payload()
+            fmt = (query or {}).get("format", [""])[-1]
+            body, ctype = self._metrics_payload(fmt)
             return 200, body, ctype
         if self._expose_debug:
             if path == "/debug/profile":
                 return self._handle_profile(query or {})
+            if path == "/debug/spans":
+                return self._handle_spans(query or {})
             if path == "/debug/flight-recorder":
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"

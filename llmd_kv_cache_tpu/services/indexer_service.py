@@ -288,6 +288,17 @@ class IndexerService:
         cc = self.indexer.config.cluster_config
         return cc.shard_id if cc is not None else ""
 
+    @property
+    def process_name(self) -> str:
+        """Span attribution identity: an explicitly configured fleet
+        process identity wins over the shard id, so an unsharded pod
+        launched with --process-identity groups consistently in the
+        collector's critical-path view."""
+        ft = self.indexer.config.fleet_telemetry
+        if ft is not None and ft.process_identity:
+            return ft.process_identity
+        return self.shard_id or "indexer"
+
     def attach_peer_digest_source(self) -> None:
         """Cross-replica anti-entropy: reconcile the locally-owned key
         range against the union of the other replicas' advertised views
@@ -372,6 +383,18 @@ class IndexerService:
             providers=providers,
             health=health,
         )
+        # Fleet span export: /debug/spans on every admin server, backed by
+        # the (shared) recording ring exporter. The collector pulls from
+        # here to assemble cross-process traces.
+        ft = self.indexer.config.fleet_telemetry
+        if ft is not None:
+            from ..telemetry.fleet import enable_span_export
+
+            source = enable_span_export(
+                ft, default_identity=self.process_name)
+            if source is not None:
+                for server in self._observability_servers:
+                    server.register_spans_source(source)
 
     def stop(self) -> None:
         for server in self._observability_servers:
@@ -448,6 +471,7 @@ class IndexerService:
             model=req.model_name,
             tokens=len(req.tokens),
             role=req.role,
+            process=self.process_name,
         ):
             try:
                 detail: dict = {}
@@ -487,6 +511,7 @@ class IndexerService:
             "llm_d.kv_cache.indexer.LookupBlocks",
             parent_traceparent=extract_traceparent(context),
             keys=len(keys),
+            process=self.process_name,
         ):
             hits: list = []
             if keys:
@@ -545,6 +570,7 @@ class IndexerService:
                 parent_traceparent=extract_traceparent(ctx),
                 model=req.model_name,
                 wire="protobuf",
+                process=self.process_name,
             ):
                 tokens = list(self.tokenize(req.prompt, req.model_name))
                 scores = self.indexer.score_tokens(

@@ -1,0 +1,731 @@
+"""Fleet telemetry collector: trace assembly, metric rollup, SLO burn rates.
+
+The fleet-level half of the observability stack (ISSUE 10). One collector
+process polls every pod's admin endpoint and turns per-process telemetry
+into fleet answers:
+
+- **Cross-process trace assembly** — pulls finished spans from each
+  target's ``/debug/spans?since=seq`` (the ring exporter's cursor API),
+  groups them by trace id across processes, and once a trace goes idle
+  computes its **critical path**: the chain of span segments that actually
+  gated the request end-to-end (score fan-out → handoff transfer →
+  admission queue → prefill chunks → decode steps), with per-segment
+  *self time* (span wall time not covered by on-path children). Spans are
+  deduped by span id, so at-least-once pulls and shared in-process
+  exporters are safe.
+- **Tail-based sampling** — a trace is retained when it breached the SLO
+  latency threshold, or belongs to the K-slowest reservoir, or wins the
+  head-sample lottery (hash of the trace id, so the decision is stable
+  across collectors). Everything else is dropped after accounting.
+- **Metric rollup** — scrapes every target's ``/metrics`` and merges
+  families type-correctly (``telemetry/rollup.py``), serving fleet
+  TTFT/ITL/score-latency percentiles per role from ``/debug/rollup``.
+- **SLO burn rates** — feeds threshold SLIs (TTFT, score latency, target
+  availability) into ``telemetry/slo.py`` trackers; alert state lives at
+  ``/debug/slo`` and in the ``kvtpu_slo_*`` families.
+
+Scrapes ride the PR 1 resilience primitives: per-target
+:class:`CircuitBreaker` plus a jittered :class:`RetryPolicy`, so one dead
+pod degrades that target's freshness instead of stalling the round.
+Stdlib-only transport (``urllib``): the collector must run on the most
+degraded image available.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from prometheus_client import Counter, Gauge
+
+from ..resilience.policy import CircuitBreaker, RetryPolicy, call_with_retry
+from ..telemetry.rollup import (
+    MetricFamily,
+    merge_families,
+    parse_exposition,
+    rollup_percentiles,
+)
+from ..telemetry.slo import SLOConfig, SLORegistry
+from ..telemetry.tracing import RecordedSpan, tracer
+from ..utils.logging import get_logger
+from .admin import AdminServer
+
+logger = get_logger("services.telemetry_collector")
+
+FLEET_SCRAPES = Counter(
+    "kvtpu_fleet_scrapes_total",
+    "Collector scrape attempts per target and outcome",
+    ["target", "outcome"],  # success|failure|skipped (breaker open)
+)
+FLEET_SPANS_INGESTED = Counter(
+    "kvtpu_fleet_spans_ingested_total",
+    "Spans pulled from pod ring exporters (post-dedupe)",
+)
+FLEET_TRACES_ASSEMBLED = Counter(
+    "kvtpu_fleet_traces_assembled_total",
+    "Traces finalized by the assembler (idle-timeout reached)",
+)
+FLEET_TRACES_RETAINED = Counter(
+    "kvtpu_fleet_traces_retained_total",
+    "Finalized traces retained by the tail sampler, by reason",
+    ["reason"],  # slo_breach|k_slowest|head_sample
+)
+FLEET_TARGETS_REACHABLE = Gauge(
+    "kvtpu_fleet_targets_reachable",
+    "Targets whose last scrape round succeeded",
+)
+
+# Fleet-level serving histograms worth rolling up, per role.
+_ROLLUP_FAMILIES = (
+    "kvtpu_engine_ttft_seconds",
+    "kvtpu_engine_itl_seconds",
+    "kvcache_score_latency_seconds",
+)
+
+
+@dataclass(frozen=True)
+class ScrapeTarget:
+    """One pod admin endpoint: ``address`` is ``host:port``."""
+
+    name: str
+    address: str
+    role: str = ""  # prefill|decode|indexer-shard|router|""
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ScrapeTarget":
+        return cls(
+            name=str(data.get("name") or data.get("address", "")),
+            address=str(data["address"]),
+            role=str(data.get("role", "")),
+        )
+
+
+@dataclass(frozen=True)
+class CollectorConfig:
+    """``fleetTelemetry.collector`` config block (camelCase in files)."""
+
+    targets: Tuple[ScrapeTarget, ...] = ()
+    scrape_interval_s: float = 5.0
+    admin_port: int = 0
+    host: str = "127.0.0.1"
+    # Trace assembly/sampling.
+    trace_idle_s: float = 1.0
+    max_traces: int = 256
+    k_slowest: int = 8
+    head_sample_rate: float = 0.01
+    slo_latency_threshold_s: float = 2.0
+    # SLO thresholds/objectives.
+    ttft_threshold_s: float = 2.0
+    ttft_objective: float = 0.99
+    score_threshold_s: float = 0.1
+    score_objective: float = 0.99
+    availability_objective: float = 0.999
+    fast_windows: Tuple[float, float] = (300.0, 3600.0)
+    slow_window: float = 21600.0
+    fast_threshold: float = 14.4
+    slow_threshold: float = 6.0
+    # Scrape resilience.
+    request_timeout_s: float = 2.0
+    retry_attempts: int = 2
+    breaker_failures: int = 3
+    breaker_reset_s: float = 10.0
+
+    @classmethod
+    def from_dict(cls, data: Optional[dict]) -> "CollectorConfig":
+        if not data:
+            return cls()
+
+        def k(camel: str, snake: str, default):
+            if camel in data:
+                return data[camel]
+            if snake in data:
+                return data[snake]
+            return default
+
+        d = cls()
+        fast = k("fastWindows", "fast_windows", d.fast_windows)
+        return cls(
+            targets=tuple(
+                ScrapeTarget.from_dict(t)
+                for t in k("targets", "targets", ())
+            ),
+            scrape_interval_s=float(
+                k("scrapeIntervalS", "scrape_interval_s", d.scrape_interval_s)),
+            admin_port=int(k("adminPort", "admin_port", d.admin_port)),
+            host=str(k("host", "host", d.host)),
+            trace_idle_s=float(k("traceIdleS", "trace_idle_s", d.trace_idle_s)),
+            max_traces=int(k("maxTraces", "max_traces", d.max_traces)),
+            k_slowest=int(k("kSlowest", "k_slowest", d.k_slowest)),
+            head_sample_rate=float(
+                k("headSampleRate", "head_sample_rate", d.head_sample_rate)),
+            slo_latency_threshold_s=float(
+                k("sloLatencyThresholdS", "slo_latency_threshold_s",
+                  d.slo_latency_threshold_s)),
+            ttft_threshold_s=float(
+                k("ttftThresholdS", "ttft_threshold_s", d.ttft_threshold_s)),
+            ttft_objective=float(
+                k("ttftObjective", "ttft_objective", d.ttft_objective)),
+            score_threshold_s=float(
+                k("scoreThresholdS", "score_threshold_s", d.score_threshold_s)),
+            score_objective=float(
+                k("scoreObjective", "score_objective", d.score_objective)),
+            availability_objective=float(
+                k("availabilityObjective", "availability_objective",
+                  d.availability_objective)),
+            fast_windows=(float(fast[0]), float(fast[1])),
+            slow_window=float(k("slowWindow", "slow_window", d.slow_window)),
+            fast_threshold=float(
+                k("fastThreshold", "fast_threshold", d.fast_threshold)),
+            slow_threshold=float(
+                k("slowThreshold", "slow_threshold", d.slow_threshold)),
+            request_timeout_s=float(
+                k("requestTimeoutS", "request_timeout_s", d.request_timeout_s)),
+            retry_attempts=int(
+                k("retryAttempts", "retry_attempts", d.retry_attempts)),
+            breaker_failures=int(
+                k("breakerFailures", "breaker_failures", d.breaker_failures)),
+            breaker_reset_s=float(
+                k("breakerResetS", "breaker_reset_s", d.breaker_reset_s)),
+        )
+
+
+# -- critical path -----------------------------------------------------------
+
+
+def critical_path(spans: List[RecordedSpan]) -> List[dict]:
+    """Per-segment critical-path attribution for one assembled trace.
+
+    Walks backward from the **latest end in the root's subtree** — not the
+    root span's own end, because in the score→serve shape the root
+    (``GetPodScores``) returns long before the spans it parents (handoff
+    transfer, admission, prefill chunks, decode steps) finish. At each
+    span, the child subtree whose end is latest (but not after the cursor)
+    is the one the request was actually waiting on; the uncovered
+    remainder inside the span's own lifetime is its *self time*. Wall
+    time covered by no span at all (gaps between sequential children
+    after their parent returned — queueing, scheduling, engine init) is
+    surfaced as one synthetic ``(untracked)`` segment rather than
+    mis-billed to whichever tiny span encloses the gap in the tree.
+    Returns ordered segments ``{name, process, start, end,
+    self_time_s}`` (earliest first), one per on-path span; the segments'
+    ``self_time_s`` values tile the trace duration exactly.
+
+    Orphan spans (parent never exported, e.g. dropped by the ring) start
+    their own subtree only when nothing else claims the root; the path
+    follows the earliest-starting root candidate with an end time.
+    """
+    by_id = {s.span_id: s for s in spans if s.end_time is not None}
+    children: Dict[int, List[RecordedSpan]] = {}
+    roots = []
+    for s in by_id.values():
+        if s.parent_span_id is not None and s.parent_span_id in by_id:
+            children.setdefault(s.parent_span_id, []).append(s)
+        else:
+            roots.append(s)
+    if not roots:
+        return []
+    root = min(roots, key=lambda s: s.start_time)
+    segments: List[dict] = []
+
+    subtree_ends: Dict[int, float] = {}
+
+    def subtree_end(span: RecordedSpan) -> float:
+        cached = subtree_ends.get(span.span_id)
+        if cached is not None:
+            return cached
+        end = span.end_time
+        for child in children.get(span.span_id, ()):
+            end = max(end, subtree_end(child))
+        subtree_ends[span.span_id] = end
+        return end
+
+    untracked = [0.0]
+
+    def visit(span: RecordedSpan, end_cursor: float) -> None:
+        cursor = end_cursor
+        self_time = 0.0
+
+        def credit(lo: float, hi: float) -> None:
+            # Wall time [lo, hi) covered by no child: the portion inside
+            # the span's own lifetime is its self time; the overhang
+            # (children outlasting the span, inter-child gaps after it
+            # returned) is untracked — real critical-path time no span
+            # instruments.
+            nonlocal self_time
+            if hi <= lo:
+                return
+            own = max(0.0, min(hi, span.end_time) - max(lo, span.start_time))
+            self_time += own
+            untracked[0] += (hi - lo) - own
+
+        kids = sorted(
+            children.get(span.span_id, ()),
+            key=subtree_end,
+            reverse=True,
+        )
+        for child in kids:
+            if child.start_time >= cursor:
+                continue  # fully shadowed by a later sibling already walked
+            child_end = min(subtree_end(child), cursor)
+            if child_end <= child.start_time:
+                continue
+            credit(child_end, cursor)
+            visit(child, child_end)
+            cursor = min(cursor, child.start_time)
+        credit(span.start_time, cursor)
+        segments.append({
+            "name": span.name,
+            "process": str(span.attributes.get("process", "")),
+            "start": span.start_time,
+            "end": span.end_time,
+            "self_time_s": round(self_time, 6),
+        })
+
+    end = subtree_end(root)
+    visit(root, end)
+    if untracked[0] > 1e-9:
+        segments.append({
+            "name": "(untracked)",
+            "process": "",
+            "start": root.start_time,
+            "end": end,
+            "self_time_s": round(untracked[0], 6),
+        })
+    segments.sort(key=lambda seg: seg["start"])
+    return segments
+
+
+# -- trace assembly + tail sampling ------------------------------------------
+
+
+class TraceAssembler:
+    """Groups pulled spans by trace id; finalizes idle traces.
+
+    A trace is *finalized* once no new span arrived for ``idle_s`` —
+    cross-process ingestion has no explicit end marker, so idleness is the
+    completion signal (same trick tail-sampling OTel collectors use).
+    """
+
+    def __init__(
+        self,
+        idle_s: float = 1.0,
+        slo_threshold_s: float = 2.0,
+        k_slowest: int = 8,
+        head_sample_rate: float = 0.01,
+        max_traces: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._idle_s = idle_s
+        self._slo_threshold_s = slo_threshold_s
+        self._k_slowest = max(0, k_slowest)
+        self._head_rate = min(max(head_sample_rate, 0.0), 1.0)
+        self._max_traces = max(1, max_traces)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # trace_id -> {"spans": {span_id: RecordedSpan}, "last": mono_ts}
+        self._open: Dict[int, dict] = {}
+        self._retained: Dict[int, dict] = {}
+        self._retained_order: List[int] = []
+        self._seen_span_ids: Dict[int, set] = {}
+        self.assembled = 0
+        self.sampled_out = 0
+
+    def ingest(self, wire_spans: List[dict]) -> int:
+        """Add pulled spans (wire dicts); returns newly ingested count."""
+        now = self._clock()
+        added = 0
+        with self._lock:
+            for data in wire_spans:
+                try:
+                    span = RecordedSpan.from_wire(data)
+                except Exception:
+                    continue  # one bad span must not poison the pull
+                if span.trace_id == 0 or span.span_id == 0:
+                    continue
+                seen = self._seen_span_ids.setdefault(span.trace_id, set())
+                if span.span_id in seen:
+                    continue
+                seen.add(span.span_id)
+                entry = self._open.setdefault(
+                    span.trace_id, {"spans": {}, "last": now})
+                entry["spans"][span.span_id] = span
+                entry["last"] = now
+                added += 1
+        if added:
+            FLEET_SPANS_INGESTED.inc(added)
+        return added
+
+    def finalize_idle(self, force: bool = False) -> List[dict]:
+        """Assemble every idle (or, with ``force``, every open) trace."""
+        now = self._clock()
+        done: List[Tuple[int, dict]] = []
+        with self._lock:
+            for tid in list(self._open):
+                if force or now - self._open[tid]["last"] >= self._idle_s:
+                    done.append((tid, self._open.pop(tid)))
+        out = []
+        for tid, entry in done:
+            summary = self._assemble(tid, entry)
+            FLEET_TRACES_ASSEMBLED.inc()
+            self.assembled += 1
+            reason = self._retention_reason(tid, summary)
+            if reason is not None:
+                summary["retained_reason"] = reason
+                FLEET_TRACES_RETAINED.labels(reason).inc()
+                self._retain(tid, summary)
+            else:
+                self.sampled_out += 1
+                with self._lock:
+                    self._seen_span_ids.pop(tid, None)
+            out.append(summary)
+        return out
+
+    def _assemble(self, trace_id: int, entry: dict) -> dict:
+        spans = [s for s in entry["spans"].values() if s.end_time is not None]
+        spans.sort(key=lambda s: s.start_time)
+        processes = sorted(
+            {str(s.attributes.get("process", "")) for s in spans} - {""})
+        start = min((s.start_time for s in spans), default=0.0)
+        end = max((s.end_time for s in spans), default=0.0)
+        path = critical_path(spans)
+        return {
+            "trace_id": f"{trace_id:032x}",
+            "span_count": len(spans),
+            "processes": processes,
+            "duration_s": round(max(0.0, end - start), 6),
+            "critical_path": path,
+            "critical_path_processes": sorted(
+                {seg["process"] for seg in path} - {""}),
+        }
+
+    def _retention_reason(self, trace_id: int, summary: dict) -> Optional[str]:
+        if summary["duration_s"] >= self._slo_threshold_s:
+            return "slo_breach"
+        if self._k_slowest > 0:
+            with self._lock:
+                slowest = sorted(
+                    (t["duration_s"] for t in self._retained.values()
+                     if t.get("retained_reason") == "k_slowest"),
+                    reverse=True,
+                )
+            if len(slowest) < self._k_slowest or \
+                    summary["duration_s"] > slowest[min(len(slowest), self._k_slowest) - 1]:
+                return "k_slowest"
+        if self._head_rate > 0.0:
+            digest = hashlib.sha256(summary["trace_id"].encode()).digest()
+            if int.from_bytes(digest[:8], "big") / 2**64 < self._head_rate:
+                return "head_sample"
+        return None
+
+    def _retain(self, trace_id: int, summary: dict) -> None:
+        with self._lock:
+            self._retained[trace_id] = summary
+            self._retained_order.append(trace_id)
+            while len(self._retained_order) > self._max_traces:
+                old = self._retained_order.pop(0)
+                self._retained.pop(old, None)
+                self._seen_span_ids.pop(old, None)
+
+    def retained(self) -> List[dict]:
+        with self._lock:
+            return [self._retained[t] for t in self._retained_order
+                    if t in self._retained]
+
+    def find_trace(self, trace_id_hex: str) -> Optional[dict]:
+        try:
+            tid = int(trace_id_hex, 16)
+        except ValueError:
+            return None
+        with self._lock:
+            return self._retained.get(tid)
+
+    def debug_view(self) -> dict:
+        with self._lock:
+            open_count = len(self._open)
+            retained = [self._retained[t] for t in self._retained_order
+                        if t in self._retained]
+        return {
+            "open_traces": open_count,
+            "assembled_total": self.assembled,
+            "sampled_out_total": self.sampled_out,
+            "retained": retained,
+        }
+
+
+# -- the collector service ---------------------------------------------------
+
+
+@dataclass
+class _TargetState:
+    target: ScrapeTarget
+    breaker: CircuitBreaker
+    span_cursor: int = -1
+    reachable: bool = False
+    families: Dict[str, MetricFamily] = field(default_factory=dict)
+    last_hist_counts: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+
+
+class TelemetryCollector:
+    """Scrape loop + assembler + rollup + SLO registry + admin surface."""
+
+    def __init__(
+        self,
+        config: CollectorConfig,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = config
+        self._clock = clock
+        self._retry = RetryPolicy(
+            max_attempts=max(1, config.retry_attempts),
+            base_delay_s=0.02,
+            max_delay_s=0.2,
+            deadline_s=config.request_timeout_s,
+        )
+        self._targets = [
+            _TargetState(
+                target=t,
+                breaker=CircuitBreaker(
+                    target=t.name,
+                    failure_threshold=config.breaker_failures,
+                    reset_timeout_s=config.breaker_reset_s,
+                    clock=clock,
+                ),
+            )
+            for t in config.targets
+        ]
+        self.assembler = TraceAssembler(
+            idle_s=config.trace_idle_s,
+            slo_threshold_s=config.slo_latency_threshold_s,
+            k_slowest=config.k_slowest,
+            head_sample_rate=config.head_sample_rate,
+            max_traces=config.max_traces,
+            clock=clock,
+        )
+        self.slos = SLORegistry(clock=clock)
+        windows = dict(
+            fast_windows=config.fast_windows,
+            slow_window=config.slow_window,
+            fast_threshold=config.fast_threshold,
+            slow_threshold=config.slow_threshold,
+        )
+        self.slos.add(SLOConfig(
+            name="ttft",
+            objective=config.ttft_objective,
+            description=f"TTFT <= {config.ttft_threshold_s}s", **windows))
+        self.slos.add(SLOConfig(
+            name="score_latency",
+            objective=config.score_objective,
+            description=f"score_tokens <= {config.score_threshold_s}s",
+            **windows))
+        self.slos.add(SLOConfig(
+            name="availability",
+            objective=config.availability_objective,
+            description="scrape target reachable", **windows))
+        self._tracer = tracer()
+        self._admin: Optional[AdminServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.rounds = 0
+
+    # -- transport ---------------------------------------------------------
+
+    def _fetch(self, url: str) -> bytes:
+        def one() -> bytes:
+            with urllib.request.urlopen(
+                    url, timeout=self.cfg.request_timeout_s) as resp:
+                return resp.read()
+
+        return call_with_retry(one, self._retry)
+
+    def _scrape_target(self, state: _TargetState) -> bool:
+        """One target's spans + metrics pull; returns reachability."""
+        name = state.target.name
+        if not state.breaker.allow():
+            FLEET_SCRAPES.labels(name, "skipped").inc()
+            return False
+        base = f"http://{state.target.address}"
+        try:
+            spans_raw = self._fetch(
+                f"{base}/debug/spans?since={state.span_cursor}")
+            metrics_raw = self._fetch(f"{base}/metrics")
+        except Exception as exc:
+            state.breaker.record_failure()
+            FLEET_SCRAPES.labels(name, "failure").inc()
+            logger.debug("scrape of %s failed: %s", name, exc)
+            return False
+        state.breaker.record_success()
+        FLEET_SCRAPES.labels(name, "success").inc()
+        try:
+            payload = json.loads(spans_raw)
+            self.assembler.ingest(payload.get("spans", []))
+            state.span_cursor = int(payload.get("next_seq", state.span_cursor))
+        except Exception as exc:
+            logger.debug("span payload from %s unparseable: %s", name, exc)
+        try:
+            state.families = parse_exposition(metrics_raw.decode("utf-8"))
+        except Exception as exc:
+            logger.debug("metrics from %s unparseable: %s", name, exc)
+        return True
+
+    # -- SLI extraction ----------------------------------------------------
+
+    def _feed_latency_slis(self) -> None:
+        """Per-round good/bad deltas from each target's histograms.
+
+        Good = observations at or under the SLO threshold bucket; bad =
+        over it. Deltas are per-target against the previous scrape, so
+        restarts (cumulative counts going backward) reset cleanly.
+        """
+        feeds = (
+            ("ttft", "kvtpu_engine_ttft_seconds", self.cfg.ttft_threshold_s),
+            ("score_latency", "kvcache_score_latency_seconds",
+             self.cfg.score_threshold_s),
+        )
+        for slo_name, family, threshold in feeds:
+            tracker = self.slos.get(slo_name)
+            if tracker is None:
+                continue
+            for state in self._targets:
+                fam = state.families.get(family)
+                if fam is None or fam.type != "histogram":
+                    continue
+                total = 0.0
+                under = 0.0
+                for (suffix, labels), value in fam.samples.items():
+                    if suffix == "_count":
+                        total += value
+                    elif suffix == "_bucket":
+                        le = dict(labels).get("le", "+Inf")
+                        try:
+                            bound = float("inf") if le == "+Inf" else float(le)
+                        except ValueError:
+                            continue
+                        if bound <= threshold:
+                            under = max(under, value)
+                key = f"{state.target.name}:{family}"
+                prev_total, prev_under = state.last_hist_counts.get(
+                    key, (0.0, 0.0))
+                if total < prev_total:  # target restarted
+                    prev_total, prev_under = 0.0, 0.0
+                d_total = total - prev_total
+                d_under = min(under - prev_under, d_total)
+                state.last_hist_counts[key] = (total, under)
+                if d_total > 0:
+                    tracker.record(
+                        good=int(round(d_under)),
+                        bad=int(round(d_total - d_under)),
+                    )
+
+    # -- rounds ------------------------------------------------------------
+
+    def scrape_once(self) -> dict:
+        """One full collection round (also the unit-test entry point)."""
+        with self._tracer.span(
+            "llm_d.kv_cache.collector.scrape_round",
+            targets=len(self._targets),
+        ) as span:
+            reachable = 0
+            for state in self._targets:
+                state.reachable = self._scrape_target(state)
+                reachable += int(state.reachable)
+            FLEET_TARGETS_REACHABLE.set(reachable)
+            span.set_attribute("reachable", reachable)
+            availability = self.slos.get("availability")
+            if availability is not None and self._targets:
+                availability.record(
+                    good=reachable, bad=len(self._targets) - reachable)
+            self._feed_latency_slis()
+            finalized = self.assembler.finalize_idle()
+            slo_state = self.slos.evaluate_all()
+            self.rounds += 1
+            return {
+                "reachable": reachable,
+                "targets": len(self._targets),
+                "finalized_traces": len(finalized),
+                "slo": slo_state,
+            }
+
+    # -- read surface ------------------------------------------------------
+
+    def rollup_view(self) -> dict:
+        """Fleet percentiles per role (and overall) for the key families."""
+        by_role: Dict[str, List[Dict[str, MetricFamily]]] = {"all": []}
+        for state in self._targets:
+            if not state.families:
+                continue
+            by_role["all"].append(state.families)
+            if state.target.role:
+                by_role.setdefault(state.target.role, []).append(state.families)
+        out: dict = {}
+        for role, expositions in by_role.items():
+            merged = merge_families(expositions)
+            out[role] = {
+                fam: rollup_percentiles(merged, fam)
+                for fam in _ROLLUP_FAMILIES
+                if rollup_percentiles(merged, fam)
+            }
+        out["targets"] = {
+            s.target.name: {
+                "address": s.target.address,
+                "role": s.target.role,
+                "reachable": s.reachable,
+                "breaker": s.breaker.state,
+                "span_cursor": s.span_cursor,
+            }
+            for s in self._targets
+        }
+        return out
+
+    def debug_view(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "traces": self.assembler.debug_view(),
+            "slo": self.slos.debug_view(),
+            "rollup": self.rollup_view(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the periodic scrape loop and (optionally) the admin port."""
+        if self.cfg.admin_port > 0 and self._admin is None:
+            self._admin = AdminServer(
+                port=self.cfg.admin_port, host=self.cfg.host,
+                expose_debug=True)
+            self._admin.register_debug(
+                "traces", self.assembler.debug_view)
+            self._admin.register_debug("slo", self.slos.debug_view)
+            self._admin.register_debug("rollup", self.rollup_view)
+            self._admin.register_debug("fleet", self.debug_view)
+            self._admin.start()
+        if self._thread is None and self.cfg.scrape_interval_s > 0:
+            self._stop.clear()
+
+            def loop() -> None:
+                while not self._stop.wait(self.cfg.scrape_interval_s):
+                    try:
+                        self.scrape_once()
+                    except Exception:  # the loop must survive bad rounds
+                        logger.exception("collector round failed")
+
+            self._thread = threading.Thread(
+                target=loop, name="kvtpu-telemetry-collector", daemon=True)
+            self._thread.start()
+
+    @property
+    def admin_port(self) -> int:
+        return self._admin.port if self._admin is not None else 0
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._admin is not None:
+            self._admin.stop()
+            self._admin = None

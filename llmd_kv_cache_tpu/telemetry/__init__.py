@@ -6,6 +6,7 @@ from .engine_telemetry import (
     ProfileInProgress,
     ProfilerCapture,
 )
+from .fleet import FleetTelemetryConfig, enable_span_export
 from .flight_recorder import (
     FlightRecorder,
     attach_failpoint_listener,
@@ -13,14 +14,19 @@ from .flight_recorder import (
     install_signal_dump,
     set_flight_recorder,
 )
+from .slo import SLOConfig, SLORegistry, SLOTracker
 from .tracing import (
     InMemorySpanExporter,
+    RecordedSpan,
+    active_span_exporter,
     current_traceparent,
     format_traceparent,
     init_tracing,
     install_span_exporter,
     parse_traceparent,
+    process_identity,
     recording_tracing,
+    set_process_identity,
     tracer,
     uninstall_span_exporter,
 )
@@ -28,20 +34,29 @@ from .tracing import (
 __all__ = [
     "EngineTelemetry",
     "EngineTelemetryConfig",
+    "FleetTelemetryConfig",
     "FlightRecorder",
     "InMemorySpanExporter",
     "ProfileInProgress",
     "ProfilerCapture",
+    "RecordedSpan",
+    "SLOConfig",
+    "SLORegistry",
+    "SLOTracker",
+    "active_span_exporter",
     "attach_failpoint_listener",
     "current_traceparent",
+    "enable_span_export",
     "flight_recorder",
     "format_traceparent",
     "init_tracing",
     "install_signal_dump",
     "install_span_exporter",
     "parse_traceparent",
+    "process_identity",
     "recording_tracing",
     "set_flight_recorder",
+    "set_process_identity",
     "tracer",
     "uninstall_span_exporter",
 ]

@@ -40,8 +40,15 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..metrics import collector
 from ..utils.logging import get_logger
 from . import flight_recorder as fr
+from .tracing import parse_traceparent
 
 logger = get_logger("engine_telemetry")
+
+
+def trace_id_of(traceparent: Optional[str]) -> Optional[str]:
+    """Hex trace id from a W3C traceparent, for histogram exemplars."""
+    parsed = parse_traceparent(traceparent)
+    return None if parsed is None else f"{parsed[0]:032x}"
 
 # Default bucket bounds span CPU dev loops through TPU pods; deployments
 # with tighter SLOs override them via EngineTelemetryConfig.
@@ -282,7 +289,10 @@ class EngineTelemetry:
         st.tokens = 1
         if st.admit_ts is None:  # synchronous add_request path
             st.admit_ts = st.enqueue_ts
-        self.ttft.observe(now - st.enqueue_ts)
+        # The trace-id exemplar links a slow TTFT bucket straight to the
+        # retained trace in the fleet collector (OpenMetrics exposition).
+        self.ttft.observe(now - st.enqueue_ts,
+                          trace_id=trace_id_of(st.traceparent))
 
     def on_decode_tokens(self, request_id: str, n: int, now: float) -> None:
         st = self._requests.get(request_id)

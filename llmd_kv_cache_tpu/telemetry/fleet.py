@@ -1,0 +1,95 @@
+"""Pod-side fleet-telemetry wiring: the ``fleetTelemetry`` config block.
+
+The *collector-side* configuration lives in
+``services.telemetry_collector.CollectorConfig``; this module is the thin
+pod-side counterpart: whether this process exports finished spans through
+its admin ``/debug/spans`` endpoint, how deep the ring buffer is, and the
+logical ``process`` identity stamped on every exported span (what the
+collector's critical-path attribution groups by).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .tracing import (
+    InMemorySpanExporter,
+    active_span_exporter,
+    install_span_exporter,
+    set_process_identity,
+)
+
+
+@dataclass(frozen=True)
+class FleetTelemetryConfig:
+    """``fleetTelemetry`` block of a pod config (camelCase in files)."""
+
+    # Master switch: install a recording ring exporter and expose
+    # /debug/spans on the pod's admin endpoint.
+    span_export: bool = False
+    # Ring depth; evict-oldest beyond this (drops are counted in
+    # kvtpu_trace_dropped_spans_total).
+    max_spans: int = 10_000
+    # Span attribution identity; defaults to the pod/shard id the owning
+    # service already knows.
+    process_identity: str = ""
+    # The collector's address (host:port), informational for operators /
+    # kvdiag --fleet; pods never dial it (the collector pulls).
+    collector_address: str = ""
+
+    @classmethod
+    def from_dict(cls, data: Optional[dict]) -> Optional["FleetTelemetryConfig"]:
+        if not data:
+            return None
+
+        def k(camel: str, snake: str, default):
+            if camel in data:
+                return data[camel]
+            if snake in data:
+                return data[snake]
+            return default
+
+        d = cls()
+        return cls(
+            span_export=bool(k("spanExport", "span_export", d.span_export)),
+            max_spans=int(k("maxSpans", "max_spans", d.max_spans)),
+            process_identity=str(
+                k("processIdentity", "process_identity", d.process_identity)),
+            collector_address=str(
+                k("collectorAddress", "collector_address",
+                  d.collector_address)),
+        )
+
+
+def enable_span_export(
+    config: FleetTelemetryConfig,
+    default_identity: str = "",
+) -> Optional[Callable[[int], dict]]:
+    """Install (or reuse) the ring exporter per ``config``.
+
+    Returns the ``/debug/spans`` source callable to hand to
+    ``AdminServer.register_spans_source``, or None when span export is
+    disabled. An exporter already installed (tests, another service in
+    the same process) is reused rather than replaced, so every in-process
+    service shares one ring and one seq space.
+    """
+    if not config.span_export:
+        return None
+    set_process_identity(config.process_identity or default_identity or None)
+    exporter = active_span_exporter()
+    if exporter is None:
+        exporter = install_span_exporter(
+            InMemorySpanExporter(max_spans=config.max_spans))
+
+    def source(since: int, _exp=exporter) -> dict:
+        payload = _exp.export_since(since)
+        try:
+            from ..metrics.collector import record_spans_exported
+
+            record_spans_exported(len(payload["spans"]))
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
+        return payload
+
+    return source

@@ -1,0 +1,221 @@
+"""Fleet metric rollup: parse per-pod expositions, merge type-correctly.
+
+The collector scrapes every pod's ``/metrics`` (Prometheus text format) and
+needs fleet-level answers — "TTFT p99 across all decode pods", "total
+handoff chunks landed" — which requires merging *by metric type*:
+
+- **counter** samples sum across pods (monotonic totals are additive);
+- **gauge** samples report sum, max, and avg (occupancy gauges are
+  additive, watermark gauges are not — the reader picks);
+- **histogram** families merge bucket-by-bucket (cumulative counts and
+  sums are additive when bucket bounds agree, which they do fleet-wide
+  because every pod runs the same config), giving true fleet percentiles
+  rather than an average-of-percentiles.
+
+This is the package-internal sibling of ``hack/kvdiag.py``'s parser:
+kvdiag stays stdlib-only and standalone by design, so the two do not
+share code. Everything here is pure parsing/arithmetic — no network —
+so the unit suite drives it with literal exposition text.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^ ]+)"
+    r"(?:\s+(?P<ts>[0-9.+-eE]+))?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(raw: Optional[str]) -> Tuple[Tuple[str, str], ...]:
+    if not raw:
+        return ()
+    out = []
+    for key, val in _LABEL_RE.findall(raw):
+        out.append((key, val.replace(r"\"", '"').replace(r"\\", "\\").replace(r"\n", "\n")))
+    return tuple(sorted(out))
+
+
+class MetricFamily:
+    """One parsed family: name, TYPE, and ``{labelset: value}`` samples.
+
+    Histogram families keep their ``_bucket``/``_sum``/``_count`` samples
+    under the family name; :func:`merge_families` reassembles them.
+    """
+
+    __slots__ = ("name", "type", "samples")
+
+    def __init__(self, name: str, type_: str = "untyped"):
+        self.name = name
+        self.type = type_
+        # {(sample_suffix, labelset): value}
+        self.samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"MetricFamily({self.name!r}, {self.type!r}, {len(self.samples)} samples)"
+
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+_COUNTER_SUFFIX = "_total"
+
+
+def _family_name(sample_name: str, types: Dict[str, str]) -> Tuple[str, str]:
+    """Map a sample name back to its family name + sample suffix."""
+    for suffix in _HIST_SUFFIXES:
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in types:
+                return base, suffix
+    if sample_name.endswith(_COUNTER_SUFFIX) and sample_name not in types:
+        # prometheus_client registers Counter("x_total") under family "x".
+        base = sample_name[: -len(_COUNTER_SUFFIX)]
+        if base in types:
+            return base, _COUNTER_SUFFIX
+    return sample_name, ""
+
+
+def parse_exposition(text: str) -> Dict[str, MetricFamily]:
+    """Parse Prometheus text exposition into ``{family_name: MetricFamily}``.
+
+    ``# TYPE`` lines are retained (this is the whole point — a merger must
+    know counters from gauges); other comments are skipped; malformed lines
+    are dropped rather than raised, because one bad pod must not take down
+    the fleet view.
+    """
+    types: Dict[str, str] = {}
+    families: Dict[str, MetricFamily] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        fam_name, suffix = _family_name(m.group("name"), types)
+        fam = families.get(fam_name)
+        if fam is None:
+            fam = MetricFamily(fam_name, types.get(fam_name, "untyped"))
+            families[fam_name] = fam
+        labels = _parse_labels(m.group("labels"))
+        fam.samples[(suffix, labels)] = value
+    return families
+
+
+def merge_families(
+    expositions: Iterable[Dict[str, MetricFamily]],
+) -> Dict[str, dict]:
+    """Type-correct merge of several pods' parsed expositions.
+
+    Returns ``{family: {"type": t, "samples": {labels: merged}}}`` where a
+    merged counter/histogram sample is the cross-pod **sum** and a merged
+    gauge sample is ``{"sum": s, "max": m, "avg": a, "pods": n}``. Histogram
+    families come back as ``{"buckets": {le: cum}, "sum": s, "count": n}``
+    per labelset so :func:`histogram_percentile` can read them directly.
+    """
+    merged: Dict[str, dict] = {}
+    gauge_acc: Dict[Tuple[str, Tuple], List[float]] = {}
+    for families in expositions:
+        for name, fam in families.items():
+            out = merged.setdefault(name, {"type": fam.type, "samples": {}})
+            if out["type"] == "untyped" and fam.type != "untyped":
+                out["type"] = fam.type
+            if fam.type == "histogram":
+                for (suffix, labels), value in fam.samples.items():
+                    if suffix == "_bucket":
+                        le = dict(labels).get("le", "+Inf")
+                        rest = tuple(kv for kv in labels if kv[0] != "le")
+                        hist = out["samples"].setdefault(
+                            rest, {"buckets": {}, "sum": 0.0, "count": 0.0}
+                        )
+                        hist["buckets"][le] = hist["buckets"].get(le, 0.0) + value
+                    elif suffix in ("_sum", "_count"):
+                        hist = out["samples"].setdefault(
+                            labels, {"buckets": {}, "sum": 0.0, "count": 0.0}
+                        )
+                        hist[suffix[1:]] += value
+            elif fam.type == "gauge":
+                for (_suffix, labels), value in fam.samples.items():
+                    gauge_acc.setdefault((name, labels), []).append(value)
+            else:  # counter / untyped: additive; the _total suffix is
+                # implied by the counter type, so keys are just labelsets.
+                for (_suffix, labels), value in fam.samples.items():
+                    out["samples"][labels] = out["samples"].get(labels, 0.0) + value
+    for (name, labels), values in gauge_acc.items():
+        merged[name]["samples"][labels] = {
+            "sum": sum(values),
+            "max": max(values),
+            "avg": sum(values) / len(values),
+            "pods": len(values),
+        }
+    return merged
+
+
+def _le_key(le: str) -> float:
+    return math.inf if le in ("+Inf", "inf") else float(le)
+
+
+def histogram_percentile(hist: dict, q: float) -> float:
+    """q-quantile (q in [0,1]) from a merged ``{"buckets": {le: cum}}``.
+
+    Linear interpolation within the containing bucket, mirroring
+    ``BucketHistogram.percentile`` so fleet and per-pod readbacks agree.
+    Returns 0.0 for an empty histogram.
+    """
+    buckets = sorted(hist.get("buckets", {}).items(), key=lambda kv: _le_key(kv[0]))
+    if not buckets:
+        return 0.0
+    total = buckets[-1][1]
+    if total <= 0:
+        return 0.0
+    target = max(q, 0.0) * total
+    prev_le, prev_cum = 0.0, 0.0
+    finite = [_le_key(le) for le, _ in buckets if _le_key(le) != math.inf]
+    top = finite[-1] if finite else 0.0
+    for le, cum in buckets:
+        bound = _le_key(le)
+        if cum >= target:
+            if bound == math.inf:
+                return top
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return bound
+            frac = (target - prev_cum) / in_bucket
+            return prev_le + (bound - prev_le) * min(max(frac, 0.0), 1.0)
+        prev_le, prev_cum = (0.0 if bound == math.inf else bound), cum
+    return top
+
+
+def rollup_percentiles(
+    merged: Dict[str, dict],
+    family: str,
+    quantiles: Tuple[float, ...] = (0.5, 0.9, 0.99),
+) -> Dict[str, float]:
+    """Fleet percentiles for one merged histogram family (empty if absent)."""
+    fam = merged.get(family)
+    if fam is None or fam["type"] != "histogram" or not fam["samples"]:
+        return {}
+    # Merge across labelsets too: the fleet answer ignores per-pod labels.
+    combined: dict = {"buckets": {}, "sum": 0.0, "count": 0.0}
+    for hist in fam["samples"].values():
+        for le, cum in hist["buckets"].items():
+            combined["buckets"][le] = combined["buckets"].get(le, 0.0) + cum
+        combined["sum"] += hist["sum"]
+        combined["count"] += hist["count"]
+    out = {f"p{int(q * 100)}": histogram_percentile(combined, q) for q in quantiles}
+    out["count"] = combined["count"]
+    return out

@@ -34,6 +34,7 @@ import random
 import re
 import threading
 import time
+from collections import deque
 from typing import Iterator, Optional
 
 try:
@@ -112,6 +113,44 @@ class _NoopSpanCM:
 
 _NOOP_CM = _NoopSpanCM()
 
+# Logical process identity ("engine-pod-0", "shard:127.0.0.1:15920",
+# "router", ...) stamped onto every exported span that does not already
+# carry an explicit ``process`` attribute. The fleet collector attributes
+# critical-path segments to these identities; in production each identity
+# also maps to a distinct scrape endpoint.
+_PROCESS_IDENTITY: Optional[str] = None
+
+
+def set_process_identity(identity: Optional[str]) -> None:
+    """Set (or clear, with None) this process's span attribution identity."""
+    global _PROCESS_IDENTITY
+    _PROCESS_IDENTITY = identity
+
+
+def process_identity() -> Optional[str]:
+    return _PROCESS_IDENTITY
+
+
+_dropped_counter = None
+
+
+def _count_dropped_span() -> None:
+    """Bump ``kvtpu_trace_dropped_spans_total`` (lazy: tracing must stay
+    importable without the metrics stack, e.g. under kvdiag deep-debug)."""
+    global _dropped_counter
+    if _dropped_counter is None:
+        try:
+            from llmd_kv_cache_tpu.metrics.collector import TRACE_DROPPED_SPANS
+
+            _dropped_counter = TRACE_DROPPED_SPANS
+        except Exception:  # pragma: no cover - metrics stack absent
+            _dropped_counter = False
+    if _dropped_counter:
+        try:
+            _dropped_counter.inc()
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
+
 
 class RecordedSpan:
     """A finished-or-active span in recording mode.
@@ -131,6 +170,7 @@ class RecordedSpan:
         "status_description",
         "start_time",
         "end_time",
+        "seq",
     )
 
     def __init__(
@@ -151,6 +191,9 @@ class RecordedSpan:
         self.status_description: Optional[str] = None
         self.start_time = time.time()
         self.end_time: Optional[float] = None
+        # Monotonic export sequence number, stamped by the exporter so
+        # remote pullers (/debug/spans?since=seq) can resume a cursor.
+        self.seq: Optional[int] = None
 
     def set_attribute(self, key: str, value) -> "RecordedSpan":
         self.attributes[key] = value
@@ -177,6 +220,56 @@ class RecordedSpan:
     def traceparent(self) -> str:
         return format_traceparent(self.trace_id, self.span_id)
 
+    def to_wire(self) -> dict:
+        """JSON-safe dict for span export over ``/debug/spans``.
+
+        Ids travel as hex strings (W3C casing), attribute values are
+        coerced to JSON scalars so a numpy int at a span site can never
+        break the export path.
+        """
+
+        def _scalar(v):
+            if isinstance(v, (str, bool)) or v is None:
+                return v
+            if isinstance(v, (int, float)):
+                return v
+            try:  # numpy scalars and friends
+                return v.item()
+            except Exception:
+                return str(v)
+
+        return {
+            "name": self.name,
+            "trace_id": f"{self.trace_id:032x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_span_id": (
+                None if self.parent_span_id is None else f"{self.parent_span_id:016x}"
+            ),
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "status": self.status,
+            "attributes": {str(k): _scalar(v) for k, v in self.attributes.items()},
+            "seq": self.seq,
+        }
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "RecordedSpan":
+        """Inverse of :meth:`to_wire` (collector side)."""
+        parent = data.get("parent_span_id")
+        sp = cls(
+            str(data.get("name", "")),
+            int(str(data.get("trace_id", "0")) or "0", 16),
+            int(str(data.get("span_id", "0")) or "0", 16),
+            None if parent in (None, "") else int(str(parent), 16),
+            data.get("attributes") or {},
+        )
+        sp.start_time = float(data.get("start_time") or 0.0)
+        end = data.get("end_time")
+        sp.end_time = None if end is None else float(end)
+        sp.status = str(data.get("status", "UNSET"))
+        sp.seq = data.get("seq")
+        return sp
+
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
             f"RecordedSpan({self.name!r}, trace={self.trace_id:032x}, "
@@ -186,26 +279,97 @@ class RecordedSpan:
 
 
 class InMemorySpanExporter:
-    """Collects finished :class:`RecordedSpan` objects for test assertions.
+    """Collects finished :class:`RecordedSpan` objects for assembly/export.
 
     Stand-in for ``opentelemetry.sdk``'s in-memory exporter on images where
-    only ``opentelemetry-api`` is installed.
+    only ``opentelemetry-api`` is installed — and the local buffer behind
+    the admin ``/debug/spans?since=seq`` pull endpoint.
+
+    The buffer is a ring: when ``max_spans`` is reached the **oldest** span
+    is evicted (previously new spans were silently discarded, which meant a
+    long-lived pod stopped tracing entirely once warm). Every eviction is
+    counted both locally (:attr:`dropped`) and in the
+    ``kvtpu_trace_dropped_spans_total`` counter so the collector can see
+    export-loss on a lagging cursor.
+
+    Spans are stamped with a monotonically increasing ``seq`` (and, when
+    missing, the process identity) lazily — at pull time under the ring
+    lock, not on the per-span export hot path — so :meth:`drain_since`
+    lets a remote puller resume from its last cursor while ``export``
+    itself stays a bare ring append (gated <1% of score p50 by
+    ``bench.py --fleet-telemetry``).
     """
+
+    __slots__ = ("_lock", "_spans", "_max_spans", "_next_seq", "dropped")
 
     def __init__(self, max_spans: int = 10_000):
         self._lock = threading.Lock()
-        self._spans: list[RecordedSpan] = []
-        self._max_spans = max_spans
+        self._spans: deque[RecordedSpan] = deque(maxlen=max(1, int(max_spans)))
+        self._max_spans = max(1, int(max_spans))
+        self._next_seq = 0
+        self.dropped = 0
 
     def export(self, span: RecordedSpan) -> None:
+        # Hot path: runs inline at every span end once fleet span export
+        # is on. Everything deferrable (seq + identity stamping, wire
+        # encoding) happens at pull time instead.
+        spans = self._spans
         with self._lock:
-            if len(self._spans) < self._max_spans:
-                self._spans.append(span)
+            if len(spans) >= self._max_spans:
+                self.dropped += 1
+                _count_dropped_span()
+            spans.append(span)  # at capacity the deque evicts the oldest
+
+    def _stamp_locked(self) -> None:
+        """Assign ``seq`` (and process identity) to not-yet-stamped spans.
+
+        Caller holds ``self._lock``. Spans are stamped newest-backwards
+        until the first already-stamped one, so the cost is O(new spans)
+        per pull, not O(ring).
+        """
+        fresh = []
+        for span in reversed(self._spans):
+            if span.seq is not None:
+                break
+            fresh.append(span)
+        identity = _PROCESS_IDENTITY
+        for span in reversed(fresh):
+            span.seq = self._next_seq
+            self._next_seq += 1
+            if identity is not None and "process" not in span.attributes:
+                span.attributes["process"] = identity
 
     @property
     def spans(self) -> list[RecordedSpan]:
         with self._lock:
             return list(self._spans)
+
+    @property
+    def next_seq(self) -> int:
+        with self._lock:
+            self._stamp_locked()
+            return self._next_seq
+
+    def drain_since(self, since: int = -1) -> tuple[list[RecordedSpan], int]:
+        """Spans with ``seq > since`` (oldest first) and the next cursor.
+
+        Non-destructive: the ring keeps its contents so several pullers
+        (or a retried pull) each keep their own cursor; the collector
+        dedupes by span id anyway.
+        """
+        with self._lock:
+            self._stamp_locked()
+            out = [s for s in self._spans if s.seq is not None and s.seq > since]
+            return out, self._next_seq - 1
+
+    def export_since(self, since: int = -1) -> dict:
+        """JSON-safe ``/debug/spans`` payload: spans + cursor + drop count."""
+        spans, cursor = self.drain_since(since)
+        return {
+            "spans": [s.to_wire() for s in spans if s.end_time is not None],
+            "next_seq": cursor,
+            "dropped": self.dropped,
+        }
 
     def find(self, name: str) -> list[RecordedSpan]:
         return [s for s in self.spans if s.name == name]
@@ -385,6 +549,12 @@ def uninstall_span_exporter() -> None:
     global _recording_exporter, _tracer
     _recording_exporter = None
     _tracer = None
+
+
+def active_span_exporter() -> Optional[InMemorySpanExporter]:
+    """The currently installed recording exporter, if any (fleet wiring
+    reuses an already-installed exporter instead of replacing it)."""
+    return _recording_exporter
 
 
 @contextlib.contextmanager

@@ -401,3 +401,250 @@ class TestShardedClusterE2E:
                     svc.stop()
                 except Exception:
                     pass  # victim's first incarnation is already stopped
+
+
+FLEET_GRPC_PORTS = range(15950, 15954)   # clear of the port ranges above
+FLEET_ADMIN_PORTS = range(15960, 15964)
+FLEET_COLLECTOR_PORT = 15970
+
+
+class TestFleetObservabilityE2E:
+    """The fleet observability plane over the sharded toy cluster.
+
+    Acceptance shape from ISSUE 10: the telemetry collector attached to
+    the 4-shard cluster plus a prefill/decode pair assembles ONE
+    cross-process trace — GetPodScores → handoff prefill commits →
+    engine decode steps — spanning at least three logical processes,
+    with per-segment critical-path attribution; killing a shard fires
+    the availability burn-rate alert (multi-window, fast_burn) and the
+    alert clears once the shard is rebuilt on the same identity.
+    """
+
+    def _make_service(self, addr, admin_port, addrs):
+        from llmd_kv_cache_tpu.cluster.config import ClusterConfig
+        from llmd_kv_cache_tpu.core import TokenProcessorConfig
+        from llmd_kv_cache_tpu.events import PoolConfig
+        from llmd_kv_cache_tpu.scoring.indexer import IndexerConfig
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerService,
+            serve,
+        )
+        from llmd_kv_cache_tpu.telemetry import FleetTelemetryConfig
+
+        cfg = IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK),
+            admin_port=admin_port,
+            cluster_config=ClusterConfig(
+                shard_addresses=list(addrs),
+                shard_id=addr,
+                replication_factor=2,
+                breaker_reset_timeout_s=0.2,
+            ),
+            # Span export on: the admin endpoint grows /debug/spans and
+            # every shard's spans land in the (shared, in-process) ring.
+            fleet_telemetry=FleetTelemetryConfig(span_export=True),
+        )
+        svc = IndexerService(cfg, PoolConfig(concurrency=1))
+        svc.start()
+        return svc, serve(addr, svc)
+
+    def _ingest(self, services, pod, tokens, engine_base):
+        from llmd_kv_cache_tpu.events.model import BlockStoredEvent, EventBatch
+
+        n = len(tokens) // BLOCK
+        batch = EventBatch(
+            timestamp=time.time(),
+            events=[BlockStoredEvent(
+                block_hashes=list(range(engine_base, engine_base + n)),
+                tokens=list(tokens), parent_hash=0, block_size=BLOCK,
+                device_tier="gpu",
+            )],
+        )
+        for svc in services:
+            svc.pool.process_event_batch(batch, pod, MODEL)
+
+    def test_fleet_trace_assembly_and_burn_rate_alert(self):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+        from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerServiceClient,
+        )
+        from llmd_kv_cache_tpu.services.telemetry_collector import (
+            CollectorConfig,
+            ScrapeTarget,
+            TelemetryCollector,
+        )
+        from llmd_kv_cache_tpu.telemetry.tracing import (
+            set_process_identity,
+            uninstall_span_exporter,
+        )
+
+        addrs = [f"127.0.0.1:{p}" for p in FLEET_GRPC_PORTS]
+        admin_ports = dict(zip(addrs, FLEET_ADMIN_PORTS))
+        services, servers = {}, {}
+        client = None
+        collector = None
+        try:
+            for addr in addrs:
+                services[addr], servers[addr] = self._make_service(
+                    addr, admin_ports[addr], addrs)
+
+            prompt = list(range(1, 1 + 8 * BLOCK))
+            self._ingest(services.values(), "decode-0", prompt, 1000)
+
+            # 1) Score over the real gRPC wire. The server's GetPodScores
+            # span is the trace root; its traceparent rides back on the
+            # response (PR 7 score→serve continuity).
+            client = IndexerServiceClient(addrs[0])
+            resp = client.score(prompt, MODEL)
+            tp = resp.traceparent
+            assert tp.startswith("00-"), resp
+            trace_id_hex = tp.split("-")[1]
+
+            # 2) Prefill-side handoff under the same trace: pairing span +
+            # one prefill_commit per landed chunk (process = prefill-0).
+            coord = HandoffCoordinator()
+            coord.begin("r1", "prefill-0", "decode-0",
+                        total_blocks=4, traceparent=tp)
+            coord.on_chunk_start("r1", [1, 2])
+            coord.on_chunk_landed("r1", [1, 2])
+            coord.on_chunk_start("r1", [3, 4])
+            coord.on_chunk_landed("r1", [3, 4])
+            coord.prefill_finished("r1")
+
+            # 3) Decode-side serve under the same trace: a real engine's
+            # admission/prefill_chunk/decode_step spans (process=decode-0).
+            tiny = LlamaConfig.tiny()
+            engine = MiniEngine(EngineConfig(
+                model=tiny, num_pages=64, max_pages_per_seq=16,
+                model_name=MODEL, pod_identifier="decode-0",
+                max_prefill_tokens=tiny.page_size))
+            req = engine.enqueue(
+                "r1", list(range(300, 300 + 2 * tiny.page_size)),
+                max_new_tokens=3, traceparent=tp)
+            deadline = time.monotonic() + 120.0
+            while not req.done and time.monotonic() < deadline:
+                engine.step()
+            assert req.done
+            coord.decode_settled("r1", "complete")
+
+            # 4) The collector scrapes all four shard admin endpoints.
+            # Manual rounds (interval 0) keep the test deterministic;
+            # tight SLO windows let the chaos phase run in seconds.
+            collector = TelemetryCollector(CollectorConfig(
+                targets=tuple(
+                    ScrapeTarget(name=f"shard-{i}",
+                                 address=f"127.0.0.1:{p}",
+                                 role="indexer-shard")
+                    for i, p in enumerate(FLEET_ADMIN_PORTS)),
+                scrape_interval_s=0.0,
+                admin_port=FLEET_COLLECTOR_PORT,
+                trace_idle_s=0.2,
+                slo_latency_threshold_s=0.0,  # retain every trace
+                fast_windows=(0.6, 1.2),
+                slow_window=2.4,
+                breaker_reset_s=0.3,
+            ))
+            collector.start()  # admin endpoint only; rounds driven below
+            round1 = collector.scrape_once()
+            assert round1["reachable"] == len(addrs)
+            time.sleep(0.3)  # > trace_idle_s: the request trace goes idle
+            collector.scrape_once()
+
+            # One assembled trace, ≥3 logical processes, with the
+            # score → prefill commit → decode step chain on its path.
+            trace = collector.assembler.find_trace(trace_id_hex)
+            assert trace is not None, collector.assembler.debug_view()
+            assert trace["retained_reason"] == "slo_breach"
+            assert {"prefill-0", "decode-0", addrs[0]} <= set(
+                trace["processes"])
+            path_names = [seg["name"] for seg in trace["critical_path"]]
+            assert "llm_d.kv_cache.indexer.GetPodScores" in path_names
+            assert "llm_d.kv_cache.handoff.prefill_commit" in path_names
+            assert "llm_d.kv_cache.engine.decode_step" in path_names
+            assert len(trace["critical_path_processes"]) >= 3
+            # Attribution is complete: on-path self times tile the trace.
+            assert sum(s["self_time_s"] for s in trace["critical_path"]) \
+                == pytest.approx(trace["duration_s"], abs=1e-3)
+            # Real spans are never billed more than their own lifetime;
+            # the gap between score and serve (engine init here) shows up
+            # as the synthetic (untracked) segment instead.
+            for seg in trace["critical_path"]:
+                if seg["name"] != "(untracked)":
+                    # 1e-6: self_time_s is rounded to microseconds
+                    assert seg["self_time_s"] <= \
+                        (seg["end"] - seg["start"]) + 1e-6
+
+            # Fleet rollup: the merged score-latency histogram yields
+            # percentiles for the shard role and the fleet overall.
+            rollup = collector.rollup_view()
+            for role in ("all", "indexer-shard"):
+                pcts = rollup[role]["kvcache_score_latency_seconds"]
+                assert pcts["count"] > 0 and pcts["p50"] >= 0.0
+
+            # kvdiag --fleet against the collector's admin endpoint: one
+            # snapshot carries traces + rollup + SLO state.
+            diag = subprocess.run(
+                [sys.executable, "hack/kvdiag.py",
+                 "--port", str(FLEET_COLLECTOR_PORT), "--fleet"],
+                cwd=str(REPO), capture_output=True, text=True, timeout=30)
+            assert diag.returncode == 0, diag.stderr
+            fleet = json.loads(diag.stdout)["fleet"]
+            assert any(t["trace_id"] == trace["trace_id"]
+                       for t in fleet["retained_traces"])
+            dominant = next(
+                t["dominant_segment"] for t in fleet["retained_traces"]
+                if t["trace_id"] == trace["trace_id"])
+            assert dominant["self_time_s"] > 0.0
+            assert set(fleet["slo"]) == {
+                "ttft", "score_latency", "availability"}
+            assert fleet["alerts"] == []  # healthy fleet: nothing firing
+
+            # 5) Chaos: kill one shard. Scrapes of its admin endpoint
+            # fail, the availability SLI burns 250x budget, and once both
+            # fast windows agree the fast_burn alert fires.
+            victim = addrs[-1]
+            servers[victim].stop(grace=0)
+            services[victim].stop()
+            availability = collector.slos.get("availability")
+            deadline = time.monotonic() + 15.0
+            while (availability.alert_severity != "fast_burn"
+                   and time.monotonic() < deadline):
+                collector.scrape_once()
+                time.sleep(0.1)
+            assert availability.alert_severity == "fast_burn", \
+                availability.debug_view()
+            slo_view = collector.slos.debug_view()["availability"]
+            assert slo_view["alert"]["fires"] >= 1
+            assert slo_view["error_budget_remaining"] < 1.0
+
+            # 6) Recovery: same identity, fresh service. Good rounds
+            # resume, the bad samples age out of the fast windows, and
+            # the alert clears (possibly stepping down through slow_burn
+            # while the long window drains).
+            services[victim], servers[victim] = self._make_service(
+                victim, admin_ports[victim], addrs)
+            deadline = time.monotonic() + 20.0
+            while (availability.alert_severity is not None
+                   and time.monotonic() < deadline):
+                collector.scrape_once()
+                time.sleep(0.1)
+            assert availability.alert_severity is None, \
+                availability.debug_view()
+            assert collector.scrape_once()["reachable"] == len(addrs)
+        finally:
+            if client is not None:
+                client.close()
+            if collector is not None:
+                collector.stop()
+            for server in servers.values():
+                server.stop(grace=0)
+            for svc in services.values():
+                try:
+                    svc.stop()
+                except Exception:
+                    pass  # the victim's first incarnation already stopped
+            uninstall_span_exporter()
+            set_process_identity(None)

@@ -1,0 +1,576 @@
+"""Unit coverage for the fleet observability plane (ISSUE 10).
+
+The cross-process pieces — ring exporter cursors, trace assembly with
+tail sampling, critical-path attribution, type-correct metric rollup,
+multi-window SLO burn rates, histogram exemplars, and kvdiag's
+TYPE-aware ``/metrics`` parsing — each driven in isolation with literal
+spans/expositions and fake clocks. The end-to-end composition (a live
+collector over the sharded toy cluster) lives in
+``tests/test_cluster_e2e.py::TestFleetObservabilityE2E``.
+"""
+
+import importlib.util
+import json
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from llmd_kv_cache_tpu.services.telemetry_collector import (
+    CollectorConfig,
+    ScrapeTarget,
+    TelemetryCollector,
+    TraceAssembler,
+    critical_path,
+)
+from llmd_kv_cache_tpu.telemetry.rollup import (
+    merge_families,
+    parse_exposition,
+    rollup_percentiles,
+)
+from llmd_kv_cache_tpu.telemetry.slo import SLOConfig, SLORegistry, SLOTracker
+from llmd_kv_cache_tpu.telemetry.tracing import (
+    InMemorySpanExporter,
+    RecordedSpan,
+    install_span_exporter,
+    set_process_identity,
+    tracer,
+    uninstall_span_exporter,
+)
+
+
+def _span(name, trace_id, span_id, parent, start, end, process=None):
+    attrs = {} if process is None else {"process": process}
+    sp = RecordedSpan(name, trace_id, span_id, parent, attrs)
+    sp.start_time = start
+    sp.end_time = end
+    return sp
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def _load_kvdiag():
+    spec = importlib.util.spec_from_file_location(
+        "kvdiag", Path(__file__).resolve().parents[1] / "hack" / "kvdiag.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- ring exporter ------------------------------------------------------------
+
+
+class TestRingExporter:
+    def test_evict_oldest_cursor_resume_and_idempotent_pulls(self):
+        exp = InMemorySpanExporter(max_spans=4)
+        for i in range(6):
+            exp.export(_span(f"s{i}", 1, i + 1, None, float(i), float(i) + 0.5))
+
+        # Oldest two evicted; seqs are assigned at pull time to survivors.
+        p1 = exp.export_since(-1)
+        assert [s["name"] for s in p1["spans"]] == ["s2", "s3", "s4", "s5"]
+        assert p1["dropped"] == 2
+        assert exp.dropped == 2
+
+        # Cursor resume: only spans exported after the cursor come back.
+        exp.export(_span("s6", 1, 7, None, 6.0, 6.5))
+        p2 = exp.export_since(p1["next_seq"])
+        assert [s["name"] for s in p2["spans"]] == ["s6"]
+        # Non-destructive: a retried pull returns the same window.
+        assert exp.export_since(p1["next_seq"])["spans"] == p2["spans"]
+        # The ring stayed full: s6 evicted s2, full pull now starts at s3.
+        p3 = exp.export_since(-1)
+        assert [s["name"] for s in p3["spans"]] == ["s3", "s4", "s5", "s6"]
+        assert p3["dropped"] == 3
+
+    def test_process_identity_stamped_at_pull_only_when_absent(self):
+        exp = InMemorySpanExporter(max_spans=8)
+        exp.export(_span("anon", 1, 1, None, 0.0, 1.0))
+        exp.export(_span("owned", 1, 2, None, 0.0, 1.0, process="shard-7"))
+        set_process_identity("pod-3")
+        try:
+            by_name = {s["name"]: s for s in exp.export_since(-1)["spans"]}
+            assert by_name["anon"]["attributes"]["process"] == "pod-3"
+            assert by_name["owned"]["attributes"]["process"] == "shard-7"
+        finally:
+            set_process_identity(None)
+
+    def test_tracer_spans_round_trip_over_the_wire(self):
+        exp = install_span_exporter(InMemorySpanExporter(max_spans=8))
+        try:
+            with tracer().span("llm_d.kv_cache.test.outer", pod="p0"):
+                with tracer().span("llm_d.kv_cache.test.inner"):
+                    pass
+        finally:
+            uninstall_span_exporter()
+        wire = {s["name"]: s for s in exp.export_since(-1)["spans"]}
+        outer = RecordedSpan.from_wire(wire["llm_d.kv_cache.test.outer"])
+        inner = RecordedSpan.from_wire(wire["llm_d.kv_cache.test.inner"])
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_span_id == outer.span_id
+        assert outer.attributes["pod"] == "p0"
+        assert outer.end_time >= outer.start_time
+
+
+# -- critical path ------------------------------------------------------------
+
+
+class TestCriticalPath:
+    def test_sequential_children_tile_the_parent(self):
+        spans = [
+            _span("root", 9, 1, None, 0.0, 10.0, process="a"),
+            _span("c1", 9, 2, 1, 1.0, 3.0, process="b"),
+            _span("c2", 9, 3, 1, 4.0, 9.0, process="c"),
+        ]
+        path = critical_path(spans)
+        by_name = {seg["name"]: seg for seg in path}
+        assert set(by_name) == {"root", "c1", "c2"}
+        assert by_name["root"]["self_time_s"] == pytest.approx(3.0)  # 0-1,3-4,9-10
+        assert by_name["c1"]["self_time_s"] == pytest.approx(2.0)
+        assert by_name["c2"]["self_time_s"] == pytest.approx(5.0)
+        assert sum(s["self_time_s"] for s in path) == pytest.approx(10.0)
+        # Ordered earliest-first for rendering.
+        assert [seg["name"] for seg in path] == ["root", "c1", "c2"]
+
+    def test_overlapping_children_split_at_the_shadow_boundary(self):
+        spans = [
+            _span("root", 9, 1, None, 0.0, 10.0),
+            _span("slow", 9, 2, 1, 2.0, 8.0),
+            _span("early", 9, 3, 1, 1.0, 6.0),
+        ]
+        by_name = {seg["name"]: seg for seg in critical_path(spans)}
+        # The later-ending child owns the overlap; the earlier one only
+        # contributes the part before the later child started.
+        assert by_name["slow"]["self_time_s"] == pytest.approx(6.0)
+        assert by_name["early"]["self_time_s"] == pytest.approx(1.0)
+        assert by_name["root"]["self_time_s"] == pytest.approx(3.0)
+
+    def test_children_outlasting_the_root_stay_on_the_path(self):
+        # The score→serve shape: the GetPodScores root returns in
+        # milliseconds; handoff + decode children run long after. The gap
+        # between them is surfaced as "(untracked)", never billed to the
+        # tiny root span.
+        spans = [
+            _span("score", 9, 1, None, 0.0, 1.0, process="shard"),
+            _span("commit", 9, 2, 1, 2.0, 4.0, process="prefill"),
+            _span("decode", 9, 3, 1, 5.0, 9.0, process="decode"),
+        ]
+        path = critical_path(spans)
+        by_name = {seg["name"]: seg for seg in path}
+        assert by_name["score"]["self_time_s"] == pytest.approx(1.0)
+        assert by_name["commit"]["self_time_s"] == pytest.approx(2.0)
+        assert by_name["decode"]["self_time_s"] == pytest.approx(4.0)
+        assert by_name["(untracked)"]["self_time_s"] == pytest.approx(2.0)
+        assert sum(s["self_time_s"] for s in path) == pytest.approx(9.0)
+
+    def test_orphan_span_roots_its_own_subtree(self):
+        spans = [_span("only", 9, 5, 12345, 1.0, 2.0)]  # parent never seen
+        path = critical_path(spans)
+        assert [seg["name"] for seg in path] == ["only"]
+        assert path[0]["self_time_s"] == pytest.approx(1.0)
+
+    def test_unfinished_spans_are_ignored(self):
+        assert critical_path([]) == []
+        assert critical_path([_span("open", 9, 1, None, 0.0, None)]) == []
+
+
+# -- trace assembly + tail sampling -------------------------------------------
+
+
+def _wire(trace_id, span_id, start, end, name="s", parent=None, process="p"):
+    return _span(name, trace_id, span_id, parent, start, end,
+                 process=process).to_wire()
+
+
+class TestTraceAssembler:
+    def test_dedupe_idle_finalize_and_slo_breach_retention(self):
+        clock = FakeClock()
+        asm = TraceAssembler(idle_s=1.0, slo_threshold_s=2.0,
+                             k_slowest=0, head_sample_rate=0.0, clock=clock)
+        spans = [
+            _wire(7, 1, 0.0, 3.0, name="root", process="a"),
+            _wire(7, 2, 0.5, 1.5, name="child", parent=1, process="b"),
+        ]
+        assert asm.ingest(spans) == 2
+        assert asm.ingest(spans) == 0  # at-least-once pulls dedupe
+
+        clock.now = 0.5
+        assert asm.finalize_idle() == []  # not idle yet
+        clock.now = 1.6
+        done = asm.finalize_idle()
+        assert len(done) == 1
+        trace = done[0]
+        assert trace["span_count"] == 2
+        assert trace["processes"] == ["a", "b"]
+        assert trace["duration_s"] == pytest.approx(3.0)
+        assert trace["retained_reason"] == "slo_breach"  # 3.0s >= 2.0s
+        assert asm.find_trace(f"{7:032x}") is not None
+
+    def test_k_slowest_reservoir_and_sampled_out(self):
+        clock = FakeClock()
+        asm = TraceAssembler(idle_s=0.0, slo_threshold_s=1e9,
+                             k_slowest=2, head_sample_rate=0.0, clock=clock)
+
+        def run(tid, duration):
+            asm.ingest([_wire(tid, 1, 0.0, duration)])
+            out = asm.finalize_idle(force=True)
+            assert len(out) == 1
+            return out[0].get("retained_reason")
+
+        assert run(1, 1.0) == "k_slowest"   # reservoir not full
+        assert run(2, 0.5) == "k_slowest"
+        assert run(3, 0.1) is None          # slower than the K kept
+        assert run(4, 2.0) == "k_slowest"   # beats the current floor
+        assert asm.sampled_out == 1
+        assert asm.assembled == 4
+
+    def test_head_sample_lottery_is_stable_on_trace_id(self):
+        clock = FakeClock()
+        asm = TraceAssembler(idle_s=0.0, slo_threshold_s=1e9,
+                             k_slowest=0, head_sample_rate=1.0, clock=clock)
+        asm.ingest([_wire(42, 1, 0.0, 0.1)])
+        trace = asm.finalize_idle(force=True)[0]
+        assert trace["retained_reason"] == "head_sample"  # rate 1.0: always
+
+        never = TraceAssembler(idle_s=0.0, slo_threshold_s=1e9, k_slowest=0,
+                               head_sample_rate=0.0, clock=clock)
+        never.ingest([_wire(42, 1, 0.0, 0.1)])
+        assert "retained_reason" not in never.finalize_idle(force=True)[0]
+
+    def test_retained_ring_evicts_oldest_trace(self):
+        clock = FakeClock()
+        asm = TraceAssembler(idle_s=0.0, slo_threshold_s=0.0, k_slowest=0,
+                             head_sample_rate=0.0, max_traces=2, clock=clock)
+        for tid in (1, 2, 3):
+            asm.ingest([_wire(tid, 1, 0.0, 1.0)])
+            asm.finalize_idle(force=True)
+        assert [t["trace_id"] for t in asm.retained()] == \
+            [f"{2:032x}", f"{3:032x}"]
+        assert asm.find_trace(f"{1:032x}") is None
+
+
+# -- metric rollup ------------------------------------------------------------
+
+
+POD_A = """
+# TYPE kvtpu_engine_ttft_seconds histogram
+kvtpu_engine_ttft_seconds_bucket{le="0.1"} 4
+kvtpu_engine_ttft_seconds_bucket{le="1.0"} 9
+kvtpu_engine_ttft_seconds_bucket{le="+Inf"} 10
+kvtpu_engine_ttft_seconds_count 10
+kvtpu_engine_ttft_seconds_sum 3.5
+# TYPE kvtpu_engine_requests_finished counter
+kvtpu_engine_requests_finished_total 7
+# TYPE kvtpu_engine_kv_pool_used_pages gauge
+kvtpu_engine_kv_pool_used_pages 40
+"""
+
+POD_B = """
+# TYPE kvtpu_engine_ttft_seconds histogram
+kvtpu_engine_ttft_seconds_bucket{le="0.1"} 1
+kvtpu_engine_ttft_seconds_bucket{le="1.0"} 2
+kvtpu_engine_ttft_seconds_bucket{le="+Inf"} 10
+kvtpu_engine_ttft_seconds_count 10
+kvtpu_engine_ttft_seconds_sum 30.0
+# TYPE kvtpu_engine_requests_finished counter
+kvtpu_engine_requests_finished_total 5
+# TYPE kvtpu_engine_kv_pool_used_pages gauge
+kvtpu_engine_kv_pool_used_pages 10
+"""
+
+
+class TestMetricRollup:
+    def test_type_correct_merge(self):
+        merged = merge_families([parse_exposition(POD_A),
+                                 parse_exposition(POD_B)])
+        # Counters sum across pods.
+        counter = merged["kvtpu_engine_requests_finished"]
+        assert counter["type"] == "counter"
+        assert counter["samples"][()] == pytest.approx(12.0)
+        # Gauges keep sum/max/avg so the reader picks the right view.
+        gauge = merged["kvtpu_engine_kv_pool_used_pages"]["samples"][()]
+        assert gauge == {"sum": 50.0, "max": 40.0, "avg": 25.0, "pods": 2}
+        # Histogram buckets merge bucket-by-bucket.
+        hist = merged["kvtpu_engine_ttft_seconds"]["samples"][()]
+        assert hist["buckets"] == {"0.1": 5.0, "1.0": 11.0, "+Inf": 20.0}
+        assert hist["count"] == 20.0
+        assert hist["sum"] == pytest.approx(33.5)
+
+    def test_fleet_percentiles_are_merged_not_averaged(self):
+        merged = merge_families([parse_exposition(POD_A),
+                                 parse_exposition(POD_B)])
+        pcts = rollup_percentiles(merged, "kvtpu_engine_ttft_seconds")
+        assert pcts["count"] == 20.0
+        # 11 of 20 observations are <= 1.0s: the fleet p50 sits inside
+        # the (0.1, 1.0] bucket — pod A alone would put it near 0.1.
+        assert 0.1 < pcts["p50"] <= 1.0
+        assert pcts["p99"] == pytest.approx(1.0)  # +Inf saturates
+        assert rollup_percentiles(merged, "kvtpu_engine_requests_finished") == {}
+
+
+# -- SLO burn rates -----------------------------------------------------------
+
+
+class TestSLOBurnRate:
+    def _tracker(self, clock, objective=0.99, fast=(60.0, 300.0),
+                 slow=900.0, fast_threshold=14.4, slow_threshold=6.0):
+        return SLOTracker(SLOConfig(
+            name="t", objective=objective, fast_windows=fast,
+            slow_window=slow, fast_threshold=fast_threshold,
+            slow_threshold=slow_threshold), clock=clock)
+
+    def test_burn_rate_is_bad_fraction_over_budget(self):
+        clock = FakeClock()
+        t = self._tracker(clock)  # budget = 1%
+        t.record(good=98, bad=2)  # bad fraction 2%
+        assert t.burn_rate(60.0) == pytest.approx(2.0)
+        assert t.burn_rate(900.0) == pytest.approx(2.0)
+
+    def test_fast_burn_needs_both_windows_and_clears_after_drain(self):
+        clock = FakeClock(1000.0)
+        t = self._tracker(clock)
+        # Healthy history fills the confirmation window.
+        for i in range(10):
+            clock.now = 1000.0 + i * 10.0
+            t.record(good=10, bad=0)
+            assert t.evaluate()["alert"]["severity"] is None
+        # Hard outage: burn far beyond 14.4 in both fast windows.
+        for i in range(10):
+            clock.now = 1100.0 + i * 5.0
+            t.record(good=0, bad=10)
+        view = t.evaluate()
+        assert view["alert"]["severity"] == "fast_burn"
+        assert view["alert"]["fires"] == 1
+        assert view["error_budget_remaining"] < 1.0
+        assert t.alert_severity == "fast_burn"
+        # Recovery: the short window drains first (severity may pass
+        # through slow_burn while the long window still remembers).
+        clock.now = 1100.0 + 9 * 5.0 + 301.0  # past the confirm window
+        t.record(good=100, bad=0)
+        view = t.evaluate()
+        assert view["alert"]["severity"] in (None, "slow_burn")
+        clock.now += 900.0  # past the slow window too
+        t.record(good=1, bad=0)
+        assert t.evaluate()["alert"]["severity"] is None
+        assert t.alert_severity is None
+
+    def test_slow_burn_flags_a_simmering_regression(self):
+        clock = FakeClock()
+        # fast_threshold unreachable: only the slow window can fire.
+        t = self._tracker(clock, fast_threshold=1e9)
+        for i in range(20):
+            clock.now = float(i * 30)
+            t.record(good=90, bad=10)  # 10x budget: > slow, < fast
+        assert t.evaluate()["alert"]["severity"] == "slow_burn"
+
+    def test_registry_evaluates_every_tracker(self):
+        clock = FakeClock()
+        reg = SLORegistry(clock=clock)
+        reg.add(SLOConfig(name="a"))
+        reg.add(SLOConfig(name="b"))
+        reg.get("a").record(good=1, bad=0)
+        views = reg.evaluate_all()
+        assert set(views) == {"a", "b"}
+        assert set(reg.debug_view()) == {"a", "b"}
+
+
+# -- collector SLI extraction -------------------------------------------------
+
+
+TTFT_ROUND_1 = """
+# TYPE kvtpu_engine_ttft_seconds histogram
+kvtpu_engine_ttft_seconds_bucket{le="1.0"} 6
+kvtpu_engine_ttft_seconds_bucket{le="2.0"} 8
+kvtpu_engine_ttft_seconds_bucket{le="+Inf"} 10
+kvtpu_engine_ttft_seconds_count 10
+kvtpu_engine_ttft_seconds_sum 12.5
+"""
+
+TTFT_ROUND_2 = TTFT_ROUND_1.replace('le="2.0"} 8', 'le="2.0"} 12') \
+    .replace('le="1.0"} 6', 'le="1.0"} 8') \
+    .replace('le="+Inf"} 10', 'le="+Inf"} 14') \
+    .replace("_count 10", "_count 14")
+
+TTFT_RESTARTED = """
+# TYPE kvtpu_engine_ttft_seconds histogram
+kvtpu_engine_ttft_seconds_bucket{le="1.0"} 3
+kvtpu_engine_ttft_seconds_bucket{le="2.0"} 3
+kvtpu_engine_ttft_seconds_bucket{le="+Inf"} 3
+kvtpu_engine_ttft_seconds_count 3
+kvtpu_engine_ttft_seconds_sum 0.9
+"""
+
+
+class TestCollectorSLIFeeds:
+    def _collector(self, clock):
+        return TelemetryCollector(CollectorConfig(
+            targets=(ScrapeTarget(name="pod-0", address="127.0.0.1:1",
+                                  role="decode"),),
+            scrape_interval_s=0.0, admin_port=0,
+            fast_windows=(60.0, 300.0), slow_window=900.0,
+        ), clock=clock)
+
+    def test_histogram_deltas_feed_the_ttft_slo(self):
+        clock = FakeClock()
+        col = self._collector(clock)
+        state = col._targets[0]
+        tracker = col.slos.get("ttft")
+
+        state.families = parse_exposition(TTFT_ROUND_1)
+        col._feed_latency_slis()
+        # Threshold 2.0s: 8 of 10 under -> bad fraction 0.2 -> burn 20x.
+        assert tracker.burn_rate(60.0) == pytest.approx(20.0)
+
+        # Unchanged counts contribute no new events.
+        col._feed_latency_slis()
+        assert tracker.burn_rate(60.0) == pytest.approx(20.0)
+
+        # Next round: +4 requests, all under threshold.
+        state.families = parse_exposition(TTFT_ROUND_2)
+        col._feed_latency_slis()
+        assert tracker.burn_rate(60.0) == pytest.approx((2 / 14) / 0.01)
+
+    def test_pod_restart_resets_the_delta_baseline(self):
+        clock = FakeClock()
+        col = self._collector(clock)
+        state = col._targets[0]
+        tracker = col.slos.get("ttft")
+
+        state.families = parse_exposition(TTFT_ROUND_1)
+        col._feed_latency_slis()
+        # Counts went backward: the pod restarted. The whole post-restart
+        # histogram counts as fresh events, never as a negative delta.
+        state.families = parse_exposition(TTFT_RESTARTED)
+        col._feed_latency_slis()
+        assert tracker.burn_rate(60.0) == pytest.approx((2 / 13) / 0.01)
+
+
+# -- span export over the admin endpoint --------------------------------------
+
+
+class TestSpanExportEndpoint:
+    def test_debug_spans_serves_ring_payload(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        exp = InMemorySpanExporter(max_spans=8)
+        exp.export(_span("s0", 3, 1, None, 0.0, 1.0, process="p0"))
+        admin = AdminServer(port=0, expose_debug=True)
+        admin.register_spans_source(exp.export_since)
+        admin.start()
+        try:
+            base = f"http://127.0.0.1:{admin.port}"
+            with urllib.request.urlopen(f"{base}/debug/spans?since=-1") as r:
+                payload = json.loads(r.read())
+            assert [s["name"] for s in payload["spans"]] == ["s0"]
+            cursor = payload["next_seq"]
+            with urllib.request.urlopen(
+                    f"{base}/debug/spans?since={cursor}") as r:
+                assert json.loads(r.read())["spans"] == []
+        finally:
+            admin.stop()
+
+
+# -- exemplars ----------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_openmetrics_renders_trace_id_exemplars(self):
+        from prometheus_client import REGISTRY
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as generate_openmetrics,
+        )
+
+        from llmd_kv_cache_tpu.metrics.collector import bucket_histogram
+
+        hist = bucket_histogram(
+            "kvtpu_engine_test_exemplar_seconds",
+            "exemplar rendering fixture", (0.1, 1.0))
+        trace_id = "deadbeef" * 4
+        hist.observe(0.05, trace_id=trace_id)
+        hist.observe(5.0)  # no trace context: bucket stays exemplar-free
+
+        ex = hist.exemplars()
+        assert ex[0][0] == trace_id
+        assert ex[2] is None  # +Inf bucket never saw a traced observation
+
+        text = generate_openmetrics(REGISTRY).decode("utf-8")
+        line = next(
+            l for l in text.splitlines()
+            if l.startswith('kvtpu_engine_test_exemplar_seconds_bucket{le="0.1"}'))
+        assert f'# {{trace_id="{trace_id}"}} 0.05' in line
+
+
+# -- kvdiag parsing -----------------------------------------------------------
+
+
+class TestKvdiagParsing:
+    def test_parse_metrics_retains_types_and_groups_families(self):
+        kvdiag = _load_kvdiag()
+        report = kvdiag.parse_metrics(POD_A + "\nunrelated_total 9\n")
+        assert "unrelated" not in report  # non-project families filtered
+        assert report["kvtpu_engine_requests_finished"]["type"] == "counter"
+        hist = report["kvtpu_engine_ttft_seconds"]
+        assert hist["type"] == "histogram"
+        names = {s["name"] for s in hist["samples"]}
+        # _bucket/_sum/_count samples grouped under the TYPE'd family.
+        assert names == {"kvtpu_engine_ttft_seconds_bucket",
+                         "kvtpu_engine_ttft_seconds_sum",
+                         "kvtpu_engine_ttft_seconds_count"}
+        les = [s["labels"].get("le") for s in hist["samples"]
+               if s["name"].endswith("_bucket")]
+        assert les == ["0.1", "1.0", "+Inf"]
+
+    def test_multi_snapshot_degrades_unreachable_targets(self):
+        kvdiag = _load_kvdiag()
+        report = kvdiag.multi_snapshot(["127.0.0.1:1", "nonsense"],
+                                       timeout=0.5)
+        assert report["reachable"] == 0
+        assert report["unreachable"] == 2
+        assert "cannot reach" in report["targets"]["127.0.0.1:1"]["error"]
+        assert "bad target spec" in report["targets"]["nonsense"]["error"]
+
+    def test_fleet_summary_condenses_collector_debug(self):
+        kvdiag = _load_kvdiag()
+        debug = {
+            "traces": {
+                "open_traces": 0, "assembled_total": 2,
+                "sampled_out_total": 1,
+                "retained": [{
+                    "trace_id": "ab" * 16,
+                    "retained_reason": "slo_breach",
+                    "duration_s": 3.0, "span_count": 4,
+                    "processes": ["a", "b"],
+                    "critical_path": [
+                        {"name": "score", "process": "a", "self_time_s": 0.5},
+                        {"name": "decode", "process": "b", "self_time_s": 2.5},
+                    ],
+                }],
+            },
+            "slo": {
+                "availability": {
+                    "burn_rates": {"60s": 250.0},
+                    "error_budget_remaining": 0.0,
+                    "alert": {"severity": "fast_burn", "fires": 1},
+                },
+                "ttft": {"burn_rates": {"60s": 0.0},
+                         "error_budget_remaining": 1.0,
+                         "alert": {"severity": None, "fires": 0}},
+            },
+            "rollup": {"all": {}, "targets": {"pod-0": {"reachable": True}}},
+        }
+        fleet = kvdiag.fleet_summary(debug)
+        kept = fleet["retained_traces"]
+        assert kept[0]["reason"] == "slo_breach"
+        assert kept[0]["dominant_segment"] == {
+            "name": "decode", "process": "b", "self_time_s": 2.5}
+        assert fleet["alerts"] == [{
+            "slo": "availability", "severity": "fast_burn",
+            "burn_rates": {"60s": 250.0}, "error_budget_remaining": 0.0}]
+        assert fleet["targets"] == {"pod-0": {"reachable": True}}
+        assert "targets" not in fleet["rollup"]
