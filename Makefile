@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -92,6 +92,24 @@ bench-disagg: native
 # /debug/spans pull and trace-assembly round timings.
 bench-fleet: native
 	$(CPU_ENV) $(PY) bench.py --fleet-telemetry
+
+# Continuous-profiling overhead gate (telemetry/sampling_profiler): the
+# always-on sampler's pass-cost x hz CPU fraction must stay under 1% of
+# the score p50; also emits the hot-function shares the perf sentinel
+# diffs.
+bench-pyprof: native
+	$(CPU_ENV) $(PY) bench.py --pyprof-overhead
+
+# Perf-regression sentinel: run the profiling gate, then diff its value
+# and hot-function shares against the committed baseline manifest.
+# Emits machine-verdict `PERF PASS|FAIL ...` lines; fails on regression.
+perf-check: native
+	$(CPU_ENV) $(PY) bench.py --pyprof-overhead > /tmp/kvtpu_pyprof_bench.json
+	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
+	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json
+
+# The pre-merge bundle: conventions lint + the perf sentinel.
+verify: lint perf-check
 
 # Run every runnable example headlessly (the reference's
 # hack/verify-examples.sh equivalent).

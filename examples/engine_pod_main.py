@@ -69,6 +69,16 @@ def main() -> None:
                              "(process identity = the pod id) into a ring "
                              "served at /debug/spans on --admin-port for "
                              "the telemetry collector to pull")
+    parser.add_argument("--pyprof", action="store_true",
+                        help="continuous profiling: always-on sampling "
+                             "profiler serving folded stacks at "
+                             "/debug/pyprof (+ /debug/pyprof/capture) on "
+                             "--admin-port")
+    parser.add_argument("--pyprof-hz", type=float, default=67.0,
+                        help="sampling rate for --pyprof (default 67)")
+    parser.add_argument("--pyprof-window-s", type=float, default=10.0,
+                        help="profile window length for --pyprof "
+                             "(default 10s)")
     args = parser.parse_args()
 
     cfg = LlamaConfig.tiny()
@@ -128,6 +138,23 @@ def main() -> None:
                 default_identity=args.pod_id)
             if source is not None:
                 admin.register_spans_source(source)
+        if args.pyprof:
+            from llmd_kv_cache_tpu.telemetry import (
+                FleetTelemetryConfig,
+                SamplingProfilerConfig,
+                enable_pyprof,
+            )
+
+            pyprof = enable_pyprof(
+                FleetTelemetryConfig(
+                    pyprof=SamplingProfilerConfig(
+                        enabled=True, hz=args.pyprof_hz,
+                        window_s=args.pyprof_window_s)),
+                default_identity=args.pod_id)
+            if pyprof is not None:
+                prof_source, prof_capture = pyprof
+                admin.register_pyprof_source(prof_source)
+                admin.register_pyprof_capture(prof_capture)
         admin.start()
         (control / f"{args.pod_id}.admin_port").write_text(str(admin.port))
 

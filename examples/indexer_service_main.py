@@ -111,6 +111,16 @@ def main() -> None:
              "(counted in kvtpu_trace_dropped_spans_total)",
     )
     parser.add_argument(
+        "--pyprof", action="store_true",
+        help="continuous profiling: always-on sampling profiler serving "
+             "span-attributed folded stacks at /debug/pyprof "
+             "(+ /debug/pyprof/capture burst mode) on --admin-port",
+    )
+    parser.add_argument(
+        "--pyprof-hz", type=float, default=67.0,
+        help="sampling rate for --pyprof (default 67 Hz)",
+    )
+    parser.add_argument(
         "--process-identity", default="",
         help="logical process name stamped on exported spans (what the "
              "collector's critical-path attribution groups by); default: "
@@ -156,12 +166,16 @@ def main() -> None:
         "adminPort": args.admin_port,
         "adminHost": args.admin_host,
     }
-    if args.span_export:
+    if args.span_export or args.pyprof:
         indexer_cfg_dict["fleetTelemetry"] = {
-            "spanExport": True,
+            "spanExport": args.span_export,
             "maxSpans": args.span_export_max_spans,
             "processIdentity": args.process_identity,
         }
+        if args.pyprof:
+            indexer_cfg_dict["fleetTelemetry"]["pyprof"] = {
+                "enabled": True, "hz": args.pyprof_hz,
+            }
     if args.snapshot_dir:
         indexer_cfg_dict["recoveryConfig"] = {
             "snapshotDir": args.snapshot_dir,

@@ -53,7 +53,7 @@ import urllib.request
 
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
-                   "kvtpu_fleet_")
+                   "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -213,6 +213,8 @@ def fleet_summary(debug: dict) -> dict:
     traces = debug.get("traces") or {}
     slo = debug.get("slo") or {}
     rollup = debug.get("rollup") or {}
+    pyprof = debug.get("pyprof") or {}
+    prof_spans = pyprof.get("spans") or {}
     out: dict = {
         "open_traces": traces.get("open_traces"),
         "assembled_total": traces.get("assembled_total"),
@@ -224,20 +226,51 @@ def fleet_summary(debug: dict) -> dict:
         path = t.get("critical_path") or []
         head = max(path, key=lambda seg: seg.get("self_time_s", 0.0)) \
             if path else None
+        dominant = None
+        if head is not None:
+            dominant = {
+                "name": head.get("name"),
+                "process": head.get("process"),
+                "self_time_s": head.get("self_time_s"),
+            }
+            # Join against the fleet-merged continuous profile: which
+            # function dominates the CPU samples taken *inside* this
+            # critical-path segment ("score fan-out: 41% in msgpack
+            # decode").
+            prof = prof_spans.get(head.get("name"))
+            functions = (prof or {}).get("functions") or {}
+            if functions:
+                fn = next(iter(functions))
+                dominant["dominant_function"] = fn
+                dominant["function_share"] = functions[fn]
         kept.append({
             "trace_id": t.get("trace_id"),
             "reason": t.get("retained_reason"),
             "duration_s": t.get("duration_s"),
             "span_count": t.get("span_count"),
             "processes": t.get("processes"),
-            "dominant_segment": None if head is None else {
-                "name": head.get("name"),
-                "process": head.get("process"),
-                "self_time_s": head.get("self_time_s"),
-            },
+            "dominant_segment": dominant,
         })
     kept.sort(key=lambda t: -(t["duration_s"] or 0.0))
     out["retained_traces"] = kept
+
+    if pyprof:
+        # Continuous-profiling rollup: where the fleet's CPU time went,
+        # per span, without anyone having run a profiler by hand.
+        out["profile"] = {
+            "windows": pyprof.get("windows"),
+            "samples": pyprof.get("samples"),
+            "targets": pyprof.get("targets"),
+            "spans": {
+                name: {
+                    "samples": entry.get("samples"),
+                    "top_functions": dict(
+                        list((entry.get("functions") or {}).items())[:3]),
+                }
+                for name, entry in prof_spans.items()
+            },
+            "attribution": pyprof.get("attribution"),
+        }
 
     out["rollup"] = {
         role: fams for role, fams in rollup.items() if role != "targets"

@@ -11,13 +11,14 @@ Three AST checks over every ``.py`` file under the given roots (default
    (and config-bucketed ``BucketHistogram`` / ``bucket_histogram``)
    constructed in the library must start with ``kvcache_``,
    ``kv_offload_``, ``kvtpu_engine_``, ``kvtpu_shard_``,
-   ``kvtpu_handoff_``, ``kvtpu_slo_``, ``kvtpu_trace_``, or
-   ``kvtpu_fleet_`` so dashboards can select the project's families
-   with one matcher.
+   ``kvtpu_handoff_``, ``kvtpu_slo_``, ``kvtpu_trace_``,
+   ``kvtpu_fleet_``, ``kvtpu_pyprof_``, or ``kvtpu_offload_`` so
+   dashboards can select the project's families with one matcher.
 3. **docs coverage** — every metric name constructed in the library, and
    every fully-literal span name, must appear in
    ``docs/observability.md``; an undocumented metric is a dashboard
-   nobody will ever build.
+   nobody will ever build. The debug endpoints in ``REQUIRED_ENDPOINTS``
+   (the continuous-profiling surface) must be documented too.
 
 Exit status 1 when any violation is found (CI-friendly; see Makefile
 ``lint`` target).
@@ -32,7 +33,10 @@ from pathlib import Path
 SPAN_PREFIX = "llm_d.kv_cache."
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
-                   "kvtpu_fleet_")
+                   "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_")
+# Admin-plane surfaces an operator must be able to find without reading
+# the source: each literal must appear in docs/observability.md.
+REQUIRED_ENDPOINTS = ("/debug/pyprof", "/debug/pyprof/capture")
 METRIC_CLASSES = frozenset({
     "Counter", "Gauge", "Histogram", "Summary",
     # The engine-telemetry histogram primitive with config-driven buckets
@@ -128,6 +132,11 @@ def check_docs(metric_names: list[str], span_names: list[str],
         f"{docs_path}: span `{name}` is not documented"
         for name in sorted(set(span_names))
         if name not in text
+    )
+    problems.extend(
+        f"{docs_path}: endpoint `{endpoint}` is not documented"
+        for endpoint in REQUIRED_ENDPOINTS
+        if endpoint not in text
     )
     return problems
 

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""perf_sentinel: machine-verdict perf-regression gate for CI (``make perf-check``).
+
+Compares bench results (the single-line JSON ``bench.py`` modes emit)
+and continuous-profile hot-function shares against a committed baseline
+manifest, and prints one machine-parseable verdict line per check::
+
+    PERF PASS bench:pyprof-overhead value=0.0772 baseline=0.5 limit=1.0
+    PERF FAIL bench:pyprof-overhead value=1.3100 baseline=0.5 limit=1.0 (regression +162.0%)
+    PERF PASS hotfn:llm_d.kv_cache.score_tokens:tracing.py:export share=0.0100 max=0.2500
+    PERF OVERALL PASS checks=3 failed=0
+
+Line grammar (stable; tests in ``tests/test_bench_units.py`` parse it):
+``PERF <PASS|FAIL> <check-id> key=value...`` with the summary line
+``PERF OVERALL <PASS|FAIL> checks=N failed=M`` last. Exit code 0 iff no
+check failed.
+
+The baseline manifest (``benchmarking/perf_baseline.json``)::
+
+    {
+      "benches": {
+        "pyprof-overhead": {
+          "baseline": 0.5,            # expected value (bench "value" field)
+          "max_regression_pct": 100,  # value may grow this % past baseline
+          "direction": "lower_is_better"
+        }
+      },
+      "hot_functions": {
+        "llm_d.kv_cache.score_tokens": {"tracing.py:export": 0.25}
+      }
+    }
+
+``hot_functions`` caps the *share* a leaf function may claim of a span's
+CPU samples (from the ``hot_functions`` field of profile-carrying bench
+results, e.g. ``--pyprof-overhead``): a function creeping past its cap
+is a hot-path regression even when the headline latency gate still
+passes, because latency gates average over everything while shares name
+the culprit. A function absent from the profile passes trivially (it
+never got hot).
+
+Usage::
+
+    python hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
+        --results pyprof-overhead=/tmp/pyprof_bench.json
+
+Stdlib-only, like every hack/ tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+
+def _fmt(value: float) -> str:
+    return f"{value:.4f}".rstrip("0").rstrip(".") if value == value else "nan"
+
+
+def check_bench(name: str, result: dict, spec: dict) -> Tuple[bool, str]:
+    """One bench-value check → (passed, verdict line)."""
+    value = float(result.get("value", float("nan")))
+    baseline = float(spec.get("baseline", float("nan")))
+    max_reg = float(spec.get("max_regression_pct", 25.0))
+    lower_is_better = spec.get("direction", "lower_is_better") == "lower_is_better"
+    if lower_is_better:
+        limit = baseline * (1.0 + max_reg / 100.0)
+        ok = value <= limit
+        reg_pct = 100.0 * (value - baseline) / baseline if baseline else 0.0
+    else:
+        limit = baseline * (1.0 - max_reg / 100.0)
+        ok = value >= limit
+        reg_pct = 100.0 * (baseline - value) / baseline if baseline else 0.0
+    if value != value:  # NaN: bench emitted no "value" field
+        ok = False
+        reg_pct = float("nan")
+    line = (f"PERF {'PASS' if ok else 'FAIL'} bench:{name} "
+            f"value={_fmt(value)} baseline={_fmt(baseline)} "
+            f"limit={_fmt(limit)}")
+    if not ok:
+        line += f" (regression {reg_pct:+.1f}%)"
+    return ok, line
+
+
+def check_hot_functions(
+    caps: Dict[str, Dict[str, float]],
+    hot: Dict[str, dict],
+) -> List[Tuple[bool, str]]:
+    """Share caps vs an observed ``hot_functions`` profile section."""
+    out: List[Tuple[bool, str]] = []
+    for span, fn_caps in sorted(caps.items()):
+        observed = (hot.get(span) or {}).get("functions") or {}
+        for fn, max_share in sorted(fn_caps.items()):
+            share = float(observed.get(fn, 0.0))
+            ok = share <= float(max_share)
+            out.append((ok, (
+                f"PERF {'PASS' if ok else 'FAIL'} hotfn:{span}:{fn} "
+                f"share={_fmt(share)} max={_fmt(float(max_share))}")))
+    return out
+
+
+def evaluate(baseline: dict, results: Dict[str, dict]) -> Tuple[List[str], int]:
+    """All checks → (verdict lines incl. OVERALL, failed count)."""
+    checks: List[Tuple[bool, str]] = []
+    benches = baseline.get("benches") or {}
+    for name, spec in sorted(benches.items()):
+        result = results.get(name)
+        if result is None:
+            # A bench the manifest gates but the run did not produce: an
+            # absent gate must fail loudly, not silently pass.
+            checks.append((False, f"PERF FAIL bench:{name} missing=1"))
+            continue
+        checks.append(check_bench(name, result, spec))
+    caps = baseline.get("hot_functions") or {}
+    if caps:
+        merged_hot: Dict[str, dict] = {}
+        for result in results.values():
+            for span, entry in (result.get("hot_functions") or {}).items():
+                merged_hot[span] = entry
+        checks.extend(check_hot_functions(caps, merged_hot))
+    failed = sum(1 for ok, _ in checks if not ok)
+    lines = [line for _, line in checks]
+    lines.append(f"PERF OVERALL {'FAIL' if failed else 'PASS'} "
+                 f"checks={len(checks)} failed={failed}")
+    return lines, failed
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--baseline", required=True,
+                        help="the committed manifest "
+                             "(benchmarking/perf_baseline.json)")
+    parser.add_argument("--results", action="append", default=[],
+                        metavar="NAME=FILE",
+                        help="bench result JSON (the bench's single output "
+                             "line) keyed by its manifest name; repeatable")
+    args = parser.parse_args(argv)
+
+    with open(args.baseline, encoding="utf-8") as f:
+        baseline = json.load(f)
+    results: Dict[str, dict] = {}
+    for spec in args.results:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            parser.error(f"--results needs NAME=FILE, got {spec!r}")
+        with open(path, encoding="utf-8") as f:
+            results[name] = json.load(f)
+
+    lines, failed = evaluate(baseline, results)
+    for line in lines:
+        print(line)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

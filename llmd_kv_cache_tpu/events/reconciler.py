@@ -66,10 +66,16 @@ class KubernetesDiscovery:
 
     Endpoint per pod: ``tcp://<pod-ip>:<socket_port>`` (reference
     ``pod_reconciler.go:86-162``). Requires the optional ``kubernetes``
-    package and in-cluster or kubeconfig credentials.
+    package and in-cluster or kubeconfig credentials — unless a
+    ``core_api`` is injected (tests stub the CoreV1Api surface;
+    ``discover`` itself is then exercised without a cluster).
     """
 
-    def __init__(self, cfg: PodDiscoveryConfig):
+    def __init__(self, cfg: PodDiscoveryConfig, core_api=None):
+        if core_api is not None:
+            self._core = core_api
+            self.cfg = cfg
+            return
         try:
             from kubernetes import client, config as k8s_config
         except ImportError as e:  # pragma: no cover - optional dep
@@ -83,7 +89,7 @@ class KubernetesDiscovery:
         self._core = client.CoreV1Api()
         self.cfg = cfg
 
-    def discover(self) -> dict[str, str]:  # pragma: no cover - needs cluster
+    def discover(self) -> dict[str, str]:
         kwargs = {"label_selector": self.cfg.pod_label_selector}
         if self.cfg.pod_namespace:
             pods = self._core.list_namespaced_pod(self.cfg.pod_namespace, **kwargs)

@@ -10,6 +10,7 @@ for text-only blocks (multimodal-tainted blocks take the Python path).
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from dataclasses import dataclass
@@ -44,7 +45,18 @@ def load_library() -> ctypes.CDLL:
         if not _LIB_PATH.exists() or (
             src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
         ):
-            logger.info("building libkvindex.so")
+            if os.environ.get("KVTPU_NATIVE_NO_BUILD") == "1":
+                raise RuntimeError(
+                    f"{_LIB_PATH} is missing or stale and "
+                    "KVTPU_NATIVE_NO_BUILD=1 forbids compiling at import "
+                    "time; run `make native` first (or drop the env knob)")
+            # Loud on purpose: an import-time compile means the prebuilt
+            # path was skipped, which in production adds seconds of
+            # latency (and a toolchain dependency) to first use.
+            logger.warning(
+                "libkvindex.so missing/stale at %s — compiling at import "
+                "time; prebuild with `make native` to avoid this",
+                _LIB_PATH)
             subprocess.run(["make", "-s"], cwd=str(_CSRC_DIR), check=True,
                            capture_output=True)
         lib = ctypes.CDLL(str(_LIB_PATH))

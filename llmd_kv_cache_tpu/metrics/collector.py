@@ -679,6 +679,53 @@ def record_spans_exported(count: int) -> None:
         TRACE_EXPORTED_SPANS.inc(count)
 
 
+# --------------------------------------------------------------------------
+# Continuous profiling (kvtpu_pyprof_*): the always-on sampling profiler
+# (telemetry/sampling_profiler.py). samples/overhead are the self-measured
+# cost ledger — rate(overhead)/1s is the live CPU fraction the sampler
+# steals, gated <1% by ``bench.py --pyprof-overhead``; dropped windows mean
+# the collector's /debug/pyprof cursor is lagging the export ring.
+# --------------------------------------------------------------------------
+
+PYPROF_SAMPLES = Counter(
+    "kvtpu_pyprof_samples_total",
+    "Thread-stack samples folded by the sampling profiler",
+)
+PYPROF_OVERHEAD_SECONDS = Counter(
+    "kvtpu_pyprof_overhead_seconds_total",
+    "Wall time spent inside sampling-profiler passes (self-measured)",
+)
+PYPROF_WINDOWS_DROPPED = Counter(
+    "kvtpu_pyprof_windows_dropped_total",
+    "Sealed profile windows evicted before any /debug/pyprof pull",
+)
+PYPROF_TRIE_NODES = Gauge(
+    "kvtpu_pyprof_trie_nodes",
+    "Interned stack-trie nodes in the live (unsealed) profile window",
+)
+
+
+# --------------------------------------------------------------------------
+# Per-tier restore latency (ROADMAP item 3): the engine's storage-restore
+# paths label each restore with the offload medium (SHARED_STORAGE,
+# OBJECT_STORE, ...) so slow-tier restores are visible per tier — and,
+# via the fleet collector's restore_latency SLI, in burn-rate alerts.
+# kvtpu_engine_restore_latency_seconds stays as the tier-blind aggregate.
+# --------------------------------------------------------------------------
+
+OFFLOAD_RESTORE_SECONDS = Histogram(
+    "kvtpu_offload_restore_seconds",
+    "Storage-tier KV restore wall time per tier (sync + deferred paths)",
+    ["tier"],
+    buckets=(1e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0),
+)
+
+
+def record_offload_restore(tier: str, seconds: float) -> None:
+    OFFLOAD_RESTORE_SECONDS.labels(tier or "unknown").observe(
+        max(seconds, 0.0))
+
+
 _beat_thread: Optional[threading.Thread] = None
 _beat_stop = threading.Event()
 

@@ -1278,7 +1278,10 @@ class MiniEngine:
             return
         restore_hashes = restore_hashes[: len(pages)]
 
-        from ..metrics.collector import record_engine_restore
+        from ..metrics.collector import (
+            record_engine_restore,
+            record_offload_restore,
+        )
 
         self._sync_caches_to_copier()
         started = time.monotonic()
@@ -1303,6 +1306,7 @@ class MiniEngine:
             return
         elapsed = time.monotonic() - started
         record_engine_restore("success", elapsed)
+        record_offload_restore(self._offload_medium, elapsed)
         if self.on_restore_latency is not None:
             try:
                 self.on_restore_latency(elapsed)
@@ -1370,7 +1374,10 @@ class MiniEngine:
         """Advance an in-flight deferred restore. Returns True once settled
         (success, failure, or timeout) — prefill may proceed; False while
         the load is still in flight (the step goes on decoding)."""
-        from ..metrics.collector import record_engine_restore
+        from ..metrics.collector import (
+            record_engine_restore,
+            record_offload_restore,
+        )
 
         job, first_missing, hashes, pages, deadline, started = req.restore_job
         result = self._restore_results.pop(job, None)
@@ -1399,6 +1406,7 @@ class MiniEngine:
             return True
         elapsed = time.monotonic() - started
         record_engine_restore("success", elapsed)
+        record_offload_restore(self._offload_medium, elapsed)
         if self.on_restore_latency is not None:
             # Residency scoring's tier-discount feed (index.cost_aware
             # .observe_tier_latency when the serving assembly wired it).

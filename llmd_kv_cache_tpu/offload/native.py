@@ -8,6 +8,7 @@ runs on the C++ pool threads, off the GIL.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -59,7 +60,18 @@ def load_library() -> ctypes.CDLL:
             s.exists() and s.stat().st_mtime > _LIB_PATH.stat().st_mtime
             for s in sources
         ):
-            logger.info("building libkvio.so")
+            if os.environ.get("KVTPU_NATIVE_NO_BUILD") == "1":
+                raise RuntimeError(
+                    f"{_LIB_PATH} is missing or stale and "
+                    "KVTPU_NATIVE_NO_BUILD=1 forbids compiling at import "
+                    "time; run `make native` first (or drop the env knob)")
+            # Loud on purpose: an import-time compile means the prebuilt
+            # path was skipped, which in production adds seconds of
+            # latency (and a toolchain dependency) to first use.
+            logger.warning(
+                "libkvio.so missing/stale at %s — compiling at import "
+                "time; prebuild with `make native` to avoid this",
+                _LIB_PATH)
             _build()
         lib = ctypes.CDLL(str(_LIB_PATH))
 

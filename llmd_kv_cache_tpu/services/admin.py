@@ -16,6 +16,13 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
   exporter, newer than the puller's cursor (404 until the owner calls
   :meth:`AdminServer.register_spans_source`). The fleet telemetry
   collector polls this to assemble cross-process traces.
+- ``/debug/pyprof?since=SEQ`` — sealed folded-stack windows from the
+  always-on sampling profiler, same cursor semantics as
+  ``/debug/spans`` (404 until :meth:`AdminServer.register_pyprof_source`
+  is called). The collector merges these fleet-wide.
+- ``/debug/pyprof/capture?seconds=N`` — on-demand burst capture on the
+  sampling profiler, next to the jax ``/debug/profile`` endpoint (one at
+  a time → 409; 404 until :meth:`AdminServer.register_pyprof_capture`).
 
 ``/metrics?format=openmetrics`` switches the exposition to OpenMetrics,
 the only text format that renders exemplars (trace-id links on
@@ -65,6 +72,8 @@ class AdminServer:
         self._health = health
         self._profiler: Optional[Callable[[float], dict]] = None
         self._spans_source: Optional[Callable[[int], dict]] = None
+        self._pyprof_source: Optional[Callable[[int], dict]] = None
+        self._pyprof_capture: Optional[Callable[[float], dict]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -87,6 +96,19 @@ class AdminServer:
         Typically ``InMemorySpanExporter.export_since``. 404 until set —
         span export is opt-in per pod (``fleetTelemetry.spanExport``)."""
         self._spans_source = source
+
+    def register_pyprof_source(self, source: Callable[[int], dict]) -> None:
+        """Enable ``/debug/pyprof``: ``source(since_seq)`` returns the
+        sampling profiler's ``export_since`` payload (sealed folded-stack
+        windows + cursor + drops). 404 until set — continuous profiling
+        is opt-in per pod (``fleetTelemetry.pyprof``)."""
+        self._pyprof_source = source
+
+    def register_pyprof_capture(self, capture: Callable[[float], dict]) -> None:
+        """Enable ``/debug/pyprof/capture``: ``capture(seconds)`` runs a
+        blocking burst capture on the sampling profiler and returns the
+        folded profile. 404 until set."""
+        self._pyprof_capture = capture
 
     def set_health_provider(self, provider: Callable[[], dict]) -> None:
         """Make ``/healthz`` report ``provider()`` instead of the static
@@ -130,6 +152,49 @@ class AdminServer:
         except Exception as exc:
             return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
         return (200, json.dumps(payload, default=repr).encode(),
+                "application/json")
+
+    def _handle_pyprof(self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._pyprof_source is None:
+            return (404, b'{"error": "sampling profiler not configured"}',
+                    "application/json")
+        raw = query.get("since", ["-1"])[-1]
+        try:
+            since = int(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad since: {raw!r}"}).encode(), "application/json")
+        try:
+            payload = self._pyprof_source(since)
+        except Exception as exc:
+            return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(payload, default=repr).encode(),
+                "application/json")
+
+    def _handle_pyprof_capture(
+            self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._pyprof_capture is None:
+            return (404, b'{"error": "sampling profiler not configured"}',
+                    "application/json")
+        raw = query.get("seconds", ["1.0"])[-1]
+        try:
+            seconds = float(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad seconds: {raw!r}"}).encode(),
+                "application/json")
+        try:
+            summary = self._pyprof_capture(seconds)
+        except ValueError as exc:
+            return 400, json.dumps({"error": str(exc)}).encode(), "application/json"
+        except Exception as exc:
+            # CaptureInProgress (a RuntimeError subclass) → 409, matching
+            # the jax profiler endpoint; anything else → 500.
+            from ..telemetry.sampling_profiler import CaptureInProgress
+
+            status = 409 if isinstance(exc, CaptureInProgress) else 500
+            return status, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(summary, indent=2, default=repr).encode(),
                 "application/json")
 
     def _debug_vars(self) -> dict:
@@ -193,6 +258,15 @@ class AdminServer:
                 return self._handle_profile(query or {})
             if path == "/debug/spans":
                 return self._handle_spans(query or {})
+            # No local sampler but a registered "pyprof" provider (the
+            # collector's fleet-merged view): fall through to the generic
+            # /debug/<name> dispatch below instead of 404ing.
+            if path == "/debug/pyprof" and (
+                    self._pyprof_source is not None
+                    or "pyprof" not in self._providers):
+                return self._handle_pyprof(query or {})
+            if path == "/debug/pyprof/capture":
+                return self._handle_pyprof_capture(query or {})
             if path == "/debug/flight-recorder":
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"

@@ -388,13 +388,21 @@ class IndexerService:
         # here to assemble cross-process traces.
         ft = self.indexer.config.fleet_telemetry
         if ft is not None:
-            from ..telemetry.fleet import enable_span_export
+            from ..telemetry.fleet import enable_pyprof, enable_span_export
 
             source = enable_span_export(
                 ft, default_identity=self.process_name)
             if source is not None:
                 for server in self._observability_servers:
                     server.register_spans_source(source)
+            # Continuous profiling: /debug/pyprof (windowed pull) and
+            # /debug/pyprof/capture (burst) on the same admin servers.
+            pyprof = enable_pyprof(ft, default_identity=self.process_name)
+            if pyprof is not None:
+                prof_source, prof_capture = pyprof
+                for server in self._observability_servers:
+                    server.register_pyprof_source(prof_source)
+                    server.register_pyprof_capture(prof_capture)
 
     def stop(self) -> None:
         for server in self._observability_servers:

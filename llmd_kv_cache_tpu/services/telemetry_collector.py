@@ -38,6 +38,7 @@ import json
 import threading
 import time
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -50,6 +51,7 @@ from ..telemetry.rollup import (
     parse_exposition,
     rollup_percentiles,
 )
+from ..telemetry.sampling_profiler import merge_folded, span_function_shares
 from ..telemetry.slo import SLOConfig, SLORegistry
 from ..telemetry.tracing import RecordedSpan, tracer
 from ..utils.logging import get_logger
@@ -78,6 +80,10 @@ FLEET_TRACES_RETAINED = Counter(
 FLEET_TARGETS_REACHABLE = Gauge(
     "kvtpu_fleet_targets_reachable",
     "Targets whose last scrape round succeeded",
+)
+FLEET_PROFILE_WINDOWS = Counter(
+    "kvtpu_fleet_profile_windows_total",
+    "Sampling-profiler windows pulled from pod /debug/pyprof endpoints",
 )
 
 # Fleet-level serving histograms worth rolling up, per role.
@@ -124,7 +130,15 @@ class CollectorConfig:
     ttft_objective: float = 0.99
     score_threshold_s: float = 0.1
     score_objective: float = 0.99
+    restore_threshold_s: float = 0.25
+    restore_objective: float = 0.99
     availability_objective: float = 0.999
+    # Continuous-profiling leg: pull /debug/pyprof windows from every
+    # target (404 from a pod with the sampler off is tolerated and never
+    # trips that target's breaker) and keep the newest pyprof_max_windows
+    # fleet-wide for merging.
+    pyprof_enabled: bool = True
+    pyprof_max_windows: int = 120
     fast_windows: Tuple[float, float] = (300.0, 3600.0)
     slow_window: float = 21600.0
     fast_threshold: float = 14.4
@@ -174,9 +188,20 @@ class CollectorConfig:
                 k("scoreThresholdS", "score_threshold_s", d.score_threshold_s)),
             score_objective=float(
                 k("scoreObjective", "score_objective", d.score_objective)),
+            restore_threshold_s=float(
+                k("restoreThresholdS", "restore_threshold_s",
+                  d.restore_threshold_s)),
+            restore_objective=float(
+                k("restoreObjective", "restore_objective",
+                  d.restore_objective)),
             availability_objective=float(
                 k("availabilityObjective", "availability_objective",
                   d.availability_objective)),
+            pyprof_enabled=bool(
+                k("pyprofEnabled", "pyprof_enabled", d.pyprof_enabled)),
+            pyprof_max_windows=int(
+                k("pyprofMaxWindows", "pyprof_max_windows",
+                  d.pyprof_max_windows)),
             fast_windows=(float(fast[0]), float(fast[1])),
             slow_window=float(k("slowWindow", "slow_window", d.slow_window)),
             fast_threshold=float(
@@ -465,6 +490,7 @@ class _TargetState:
     target: ScrapeTarget
     breaker: CircuitBreaker
     span_cursor: int = -1
+    pyprof_cursor: int = -1
     reachable: bool = False
     families: Dict[str, MetricFamily] = field(default_factory=dict)
     last_hist_counts: Dict[str, Tuple[float, float]] = field(default_factory=dict)
@@ -523,9 +549,17 @@ class TelemetryCollector:
             description=f"score_tokens <= {config.score_threshold_s}s",
             **windows))
         self.slos.add(SLOConfig(
+            name="restore_latency",
+            objective=config.restore_objective,
+            description=f"KV restore <= {config.restore_threshold_s}s "
+                        "(any tier)", **windows))
+        self.slos.add(SLOConfig(
             name="availability",
             objective=config.availability_objective,
             description="scrape target reachable", **windows))
+        self._profile_lock = threading.Lock()
+        self._profile_windows: deque = deque(
+            maxlen=max(1, config.pyprof_max_windows))
         self._tracer = tracer()
         self._admin: Optional[AdminServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -570,6 +604,27 @@ class TelemetryCollector:
             state.families = parse_exposition(metrics_raw.decode("utf-8"))
         except Exception as exc:
             logger.debug("metrics from %s unparseable: %s", name, exc)
+        # Profile leg: separate try so a pod without the sampler (404) or
+        # with a flaky pyprof endpoint stays "reachable" and never trips
+        # the breaker — profiles are an enrichment, not a health signal.
+        if self.cfg.pyprof_enabled:
+            try:
+                prof_raw = self._fetch(
+                    f"{base}/debug/pyprof?since={state.pyprof_cursor}")
+                prof = json.loads(prof_raw)
+                windows = prof.get("windows", [])
+                with self._profile_lock:
+                    for window in windows:
+                        window = dict(window)
+                        window.setdefault("process", "")
+                        window["target"] = name
+                        self._profile_windows.append(window)
+                if windows:
+                    FLEET_PROFILE_WINDOWS.inc(len(windows))
+                state.pyprof_cursor = int(
+                    prof.get("next_seq", state.pyprof_cursor))
+            except Exception as exc:
+                logger.debug("pyprof pull from %s skipped: %s", name, exc)
         return True
 
     # -- SLI extraction ----------------------------------------------------
@@ -585,6 +640,8 @@ class TelemetryCollector:
             ("ttft", "kvtpu_engine_ttft_seconds", self.cfg.ttft_threshold_s),
             ("score_latency", "kvcache_score_latency_seconds",
              self.cfg.score_threshold_s),
+            ("restore_latency", "kvtpu_offload_restore_seconds",
+             self.cfg.restore_threshold_s),
         )
         for slo_name, family, threshold in feeds:
             tracker = self.slos.get(slo_name)
@@ -595,7 +652,12 @@ class TelemetryCollector:
                 if fam is None or fam.type != "histogram":
                     continue
                 total = 0.0
-                under = 0.0
+                # Cumulative buckets are per labelset (the restore family
+                # carries a ``tier`` label): take the widest bucket at or
+                # under the threshold *per labelset*, then sum across
+                # labelsets — a plain max would undercount every labelset
+                # but the busiest tier.
+                under_by_labels: Dict[tuple, float] = {}
                 for (suffix, labels), value in fam.samples.items():
                     if suffix == "_count":
                         total += value
@@ -606,7 +668,11 @@ class TelemetryCollector:
                         except ValueError:
                             continue
                         if bound <= threshold:
-                            under = max(under, value)
+                            rest = tuple(kv for kv in labels
+                                         if kv[0] != "le")
+                            under_by_labels[rest] = max(
+                                under_by_labels.get(rest, 0.0), value)
+                under = sum(under_by_labels.values())
                 key = f"{state.target.name}:{family}"
                 prev_total, prev_under = state.last_hist_counts.get(
                     key, (0.0, 0.0))
@@ -681,12 +747,59 @@ class TelemetryCollector:
         }
         return out
 
+    def profile_view(self) -> dict:
+        """Fleet-merged continuous profile + critical-path attribution.
+
+        Merges every pulled ``/debug/pyprof`` window into one folded
+        profile, derives per-span leaf-function shares, and joins them
+        against the retained traces' critical paths so each trace answers
+        *dominant segment × dominant function* ("score fan-out: 41% in
+        msgpack decode"). ``folded`` is ready for ``flamegraph.pl``.
+        """
+        with self._profile_lock:
+            windows = list(self._profile_windows)
+        merged = merge_folded([w.get("folded", "") for w in windows])
+        spans = span_function_shares(merged)
+        attribution = []
+        for summary in self.assembler.retained():
+            path = summary.get("critical_path") or []
+            if not path:
+                continue
+            dominant = max(path, key=lambda seg: seg["self_time_s"])
+            entry = {
+                "trace_id": summary["trace_id"],
+                "segment": dominant["name"],
+                "process": dominant["process"],
+                "self_time_s": dominant["self_time_s"],
+                "dominant_function": "",
+                "function_share": 0.0,
+            }
+            prof = spans.get(dominant["name"])
+            if prof and prof["functions"]:
+                fn, share = next(iter(prof["functions"].items()))
+                entry["dominant_function"] = fn
+                entry["function_share"] = share
+            attribution.append(entry)
+        return {
+            "windows": len(windows),
+            "targets": sorted({w.get("target", "") for w in windows} - {""}),
+            "samples": sum(int(w.get("samples", 0)) for w in windows),
+            "spans": spans,
+            "attribution": attribution,
+            "folded": "\n".join(
+                f"{stack} {count}"
+                for stack, count in sorted(merged.items())),
+        }
+
     def debug_view(self) -> dict:
+        pyprof = self.profile_view()
+        pyprof.pop("folded", None)  # bulk text lives at /debug/pyprof
         return {
             "rounds": self.rounds,
             "traces": self.assembler.debug_view(),
             "slo": self.slos.debug_view(),
             "rollup": self.rollup_view(),
+            "pyprof": pyprof,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -702,6 +815,7 @@ class TelemetryCollector:
             self._admin.register_debug("slo", self.slos.debug_view)
             self._admin.register_debug("rollup", self.rollup_view)
             self._admin.register_debug("fleet", self.debug_view)
+            self._admin.register_debug("pyprof", self.profile_view)
             self._admin.start()
         if self._thread is None and self.cfg.scrape_interval_s > 0:
             self._stop.clear()

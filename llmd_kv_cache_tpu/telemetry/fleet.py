@@ -10,9 +10,15 @@ collector's critical-path attribution groups by).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from .sampling_profiler import (
+    SamplingProfiler,
+    SamplingProfilerConfig,
+    active_sampling_profiler,
+    install_sampling_profiler,
+)
 from .tracing import (
     InMemorySpanExporter,
     active_span_exporter,
@@ -37,6 +43,11 @@ class FleetTelemetryConfig:
     # The collector's address (host:port), informational for operators /
     # kvdiag --fleet; pods never dial it (the collector pulls).
     collector_address: str = ""
+    # Continuous profiling (``pyprof`` sub-block): the always-on sampling
+    # profiler exported at /debug/pyprof. Off by default; the sampler's
+    # own cost is gated <1% of score p50 by ``bench.py --pyprof-overhead``.
+    pyprof: SamplingProfilerConfig = field(
+        default_factory=SamplingProfilerConfig)
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> Optional["FleetTelemetryConfig"]:
@@ -59,6 +70,8 @@ class FleetTelemetryConfig:
             collector_address=str(
                 k("collectorAddress", "collector_address",
                   d.collector_address)),
+            pyprof=SamplingProfilerConfig.from_dict(
+                k("pyprof", "pyprof", None)),
         )
 
 
@@ -93,3 +106,33 @@ def enable_span_export(
         return payload
 
     return source
+
+
+def enable_pyprof(
+    config: FleetTelemetryConfig,
+    default_identity: str = "",
+) -> Optional[tuple]:
+    """Install + start the sampling profiler per ``config.pyprof``.
+
+    Returns ``(source, capture)`` callables to hand to
+    ``AdminServer.register_pyprof_source`` /
+    ``register_pyprof_capture``, or None when continuous profiling is
+    disabled. Like :func:`enable_span_export`, a profiler already
+    installed in this process is reused (one sampler per process — the
+    OS only has one set of thread stacks to walk).
+    """
+    if not config.pyprof.enabled:
+        return None
+    set_process_identity(config.process_identity or default_identity or None)
+    profiler = active_sampling_profiler()
+    if profiler is None:
+        profiler = install_sampling_profiler(SamplingProfiler(config.pyprof))
+    profiler.start()
+
+    def source(since: int, _p=profiler) -> dict:
+        return _p.export_since(since)
+
+    def capture(seconds: float, _p=profiler) -> dict:
+        return _p.capture(seconds)
+
+    return source, capture

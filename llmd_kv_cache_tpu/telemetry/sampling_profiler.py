@@ -1,0 +1,499 @@
+"""Always-on span-attributed CPU sampling profiler (stdlib only).
+
+Fleet tracing (PR 10) answers *where a request waited*; this module
+answers *where CPU time goes* — continuously and per pod, so ROADMAP
+item 4's "Python-side score/ingest overhead" claim is measurable in
+production instead of asserted from a one-off local profile.
+
+Design:
+
+- A daemon thread wakes at a configurable rate (default ~67 Hz, a prime
+  period of ~15 ms so the sampler cannot alias with 10/100 ms pollers)
+  and walks ``sys._current_frames()``.
+- Each thread's stack is folded leaf-up into a **bounded trie**
+  (``max_nodes`` interned frames; overflow collapses into a synthetic
+  ``(trie-full)`` frame so memory is hard-capped), with per-thread
+  sample counts kept alongside.
+- Each sample is tagged with the sampled thread's **currently-active
+  span name** read from the tracer's cross-thread registry
+  (:func:`telemetry.tracing.active_span_names`) — span-attributed
+  profiling: the fleet collector joins these tags against critical-path
+  segments to report *dominant segment × dominant function*.
+- Every ``window_s`` the live trie is sealed into a window and pushed
+  onto an evict-oldest ring of ``max_windows``; windows export as
+  Brendan-Gregg folded-stack text over ``/debug/pyprof?since=seq`` with
+  the same cursor semantics as ``/debug/spans`` (non-destructive,
+  monotonic seq, drop counting).
+- The sampler self-measures: wall time spent inside each sampling pass
+  is accumulated per window and exported as ``overhead_frac`` (plus the
+  ``kvtpu_pyprof_*`` metric families), and ``bench.py --pyprof-overhead``
+  gates that cost under 1% of the score-path p50.
+
+Folded line format (one stack per line, count last)::
+
+    span:<name-or-(nospan)>;thread:<name>;file.py:func;file.py:func 42
+
+Root-first frames after the two tag frames; ``flamegraph.pl`` or
+speedscope render it directly (docs/observability.md "Continuous
+profiling").
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .tracing import active_span_names, process_identity
+
+logger = get_logger("telemetry.sampling_profiler")
+
+# Tag frame for samples whose thread is not inside any span.
+NO_SPAN = "(nospan)"
+# Synthetic frame charged once the trie hits max_nodes.
+TRIE_FULL = "(trie-full)"
+
+
+class CaptureInProgress(RuntimeError):
+    """A burst ``/debug/pyprof/capture`` is already running (→ HTTP 409)."""
+
+
+MAX_CAPTURE_SECONDS = 60.0
+
+
+def _metrics():
+    """Lazy metric handles: the profiler must stay importable (and usable
+    by kvdiag deep-debug) without the metrics stack."""
+    try:
+        from ..metrics.collector import (
+            PYPROF_OVERHEAD_SECONDS,
+            PYPROF_SAMPLES,
+            PYPROF_TRIE_NODES,
+            PYPROF_WINDOWS_DROPPED,
+        )
+
+        return (PYPROF_SAMPLES, PYPROF_OVERHEAD_SECONDS,
+                PYPROF_WINDOWS_DROPPED, PYPROF_TRIE_NODES)
+    except Exception:  # pragma: no cover - metrics stack absent
+        return None
+
+
+@dataclass(frozen=True)
+class SamplingProfilerConfig:
+    """``fleetTelemetry.pyprof`` knobs (camelCase in config files)."""
+
+    enabled: bool = False
+    # Sampling rate. 67 Hz ≈ a 14.9 ms period: prime-ish so periodic
+    # 10/100 ms work cannot hide between samples, and low enough that a
+    # <150 µs pass stays under the 1% CPU budget.
+    hz: float = 67.0
+    # Windowing: seal the live trie every window_s; keep max_windows
+    # sealed windows in the evict-oldest export ring.
+    window_s: float = 10.0
+    max_windows: int = 30
+    # Bounded-trie caps: total interned stack nodes per window and frames
+    # kept per stack (deepest frames beyond max_depth are dropped,
+    # keeping the leaf).
+    max_nodes: int = 8192
+    max_depth: int = 64
+
+    @classmethod
+    def from_dict(cls, data: Optional[dict]) -> "SamplingProfilerConfig":
+        if not data:
+            return cls()
+
+        def k(camel: str, snake: str, default):
+            if camel in data:
+                return data[camel]
+            if snake in data:
+                return data[snake]
+            return default
+
+        d = cls()
+        return cls(
+            enabled=bool(k("enabled", "enabled", d.enabled)),
+            hz=float(k("hz", "hz", d.hz)),
+            window_s=float(k("windowS", "window_s", d.window_s)),
+            max_windows=int(k("maxWindows", "max_windows", d.max_windows)),
+            max_nodes=int(k("maxNodes", "max_nodes", d.max_nodes)),
+            max_depth=int(k("maxDepth", "max_depth", d.max_depth)),
+        )
+
+
+class _StackTrie:
+    """Bounded trie of folded stacks with per-leaf sample counts.
+
+    Nodes are interned as ``(parent_id, frame) → node_id``; counts land
+    on the node where a sampled stack terminates. ``max_nodes`` caps
+    interning: once full, unseen frames collapse into one shared
+    ``(trie-full)`` child per parent-or-root so hot (already-interned)
+    paths keep full resolution while the long tail degrades gracefully.
+    """
+
+    __slots__ = ("_nodes", "_frames", "_parents", "_counts", "_max_nodes",
+                 "_tf_cap", "truncations")
+
+    def __init__(self, max_nodes: int):
+        self._nodes: Dict[tuple, int] = {}
+        self._frames: List[str] = []
+        self._parents: List[int] = []
+        self._counts: Dict[int, int] = {}
+        self._max_nodes = max(16, int(max_nodes))
+        # Overflow ``(trie-full)`` children intern into a small slack
+        # beyond max_nodes so truncation stays *visible* in the folded
+        # output; the slack itself is the hard cap.
+        self._tf_cap = self._max_nodes + max(16, self._max_nodes // 16)
+        self.truncations = 0
+
+    def __len__(self) -> int:
+        return len(self._frames)
+
+    def _child(self, parent: int, frame: str) -> int:
+        key = (parent, frame)
+        node = self._nodes.get(key)
+        if node is not None:
+            return node
+        if frame == TRIE_FULL:
+            if len(self._frames) >= self._tf_cap:  # even the slack is full
+                return parent
+        elif len(self._frames) >= self._max_nodes:
+            self.truncations += 1
+            return self._child(parent, TRIE_FULL)
+        node = len(self._frames)
+        self._nodes[key] = node
+        self._frames.append(frame)
+        self._parents.append(parent)
+        return node
+
+    def add(self, frames: List[str], count: int = 1) -> None:
+        """Record one root-first folded stack."""
+        node = -1
+        for frame in frames:
+            node = self._child(node, frame)
+        if node >= 0:
+            self._counts[node] = self._counts.get(node, 0) + count
+
+    def folded_lines(self) -> List[str]:
+        """Render ``frame;frame;... count`` lines, deterministic order."""
+        out = []
+        for node, count in self._counts.items():
+            frames = []
+            cur = node
+            while cur >= 0:
+                frames.append(self._frames[cur])
+                cur = self._parents[cur]
+            frames.reverse()
+            out.append(f"{';'.join(frames)} {count}")
+        out.sort()
+        return out
+
+
+def _frame_label(frame) -> str:
+    """``file.py:func`` — short, stable across pods, merge-friendly."""
+    code = frame.f_code
+    filename = code.co_filename
+    slash = filename.rfind("/")
+    if slash >= 0:
+        filename = filename[slash + 1:]
+    return f"{filename}:{code.co_name}"
+
+
+class SamplingProfiler:
+    """The always-on sampler + windowed folded-stack exporter."""
+
+    def __init__(
+        self,
+        config: Optional[SamplingProfilerConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = config or SamplingProfilerConfig(enabled=True)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._trie = _StackTrie(self.cfg.max_nodes)
+        self._window_started = clock()
+        self._window_samples = 0
+        self._window_overhead_s = 0.0
+        self._window_threads: Dict[str, int] = {}
+        self._window_spans: Dict[str, int] = {}
+        self._windows: deque = deque(maxlen=max(1, self.cfg.max_windows))
+        self._next_seq = 0
+        self.dropped = 0
+        self.samples_total = 0
+        self.overhead_s_total = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._capture_lock = threading.Lock()
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_once(self) -> float:
+        """One sampling pass over every thread; returns its own cost (s).
+
+        Public so the overhead bench and tests can drive passes without
+        the timer thread.
+        """
+        t0 = time.perf_counter()
+        own_ident = threading.get_ident()
+        span_by_ident = active_span_names()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        frames_by_ident = sys._current_frames()
+        max_depth = self.cfg.max_depth
+        stacks = []
+        for ident, frame in frames_by_ident.items():
+            if ident == own_ident:
+                continue  # never bill the sampler to the program
+            stack: List[str] = []
+            depth = 0
+            while frame is not None and depth < max_depth:
+                stack.append(_frame_label(frame))
+                frame = frame.f_back
+                depth += 1
+            stack.reverse()  # root first
+            span = span_by_ident.get(ident, NO_SPAN)
+            thread_name = names.get(ident, str(ident))
+            stacks.append((span, thread_name, stack))
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            for span, thread_name, stack in stacks:
+                self._trie.add(
+                    [f"span:{span}", f"thread:{thread_name}"] + stack)
+                self._window_threads[thread_name] = \
+                    self._window_threads.get(thread_name, 0) + 1
+                self._window_spans[span] = self._window_spans.get(span, 0) + 1
+            self._window_samples += len(stacks)
+            self.samples_total += len(stacks)
+            self._window_overhead_s += elapsed
+            self.overhead_s_total += elapsed
+        m = _metrics()
+        if m is not None:
+            samples, overhead, _dropped, nodes = m
+            samples.inc(len(stacks))
+            overhead.inc(elapsed)
+            nodes.set(len(self._trie))
+        return elapsed
+
+    def _rotate_locked(self, now: float) -> None:
+        wall = max(now - self._window_started, 1e-9)
+        window = {
+            "seq": self._next_seq,
+            "process": process_identity() or "",
+            "start_unix": time.time() - wall,
+            "duration_s": round(wall, 3),
+            "hz": self.cfg.hz,
+            "samples": self._window_samples,
+            "threads": dict(self._window_threads),
+            "spans": dict(self._window_spans),
+            "truncations": self._trie.truncations,
+            "overhead_frac": round(self._window_overhead_s / wall, 6),
+            "folded": "\n".join(self._trie.folded_lines()),
+        }
+        self._next_seq += 1
+        if len(self._windows) == self._windows.maxlen:
+            self.dropped += 1
+            m = _metrics()
+            if m is not None:
+                m[2].inc()
+        self._windows.append(window)
+        self._trie = _StackTrie(self.cfg.max_nodes)
+        self._window_started = now
+        self._window_samples = 0
+        self._window_overhead_s = 0.0
+        self._window_threads = {}
+        self._window_spans = {}
+
+    def rotate(self, force: bool = False) -> None:
+        """Seal the live window when due (or unconditionally with force).
+
+        Empty windows are sealed too: a flat profile ("nothing ran") is
+        itself evidence, and the collector's cursor math stays uniform.
+        """
+        with self._lock:
+            now = self._clock()
+            if force or now - self._window_started >= self.cfg.window_s:
+                self._rotate_locked(now)
+
+    # -- export ------------------------------------------------------------
+
+    def export_since(self, since: int = -1) -> dict:
+        """``/debug/pyprof`` payload, mirroring ``/debug/spans`` cursors:
+        sealed windows with ``seq > since`` (oldest first), the next
+        cursor, and the evict-before-pull drop count."""
+        with self._lock:
+            windows = [w for w in self._windows if w["seq"] > since]
+            return {
+                "windows": windows,
+                "next_seq": self._next_seq - 1,
+                "dropped": self.dropped,
+                "live_samples": self._window_samples,
+            }
+
+    def capture(self, seconds: float) -> dict:
+        """Burst mode (``/debug/pyprof/capture?seconds=N``): sample the
+        process at the configured rate for ``seconds`` on the caller's
+        thread and return the folded profile directly — one capture at a
+        time, same guard shape as the jax profiler endpoint."""
+        if not (0.0 < seconds <= MAX_CAPTURE_SECONDS):
+            raise ValueError(
+                f"seconds must be in (0, {MAX_CAPTURE_SECONDS:g}], "
+                f"got {seconds}")
+        if not self._capture_lock.acquire(blocking=False):
+            raise CaptureInProgress("a pyprof capture is already running")
+        try:
+            trie = _StackTrie(self.cfg.max_nodes)
+            period = 1.0 / max(self.cfg.hz, 1e-3)
+            deadline = time.perf_counter() + seconds
+            samples = 0
+            overhead = 0.0
+            own_ident = threading.get_ident()
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                span_by_ident = active_span_names()
+                names = {t.ident: t.name for t in threading.enumerate()}
+                for ident, frame in sys._current_frames().items():
+                    if ident == own_ident:
+                        continue
+                    stack: List[str] = []
+                    depth = 0
+                    while frame is not None and depth < self.cfg.max_depth:
+                        stack.append(_frame_label(frame))
+                        frame = frame.f_back
+                        depth += 1
+                    stack.reverse()
+                    trie.add([f"span:{span_by_ident.get(ident, NO_SPAN)}",
+                              f"thread:{names.get(ident, str(ident))}"]
+                             + stack)
+                    samples += 1
+                overhead += time.perf_counter() - t0
+                time.sleep(max(0.0, period - (time.perf_counter() - t0)))
+            return {
+                "seconds": seconds,
+                "hz": self.cfg.hz,
+                "samples": samples,
+                "process": process_identity() or "",
+                "overhead_frac": round(overhead / max(seconds, 1e-9), 6),
+                "folded": "\n".join(trie.folded_lines()),
+            }
+        finally:
+            self._capture_lock.release()
+
+    def debug_view(self) -> dict:
+        with self._lock:
+            return {
+                "running": self._thread is not None,
+                "hz": self.cfg.hz,
+                "window_s": self.cfg.window_s,
+                "windows_sealed": self._next_seq,
+                "windows_buffered": len(self._windows),
+                "windows_dropped": self.dropped,
+                "samples_total": self.samples_total,
+                "overhead_s_total": round(self.overhead_s_total, 6),
+                "live_samples": self._window_samples,
+                "trie_nodes": len(self._trie),
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the daemon sampling thread (idempotent)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        period = 1.0 / max(self.cfg.hz, 1e-3)
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                try:
+                    self.sample_once()
+                    self.rotate()
+                except Exception:  # sampling must never kill the pod
+                    logger.exception("sampling pass failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="kvtpu-pyprof-sampler", daemon=True)
+        self._thread.start()
+        logger.info(
+            "sampling profiler on: %.0f Hz, %ss windows x %d",
+            self.cfg.hz, self.cfg.window_s, self.cfg.max_windows)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# -- process-global wiring (mirrors install_span_exporter) -------------------
+
+_active_profiler: Optional[SamplingProfiler] = None
+
+
+def install_sampling_profiler(
+    profiler: Optional[SamplingProfiler] = None,
+) -> SamplingProfiler:
+    """Install (or create) the process's profiler; does not start it."""
+    global _active_profiler
+    if profiler is None:
+        profiler = SamplingProfiler()
+    _active_profiler = profiler
+    return profiler
+
+
+def active_sampling_profiler() -> Optional[SamplingProfiler]:
+    return _active_profiler
+
+
+def uninstall_sampling_profiler() -> None:
+    global _active_profiler
+    if _active_profiler is not None:
+        _active_profiler.stop()
+    _active_profiler = None
+
+
+# -- fleet-merge helpers (collector + kvdiag side) ---------------------------
+
+
+def merge_folded(folded_texts: List[str]) -> Dict[str, int]:
+    """Merge folded-stack texts into one ``stack → count`` dict."""
+    merged: Dict[str, int] = {}
+    for text in folded_texts:
+        for line in text.splitlines():
+            stack, _, count = line.rpartition(" ")
+            if not stack:
+                continue
+            try:
+                merged[stack] = merged.get(stack, 0) + int(count)
+            except ValueError:
+                continue
+    return merged
+
+
+def span_function_shares(merged: Dict[str, int]) -> Dict[str, dict]:
+    """Per-span leaf-function attribution from a merged folded profile.
+
+    Returns ``{span_name: {"samples": n, "functions": {leaf_frame:
+    share}}}`` where share is the fraction of that span's samples whose
+    leaf (on-CPU) frame is ``leaf_frame`` — the join key for "dominant
+    segment × dominant function" in ``kvdiag --fleet``.
+    """
+    by_span: Dict[str, dict] = {}
+    for stack, count in merged.items():
+        frames = stack.split(";")
+        span = NO_SPAN
+        if frames and frames[0].startswith("span:"):
+            span = frames[0][len("span:"):]
+        leaf = frames[-1] if frames else ""
+        entry = by_span.setdefault(span, {"samples": 0, "functions": {}})
+        entry["samples"] += count
+        entry["functions"][leaf] = entry["functions"].get(leaf, 0) + count
+    for entry in by_span.values():
+        total = max(entry["samples"], 1)
+        entry["functions"] = {
+            fn: round(c / total, 4)
+            for fn, c in sorted(entry["functions"].items(),
+                                key=lambda kv: -kv[1])
+        }
+    return by_span

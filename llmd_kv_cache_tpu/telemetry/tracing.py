@@ -386,6 +386,26 @@ _CURRENT_SPAN: contextvars.ContextVar[Optional[RecordedSpan]] = contextvars.Cont
     "kvtpu_current_span", default=None
 )
 
+# Cross-thread view of each thread's innermost active span *name*.
+# contextvars are only readable from their own thread, but the sampling
+# profiler (telemetry/sampling_profiler.py) walks ``sys._current_frames()``
+# from a background thread and must attribute each sampled stack to the
+# span the sampled thread is inside. A plain dict keyed by thread ident is
+# enough: single-key int reads/writes are atomic under the GIL, so the hot
+# path stays two dict stores per span (inside the <1% budget that
+# ``bench.py --fleet-telemetry`` gates) and the sampler reads without a
+# lock — a momentarily stale name only mis-tags one 15 ms sample.
+_THREAD_SPAN_NAMES: dict[int, str] = {}
+
+
+def active_span_names() -> dict[int, str]:
+    """Snapshot of thread ident → innermost active span name.
+
+    Read by the sampling profiler; copies so the caller can iterate while
+    spans keep opening/closing.
+    """
+    return dict(_THREAD_SPAN_NAMES)
+
 _recording_exporter: Optional[InMemorySpanExporter] = None
 
 
@@ -456,6 +476,9 @@ class _Tracer:
             trace_id = _new_trace_id()
         sp = RecordedSpan(name, trace_id, _new_span_id(), parent_id, attributes)
         token = _CURRENT_SPAN.set(sp)
+        tid = threading.get_ident()
+        prev_name = _THREAD_SPAN_NAMES.get(tid)
+        _THREAD_SPAN_NAMES[tid] = name
         try:
             yield sp
         except BaseException as exc:
@@ -463,6 +486,10 @@ class _Tracer:
             sp.set_status("ERROR", str(exc))
             raise
         finally:
+            if prev_name is None:
+                _THREAD_SPAN_NAMES.pop(tid, None)
+            else:
+                _THREAD_SPAN_NAMES[tid] = prev_name
             _CURRENT_SPAN.reset(token)
             sp.end_time = time.time()
             if exporter is not None:
@@ -483,20 +510,29 @@ class _Tracer:
                 trace_flags=_otel_trace.TraceFlags(flags),
             )
             context = _otel_trace.set_span_in_context(_otel_trace.NonRecordingSpan(remote))
-        with self._otel_tracer.start_as_current_span(
-            name, context=context, attributes=attributes or None, end_on_exit=True
-        ) as sp:
-            try:
-                yield sp
-            except BaseException as exc:
-                sp.record_exception(exc)
+        tid = threading.get_ident()
+        prev_name = _THREAD_SPAN_NAMES.get(tid)
+        _THREAD_SPAN_NAMES[tid] = name
+        try:
+            with self._otel_tracer.start_as_current_span(
+                name, context=context, attributes=attributes or None, end_on_exit=True
+            ) as sp:
                 try:
-                    from opentelemetry.trace import Status, StatusCode
+                    yield sp
+                except BaseException as exc:
+                    sp.record_exception(exc)
+                    try:
+                        from opentelemetry.trace import Status, StatusCode
 
-                    sp.set_status(Status(StatusCode.ERROR, str(exc)))
-                except Exception:  # pragma: no cover - api drift  # lint: allow-swallow
-                    pass
-                raise
+                        sp.set_status(Status(StatusCode.ERROR, str(exc)))
+                    except Exception:  # pragma: no cover - api drift  # lint: allow-swallow
+                        pass
+                    raise
+        finally:
+            if prev_name is None:
+                _THREAD_SPAN_NAMES.pop(tid, None)
+            else:
+                _THREAD_SPAN_NAMES[tid] = prev_name
 
 
 _tracer: Optional[_Tracer] = None

@@ -188,3 +188,125 @@ class TestGuardedLadder:
                             lambda env=None, timeout=900: None)
         out = json.loads(bench.guarded_main())
         assert "value" in out and "vs_baseline" in out
+
+
+class TestPerfSentinel:
+    """The perf-regression gate's verdict-line grammar and exit codes
+    (``hack/perf_sentinel.py``, wired into ``make perf-check``)."""
+
+    @staticmethod
+    def _sentinel():
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_sentinel", "/root/repo/hack/perf_sentinel.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    BASELINE = {
+        "benches": {
+            "pyprof-overhead": {"baseline": 0.5,
+                                "max_regression_pct": 100.0,
+                                "direction": "lower_is_better"},
+        },
+        "hot_functions": {
+            "llm_d.kv_cache.score_tokens": {"tracing.py:export": 0.25},
+        },
+    }
+
+    def _result(self, value, export_share=0.01):
+        return {"metric": "pyprof_overhead_pct", "value": value,
+                "unit": "%", "vs_baseline": 1.0,
+                "hot_functions": {"llm_d.kv_cache.score_tokens": {
+                    "samples": 100,
+                    "functions": {"native.py:score": 1.0 - export_share,
+                                  "tracing.py:export": export_share}}}}
+
+    def test_healthy_run_passes_every_check(self):
+        sentinel = self._sentinel()
+        lines, failed = sentinel.evaluate(
+            self.BASELINE, {"pyprof-overhead": self._result(0.6)})
+        assert failed == 0
+        assert lines[0] == ("PERF PASS bench:pyprof-overhead "
+                            "value=0.6 baseline=0.5 limit=1")
+        assert lines[1] == ("PERF PASS hotfn:llm_d.kv_cache.score_tokens:"
+                            "tracing.py:export share=0.01 max=0.25")
+        assert lines[-1] == "PERF OVERALL PASS checks=2 failed=0"
+
+    def test_bench_regression_fails_with_verdict_line(self):
+        sentinel = self._sentinel()
+        lines, failed = sentinel.evaluate(
+            self.BASELINE, {"pyprof-overhead": self._result(1.31)})
+        assert failed == 1
+        assert lines[0].startswith(
+            "PERF FAIL bench:pyprof-overhead value=1.31")
+        assert "(regression +162.0%)" in lines[0]
+        assert lines[-1] == "PERF OVERALL FAIL checks=2 failed=1"
+
+    def test_injected_hot_function_regression_fails(self):
+        # The headline latency gate still passes, but a capped function
+        # claims 40% of the span's samples: the sentinel must FAIL.
+        sentinel = self._sentinel()
+        lines, failed = sentinel.evaluate(
+            self.BASELINE,
+            {"pyprof-overhead": self._result(0.6, export_share=0.4)})
+        assert failed == 1
+        assert ("PERF FAIL hotfn:llm_d.kv_cache.score_tokens:"
+                "tracing.py:export share=0.4 max=0.25") in lines
+        assert lines[-1] == "PERF OVERALL FAIL checks=2 failed=1"
+
+    def test_missing_gated_bench_fails_loudly(self):
+        sentinel = self._sentinel()
+        lines, failed = sentinel.evaluate(self.BASELINE, {})
+        assert failed == 1
+        assert "PERF FAIL bench:pyprof-overhead missing=1" in lines
+
+    def test_absent_function_passes_trivially(self):
+        sentinel = self._sentinel()
+        result = self._result(0.6)
+        del result["hot_functions"]["llm_d.kv_cache.score_tokens"][
+            "functions"]["tracing.py:export"]
+        lines, failed = sentinel.evaluate(
+            self.BASELINE, {"pyprof-overhead": result})
+        assert failed == 0
+        assert ("PERF PASS hotfn:llm_d.kv_cache.score_tokens:"
+                "tracing.py:export share=0 max=0.25") in lines
+
+    def test_cli_exit_codes_and_grammar(self, tmp_path):
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps(self.BASELINE))
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(self._result(0.6)))
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(self._result(0.6, export_share=0.9)))
+
+        def run(results):
+            return subprocess.run(
+                [sys.executable, "/root/repo/hack/perf_sentinel.py",
+                 "--baseline", str(baseline),
+                 "--results", f"pyprof-overhead={results}"],
+                capture_output=True, text=True, timeout=60)
+
+        ok = run(good)
+        assert ok.returncode == 0
+        verdicts = [l for l in ok.stdout.splitlines() if l.startswith("PERF")]
+        assert len(verdicts) == 3  # bench + hotfn + OVERALL
+        assert verdicts[-1].startswith("PERF OVERALL PASS")
+
+        regressed = run(bad)
+        assert regressed.returncode == 1
+        assert "PERF OVERALL FAIL checks=2 failed=1" in regressed.stdout
+
+    def test_committed_manifest_matches_a_live_overhead_result(self):
+        # The committed baseline must gate the bench the Makefile feeds
+        # it, with headroom wide enough that a nominal run passes.
+        with open("/root/repo/benchmarking/perf_baseline.json") as f:
+            manifest = json.load(f)
+        assert "pyprof-overhead" in manifest["benches"]
+        sentinel = self._sentinel()
+        nominal = {"metric": "pyprof_overhead_pct", "value": 0.08,
+                   "unit": "%", "vs_baseline": 1.0, "hot_functions": {}}
+        _, failed = sentinel.evaluate(
+            manifest, {"pyprof-overhead": nominal})
+        assert failed == 0

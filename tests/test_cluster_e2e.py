@@ -599,7 +599,7 @@ class TestFleetObservabilityE2E:
                 if t["trace_id"] == trace["trace_id"])
             assert dominant["self_time_s"] > 0.0
             assert set(fleet["slo"]) == {
-                "ttft", "score_latency", "availability"}
+                "ttft", "score_latency", "restore_latency", "availability"}
             assert fleet["alerts"] == []  # healthy fleet: nothing firing
 
             # 5) Chaos: kill one shard. Scrapes of its admin endpoint

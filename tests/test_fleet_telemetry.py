@@ -406,6 +406,21 @@ kvtpu_engine_ttft_seconds_count 3
 kvtpu_engine_ttft_seconds_sum 0.9
 """
 
+RESTORE_ROUND = """
+# TYPE kvtpu_offload_restore_seconds histogram
+kvtpu_offload_restore_seconds_bucket{tier="SHARED_STORAGE",le="0.1"} 5
+kvtpu_offload_restore_seconds_bucket{tier="SHARED_STORAGE",le="0.25"} 8
+kvtpu_offload_restore_seconds_bucket{tier="SHARED_STORAGE",le="1.0"} 10
+kvtpu_offload_restore_seconds_bucket{tier="SHARED_STORAGE",le="+Inf"} 10
+kvtpu_offload_restore_seconds_count{tier="SHARED_STORAGE"} 10
+kvtpu_offload_restore_seconds_sum{tier="SHARED_STORAGE"} 3.1
+kvtpu_offload_restore_seconds_bucket{tier="LOCAL_CPU",le="0.1"} 3
+kvtpu_offload_restore_seconds_bucket{tier="LOCAL_CPU",le="0.25"} 4
+kvtpu_offload_restore_seconds_bucket{tier="LOCAL_CPU",le="+Inf"} 4
+kvtpu_offload_restore_seconds_count{tier="LOCAL_CPU"} 4
+kvtpu_offload_restore_seconds_sum{tier="LOCAL_CPU"} 0.4
+"""
+
 
 class TestCollectorSLIFeeds:
     def _collector(self, clock):
@@ -449,6 +464,32 @@ class TestCollectorSLIFeeds:
         state.families = parse_exposition(TTFT_RESTARTED)
         col._feed_latency_slis()
         assert tracker.burn_rate(60.0) == pytest.approx((2 / 13) / 0.01)
+
+    def test_restore_slo_sums_under_buckets_per_tier(self):
+        # The restore family carries a ``tier`` label: the under-threshold
+        # count must be the per-labelset bucket max *summed across
+        # labelsets* (a plain max would bill every quiet tier's restores
+        # as SLO-bad). 12 of 14 restores land under the 0.25 s threshold.
+        clock = FakeClock()
+        col = self._collector(clock)
+        state = col._targets[0]
+        tracker = col.slos.get("restore_latency")
+        assert tracker is not None  # registered as a first-class SLI
+        state.families = parse_exposition(RESTORE_ROUND)
+        col._feed_latency_slis()
+        assert tracker.burn_rate(60.0) == pytest.approx((2 / 14) / 0.01)
+
+    def test_restore_histogram_records_by_tier(self):
+        from prometheus_client import generate_latest
+
+        from llmd_kv_cache_tpu.metrics.collector import record_offload_restore
+
+        record_offload_restore("SHARED_STORAGE", 0.03)
+        record_offload_restore("", 0.5)  # unlabeled falls to "unknown"
+        text = generate_latest().decode()
+        assert 'kvtpu_offload_restore_seconds_count{tier="SHARED_STORAGE"}' \
+            in text
+        assert 'kvtpu_offload_restore_seconds_count{tier="unknown"}' in text
 
 
 # -- span export over the admin endpoint --------------------------------------

@@ -158,3 +158,31 @@ class TestNativeIndexSpecifics:
         idx.add(keys, keys, [PodEntry("p", "tpu-hbm")])
         result = idx.lookup(keys)
         assert len(result) == len(keys)
+
+
+class TestNoBuildGate:
+    """``KVTPU_NATIVE_NO_BUILD=1`` must fail fast instead of compiling at
+    import time when a prebuilt .so is missing or stale (the loud-warning
+    counterpart is exercised by eye: ``make native`` names the fix)."""
+
+    @pytest.mark.parametrize("module_path", [
+        "llmd_kv_cache_tpu.index.native",
+        "llmd_kv_cache_tpu.offload.native",
+    ])
+    def test_missing_library_raises_instead_of_building(
+            self, module_path, monkeypatch, tmp_path):
+        import importlib
+
+        mod = importlib.import_module(module_path)
+        monkeypatch.setattr(mod, "_lib", None)
+        monkeypatch.setattr(mod, "_LIB_PATH", tmp_path / "nowhere.so")
+        monkeypatch.setenv("KVTPU_NATIVE_NO_BUILD", "1")
+        with pytest.raises(RuntimeError) as err:
+            mod.load_library()
+        assert "make native" in str(err.value)
+        assert "KVTPU_NATIVE_NO_BUILD" in str(err.value)
+
+    def test_gate_off_is_inert_for_fresh_library(self, monkeypatch):
+        # With the .so present and fresh, the knob must not interfere.
+        monkeypatch.setenv("KVTPU_NATIVE_NO_BUILD", "1")
+        assert native.load_library() is not None

@@ -1,0 +1,552 @@
+"""Continuous fleet profiling (ISSUE 11): the always-on sampling
+profiler, its admin endpoints, the collector's profile-merge leg, and
+the end-to-end join — two real pod processes sampled under spans, the
+collector merging their ``/debug/pyprof`` windows, and ``kvdiag
+--fleet`` naming *dominant segment × dominant function*.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from llmd_kv_cache_tpu.services.admin import AdminServer
+from llmd_kv_cache_tpu.services.telemetry_collector import (
+    CollectorConfig,
+    ScrapeTarget,
+    TelemetryCollector,
+)
+from llmd_kv_cache_tpu.telemetry.sampling_profiler import (
+    NO_SPAN,
+    TRIE_FULL,
+    CaptureInProgress,
+    SamplingProfiler,
+    SamplingProfilerConfig,
+    _StackTrie,
+    merge_folded,
+    span_function_shares,
+)
+from llmd_kv_cache_tpu.telemetry.tracing import (
+    InMemorySpanExporter,
+    install_span_exporter,
+    set_process_identity,
+    tracer,
+    uninstall_span_exporter,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Clear of every other fixed-port suite (15900s in test_cluster_e2e).
+PROFILE_COLLECTOR_PORT = 16075
+
+
+def _cfg(**kw):
+    kw.setdefault("enabled", True)
+    return SamplingProfilerConfig(**kw)
+
+
+class _busy_thread:
+    """A second thread to sample: the sampler never bills its own
+    (calling) thread, so a single-threaded test would see zero stacks."""
+
+    def __enter__(self):
+        self._stop = threading.Event()
+
+        def spin():
+            while not self._stop.is_set():
+                sum(range(64))
+
+        self._t = threading.Thread(target=spin, name="spin", daemon=True)
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(5.0)
+
+
+# -- bounded trie -------------------------------------------------------------
+
+
+class TestStackTrie:
+    def test_counts_and_folded_lines(self):
+        trie = _StackTrie(max_nodes=64)
+        trie.add(["a", "b"])
+        trie.add(["a", "b"])
+        trie.add(["a", "c"], count=3)
+        assert trie.folded_lines() == ["a;b 2", "a;c 3"]
+        assert len(trie) == 3  # a, b, c interned once each
+
+    def test_overflow_collapses_into_visible_trie_full(self):
+        trie = _StackTrie(max_nodes=16)
+        for i in range(16):
+            trie.add([f"f{i:02d}"])
+        assert trie.truncations == 0
+        # The 17th distinct frame cannot intern: it collapses into a
+        # shared (trie-full) child so truncation shows up in the flame
+        # instead of silently inflating an ancestor.
+        trie.add(["brand-new-frame"])
+        trie.add(["another-new-frame"])
+        assert trie.truncations == 2
+        lines = trie.folded_lines()
+        assert f"{TRIE_FULL} 2" in lines
+        # Hot (already interned) paths keep full resolution.
+        trie.add(["f00"], count=5)
+        assert "f00 6" in trie.folded_lines()
+
+    def test_hard_cap_holds_under_adversarial_load(self):
+        trie = _StackTrie(max_nodes=16)
+        for i in range(500):
+            trie.add([f"g{i}", f"h{i}", f"k{i}"])
+        # max_nodes plus the bounded (trie-full) slack, never more.
+        assert len(trie) <= 16 + 16
+        assert trie.truncations > 0
+
+
+# -- profiler windows, cursors, span tags -------------------------------------
+
+
+class TestSamplingProfiler:
+    def test_rotation_cursor_and_eviction(self):
+        now = [100.0]
+        prof = SamplingProfiler(
+            _cfg(window_s=1.0, max_windows=2), clock=lambda: now[0])
+        prof.rotate()  # not due yet
+        assert prof.export_since(-1)["windows"] == []
+
+        for _ in range(3):
+            now[0] += 1.0
+            prof.sample_once()
+            prof.rotate()
+        out = prof.export_since(-1)
+        # Three sealed, ring keeps two, oldest dropped and counted.
+        assert [w["seq"] for w in out["windows"]] == [1, 2]
+        assert out["dropped"] == 1
+        assert out["next_seq"] == 2
+        # Cursor semantics: nothing newer than the cursor re-exports.
+        assert prof.export_since(out["next_seq"])["windows"] == []
+        assert prof.export_since(1)["windows"][0]["seq"] == 2
+
+    def test_windows_carry_samples_and_self_measured_overhead(self):
+        prof = SamplingProfiler(_cfg(window_s=3600.0))
+        with _busy_thread():
+            for _ in range(5):
+                cost = prof.sample_once()
+                assert cost >= 0.0
+        prof.rotate(force=True)
+        (window,) = prof.export_since(-1)["windows"]
+        assert window["samples"] >= 5  # >= one thread sampled per pass
+        assert window["overhead_frac"] >= 0.0
+        assert window["hz"] == prof.cfg.hz
+        # The sampler never bills itself... and every stack is tagged.
+        for line in window["folded"].splitlines():
+            assert line.startswith("span:")
+        assert f"span:{NO_SPAN}" in window["folded"]
+
+    def test_samples_tag_the_active_span(self):
+        install_span_exporter(InMemorySpanExporter())
+        set_process_identity("pyprof-test-pod")
+        ready, stop = threading.Event(), threading.Event()
+
+        def busy_in_span():
+            with tracer().span("llm_d.test.busy_leg"):
+                ready.set()
+                while not stop.is_set():
+                    sum(range(64))
+
+        t = threading.Thread(
+            target=busy_in_span, name="busy-span-thread", daemon=True)
+        prof = SamplingProfiler(_cfg(window_s=3600.0))
+        try:
+            t.start()
+            assert ready.wait(5.0)
+            for _ in range(10):
+                prof.sample_once()
+            prof.rotate(force=True)
+            (window,) = prof.export_since(-1)["windows"]
+            assert window["process"] == "pyprof-test-pod"
+            assert window["spans"].get("llm_d.test.busy_leg", 0) >= 10
+            assert ("span:llm_d.test.busy_leg;thread:busy-span-thread;"
+                    in window["folded"])
+        finally:
+            stop.set()
+            t.join(5.0)
+            uninstall_span_exporter()
+            set_process_identity(None)
+
+    def test_capture_validates_and_serializes(self):
+        prof = SamplingProfiler(_cfg(hz=200.0))
+        with pytest.raises(ValueError):
+            prof.capture(0.0)
+        with pytest.raises(ValueError):
+            prof.capture(10_000.0)
+        with _busy_thread():
+            result = prof.capture(0.05)
+        assert result["samples"] > 0
+        assert "folded" in result
+        # One capture at a time: a held capture lock means 409 upstream.
+        assert prof._capture_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(CaptureInProgress):
+                prof.capture(0.05)
+        finally:
+            prof._capture_lock.release()
+
+
+# -- fleet-merge helpers ------------------------------------------------------
+
+
+class TestMergeHelpers:
+    FOLDED_A = ("span:llm_d.score;thread:w;srv.py:loop;native.py:score 30\n"
+                "span:(nospan);thread:main;run.py:main 4")
+    FOLDED_B = ("span:llm_d.score;thread:w;srv.py:loop;native.py:score 10\n"
+                "span:llm_d.score;thread:w;srv.py:loop;codec.py:decode 10")
+
+    def test_merge_folded_sums_identical_stacks(self):
+        merged = merge_folded([self.FOLDED_A, self.FOLDED_B, "", "garbage"])
+        assert merged[
+            "span:llm_d.score;thread:w;srv.py:loop;native.py:score"] == 40
+        assert merged["span:(nospan);thread:main;run.py:main"] == 4
+
+    def test_span_function_shares_ranks_leaf_frames(self):
+        shares = span_function_shares(
+            merge_folded([self.FOLDED_A, self.FOLDED_B]))
+        score = shares["llm_d.score"]
+        assert score["samples"] == 50
+        functions = list(score["functions"].items())
+        assert functions[0] == ("native.py:score", 0.8)
+        assert functions[1] == ("codec.py:decode", 0.2)
+        assert shares[NO_SPAN]["samples"] == 4
+
+
+# -- admin endpoints ----------------------------------------------------------
+
+
+class TestAdminPyprofEndpoints:
+    def test_404_until_registered_then_cursor_contract(self):
+        admin = AdminServer(port=0)
+        assert admin._handle("/debug/pyprof", {})[0] == 404
+        assert admin._handle("/debug/pyprof/capture", {})[0] == 404
+
+        prof = SamplingProfiler(_cfg(hz=200.0, window_s=3600.0))
+        prof.sample_once()
+        prof.rotate(force=True)
+        admin.register_pyprof_source(prof.export_since)
+        admin.register_pyprof_capture(prof.capture)
+
+        status, body, ctype = admin._handle("/debug/pyprof", {"since": ["-1"]})
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert len(payload["windows"]) == 1
+        assert payload["next_seq"] == 0
+        assert admin._handle(
+            "/debug/pyprof", {"since": ["0"]})[0] == 200
+
+    def test_bad_query_values_are_400(self):
+        admin = AdminServer(port=0)
+        prof = SamplingProfiler(_cfg(hz=200.0))
+        admin.register_pyprof_source(prof.export_since)
+        admin.register_pyprof_capture(prof.capture)
+        assert admin._handle("/debug/pyprof", {"since": ["xx"]})[0] == 400
+        assert admin._handle(
+            "/debug/pyprof/capture", {"seconds": ["nope"]})[0] == 400
+        assert admin._handle(
+            "/debug/pyprof/capture", {"seconds": ["0"]})[0] == 400
+
+    def test_concurrent_capture_is_409(self):
+        admin = AdminServer(port=0)
+        prof = SamplingProfiler(_cfg(hz=200.0))
+        admin.register_pyprof_capture(prof.capture)
+        assert prof._capture_lock.acquire(blocking=False)
+        try:
+            assert admin._handle(
+                "/debug/pyprof/capture", {"seconds": ["0.05"]})[0] == 409
+        finally:
+            prof._capture_lock.release()
+        status, body, _ = admin._handle(
+            "/debug/pyprof/capture", {"seconds": ["0.05"]})
+        assert status == 200
+        assert "folded" in json.loads(body)
+
+    def test_collector_provider_falls_through_generic_dispatch(self):
+        # A collector has no local sampler but registers its fleet-merged
+        # profile as the "pyprof" debug provider: the exact route must
+        # defer to the provider instead of 404ing.
+        admin = AdminServer(port=0)
+        admin.register_debug("pyprof", lambda: {"windows": 3,
+                                                "targets": ["pod-a"]})
+        status, body, _ = admin._handle("/debug/pyprof", {})
+        assert status == 200
+        assert json.loads(body)["windows"] == 3
+
+
+# -- collector profile leg ----------------------------------------------------
+
+
+def _window(seq, folded, samples):
+    return {"seq": seq, "process": "", "start_unix": 0.0, "duration_s": 1.0,
+            "hz": 67.0, "samples": samples, "threads": {}, "spans": {},
+            "truncations": 0, "overhead_frac": 0.0, "folded": folded}
+
+
+def _static_pyprof_source(windows):
+    def source(since):
+        fresh = [w for w in windows if w["seq"] > since]
+        return {"windows": fresh,
+                "next_seq": max((w["seq"] for w in windows), default=since),
+                "dropped": 0, "live_samples": 0}
+    return source
+
+
+class TestCollectorProfileLeg:
+    SPAN = "llm_d.kv_cache.score_tokens"
+
+    def _start_pod(self, folded, samples):
+        admin = AdminServer(port=0)
+        admin.register_spans_source(
+            lambda since: {"spans": [], "next_seq": since, "dropped": 0})
+        admin.register_pyprof_source(
+            _static_pyprof_source([_window(0, folded, samples)]))
+        admin.start()
+        return admin
+
+    def test_merges_windows_from_two_pods_and_joins_attribution(self):
+        pod_a = self._start_pod(
+            f"span:{self.SPAN};thread:g;srv.py:loop;native.py:score 30",
+            30)
+        pod_b = self._start_pod(
+            f"span:{self.SPAN};thread:g;srv.py:loop;native.py:score 10\n"
+            f"span:{self.SPAN};thread:g;srv.py:loop;codec.py:decode 10",
+            20)
+        col = TelemetryCollector(CollectorConfig(
+            targets=(
+                ScrapeTarget(name="pod-a",
+                             address=f"127.0.0.1:{pod_a.port}"),
+                ScrapeTarget(name="pod-b",
+                             address=f"127.0.0.1:{pod_b.port}"),
+            ),
+            scrape_interval_s=0.0, admin_port=0))
+        try:
+            col.scrape_once()
+            view = col.profile_view()
+            assert view["windows"] == 2
+            assert view["targets"] == ["pod-a", "pod-b"]
+            assert view["samples"] == 50
+            score = view["spans"][self.SPAN]
+            assert score["samples"] == 50
+            assert next(iter(score["functions"])) == "native.py:score"
+            assert score["functions"]["native.py:score"] == 0.8
+            # flamegraph.pl-ready merged folded text.
+            assert ("srv.py:loop;native.py:score 40"
+                    in view["folded"])
+
+            # Cursors advance: a second round pulls nothing new.
+            col.scrape_once()
+            assert col.profile_view()["windows"] == 2
+
+            # Retained trace joins against the merged profile: dominant
+            # segment gets its dominant on-CPU function.
+            t0 = time.time()
+            col.assembler.ingest([{
+                "name": self.SPAN,
+                "trace_id": f"{0xabc123:032x}",
+                "span_id": f"{0x1:016x}",
+                "parent_span_id": None,
+                "start_time": t0, "end_time": t0 + 3.0,
+                "status": "OK",
+                "attributes": {"process": "pod-a"}, "seq": 0,
+            }])
+            col.assembler.finalize_idle(force=True)
+            view = col.profile_view()
+            (entry,) = [a for a in view["attribution"]
+                        if a["segment"] == self.SPAN]
+            assert entry["dominant_function"] == "native.py:score"
+            assert entry["function_share"] == 0.8
+            # And the debug surface exposes it (minus the bulk text).
+            debug = col.debug_view()
+            assert debug["pyprof"]["windows"] == 2
+            assert "folded" not in debug["pyprof"]
+        finally:
+            col.stop()
+            pod_a.stop()
+            pod_b.stop()
+
+    def test_pod_without_sampler_does_not_trip_the_breaker(self):
+        # Span export on, sampler off: /debug/pyprof serves 404 but the
+        # scrape must still count as a success.
+        bare = AdminServer(port=0)
+        bare.register_spans_source(
+            lambda since: {"spans": [], "next_seq": since, "dropped": 0})
+        bare.start()
+        col = TelemetryCollector(CollectorConfig(
+            targets=(ScrapeTarget(name="pod-off",
+                                  address=f"127.0.0.1:{bare.port}"),),
+            scrape_interval_s=0.0, admin_port=0, breaker_failures=1))
+        try:
+            for _ in range(3):
+                col.scrape_once()
+            state = col._targets[0]
+            assert state.breaker.allow()  # 404 tolerated, breaker closed
+            assert state.families  # the /metrics leg still landed
+            assert col.profile_view()["windows"] == 0
+        finally:
+            col.stop()
+            bare.stop()
+
+
+# -- end-to-end: two real pods, one collector, kvdiag --fleet -----------------
+
+
+POD_SCRIPT = """\
+import sys, time
+from pathlib import Path
+
+sys.path.insert(0, {repo!r})
+from llmd_kv_cache_tpu.services.admin import AdminServer
+from llmd_kv_cache_tpu.telemetry import (
+    FleetTelemetryConfig, SamplingProfilerConfig, active_sampling_profiler,
+    enable_pyprof, enable_span_export, tracer)
+
+pod, span_name, traceparent, busy_s, port_file = sys.argv[1:6]
+ft = FleetTelemetryConfig(
+    span_export=True, process_identity=pod,
+    pyprof=SamplingProfilerConfig(enabled=True, hz=250.0, window_s=0.25))
+spans_source = enable_span_export(ft)
+prof_source, prof_capture = enable_pyprof(ft)
+admin = AdminServer(port=0)
+admin.register_spans_source(spans_source)
+admin.register_pyprof_source(prof_source)
+admin.register_pyprof_capture(prof_capture)
+admin.start()
+
+
+def {busy_name}(deadline):
+    x = 0
+    while time.monotonic() < deadline:
+        x += sum(range(32))
+    return x
+
+
+with tracer().span(span_name, parent_traceparent=traceparent):
+    {busy_name}(time.monotonic() + float(busy_s))
+
+active_sampling_profiler().rotate(force=True)
+Path(port_file).write_text(str(admin.port))
+time.sleep(120)
+"""
+
+TRACEPARENT = "00-00000000000000000000000000abc999-00000000000000aa-01"
+
+
+class TestFleetProfilingE2E:
+    """ISSUE 11 acceptance: the collector merges continuous profiles from
+    two *real* pod processes and ``kvdiag --fleet`` names a dominant
+    function under a critical-path segment."""
+
+    def _spawn_pod(self, tmp_path, pod, span, busy_s):
+        script = tmp_path / f"{pod.replace('-', '_')}_main.py"
+        script.write_text(POD_SCRIPT.format(
+            repo=str(REPO), busy_name=f"busy_{pod.replace('-', '_')}"))
+        port_file = tmp_path / f"{pod}.port"
+        proc = subprocess.Popen(
+            [sys.executable, str(script), pod, span, TRACEPARENT,
+             str(busy_s), str(port_file)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        return proc, port_file
+
+    def test_fleet_merge_and_kvdiag_attribution(self, tmp_path):
+        # Pod A burns the longer span (the dominant critical-path
+        # segment); pod B rides along so the merge is genuinely
+        # cross-process. Staggered start makes A the trace root.
+        pod_a, port_a = self._spawn_pod(
+            tmp_path, "prof-pod-a", "llm_d.e2e.score_fanout", 1.2)
+        time.sleep(0.6)
+        pod_b, port_b = self._spawn_pod(
+            tmp_path, "prof-pod-b", "llm_d.e2e.decode_step", 0.4)
+        col = None
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not (
+                    port_a.exists() and port_b.exists()):
+                for proc, name in ((pod_a, "pod-a"), (pod_b, "pod-b")):
+                    if proc.poll() is not None:
+                        pytest.fail(
+                            f"{name} died: {proc.stderr.read()}")
+                time.sleep(0.05)
+            assert port_a.exists() and port_b.exists(), "pods never came up"
+
+            col = TelemetryCollector(CollectorConfig(
+                targets=(
+                    ScrapeTarget(name="prof-pod-a",
+                                 address=f"127.0.0.1:{port_a.read_text()}"),
+                    ScrapeTarget(name="prof-pod-b",
+                                 address=f"127.0.0.1:{port_b.read_text()}"),
+                ),
+                scrape_interval_s=0.0,
+                admin_port=PROFILE_COLLECTOR_PORT,
+                trace_idle_s=0.2))
+            col.start()
+
+            view = {}
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                col.scrape_once()
+                col.assembler.finalize_idle()
+                view = col.profile_view()
+                if (set(view["targets"]) >= {"prof-pod-a", "prof-pod-b"}
+                        and any(a["dominant_function"]
+                                for a in view["attribution"])):
+                    break
+                time.sleep(0.1)
+
+            # Fleet merge really crossed processes.
+            assert set(view["targets"]) == {"prof-pod-a", "prof-pod-b"}
+            assert view["windows"] >= 2
+            spans = view["spans"]
+            assert "busy_prof_pod_a" in str(
+                spans["llm_d.e2e.score_fanout"]["functions"])
+            assert "busy_prof_pod_b" in str(
+                spans["llm_d.e2e.decode_step"]["functions"])
+
+            # The join: the retained trace's dominant critical-path
+            # segment is pod A's span, attributed to pod A's busy loop.
+            (entry,) = [a for a in view["attribution"]
+                        if a["trace_id"].endswith("abc999")]
+            assert entry["segment"] == "llm_d.e2e.score_fanout"
+            assert entry["process"] == "prof-pod-a"
+            assert "busy_prof_pod_a" in entry["dominant_function"]
+            assert entry["function_share"] > 0.5
+
+            # kvdiag --fleet surfaces the same story for operators.
+            diag = subprocess.run(
+                [sys.executable, "hack/kvdiag.py",
+                 "--port", str(PROFILE_COLLECTOR_PORT), "--fleet"],
+                cwd=str(REPO), capture_output=True, text=True, timeout=30)
+            assert diag.returncode == 0, diag.stderr
+            fleet = json.loads(diag.stdout)["fleet"]
+            assert set(fleet["profile"]["targets"]) == {
+                "prof-pod-a", "prof-pod-b"}
+            trace = next(t for t in fleet["retained_traces"]
+                         if t["trace_id"].endswith("abc999"))
+            dominant = trace["dominant_segment"]
+            assert dominant["name"] == "llm_d.e2e.score_fanout"
+            assert "busy_prof_pod_a" in dominant["dominant_function"]
+            assert dominant["function_share"] > 0.5
+
+            # The raw merged flame is one HTTP GET away.
+            raw = urllib.request.urlopen(
+                "http://127.0.0.1:%d/debug/pyprof"
+                % PROFILE_COLLECTOR_PORT, timeout=10).read()
+            assert b"busy_prof_pod_a" in raw
+        finally:
+            if col is not None:
+                col.stop()
+            for proc in (pod_a, pod_b):
+                proc.kill()
+            for proc in (pod_a, pod_b):
+                proc.wait(timeout=10)

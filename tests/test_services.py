@@ -126,6 +126,76 @@ class TestPodReconciler:
             rec.stop()
             mgr.shutdown()
 
+    def test_kubernetes_discovery_with_stubbed_core_api(self):
+        from types import SimpleNamespace
+
+        from llmd_kv_cache_tpu.events.pool import PodDiscoveryConfig
+        from llmd_kv_cache_tpu.events.reconciler import KubernetesDiscovery
+
+        def pod(name, ip, phase="Running"):
+            return SimpleNamespace(
+                metadata=SimpleNamespace(name=name),
+                status=SimpleNamespace(pod_ip=ip, phase=phase))
+
+        class StubCoreV1Api:
+            def __init__(self, pods):
+                self._pods = pods
+                self.calls = []
+
+            def list_namespaced_pod(self, namespace, label_selector):
+                self.calls.append(("namespaced", namespace, label_selector))
+                return SimpleNamespace(items=self._pods)
+
+            def list_pod_for_all_namespaces(self, label_selector):
+                self.calls.append(("all", None, label_selector))
+                return SimpleNamespace(items=self._pods)
+
+        pods = [
+            pod("pod-ready", "10.0.0.7"),
+            pod("pod-pending", "10.0.0.8", phase="Pending"),
+            pod("pod-no-ip", None),
+        ]
+
+        # Namespaced listing: only the Running pod with an IP survives,
+        # mapped to tcp://<ip>:<socket_port>.
+        api = StubCoreV1Api(pods)
+        disc = KubernetesDiscovery(
+            PodDiscoveryConfig(pod_namespace="serving", socket_port=5557),
+            core_api=api)
+        assert disc.discover() == {"pod-ready": "tcp://10.0.0.7:5557"}
+        assert api.calls == [
+            ("namespaced", "serving", "llm-d.ai/inference-serving=true")]
+
+        # Empty namespace falls back to the all-namespaces listing.
+        api = StubCoreV1Api(pods)
+        disc = KubernetesDiscovery(
+            PodDiscoveryConfig(pod_namespace="", socket_port=6000),
+            core_api=api)
+        assert disc.discover() == {"pod-ready": "tcp://10.0.0.7:6000"}
+        assert api.calls[0][0] == "all"
+
+    def test_kubernetes_discovery_drives_the_reconciler(self):
+        from types import SimpleNamespace
+
+        from llmd_kv_cache_tpu.events.pool import PodDiscoveryConfig
+        from llmd_kv_cache_tpu.events.reconciler import KubernetesDiscovery
+
+        class OnePodApi:
+            def list_pod_for_all_namespaces(self, label_selector):
+                return SimpleNamespace(items=[SimpleNamespace(
+                    metadata=SimpleNamespace(name="pod-k8s"),
+                    status=SimpleNamespace(pod_ip="10.1.2.3",
+                                           phase="Running"))])
+
+        mgr = SubscriberManager(lambda msg: None)
+        try:
+            disc = KubernetesDiscovery(PodDiscoveryConfig(), core_api=OnePodApi())
+            rec = PodReconciler(disc, mgr)
+            assert rec.reconcile_once() == (1, 0)
+            assert mgr.pods() == ["pod-k8s"]
+        finally:
+            mgr.shutdown()
+
     def test_discovery_failure_keeps_subscribers(self):
         class FailingSource:
             def discover(self):
