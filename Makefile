@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -100,13 +100,23 @@ bench-fleet: native
 bench-pyprof: native
 	$(CPU_ENV) $(PY) bench.py --pyprof-overhead
 
-# Perf-regression sentinel: run the profiling gate, then diff its value
-# and hot-function shares against the committed baseline manifest.
-# Emits machine-verdict `PERF PASS|FAIL ...` lines; fails on regression.
+# Working-set analytics gates (telemetry/workingset): the SHARDS-sampled
+# miss-ratio curve must track an exact LRU-simulation oracle within a
+# bounded error, and the per-score hook cost must stay under 1% of the
+# score p50.
+bench-workingset: native
+	$(CPU_ENV) $(PY) bench.py --workingset
+
+# Perf-regression sentinel: run the profiling + working-set gates, then
+# diff their values and hot-function shares against the committed
+# baseline manifest. Emits machine-verdict `PERF PASS|FAIL ...` lines;
+# fails on regression.
 perf-check: native
 	$(CPU_ENV) $(PY) bench.py --pyprof-overhead > /tmp/kvtpu_pyprof_bench.json
+	$(CPU_ENV) $(PY) bench.py --workingset > /tmp/kvtpu_workingset_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
-	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json
+	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
+	  --results workingset=/tmp/kvtpu_workingset_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.
 verify: lint perf-check

@@ -79,6 +79,17 @@ def main() -> None:
     parser.add_argument("--pyprof-window-s", type=float, default=10.0,
                         help="profile window length for --pyprof "
                              "(default 10s)")
+    parser.add_argument("--workingset", action="store_true",
+                        help="working-set analytics: sample block reuse "
+                             "on admission/eviction/offload and serve "
+                             "reuse windows at /debug/workingset on "
+                             "--admin-port for the collector's what-if "
+                             "capacity table")
+    parser.add_argument("--workingset-sample-rate", type=float, default=0.05,
+                        help="spatial sampling rate for --workingset "
+                             "(default 0.05)")
+    parser.add_argument("--workingset-window-s", type=float, default=10.0,
+                        help="window length for --workingset (default 10s)")
     args = parser.parse_args()
 
     cfg = LlamaConfig.tiny()
@@ -155,6 +166,23 @@ def main() -> None:
                 prof_source, prof_capture = pyprof
                 admin.register_pyprof_source(prof_source)
                 admin.register_pyprof_capture(prof_capture)
+        if args.workingset:
+            from llmd_kv_cache_tpu.telemetry import (
+                FleetTelemetryConfig,
+                WorkingSetConfig,
+                enable_workingset,
+            )
+
+            tracker = enable_workingset(
+                FleetTelemetryConfig(
+                    workingset=WorkingSetConfig(
+                        enabled=True,
+                        sample_rate=args.workingset_sample_rate,
+                        window_s=args.workingset_window_s)),
+                default_identity=args.pod_id)
+            if tracker is not None:
+                engine.attach_workingset(tracker)
+                admin.register_workingset_source(tracker.export_since)
         admin.start()
         (control / f"{args.pod_id}.admin_port").write_text(str(admin.port))
 

@@ -121,6 +121,16 @@ def main() -> None:
         help="sampling rate for --pyprof (default 67 Hz)",
     )
     parser.add_argument(
+        "--workingset", action="store_true",
+        help="working-set analytics: sample block reuse on the scoring "
+             "path and serve reuse windows at /debug/workingset on "
+             "--admin-port for the collector's what-if capacity table",
+    )
+    parser.add_argument(
+        "--workingset-sample-rate", type=float, default=0.05,
+        help="spatial sampling rate for --workingset (default 0.05)",
+    )
+    parser.add_argument(
         "--process-identity", default="",
         help="logical process name stamped on exported spans (what the "
              "collector's critical-path attribution groups by); default: "
@@ -166,7 +176,7 @@ def main() -> None:
         "adminPort": args.admin_port,
         "adminHost": args.admin_host,
     }
-    if args.span_export or args.pyprof:
+    if args.span_export or args.pyprof or args.workingset:
         indexer_cfg_dict["fleetTelemetry"] = {
             "spanExport": args.span_export,
             "maxSpans": args.span_export_max_spans,
@@ -175,6 +185,11 @@ def main() -> None:
         if args.pyprof:
             indexer_cfg_dict["fleetTelemetry"]["pyprof"] = {
                 "enabled": True, "hz": args.pyprof_hz,
+            }
+        if args.workingset:
+            indexer_cfg_dict["fleetTelemetry"]["workingset"] = {
+                "enabled": True,
+                "sampleRate": args.workingset_sample_rate,
             }
     if args.snapshot_dir:
         indexer_cfg_dict["recoveryConfig"] = {

@@ -24,16 +24,26 @@ an on-call engineer needs into a single JSON report on stdout:
                                  decode disaggregation: transfer queue
                                  depth, in-flight store jobs, and the last
                                  handoff latency
+- ``ledger`` (summary)         — indexer pods: the cache-efficiency
+                                 ledger condensed per pod (appearances,
+                                 wins, stored/evicted blocks)
+- ``workingset`` (summary)     — pods running the working-set tracker:
+                                 sampler health (rate, windows, tracked
+                                 blocks, self-measured overhead)
 - ``fleet`` (``--fleet``)      — when the target is the fleet telemetry
                                  collector: assembled-trace summaries
                                  (critical path + processes), per-role
-                                 rollup percentiles, and SLO burn-rate /
-                                 alert state
+                                 rollup percentiles, SLO burn-rate /
+                                 alert state, and the working-set what-if
+                                 capacity table (hit ratio at
+                                 0.5x/1x/2x/4x HBM, never-read offload
+                                 fraction, cross-pod duplicate share)
 
 Usage:
   python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
   python hack/kvdiag.py --port 9500 --fleet          # collector target
   python hack/kvdiag.py --targets 127.0.0.1:9400,127.0.0.1:9401
+  python hack/kvdiag.py --port 9400 --watch 5        # delta lines
 
 Multi-target scrapes (``--targets``) degrade gracefully: an unreachable
 pod contributes an ``{"error": ...}`` stanza instead of aborting the
@@ -48,12 +58,14 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 import urllib.error
 import urllib.request
 
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
-                   "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_")
+                   "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
+                   "kvtpu_workingset_", "kvtpu_cache_ledger_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -198,6 +210,34 @@ def snapshot(host: str, port: int, timeout: float = 5.0,
         }
 
     debug = report["debug"] if isinstance(report["debug"], dict) else {}
+
+    ledger = debug.get("ledger")
+    if isinstance(ledger, dict) and "pods" in ledger:
+        # Indexer pods: the cache-efficiency ledger (also exported as the
+        # kvtpu_cache_ledger_* families) — which pods earn their cache
+        # footprint, condensed to the counters scanned first.
+        hit = ledger.get("lookup_hit_blocks") or 0
+        total = ledger.get("lookup_blocks") or 0
+        report["ledger"] = {
+            "score_calls": ledger.get("score_calls"),
+            "lookup_hit_ratio": round(hit / total, 4) if total else None,
+            "pods": {
+                pod: {
+                    "appearances": st.get("appearances"),
+                    "wins": st.get("wins"),
+                    "stored_blocks": st.get("stored_blocks"),
+                    "evicted_blocks": st.get("evicted_blocks"),
+                }
+                for pod, st in (ledger.get("pods") or {}).items()
+            },
+        }
+
+    ws_state = debug.get("workingset_state")
+    if isinstance(ws_state, dict):
+        # Pods running the working-set tracker: sampler health (the
+        # reuse windows themselves live at /debug/workingset).
+        report["workingset"] = ws_state
+
     if fleet or "rollup" in debug:
         report["fleet"] = fleet_summary(debug)
 
@@ -289,6 +329,36 @@ def fleet_summary(debug: dict) -> dict:
                 "burn_rates": view.get("burn_rates"),
                 "error_budget_remaining": view.get("error_budget_remaining"),
             })
+    workingset = debug.get("workingset") or {}
+    if workingset.get("windows"):
+        # What-if capacity planning: the fleet-merged miss-ratio curve
+        # evaluated at multiples of current HBM, next to the never-read
+        # offload fraction and the cross-pod duplicate share (the numbers
+        # the SSD-admission and dedup ROADMAP items consume).
+        out["workingset"] = {
+            "windows": workingset.get("windows"),
+            "targets": workingset.get("targets"),
+            "hbm_capacity_blocks": workingset.get("hbm_capacity_blocks"),
+            "whatif": workingset.get("whatif"),
+            "whatif_table": [
+                f"{row.get('factor'):g}x HBM "
+                f"({row.get('capacity_blocks')} blocks): "
+                f"est hit ratio {row.get('est_hit_ratio'):.1%}"
+                for row in workingset.get("whatif") or []
+            ],
+            "never_read_offload_fraction":
+                (workingset.get("never_read") or {}).get("fraction"),
+            "cross_pod_duplicate_share":
+                (workingset.get("duplication") or {}).get("share"),
+            "scopes": {
+                name: {
+                    "accesses": st.get("accesses"),
+                    "measured_hit_ratio": st.get("measured_hit_ratio"),
+                }
+                for name, st in (workingset.get("scopes") or {}).items()
+            },
+        }
+
     out["alerts"] = alerts
     out["slo"] = slo
     return out
@@ -319,6 +389,71 @@ def multi_snapshot(targets: list[str], timeout: float = 5.0,
     return report
 
 
+def _watch_stats(report: dict) -> dict:
+    """Counters the watch loop turns into delta lines, from one snapshot
+    (single-target) or a multi_snapshot report."""
+    stats = {"score_calls": 0.0, "staleness_s": None, "alerts": 0,
+             "reachable": 1, "targets": 1}
+    if "targets" in report and isinstance(report["targets"], dict):
+        stats["reachable"] = report.get("reachable", 0)
+        stats["targets"] = len(report["targets"])
+        per = [t for t in report["targets"].values()
+               if isinstance(t, dict) and "error" not in t]
+    else:
+        per = [report]
+    staleness = []
+    for rep in per:
+        debug = rep.get("debug") if isinstance(rep.get("debug"), dict) else {}
+        ledger = debug.get("ledger") or {}
+        stats["score_calls"] += ledger.get("score_calls") or 0
+        lag = debug.get("lag") or {}
+        if lag.get("staleness_s") is not None:
+            staleness.append(lag["staleness_s"])
+        fleet = rep.get("fleet") or {}
+        stats["alerts"] += len(fleet.get("alerts") or [])
+    if staleness:
+        stats["staleness_s"] = max(staleness)
+    return stats
+
+
+def watch_loop(args, specs) -> int:
+    """``--watch N``: re-poll every N seconds, print one delta line per
+    round (score rate, ingest lag, firing alerts) instead of the full
+    JSON snapshot — 'is it moving?' without a dashboard."""
+    prev = None
+    try:
+        while True:
+            try:
+                if specs is not None:
+                    report = multi_snapshot(specs, args.timeout,
+                                            fleet=args.fleet)
+                else:
+                    report = snapshot(args.host, args.port, args.timeout,
+                                      fleet=args.fleet)
+            except OSError as e:
+                print(f"[{time.strftime('%H:%M:%S')}] unreachable: {e}",
+                      flush=True)
+                time.sleep(args.watch)
+                continue
+            cur = _watch_stats(report)
+            line = [time.strftime("[%H:%M:%S]")]
+            if prev is not None:
+                rate = (cur["score_calls"] - prev["score_calls"]) / args.watch
+                line.append(f"score_rate={max(rate, 0.0):.1f}/s")
+            else:
+                line.append(f"score_calls={cur['score_calls']:.0f}")
+            if cur["staleness_s"] is not None:
+                line.append(f"ingest_lag={cur['staleness_s']:.3f}s")
+            line.append(f"alerts={cur['alerts']}")
+            if cur["targets"] > 1:
+                line.append(f"reachable={cur['reachable']}/{cur['targets']}")
+            print(" ".join(line), flush=True)
+            prev = cur
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--host", default="127.0.0.1")
@@ -332,11 +467,23 @@ def main(argv=None) -> int:
                              "(retained traces, rollup percentiles, SLO "
                              "burn state) into a top-level fleet section")
     parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--watch", type=float, default=None, metavar="N",
+                        help="re-poll every N seconds and print delta "
+                             "lines (score rate, ingest lag, firing "
+                             "alerts) instead of a one-shot snapshot")
     parser.add_argument("--out", default=None,
                         help="write the JSON report here instead of stdout")
     args = parser.parse_args(argv)
     if (args.port is None) == (args.targets is None):
         parser.error("exactly one of --port / --targets is required")
+    if args.watch is not None and args.watch <= 0:
+        parser.error("--watch needs a positive interval")
+
+    if args.watch is not None:
+        specs = None
+        if args.targets is not None:
+            specs = [t.strip() for t in args.targets.split(",") if t.strip()]
+        return watch_loop(args, specs)
 
     if args.targets is not None:
         specs = [t.strip() for t in args.targets.split(",") if t.strip()]

@@ -33,15 +33,20 @@ from pathlib import Path
 SPAN_PREFIX = "llm_d.kv_cache."
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
-                   "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_")
+                   "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
+                   "kvtpu_workingset_", "kvtpu_cache_ledger_")
 # Admin-plane surfaces an operator must be able to find without reading
 # the source: each literal must appear in docs/observability.md.
-REQUIRED_ENDPOINTS = ("/debug/pyprof", "/debug/pyprof/capture")
+REQUIRED_ENDPOINTS = ("/debug/pyprof", "/debug/pyprof/capture",
+                      "/debug/workingset")
 METRIC_CLASSES = frozenset({
     "Counter", "Gauge", "Histogram", "Summary",
     # The engine-telemetry histogram primitive with config-driven buckets
     # (metrics/collector.py): both the class and its get-or-create helper.
     "BucketHistogram", "bucket_histogram",
+    # Scrape-time families yielded by custom collectors (the cache-ledger
+    # exporter in metrics/collector.py) — same namespace rules apply.
+    "CounterMetricFamily", "GaugeMetricFamily",
 })
 DOCS_PATH = Path("docs/observability.md")
 

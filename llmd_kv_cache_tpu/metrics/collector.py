@@ -726,6 +726,122 @@ def record_offload_restore(tier: str, seconds: float) -> None:
         max(seconds, 0.0))
 
 
+# --------------------------------------------------------------------------
+# Working-set analytics (kvtpu_workingset_*): the SHARDS-style reuse
+# sampler (telemetry/workingset.py). sampled/overhead are its self-measured
+# cost ledger — gated <1% of score p50 by ``bench.py --workingset``;
+# tracked_blocks shows how much of the max_tracked_blocks budget the
+# sampled working set occupies; dropped windows mean the collector's
+# /debug/workingset cursor is lagging the export ring.
+# --------------------------------------------------------------------------
+
+WORKINGSET_SAMPLED_TOTAL = Counter(
+    "kvtpu_workingset_sampled_accesses_total",
+    "Block accesses that passed the working-set spatial sampling filter",
+)
+WORKINGSET_OVERHEAD_SECONDS = Counter(
+    "kvtpu_workingset_overhead_seconds_total",
+    "Wall time spent inside working-set tracker hooks (self-measured)",
+)
+WORKINGSET_TRACKED_BLOCKS = Gauge(
+    "kvtpu_workingset_tracked_blocks",
+    "Sampled block keys currently tracked for reuse distances (all scopes)",
+)
+WORKINGSET_WINDOWS_DROPPED = Counter(
+    "kvtpu_workingset_windows_dropped_total",
+    "Sealed working-set windows evicted before any /debug/workingset pull",
+)
+
+
+# --------------------------------------------------------------------------
+# Cache-efficiency ledger export (kvtpu_cache_ledger_*): the per-pod
+# appearance/win/stored/evicted attribution the Indexer already keeps
+# (scoring.indexer.CacheEfficiencyLedger), exported as metric families via
+# a custom collector that snapshots the ledger at scrape time — zero cost
+# on the score/ingest hot paths, and the /metrics view stays consistent
+# with the /debug/vars ledger snapshot.
+# --------------------------------------------------------------------------
+
+
+class _CacheLedgerCollector:
+    """Scrape-time bridge from a CacheEfficiencyLedger to /metrics."""
+
+    def __init__(self, snapshot_fn):
+        self._snapshot = snapshot_fn
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        try:
+            snap = self._snapshot()
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            return
+        appearances = CounterMetricFamily(
+            "kvtpu_cache_ledger_appearances_total",
+            "Score results a pod appeared in (cache-efficiency ledger)",
+            labels=["pod"],
+        )
+        wins = CounterMetricFamily(
+            "kvtpu_cache_ledger_wins_total",
+            "Score results a pod won (highest score) per the ledger",
+            labels=["pod"],
+        )
+        score_total = CounterMetricFamily(
+            "kvtpu_cache_ledger_score_total",
+            "Cumulative weighted prefix score attributed to a pod",
+            labels=["pod"],
+        )
+        stored = GaugeMetricFamily(
+            "kvtpu_cache_ledger_stored_blocks",
+            "Blocks the event stream has stored minus evicted on a pod",
+            labels=["pod"],
+        )
+        evicted = CounterMetricFamily(
+            "kvtpu_cache_ledger_evicted_blocks_total",
+            "Blocks the event stream has evicted from a pod",
+            labels=["pod"],
+        )
+        for pod, st in (snap.get("pods") or {}).items():
+            appearances.add_metric([pod], st.get("appearances", 0))
+            wins.add_metric([pod], st.get("wins", 0))
+            score_total.add_metric([pod], st.get("score_total", 0.0))
+            stored.add_metric(
+                [pod],
+                st.get("stored_blocks", 0) - st.get("evicted_blocks", 0))
+            evicted.add_metric([pod], st.get("evicted_blocks", 0))
+        yield appearances
+        yield wins
+        yield score_total
+        yield stored
+        yield evicted
+
+
+_ledger_collector_lock = threading.Lock()
+_ledger_collector: Optional[_CacheLedgerCollector] = None
+
+
+def register_cache_ledger(snapshot_fn) -> None:
+    """Export a ledger's snapshot() as kvtpu_cache_ledger_* families.
+
+    Process-global and last-writer-wins (one collector instance, its
+    snapshot source swapped), matching prometheus_client's process-global
+    family semantics — re-registration across tests must not raise.
+    """
+    global _ledger_collector
+    with _ledger_collector_lock:
+        if _ledger_collector is None:
+            _ledger_collector = _CacheLedgerCollector(snapshot_fn)
+            register_now = True
+        else:
+            _ledger_collector._snapshot = snapshot_fn
+            register_now = False
+    if register_now:
+        REGISTRY.register(_ledger_collector)
+
+
 _beat_thread: Optional[threading.Thread] = None
 _beat_stop = threading.Event()
 

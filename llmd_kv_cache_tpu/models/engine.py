@@ -296,6 +296,10 @@ class BlockManager:
         # Lifetime eviction count: a plain int (one add per eviction) that
         # telemetry turns into kvtpu_engine_kv_pool_evictions_total deltas.
         self.evictions = 0
+        # Optional eviction tap: called with the victim's age (seconds
+        # since last use) — the working-set tracker's eviction-age
+        # histogram (engine.attach_workingset wires it).
+        self.on_evict: Optional[Callable[[float], None]] = None
         if spec_kind is not None:
             self.spec_kind = spec_kind
             self.spec_window = spec_window
@@ -412,6 +416,11 @@ class BlockManager:
         self.page_to_hash.pop(info.page, None)
         self.free_pages.append(info.page)
         self.evictions += 1
+        if self.on_evict is not None:
+            try:
+                self.on_evict(time.monotonic() - victim_time)
+            except Exception:  # pragma: no cover  # lint: allow-swallow
+                pass
         # Must carry the same group tag as the BlockStored that created the
         # entry, or the index's entry-match eviction is a silent no-op.
         self._emit([
@@ -1013,6 +1022,9 @@ class MiniEngine:
         # every hook site below guards on that, so the disabled step path
         # pays one attribute load + branch per site.
         self.telemetry = None
+        # Working-set analytics: None until attach_workingset wires a
+        # telemetry.workingset.WorkingSetTracker (same guard style).
+        self.workingset = None
         self._telemetry_pools: list[tuple[str, BlockManager]] = []
         tcfg = self.cfg.telemetry
         if tcfg is not None and getattr(tcfg, "enabled", True):
@@ -1036,6 +1048,21 @@ class MiniEngine:
         waiting, pulling, and falling back to local prefill.
         """
         self.handoff = coordinator
+
+    def attach_workingset(self, tracker) -> None:
+        """Wire a telemetry.workingset.WorkingSetTracker into this
+        engine's cache paths: admission feeds the "hbm" reuse stream
+        (every request's block keys, hit count = resident prefix), the
+        block manager's evictions feed the eviction-age histogram, and
+        the offload manager's lookups/stores feed the storage-tier
+        stream plus the written-never-read ledger. Also declares the
+        real HBM pool capacity so the what-if table has its 1x anchor.
+        """
+        self.workingset = tracker
+        self.block_manager.on_evict = tracker.record_eviction_age
+        tracker.set_capacity("hbm", self.block_manager.num_pages)
+        if self.offload_manager is not None:
+            self.offload_manager.workingset = tracker
 
     def add_request(self, request_id: str, prompt: Sequence[int],
                     max_new_tokens: int = 16) -> Request:
@@ -1154,6 +1181,11 @@ class MiniEngine:
         req.pages = list(cached_pages)
         req.cached_len = len(cached_pages) * page_size
         req.computed_len = req.cached_len
+        if self.workingset is not None:
+            # Admission is the HBM tier's reuse stream: one access per
+            # prompt block, hits = the resident prefix length.
+            self.workingset.record_accesses(
+                "hbm", req.block_hashes, hits=len(cached_pages))
 
         # Storage tier: extend the HBM prefix hit with blocks resident on
         # shared storage. add_request (synchronous serving) restores here —

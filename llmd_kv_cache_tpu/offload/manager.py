@@ -33,6 +33,10 @@ class SharedStorageOffloadManager:
         self.mapper = mapper
         self.event_publisher = event_publisher
         self.block_size_tokens = block_size_tokens
+        # Optional working-set tap (telemetry.workingset): lookups feed
+        # the storage-tier reuse stream, completed stores the
+        # written-never-read ledger. Wired by engine.attach_workingset.
+        self.workingset = None
         mapper.write_run_config()
 
     def lookup(self, block_hashes: Sequence[int], group_idx: int = 0) -> int:
@@ -46,6 +50,8 @@ class SharedStorageOffloadManager:
             if not file_exists(self.mapper.block_path(h, group_idx), touch_atime=True):
                 break
             hits += 1
+        if self.workingset is not None and group_idx == 0:
+            self.workingset.record_offload_read(block_hashes, hits=hits)
         return hits
 
     def prepare_store(
@@ -61,6 +67,8 @@ class SharedStorageOffloadManager:
     def complete_store(self, block_hashes: Sequence[int]) -> None:
         """Publish the storage-tier BlockStored event (tokenless; the
         indexer resolves request keys via the engine→request mapping)."""
+        if self.workingset is not None and block_hashes:
+            self.workingset.record_offload_write(block_hashes)
         if self.event_publisher is not None and block_hashes:
             self.event_publisher.publish_block_stored(
                 list(block_hashes), self.block_size_tokens

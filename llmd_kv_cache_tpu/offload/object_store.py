@@ -674,6 +674,9 @@ class ObjectStoreOffloadManager:
         self.mapper = mapper
         self.event_publisher = event_publisher
         self.block_size_tokens = block_size_tokens
+        # Optional working-set tap (telemetry.workingset), same contract
+        # as SharedStorageOffloadManager.workingset.
+        self.workingset = None
 
     def lookup(self, block_hashes: Sequence[int], group_idx: int = 0) -> int:
         hits = 0
@@ -681,6 +684,8 @@ class ObjectStoreOffloadManager:
             if not self.client.exists(self.mapper.block_key(h, group_idx)):
                 break
             hits += 1
+        if self.workingset is not None and group_idx == 0:
+            self.workingset.record_offload_read(block_hashes, hits=hits)
         return hits
 
     def prepare_store(self, block_hashes: Sequence[int], group_idx: int = 0) -> list[int]:
@@ -690,6 +695,8 @@ class ObjectStoreOffloadManager:
         ]
 
     def complete_store(self, block_hashes: Sequence[int]) -> None:
+        if self.workingset is not None and block_hashes:
+            self.workingset.record_offload_write(block_hashes)
         if self.event_publisher is not None and block_hashes:
             self.event_publisher.publish_block_stored(
                 list(block_hashes), self.block_size_tokens

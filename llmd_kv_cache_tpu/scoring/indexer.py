@@ -260,6 +260,9 @@ class Indexer:
         # disaggregation): None until attach_residency wires a
         # scoring.residency.ResidencyTracker.
         self.residency = None
+        # Working-set analytics: None until attach_workingset wires a
+        # telemetry.workingset.WorkingSetTracker into the lookup path.
+        self.workingset = None
 
     def prefix_cache_stats(self) -> Optional[dict]:
         """Token-processor prefix-cache counters (None when disabled)."""
@@ -301,6 +304,15 @@ class Indexer:
             from ..core.keys import TIER_SHARED_STORAGE
 
             tracker.tier_discount_fn = lambda: fn(TIER_SHARED_STORAGE)
+
+    def attach_workingset(self, tracker) -> None:
+        """Wire a telemetry.workingset.WorkingSetTracker into the score
+        path: every lookup's block keys feed the global "index" reuse
+        stream (the fleet MRC), and — on the Python scoring path, where
+        the per-key pod map exists — the cross-pod duplication estimator.
+        Unsampled keys cost one dict hit each; the whole hook is gated
+        <1% of score p50 by ``bench.py --workingset``."""
+        self.workingset = tracker
 
     def attach_liveness(self, liveness) -> None:
         """Wire the event pool's PodLivenessTracker into scoring: pods whose
@@ -404,6 +416,12 @@ class Indexer:
                 self._record_score_decision(
                     model_name, len(block_keys), hit_count, scores
                 )
+                if self.workingset is not None:
+                    # The fused C++ path returns no per-key pod map; the
+                    # reuse stream still gets every key (dup estimation
+                    # just rides the Python path only).
+                    self.workingset.record_index_lookup(
+                        block_keys, None, hits=hit_count)
                 return scores
 
             if self._early_exit:
@@ -423,6 +441,9 @@ class Indexer:
             self._record_score_decision(
                 model_name, len(block_keys), len(key_to_pods), scores
             )
+            if self.workingset is not None:
+                self.workingset.record_index_lookup(
+                    block_keys, key_to_pods, hits=len(key_to_pods))
             return scores
 
     def _apply_residency(

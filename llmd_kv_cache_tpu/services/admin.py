@@ -20,6 +20,10 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
   always-on sampling profiler, same cursor semantics as
   ``/debug/spans`` (404 until :meth:`AdminServer.register_pyprof_source`
   is called). The collector merges these fleet-wide.
+- ``/debug/workingset?since=SEQ`` — sealed working-set/reuse windows
+  from the process's tracker (telemetry/workingset.py), same cursor
+  semantics (404 until :meth:`AdminServer.register_workingset_source`
+  registers a source).
 - ``/debug/pyprof/capture?seconds=N`` — on-demand burst capture on the
   sampling profiler, next to the jax ``/debug/profile`` endpoint (one at
   a time → 409; 404 until :meth:`AdminServer.register_pyprof_capture`).
@@ -74,6 +78,7 @@ class AdminServer:
         self._spans_source: Optional[Callable[[int], dict]] = None
         self._pyprof_source: Optional[Callable[[int], dict]] = None
         self._pyprof_capture: Optional[Callable[[float], dict]] = None
+        self._workingset_source: Optional[Callable[[int], dict]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -103,6 +108,15 @@ class AdminServer:
         windows + cursor + drops). 404 until set — continuous profiling
         is opt-in per pod (``fleetTelemetry.pyprof``)."""
         self._pyprof_source = source
+
+    def register_workingset_source(
+            self, source: Callable[[int], dict]) -> None:
+        """Enable ``/debug/workingset``: ``source(since_seq)`` returns the
+        working-set tracker's sealed reuse windows with the same cursor
+        semantics as ``/debug/spans`` / ``/debug/pyprof``. 404 until
+        registered — workingset is opt-in per pod
+        (``fleetTelemetry.workingset``)."""
+        self._workingset_source = source
 
     def register_pyprof_capture(self, capture: Callable[[float], dict]) -> None:
         """Enable ``/debug/pyprof/capture``: ``capture(seconds)`` runs a
@@ -166,6 +180,24 @@ class AdminServer:
                 {"error": f"bad since: {raw!r}"}).encode(), "application/json")
         try:
             payload = self._pyprof_source(since)
+        except Exception as exc:
+            return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(payload, default=repr).encode(),
+                "application/json")
+
+    def _handle_workingset(
+            self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._workingset_source is None:
+            return (404, b'{"error": "workingset tracking not configured"}',
+                    "application/json")
+        raw = query.get("since", ["-1"])[-1]
+        try:
+            since = int(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad since: {raw!r}"}).encode(), "application/json")
+        try:
+            payload = self._workingset_source(since)
         except Exception as exc:
             return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
         return (200, json.dumps(payload, default=repr).encode(),
@@ -267,6 +299,12 @@ class AdminServer:
                 return self._handle_pyprof(query or {})
             if path == "/debug/pyprof/capture":
                 return self._handle_pyprof_capture(query or {})
+            # Same provider fall-through as pyprof: the collector exposes
+            # its *merged* fleet view as a "workingset" debug provider.
+            if path == "/debug/workingset" and (
+                    self._workingset_source is not None
+                    or "workingset" not in self._providers):
+                return self._handle_workingset(query or {})
             if path == "/debug/flight-recorder":
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"

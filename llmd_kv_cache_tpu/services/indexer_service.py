@@ -367,6 +367,14 @@ class IndexerService:
             "lag": self.pool.lag_stats,
             "ledger": self.indexer.ledger.snapshot,
         }
+        # Ledger counters double as kvtpu_cache_ledger_* families on
+        # /metrics (scrape-time snapshot — nothing added to hot paths).
+        try:
+            from ..metrics.collector import register_cache_ledger
+
+            register_cache_ledger(self.indexer.ledger.snapshot)
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
         if self.shard_index is not None:
             providers["shard"] = self.shard_index.debug_view
         health = None
@@ -403,6 +411,19 @@ class IndexerService:
                 for server in self._observability_servers:
                     server.register_pyprof_source(prof_source)
                     server.register_pyprof_capture(prof_capture)
+            # Working-set analytics: the tracker taps the score path and
+            # exports reuse windows at /debug/workingset (same cursor
+            # contract) for the collector's what-if capacity table.
+            from ..telemetry.fleet import enable_workingset
+
+            tracker = enable_workingset(
+                ft, default_identity=self.process_name)
+            if tracker is not None:
+                self.indexer.attach_workingset(tracker)
+                for server in self._observability_servers:
+                    server.register_workingset_source(tracker.export_since)
+                    server.register_debug("workingset_state",
+                                          tracker.debug_view)
 
     def stop(self) -> None:
         for server in self._observability_servers:

@@ -53,6 +53,7 @@ from ..telemetry.rollup import (
 )
 from ..telemetry.sampling_profiler import merge_folded, span_function_shares
 from ..telemetry.slo import SLOConfig, SLORegistry
+from ..telemetry.workingset import merge_workingset_windows, whatif_table
 from ..telemetry.tracing import RecordedSpan, tracer
 from ..utils.logging import get_logger
 from .admin import AdminServer
@@ -84,6 +85,14 @@ FLEET_TARGETS_REACHABLE = Gauge(
 FLEET_PROFILE_WINDOWS = Counter(
     "kvtpu_fleet_profile_windows_total",
     "Sampling-profiler windows pulled from pod /debug/pyprof endpoints",
+)
+FLEET_WORKINGSET_WINDOWS = Counter(
+    "kvtpu_fleet_workingset_windows_total",
+    "Working-set windows pulled from pod /debug/workingset endpoints",
+)
+FLEET_TYPE_CONFLICTS = Counter(
+    "kvtpu_fleet_metric_type_conflicts_total",
+    "Metric families skipped by the rollup because pods disagreed on TYPE",
 )
 
 # Fleet-level serving histograms worth rolling up, per role.
@@ -139,6 +148,13 @@ class CollectorConfig:
     # fleet-wide for merging.
     pyprof_enabled: bool = True
     pyprof_max_windows: int = 120
+    # Working-set analytics leg: pull /debug/workingset windows (404 from
+    # a pod without the tracker is tolerated, same as pyprof) and keep
+    # the newest workingset_max_windows fleet-wide; the what-if capacity
+    # table evaluates the merged MRC at these multiples of current HBM.
+    workingset_enabled: bool = True
+    workingset_max_windows: int = 240
+    whatif_factors: Tuple[float, ...] = (0.5, 1.0, 2.0, 4.0)
     fast_windows: Tuple[float, float] = (300.0, 3600.0)
     slow_window: float = 21600.0
     fast_threshold: float = 14.4
@@ -202,6 +218,15 @@ class CollectorConfig:
             pyprof_max_windows=int(
                 k("pyprofMaxWindows", "pyprof_max_windows",
                   d.pyprof_max_windows)),
+            workingset_enabled=bool(
+                k("workingsetEnabled", "workingset_enabled",
+                  d.workingset_enabled)),
+            workingset_max_windows=int(
+                k("workingsetMaxWindows", "workingset_max_windows",
+                  d.workingset_max_windows)),
+            whatif_factors=tuple(
+                float(f) for f in
+                k("whatifFactors", "whatif_factors", d.whatif_factors)),
             fast_windows=(float(fast[0]), float(fast[1])),
             slow_window=float(k("slowWindow", "slow_window", d.slow_window)),
             fast_threshold=float(
@@ -491,6 +516,7 @@ class _TargetState:
     breaker: CircuitBreaker
     span_cursor: int = -1
     pyprof_cursor: int = -1
+    workingset_cursor: int = -1
     reachable: bool = False
     families: Dict[str, MetricFamily] = field(default_factory=dict)
     last_hist_counts: Dict[str, Tuple[float, float]] = field(default_factory=dict)
@@ -560,6 +586,11 @@ class TelemetryCollector:
         self._profile_lock = threading.Lock()
         self._profile_windows: deque = deque(
             maxlen=max(1, config.pyprof_max_windows))
+        self._workingset_windows: deque = deque(
+            maxlen=max(1, config.workingset_max_windows))
+        # TYPE-conflicted families already warned about (warn + count
+        # once per family name, not per rollup read).
+        self._warned_type_conflicts: set = set()
         self._tracer = tracer()
         self._admin: Optional[AdminServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -625,6 +656,26 @@ class TelemetryCollector:
                     prof.get("next_seq", state.pyprof_cursor))
             except Exception as exc:
                 logger.debug("pyprof pull from %s skipped: %s", name, exc)
+        # Working-set leg: same enrichment contract as pyprof — a 404
+        # from a pod without the tracker never trips the breaker.
+        if self.cfg.workingset_enabled:
+            try:
+                ws_raw = self._fetch(
+                    f"{base}/debug/workingset?since={state.workingset_cursor}")
+                ws = json.loads(ws_raw)
+                windows = ws.get("windows", [])
+                with self._profile_lock:
+                    for window in windows:
+                        window = dict(window)
+                        window.setdefault("process", "")
+                        window["target"] = name
+                        self._workingset_windows.append(window)
+                if windows:
+                    FLEET_WORKINGSET_WINDOWS.inc(len(windows))
+                state.workingset_cursor = int(
+                    ws.get("next_seq", state.workingset_cursor))
+            except Exception as exc:
+                logger.debug("workingset pull from %s skipped: %s", name, exc)
         return True
 
     # -- SLI extraction ----------------------------------------------------
@@ -728,13 +779,23 @@ class TelemetryCollector:
             if state.target.role:
                 by_role.setdefault(state.target.role, []).append(state.families)
         out: dict = {}
+        conflicts: List[str] = []
         for role, expositions in by_role.items():
-            merged = merge_families(expositions)
+            merged = merge_families(expositions, conflicts=conflicts)
             out[role] = {
                 fam: rollup_percentiles(merged, fam)
                 for fam in _ROLLUP_FAMILIES
                 if rollup_percentiles(merged, fam)
             }
+        for name in conflicts:
+            if name not in self._warned_type_conflicts:
+                self._warned_type_conflicts.add(name)
+                FLEET_TYPE_CONFLICTS.inc()
+                logger.warning(
+                    "metric family %s skipped: pods disagree on its TYPE "
+                    "line (version skew?)", name)
+        if conflicts:
+            out["type_conflicts"] = sorted(set(conflicts))
         out["targets"] = {
             s.target.name: {
                 "address": s.target.address,
@@ -791,6 +852,32 @@ class TelemetryCollector:
                 for stack, count in sorted(merged.items())),
         }
 
+    def workingset_view(self) -> dict:
+        """Fleet-merged working-set analytics + the what-if table.
+
+        Merges every pulled ``/debug/workingset`` window sample-weighted
+        (``telemetry.workingset.merge_workingset_windows``) and evaluates
+        the fleet MRC at ``whatif_factors`` multiples of the summed HBM
+        capacity — the numbers ``kvdiag --fleet`` prints: "hit ratio at
+        0.5x/1x/2x/4x current HBM", the never-read offload fraction, and
+        the cross-pod duplicate share.
+        """
+        with self._profile_lock:
+            windows = list(self._workingset_windows)
+        merged = merge_workingset_windows(windows)
+        merged["windows"] = len(windows)
+        merged["targets"] = sorted(
+            {w.get("target", "") for w in windows} - {""})
+        merged["whatif"] = whatif_table(
+            merged, factors=self.cfg.whatif_factors)
+        # Measured (not modeled) hit ratios per scope, for sanity checks
+        # against the MRC estimate at 1.0x.
+        for st in merged["scopes"].values():
+            st["measured_hit_ratio"] = (
+                round(st["hits"] / st["accesses"], 4)
+                if st.get("accesses") else 0.0)
+        return merged
+
     def debug_view(self) -> dict:
         pyprof = self.profile_view()
         pyprof.pop("folded", None)  # bulk text lives at /debug/pyprof
@@ -800,6 +887,7 @@ class TelemetryCollector:
             "slo": self.slos.debug_view(),
             "rollup": self.rollup_view(),
             "pyprof": pyprof,
+            "workingset": self.workingset_view(),
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -816,6 +904,7 @@ class TelemetryCollector:
             self._admin.register_debug("rollup", self.rollup_view)
             self._admin.register_debug("fleet", self.debug_view)
             self._admin.register_debug("pyprof", self.profile_view)
+            self._admin.register_debug("workingset", self.workingset_view)
             self._admin.start()
         if self._thread is None and self.cfg.scrape_interval_s > 0:
             self._stop.clear()

@@ -25,6 +25,12 @@ from .tracing import (
     install_span_exporter,
     set_process_identity,
 )
+from .workingset import (
+    WorkingSetConfig,
+    WorkingSetTracker,
+    active_workingset_tracker,
+    install_workingset_tracker,
+)
 
 
 @dataclass(frozen=True)
@@ -48,6 +54,10 @@ class FleetTelemetryConfig:
     # own cost is gated <1% of score p50 by ``bench.py --pyprof-overhead``.
     pyprof: SamplingProfilerConfig = field(
         default_factory=SamplingProfilerConfig)
+    # Working-set analytics (``workingset`` sub-block): the SHARDS-style
+    # reuse sampler exported at /debug/workingset. Off by default; its
+    # cost is gated <1% of score p50 by ``bench.py --workingset``.
+    workingset: WorkingSetConfig = field(default_factory=WorkingSetConfig)
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> Optional["FleetTelemetryConfig"]:
@@ -72,6 +82,8 @@ class FleetTelemetryConfig:
                   d.collector_address)),
             pyprof=SamplingProfilerConfig.from_dict(
                 k("pyprof", "pyprof", None)),
+            workingset=WorkingSetConfig.from_dict(
+                k("workingset", "workingset", None)),
         )
 
 
@@ -136,3 +148,26 @@ def enable_pyprof(
         return _p.capture(seconds)
 
     return source, capture
+
+
+def enable_workingset(
+    config: FleetTelemetryConfig,
+    default_identity: str = "",
+) -> Optional[WorkingSetTracker]:
+    """Install (or reuse) the working-set tracker per ``config.workingset``.
+
+    Returns the tracker — callers attach it to their hot paths
+    (``Indexer.attach_workingset``, ``MiniEngine.attach_workingset``) and
+    hand ``tracker.export_since`` to
+    ``AdminServer.register_workingset_source``. None when disabled. Like
+    the span exporter, a tracker already installed in this process is
+    reused so co-resident services share one sampled reuse stream.
+    """
+    if not config.workingset.enabled:
+        return None
+    set_process_identity(config.process_identity or default_identity or None)
+    tracker = active_workingset_tracker()
+    if tracker is None:
+        tracker = install_workingset_tracker(
+            WorkingSetTracker(config.workingset))
+    return tracker

@@ -118,6 +118,7 @@ def parse_exposition(text: str) -> Dict[str, MetricFamily]:
 
 def merge_families(
     expositions: Iterable[Dict[str, MetricFamily]],
+    conflicts: Optional[List[str]] = None,
 ) -> Dict[str, dict]:
     """Type-correct merge of several pods' parsed expositions.
 
@@ -126,14 +127,32 @@ def merge_families(
     gauge sample is ``{"sum": s, "max": m, "avg": a, "pods": n}``. Histogram
     families come back as ``{"buckets": {le: cum}, "sum": s, "count": n}``
     per labelset so :func:`histogram_percentile` can read them directly.
+
+    Pods that disagree on a family's TYPE line (a counter on one pod, a
+    gauge on another — version skew, or a name collision) cannot be
+    merged meaningfully: summing a gauge into a counter silently corrupts
+    the fleet number. Such a family is dropped from the result with
+    ``{"type": "conflict", "samples": {}}`` and its name appended to
+    ``conflicts`` (when given) so callers can count/warn. An ``untyped``
+    exposition never conflicts — it upgrades to the first typed peer.
     """
     merged: Dict[str, dict] = {}
     gauge_acc: Dict[Tuple[str, Tuple], List[float]] = {}
     for families in expositions:
         for name, fam in families.items():
             out = merged.setdefault(name, {"type": fam.type, "samples": {}})
+            if out["type"] == "conflict":
+                continue
             if out["type"] == "untyped" and fam.type != "untyped":
                 out["type"] = fam.type
+            elif fam.type not in ("untyped", out["type"]):
+                out["type"] = "conflict"
+                out["samples"] = {}
+                for key in [k for k in gauge_acc if k[0] == name]:
+                    del gauge_acc[key]
+                if conflicts is not None:
+                    conflicts.append(name)
+                continue
             if fam.type == "histogram":
                 for (suffix, labels), value in fam.samples.items():
                     if suffix == "_bucket":

@@ -299,14 +299,26 @@ class TestPerfSentinel:
         assert "PERF OVERALL FAIL checks=2 failed=1" in regressed.stdout
 
     def test_committed_manifest_matches_a_live_overhead_result(self):
-        # The committed baseline must gate the bench the Makefile feeds
-        # it, with headroom wide enough that a nominal run passes.
+        # The committed baseline must gate every bench the Makefile
+        # feeds it (perf-check runs both telemetry overhead benches),
+        # with headroom wide enough that a nominal run passes — and a
+        # bench missing from the results must fail, so perf-check can
+        # never silently skip one.
         with open("/root/repo/benchmarking/perf_baseline.json") as f:
             manifest = json.load(f)
         assert "pyprof-overhead" in manifest["benches"]
+        assert "workingset" in manifest["benches"]
         sentinel = self._sentinel()
-        nominal = {"metric": "pyprof_overhead_pct", "value": 0.08,
-                   "unit": "%", "vs_baseline": 1.0, "hot_functions": {}}
-        _, failed = sentinel.evaluate(
-            manifest, {"pyprof-overhead": nominal})
+        nominal = {
+            "pyprof-overhead": {
+                "metric": "pyprof_overhead_pct", "value": 0.08,
+                "unit": "%", "vs_baseline": 1.0, "hot_functions": {}},
+            "workingset": {
+                "metric": "workingset_overhead_pct", "value": 0.4,
+                "unit": "% of score p50", "vs_baseline": 1.0},
+        }
+        _, failed = sentinel.evaluate(manifest, nominal)
         assert failed == 0
+        _, failed = sentinel.evaluate(
+            manifest, {"pyprof-overhead": nominal["pyprof-overhead"]})
+        assert failed == 1  # workingset bench result went missing

@@ -1,0 +1,490 @@
+"""Working-set analytics plane (ISSUE 12): SHARDS spatial sampling,
+reuse-distance/MRC estimation, the written-never-read and duplication
+ledgers, window cursors, the ``/debug/workingset`` admin contract, the
+collector's sample-weighted fleet merge, and the TYPE-conflict rollup
+hardening that rides along.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from llmd_kv_cache_tpu.services.admin import AdminServer
+from llmd_kv_cache_tpu.services.telemetry_collector import (
+    CollectorConfig,
+    ScrapeTarget,
+    TelemetryCollector,
+)
+from llmd_kv_cache_tpu.telemetry.rollup import merge_families, parse_exposition
+from llmd_kv_cache_tpu.telemetry.workingset import (
+    SCOPE_HBM,
+    WorkingSetConfig,
+    WorkingSetTracker,
+    _ScopeState,
+    estimate_hit_ratio,
+    key64,
+    merge_workingset_windows,
+    whatif_table,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _cfg(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("window_s", 3600.0)
+    return WorkingSetConfig(**kw)
+
+
+# -- spatial sampling ---------------------------------------------------------
+
+
+class TestSpatialSampling:
+    KEYS = ["block-abc", "pfx:0001", 12345, 0, 2**63 + 17]
+
+    def test_key64_deterministic_across_processes(self):
+        # The whole point of hash-based spatial sampling: every process
+        # makes the identical per-key decision, with no PYTHONHASHSEED
+        # dependence — otherwise cross-pod duplication estimates and
+        # fleet merges would compare disjoint samples.
+        script = (
+            "from llmd_kv_cache_tpu.telemetry.workingset import key64\n"
+            f"print([key64(k) for k in {self.KEYS!r}])\n"
+        )
+        outs = []
+        for seed in ("1", "2"):
+            env = dict(os.environ, PYTHONHASHSEED=seed,
+                       PYTHONPATH=str(REPO))
+            out = subprocess.run(
+                [sys.executable, "-c", script], env=env, text=True,
+                capture_output=True, check=True).stdout.strip()
+            outs.append(out)
+        assert outs[0] == outs[1]
+        assert outs[0] == str([key64(k) for k in self.KEYS])
+
+    def test_sample_rate_selects_about_that_fraction_of_keys(self):
+        rate = 0.25
+        threshold = int(rate * (1 << 64))
+        hits = sum(1 for i in range(4000) if key64(i) < threshold)
+        assert 0.2 < hits / 4000 < 0.3
+
+    def test_config_from_dict_camelcase_and_defaults(self):
+        cfg = WorkingSetConfig.from_dict({
+            "enabled": True, "sampleRate": 0.1, "windowS": 5,
+            "maxWindows": 7, "maxTrackedBlocks": 99,
+        })
+        assert (cfg.enabled, cfg.sample_rate, cfg.window_s,
+                cfg.max_windows, cfg.max_tracked_blocks) == (
+                    True, 0.1, 5.0, 7, 99)
+        d = WorkingSetConfig.from_dict(None)
+        assert not d.enabled and d.sample_rate == 0.05
+
+
+# -- stack distances / MRC ----------------------------------------------------
+
+
+class TestDistances:
+    def test_touch_matches_bruteforce_stack_distance(self):
+        # _ScopeState's Fenwick-over-timestamps distance must equal the
+        # textbook most-recent-first stack simulation, including across
+        # the in-place renumbering (forced via a tiny tree).
+        import random
+
+        rng = random.Random(7)
+        st = _ScopeState(cap=64)  # tree_size 512 -> several renumbers
+        stack = []
+        for _ in range(3000):
+            k = rng.randrange(48)
+            got = st.touch(k)
+            if k in stack:
+                idx = stack.index(k)
+                assert got == idx, f"key {k}: got {got}, stack says {idx}"
+                stack.remove(k)
+            else:
+                assert got is None
+            stack.insert(0, k)
+
+    def test_mrc_monotone_and_tracks_exact_ratio_at_rate_one(self):
+        import random
+
+        rng = random.Random(3)
+        trace = [rng.randrange(256) for _ in range(8000)]
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        tracker.record_accesses("hbm", trace)
+        tracker.rotate(force=True)
+        st = tracker.export_since(-1)["windows"][-1]["scopes"]["hbm"]
+        caps = [4, 16, 64, 256, 1024]
+        curve = [estimate_hit_ratio(st["hist"], st["cold"], c) for c in caps]
+        assert all(0.0 <= r <= 1.0 for r in curve)
+        assert curve == sorted(curve)  # monotone in capacity
+        # At a capacity >= the whole universe every non-cold access hits.
+        exact_top = (len(trace) - st["cold"]) / len(trace)
+        assert abs(curve[-1] - exact_top) < 1e-9
+
+    def test_cold_scan_traffic_depresses_the_curve_everywhere(self):
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        hot = [i % 8 for i in range(800)]
+        tracker.record_accesses("hbm", hot)
+        tracker.rotate(force=True)
+        st = tracker.export_since(-1)["windows"][-1]["scopes"]["hbm"]
+        warm_ratio = estimate_hit_ratio(st["hist"], st["cold"], 1024)
+
+        scan = list(range(1000, 1800))  # one-touch keys: always cold
+        tracker.record_accesses("hbm", hot + scan)
+        tracker.rotate(force=True)
+        st2 = tracker.export_since(-1)["windows"][-1]["scopes"]["hbm"]
+        assert st2["cold"] == len(scan)  # hot keys stayed resident
+        assert estimate_hit_ratio(
+            st2["hist"], st2["cold"], 1024) < warm_ratio
+
+    def test_tracked_keys_bounded_by_max_tracked_blocks(self):
+        tracker = WorkingSetTracker(
+            _cfg(sample_rate=1.0, max_tracked_blocks=32))
+        tracker.record_accesses("hbm", list(range(10_000)))
+        view = tracker.debug_view()
+        assert view["scopes"]["hbm"]["tracked"] <= 32
+
+
+# -- side ledgers -------------------------------------------------------------
+
+
+class TestLedgers:
+    def test_written_never_read_accounting(self):
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        tracker.record_offload_write(["a", "b", "c", "d"])
+        # Restore looked up a+b; only the hit prefix (a) was read back.
+        tracker.record_offload_read(["a", "b"], hits=1)
+        tracker.rotate(force=True)
+        nr = tracker.export_since(-1)["windows"][-1]["never_read"]
+        assert nr == {"written": 4, "read": 1, "fraction": 0.75}
+        # Re-writing an already-read key must not reset its read bit.
+        tracker.record_offload_write(["a"])
+        tracker.rotate(force=True)
+        nr = tracker.export_since(-1)["windows"][-1]["never_read"]
+        assert nr["read"] == 1
+
+    def test_eviction_age_histogram(self):
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        for age in (0.01, 0.5, 0.5, 40.0):
+            tracker.record_eviction_age(age)
+        tracker.rotate(force=True)
+        hist = tracker.export_since(-1)["windows"][-1]["eviction_age"]
+        assert sum(hist.values()) == 4
+        # Bucket upper bounds bracket the recorded ages.
+        assert all(float(b) > 0 for b in hist)
+
+    def test_duplication_estimator_counts_multi_pod_keys(self):
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        tracker.record_index_lookup(
+            ["k1", "k2", "k3", "k4"],
+            {"k1": ["pod-a", "pod-b"], "k2": ["pod-a"],
+             "k3": ["pod-a", "pod-b", "pod-c"], "k4": ["pod-b"]},
+            hits=4)
+        tracker.rotate(force=True)
+        dup = tracker.export_since(-1)["windows"][-1]["duplication"]
+        assert dup == {"tracked": 4, "multi_pod": 2, "share": 0.5}
+
+
+# -- windows / cursors --------------------------------------------------------
+
+
+class TestWindows:
+    def test_cursor_contract_and_ring_eviction(self):
+        now = [100.0]
+        tracker = WorkingSetTracker(
+            WorkingSetConfig(enabled=True, window_s=1.0, max_windows=2),
+            clock=lambda: now[0])
+        tracker.rotate()  # not due yet
+        assert tracker.export_since(-1)["windows"] == []
+        for _ in range(3):
+            now[0] += 1.0
+            tracker.record_accesses("hbm", [1, 2, 3])
+            tracker.rotate()
+        out = tracker.export_since(-1)
+        # Three sealed, ring keeps two, oldest dropped and counted.
+        assert [w["seq"] for w in out["windows"]] == [1, 2]
+        assert out["dropped"] == 1
+        assert out["next_seq"] == 2
+        assert tracker.export_since(out["next_seq"])["windows"] == []
+        assert tracker.export_since(1)["windows"][0]["seq"] == 2
+
+    def test_reuse_state_survives_window_boundaries(self):
+        # Reuse has no window boundary: a key touched in window N and
+        # again in window N+1 is a *reuse* in N+1, not a cold touch.
+        now = [0.0]
+        tracker = WorkingSetTracker(
+            WorkingSetConfig(enabled=True, window_s=1.0, max_windows=8,
+                             sample_rate=1.0),
+            clock=lambda: now[0])
+        tracker.record_accesses("hbm", ["x", "y"])
+        now[0] += 1.5
+        tracker.rotate()
+        tracker.record_accesses("hbm", ["x"])
+        now[0] += 1.5
+        tracker.rotate()
+        w0, w1 = tracker.export_since(-1)["windows"]
+        assert w0["scopes"]["hbm"]["cold"] == 2
+        assert w1["scopes"]["hbm"]["cold"] == 0
+        assert sum(w1["scopes"]["hbm"]["hist"].values()) == 1
+
+    def test_window_reports_capacity_and_overhead(self):
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        tracker.set_capacity("hbm", 64)
+        tracker.record_accesses("hbm", list(range(100)), hits=40)
+        tracker.rotate(force=True)
+        w = tracker.export_since(-1)["windows"][-1]
+        st = w["scopes"]["hbm"]
+        assert st["capacity_blocks"] == 64
+        assert st["accesses"] == 100 and st["hits"] == 40
+        assert w["overhead_s"] >= 0.0 and w["overhead_frac"] >= 0.0
+
+
+# -- admin endpoint -----------------------------------------------------------
+
+
+class TestAdminWorkingsetEndpoint:
+    def test_404_until_registered_then_cursor_contract(self):
+        admin = AdminServer(port=0)
+        assert admin._handle("/debug/workingset", {})[0] == 404
+
+        tracker = WorkingSetTracker(_cfg(sample_rate=1.0))
+        tracker.record_accesses("hbm", [1, 2, 1])
+        tracker.rotate(force=True)
+        admin.register_workingset_source(tracker.export_since)
+        status, body, ctype = admin._handle(
+            "/debug/workingset", {"since": ["-1"]})
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert len(payload["windows"]) == 1
+        assert payload["next_seq"] == 0
+        assert payload["sample_rate"] == 1.0
+
+    def test_bad_since_is_400(self):
+        admin = AdminServer(port=0)
+        tracker = WorkingSetTracker(_cfg())
+        admin.register_workingset_source(tracker.export_since)
+        assert admin._handle(
+            "/debug/workingset", {"since": ["xx"]})[0] == 400
+
+    def test_collector_provider_falls_through_generic_dispatch(self):
+        # The collector has no local tracker but registers its fleet-
+        # merged view as the "workingset" debug provider: the exact
+        # route must defer to the provider instead of 404ing.
+        admin = AdminServer(port=0)
+        admin.register_debug(
+            "workingset", lambda: {"windows": 5, "whatif": []})
+        status, body, _ = admin._handle("/debug/workingset", {})
+        assert status == 200
+        assert json.loads(body)["windows"] == 5
+
+
+# -- fleet merge --------------------------------------------------------------
+
+
+def _ws_window(seq, rate, scopes, process="", never=None, dup=None):
+    return {
+        "seq": seq, "process": process, "start_unix": 0.0,
+        "duration_s": 1.0, "sample_rate": rate, "scopes": scopes,
+        "never_read": never or {"written": 0, "read": 0, "fraction": 0.0},
+        "duplication": dup or {"tracked": 0, "multi_pod": 0, "share": 0.0},
+        "eviction_age": {}, "overhead_s": 0.0, "overhead_frac": 0.0,
+    }
+
+
+def _hbm(accesses, sampled, cold, hits, hist, capacity=0):
+    return {"hbm": {"accesses": accesses, "sampled": sampled, "cold": cold,
+                    "hits": hits, "capacity_blocks": capacity,
+                    "tracked": sampled, "hist": hist}}
+
+
+class TestFleetMerge:
+    def test_merge_weights_by_inverse_sample_rate(self):
+        # Pod A samples at 0.5, pod B at 0.1: identical underlying
+        # traffic must merge to identical estimated contributions.
+        wa = _ws_window(0, 0.5, _hbm(100, 50, 10, 60, {"8": 40}, 64),
+                        process="pod-a")
+        wb = _ws_window(0, 0.1, _hbm(100, 10, 2, 50, {"128": 8}, 64),
+                        process="pod-b")
+        merged = merge_workingset_windows([wa, wb])
+        st = merged["scopes"]["hbm"]
+        assert st["hist"] == {"8": 80.0, "128": 80.0}
+        assert st["cold"] == 40.0 and st["sampled"] == 200.0
+        assert st["accesses"] == 200 and st["hits"] == 110
+        assert merged["hbm_capacity_blocks"] == 128
+        assert merged["processes"] == ["pod-a", "pod-b"]
+
+        rows = whatif_table(merged, factors=(0.5, 1.0, 2.0, 4.0))
+        by_factor = {r["factor"]: r for r in rows}
+        assert by_factor[0.5]["capacity_blocks"] == 64
+        assert by_factor[0.5]["est_hit_ratio"] == 0.4  # only the "8" mass
+        assert by_factor[1.0]["est_hit_ratio"] == 0.8  # both buckets fit
+
+    def test_never_read_and_duplication_merge_weighted(self):
+        wa = _ws_window(0, 0.5, _hbm(0, 0, 0, 0, {}),
+                        never={"written": 10, "read": 5, "fraction": 0.5},
+                        dup={"tracked": 10, "multi_pod": 5, "share": 0.5})
+        wb = _ws_window(0, 0.1, _hbm(0, 0, 0, 0, {}),
+                        never={"written": 4, "read": 0, "fraction": 1.0},
+                        dup={"tracked": 2, "multi_pod": 0, "share": 0.0})
+        merged = merge_workingset_windows([wa, wb])
+        # written: 10*2 + 4*10 = 60; read: 5*2 = 10 -> 50/60 never read.
+        assert merged["never_read"]["fraction"] == round(50 / 60, 4)
+        # tracked: 10*2 + 2*10 = 40; multi: 5*2 = 10 -> share 0.25.
+        assert merged["duplication"]["share"] == 0.25
+
+    def test_whatif_falls_back_to_index_scope(self):
+        w = _ws_window(0, 1.0, {
+            "index": {"accesses": 10, "sampled": 10, "cold": 2, "hits": 8,
+                      "capacity_blocks": 0, "tracked": 8,
+                      "hist": {"4": 8}},
+            "hbm": {"accesses": 0, "sampled": 0, "cold": 0, "hits": 0,
+                    "capacity_blocks": 16, "tracked": 0, "hist": {}},
+        })
+        merged = merge_workingset_windows([w])
+        rows = whatif_table(merged, factors=(1.0,))
+        assert rows[0]["capacity_blocks"] == 16
+        assert rows[0]["est_hit_ratio"] == 0.8
+
+
+class TestCollectorWorkingsetLeg:
+    @staticmethod
+    def _static_source(windows, rate):
+        def source(since):
+            fresh = [w for w in windows if w["seq"] > since]
+            return {"windows": fresh,
+                    "next_seq": max((w["seq"] for w in windows),
+                                    default=since),
+                    "dropped": 0, "sample_rate": rate}
+        return source
+
+    def _start_pod(self, windows, rate):
+        admin = AdminServer(port=0)
+        admin.register_spans_source(
+            lambda since: {"spans": [], "next_seq": since, "dropped": 0})
+        admin.register_workingset_source(self._static_source(windows, rate))
+        admin.start()
+        return admin
+
+    def test_pulls_merge_and_whatif_with_cursor_advance(self):
+        wa = _ws_window(0, 0.5, _hbm(100, 50, 10, 60, {"8": 40}, 64),
+                        process="pod-a")
+        wb = _ws_window(0, 0.1, _hbm(100, 10, 2, 50, {"128": 8}, 64),
+                        process="pod-b")
+        pod_a = self._start_pod([wa], 0.5)
+        pod_b = self._start_pod([wb], 0.1)
+        col = TelemetryCollector(CollectorConfig(
+            targets=(
+                ScrapeTarget(name="pod-a",
+                             address=f"127.0.0.1:{pod_a.port}"),
+                ScrapeTarget(name="pod-b",
+                             address=f"127.0.0.1:{pod_b.port}"),
+            ),
+            scrape_interval_s=0.0, admin_port=0))
+        try:
+            col.scrape_once()
+            view = col.workingset_view()
+            assert view["windows"] == 2
+            assert view["targets"] == ["pod-a", "pod-b"]
+            assert view["hbm_capacity_blocks"] == 128
+            by_factor = {r["factor"]: r for r in view["whatif"]}
+            assert by_factor[1.0]["est_hit_ratio"] == 0.8
+            assert view["scopes"]["hbm"]["measured_hit_ratio"] == round(
+                110 / 200, 4)
+            # Cursors advance: a second round pulls nothing new.
+            col.scrape_once()
+            assert col.workingset_view()["windows"] == 2
+            # And the collector's own debug surface carries the view.
+            assert col.debug_view()["workingset"]["windows"] == 2
+        finally:
+            col.stop()
+            pod_a.stop()
+            pod_b.stop()
+
+    def test_pod_without_tracker_does_not_trip_the_breaker(self):
+        bare = AdminServer(port=0)
+        bare.register_spans_source(
+            lambda since: {"spans": [], "next_seq": since, "dropped": 0})
+        bare.start()
+        col = TelemetryCollector(CollectorConfig(
+            targets=(ScrapeTarget(name="pod-off",
+                                  address=f"127.0.0.1:{bare.port}"),),
+            scrape_interval_s=0.0, admin_port=0, breaker_failures=1))
+        try:
+            for _ in range(3):
+                col.scrape_once()
+            state = col._targets[0]
+            assert state.breaker.allow()  # 404 tolerated, breaker closed
+            assert col.workingset_view()["windows"] == 0
+        finally:
+            col.stop()
+            bare.stop()
+
+
+# -- TYPE-conflict rollup hardening -------------------------------------------
+
+
+class TestTypeConflictRollup:
+    COUNTER_POD = (
+        "# TYPE kvtpu_engine_widget counter\n"
+        "kvtpu_engine_widget_total 5\n"
+        "# TYPE kvtpu_engine_ok counter\n"
+        "kvtpu_engine_ok_total 1\n"
+    )
+    GAUGE_POD = (
+        "# TYPE kvtpu_engine_widget gauge\n"
+        "kvtpu_engine_widget 3\n"
+        "# TYPE kvtpu_engine_ok counter\n"
+        "kvtpu_engine_ok_total 2\n"
+    )
+
+    def test_counter_vs_gauge_conflict_drops_family_and_reports(self):
+        conflicts = []
+        merged = merge_families(
+            [parse_exposition(self.COUNTER_POD),
+             parse_exposition(self.GAUGE_POD)],
+            conflicts=conflicts)
+        assert conflicts == ["kvtpu_engine_widget"]
+        fam = merged["kvtpu_engine_widget"]
+        # Dropped, not corrupted: no 5+3 pseudo-sum survives anywhere.
+        assert fam["type"] == "conflict" and fam["samples"] == {}
+        # Agreeing families still merge.
+        assert merged["kvtpu_engine_ok"]["samples"][()] == 3.0
+
+    def test_conflict_sticks_for_later_pods_too(self):
+        # A third pod agreeing with the first must not resurrect the
+        # family: once poisoned, always dropped this merge.
+        conflicts = []
+        merged = merge_families(
+            [parse_exposition(self.COUNTER_POD),
+             parse_exposition(self.GAUGE_POD),
+             parse_exposition(self.COUNTER_POD)],
+            conflicts=conflicts)
+        assert merged["kvtpu_engine_widget"]["samples"] == {}
+
+    def test_untyped_exposition_upgrades_without_conflict(self):
+        untyped = "kvtpu_engine_widget_total 7\n"
+        conflicts = []
+        merged = merge_families(
+            [parse_exposition(untyped),
+             parse_exposition(self.COUNTER_POD)],
+            conflicts=conflicts)
+        assert conflicts == []
+        assert merged["kvtpu_engine_widget_total"]["type"] != "conflict"
+
+    def test_collector_rollup_surfaces_type_conflicts_once(self):
+        col = TelemetryCollector(CollectorConfig(
+            targets=(ScrapeTarget(name="a", address="127.0.0.1:1"),
+                     ScrapeTarget(name="b", address="127.0.0.1:2")),
+            scrape_interval_s=0.0, admin_port=0))
+        try:
+            col._targets[0].families = parse_exposition(self.COUNTER_POD)
+            col._targets[1].families = parse_exposition(self.GAUGE_POD)
+            out = col.rollup_view()
+            assert out["type_conflicts"] == ["kvtpu_engine_widget"]
+            # Warn-once bookkeeping: the name is remembered.
+            col.rollup_view()
+            assert "kvtpu_engine_widget" in col._warned_type_conflicts
+        finally:
+            col.stop()
