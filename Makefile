@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -107,16 +107,25 @@ bench-pyprof: native
 bench-workingset: native
 	$(CPU_ENV) $(PY) bench.py --workingset
 
-# Perf-regression sentinel: run the profiling + working-set gates, then
-# diff their values and hot-function shares against the committed
-# baseline manifest. Emits machine-verdict `PERF PASS|FAIL ...` lines;
-# fails on regression.
+# Fleet-controller chaos arm (control/): traffic-flip re-role, 4x index
+# ramp shard scale-up, and flap injection against a modeled fleet; the
+# flap-injection executed-action count is the perf-sentinel value
+# (hysteresis must bound it).
+bench-controller: native
+	$(CPU_ENV) $(PY) bench.py --controller
+
+# Perf-regression sentinel: run the profiling + working-set gates and the
+# controller chaos arm, then diff their values and hot-function shares
+# against the committed baseline manifest. Emits machine-verdict
+# `PERF PASS|FAIL ...` lines; fails on regression.
 perf-check: native
 	$(CPU_ENV) $(PY) bench.py --pyprof-overhead > /tmp/kvtpu_pyprof_bench.json
 	$(CPU_ENV) $(PY) bench.py --workingset > /tmp/kvtpu_workingset_bench.json
+	$(CPU_ENV) $(PY) bench.py --controller > /tmp/kvtpu_controller_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
 	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
-	  --results workingset=/tmp/kvtpu_workingset_bench.json
+	  --results workingset=/tmp/kvtpu_workingset_bench.json \
+	  --results controller=/tmp/kvtpu_controller_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.
 verify: lint perf-check

@@ -2405,6 +2405,203 @@ def bench_disagg() -> dict:
     }
 
 
+def bench_controller() -> dict:
+    """Fleet-controller chaos arm (``--controller``, ISSUE 13).
+
+    Three deterministic scenarios drive a REAL control stack — SLORegistry
+    burn-rate alerting, HandoffCoordinator mix EMA, HashRing membership,
+    FleetController with hysteresis/cooldown/budget policy — against a
+    modeled fleet (pod service times are analytic functions of topology,
+    so the arm is fast and bit-stable):
+
+    1. **re-role chaos**: traffic flips balanced → prefill-heavy mid-run;
+       the controller must flip a decode pod to prefill with zero manual
+       intervention and bring modeled TTFT p90 back inside the SLO.
+    2. **shard ramp**: the index grows 4x; the controller must scale the
+       ring up (each join moving < 2/N of partitions) and hold modeled
+       score p99 at the threshold.
+    3. **flap injection**: the burn rate oscillates around the act band
+       every round for 40 rounds; hysteresis must bound executed actions
+       (the perf-sentinel value — lower is better, baseline 1).
+
+    Every executed action must carry a ``llm_d.kv_cache.control.action``
+    span with the causing signal attached (part of the gate).
+    """
+    from llmd_kv_cache_tpu.cluster.ring import HashRing, moved_partitions
+    from llmd_kv_cache_tpu.control import (
+        CollectorSignalSource,
+        ControllerConfig,
+        FleetController,
+        InProcessActuator,
+    )
+    from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
+    from llmd_kv_cache_tpu.telemetry.slo import SLOConfig, SLORegistry
+    from llmd_kv_cache_tpu.telemetry.tracing import recording_tracing
+
+    clk = [0.0]
+
+    def clock():
+        return clk[0]
+
+    def p90(values):
+        xs = sorted(values)
+        return xs[min(len(xs) - 1, int(0.9 * len(xs)))] if xs else 0.0
+
+    with recording_tracing() as exporter:
+        # -- scenario 1: prefill-heavy flip → re-role ----------------------
+        roles = {"pod-0": "prefill", "pod-1": "prefill",
+                 "pod-2": "decode", "pod-3": "decode"}
+        reg = SLORegistry(clock=clock)
+        ttft_slo = reg.add(SLOConfig(
+            name="ttft", objective=0.99,
+            fast_windows=(5.0, 10.0), slow_window=20.0))
+        handoff = HandoffCoordinator()
+        handoff.mix_alpha = 0.5  # fast EMA so the flip lands in a few rounds
+        src = CollectorSignalSource(
+            slo_registry=reg, handoff=handoff,
+            shards=lambda: ["shard-0"], roles=lambda: dict(roles),
+            clock=clock)
+        act = InProcessActuator(
+            set_role=lambda t, r: roles.__setitem__(t, r),
+            drain_pod=lambda t: {"ok": True})
+        ctl = FleetController(
+            src, act,
+            config=ControllerConfig(
+                confirm_rounds=2, role_cooldown_s=3.0,
+                role_imbalance_act=0.2, role_imbalance_rearm=0.1),
+            clock=clock)
+        TTFT_BASE, TTFT_SLO_S = 1.4, 2.0
+        ttfts = []
+        for rnd in range(40):
+            mix = 0.5 if rnd < 10 else 0.85  # the chaos flip
+            handoff.observe_mix(int(mix * 100), 100 - int(mix * 100))
+            prefill_frac = (
+                sum(1 for r in roles.values() if r == "prefill")
+                / max(len(roles), 1))
+            ttft_s = TTFT_BASE * max(1.0, mix / max(prefill_frac, 1e-9))
+            ttfts.append(ttft_s)
+            ttft_slo.record(*((100, 0) if ttft_s <= TTFT_SLO_S else (0, 100)))
+            reg.evaluate_all()
+            ctl.reconcile_once()
+            clk[0] += 1.0
+        reroles = [a for a in act.applied if a[0] == "set_role"]
+        ttft_p90_after = p90(ttfts[-10:])
+        reroles_ok = (len(reroles) >= 1 and ttft_p90_after <= TTFT_SLO_S
+                      and ttft_slo.alert_severity is None)
+
+        # -- scenario 2: 4x index ramp → shard scale-up --------------------
+        clk[0] += 100.0
+        shards = ["shard-0"]
+        reg2 = SLORegistry(clock=clock)
+        score_slo = reg2.add(SLOConfig(
+            name="score_latency", objective=0.99,
+            fast_windows=(5.0, 10.0), slow_window=15.0))
+        src2 = CollectorSignalSource(
+            slo_registry=reg2, shards=lambda: list(shards),
+            roles=lambda: {}, clock=clock)
+        move_fracs = []
+
+        def add_shard(target):
+            old = HashRing(shards)
+            shards.append(target)
+            new = HashRing(shards)
+            frac = moved_partitions(old, new) / new.partitions
+            move_fracs.append(frac)
+            return {"joined": target, "moved_fraction": round(frac, 4)}
+
+        act2 = InProcessActuator(
+            add_shard=add_shard,
+            remove_shard=lambda t: shards.remove(t),
+            drain_pod=lambda t: {"ok": True})
+        ctl2 = FleetController(
+            src2, act2,
+            config=ControllerConfig(confirm_rounds=2, shard_cooldown_s=4.0,
+                                    max_shards=8),
+            clock=clock)
+        SCORE_MS_PER_X, SCORE_SLO_MS = 2.0, 4.0
+        score_p99 = 0.0
+        for rnd in range(50):
+            index_x = 1.0 + 3.0 * min(1.0, rnd / 20.0)  # 1x → 4x ramp
+            score_p99 = SCORE_MS_PER_X * index_x / max(len(shards), 1)
+            score_slo.record(
+                *((100, 0) if score_p99 <= SCORE_SLO_MS else (0, 100)))
+            reg2.evaluate_all()
+            ctl2.reconcile_once()
+            clk[0] += 1.0
+        scaleup_ok = (len(shards) >= 2 and score_p99 <= SCORE_SLO_MS
+                      and all(f <= 2.0 / len(shards) for f in move_fracs))
+
+        # -- scenario 3: flap injection → bounded actions ------------------
+        clk[0] += 100.0
+        shards3 = ["shard-0"]
+        reg3 = SLORegistry(clock=clock)
+        flap_slo = reg3.add(SLOConfig(
+            name="score_latency", objective=0.99,
+            fast_windows=(3.0, 6.0), slow_window=10.0))
+        src3 = CollectorSignalSource(
+            slo_registry=reg3, shards=lambda: list(shards3),
+            roles=lambda: {}, clock=clock)
+        act3 = InProcessActuator(
+            add_shard=lambda t: shards3.append(t),
+            remove_shard=lambda t: shards3.remove(t),
+            drain_pod=lambda t: {"ok": True})
+        ctl3 = FleetController(
+            src3, act3,
+            config=ControllerConfig(confirm_rounds=1, shard_cooldown_s=5.0,
+                                    max_shards=8),
+            clock=clock)
+        for rnd in range(40):
+            # Oscillate the instantaneous burn around the act band (1.0):
+            # 1.5x on even rounds, 0.8x on odd — without hysteresis this
+            # would act every other round.
+            bad = 15 if rnd % 2 == 0 else 8
+            flap_slo.record(1000 - bad, bad)
+            reg3.evaluate_all()
+            ctl3.reconcile_once()
+            clk[0] += 1.0
+        flap_actions = len(act3.applied)
+        flap_ok = flap_actions <= 2
+
+        executed_total = len(act.applied) + len(act2.applied) + len(act3.applied)
+        action_spans = exporter.find("llm_d.kv_cache.control.action")
+        spans_ok = (
+            len([s for s in action_spans if s.attributes.get("signal")])
+            >= executed_total > 0)
+
+    detail = {
+        "reroles": {
+            "actions": len(reroles),
+            "ttft_p90_after_s": round(ttft_p90_after, 3),
+            "ttft_slo_s": TTFT_SLO_S,
+            "alert_cleared": ttft_slo.alert_severity is None,
+            "ok": reroles_ok,
+        },
+        "scaleup": {
+            "final_shards": len(shards),
+            "score_p99_ms": round(score_p99, 3),
+            "score_slo_ms": SCORE_SLO_MS,
+            "max_moved_fraction": round(max(move_fracs), 4) if move_fracs else 0.0,
+            "ok": scaleup_ok,
+        },
+        "flap": {
+            "executed_actions": flap_actions,
+            "rounds": 40,
+            "ok": flap_ok,
+        },
+        "action_spans_with_signal": spans_ok,
+    }
+    return {
+        "metric": "fleet controller chaos arm "
+                  "(flap-injection executed actions; re-role + shard-ramp "
+                  "gates)",
+        "value": flap_actions,
+        "unit": "actions",
+        "vs_baseline": 1,
+        "gate_ok": bool(reroles_ok and scaleup_ok and flap_ok and spans_ok),
+        "detail": detail,
+    }
+
+
 def _run_ttft_subprocess(env=None, timeout=2400):
     """Run the TTFT arm in a watchdogged subprocess; returns the JSON
     result line or None. The budget covers the replay arms, the hardened
@@ -2518,6 +2715,8 @@ def _dispatch(argv: list) -> object:
         return bench_engine_telemetry()
     if "--disagg" in argv:
         return bench_disagg()
+    if "--controller" in argv:
+        return bench_controller()
     if "--shards" in argv:
         i = argv.index("--shards")
         n = 4

@@ -120,6 +120,7 @@ def main() -> None:
         seed=0,  # all pods share deterministic params: cross-pod
         #         storage restores must be bit-exact resumable
     )
+    handoff = None
     if args.role != "both":
         # Local coordinator: feeds the kvtpu_handoff_* metrics and, on a
         # prefill pod, streams chunk commits. Cross-pod availability rides
@@ -127,10 +128,14 @@ def main() -> None:
         # deployment shim.
         from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
 
-        engine.attach_handoff(HandoffCoordinator())
+        handoff = HandoffCoordinator()
+        engine.attach_handoff(handoff)
 
     control = pathlib.Path(args.control_dir)
     control.mkdir(parents=True, exist_ok=True)
+
+    running = [True]
+    signal.signal(signal.SIGTERM, lambda *_: running.__setitem__(0, False))
 
     admin = None
     if args.admin_port != "0":
@@ -183,11 +188,41 @@ def main() -> None:
             if tracker is not None:
                 engine.attach_workingset(tracker)
                 admin.register_workingset_source(tracker.export_since)
+        # Fleet-controller surface: /debug/role reports this pod's
+        # serving role plus the handoff coordinator's residency/
+        # starvation stats; POST /debug/role?set=<role> re-roles the
+        # engine (guarded — only wired because this entry point opts in);
+        # POST /debug/drain runs the PR 4 graceful drain.
+        def role_view() -> dict:
+            view = {"pod": args.pod_id, "role": engine.cfg.role}
+            if handoff is not None:
+                view["starvation"] = handoff.starvation()
+            return view
+
+        def set_role(params) -> dict:
+            role = params.get("set", "")
+            previous = engine.set_role(role)  # ValueError → HTTP 400
+            return {"ok": True, "pod": args.pod_id, "role": role,
+                    "previous": previous}
+
+        admin.register_debug("role", role_view)
+        admin.register_action("role", set_role)
+
+        from llmd_kv_cache_tpu.recovery.drain import DrainCoordinator
+
+        drainer = DrainCoordinator(
+            intake_stoppers=[lambda: running.__setitem__(0, False)],
+            offload=getattr(engine, "offload_manager", None),
+        )
+
+        def drain_action(params) -> dict:
+            if "deadline_s" in params:
+                drainer.deadline_s = float(params["deadline_s"])
+            return drainer.drain()
+
+        admin.register_action("drain", drain_action)
         admin.start()
         (control / f"{args.pod_id}.admin_port").write_text(str(admin.port))
-
-    running = [True]
-    signal.signal(signal.SIGTERM, lambda *_: running.__setitem__(0, False))
 
     # Warm the tiny model (first jit), then declare readiness.
     engine.generate(f"{args.pod_id}-warm", [1, 2, 3, 4], max_new_tokens=1)

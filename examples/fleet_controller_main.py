@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Deployment entry point: the self-driving fleet controller.
+
+One controller per fleet. It closes the loop the observability planes
+opened: SLO burn-rate edges (telemetry collector's ``/debug/slo``),
+critical-path attribution (``/debug/traces``), the what-if capacity
+table (``/debug/workingset``), and each engine pod's role + handoff
+starvation stats (``/debug/role``) flow in; indexer shard join/leave,
+prefill↔decode re-roles, and pre-scale-down drains flow out through the
+pods' guarded admin POST endpoints.
+
+Safety rails (see docs/architecture.md "Fleet controller"):
+hysteresis bands + confirm rounds + per-action cooldowns + a global
+action budget mean the controller never flaps; every action is
+journaled (``--journal``) before and after execution so a restarted
+controller resumes without repeating or reversing in-flight actions;
+``--dry-run`` records would-have-acted decisions without touching the
+cluster. ``kvdiag --fleet`` (pointed at ``--admin-port``) shows the
+action history with each action's causing signal.
+
+Usage:
+  python examples/fleet_controller_main.py \
+      --collector 127.0.0.1:9500 \
+      --pods pod-0=127.0.0.1:9401,pod-1=127.0.0.1:9402 \
+      --admin-port 9600 --journal /var/run/kvtpu/controller.journal \
+      [--interval-s 5] [--dry-run]
+  python examples/fleet_controller_main.py --config controller.json
+"""
+
+import argparse
+import json
+import signal
+import threading
+
+from llmd_kv_cache_tpu.services.fleet_controller import (
+    FleetControllerService,
+    FleetControllerServiceConfig,
+)
+from llmd_kv_cache_tpu.utils.logging import configure_from_env
+
+
+def parse_pods(spec: str) -> dict:
+    """``pod-id=host:port`` comma-separated items."""
+    out = {}
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        name, eq, address = item.partition("=")
+        if not eq:
+            raise ValueError(f"bad --pods item {item!r} (want id=host:port)")
+        out[name] = address
+    return out
+
+
+def main() -> None:
+    configure_from_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--collector", default="",
+                        help="host:port of the telemetry collector's admin "
+                             "endpoint")
+    parser.add_argument("--pods", default="",
+                        help="comma-separated pod-id=host:port admin "
+                             "addresses of the engine pods")
+    parser.add_argument("--admin-port", type=int, default=0,
+                        help="this controller's own admin endpoint "
+                             "(/debug/controller); 0 = off")
+    parser.add_argument("--journal", default="",
+                        help="append-only action journal path (warm-restart "
+                             "safety); empty = no persistence")
+    parser.add_argument("--interval-s", type=float, default=5.0,
+                        help="reconcile loop interval (default 5s)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="record would-have-acted decisions without "
+                             "mutating the cluster")
+    parser.add_argument("--config", default=None,
+                        help="JSON file with the controllerConfig block "
+                             "(camelCase; overrides other flags)")
+    args = parser.parse_args()
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = FleetControllerServiceConfig.from_dict(json.load(f))
+    else:
+        controller = {
+            "loopIntervalS": args.interval_s,
+            "dryRun": args.dry_run,
+            "journalPath": args.journal,
+        }
+        cfg = FleetControllerServiceConfig.from_dict({
+            "collectorAddress": args.collector,
+            "podAdmin": parse_pods(args.pods),
+            "adminPort": args.admin_port,
+            "controllerConfig": controller,
+        })
+
+    service = FleetControllerService(cfg)
+    service.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

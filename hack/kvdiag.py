@@ -38,6 +38,12 @@ an on-call engineer needs into a single JSON report on stdout:
                                  capacity table (hit ratio at
                                  0.5x/1x/2x/4x HBM, never-read offload
                                  fraction, cross-pod duplicate share)
+- ``controller`` (summary)     — when the target is the fleet controller:
+                                 the last N actions with each action's
+                                 causing signal, per-action-kind cooldown
+                                 + hysteresis state, the global action
+                                 budget, in-flight (unsettled) actions,
+                                 and dry-run would-have-acted records
 
 Usage:
   python hack/kvdiag.py --port 9400 [--host 127.0.0.1] [--out report.json]
@@ -65,7 +71,7 @@ import urllib.request
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
                    "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
-                   "kvtpu_workingset_", "kvtpu_cache_ledger_")
+                   "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -238,10 +244,64 @@ def snapshot(host: str, port: int, timeout: float = 5.0,
         # reuse windows themselves live at /debug/workingset).
         report["workingset"] = ws_state
 
+    controller = debug.get("controller")
+    if isinstance(controller, dict):
+        report["controller"] = controller_summary(controller)
+
     if fleet or "rollup" in debug:
         report["fleet"] = fleet_summary(debug)
 
     return report
+
+
+def controller_summary(view: dict, last_n: int = 10) -> dict:
+    """Condense the fleet controller's ``/debug/controller`` view into the
+    triage questions: what did it do and *why* (last N actions, each with
+    the causing signal), is it allowed to act again (cooldowns, budget,
+    hysteresis arming), is anything in flight after a restart, and what
+    would a ``--dry-run`` controller have done."""
+
+    def _action(rec: dict) -> dict:
+        signal = rec.get("signal")
+        if isinstance(signal, str):
+            # Span attributes carry the signal JSON-encoded; decode for
+            # the report so grepping the snapshot finds slo names.
+            try:
+                signal = json.loads(signal)
+            except ValueError:
+                pass
+        return {
+            "action_id": rec.get("action_id"),
+            "ts": rec.get("ts"),
+            "phase": rec.get("phase"),
+            "kind": rec.get("kind"),
+            "target": rec.get("target"),
+            "reason": rec.get("reason"),
+            "signal": signal,
+            "result": rec.get("result"),
+        }
+
+    policy = view.get("policy") or {}
+    hysteresis = policy.get("hysteresis") or {}
+    return {
+        "dry_run": view.get("dry_run"),
+        "rounds": view.get("rounds"),
+        "resumed_records": view.get("resumed_records"),
+        "budget": view.get("budget"),
+        "cooldowns": policy.get("cooldowns"),
+        "hysteresis_armed": {
+            name: (st or {}).get("armed")
+            for name, st in hysteresis.items()
+            if isinstance(st, dict)
+        },
+        "pending": [_action(r) for r in view.get("pending") or []],
+        "last_actions": [
+            _action(r) for r in (view.get("actions") or [])[-last_n:]
+        ],
+        "would_act": [
+            _action(r) for r in (view.get("would_act") or [])[-last_n:]
+        ],
+    }
 
 
 def fleet_summary(debug: dict) -> dict:

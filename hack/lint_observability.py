@@ -34,11 +34,12 @@ SPAN_PREFIX = "llm_d.kv_cache."
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
                    "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
-                   "kvtpu_workingset_", "kvtpu_cache_ledger_")
+                   "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_")
 # Admin-plane surfaces an operator must be able to find without reading
 # the source: each literal must appear in docs/observability.md.
 REQUIRED_ENDPOINTS = ("/debug/pyprof", "/debug/pyprof/capture",
-                      "/debug/workingset")
+                      "/debug/workingset", "/debug/slo", "/debug/role",
+                      "/debug/controller")
 METRIC_CLASSES = frozenset({
     "Counter", "Gauge", "Histogram", "Summary",
     # The engine-telemetry histogram primitive with config-driven buckets
@@ -83,6 +84,24 @@ def _metric_class(call: ast.Call) -> str:
     return ""
 
 
+def _module_string_consts(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments.
+
+    Span names are often hoisted into constants (``SPAN_ACTION = "llm_d.
+    kv_cache.control.action"``) and passed by name to ``tracer().span``;
+    resolving them keeps those names inside the namespace + docs checks
+    instead of silently skipping them as dynamic."""
+    consts: dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    consts[target.id] = stmt.value.value
+    return consts
+
+
 def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
     """Returns (problems, metric_names_constructed, span_names)."""
     src = path.read_text()
@@ -90,6 +109,7 @@ def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"], [], []
+    consts = _module_string_consts(tree)
     problems: list[str] = []
     metric_names: list[str] = []
     span_names: list[str] = []
@@ -98,7 +118,10 @@ def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
             continue
         first = node.args[0]
         if _is_span_call(node):
-            prefix, full = _literal_prefix(first)
+            if isinstance(first, ast.Name) and first.id in consts:
+                prefix, full = consts[first.id], True
+            else:
+                prefix, full = _literal_prefix(first)
             if not prefix and not full:
                 continue  # dynamic name; nothing to check statically
             if not prefix.startswith(SPAN_PREFIX) and not SPAN_PREFIX.startswith(prefix):

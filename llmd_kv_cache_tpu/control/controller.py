@@ -1,0 +1,382 @@
+"""The reconciliation loop: sense → decide → act, journaled and traced.
+
+Each round takes one :class:`FleetSignals` snapshot, first *resolves*
+any in-flight actions a predecessor journaled but never settled (verify
+against observed topology; only re-execute when the world does not
+already reflect the action — never repeat, never reverse), then asks the
+policy for new actions and pushes them through the actuator under the
+global action budget.
+
+Crash safety is the journal's write ordering: ``planned`` lands on disk
+*before* the actuator runs, ``executed``/``failed`` after it settles, so
+every controller state is reconstructible from the journal alone. Every
+executed (or dry-run) action gets a ``llm_d.kv_cache.control.action``
+span whose attributes carry the causing alert/signal snapshot — the
+audit trail from "SLO burned" to "topology changed" is one trace query.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from prometheus_client import Counter, Gauge
+
+from ..utils.logging import get_logger
+from ..telemetry.tracing import tracer
+from .actions import (
+    ACTION_ADD_SHARD,
+    ACTION_DRAIN_POD,
+    ACTION_REMOVE_SHARD,
+    ACTION_SET_ROLE,
+    Action,
+    Actuator,
+)
+from .config import ControllerConfig
+from .journal import (
+    PHASE_EXECUTED,
+    PHASE_FAILED,
+    PHASE_PLANNED,
+    PHASE_WOULD_ACT,
+    ActionJournal,
+    ActionRecord,
+    last_settlement_ts,
+    unresolved_actions,
+)
+from .policy import ControlPolicy
+from .signals import FleetSignals
+
+logger = get_logger("control.controller")
+
+CTRL_ROUNDS = Counter(
+    "kvtpu_ctrl_reconcile_rounds_total",
+    "Fleet-controller reconcile rounds completed",
+)
+CTRL_ACTIONS = Counter(
+    "kvtpu_ctrl_actions_total",
+    "Fleet-controller actions by kind and settlement phase",
+    ["kind", "phase"],
+)
+CTRL_BUDGET_DEFERRED = Counter(
+    "kvtpu_ctrl_budget_deferred_total",
+    "Actions the policy wanted but the global budget deferred",
+)
+CTRL_INFLIGHT = Gauge(
+    "kvtpu_ctrl_inflight_actions",
+    "Journaled planned actions not yet settled",
+)
+
+SPAN_RECONCILE = "llm_d.kv_cache.control.reconcile"
+SPAN_ACTION = "llm_d.kv_cache.control.action"
+
+
+class FleetController:
+    """Sense → decide → act loop over a signal source and an actuator."""
+
+    def __init__(
+        self,
+        signal_source,
+        actuator: Actuator,
+        config: Optional[ControllerConfig] = None,
+        journal: Optional[ActionJournal] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.cfg = config or ControllerConfig()
+        self.source = signal_source
+        self.actuator = actuator
+        # Wall clock on purpose: journal timestamps must stay comparable
+        # across restarts for cooldown/budget restoration.
+        self._clock = clock
+        self.policy = ControlPolicy(self.cfg, clock)
+        if journal is None and self.cfg.journal_path:
+            journal = ActionJournal(self.cfg.journal_path)
+        self.journal = journal
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.rounds = 0
+        self.budget_deferred = 0
+        # Executed-action timestamps inside the sliding budget window.
+        self._budget_ts: deque = deque()
+        # Bounded histories for kvdiag / /debug/controller.
+        self._history: deque = deque(maxlen=self.cfg.history)
+        self._would_act: deque = deque(maxlen=self.cfg.history)
+        # In-flight (planned, unsettled) records to resolve next round.
+        self._pending: List[ActionRecord] = []
+        # Monotonic action-id counter, assigned BEFORE the planned record
+        # is journaled so the on-disk planned and settled records carry
+        # the same action_id (unresolved_actions matches by id).
+        self._action_counter = 0
+        self.resumed_records = 0
+        if self.journal is not None:
+            self._restore()
+
+    # -- warm restart ------------------------------------------------------
+
+    def _restore(self) -> None:
+        records = list(self.journal.replay())
+        self.resumed_records = len(records)
+        if not records:
+            return
+        # Resume past the highest journal seq: action ids embed the
+        # counter, so reuse across restarts would alias distinct actions.
+        self._action_counter = max(r.seq for r in records)
+        for kind, ts in last_settlement_ts(records).items():
+            self.policy.notify_action(kind, ts)
+        now = self._clock()
+        for rec in records:
+            if rec.phase == PHASE_EXECUTED \
+                    and now - rec.ts <= self.cfg.budget_window_s:
+                self._budget_ts.append(rec.ts)
+            if rec.phase in (PHASE_EXECUTED, PHASE_FAILED):
+                self._history.append(rec.to_wire())
+            elif rec.phase == PHASE_WOULD_ACT:
+                self._would_act.append(rec.to_wire())
+        self._pending = unresolved_actions(records)
+        CTRL_INFLIGHT.set(len(self._pending))
+        if self._pending:
+            logger.info(
+                "restored %d journal records, %d in-flight action(s) to "
+                "re-verify: %s", len(records), len(self._pending),
+                [r.action_id for r in self._pending])
+        else:
+            logger.info("restored %d journal records, no in-flight actions",
+                        len(records))
+
+    # -- budget ------------------------------------------------------------
+
+    def _budget_ok(self) -> bool:
+        now = self._clock()
+        while self._budget_ts and now - self._budget_ts[0] > self.cfg.budget_window_s:
+            self._budget_ts.popleft()
+        return len(self._budget_ts) < self.cfg.action_budget
+
+    def _charge_budget(self) -> None:
+        self._budget_ts.append(self._clock())
+
+    # -- journaling helpers ------------------------------------------------
+
+    def _journal(self, record: ActionRecord) -> ActionRecord:
+        if self.journal is not None:
+            return self.journal.append(record)
+        # No persistence configured: still assign seqs so action ids and
+        # histories stay well-formed.
+        self._seq = getattr(self, "_seq", 0) + 1
+        record.seq = self._seq
+        return record
+
+    def _record(self, action: Action, phase: str,
+                result: Optional[dict] = None) -> ActionRecord:
+        self._action_counter += 1
+        rec = ActionRecord(
+            action_id=action.action_id(self._action_counter),
+            seq=0,
+            ts=self._clock(),
+            phase=phase,
+            kind=action.kind,
+            target=action.target,
+            params=dict(action.params),
+            reason=action.reason,
+            signal=dict(action.signal),
+            result=dict(result or {}),
+        )
+        return self._journal(rec)
+
+    # -- action execution --------------------------------------------------
+
+    def _execute(self, action: Action) -> ActionRecord:
+        """planned → actuate → executed/failed, traced and journaled."""
+        planned = self._record(action, PHASE_PLANNED)
+        CTRL_ACTIONS.labels(action.kind, PHASE_PLANNED).inc()
+        self._pending.append(planned)
+        CTRL_INFLIGHT.set(len(self._pending))
+        try:
+            with tracer().span(
+                SPAN_ACTION,
+                action_id=planned.action_id,
+                action_kind=action.kind,
+                action_target=action.target,
+                reason=action.reason,
+                signal=json.dumps(action.signal, sort_keys=True,
+                                  default=repr),
+                dry_run=False,
+            ):
+                result = self.actuator.apply(action)
+            phase, payload = PHASE_EXECUTED, {"ok": True, **(result or {})}
+            self._charge_budget()
+        except Exception as exc:
+            phase, payload = PHASE_FAILED, {"ok": False, "error": repr(exc)}
+            logger.warning("action %s failed: %r", planned.action_id, exc)
+        settled = ActionRecord(
+            action_id=planned.action_id,
+            seq=0,
+            ts=self._clock(),
+            phase=phase,
+            kind=action.kind,
+            target=action.target,
+            params=dict(action.params),
+            reason=action.reason,
+            signal=dict(action.signal),
+            result=payload,
+        )
+        settled = self._journal(settled)
+        CTRL_ACTIONS.labels(action.kind, phase).inc()
+        self._pending = [p for p in self._pending
+                         if p.action_id != planned.action_id]
+        CTRL_INFLIGHT.set(len(self._pending))
+        self._history.append(settled.to_wire())
+        return settled
+
+    def _dry_run(self, action: Action) -> ActionRecord:
+        with tracer().span(
+            SPAN_ACTION,
+            action_kind=action.kind,
+            action_target=action.target,
+            reason=action.reason,
+            signal=json.dumps(action.signal, sort_keys=True, default=repr),
+            dry_run=True,
+        ):
+            rec = self._record(action, PHASE_WOULD_ACT,
+                               result={"dry_run": True})
+        CTRL_ACTIONS.labels(action.kind, PHASE_WOULD_ACT).inc()
+        self._would_act.append(rec.to_wire())
+        return rec
+
+    # -- in-flight resolution ----------------------------------------------
+
+    def _world_reflects(self, rec: ActionRecord,
+                        signals: FleetSignals) -> bool:
+        """Does observed topology already show this action's effect?"""
+        if rec.kind == ACTION_SET_ROLE:
+            return signals.roles.get(rec.target) == rec.params.get("role")
+        if rec.kind == ACTION_ADD_SHARD:
+            return rec.target in signals.shards
+        if rec.kind == ACTION_REMOVE_SHARD:
+            return rec.target not in signals.shards
+        if rec.kind == ACTION_DRAIN_POD:
+            # Drain leaves no durable topology mark; once its pod is gone
+            # from the ring the paired scale-down clearly went through.
+            return rec.target not in signals.shards
+        return False
+
+    def _resolve_pending(self, signals: FleetSignals) -> None:
+        pending, self._pending = self._pending, []
+        for rec in pending:
+            action = Action(kind=rec.kind, target=rec.target,
+                            params=dict(rec.params),
+                            reason=f"resume in-flight: {rec.reason}",
+                            signal=dict(rec.signal))
+            if self._world_reflects(rec, signals):
+                settled = ActionRecord(
+                    action_id=rec.action_id, seq=0, ts=self._clock(),
+                    phase=PHASE_EXECUTED, kind=rec.kind, target=rec.target,
+                    params=dict(rec.params), reason=rec.reason,
+                    signal=dict(rec.signal),
+                    result={"ok": True, "resumed": True,
+                            "already_applied": True},
+                )
+                settled = self._journal(settled)
+                CTRL_ACTIONS.labels(rec.kind, PHASE_EXECUTED).inc()
+                self._history.append(settled.to_wire())
+                logger.info("in-flight action %s already applied; settled "
+                            "without re-executing", rec.action_id)
+                continue
+            if self.cfg.dry_run:
+                self._dry_run(action)
+                continue
+            if not self._budget_ok():
+                self.budget_deferred += 1
+                CTRL_BUDGET_DEFERRED.inc()
+                self._pending.append(rec)
+                continue
+            logger.info("re-executing in-flight action %s", rec.action_id)
+            self._execute(action)
+        CTRL_INFLIGHT.set(len(self._pending))
+
+    # -- the loop ----------------------------------------------------------
+
+    def reconcile_once(self) -> Dict[str, object]:
+        """One sense→decide→act round; returns a round summary."""
+        with self._mu:
+            with tracer().span(SPAN_RECONCILE, dry_run=self.cfg.dry_run):
+                signals = self.source.poll()
+                self._resolve_pending(signals)
+                proposed = self.policy.decide(signals)
+                executed: List[str] = []
+                deferred = 0
+                for action in proposed:
+                    if self.cfg.dry_run:
+                        rec = self._dry_run(action)
+                        executed.append(rec.action_id)
+                        continue
+                    if not self._budget_ok():
+                        self.budget_deferred += 1
+                        deferred += 1
+                        CTRL_BUDGET_DEFERRED.inc()
+                        logger.warning(
+                            "budget exhausted (%d actions in %.0fs window); "
+                            "deferring %s", self.cfg.action_budget,
+                            self.cfg.budget_window_s, action.describe())
+                        continue
+                    rec = self._execute(action)
+                    executed.append(rec.action_id)
+                self.rounds += 1
+                CTRL_ROUNDS.inc()
+                return {
+                    "ts": signals.ts,
+                    "proposed": len(proposed),
+                    "settled": executed,
+                    "budget_deferred": deferred,
+                    "pending": [r.action_id for r in self._pending],
+                    "dry_run": self.cfg.dry_run,
+                }
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-controller", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # loop survives a bad round  # lint: allow-swallow
+                logger.exception("reconcile round failed")
+            self._stop.wait(self.cfg.loop_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self.journal is not None:
+            self.journal.close()
+
+    # -- introspection -----------------------------------------------------
+
+    def debug_view(self) -> dict:
+        with self._mu:
+            now = self._clock()
+            window = [t for t in self._budget_ts
+                      if now - t <= self.cfg.budget_window_s]
+            return {
+                "dry_run": self.cfg.dry_run,
+                "rounds": self.rounds,
+                "resumed_records": self.resumed_records,
+                "budget": {
+                    "limit": self.cfg.action_budget,
+                    "window_s": self.cfg.budget_window_s,
+                    "used": len(window),
+                    "deferred_total": self.budget_deferred,
+                },
+                "policy": self.policy.debug_view(),
+                "pending": [r.to_wire() for r in self._pending],
+                "actions": list(self._history),
+                "would_act": list(self._would_act),
+            }

@@ -1,0 +1,153 @@
+"""Fleet signal snapshot: everything the policy reads, in one struct.
+
+The controller is deliberately *pull*-shaped: each reconcile round takes
+one immutable :class:`FleetSignals` snapshot and decides from it alone,
+so a decision is always attributable to a concrete, journalable signal
+state (the acceptance criterion: every action's span carries the alert/
+signal that caused it).
+
+Sources:
+
+- **SLO state + alert edges** — ``telemetry/slo.py``'s registry; edges
+  (fire/clear transitions) rather than level state, so the policy can
+  react to a fire exactly once and the journal names the alert.
+- **critical-path dominant segment** — the collector's retained traces:
+  *where* the request time goes steers *which* actuator helps (score
+  fan-out dominant → shard scale-up; decode/admission dominant →
+  re-role).
+- **handoff residency/starvation stats** — the coordinator's traffic-mix
+  EMA + transfer-pressure counters name the starved side.
+- **what-if capacity table** — PR 12's working-set plane; journaled with
+  scale decisions so capacity actions are auditable against the MRC.
+- **topology** — current shard membership and pod→role map (what the
+  actions mutate; also how a restarted controller verifies in-flight
+  actions against reality).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class FleetSignals:
+    """One reconcile round's immutable input snapshot."""
+
+    ts: float = 0.0
+    # slo name -> {"severity": None|fast_burn|slow_burn, "burn_slow": x}
+    slo: Dict[str, dict] = field(default_factory=dict)
+    # New alert edges since the previous round:
+    # {"slo", "severity", "edge": fire|clear, "ts", "seq"}
+    alert_edges: Tuple[dict, ...] = ()
+    # Dominant critical-path segment across retained traces
+    # ({"name", "process", "self_time_s"}) or {}.
+    dominant_segment: dict = field(default_factory=dict)
+    # Handoff coordinator starvation/residency stats (see
+    # offload.handoff.HandoffCoordinator.starvation()).
+    handoff: dict = field(default_factory=dict)
+    # PR 12 what-if capacity rows ({"factor", "est_hit_ratio", ...}).
+    whatif: Tuple[dict, ...] = ()
+    # Topology.
+    shards: Tuple[str, ...] = ()
+    roles: Dict[str, str] = field(default_factory=dict)
+
+    def burn(self, slo_name: str) -> float:
+        return float((self.slo.get(slo_name) or {}).get("burn_slow", 0.0))
+
+    def severity(self, slo_name: str) -> Optional[str]:
+        sev = (self.slo.get(slo_name) or {}).get("severity")
+        return str(sev) if sev else None
+
+    def firing(self, slo_name: str) -> bool:
+        return self.severity(slo_name) is not None
+
+    def pods_with_role(self, role: str) -> List[str]:
+        return sorted(p for p, r in self.roles.items() if r == role)
+
+    def describe(self) -> dict:
+        """Compact JSON-able summary (journal/span payloads)."""
+        return {
+            "ts": self.ts,
+            "slo": {
+                name: {"severity": st.get("severity"),
+                       "burn_slow": round(float(st.get("burn_slow", 0.0)), 3)}
+                for name, st in self.slo.items()
+            },
+            "alert_edges": list(self.alert_edges),
+            "dominant_segment": dict(self.dominant_segment),
+            "handoff": dict(self.handoff),
+            "shards": list(self.shards),
+            "roles": dict(self.roles),
+        }
+
+
+class CollectorSignalSource:
+    """In-process signal source: a live :class:`TelemetryCollector` plus
+    topology/handoff hooks (the bench and single-process deployments; the
+    HTTP counterpart lives in ``services/fleet_controller.py``)."""
+
+    def __init__(
+        self,
+        collector=None,
+        slo_registry=None,
+        handoff=None,
+        shards: Optional[Callable[[], List[str]]] = None,
+        roles: Optional[Callable[[], Dict[str, str]]] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        if collector is None and slo_registry is None:
+            raise ValueError(
+                "CollectorSignalSource needs a collector or an SLO registry")
+        self._collector = collector
+        self._slos = slo_registry if slo_registry is not None else collector.slos
+        self._handoff = handoff
+        self._shards = shards or (lambda: [])
+        self._roles = roles or (lambda: {})
+        self._clock = clock
+        self._edge_cursor = -1
+
+    def poll(self) -> FleetSignals:
+        slo_state: Dict[str, dict] = {}
+        for name, tracker in self._slos.trackers.items():
+            cfg = tracker.config
+            slo_state[name] = {
+                "severity": tracker.alert_severity,
+                "burn_slow": tracker.burn_rate(cfg.slow_window),
+            }
+        edges_payload = self._slos.export_edges_since(self._edge_cursor)
+        self._edge_cursor = int(edges_payload.get("next_seq",
+                                                  self._edge_cursor))
+        dominant: dict = {}
+        whatif: Tuple[dict, ...] = ()
+        if self._collector is not None:
+            best = 0.0
+            for summary in self._collector.assembler.retained():
+                for seg in summary.get("critical_path") or ():
+                    if seg.get("self_time_s", 0.0) > best:
+                        best = seg["self_time_s"]
+                        dominant = {
+                            "name": seg.get("name"),
+                            "process": seg.get("process"),
+                            "self_time_s": seg.get("self_time_s"),
+                            "trace_id": summary.get("trace_id"),
+                        }
+            try:
+                whatif = tuple(
+                    self._collector.workingset_view().get("whatif") or ())
+            except Exception:  # enrichment, never round-fatal  # lint: allow-swallow
+                whatif = ()
+        handoff = {}
+        if self._handoff is not None:
+            handoff = self._handoff.starvation()
+        return FleetSignals(
+            ts=self._clock(),
+            slo=slo_state,
+            alert_edges=tuple(edges_payload.get("edges") or ()),
+            dominant_segment=dominant,
+            handoff=handoff,
+            whatif=whatif,
+            shards=tuple(self._shards()),
+            roles=dict(self._roles()),
+        )

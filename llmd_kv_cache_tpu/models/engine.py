@@ -1049,6 +1049,33 @@ class MiniEngine:
         """
         self.handoff = coordinator
 
+    def set_role(self, role: str) -> str:
+        """Re-role a running engine (the fleet controller's
+        prefill↔decode flip); returns the previous role.
+
+        Same invariants as construction: a non-"both" role needs a
+        non-hybrid model and an offload spec. The flip affects requests
+        admitted *after* it — in-flight requests finish under the role
+        they were admitted with (their handoff state machine is already
+        chosen), which is exactly the drain semantics the controller
+        wants.
+        """
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"unknown engine role {role!r} "
+                "(expected 'both', 'prefill', or 'decode')")
+        if role != "both" and self.hybrid:
+            raise ValueError(
+                "prefill/decode disaggregation needs a non-hybrid model "
+                "(hybrid restores are all-or-nothing, not chunk-granular)")
+        if role != "both" and self.offload_manager is None:
+            raise ValueError(
+                f"role={role!r} needs an offload spec — the handoff moves "
+                "KV through the shared transfer tier")
+        old = self.cfg.role
+        self.cfg = dataclasses.replace(self.cfg, role=role)
+        return old
+
     def attach_workingset(self, tracker) -> None:
         """Wire a telemetry.workingset.WorkingSetTracker into this
         engine's cache paths: admission feeds the "hbm" reuse stream

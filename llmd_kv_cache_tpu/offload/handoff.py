@@ -87,6 +87,13 @@ class HandoffCoordinator:
         self.completed = 0
         self.failed = 0
         self.last_latency_s: Optional[float] = None
+        # Traffic-mix EMA (prefill-token fraction) + per-outcome counters:
+        # the fleet controller's starvation signal. ``mix_alpha`` is the
+        # EMA weight of one observation batch.
+        self.mix_alpha = 0.2
+        self._mix_fraction: Optional[float] = None
+        self._mix_samples = 0
+        self._outcomes: dict[str, int] = {}
 
     # -- pair picking ----------------------------------------------------
 
@@ -284,6 +291,8 @@ class HandoffCoordinator:
             self.completed += 1
         else:
             self.failed += 1
+        with self._mu:
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
         try:
             from ..metrics.collector import record_handoff_request
 
@@ -304,6 +313,64 @@ class HandoffCoordinator:
         if self.residency is not None:
             self.residency.release_pod_claims(st.decode_pod)
         self._update_gauges()
+
+    # -- traffic mix / starvation ----------------------------------------
+
+    def observe_mix(self, prefill_tokens: int, decode_tokens: int) -> None:
+        """Fold one batch's prefill/decode token split into the mix EMA.
+
+        The router (or engine service) calls this per admitted request or
+        per batch; the EMA'd prefill fraction is what the fleet controller
+        compares against the provisioned role split to spot a starved
+        side.
+        """
+        total = max(prefill_tokens, 0) + max(decode_tokens, 0)
+        if total <= 0:
+            return
+        frac = max(prefill_tokens, 0) / total
+        with self._mu:
+            if self._mix_fraction is None:
+                self._mix_fraction = frac
+            else:
+                self._mix_fraction += self.mix_alpha * (frac - self._mix_fraction)
+            self._mix_samples += 1
+
+    def starvation(self) -> dict:
+        """Residency/starvation view for the fleet controller + kvdiag.
+
+        ``starved_side`` is a *hint* from transfer pressure alone:
+        ``timeout``/``fallback`` outcomes mean decode pods gave up waiting
+        on prefill output (prefill capacity starved); a deep transfer
+        queue with healthy outcomes means decode pods are not draining
+        restores (decode starved). The controller combines this with the
+        mix-vs-provisioned imbalance before acting.
+        """
+        with self._mu:
+            active = [st for st in self._states.values() if not st.done]
+            in_flight = sum(st.in_flight_jobs for st in self._states.values())
+            outcomes = dict(self._outcomes)
+            mix = self._mix_fraction
+            samples = self._mix_samples
+        gave_up = outcomes.get("timeout", 0) + outcomes.get("fallback", 0) \
+            + outcomes.get("failed", 0)
+        settled = gave_up + outcomes.get("complete", 0)
+        starved_side = None
+        if settled and gave_up / settled > 0.1:
+            starved_side = ROLE_PREFILL
+        elif len(active) > 2 * max(in_flight, 1):
+            starved_side = ROLE_DECODE
+        return {
+            "mix": {
+                "prefill_fraction": None if mix is None else round(mix, 4),
+                "samples": samples,
+                "alpha": self.mix_alpha,
+            },
+            "outcomes": outcomes,
+            "transfer_queue_depth": len(active),
+            "in_flight_jobs": in_flight,
+            "last_handoff_latency_s": self.last_latency_s,
+            "starved_side": starved_side,
+        }
 
     # -- introspection ---------------------------------------------------
 
@@ -331,6 +398,7 @@ class HandoffCoordinator:
             "completed": self.completed,
             "failed": self.failed,
             "last_handoff_latency_s": self.last_latency_s,
+            "starvation": self.starvation(),
         }
 
     # -- internals -------------------------------------------------------

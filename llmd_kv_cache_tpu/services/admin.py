@@ -27,6 +27,14 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
 - ``/debug/pyprof/capture?seconds=N`` — on-demand burst capture on the
   sampling profiler, next to the jax ``/debug/profile`` endpoint (one at
   a time → 409; 404 until :meth:`AdminServer.register_pyprof_capture`).
+- ``/debug/slo?since=SEQ`` — SLO alert fire/clear **edge history** from
+  the registry, same cursor semantics as ``/debug/spans`` (404 until
+  :meth:`AdminServer.register_slo_source`; without ``since`` it falls
+  through to a plain registered ``slo`` level-state provider). The fleet
+  controller consumes this to react to each alert transition once.
+- ``POST /debug/<name>`` — guarded mutation endpoints (e.g. ``role``,
+  ``drain``): 404 until the owner registers a handler via
+  :meth:`AdminServer.register_action`; parameters ride the query string.
 
 ``/metrics?format=openmetrics`` switches the exposition to OpenMetrics,
 the only text format that renders exemplars (trace-id links on
@@ -79,6 +87,8 @@ class AdminServer:
         self._pyprof_source: Optional[Callable[[int], dict]] = None
         self._pyprof_capture: Optional[Callable[[float], dict]] = None
         self._workingset_source: Optional[Callable[[int], dict]] = None
+        self._slo_source: Optional[Callable[[int], dict]] = None
+        self._actions: dict[str, Callable[[Mapping[str, str]], dict]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -117,6 +127,26 @@ class AdminServer:
         registered — workingset is opt-in per pod
         (``fleetTelemetry.workingset``)."""
         self._workingset_source = source
+
+    def register_slo_source(self, source: Callable[[int], dict]) -> None:
+        """Enable ``/debug/slo?since=``: ``source(since_seq)`` returns the
+        SLO registry's ``export_edges_since`` payload (alert fire/clear
+        edges + cursor + drops), same cursor semantics as
+        ``/debug/spans``. Without a query string the endpoint still falls
+        through to a registered plain ``slo`` provider (level state), so
+        existing consumers keep working."""
+        self._slo_source = source
+
+    def register_action(
+            self, name: str,
+            handler: Callable[[Mapping[str, str]], dict]) -> None:
+        """Enable ``POST /debug/<name>``: ``handler(params)`` receives the
+        flattened query parameters and returns a JSON-serializable result.
+        POST endpoints are guarded the same way as the profiler: 404 until
+        the owning service explicitly registers a handler, so an
+        unconfigured pod cannot be mutated over HTTP. ``ValueError`` from
+        the handler maps to 400 (bad request), anything else to 500."""
+        self._actions[name] = handler
 
     def register_pyprof_capture(self, capture: Callable[[float], dict]) -> None:
         """Enable ``/debug/pyprof/capture``: ``capture(seconds)`` runs a
@@ -198,6 +228,23 @@ class AdminServer:
                 {"error": f"bad since: {raw!r}"}).encode(), "application/json")
         try:
             payload = self._workingset_source(since)
+        except Exception as exc:
+            return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(payload, default=repr).encode(),
+                "application/json")
+
+    def _handle_slo(self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._slo_source is None:
+            return (404, b'{"error": "slo edge export not configured"}',
+                    "application/json")
+        raw = query.get("since", ["-1"])[-1]
+        try:
+            since = int(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad since: {raw!r}"}).encode(), "application/json")
+        try:
+            payload = self._slo_source(since)
         except Exception as exc:
             return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
         return (200, json.dumps(payload, default=repr).encode(),
@@ -305,6 +352,13 @@ class AdminServer:
                     self._workingset_source is not None
                     or "workingset" not in self._providers):
                 return self._handle_workingset(query or {})
+            # /debug/slo serves two shapes: with ?since= (or with no plain
+            # "slo" provider) the edge-history cursor payload; otherwise it
+            # falls through to the registered level-state provider, so
+            # pre-cursor consumers keep working.
+            if path == "/debug/slo" and self._slo_source is not None and (
+                    "since" in (query or {}) or "slo" not in self._providers):
+                return self._handle_slo(query or {})
             if path == "/debug/flight-recorder":
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"
@@ -322,6 +376,25 @@ class AdminServer:
                     return 200, body.encode("utf-8"), "application/json"
         return 404, b'{"error": "not found"}', "application/json"
 
+    def _handle_post(self, path: str,
+                     query: Optional[Mapping[str, list]] = None) -> tuple[int, bytes, str]:
+        """Route one POST; only registered /debug/<name> actions exist."""
+        if self._expose_debug and path.startswith("/debug/"):
+            handler = self._actions.get(path[len("/debug/"):])
+            if handler is not None:
+                params = {k: v[-1] for k, v in (query or {}).items()}
+                try:
+                    payload = handler(params)
+                except ValueError as exc:
+                    return (400, json.dumps({"error": str(exc)}).encode(),
+                            "application/json")
+                except Exception as exc:
+                    return (500, json.dumps({"error": str(exc)}).encode(),
+                            "application/json")
+                return (200, json.dumps(payload, default=repr).encode(),
+                        "application/json")
+        return 404, b'{"error": "not found"}', "application/json"
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> int:
@@ -337,6 +410,26 @@ class AdminServer:
                     status, body, ctype = outer._handle(
                         path, parse_qs(raw_query))
                 except Exception as exc:  # a broken provider must not kill the server
+                    status = 500
+                    body = json.dumps({"error": str(exc)}).encode("utf-8")
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                try:
+                    # Drain any request body so keep-alive stays coherent;
+                    # action parameters travel in the query string.
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length > 0:
+                        self.rfile.read(length)
+                    path, _, raw_query = self.path.partition("?")
+                    status, body, ctype = outer._handle_post(
+                        path, parse_qs(raw_query))
+                except Exception as exc:  # a broken handler must not kill the server
                     status = 500
                     body = json.dumps({"error": str(exc)}).encode("utf-8")
                     ctype = "application/json"

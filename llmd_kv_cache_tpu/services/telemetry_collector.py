@@ -901,6 +901,10 @@ class TelemetryCollector:
             self._admin.register_debug(
                 "traces", self.assembler.debug_view)
             self._admin.register_debug("slo", self.slos.debug_view)
+            # /debug/slo?since= additionally serves the alert edge
+            # history (cursor semantics of /debug/spans); plain GETs keep
+            # the level-state provider above.
+            self._admin.register_slo_source(self.slos.export_edges_since)
             self._admin.register_debug("rollup", self.rollup_view)
             self._admin.register_debug("fleet", self.debug_view)
             self._admin.register_debug("pyprof", self.profile_view)

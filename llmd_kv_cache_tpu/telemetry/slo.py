@@ -89,6 +89,7 @@ class SLOTracker:
         self,
         config: SLOConfig,
         clock: Callable[[], float] = time.monotonic,
+        on_edge: Optional[Callable[[dict], None]] = None,
     ):
         self.config = config
         self._clock = clock
@@ -96,6 +97,10 @@ class SLOTracker:
         # (ts, good, bad) event-count samples, pruned past the slow window.
         self._samples: deque = deque()
         self._alert = _AlertState()
+        # Called once per alert transition (fire/clear/severity change)
+        # with an edge record — the registry's edge history feed, and the
+        # fleet controller's trigger.
+        self._on_edge = on_edge
 
     # -- ingestion ---------------------------------------------------------
 
@@ -154,6 +159,7 @@ class SLOTracker:
             severity = "fast_burn"
         elif burns["slow"] >= cfg.slow_threshold:
             severity = "slow_burn"
+        edge: Optional[dict] = None
         with self._lock:
             prev = self._alert.severity
             if severity != prev:
@@ -164,6 +170,17 @@ class SLOTracker:
                 self._alert.severity = severity
                 if severity is None:
                     self._alert.fired_at = None
+                edge = {
+                    "ts": self._clock(),
+                    "slo": cfg.name,
+                    "edge": "fire" if severity is not None else "clear",
+                    "severity": severity if severity is not None else prev,
+                    "prev_severity": prev,
+                    "burns": {k: round(v, 3) for k, v in burns.items()},
+                }
+        if edge is not None and self._on_edge is not None:
+            # Outside the lock: the sink may re-enter tracker readbacks.
+            self._on_edge(edge)
         for sev in ("fast_burn", "slow_burn"):
             SLO_ALERT_ACTIVE.labels(cfg.name, sev).set(1.0 if severity == sev else 0.0)
         SLO_BURN_RATE.labels(cfg.name, f"{int(short)}s").set(burns["short"])
@@ -227,13 +244,28 @@ class SLOTracker:
 
 @dataclass
 class SLORegistry:
-    """The collector's set of trackers, evaluated as one unit."""
+    """The collector's set of trackers, evaluated as one unit.
+
+    Besides level state (:meth:`debug_view`), the registry keeps a
+    bounded, seq-stamped **edge history** of alert transitions so remote
+    consumers — the fleet controller, ``/debug/slo?since=`` pullers —
+    can react to each fire/clear exactly once, with the same cursor
+    semantics as ``/debug/spans`` (``seq > since``; ``next_seq`` is the
+    last stamped seq; ring-bounded with a drop counter).
+    """
 
     clock: Callable[[], float] = time.monotonic
     trackers: Dict[str, SLOTracker] = field(default_factory=dict)
+    max_edges: int = 512
+    _edges: deque = field(default_factory=deque, repr=False)
+    _edge_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False)
+    _edge_seq: int = field(default=0, repr=False)
+    edges_dropped: int = 0
 
     def add(self, config: SLOConfig) -> SLOTracker:
-        tracker = SLOTracker(config, clock=self.clock)
+        tracker = SLOTracker(
+            config, clock=self.clock, on_edge=self._record_edge)
         self.trackers[config.name] = tracker
         return tracker
 
@@ -245,3 +277,26 @@ class SLORegistry:
 
     def debug_view(self) -> dict:
         return {name: t.debug_view() for name, t in self.trackers.items()}
+
+    # -- alert edge history ------------------------------------------------
+
+    def _record_edge(self, edge: dict) -> None:
+        with self._edge_lock:
+            edge = dict(edge)
+            edge["seq"] = self._edge_seq
+            self._edge_seq += 1
+            self._edges.append(edge)
+            while len(self._edges) > self.max_edges:
+                self._edges.popleft()
+                self.edges_dropped += 1
+
+    def export_edges_since(self, since: int = -1) -> dict:
+        """Alert edges with ``seq > since`` plus the resume cursor
+        (``/debug/slo?since=`` payload; non-destructive, per-puller)."""
+        with self._edge_lock:
+            edges = [dict(e) for e in self._edges if e["seq"] > since]
+            return {
+                "edges": edges,
+                "next_seq": self._edge_seq - 1,
+                "dropped": self.edges_dropped,
+            }

@@ -308,6 +308,7 @@ class TestPerfSentinel:
             manifest = json.load(f)
         assert "pyprof-overhead" in manifest["benches"]
         assert "workingset" in manifest["benches"]
+        assert "controller" in manifest["benches"]
         sentinel = self._sentinel()
         nominal = {
             "pyprof-overhead": {
@@ -316,9 +317,13 @@ class TestPerfSentinel:
             "workingset": {
                 "metric": "workingset_overhead_pct", "value": 0.4,
                 "unit": "% of score p50", "vs_baseline": 1.0},
+            "controller": {
+                "metric": "flap_executed_actions", "value": 1,
+                "unit": "actions", "vs_baseline": 1.0},
         }
         _, failed = sentinel.evaluate(manifest, nominal)
         assert failed == 0
-        _, failed = sentinel.evaluate(
-            manifest, {"pyprof-overhead": nominal["pyprof-overhead"]})
+        missing_one = dict(nominal)
+        del missing_one["workingset"]
+        _, failed = sentinel.evaluate(manifest, missing_one)
         assert failed == 1  # workingset bench result went missing

@@ -119,6 +119,55 @@ class TestHashRingMovement:
         with pytest.raises(ValueError):
             moved_partitions(old, HashRing(["a", "b"], partitions=256))
 
+    @pytest.mark.parametrize("n", [3, 4, 8])
+    def test_back_to_back_join_then_leave_round_trips(self, n):
+        """The fleet controller's scale-up-then-scale-down sequence: a
+        join immediately followed by the symmetric leave must return to
+        the byte-identical assignment (membership fully determines the
+        table), and each hop must respect the 2/N movement ceiling."""
+        shards = [f"shard-{i}" for i in range(n)]
+        base = HashRing(shards)
+        grown = HashRing(shards + [f"shard-{n}"])
+        shrunk = HashRing(shards)
+        assert assignment_fingerprint(shrunk) == assignment_fingerprint(base)
+        assert moved_partitions(base, shrunk) == 0
+        for old, new in ((base, grown), (grown, shrunk)):
+            frac = moved_partitions(old, new) / base.partitions
+            assert frac < 2 / min(len(old.shards), len(new.shards))
+
+    def test_back_to_back_join_and_leave_composes(self):
+        """Controller replacing a shard (join new, drain+leave old in the
+        same reconcile window): the composed movement never exceeds the
+        sum of the per-hop movements, and only partitions whose owner
+        changed end-to-end count against the composed cost."""
+        base = HashRing(["s0", "s1", "s2", "s3"])
+        joined = HashRing(["s0", "s1", "s2", "s3", "s4"])
+        replaced = HashRing(["s0", "s1", "s2", "s4"])  # s3 left
+        hop1 = moved_partitions(base, joined)
+        hop2 = moved_partitions(joined, replaced)
+        composed = moved_partitions(base, replaced)
+        assert composed <= hop1 + hop2
+        # s3's entire share must move; s4 absorbs about one share.
+        assert composed >= base.load()["s3"]
+
+    def test_plan_owners_tracks_membership_across_join_leave(self):
+        """The router's fan-out plan under the controller's membership
+        churn: plans differ only where ownership actually moved, and a
+        leave never routes a key to the departed shard."""
+        keys = sample_keys(400)
+        base = HashRing(["s0", "s1", "s2"])
+        grown = HashRing(["s0", "s1", "s2", "s3"])
+        shrunk = HashRing(["s0", "s1", "s2"])
+        plan_base = plan_owners(base, keys)
+        plan_grown = plan_owners(grown, keys)
+        plan_shrunk = plan_owners(shrunk, keys)
+        assert plan_shrunk == plan_base  # leave undoes the join exactly
+        changed = sum(1 for a, b in zip(plan_base, plan_grown) if a != b)
+        assert 0 < changed / len(keys) < 2 / 3
+        # Every reassigned key landed on the joiner, nobody else shuffled.
+        assert {b for a, b in zip(plan_base, plan_grown) if a != b} == {"s3"}
+        assert "s3" not in plan_shrunk
+
 
 class TestHashRingDeterminism:
     def test_same_membership_same_fingerprint(self):

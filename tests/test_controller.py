@@ -1,0 +1,792 @@
+"""Self-driving fleet controller (control/): sense → decide → act.
+
+Covers the anti-flap policy primitives (hysteresis bands, cooldowns),
+the crash-tolerant action journal (framed records, torn-tail replay,
+in-flight resolution), the reconcile loop's contracts (journal write
+ordering, global budget, dry-run, action spans carrying the causing
+signal), warm restart (never repeat, never reverse an in-flight
+action), the SLO alert-edge cursor feed, the guarded admin-plane POST
+endpoints the actuator drives, and the kvdiag ``controller`` section.
+"""
+
+import importlib.util
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from llmd_kv_cache_tpu.control import (
+    ACTION_ADD_SHARD,
+    ACTION_DRAIN_POD,
+    ACTION_REMOVE_SHARD,
+    ACTION_SET_ROLE,
+    Action,
+    ActionJournal,
+    ActionRecord,
+    AdminPlaneActuator,
+    ControllerConfig,
+    ControlPolicy,
+    Cooldown,
+    FleetController,
+    FleetSignals,
+    Hysteresis,
+    InProcessActuator,
+    last_settlement_ts,
+    next_shard_name,
+    unresolved_actions,
+)
+from llmd_kv_cache_tpu.control.journal import (
+    PHASE_EXECUTED,
+    PHASE_FAILED,
+    PHASE_PLANNED,
+    PHASE_WOULD_ACT,
+)
+from llmd_kv_cache_tpu.telemetry import recording_tracing
+from llmd_kv_cache_tpu.telemetry.slo import SLOConfig, SLORegistry
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def signals(shards=("shard-0",), roles=None, burn=0.0, severity=None,
+            mix=None, ts=0.0, edges=()):
+    slo = {"score_latency": {"severity": severity, "burn_slow": burn}}
+    handoff = {}
+    if mix is not None:
+        handoff["mix"] = {"prefill_fraction": mix, "samples": 100}
+    return FleetSignals(ts=ts, slo=slo, alert_edges=tuple(edges),
+                        handoff=handoff, shards=tuple(shards),
+                        roles=dict(roles or {}))
+
+
+class QueueSource:
+    """Signal source returning a queued snapshot per poll (last repeats)."""
+
+    def __init__(self, *snapshots):
+        self.snapshots = list(snapshots)
+
+    def poll(self):
+        if len(self.snapshots) > 1:
+            return self.snapshots.pop(0)
+        return self.snapshots[0]
+
+
+# -- policy primitives --------------------------------------------------------
+
+
+class TestHysteresis:
+    def test_fires_once_after_confirm_rounds(self):
+        h = Hysteresis(act=1.0, rearm=0.25, confirm_rounds=2)
+        assert h.update(1.5) is False  # round 1 of 2
+        assert h.update(1.5) is True  # confirmed
+        # Disarmed: staying above act cannot re-fire.
+        assert not any(h.update(2.0) for _ in range(10))
+
+    def test_oscillation_around_act_never_refires(self):
+        """The no-flap core: a value bouncing across the act band (but
+        never reaching the re-arm band) produces exactly one trigger."""
+        h = Hysteresis(act=1.0, rearm=0.25, confirm_rounds=1)
+        fires = sum(h.update(v) for v in [1.5, 0.8, 1.5, 0.8] * 10)
+        assert fires == 1
+
+    def test_rearm_then_fire_again(self):
+        h = Hysteresis(act=1.0, rearm=0.25, confirm_rounds=1)
+        assert h.update(1.2) is True
+        assert h.update(0.5) is False  # above rearm: still disarmed
+        assert h.update(1.2) is False
+        assert h.update(0.1) is False  # re-arms
+        assert h.update(1.2) is True
+
+    def test_blip_resets_confirm_streak(self):
+        h = Hysteresis(act=1.0, rearm=0.25, confirm_rounds=3)
+        assert h.update(1.5) is False
+        assert h.update(1.5) is False
+        assert h.update(0.9) is False  # streak broken
+        assert h.update(1.5) is False
+        assert h.update(1.5) is False
+        assert h.update(1.5) is True
+
+    def test_below_direction_mirrors(self):
+        h = Hysteresis(act=0.25, rearm=1.0, confirm_rounds=1,
+                       direction="below")
+        assert h.update(0.5) is False
+        assert h.update(0.2) is True
+        assert h.update(0.1) is False  # disarmed
+        assert h.update(1.5) is False  # re-arms at/over rearm
+        assert h.update(0.2) is True
+
+    def test_band_validation(self):
+        with pytest.raises(ValueError):
+            Hysteresis(act=1.0, rearm=2.0)  # above needs rearm <= act
+        with pytest.raises(ValueError):
+            Hysteresis(act=1.0, rearm=0.5, direction="below")
+        with pytest.raises(ValueError):
+            Hysteresis(act=1.0, rearm=0.5, direction="sideways")
+
+
+class TestCooldown:
+    def test_ready_until_stamped_then_waits_period(self):
+        clock = FakeClock(100.0)
+        cd = Cooldown(60.0, clock)
+        assert cd.ready()
+        cd.stamp()
+        assert not cd.ready()
+        assert cd.remaining() == pytest.approx(60.0)
+        clock.now = 159.9
+        assert not cd.ready()
+        clock.now = 160.0
+        assert cd.ready()
+
+    def test_stamp_takes_max_of_existing_and_new(self):
+        """Journal replay stamps out of order; an older record must not
+        shorten a cooldown a newer record already set."""
+        clock = FakeClock(100.0)
+        cd = Cooldown(60.0, clock)
+        cd.stamp(ts=90.0)
+        cd.stamp(ts=50.0)  # older: ignored
+        assert cd.remaining() == pytest.approx(50.0)
+
+
+class TestNextShardName:
+    def test_numeric_suffix_max_plus_one(self):
+        assert next_shard_name(["shard-0", "shard-2"]) == "shard-3"
+        assert next_shard_name(["a-7", "b-1"]) == "shard-8"
+        assert next_shard_name(["alpha", "beta"]) == "shard-2"
+
+
+# -- decision policy ----------------------------------------------------------
+
+
+def make_policy(clock=None, **overrides):
+    defaults = dict(confirm_rounds=1, shard_cooldown_s=60.0,
+                    role_cooldown_s=60.0, drain_cooldown_s=60.0)
+    defaults.update(overrides)
+    cfg = ControllerConfig(**defaults)
+    return ControlPolicy(cfg, clock or FakeClock()), cfg
+
+
+class TestControlPolicy:
+    def test_scale_up_on_burn_with_causing_signal(self):
+        policy, cfg = make_policy()
+        out = policy.decide(signals(burn=2.0, shards=("shard-0",)))
+        assert [a.kind for a in out] == [ACTION_ADD_SHARD]
+        assert out[0].target == "shard-1"
+        assert out[0].signal["slo"] == "score_latency"
+        assert out[0].signal["burn_slow"] == 2.0
+        assert "score_latency" in out[0].reason
+
+    def test_firing_alert_counts_as_saturated_burn(self):
+        policy, _ = make_policy()
+        out = policy.decide(signals(burn=0.0, severity="fast_burn"))
+        assert [a.kind for a in out] == [ACTION_ADD_SHARD]
+
+    def test_scale_up_respects_max_shards_and_cooldown(self):
+        clock = FakeClock()
+        policy, _ = make_policy(clock, max_shards=2)
+        assert policy.decide(signals(burn=2.0, shards=("s-0", "s-1"))) == []
+        policy2, _ = make_policy(clock)
+        assert policy2.decide(signals(burn=2.0))  # fires, stamps cooldown
+        # Re-arm then burn again inside the cooldown window: suppressed.
+        policy2.decide(signals(burn=0.0))
+        assert policy2.decide(signals(burn=2.0)) == []
+
+    def test_scale_down_drains_before_removing(self):
+        clock = FakeClock()
+        policy, cfg = make_policy(clock, confirm_rounds=2)
+        shards = ("shard-0", "shard-1", "shard-2")
+        # The below-band trigger needs max(confirm_rounds, 2) quiet rounds.
+        assert policy.decide(signals(burn=0.1, shards=shards)) == []
+        out = policy.decide(signals(burn=0.1, shards=shards))
+        assert [a.kind for a in out] == [ACTION_DRAIN_POD,
+                                         ACTION_REMOVE_SHARD]
+        assert out[0].target == out[1].target == "shard-2"
+        assert out[0].params["deadline_s"] == cfg.drain_deadline_s
+
+    def test_scale_down_blocked_while_alert_fires(self):
+        """A low slow-window burn with the alert still firing means the
+        fast window is screaming: the policy must never shrink (the
+        firing alert even counts as a saturated scale-up signal)."""
+        policy, _ = make_policy(confirm_rounds=2)
+        shards = ("shard-0", "shard-1")
+        kinds = []
+        for _ in range(4):
+            low_but_firing = signals(burn=0.1, severity="fast_burn",
+                                     shards=shards)
+            kinds += [a.kind for a in policy.decide(low_but_firing)]
+        assert ACTION_REMOVE_SHARD not in kinds
+        assert ACTION_DRAIN_POD not in kinds
+
+    def test_scale_down_respects_min_shards(self):
+        policy, _ = make_policy(confirm_rounds=2, min_shards=1)
+        for _ in range(4):
+            assert policy.decide(signals(burn=0.0, shards=("s-0",))) == []
+
+    def test_reroles_decode_donor_when_prefill_starved(self):
+        policy, _ = make_policy()
+        roles = {"p-0": "prefill", "d-0": "decode", "d-1": "decode"}
+        # offered 0.85 vs provisioned 1/3: imbalance +0.52 > act 0.20.
+        out = policy.decide(signals(mix=0.85, roles=roles))
+        assert [a.kind for a in out] == [ACTION_SET_ROLE]
+        assert out[0].target == "d-1"  # last sorted decode pod donates
+        assert out[0].params == {"role": "prefill"}
+        assert out[0].signal["imbalance"] == pytest.approx(0.517, abs=1e-3)
+
+    def test_reroles_prefill_donor_when_decode_starved(self):
+        policy, _ = make_policy()
+        roles = {"p-0": "prefill", "p-1": "prefill", "d-0": "decode"}
+        out = policy.decide(signals(mix=0.1, roles=roles))
+        assert [(a.kind, a.target) for a in out] == [(ACTION_SET_ROLE, "p-1")]
+        assert out[0].params == {"role": "decode"}
+
+    def test_rerole_respects_min_pods(self):
+        policy, _ = make_policy(min_decode_pods=1)
+        roles = {"p-0": "prefill", "d-0": "decode"}
+        assert policy.decide(signals(mix=0.95, roles=roles)) == []
+
+    def test_no_mix_signal_is_a_safe_noop(self):
+        policy, _ = make_policy()
+        assert policy.decide(
+            signals(roles={"p-0": "prefill", "d-0": "decode"})) == []
+
+
+# -- the action journal -------------------------------------------------------
+
+
+def make_record(action_id="add_shard:shard-1:1", phase=PHASE_PLANNED,
+                kind=ACTION_ADD_SHARD, target="shard-1", ts=10.0, **kw):
+    return ActionRecord(action_id=action_id, seq=0, ts=ts, phase=phase,
+                        kind=kind, target=target, **kw)
+
+
+class TestActionJournal:
+    def test_append_replay_round_trip(self, tmp_path):
+        path = str(tmp_path / "actions.journal")
+        j = ActionJournal(path)
+        j.append(make_record(signal={"slo": "score_latency", "burn": 2.0},
+                             params={"bootstrap": "snapshot"}))
+        j.append(make_record(phase=PHASE_EXECUTED,
+                             result={"ok": True}))
+        j.close()
+        back = list(ActionJournal(path).replay())
+        assert [r.seq for r in back] == [1, 2]
+        assert back[0].signal == {"slo": "score_latency", "burn": 2.0}
+        assert back[0].params == {"bootstrap": "snapshot"}
+        assert back[1].result == {"ok": True}
+
+    def test_seq_resumes_past_existing_records(self, tmp_path):
+        path = str(tmp_path / "actions.journal")
+        j = ActionJournal(path)
+        j.append(make_record())
+        j.close()
+        j2 = ActionJournal(path)
+        rec = j2.append(make_record())
+        assert rec.seq == 2
+        j2.close()
+
+    def test_torn_tail_stops_replay_cleanly(self, tmp_path):
+        path = str(tmp_path / "actions.journal")
+        j = ActionJournal(path)
+        j.append(make_record())
+        j.append(make_record(phase=PHASE_EXECUTED))
+        j.close()
+        with open(path, "ab") as f:
+            f.write(b"\x40\x00\x00\x00partial")  # length says 64, body short
+        assert len(list(ActionJournal(path).replay())) == 2
+
+    def test_corrupt_crc_stops_replay(self, tmp_path):
+        path = str(tmp_path / "actions.journal")
+        j = ActionJournal(path)
+        j.append(make_record())
+        j.append(make_record(phase=PHASE_EXECUTED))
+        j.close()
+        data = bytearray(Path(path).read_bytes())
+        data[-1] ^= 0xFF  # flip a body byte of the last record
+        Path(path).write_bytes(bytes(data))
+        assert len(list(ActionJournal(path).replay())) == 1
+
+    def test_unresolved_actions_and_settlement(self):
+        records = [
+            make_record("a:1", PHASE_PLANNED, ts=10.0),
+            make_record("a:1", PHASE_EXECUTED, ts=11.0),
+            make_record("b:3", PHASE_PLANNED, kind=ACTION_SET_ROLE,
+                        target="pod-1", ts=12.0),
+            make_record("c:4", PHASE_PLANNED, ts=13.0),
+            make_record("c:4", PHASE_FAILED, ts=14.0),
+            make_record("d:6", PHASE_WOULD_ACT, ts=15.0),
+        ]
+        pending = unresolved_actions(records)
+        assert [r.action_id for r in pending] == ["b:3"]
+        ts = last_settlement_ts(records)
+        assert ts[ACTION_ADD_SHARD] == 13.0  # latest planned/executed
+        assert ts[ACTION_SET_ROLE] == 12.0
+
+
+# -- the reconcile loop -------------------------------------------------------
+
+
+def make_controller(tmp_path=None, clock=None, source=None, dry_run=False,
+                    **overrides):
+    clock = clock or FakeClock()
+    defaults = dict(confirm_rounds=1, shard_cooldown_s=60.0,
+                    role_cooldown_s=60.0, drain_cooldown_s=60.0,
+                    dry_run=dry_run)
+    if tmp_path is not None:
+        defaults["journal_path"] = str(tmp_path / "actions.journal")
+    defaults.update(overrides)
+    cfg = ControllerConfig(**defaults)
+    actuator = InProcessActuator(
+        add_shard=lambda t: {"ok": True, "shard": t},
+        remove_shard=lambda t: {"ok": True},
+        set_role=lambda t, r: {"ok": True, "role": r},
+        drain_pod=lambda t: {"drained": True},
+    )
+    source = source or QueueSource(signals(burn=2.0))
+    return FleetController(source, actuator, config=cfg, clock=clock)
+
+
+class TestFleetController:
+    def test_executes_and_journals_planned_before_executed(self, tmp_path):
+        ctrl = make_controller(tmp_path)
+        summary = ctrl.reconcile_once()
+        assert summary["settled"] == ["add_shard:shard-1:1"]
+        assert ctrl.actuator.applied == [
+            (ACTION_ADD_SHARD, "shard-1", {"bootstrap": "snapshot"})]
+        ctrl.stop()
+        phases = [(r.phase, r.action_id)
+                  for r in ActionJournal(ctrl.cfg.journal_path).replay()]
+        assert phases == [(PHASE_PLANNED, "add_shard:shard-1:1"),
+                          (PHASE_EXECUTED, "add_shard:shard-1:1")]
+
+    def test_budget_defers_excess_actions(self):
+        clock = FakeClock()
+        # Burn + starved mix every round; budget of 1 lets only the first
+        # of the two proposed actions through.
+        src = QueueSource(signals(
+            burn=2.0, mix=0.9,
+            roles={"p-0": "prefill", "d-0": "decode", "d-1": "decode"}))
+        ctrl = make_controller(clock=clock, source=src, action_budget=1,
+                               budget_window_s=600.0)
+        summary = ctrl.reconcile_once()
+        assert len(summary["settled"]) == 1
+        assert summary["budget_deferred"] == 1
+        assert ctrl.budget_deferred == 1
+        # Window slides: capacity returns.
+        clock.now += 601.0
+        assert ctrl._budget_ok()
+
+    def test_dry_run_records_would_act_without_touching_cluster(self):
+        ctrl = make_controller(dry_run=True)
+        summary = ctrl.reconcile_once()
+        assert summary["dry_run"] is True
+        assert ctrl.actuator.applied == []
+        view = ctrl.debug_view()
+        assert view["actions"] == []
+        assert [r["phase"] for r in view["would_act"]] == [PHASE_WOULD_ACT]
+        assert view["would_act"][0]["kind"] == ACTION_ADD_SHARD
+
+    def test_actuator_failure_is_journaled_not_fatal(self, tmp_path):
+        clock = FakeClock()
+        cfg = ControllerConfig(
+            confirm_rounds=1, journal_path=str(tmp_path / "a.journal"))
+        def boom(_):
+            raise ConnectionError("deployment hook down")
+        ctrl = FleetController(
+            QueueSource(signals(burn=2.0)),
+            InProcessActuator(add_shard=boom), config=cfg, clock=clock)
+        ctrl.reconcile_once()
+        ctrl.stop()
+        records = list(ActionJournal(cfg.journal_path).replay())
+        assert [r.phase for r in records] == [PHASE_PLANNED, PHASE_FAILED]
+        assert "ConnectionError" in records[1].result["error"]
+
+    def test_action_span_carries_causing_signal(self):
+        with recording_tracing() as exporter:
+            ctrl = make_controller()
+            ctrl.reconcile_once()
+            assert exporter.find("llm_d.kv_cache.control.reconcile")
+            rec = exporter.find("llm_d.kv_cache.control.action")[0]
+            assert rec.attributes["action_kind"] == ACTION_ADD_SHARD
+            assert rec.attributes["dry_run"] is False
+            signal = json.loads(rec.attributes["signal"])
+            assert signal["slo"] == "score_latency"
+            assert signal["burn_slow"] == 2.0
+
+
+class TestWarmRestart:
+    def test_restart_does_not_repeat_applied_inflight_action(self, tmp_path):
+        """Predecessor journaled `planned add_shard shard-1` and crashed
+        after the actuator ran: the successor sees shard-1 in the ring and
+        settles the record without re-executing."""
+        path = str(tmp_path / "a.journal")
+        j = ActionJournal(path)
+        j.append(make_record("add_shard:shard-1:1", PHASE_PLANNED, ts=10.0))
+        j.close()
+        src = QueueSource(signals(burn=0.0, shards=("shard-0", "shard-1")))
+        ctrl = make_controller(source=src, journal_path=path)
+        assert ctrl.resumed_records == 1
+        assert [r.action_id for r in ctrl._pending] == ["add_shard:shard-1:1"]
+        ctrl.reconcile_once()
+        assert ctrl.actuator.applied == []  # never repeated
+        assert ctrl._pending == []
+        ctrl.stop()
+        records = list(ActionJournal(path).replay())
+        assert records[-1].phase == PHASE_EXECUTED
+        assert records[-1].result["already_applied"] is True
+
+    def test_restart_reexecutes_unapplied_inflight_action(self, tmp_path):
+        """Crash landed between journal append and the actuator: the
+        world does not reflect the action, so the successor re-executes
+        it (exactly once) instead of dropping it."""
+        path = str(tmp_path / "a.journal")
+        j = ActionJournal(path)
+        j.append(make_record(
+            "set_role:d-1:1", PHASE_PLANNED, kind=ACTION_SET_ROLE,
+            target="d-1", params={"role": "prefill"}, ts=10.0))
+        j.close()
+        src = QueueSource(signals(
+            burn=0.0, roles={"p-0": "prefill", "d-1": "decode"}))
+        ctrl = make_controller(source=src, journal_path=path)
+        ctrl.reconcile_once()
+        assert ctrl.actuator.applied == [
+            (ACTION_SET_ROLE, "d-1", {"role": "prefill"})]
+        assert ctrl._pending == []
+        ctrl.stop()
+
+    def test_restart_restores_cooldowns_so_no_reversal(self, tmp_path):
+        """An executed re-role must keep its cooldown across restart:
+        the successor seeing the (now inverted) imbalance cannot
+        immediately flip the pod back."""
+        clock = FakeClock(1000.0)
+        path = str(tmp_path / "a.journal")
+        src1 = QueueSource(signals(
+            mix=0.9, roles={"p-0": "prefill", "d-0": "decode",
+                            "d-1": "decode"}))
+        ctrl1 = make_controller(clock=clock, source=src1, journal_path=path)
+        ctrl1.reconcile_once()
+        assert ctrl1.actuator.applied  # the re-role executed
+        ctrl1.stop()
+
+        clock.now += 5.0  # restart well inside role_cooldown_s=60
+        src2 = QueueSource(signals(
+            mix=0.1, roles={"p-0": "prefill", "d-1": "prefill",
+                            "d-0": "decode"}))
+        ctrl2 = make_controller(clock=clock, source=src2, journal_path=path)
+        assert not ctrl2.policy.cooldown_ready(ACTION_SET_ROLE)
+        summary = ctrl2.reconcile_once()
+        assert summary["settled"] == []  # no reversal inside the cooldown
+        assert ctrl2.actuator.applied == []
+        ctrl2.stop()
+
+    def test_restart_restores_budget_and_histories(self, tmp_path):
+        clock = FakeClock(1000.0)
+        ctrl1 = make_controller(tmp_path, clock=clock)
+        ctrl1.reconcile_once()
+        ctrl1.stop()
+        clock.now += 10.0
+        ctrl2 = make_controller(tmp_path, clock=clock,
+                                source=QueueSource(signals(burn=0.0)))
+        view = ctrl2.debug_view()
+        assert view["budget"]["used"] == 1  # executed record in window
+        assert [r["phase"] for r in view["actions"]] == [PHASE_EXECUTED]
+        ctrl2.stop()
+
+
+# -- SLO alert-edge feed ------------------------------------------------------
+
+
+class TestSLOEdgeFeed:
+    def _burning_registry(self, clock):
+        reg = SLORegistry(clock=clock)
+        reg.add(SLOConfig(name="score_latency", fast_windows=(60.0, 300.0),
+                          slow_window=900.0))
+        return reg
+
+    def test_fire_and_clear_edges_with_cursor(self):
+        clock = FakeClock(1000.0)
+        reg = self._burning_registry(clock)
+        t = reg.get("score_latency")
+        t.record(good=0, bad=100)
+        reg.evaluate_all()
+        payload = reg.export_edges_since(-1)
+        assert [e["edge"] for e in payload["edges"]] == ["fire"]
+        edge = payload["edges"][0]
+        assert edge["slo"] == "score_latency"
+        assert edge["severity"] == "fast_burn"
+        assert edge["burns"]["short"] > 0
+        cursor = payload["next_seq"]
+        # No transition since: the cursor read is empty (react-once).
+        reg.evaluate_all()
+        assert reg.export_edges_since(cursor)["edges"] == []
+        # Recovery produces the clear edge past the same cursor.
+        clock.now += 1000.0
+        t.record(good=100, bad=0)
+        reg.evaluate_all()
+        cleared = reg.export_edges_since(cursor)["edges"]
+        assert [e["edge"] for e in cleared] == ["clear"]
+        assert cleared[0]["prev_severity"] == "fast_burn"
+
+    def test_edge_ring_bounds_with_drop_counter(self):
+        clock = FakeClock(1000.0)
+        reg = SLORegistry(clock=clock, max_edges=4)
+        reg.add(SLOConfig(name="s", fast_windows=(10.0, 10.0),
+                          slow_window=20.0))
+        t = reg.get("s")
+        for _ in range(4):  # fire/clear cycles → 8 edges
+            t.record(good=0, bad=50)
+            reg.evaluate_all()
+            clock.now += 100.0
+            t.record(good=50, bad=0)
+            reg.evaluate_all()
+            clock.now += 100.0
+        payload = reg.export_edges_since(-1)
+        assert len(payload["edges"]) == 4
+        assert payload["dropped"] == 4
+
+
+# -- admin plane: /debug/slo cursor + guarded POST actions --------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(port, path):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestAdminPlane:
+    def test_slo_since_endpoint_and_level_fallthrough(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        clock = FakeClock(1000.0)
+        reg = SLORegistry(clock=clock)
+        reg.add(SLOConfig(name="ttft"))
+        reg.get("ttft").record(good=0, bad=100)
+        reg.evaluate_all()
+        server = AdminServer(port=0)
+        server.register_debug("slo", reg.debug_view)
+        server.register_slo_source(reg.export_edges_since)
+        try:
+            port = server.start()
+            # Plain GET keeps serving the level view (back-compat).
+            status, level = _get(port, "/debug/slo")
+            assert status == 200 and "ttft" in level
+            # ?since= serves the edge cursor payload.
+            status, edges = _get(port, "/debug/slo?since=-1")
+            assert status == 200
+            assert [e["edge"] for e in edges["edges"]] == ["fire"]
+            assert edges["next_seq"] == 0
+            status, empty = _get(port, f"/debug/slo?since={edges['next_seq']}")
+            assert empty["edges"] == []
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(port, "/debug/slo?since=bogus")
+            assert err.value.code == 400
+        finally:
+            server.stop()
+
+    def test_post_actions_guarded_until_registered(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        server = AdminServer(port=0)
+        try:
+            port = server.start()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(port, "/debug/role?set=prefill")
+            assert err.value.code == 404
+
+            role = ["decode"]
+
+            def set_role(params):
+                want = params.get("set", "")
+                if want not in ("prefill", "decode", "both"):
+                    raise ValueError(f"bad role {want!r}")
+                role[0] = want
+                return {"ok": True, "role": want}
+
+            server.register_action("role", set_role)
+            status, payload = _post(port, "/debug/role?set=prefill")
+            assert status == 200 and payload["role"] == "prefill"
+            assert role == ["prefill"]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(port, "/debug/role?set=bogus")
+            assert err.value.code == 400  # ValueError maps to bad request
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(port, "/debug/drain")  # unregistered action stays 404
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+
+# -- remote source + actuator end-to-end --------------------------------------
+
+
+class TestRemoteControlPlane:
+    def test_remote_source_polls_and_actuator_posts(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+        from llmd_kv_cache_tpu.services.fleet_controller import (
+            RemoteSignalSource,
+        )
+
+        clock = FakeClock(1000.0)
+        reg = SLORegistry(clock=clock)
+        reg.add(SLOConfig(name="score_latency"))
+        reg.get("score_latency").record(good=0, bad=100)
+        reg.evaluate_all()
+
+        collector = AdminServer(port=0)
+        collector.register_debug("slo", reg.debug_view)
+        collector.register_slo_source(reg.export_edges_since)
+
+        pod_role = ["decode"]
+        pod = AdminServer(port=0)
+        pod.register_debug("role", lambda: {
+            "pod": "d-0", "role": pod_role[0],
+            "starvation": {
+                "mix": {"prefill_fraction": 0.8, "samples": 50,
+                        "alpha": 0.2},
+                "outcomes": {}, "transfer_queue_depth": 3,
+                "in_flight_jobs": 1, "last_handoff_latency_s": None,
+                "starved_side": "prefill",
+            }})
+
+        def set_role(params):
+            pod_role[0] = params.get("set", "")
+            return {"ok": True, "role": pod_role[0]}
+
+        pod.register_action("role", set_role)
+        try:
+            cport = collector.start()
+            pport = pod.start()
+            source = RemoteSignalSource(
+                collector_address=f"127.0.0.1:{cport}",
+                pod_admin={"d-0": f"127.0.0.1:{pport}"},
+                shards=lambda: ["shard-0"], clock=clock)
+            snap = source.poll()
+            assert snap.roles == {"d-0": "decode"}
+            assert snap.handoff["mix"]["prefill_fraction"] == \
+                pytest.approx(0.8)
+            assert snap.handoff["starved_side"] == "prefill"
+            assert [e["edge"] for e in snap.alert_edges] == ["fire"]
+            assert snap.burn("score_latency") > 0
+            # The cursor advanced: the next poll sees no stale edges.
+            assert source.poll().alert_edges == ()
+
+            actuator = AdminPlaneActuator(
+                pod_addresses={"d-0": f"127.0.0.1:{pport}"})
+            result = actuator.apply(Action(
+                kind=ACTION_SET_ROLE, target="d-0",
+                params={"role": "prefill"}))
+            assert result["role"] == "prefill"
+            assert source.poll().roles == {"d-0": "prefill"}
+            with pytest.raises(ValueError):
+                actuator.apply(Action(kind=ACTION_SET_ROLE, target="ghost"))
+        finally:
+            collector.stop()
+            pod.stop()
+
+    def test_unreachable_planes_degrade_to_empty_signals(self):
+        from llmd_kv_cache_tpu.services.fleet_controller import (
+            RemoteSignalSource,
+        )
+
+        source = RemoteSignalSource(
+            collector_address="127.0.0.1:1",  # nothing listens there
+            pod_admin={"p": "127.0.0.1:1"}, timeout_s=0.2)
+        snap = source.poll()
+        assert snap.slo == {} and snap.roles == {}
+        assert source.fetch_errors > 0
+
+
+# -- engine re-role -----------------------------------------------------------
+
+
+class TestEngineSetRole:
+    def _engine(self, tmp_path=None):
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+
+        kwargs = {}
+        if tmp_path is not None:
+            from llmd_kv_cache_tpu.offload.spec import SharedStorageOffloadSpec
+
+            tiny = LlamaConfig.tiny()
+            kwargs["offload_spec"] = SharedStorageOffloadSpec(
+                root=str(tmp_path), model_name="tiny",
+                page_size=tiny.page_size, num_layers=tiny.num_layers,
+                kv_heads=tiny.num_kv_heads, head_dim=tiny.head_dim,
+                io_threads=2, parallel_agnostic=True)
+        return MiniEngine(EngineConfig(
+            model=LlamaConfig.tiny(), num_pages=16, max_pages_per_seq=8,
+            model_name="tiny", pod_identifier="p"), **kwargs)
+
+    def test_set_role_flips_and_returns_previous(self, tmp_path):
+        engine = self._engine(tmp_path)
+        assert engine.set_role("prefill") == "both"
+        assert engine.cfg.role == "prefill"
+        assert engine.set_role("decode") == "prefill"
+        assert engine.cfg.role == "decode"
+
+    def test_set_role_validates_like_the_constructor(self, tmp_path):
+        engine = self._engine(tmp_path)
+        with pytest.raises(ValueError, match="role"):
+            engine.set_role("mixed")
+        plain = self._engine()
+        with pytest.raises(ValueError, match="offload"):
+            plain.set_role("prefill")
+        assert plain.cfg.role == "both"  # failed flip left config alone
+
+
+# -- kvdiag controller section ------------------------------------------------
+
+
+def _load_kvdiag():
+    spec = importlib.util.spec_from_file_location(
+        "kvdiag", Path(__file__).resolve().parents[1] / "hack" / "kvdiag.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestKvdiagControllerSection:
+    def test_summary_decodes_signals_and_trims_history(self):
+        kvdiag = _load_kvdiag()
+        ctrl = make_controller()
+        ctrl.reconcile_once()
+        summary = kvdiag.controller_summary(ctrl.debug_view())
+        assert summary["rounds"] == 1
+        assert summary["budget"]["used"] == 1
+        act = summary["last_actions"][-1]
+        assert act["kind"] == ACTION_ADD_SHARD
+        assert act["signal"]["slo"] == "score_latency"
+        assert ACTION_ADD_SHARD in summary["cooldowns"]
+        assert summary["hysteresis_armed"]["shard_scale_up"] is False
+        assert summary["pending"] == []
+
+    def test_snapshot_includes_controller_section(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        kvdiag = _load_kvdiag()
+        ctrl = make_controller(dry_run=True)
+        ctrl.reconcile_once()
+        server = AdminServer(port=0)
+        server.register_debug("controller", ctrl.debug_view)
+        try:
+            port = server.start()
+            report = kvdiag.snapshot("127.0.0.1", port)
+            assert report["controller"]["dry_run"] is True
+            assert [r["kind"] for r in report["controller"]["would_act"]] \
+                == [ACTION_ADD_SHARD]
+        finally:
+            server.stop()
