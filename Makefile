@@ -9,32 +9,39 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 # Fault-injection suite (resilience layer): fixed failpoint seed so a
-# chaos failure reproduces byte-for-byte on a rerun.
+# chaos failure reproduces byte-for-byte on a rerun. KVTPU_LOCKDEP arms
+# the runtime lock-order witness (utils/lockdep.py) — chaos schedules are
+# exactly where latent A/B lock inversions surface.
 chaos: native
-	$(CPU_ENV) KVTPU_FAILPOINT_SEED=1337 $(PY) -m pytest tests/ -q -m chaos
+	$(CPU_ENV) KVTPU_FAILPOINT_SEED=1337 KVTPU_LOCKDEP=1 \
+	  $(PY) -m pytest tests/ -q -m chaos
 
-# Resilience lint: no bare `except:` / silently-swallowed exceptions in
-# the library (hack/lint_resilience.py). Observability lint: span/metric
-# naming conventions + docs coverage (hack/lint_observability.py).
+# Unified lint driver (hack/kvlint.py): resilience (RES-*, swallowed
+# errors / non-atomic persistence), observability (OBS-*, span+metric
+# namespaces and docs coverage), and concurrency (CONC-*, lock re-entry,
+# lock-order cycles, blocking calls and escaping callbacks under locks —
+# llmd_kv_cache_tpu/tools/conclint). One `path:line: RULE message`
+# format; `--json` for machines.
 lint:
-	$(PY) hack/lint_resilience.py llmd_kv_cache_tpu
-	$(PY) hack/lint_observability.py llmd_kv_cache_tpu
+	$(PY) hack/kvlint.py llmd_kv_cache_tpu
 
 # Concurrency-focused pass (the reference runs `go test -race` nightly;
 # Python has no race detector, so the thread-heavy suites are repeated —
 # any single failure fails the target, surfacing flaky races instead of
-# hiding them).
+# hiding them). KVTPU_LOCKDEP=1 swaps every library lock for the lockdep
+# witness: the first observed lock-order cycle or illegal re-entry
+# raises instead of deadlocking one run in a thousand.
 unit-test-race: native tsan
 	for i in 1 2 3; do \
-	  $(CPU_ENV) $(PY) -m pytest tests/test_stress.py tests/test_pool.py \
-	    tests/test_index.py tests/test_zmq_integration.py \
-	    tests/test_evictor.py -q || exit 1; \
+	  $(CPU_ENV) KVTPU_LOCKDEP=1 $(PY) -m pytest tests/test_stress.py \
+	    tests/test_pool.py tests/test_index.py \
+	    tests/test_zmq_integration.py tests/test_evictor.py -q || exit 1; \
 	done
 
 # Native race tier: the GIL hides C++ data races from the pytest reruns,
@@ -43,6 +50,12 @@ unit-test-race: native tsan
 tsan:
 	$(MAKE) -s -C csrc/kvio tsan
 	$(MAKE) -s -C csrc/kvindex tsan
+
+# Native memory tier: ASan+UBSan over the same test binaries — heap
+# misuse and UB that TSAN's race instrumentation does not see.
+asan:
+	$(MAKE) -s -C csrc/kvio asan
+	$(MAKE) -s -C csrc/kvindex asan
 
 native:
 	$(MAKE) -s -C csrc/kvio

@@ -202,7 +202,16 @@ Engine::Engine(int num_threads, int read_preferring_workers,
   }
 }
 
-Engine::~Engine() { Shutdown(); }
+Engine::~Engine() {
+  Shutdown();
+  // A BeginJob racing Shutdown can insert into jobs_ after the shutdown
+  // sweep (BeginJob deliberately holds only jobs_mu_, never mu_). By the
+  // time the destructor runs no callers remain, so sweep once more to
+  // reclaim those stragglers.
+  std::lock_guard<std::mutex> jl(jobs_mu_);
+  for (auto& [id, job] : jobs_) delete job;
+  jobs_.clear();
+}
 
 void Engine::Shutdown() {
   {
@@ -575,8 +584,16 @@ int Engine::WaitJob(uint64_t job_id, double timeout_seconds) {
     job->sealed.store(true);
   }
   std::unique_lock<std::mutex> jl(jobs_mu_);
-  bool done = jobs_cv_.wait_for(
-      jl, std::chrono::duration<double>(timeout_seconds), [&] {
+  // Wait against system_clock: a steady_clock wait_for lowers to
+  // pthread_cond_clockwait, which the gcc-10 libtsan does not intercept,
+  // so under TSAN the internal unlock/relock of jobs_mu_ goes unseen and
+  // the tool's mutex model corrupts (bogus double-lock + phantom races
+  // throughout the tsan tier). pthread_cond_timedwait is intercepted.
+  const auto deadline =
+      std::chrono::system_clock::now() +
+      std::chrono::duration_cast<std::chrono::system_clock::duration>(
+          std::chrono::duration<double>(timeout_seconds));
+  bool done = jobs_cv_.wait_until(jl, deadline, [&] {
         auto it = jobs_.find(job_id);
         if (it == jobs_.end()) return true;
         JobState* job = it->second;

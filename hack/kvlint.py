@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""kvlint: the unified lint driver behind ``make lint``.
+
+Runs the three project lint passes over the given roots (default
+``llmd_kv_cache_tpu``) and reports every finding in one format::
+
+    path:line: RULE message
+
+- **resilience** (``lint_resilience.py``): RES-* — swallowed errors,
+  bare excepts, non-atomic persistence, undocumented recovery knobs.
+- **observability** (``lint_observability.py``): OBS-* — span/metric
+  namespaces and docs coverage.
+- **concurrency** (``lint_concurrency.py`` →
+  ``llmd_kv_cache_tpu.tools.conclint``): CONC-* — lock re-entry,
+  lock-order cycles, blocking calls and escaping callbacks under locks.
+
+``--json`` emits the same findings as a JSON array of
+``{"pass", "rule", "path", "line", "message"}`` objects (``line`` 0 for
+file-level findings) for dashboards and editor integrations.
+``--only resilience,concurrency`` restricts the passes. Exit status 1
+when any pass finds a problem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_HACK = Path(__file__).resolve().parent
+sys.path.insert(0, str(_HACK))
+sys.path.insert(0, str(_HACK.parent))
+
+import lint_observability  # noqa: E402
+import lint_resilience  # noqa: E402
+
+from llmd_kv_cache_tpu.tools import conclint  # noqa: E402
+
+PASSES = ("resilience", "observability", "concurrency")
+
+
+def _run_resilience(roots: list[Path]) -> tuple[str, list[dict]]:
+    n_files, problems = lint_resilience.collect(roots)
+    return (
+        f"resilience: {n_files} file(s), {len(problems)} problem(s)",
+        [p._asdict() for p in problems],
+    )
+
+
+def _run_observability(roots: list[Path]) -> tuple[str, list[dict]]:
+    n_files, n_metrics, problems = lint_observability.collect(roots)
+    return (
+        f"observability: {n_files} file(s), {n_metrics} metric(s), "
+        f"{len(problems)} problem(s)",
+        [p._asdict() for p in problems],
+    )
+
+
+def _run_concurrency(roots: list[Path]) -> tuple[str, list[dict]]:
+    findings = conclint.analyze([str(r) for r in roots])
+    return (
+        f"concurrency: {len(findings)} problem(s)",
+        [
+            {"path": f.path, "line": f.line, "rule": f.rule, "message": f.message}
+            for f in findings
+        ],
+    )
+
+
+_RUNNERS = {
+    "resilience": _run_resilience,
+    "observability": _run_observability,
+    "concurrency": _run_concurrency,
+}
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kvlint", description="unified project lint driver"
+    )
+    parser.add_argument("roots", nargs="*", default=["llmd_kv_cache_tpu"],
+                        help="package roots or files to lint")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array on stdout")
+    parser.add_argument("--only", default=",".join(PASSES),
+                        help="comma-separated subset of passes "
+                             f"({', '.join(PASSES)})")
+    opts = parser.parse_args(argv[1:])
+
+    selected = [p.strip() for p in opts.only.split(",") if p.strip()]
+    unknown = [p for p in selected if p not in PASSES]
+    if unknown:
+        parser.error(f"unknown pass(es): {', '.join(unknown)}")
+
+    roots = [Path(r) for r in opts.roots]
+    all_findings: list[dict] = []
+    summaries: list[str] = []
+    for name in PASSES:
+        if name not in selected:
+            continue
+        summary, findings = _RUNNERS[name](roots)
+        summaries.append(summary)
+        all_findings.extend(dict(f, **{"pass": name}) for f in findings)
+
+    all_findings.sort(key=lambda f: (f["path"], f["line"], f["rule"]))
+    if opts.as_json:
+        print(json.dumps(all_findings, indent=2))
+    else:
+        for f in all_findings:
+            loc = f"{f['path']}:{f['line']}" if f["line"] else f["path"]
+            print(f"{loc}: {f['rule']} {f['message']}")
+    print("kvlint: " + "; ".join(summaries), file=sys.stderr)
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

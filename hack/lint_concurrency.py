@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Concurrency lint: whole-program lock-order + critical-section hygiene.
+
+Thin CLI over :mod:`llmd_kv_cache_tpu.tools.conclint` (the analyzer
+package); run as part of ``make lint`` via ``hack/kvlint.py``. Four rules
+over every ``.py`` file under the given roots (default
+``llmd_kv_cache_tpu``):
+
+1. **CONC-REENTRY** — a non-reentrant ``threading.Lock`` re-acquired on
+   a ``self.*`` call path that already holds it (the PR 3 ``_lag_mu``
+   self-deadlock class).
+2. **CONC-LOCK-ORDER** — a cycle in the global lock-acquisition-order
+   graph across classes and modules (AB/BA deadlocks).
+3. **CONC-BLOCKING** — ``time.sleep`` / ``recv*`` / ``Future.result`` /
+   blocking ``queue.get`` / file+network IO inside a lock region.
+4. **CONC-CALLBACK** — a stored hook/listener/callback invoked while a
+   lock is held (escaping callbacks re-enter at will).
+
+Intentional exceptions carry ``# lint: allow-<rule> (why)`` on the
+violation line or the enclosing ``with`` line; a marker without a reason
+is itself a finding (CONC-BAD-MARKER). Rule catalog + the runtime
+lockdep witness that cross-checks this model: docs/testing.md
+"Concurrency analysis".
+
+Exit status 1 when any violation is found (CI-friendly).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from llmd_kv_cache_tpu.tools import conclint  # noqa: E402
+
+
+def main(argv: list[str]) -> int:
+    roots = argv[1:] or ["llmd_kv_cache_tpu"]
+    findings = conclint.analyze(roots)
+    for f in findings:
+        print(f.format())
+    n_files = sum(
+        1 if Path(r).is_file() else len(list(Path(r).rglob("*.py")))
+        for r in roots
+    )
+    print(
+        f"lint_concurrency: {n_files} file(s), {len(findings)} problem(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

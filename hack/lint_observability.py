@@ -1,27 +1,26 @@
 #!/usr/bin/env python
 """Observability lint: naming conventions + docs coverage.
 
-Three AST checks over every ``.py`` file under the given roots (default
-``llmd_kv_cache_tpu``):
+AST checks over every ``.py`` file under the given roots (default
+``llmd_kv_cache_tpu``), each reported as ``path:line: RULE message``:
 
-1. **span names** — every ``tracer().span("...")`` / ``self._tracer.span``
-   name must start with ``llm_d.kv_cache.`` (the project's trace
-   namespace; f-strings are checked by their literal prefix).
-2. **metric names** — every ``Counter``/``Gauge``/``Histogram``/``Summary``
-   (and config-bucketed ``BucketHistogram`` / ``bucket_histogram``)
-   constructed in the library must start with ``kvcache_``,
-   ``kv_offload_``, ``kvtpu_engine_``, ``kvtpu_shard_``,
-   ``kvtpu_handoff_``, ``kvtpu_slo_``, ``kvtpu_trace_``,
-   ``kvtpu_fleet_``, ``kvtpu_pyprof_``, or ``kvtpu_offload_`` so
-   dashboards can select the project's families with one matcher.
-3. **docs coverage** — every metric name constructed in the library, and
-   every fully-literal span name, must appear in
-   ``docs/observability.md``; an undocumented metric is a dashboard
-   nobody will ever build. The debug endpoints in ``REQUIRED_ENDPOINTS``
-   (the continuous-profiling surface) must be documented too.
+1. **OBS-SPAN-NAMESPACE** — every ``tracer().span("...")`` /
+   ``self._tracer.span`` name must start with ``llm_d.kv_cache.`` (the
+   project's trace namespace; f-strings are checked by their literal
+   prefix).
+2. **OBS-METRIC-NAMESPACE** — every ``Counter``/``Gauge``/``Histogram``/
+   ``Summary`` (and config-bucketed ``BucketHistogram`` /
+   ``bucket_histogram``) constructed in the library must start with one
+   of the project's metric prefixes so dashboards can select its
+   families with one matcher.
+3. **OBS-UNDOC-METRIC / OBS-UNDOC-SPAN / OBS-UNDOC-ENDPOINT** — every
+   metric name constructed in the library, every fully-literal span
+   name, and each debug endpoint in ``REQUIRED_ENDPOINTS`` must appear
+   in ``docs/observability.md``; an undocumented metric is a dashboard
+   nobody will ever build.
 
-Exit status 1 when any violation is found (CI-friendly; see Makefile
-``lint`` target).
+Runs standalone or as one pass of ``hack/kvlint.py`` (the ``make lint``
+driver). Exit status 1 when any violation is found (CI-friendly).
 """
 
 from __future__ import annotations
@@ -29,6 +28,7 @@ from __future__ import annotations
 import ast
 import sys
 from pathlib import Path
+from typing import NamedTuple
 
 SPAN_PREFIX = "llm_d.kv_cache."
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
@@ -50,6 +50,27 @@ METRIC_CLASSES = frozenset({
     "CounterMetricFamily", "GaugeMetricFamily",
 })
 DOCS_PATH = Path("docs/observability.md")
+
+RULE_SPAN_NAMESPACE = "OBS-SPAN-NAMESPACE"
+RULE_METRIC_NAMESPACE = "OBS-METRIC-NAMESPACE"
+RULE_UNDOC_METRIC = "OBS-UNDOC-METRIC"
+RULE_UNDOC_SPAN = "OBS-UNDOC-SPAN"
+RULE_UNDOC_ENDPOINT = "OBS-UNDOC-ENDPOINT"
+RULE_SYNTAX = "OBS-SYNTAX"
+
+
+class Problem(NamedTuple):
+    """One finding; ``line == 0`` means a file-level problem."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        if self.line:
+            return f"{self.path}:{self.line}: {self.rule} {self.message}"
+        return f"{self.path}: {self.rule} {self.message}"
 
 
 def _literal_prefix(node: ast.AST) -> tuple[str, bool]:
@@ -102,15 +123,16 @@ def _module_string_consts(tree: ast.Module) -> dict[str, str]:
     return consts
 
 
-def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
+def lint_file(path: Path) -> tuple[list[Problem], list[str], list[str]]:
     """Returns (problems, metric_names_constructed, span_names)."""
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"], [], []
+        return ([Problem(str(path), e.lineno or 0, RULE_SYNTAX,
+                         f"syntax error: {e.msg}")], [], [])
     consts = _module_string_consts(tree)
-    problems: list[str] = []
+    problems: list[Problem] = []
     metric_names: list[str] = []
     span_names: list[str] = []
     for node in ast.walk(tree):
@@ -125,10 +147,11 @@ def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
             if not prefix and not full:
                 continue  # dynamic name; nothing to check statically
             if not prefix.startswith(SPAN_PREFIX) and not SPAN_PREFIX.startswith(prefix):
-                problems.append(
-                    f"{path}:{node.lineno}: span name {prefix!r}… outside the "
-                    f"`{SPAN_PREFIX}*` namespace"
-                )
+                problems.append(Problem(
+                    str(path), node.lineno, RULE_SPAN_NAMESPACE,
+                    f"span name {prefix!r}… outside the `{SPAN_PREFIX}*` "
+                    "namespace",
+                ))
             if full and prefix.startswith(SPAN_PREFIX):
                 # Fully-literal, in-namespace span names join the docs
                 # coverage check (f-string names like tokenizer.<Method>
@@ -139,39 +162,44 @@ def lint_file(path: Path) -> tuple[list[str], list[str], list[str]]:
             name = first.value
             metric_names.append(name)
             if not name.startswith(METRIC_PREFIXES):
-                problems.append(
-                    f"{path}:{node.lineno}: {cls} {name!r} outside the "
-                    f"{'/'.join(METRIC_PREFIXES)} namespaces"
-                )
+                problems.append(Problem(
+                    str(path), node.lineno, RULE_METRIC_NAMESPACE,
+                    f"{cls} {name!r} outside the "
+                    f"{'/'.join(METRIC_PREFIXES)} namespaces",
+                ))
     return problems, metric_names, span_names
 
 
 def check_docs(metric_names: list[str], span_names: list[str],
-               docs_path: Path) -> list[str]:
+               docs_path: Path) -> list[Problem]:
     if not docs_path.exists():
-        return [f"{docs_path}: missing — every metric must be documented there"]
+        return [Problem(str(docs_path), 0, RULE_UNDOC_METRIC,
+                        "missing — every metric must be documented there")]
     text = docs_path.read_text()
     problems = [
-        f"{docs_path}: metric `{name}` is not documented"
+        Problem(str(docs_path), 0, RULE_UNDOC_METRIC,
+                f"metric `{name}` is not documented")
         for name in sorted(set(metric_names))
         if name not in text
     ]
     problems.extend(
-        f"{docs_path}: span `{name}` is not documented"
+        Problem(str(docs_path), 0, RULE_UNDOC_SPAN,
+                f"span `{name}` is not documented")
         for name in sorted(set(span_names))
         if name not in text
     )
     problems.extend(
-        f"{docs_path}: endpoint `{endpoint}` is not documented"
+        Problem(str(docs_path), 0, RULE_UNDOC_ENDPOINT,
+                f"endpoint `{endpoint}` is not documented")
         for endpoint in REQUIRED_ENDPOINTS
         if endpoint not in text
     )
     return problems
 
 
-def main(argv: list[str]) -> int:
-    roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
-    problems: list[str] = []
+def collect(roots: list[Path]) -> tuple[int, int, list[Problem]]:
+    """(files scanned, metrics seen, problems) — the kvlint API."""
+    problems: list[Problem] = []
     metric_names: list[str] = []
     span_names: list[str] = []
     n_files = 0
@@ -184,11 +212,17 @@ def main(argv: list[str]) -> int:
             metric_names.extend(file_metrics)
             span_names.extend(file_spans)
     problems.extend(check_docs(metric_names, span_names, DOCS_PATH))
+    return n_files, len(set(metric_names)), problems
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
+    n_files, n_metrics, problems = collect(roots)
     for p in problems:
-        print(p)
+        print(p.format())
     print(
         f"lint_observability: {n_files} file(s), "
-        f"{len(set(metric_names))} metric(s), {len(problems)} problem(s)",
+        f"{n_metrics} metric(s), {len(problems)} problem(s)",
         file=sys.stderr,
     )
     return 1 if problems else 0

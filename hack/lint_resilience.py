@@ -2,13 +2,13 @@
 """Resilience lint: forbid silently-dropped errors in the library.
 
 AST checks over every ``.py`` file under the given roots (default
-``llmd_kv_cache_tpu``):
+``llmd_kv_cache_tpu``), each reported as ``path:line: RULE message``:
 
-1. **bare except** — ``except:`` catches ``KeyboardInterrupt`` and
+1. **RES-BARE-EXCEPT** — ``except:`` catches ``KeyboardInterrupt`` and
    ``SystemExit`` too; name the exception.
-2. **swallowed exception** — a handler whose body is only ``pass``/``...``
+2. **RES-SWALLOW** — a handler whose body is only ``pass``/``...``
    silently erases the failure. Either handle it, log it, or re-raise.
-3. **non-atomic persistence** (``offload/`` and ``recovery/`` only) —
+3. **RES-NONATOMIC** (``offload/`` and ``recovery/`` only) —
    ``open(path, "w"/"wb"/...)`` publishes a file non-atomically: a crash
    mid-write leaves a truncated file that later reads as corruption.
    Durable state under those trees must go through
@@ -16,10 +16,9 @@ AST checks over every ``.py`` file under the given roots (default
    Append mode (``"ab"``, the journal's framing-tolerant format) is
    exempt; an intentional exception carries
    ``# lint: allow-nonatomic (why)`` on the line.
-4. **recovery knobs documented** — every field of a ``*Config``
-   dataclass under ``recovery/`` must appear (camelCased) in
-   ``docs/configuration.md``; an undocumented knob is a default nobody
-   can change.
+4. **RES-UNDOC-KNOB** — every field of a ``*Config`` dataclass under
+   ``recovery/`` must appear (camelCased) in ``docs/configuration.md``;
+   an undocumented knob is a default nobody can change.
 
 A handler that is intentionally fire-and-forget (e.g. best-effort cleanup
 in a ``__del__``) may carry the explicit marker comment
@@ -29,8 +28,8 @@ in a ``__del__``) may carry the explicit marker comment
 on the ``except`` line; the marker documents the decision where the next
 reader will look for it.
 
-Exit status 1 when any violation is found (CI-friendly; see Makefile
-``lint`` target).
+Runs standalone or as one pass of ``hack/kvlint.py`` (the ``make lint``
+driver). Exit status 1 when any violation is found (CI-friendly).
 """
 
 from __future__ import annotations
@@ -38,11 +37,32 @@ from __future__ import annotations
 import ast
 import sys
 from pathlib import Path
+from typing import NamedTuple
 
 ALLOW_MARKER = "lint: allow-swallow"
 ALLOW_NONATOMIC = "lint: allow-nonatomic"
 ATOMIC_TREES = ("offload", "recovery")
 CONFIG_DOCS_PATH = Path("docs/configuration.md")
+
+RULE_BARE_EXCEPT = "RES-BARE-EXCEPT"
+RULE_SWALLOW = "RES-SWALLOW"
+RULE_NONATOMIC = "RES-NONATOMIC"
+RULE_UNDOC_KNOB = "RES-UNDOC-KNOB"
+RULE_SYNTAX = "RES-SYNTAX"
+
+
+class Problem(NamedTuple):
+    """One finding; ``line == 0`` means a file-level problem."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        if self.line:
+            return f"{self.path}:{self.line}: {self.rule} {self.message}"
+        return f"{self.path}: {self.rule} {self.message}"
 
 
 def _camel(name: str) -> str:
@@ -82,12 +102,13 @@ def _is_swallow(handler: ast.ExceptHandler) -> bool:
     return True
 
 
-def lint_file(path: Path) -> list[str]:
+def lint_file(path: Path) -> list[Problem]:
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+        return [Problem(str(path), e.lineno or 0, RULE_SYNTAX,
+                        f"syntax error: {e.msg}")]
     lines = src.splitlines()
     problems = []
     check_atomic = any(part in ATOMIC_TREES for part in path.parts)
@@ -96,26 +117,29 @@ def lint_file(path: Path) -> list[str]:
             mode = _open_write_mode(node)
             line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
             if mode and ALLOW_NONATOMIC not in line:
-                problems.append(
-                    f"{path}:{node.lineno}: non-atomic persistence — "
-                    f"open(..., {mode!r}) under {'/'.join(ATOMIC_TREES)} "
-                    "can tear on crash; use utils.atomic_io."
-                    f"atomic_write_bytes (or mark `# {ALLOW_NONATOMIC} (why)`)"
-                )
+                problems.append(Problem(
+                    str(path), node.lineno, RULE_NONATOMIC,
+                    f"non-atomic persistence — open(..., {mode!r}) under "
+                    f"{'/'.join(ATOMIC_TREES)} can tear on crash; use "
+                    "utils.atomic_io.atomic_write_bytes "
+                    f"(or mark `# {ALLOW_NONATOMIC} (why)`)",
+                ))
         if not isinstance(node, ast.ExceptHandler):
             continue
         line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
         if node.type is None:
-            problems.append(
-                f"{path}:{node.lineno}: bare `except:` — name the "
-                "exception (bare except also catches KeyboardInterrupt)"
-            )
+            problems.append(Problem(
+                str(path), node.lineno, RULE_BARE_EXCEPT,
+                "bare `except:` — name the exception "
+                "(bare except also catches KeyboardInterrupt)",
+            ))
             continue
         if _is_swallow(node) and ALLOW_MARKER not in line:
-            problems.append(
-                f"{path}:{node.lineno}: swallowed exception — handle, "
-                f"log, or re-raise (or mark `# {ALLOW_MARKER} (why)`)"
-            )
+            problems.append(Problem(
+                str(path), node.lineno, RULE_SWALLOW,
+                "swallowed exception — handle, log, or re-raise "
+                f"(or mark `# {ALLOW_MARKER} (why)`)",
+            ))
     return problems
 
 
@@ -137,28 +161,30 @@ def _config_fields(path: Path) -> list[tuple[int, str]]:
     return out
 
 
-def check_recovery_knob_docs(root: Path) -> list[str]:
+def check_recovery_knob_docs(root: Path) -> list[Problem]:
     """Every recovery config knob must be documented in configuration.md."""
     recovery_dir = root / "recovery" if root.is_dir() else None
     if recovery_dir is None or not recovery_dir.is_dir():
         return []
     if not CONFIG_DOCS_PATH.exists():
-        return [f"{CONFIG_DOCS_PATH}: missing — recovery knobs must be documented there"]
+        return [Problem(str(CONFIG_DOCS_PATH), 0, RULE_UNDOC_KNOB,
+                        "missing — recovery knobs must be documented there")]
     text = CONFIG_DOCS_PATH.read_text()
     problems = []
     for f in sorted(recovery_dir.rglob("*.py")):
         for lineno, name in _config_fields(f):
             if _camel(name) not in text:
-                problems.append(
-                    f"{f}:{lineno}: config knob `{name}` "
-                    f"(`{_camel(name)}`) is not documented in {CONFIG_DOCS_PATH}"
-                )
+                problems.append(Problem(
+                    str(f), lineno, RULE_UNDOC_KNOB,
+                    f"config knob `{name}` (`{_camel(name)}`) is not "
+                    f"documented in {CONFIG_DOCS_PATH}",
+                ))
     return problems
 
 
-def main(argv: list[str]) -> int:
-    roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
-    problems: list[str] = []
+def collect(roots: list[Path]) -> tuple[int, list[Problem]]:
+    """(files scanned, problems) over the given roots — the kvlint API."""
+    problems: list[Problem] = []
     n_files = 0
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
@@ -166,8 +192,14 @@ def main(argv: list[str]) -> int:
             n_files += 1
             problems.extend(lint_file(f))
         problems.extend(check_recovery_knob_docs(root))
+    return n_files, problems
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv[1:]] or [Path("llmd_kv_cache_tpu")]
+    n_files, problems = collect(roots)
     for p in problems:
-        print(p)
+        print(p.format())
     print(
         f"lint_resilience: {n_files} file(s), {len(problems)} problem(s)",
         file=sys.stderr,

@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional
 
 from prometheus_client import Counter, Gauge
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 from ..telemetry.tracing import tracer
 from .actions import (
@@ -94,7 +95,7 @@ class FleetController:
         if journal is None and self.cfg.journal_path:
             journal = ActionJournal(self.cfg.journal_path)
         self.journal = journal
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.rounds = 0

@@ -30,11 +30,11 @@ from __future__ import annotations
 import json
 import os
 import struct
-import threading
 import zlib
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional
 
+from ..utils.lockdep import new_lock
 from ..utils.atomic_io import fsync_dir
 from ..utils.cbor import CBORDecodeError, canonical_cbor_decode, canonical_cbor_encode
 from ..utils.logging import get_logger
@@ -109,7 +109,7 @@ class ActionJournal:
     def __init__(self, path: str, sync_every: int = 1):
         self.path = path
         self.sync_every = max(1, sync_every)
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._f = None
         self._since_sync = 0
         self._seq = 0
@@ -138,7 +138,7 @@ class ActionJournal:
             self.appended += 1
             self._since_sync += 1
             if self._since_sync >= self.sync_every:
-                os.fsync(f.fileno())
+                os.fsync(f.fileno())  # lint: allow-blocking (durability point: seq/_since_sync must match on-disk state, so fsync stays under _mu; bounded by sync_every)
                 self._since_sync = 0
         return record
 
@@ -147,7 +147,7 @@ class ActionJournal:
             if self._f is not None:
                 if self._since_sync:
                     self._f.flush()
-                    os.fsync(self._f.fileno())
+                    os.fsync(self._f.fileno())  # lint: allow-blocking (final durability barrier on close; no concurrent appends after this)
                     self._since_sync = 0
                 self._f.close()
                 self._f = None

@@ -9,9 +9,10 @@ scoring can become group-aware.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Optional
+
+from ..utils.lockdep import new_lock
 
 # KV cache spec kinds as emitted by vLLM (reference pkg/kvevents/events.go:32-43).
 SPEC_FULL_ATTENTION = "full_attention"
@@ -39,7 +40,7 @@ class GroupCatalog:
     """Thread-safe per-pod catalog of KV-cache group metadata."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._entries: dict[str, dict[int, GroupMetadata]] = {}
 
     def learn(self, pod_id: str, group_idx: int, meta: GroupMetadata) -> None:

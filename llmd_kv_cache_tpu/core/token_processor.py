@@ -20,7 +20,6 @@ byte-compatible with the engines' own block hashing:
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -30,6 +29,7 @@ try:  # numpy backs the cached-key arrays for the native fused score path
 except Exception:  # pragma: no cover - numpy-less envs degrade gracefully
     _np = None
 
+from ..utils.lockdep import new_lock
 from ..utils.cbor import canonical_cbor_encode
 from ..utils.fnv import fnv1a_64
 from .extra_keys import BlockExtraFeatures
@@ -113,7 +113,7 @@ class PrefixKeyCache:
 
     def __init__(self, capacity_tokens: int):
         self._capacity = capacity_tokens
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         # (parent, n_tokens, fp) → (keys_tuple, keys_arr)
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         # parent → MRU list of (n_tokens, fp, first_token, last_token)

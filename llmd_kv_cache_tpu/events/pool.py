@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils.lockdep import new_lock
 from ..core.extra_keys import BlockExtraFeatures, parse_raw_extra_keys
 from ..core.hma import GroupCatalog, GroupMetadata
 from ..core.keys import EMPTY_BLOCK_HASH, TIER_TPU_HBM, BlockHash, KeyType, PodEntry
@@ -168,7 +169,7 @@ class Pool:
         # so add_task skips re-encoding + FNV-hashing per message. Bounded
         # defensively; a full reset on overflow just re-hashes.
         self._shard_cache: dict[str, int] = {}
-        self._stats_mu = threading.Lock()
+        self._stats_mu = new_lock()
         # Ingestion telemetry, mirrored into Prometheus per drained batch.
         self.ingest_batches = 0
         self.ingest_messages = 0
@@ -176,7 +177,7 @@ class Pool:
         # Event-pipeline lag/staleness (ISSUE 3): per-pod last sequence +
         # timestamps for gap detection and index-staleness estimation, and
         # a bounded sample window for p50/p99 lag readouts (admin, bench).
-        self._lag_mu = threading.Lock()
+        self._lag_mu = new_lock()
         self._pod_lag: dict[str, dict] = {}
         self.lag_samples: collections.deque = collections.deque(maxlen=4096)
         # Per-pod cache-efficiency ledger (Indexer owns it; the service

@@ -18,13 +18,13 @@ Two users:
 from __future__ import annotations
 
 import struct
-import threading
 import time
 from typing import Optional, Sequence
 
 import msgpack
 import zmq
 
+from ..utils.lockdep import new_lock
 from ..telemetry import current_traceparent
 from ..utils.logging import get_logger
 from .model import AllBlocksClearedEvent, BlockRemovedEvent, BlockStoredEvent, GenericEvent
@@ -96,7 +96,7 @@ class KVEventPublisher:
             self._sock.connect(endpoint)
         self.endpoint = endpoint
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def publish(
         self,

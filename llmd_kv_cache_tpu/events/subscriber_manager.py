@@ -8,9 +8,9 @@ reconciler (Kubernetes watch) or any discovery source.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Optional
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 from .model import RawMessage
 from .zmq_subscriber import ZMQSubscriber
@@ -28,7 +28,7 @@ class SubscriberManager:
     ):
         self._on_message = on_message
         self._topic_filter = topic_filter
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._subscribers: dict[str, tuple[str, ZMQSubscriber]] = {}
 
     def ensure_subscriber(self, pod_name: str, endpoint: str) -> bool:

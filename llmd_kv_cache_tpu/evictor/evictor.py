@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..events.publisher import StorageEventPublisher
 from ..offload.file_mapper import FileMapper
 from ..utils.logging import get_logger
@@ -184,7 +185,7 @@ class Evictor:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.total_deleted = 0
-        self._deleted_lock = threading.Lock()
+        self._deleted_lock = new_lock()
 
     # -- single-pass stages (deterministic, used by tests and the loops) --
 

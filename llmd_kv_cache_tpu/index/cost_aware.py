@@ -11,10 +11,10 @@ path is dict-speed either way.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..core.keys import BlockHash, KeyType, PodEntry
 from ..utils.humanize import parse_bytes
 from ..utils.logging import get_logger
@@ -66,7 +66,7 @@ class _CostPodCache:
 
     def __init__(self) -> None:
         self.entries: dict[PodEntry, None] = {}
-        self.mu = threading.Lock()
+        self.mu = new_lock()
         self.cost = _KEY_COST
 
 
@@ -83,7 +83,7 @@ class CostAwareMemoryIndex(Index):
         self._data: LRUCache[BlockHash, _CostPodCache] = LRUCache(2**62)
         self._engine_to_request: LRUCache[BlockHash, list[BlockHash]] = LRUCache(cfg.mapping_size)
         self._total_cost = 0
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         # Tier restore-latency EMAs feeding ``tier_discount`` (see module
         # header); observed by whoever times restores against the tier
         # (the engine's deferred-restore path via on_restore_latency).

@@ -13,10 +13,10 @@ empty-key removal re-checks emptiness under the per-key lock.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..core.keys import BlockHash, KeyType, PodEntry
 from ..utils.logging import get_logger
 from ..utils.lru import LRUCache
@@ -51,7 +51,7 @@ class _PodCache:
 
     def __init__(self, capacity: int):
         self.cache: LRUCache[PodEntry, None] = LRUCache(capacity)
-        self.mu = threading.Lock()
+        self.mu = new_lock()
 
 
 class InMemoryIndex(Index):
@@ -64,7 +64,7 @@ class InMemoryIndex(Index):
         self._pod_cache_size = cfg.pod_cache_size
         # Serializes engine-key-level check-and-act (Evict's all-empty check
         # + mapping removal vs Add's insertion) — reference in_memory.go:80-82.
-        self._mu = threading.Lock()
+        self._mu = new_lock()
 
     def lookup(
         self,

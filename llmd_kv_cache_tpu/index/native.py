@@ -12,13 +12,13 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional, Sequence
 
 import numpy as np
 
+from ..utils.lockdep import new_lock
 from ..core.keys import BlockHash, KeyType, PodEntry
 from ..utils.logging import get_logger
 from .base import Index
@@ -27,7 +27,7 @@ logger = get_logger("index.native")
 
 _CSRC_DIR = Path(__file__).resolve().parent.parent.parent / "csrc" / "kvindex"
 _LIB_PATH = _CSRC_DIR / "libkvindex.so"
-_build_lock = threading.Lock()
+_build_lock = new_lock()
 _lib: Optional[ctypes.CDLL] = None
 
 _FLAG_SPECULATIVE = 1
@@ -211,7 +211,7 @@ class NativeIndex(Index):
         # Mirror of the native intern table (id → string), filled lazily.
         self._interned: dict[str, int] = {}
         self._strings: dict[int, str] = {}
-        self._intern_lock = threading.Lock()
+        self._intern_lock = new_lock()
         self._lookup_cap = 4096  # entries; grown on demand
         # PodEntry is frozen/immutable: memoize by packed tuple so lookups
         # reuse objects instead of re-materializing identical entries.

@@ -15,6 +15,7 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 from prometheus_client import REGISTRY, Counter, Gauge, Histogram
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 
 logger = get_logger("metrics")
@@ -325,7 +326,7 @@ class BucketHistogram:
         # Keeping only the latest per bucket bounds memory and matches the
         # OpenMetrics intent: link a bucket to *a* representative trace.
         self._exemplars: list = [None] * (len(bounds) + 1)
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         idx = bisect_left(self.bounds, value)
@@ -404,7 +405,7 @@ class BucketHistogram:
 
 
 _BUCKET_HISTOGRAMS: Dict[str, BucketHistogram] = {}
-_bucket_hist_lock = threading.Lock()
+_bucket_hist_lock = new_lock()
 _bucket_collector_registered = False
 
 
@@ -819,7 +820,7 @@ class _CacheLedgerCollector:
         yield evicted
 
 
-_ledger_collector_lock = threading.Lock()
+_ledger_collector_lock = new_lock()
 _ledger_collector: Optional[_CacheLedgerCollector] = None
 
 

@@ -21,11 +21,11 @@ plane.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..events.model import TransferBlocksAvailableEvent
 from ..telemetry.tracing import tracer
 from ..utils.logging import get_logger
@@ -78,7 +78,7 @@ class HandoffCoordinator:
         publish: Optional[Callable[[TransferBlocksAvailableEvent], None]] = None,
         residency=None,
     ):
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._states: dict[str, HandoffState] = {}
         self.publish = publish
         # Optional scoring.residency.ResidencyTracker: transfer progress

@@ -10,10 +10,10 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import Optional
 
+from ..utils.lockdep import new_lock
 from ..resilience.failpoints import failpoints
 from ..utils.logging import get_logger
 
@@ -31,7 +31,7 @@ FP_FILE_EXISTS = "offload.native.file_exists"
 _CSRC_DIR = Path(__file__).resolve().parent.parent.parent / "csrc" / "kvio"
 _LIB_PATH = _CSRC_DIR / "libkvio.so"
 
-_build_lock = threading.Lock()
+_build_lock = new_lock()
 _lib: Optional[ctypes.CDLL] = None
 
 STATUS_PENDING = -1

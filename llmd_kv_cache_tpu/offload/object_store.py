@@ -32,6 +32,7 @@ from typing import Optional, Protocol, Sequence
 
 import numpy as np
 
+from ..utils.lockdep import new_lock
 from ..events.publisher import StorageEventPublisher
 from ..utils.atomic_io import atomic_write_bytes
 from ..utils.logging import get_logger
@@ -453,7 +454,7 @@ class ObjectStoreOffloadHandlers:
         )
         self._jobs: dict[int, _ObjJob] = {}
         self._next_job = 1
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # Backpressure: each queued put pins a full host slab, so bound the
         # number in flight and shed the rest (the object-store analogue of
         # the POSIX engine's EMA write shedding — a future cache miss, not

@@ -20,10 +20,10 @@ the copy the pool exists to avoid.
 
 from __future__ import annotations
 
-import threading
 
 import numpy as np
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 
 logger = get_logger("offload.staging")
@@ -40,7 +40,7 @@ class HostStagingPool:
 
     def __init__(self, slot_bytes: int, slots: int):
         self.slot_bytes = int(slot_bytes)
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._free: list[np.ndarray] = [
             np.empty(self.slot_bytes, np.uint8) for _ in range(slots)
         ]

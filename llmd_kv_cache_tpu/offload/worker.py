@@ -16,7 +16,6 @@ cache misses rather than latency.
 from __future__ import annotations
 
 import os
-import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -24,6 +23,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..utils.lockdep import new_lock
 from ..resilience.failpoints import FaultInjected, failpoints
 from ..resilience.integrity import (
     IntegrityError,
@@ -323,7 +323,7 @@ class OffloadHandlers:
             direct_io=direct_io,
         )
         self._pending: dict[int, _PendingJob] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # Integrity: when the mapper's format carries a CRC footer, stores
         # append it and loads verify it (docs/resilience.md).
         self.integrity = getattr(mapper.cfg, "integrity", "none") == "crc32"

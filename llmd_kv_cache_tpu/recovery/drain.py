@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable, Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..telemetry import flight_recorder, tracer
 from ..telemetry.flight_recorder import KIND_DRAIN
 from ..utils.logging import get_logger
@@ -51,7 +52,7 @@ class DrainCoordinator:
         self.offload = offload
         self.manager = manager
         self.on_complete = on_complete
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._drained = False
         self.last_report: Optional[dict] = None
 

@@ -31,10 +31,10 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 import zlib
 from typing import Iterator, Optional
 
+from ..utils.lockdep import new_lock
 from ..utils.atomic_io import atomic_write_bytes, fsync_dir
 from ..utils.cbor import CBORDecodeError, canonical_cbor_decode, canonical_cbor_encode
 from ..utils.logging import get_logger
@@ -64,7 +64,7 @@ class EventJournal:
     def __init__(self, path: str, sync_every: int = 64):
         self.path = path
         self.sync_every = max(1, sync_every)
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._f = None
         self._since_sync = 0
         self.appended = 0
@@ -90,7 +90,7 @@ class EventJournal:
             self.appended += 1
             self._since_sync += 1
             if self._since_sync >= self.sync_every:
-                os.fsync(f.fileno())
+                os.fsync(f.fileno())  # lint: allow-blocking (durability point: _since_sync must match on-disk state, so fsync stays under _mu; bounded by sync_every)
                 self._since_sync = 0
 
     def sync(self) -> None:
@@ -98,7 +98,7 @@ class EventJournal:
         with self._mu:
             if self._f is not None and self._since_sync:
                 self._f.flush()
-                os.fsync(self._f.fileno())
+                os.fsync(self._f.fileno())  # lint: allow-blocking (explicit durability barrier; callers opt into the wait)
                 self._since_sync = 0
 
     def rotate(self) -> None:
@@ -120,7 +120,7 @@ class EventJournal:
             if self._f is not None:
                 if self._since_sync:
                     self._f.flush()
-                    os.fsync(self._f.fileno())
+                    os.fsync(self._f.fileno())  # lint: allow-blocking (final durability barrier on close; no concurrent appends after this)
                     self._since_sync = 0
                 self._f.close()
                 self._f = None

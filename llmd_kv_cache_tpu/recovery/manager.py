@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from ..utils.lockdep import new_lock
 from ..telemetry import flight_recorder, tracer
 from ..telemetry.flight_recorder import KIND_RECOVERY
 from ..utils.logging import get_logger
@@ -69,7 +70,7 @@ class RecoveryManager:
             os.path.join(cfg.snapshot_dir, JOURNAL_NAME),
             sync_every=cfg.journal_sync_every,
         )
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._state = STATE_COLD
         self._state_since = time.time()
         self.restored_entries = 0

@@ -30,6 +30,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..utils.lockdep import new_lock
+
 logger = logging.getLogger(__name__)
 
 ENV_FAILPOINTS = "KVTPU_FAILPOINTS"
@@ -63,14 +65,14 @@ class _Failpoint:
     delay_s: float = 0.0
     hits: int = 0  # times the hook was reached
     fired: int = 0  # times the fault actually triggered
-    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    lock: threading.Lock = field(default_factory=lambda: new_lock(), repr=False)
 
 
 class FailpointRegistry:
     """Thread-safe registry of named failpoints with a seeded RNG."""
 
     def __init__(self, seed: int = 0):
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._points: dict[str, _Failpoint] = {}
         self._rng = random.Random(seed)
         self._seed = seed

@@ -14,9 +14,10 @@ Pods the tracker has never seen score at full weight: a fresh indexer
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict
+
+from ..utils.lockdep import new_lock
 
 
 class PodLivenessTracker:
@@ -33,7 +34,7 @@ class PodLivenessTracker:
         self.stale_after_s = stale_after_s
         self.drop_after_s = drop_after_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._last_seen: Dict[str, float] = {}
 
     def touch(self, pod: str) -> None:

@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..utils.lockdep import new_lock
+
 logger = logging.getLogger(__name__)
 
 
@@ -116,7 +118,7 @@ class CircuitBreaker:
     _failures: int = field(default=0, init=False)
     _opened_at: float = field(default=0.0, init=False)
     _probing: bool = field(default=False, init=False)
-    _lock: threading.Lock = field(default_factory=threading.Lock, init=False, repr=False)
+    _lock: threading.Lock = field(default_factory=lambda: new_lock(), init=False, repr=False)
 
     @property
     def state(self) -> str:

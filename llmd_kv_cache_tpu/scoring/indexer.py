@@ -8,11 +8,11 @@ tokens, and how much of it" in a single in-process call.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..core.extra_keys import BlockExtraFeatures
 from ..core.keys import BlockHash
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
@@ -40,7 +40,7 @@ class CacheEfficiencyLedger:
     """
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         self._pods: dict[str, dict] = {}
         self.score_calls = 0
         self.lookup_blocks = 0

@@ -16,8 +16,9 @@ tier's restore-latency discount when the index exposes one
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Optional, Sequence
+
+from ..utils.lockdep import new_lock
 
 
 class ResidencyTracker:
@@ -33,7 +34,7 @@ class ResidencyTracker:
                  in_flight_discount: float = 0.5):
         self.landed_weight = landed_weight
         self.in_flight_discount = in_flight_discount
-        self._mu = threading.Lock()
+        self._mu = new_lock()
         # block hash → {decode pod → landed?}
         self._claims: dict[int, dict[str, bool]] = {}
         self._pod_blocks: dict[str, set[int]] = {}

@@ -12,11 +12,11 @@ entries; both coexist as distinct PodEntry values).
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..core.keys import TIER_TPU_HBM, KeyType, PodEntry
 from ..utils.logging import get_logger
 from .indexer import Indexer
@@ -45,7 +45,7 @@ class KVAwareRouter:
         self.pods = list(pods)
         self.config = config or RouterConfig()
         self._rr_counter = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # (pod, block-key) → expiry of outstanding speculative inserts;
         # keyed per block (not per chain) so overlapping prompts sharing a
         # prefix refresh the shared keys' TTLs — a shorter prompt's expiry

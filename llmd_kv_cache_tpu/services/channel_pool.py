@@ -14,16 +14,16 @@ the underlying channel closes when its last user releases it.
 
 from __future__ import annotations
 
-import threading
 
 import grpc
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 from ..utils.net import grpc_target
 
 logger = get_logger("services.channel_pool")
 
-_lock = threading.Lock()
+_lock = new_lock()
 _channels: dict[str, tuple[grpc.Channel, int]] = {}
 
 

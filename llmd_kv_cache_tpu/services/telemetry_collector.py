@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from prometheus_client import Counter, Gauge
 
+from ..utils.lockdep import new_lock
 from ..resilience.policy import CircuitBreaker, RetryPolicy, call_with_retry
 from ..telemetry.rollup import (
     MetricFamily,
@@ -376,7 +377,7 @@ class TraceAssembler:
         self._head_rate = min(max(head_sample_rate, 0.0), 1.0)
         self._max_traces = max(1, max_traces)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # trace_id -> {"spans": {span_id: RecordedSpan}, "last": mono_ts}
         self._open: Dict[int, dict] = {}
         self._retained: Dict[int, dict] = {}
@@ -583,7 +584,7 @@ class TelemetryCollector:
             name="availability",
             objective=config.availability_objective,
             description="scrape target reachable", **windows))
-        self._profile_lock = threading.Lock()
+        self._profile_lock = new_lock()
         self._profile_windows: deque = deque(
             maxlen=max(1, config.pyprof_max_windows))
         self._workingset_windows: deque = deque(

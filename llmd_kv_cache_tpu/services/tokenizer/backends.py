@@ -17,6 +17,8 @@ import hashlib
 import threading
 from typing import Optional, Protocol
 
+from ...utils.lockdep import new_lock
+
 
 class Tokenizer(Protocol):
     def encode(self, text: str, add_special_tokens: bool = True) -> list[int]: ...
@@ -129,7 +131,7 @@ class TokenizerRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._tokenizers: dict[str, Tokenizer] = {}
         self._model_locks: dict[str, threading.Lock] = {}
 
@@ -138,7 +140,7 @@ class TokenizerRegistry:
             tok = self._tokenizers.get(model_name)
             if tok is not None:
                 return tok
-            model_lock = self._model_locks.setdefault(model_name, threading.Lock())
+            model_lock = self._model_locks.setdefault(model_name, new_lock())
         with model_lock:
             with self._lock:
                 tok = self._tokenizers.get(model_name)

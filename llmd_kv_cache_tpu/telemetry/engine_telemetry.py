@@ -31,12 +31,12 @@ configured ``profileDir``; one capture at a time).
 from __future__ import annotations
 
 import os
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..utils.lockdep import new_lock
 from ..metrics import collector
 from ..utils.logging import get_logger
 from . import flight_recorder as fr
@@ -161,7 +161,7 @@ class ProfilerCapture:
 
     def __init__(self, profile_dir: str):
         self.profile_dir = profile_dir
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self.last: Optional[dict] = None
 
     def capture(self, duration_s: float = 1.0) -> dict:

@@ -50,6 +50,7 @@ KIND_DRAIN = "drain"
 KIND_OVERFLOW = "queue_overflow"
 KIND_ENGINE_REQUEST = "engine_request"
 KIND_PROFILE = "profile_capture"
+KIND_LOCKDEP = "lockdep"
 
 
 class FlightRecorder:

@@ -47,6 +47,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 from .tracing import active_span_names, process_identity
 
@@ -212,7 +213,7 @@ class SamplingProfiler:
     ):
         self.cfg = config or SamplingProfilerConfig(enabled=True)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._trie = _StackTrie(self.cfg.max_nodes)
         self._window_started = clock()
         self._window_samples = 0
@@ -226,7 +227,7 @@ class SamplingProfiler:
         self.overhead_s_total = 0.0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._capture_lock = threading.Lock()
+        self._capture_lock = new_lock()
 
     # -- sampling ----------------------------------------------------------
 

@@ -34,6 +34,8 @@ from typing import Callable, Dict, Optional
 
 from prometheus_client import Counter, Gauge
 
+from ..utils.lockdep import new_lock
+
 SLO_BURN_RATE = Gauge(
     "kvtpu_slo_burn_rate",
     "Error-budget burn rate per SLO and window",
@@ -93,7 +95,7 @@ class SLOTracker:
     ):
         self.config = config
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         # (ts, good, bad) event-count samples, pruned past the slow window.
         self._samples: deque = deque()
         self._alert = _AlertState()
@@ -259,7 +261,7 @@ class SLORegistry:
     max_edges: int = 512
     _edges: deque = field(default_factory=deque, repr=False)
     _edge_lock: threading.Lock = field(
-        default_factory=threading.Lock, repr=False)
+        default_factory=lambda: new_lock(), repr=False)
     _edge_seq: int = field(default=0, repr=False)
     edges_dropped: int = 0
 

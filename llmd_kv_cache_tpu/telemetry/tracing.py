@@ -44,6 +44,8 @@ except Exception:  # pragma: no cover - otel always present in this image
 
 import contextvars
 
+from ..utils.lockdep import new_lock
+
 _SERVICE_NAME = "llmd-kv-cache-tpu"
 
 _TRACEPARENT_RE = re.compile(
@@ -303,7 +305,7 @@ class InMemorySpanExporter:
     __slots__ = ("_lock", "_spans", "_max_spans", "_next_seq", "dropped")
 
     def __init__(self, max_spans: int = 10_000):
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._spans: deque[RecordedSpan] = deque(maxlen=max(1, int(max_spans)))
         self._max_spans = max(1, int(max_spans))
         self._next_seq = 0

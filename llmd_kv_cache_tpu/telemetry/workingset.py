@@ -51,13 +51,13 @@ like pyprof windows.
 
 from __future__ import annotations
 
-import threading
 import time
 import zlib
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
 
+from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 from .tracing import process_identity
 
@@ -277,7 +277,7 @@ class WorkingSetTracker:
         self.sample_rate = rate
         self._threshold = int(rate * (1 << 64))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = new_lock()
         self._scopes: Dict[str, _ScopeState] = {}
         # Spatial-filter memo: key -> bool(sampled). Steady-state cost of
         # an unsampled access is this one dict hit; cleared (cheaply

@@ -1,0 +1,7 @@
+"""Developer tooling shipped inside the package (static analyzers).
+
+Nothing under ``tools/`` is imported by the serving library; the hack/
+entry points (``hack/lint_concurrency.py``, ``hack/kvlint.py``) import it
+directly, and keeping it in-package lets the analyzers dogfood the same
+conventions (docstrings, lint passes) as the code they check.
+"""

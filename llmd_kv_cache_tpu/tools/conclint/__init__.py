@@ -1,0 +1,40 @@
+"""Whole-program concurrency analyzer (the ``make lint`` concurrency pass).
+
+Public surface:
+
+- :func:`analyze` — run the four rules over package roots, returning
+  :class:`Finding`s (``path:line: RULE message`` via ``Finding.format()``)
+- the ``RULE_*`` codes and :data:`MARKER_FOR_RULE` marker grammar
+
+Entry points: ``hack/lint_concurrency.py`` (standalone) and
+``hack/kvlint.py`` (the unified lint driver). The runtime counterpart —
+the lockdep witness that validates this static model under
+``make unit-test-race`` — lives in ``llmd_kv_cache_tpu/utils/lockdep.py``.
+See docs/testing.md "Concurrency analysis" for the rule catalog.
+"""
+
+from .analysis import (
+    MARKER_FOR_RULE,
+    RULE_BAD_MARKER,
+    RULE_BLOCKING,
+    RULE_CALLBACK,
+    RULE_LOCK_ORDER,
+    RULE_REENTRY,
+    RULE_SYNTAX,
+    Finding,
+    analyze,
+    load_program,
+)
+
+__all__ = [
+    "analyze",
+    "load_program",
+    "Finding",
+    "MARKER_FOR_RULE",
+    "RULE_REENTRY",
+    "RULE_LOCK_ORDER",
+    "RULE_BLOCKING",
+    "RULE_CALLBACK",
+    "RULE_BAD_MARKER",
+    "RULE_SYNTAX",
+]

@@ -1,0 +1,536 @@
+"""Whole-program assembly + the four concurrency rules.
+
+Consumes the per-module :class:`~.model.ModuleModel`s and reports:
+
+``CONC-REENTRY``
+    A non-reentrant ``threading.Lock`` re-acquired on a call path that
+    already holds it — the PR 3 ``_lag_mu`` self-deadlock class. Only
+    same-instance (``self.*``) call chains count, so a ``Pool`` calling
+    *another* object of the same class is not flagged (different
+    instance, different lock).
+
+``CONC-LOCK-ORDER``
+    A cycle in the global lock-acquisition-order graph. Edge A→B exists
+    when some method acquires B (directly, or through any resolvable
+    call, cross-class and cross-module) while holding A. Cycles mean two
+    threads can deadlock by taking the locks in opposite orders.
+
+``CONC-BLOCKING``
+    A blocking call — ``time.sleep``, socket/ZMQ ``recv*``,
+    ``Future.result``, blocking ``queue.get``/``join``, ``Event.wait``,
+    file/network IO — inside a lock region. Blocking under a lock turns
+    every other acquirer into a convoy (and, with IO, a priority
+    inversion). ``Condition.wait`` on the *held* condition is the
+    sanctioned pattern and exempt.
+
+``CONC-CALLBACK``
+    A user-supplied callable stored on ``self`` (publish hooks, failpoint
+    listeners, controller actuators, journal sinks…) invoked while a lock
+    is held. The callback can run arbitrary code — including re-entering
+    this object — so it must escape the critical section.
+
+Suppression: ``# lint: allow-<rule> (why)`` on the violation line or on
+the enclosing ``with`` line. The ``(why)`` is mandatory — a bare marker
+is itself a finding (``CONC-BAD-MARKER``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from .model import (
+    KIND_CONDITION,
+    KIND_EVENT,
+    KIND_LOCK,
+    KIND_QUEUE,
+    KIND_THREAD,
+    AcqSite,
+    CallSite,
+    ClassModel,
+    LockToken,
+    MethodModel,
+    ModuleModel,
+    extract_module,
+    module_name_for,
+)
+
+RULE_REENTRY = "CONC-REENTRY"
+RULE_LOCK_ORDER = "CONC-LOCK-ORDER"
+RULE_BLOCKING = "CONC-BLOCKING"
+RULE_CALLBACK = "CONC-CALLBACK"
+RULE_BAD_MARKER = "CONC-BAD-MARKER"
+RULE_SYNTAX = "CONC-SYNTAX"
+
+# rule code -> marker suffix ("# lint: allow-<suffix> (why)")
+MARKER_FOR_RULE = {
+    RULE_REENTRY: "reentry",
+    RULE_LOCK_ORDER: "lock-order",
+    RULE_BLOCKING: "blocking",
+    RULE_CALLBACK: "callback",
+}
+_CONC_MARKERS = frozenset(MARKER_FOR_RULE.values())
+
+# Dotted-name calls that block the calling thread. Matched on the
+# resolved name (via imports) so aliases still hit.
+_BLOCKING_DOTTED = frozenset({
+    "time.sleep",
+    "open",
+    "os.fsync", "os.replace", "os.rename",
+    "select.select",
+    "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "urllib.request.urlopen",
+})
+# Method names that block regardless of receiver type (sockets, gRPC
+# streams, ZMQ sockets, futures — receivers the type pass can't see).
+_BLOCKING_METHODS = frozenset({
+    "recv", "recv_multipart", "recv_string", "recv_json", "recv_pyobj",
+    "result",
+})
+# Injected pure-value callables that are safe under a lock by contract
+# (a clock reads time; it cannot call back into the locking object).
+_CALLBACK_EXEMPT_ATTRS = frozenset({"clock", "_clock", "now", "_now"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass(frozen=True)
+class _Edge:
+    src: LockToken
+    dst: LockToken
+    path: str
+    line: int
+    region_line: int
+    via: str  # human-readable provenance ("Pool.lag_stats → ...")
+
+
+class Program:
+    """All modules, with cross-module class/function resolution."""
+
+    def __init__(self, modules: list[ModuleModel]):
+        self.modules = modules
+        self.classes: dict[str, ClassModel] = {}
+        self.functions: dict[str, MethodModel] = {}
+        self._method_module: dict[str, ModuleModel] = {}
+        for mm in modules:
+            for cls in mm.classes.values():
+                self.classes[cls.qualname] = cls
+                for m in cls.methods.values():
+                    self._method_module[m.qualname] = mm
+            for name, fn in mm.functions.items():
+                self.functions[f"{mm.module}.{name}"] = fn
+                self._method_module[fn.qualname] = mm
+        # method qualname -> transitive may-acquire set (filled lazily)
+        self._may_acquire: dict[str, frozenset] = {}
+
+    # -- resolution --------------------------------------------------------
+
+    def module_of(self, method: MethodModel) -> ModuleModel:
+        return self._method_module[method.qualname]
+
+    def resolve_class(self, dotted: str, from_module: str) -> Optional[ClassModel]:
+        cls = self.classes.get(dotted)
+        if cls is not None:
+            return cls
+        if "." not in dotted:  # same-module reference
+            return self.classes.get(f"{from_module}.{dotted}")
+        return None
+
+    def mro_method(self, cls: ClassModel, name: str) -> Optional[MethodModel]:
+        """Method lookup through project-resolvable bases (simple DFS)."""
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            if name in c.methods:
+                return c.methods[name]
+            mod = c.qualname.rsplit(".", 1)[0]
+            for base in c.bases:
+                b = self.resolve_class(base, mod)
+                if b is not None:
+                    stack.append(b)
+        return None
+
+    def owner_class(self, method: MethodModel) -> Optional[ClassModel]:
+        owner = method.qualname.rsplit(".", 2)
+        if len(owner) < 2:
+            return None
+        return self.classes.get(".".join(owner[:-1]))
+
+    def attr_class(self, cls: ClassModel, attr: str) -> Optional[ClassModel]:
+        dotted = cls.attr_types.get(attr)
+        if not dotted:
+            return None
+        return self.resolve_class(dotted, cls.qualname.rsplit(".", 1)[0])
+
+    def call_targets(self, caller: MethodModel, site: CallSite) -> list[MethodModel]:
+        """Project methods/functions a call site can reach (may be empty)."""
+        kind = site.desc[0]
+        cls = self.owner_class(caller)
+        mod = self.module_of(caller)
+        if kind == "self_attr":
+            if cls is None:
+                return []
+            m = self.mro_method(cls, site.desc[1])
+            return [m] if m is not None else []
+        if kind == "attr_method":
+            if cls is None:
+                return []
+            target_cls = self.attr_class(cls, site.desc[1])
+            if target_cls is None:
+                return []
+            m = self.mro_method(target_cls, site.desc[2])
+            return [m] if m is not None else []
+        if kind == "name":
+            dotted = site.desc[1]
+            fn = self.functions.get(dotted)
+            if fn is None and "." not in dotted:
+                fn = self.functions.get(f"{mod.module}.{dotted}")
+            if fn is not None:
+                return [fn]
+            # Calling a class constructs it: treat as a call to __init__.
+            target_cls = self.classes.get(dotted) or (
+                self.classes.get(f"{mod.module}.{dotted}")
+                if "." not in dotted else None)
+            if target_cls is not None:
+                init = self.mro_method(target_cls, "__init__")
+                return [init] if init is not None else []
+        return []
+
+    # -- transitive may-acquire -------------------------------------------
+
+    def may_acquire(self, method: MethodModel) -> frozenset:
+        """Lock tokens ``method`` may acquire, transitively (fixpoint)."""
+        cached = self._may_acquire.get(method.qualname)
+        if cached is not None:
+            return cached
+        # Iterative DFS with cycle tolerance: start everything reachable
+        # at its direct set, then propagate to a fixpoint.
+        reach = self._reachable(method)
+        direct = {
+            m.qualname: {a.token for a in m.acquisitions}
+            for m in reach.values()
+        }
+        edges = {
+            m.qualname: [t.qualname for site in m.calls
+                         for t in self.call_targets(m, site)]
+            for m in reach.values()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, callees in edges.items():
+                for c in callees:
+                    if c in direct and not direct[c] <= direct[q]:
+                        direct[q] |= direct[c]
+                        changed = True
+        for q, toks in direct.items():
+            self._may_acquire[q] = frozenset(toks)
+        return self._may_acquire[method.qualname]
+
+    def _reachable(self, method: MethodModel) -> dict:
+        out = {}
+        stack = [method]
+        while stack:
+            m = stack.pop()
+            if m.qualname in out:
+                continue
+            out[m.qualname] = m
+            for site in m.calls:
+                stack.extend(self.call_targets(m, site))
+        return out
+
+    # -- same-instance reentry closure ------------------------------------
+
+    def self_reacquire(self, cls: ClassModel, method: MethodModel) -> frozenset:
+        """Self-lock tokens reachable through ``self.*`` calls only."""
+        out: set = set()
+        seen: set = set()
+        stack = [method]
+        while stack:
+            m = stack.pop()
+            if m.qualname in seen:
+                continue
+            seen.add(m.qualname)
+            out.update(a.token for a in m.acquisitions
+                       if a.token.cls == cls.qualname)
+            for site in m.calls:
+                if site.desc[0] != "self_attr":
+                    continue
+                target = self.mro_method(cls, site.desc[1])
+                if target is not None:
+                    stack.append(target)
+        return frozenset(out)
+
+
+# -- suppression --------------------------------------------------------------
+
+
+class _Suppressor:
+    """Marker lookup + bad-marker findings for one module."""
+
+    def __init__(self, mm: ModuleModel):
+        self.mm = mm
+        self.path = str(mm.path)
+
+    def allows(self, rule: str, *lines: int) -> bool:
+        suffix = MARKER_FOR_RULE.get(rule)
+        if suffix is None:
+            return False
+        for line in lines:
+            for marker in self.mm.markers.get(line, ()):
+                if marker.rule == suffix and marker.reason:
+                    return True
+        return False
+
+    def bad_marker_findings(self) -> list[Finding]:
+        out = []
+        for line, markers in sorted(self.mm.markers.items()):
+            for marker in markers:
+                if marker.rule in _CONC_MARKERS and not marker.reason:
+                    out.append(Finding(
+                        self.path, line, RULE_BAD_MARKER,
+                        f"suppression `allow-{marker.rule}` without a "
+                        "reason — write `# lint: allow-"
+                        f"{marker.rule} (why)`",
+                    ))
+        return out
+
+
+# -- rule evaluation ----------------------------------------------------------
+
+
+def _is_blocking(prog: Program, cls: Optional[ClassModel],
+                 site: CallSite) -> Optional[str]:
+    """Short description when the call blocks the thread (else None)."""
+    kind = site.desc[0]
+    if kind == "name":
+        dotted = site.desc[1]
+        if dotted in _BLOCKING_DOTTED:
+            return f"`{dotted}()`"
+        return None
+    if kind != "attr_method" or cls is None:
+        if kind == "attr_method":  # no class context → only method-name hits
+            m = site.desc[2]
+            if m in _BLOCKING_METHODS:
+                return f"`.{m}()`"
+        return None
+    attr, m = site.desc[1], site.desc[2]
+    attr_kind = cls.attr_kinds.get(attr, "")
+    if attr in cls.lock_attrs:
+        lk = cls.lock_attrs[attr]
+        if m == "wait" and lk == KIND_CONDITION:
+            # Condition.wait releases the lock — the sanctioned pattern
+            # when the condition itself is the held lock.
+            held_attrs = {t.attr for t in site.held if t.cls == cls.qualname}
+            if attr in held_attrs:
+                return None
+            return f"`self.{attr}.wait()` (condition not held here)"
+        if m == "acquire" and "blocking" not in site.kwargs \
+                and "timeout" not in site.kwargs:
+            return f"blocking `self.{attr}.acquire()`"
+        return None
+    if m in _BLOCKING_METHODS:
+        return f"`self.{attr}.{m}()`"
+    if m == "get" and attr_kind == KIND_QUEUE:
+        if "block" in site.kwargs or "timeout" in site.kwargs:
+            return f"`self.{attr}.get(...)`"
+        return f"blocking `self.{attr}.get()`"
+    if m == "join" and attr_kind in (KIND_QUEUE, KIND_THREAD):
+        return f"`self.{attr}.join()`"
+    if m == "wait" and attr_kind == KIND_EVENT:
+        return f"`self.{attr}.wait()`"
+    return None
+
+
+def _check_method(prog: Program, mm: ModuleModel, cls: Optional[ClassModel],
+                  method: MethodModel, sup: _Suppressor,
+                  findings: list, edges: list) -> None:
+    path = str(mm.path)
+    short = method.qualname.split(".", mm.module.count(".") + 1)[-1]
+
+    # direct re-acquisition + ordering edges from nested `with`s
+    for acq in method.acquisitions:
+        if acq.token in acq.held_before and acq.token.kind == KIND_LOCK:
+            if not sup.allows(RULE_REENTRY, acq.line, acq.region_line):
+                findings.append(Finding(
+                    path, acq.line, RULE_REENTRY,
+                    f"`{short}` re-acquires non-reentrant `self."
+                    f"{acq.token.attr}` already held — self-deadlock",
+                ))
+        for held in acq.held_before:
+            if held != acq.token:
+                edges.append(_Edge(held, acq.token, path, acq.line,
+                                   acq.region_line, short))
+
+    for site in method.calls:
+        if not site.held:
+            continue
+        # CONC-BLOCKING
+        desc = _is_blocking(prog, cls, site)
+        if desc is not None:
+            held = ", ".join(f"self.{t.attr}" for t in site.held)
+            if not sup.allows(RULE_BLOCKING, site.line, site.region_line):
+                findings.append(Finding(
+                    path, site.line, RULE_BLOCKING,
+                    f"{desc} blocks while holding {held} in `{short}` — "
+                    "move the blocking work outside the critical section",
+                ))
+            continue
+        # CONC-CALLBACK: stored-callable invocation under a lock
+        if site.desc[0] in ("self_attr", "attr_value") and cls is not None:
+            attr = site.desc[1]
+            is_method = prog.mro_method(cls, attr) is not None \
+                and site.desc[0] == "self_attr"
+            known_attr = attr in cls.lock_attrs or attr in cls.attr_kinds \
+                or attr in cls.attr_types
+            if not is_method and not known_attr \
+                    and attr not in _CALLBACK_EXEMPT_ATTRS:
+                held = ", ".join(f"self.{t.attr}" for t in site.held)
+                if not sup.allows(RULE_CALLBACK, site.line, site.region_line):
+                    findings.append(Finding(
+                        path, site.line, RULE_CALLBACK,
+                        f"callback `self.{attr}(...)` invoked while holding "
+                        f"{held} in `{short}` — escaping hooks must run "
+                        "outside the lock",
+                    ))
+                continue
+        # CONC-REENTRY through same-instance call chains
+        if site.desc[0] == "self_attr" and cls is not None:
+            target = prog.mro_method(cls, site.desc[1])
+            if target is not None:
+                reacq = prog.self_reacquire(cls, target)
+                hit = next(
+                    (t for t in site.held
+                     if t.kind == KIND_LOCK and t in reacq), None)
+                if hit is not None and not sup.allows(
+                        RULE_REENTRY, site.line, site.region_line):
+                    findings.append(Finding(
+                        path, site.line, RULE_REENTRY,
+                        f"`{short}` calls `self.{site.desc[1]}()` while "
+                        f"holding non-reentrant `self.{hit.attr}`, which "
+                        "that call path re-acquires — self-deadlock",
+                    ))
+        # CONC-LOCK-ORDER edges through any resolvable call
+        for target in prog.call_targets(method, site):
+            for tok in prog.may_acquire(target):
+                for held in site.held:
+                    if held != tok:
+                        edges.append(_Edge(
+                            held, tok, path, site.line, site.region_line,
+                            f"{short} → {target.qualname.rsplit('.', 2)[-2]}."
+                            f"{target.qualname.rsplit('.', 1)[-1]}"))
+
+
+def _cycle_findings(edges: list, suppressors: dict) -> list:
+    """Cycle detection over the lock-order graph (marker-pruned edges)."""
+    live: list[_Edge] = []
+    for e in edges:
+        sup = suppressors.get(e.path)
+        if sup is not None and sup.allows(RULE_LOCK_ORDER, e.line, e.region_line):
+            continue
+        live.append(e)
+    graph: dict[LockToken, set] = {}
+    for e in live:
+        graph.setdefault(e.src, set()).add(e.dst)
+
+    # The lock graph is tiny (one node per lock *role*), so plain
+    # transitive closure + mutual-reachability grouping is the simplest
+    # correct SCC computation — no recursion limits, no index juggling.
+    nodes = set(graph) | {d for dsts in graph.values() for d in dsts}
+    reach: dict[LockToken, set] = {n: set(graph.get(n, ())) for n in nodes}
+    changed = True
+    while changed:
+        changed = False
+        for n in nodes:
+            add: set = set()
+            for m in reach[n]:
+                add |= reach.get(m, set())
+            if not add <= reach[n]:
+                reach[n] |= add
+                changed = True
+
+    sccs: list[set] = []
+    assigned: set = set()
+    for n in nodes:
+        if n in assigned:
+            continue
+        comp = {n} | {m for m in reach[n] if n in reach.get(m, set())}
+        assigned |= comp
+        if len(comp) > 1:
+            sccs.append(comp)
+
+    findings = []
+    for comp_set in sccs:
+        cyc_edges = [e for e in live
+                     if e.src in comp_set and e.dst in comp_set]
+        cyc_edges.sort(key=lambda e: (e.path, e.line))
+        names = " ↔ ".join(sorted({str(t) for t in comp_set}))
+        sites = "; ".join(
+            f"{e.src}→{e.dst} at {e.path}:{e.line} (via {e.via})"
+            for e in cyc_edges[:4])
+        anchor = cyc_edges[0]
+        findings.append(Finding(
+            anchor.path, anchor.line, RULE_LOCK_ORDER,
+            f"lock-order cycle {names}: {sites} — acquire these locks in "
+            "one global order (or break an edge with "
+            "`# lint: allow-lock-order (why)`)",
+        ))
+    return findings
+
+
+# -- entry points -------------------------------------------------------------
+
+
+def load_program(roots: list) -> tuple:
+    """Parse every .py under the roots → (Program, [syntax Findings])."""
+    modules: list[ModuleModel] = []
+    findings: list[Finding] = []
+    for root in roots:
+        root = Path(root)
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        pkg_root = root if root.is_dir() else root.parent
+        for f in files:
+            try:
+                module = module_name_for(f, pkg_root)
+            except ValueError:
+                module = f.stem
+            mm = extract_module(f, module)
+            if mm.syntax_error:
+                findings.append(Finding(
+                    str(f), 0, RULE_SYNTAX, mm.syntax_error))
+                continue
+            modules.append(mm)
+    return Program(modules), findings
+
+
+def analyze(roots: list) -> list:
+    """Run all rules over the given roots; returns sorted Findings."""
+    prog, findings = load_program(roots)
+    suppressors = {str(mm.path): _Suppressor(mm) for mm in prog.modules}
+    edges: list[_Edge] = []
+    for mm in prog.modules:
+        sup = suppressors[str(mm.path)]
+        findings.extend(sup.bad_marker_findings())
+        for cls in mm.classes.values():
+            for method in cls.methods.values():
+                _check_method(prog, mm, cls, method, sup, findings, edges)
+        for fn in mm.functions.values():
+            _check_method(prog, mm, None, fn, sup, findings, edges)
+    findings.extend(_cycle_findings(edges, suppressors))
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,538 @@
+"""Per-module AST extraction for the concurrency analyzer.
+
+This layer turns one Python source file into a :class:`ModuleModel`:
+which classes exist, which ``self.<attr>`` attributes are locks (or
+queues / events / threads / other project classes), and — per method —
+every lock acquisition and every call *with the set of locks held at
+that point*. The cross-module assembly and the actual rules live in
+:mod:`.analysis`; nothing here decides what is a violation.
+
+Lock identity is ``(class qualname, attribute name)``: the analyzer
+reasons about lock *roles*, not instances (the same abstraction the
+runtime witness in ``utils/lockdep.py`` uses, which keys locks by
+construction site). Two instances of the same class share a lock token —
+strict, like kernel lockdep, and exactly what makes whole-program
+ordering checkable.
+
+Recognized lock constructors: ``threading.Lock/RLock/Condition`` and the
+project's own ``utils.lockdep.new_lock/new_rlock/new_condition``
+factories (the production spelling after this PR).
+
+Known limitations, by design (kept conservative to avoid false
+positives; the runtime witness covers the residue):
+
+- instance identity is erased — ``self.helper.method()`` where helper is
+  the *same* class is treated as a different instance's lock for the
+  reentry rule (only ``self.*`` call chains count);
+- nested function / lambda bodies are not attributed to the enclosing
+  lock region (they usually run later, on other threads);
+- ``lock.acquire()`` / ``release()`` pairs are recorded as acquisition
+  *events* for ordering, but do not open a held region (extent is not
+  statically obvious); ``acquire(blocking=False)`` try-locks are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+# attr kinds the analyzer distinguishes (beyond project-class types)
+KIND_LOCK = "lock"            # threading.Lock / lockdep.new_lock
+KIND_RLOCK = "rlock"          # threading.RLock / lockdep.new_rlock
+KIND_CONDITION = "condition"  # threading.Condition / lockdep.new_condition
+KIND_EVENT = "event"          # threading.Event
+KIND_THREAD = "thread"        # threading.Thread
+KIND_QUEUE = "queue"          # queue.Queue / LifoQueue / PriorityQueue / SimpleQueue
+KIND_SEMAPHORE = "semaphore"  # threading.(Bounded)Semaphore
+
+LOCK_KINDS = (KIND_LOCK, KIND_RLOCK, KIND_CONDITION)
+
+# Constructor dotted-name → attr kind. ``new_*`` factories are matched by
+# suffix so both absolute and package-relative resolutions hit.
+_CTOR_KINDS = {
+    "threading.Lock": KIND_LOCK,
+    "threading.RLock": KIND_RLOCK,
+    "threading.Condition": KIND_CONDITION,
+    "threading.Event": KIND_EVENT,
+    "threading.Thread": KIND_THREAD,
+    "threading.Semaphore": KIND_SEMAPHORE,
+    "threading.BoundedSemaphore": KIND_SEMAPHORE,
+    "queue.Queue": KIND_QUEUE,
+    "queue.LifoQueue": KIND_QUEUE,
+    "queue.PriorityQueue": KIND_QUEUE,
+    "queue.SimpleQueue": KIND_QUEUE,
+}
+_FACTORY_SUFFIXES = {
+    "lockdep.new_lock": KIND_LOCK,
+    "lockdep.new_rlock": KIND_RLOCK,
+    "lockdep.new_condition": KIND_CONDITION,
+}
+
+# ``# lint: allow-<rule> (why)`` — same grammar as lint_resilience's
+# allow-swallow, but the reason is mandatory for concurrency rules.
+MARKER_RE = re.compile(r"#\s*lint:\s*allow-([a-z][a-z0-9-]*)\s*(\(([^)]*)\))?")
+
+
+@dataclass(frozen=True)
+class LockToken:
+    """One lock *role*: the ``self._mu`` of a specific class."""
+
+    cls: str   # class qualname ("pkg.mod.Class")
+    attr: str  # attribute name ("_mu")
+    kind: str  # KIND_LOCK | KIND_RLOCK | KIND_CONDITION
+
+    def __str__(self) -> str:  # pragma: no cover - repr convenience
+        return f"{self.cls.rsplit('.', 1)[-1]}.{self.attr}"
+
+
+@dataclass(frozen=True)
+class CallSite:
+    """One call expression and the lock context it runs under.
+
+    ``desc`` is the resolution-ready descriptor:
+
+    - ``("self_attr", name)``         — ``self.name(...)``; the analysis
+      phase decides whether ``name`` is a method (call edge) or a stored
+      callable (escaping-callback rule)
+    - ``("attr_method", attr, m)``    — ``self.attr.m(...)`` (or via a
+      local alias of ``self.attr`` / an element of ``self.attr``)
+    - ``("attr_value", attr)``        — call of a *value read from*
+      ``self.attr`` through a local alias (``fn = self.hooks[0]; fn()``)
+    - ``("name", dotted)``            — import-resolved dotted call
+      ("time.sleep", "pkg.mod.fn", "open")
+    """
+
+    desc: tuple
+    line: int
+    held: tuple  # LockTokens held at the call, outermost first
+    region_line: int  # line of the innermost `with` that holds a lock (0 = none)
+    kwargs: tuple = ()  # keyword-argument names (blocking-rule heuristics)
+
+
+@dataclass(frozen=True)
+class AcqSite:
+    """One lock acquisition (``with self._mu:`` entry or ``.acquire()``)."""
+
+    token: LockToken
+    line: int
+    held_before: tuple  # LockTokens already held, outermost first
+    region_line: int
+
+
+@dataclass
+class MethodModel:
+    qualname: str  # "pkg.mod.Class.method" or "pkg.mod.func"
+    calls: list = field(default_factory=list)  # [CallSite]
+    acquisitions: list = field(default_factory=list)  # [AcqSite]
+
+
+@dataclass
+class ClassModel:
+    qualname: str
+    bases: list = field(default_factory=list)  # dotted base-class names
+    lock_attrs: dict = field(default_factory=dict)  # attr -> kind
+    attr_kinds: dict = field(default_factory=dict)  # attr -> KIND_* (queue/event/...)
+    attr_types: dict = field(default_factory=dict)  # attr -> dotted class name
+    methods: dict = field(default_factory=dict)  # name -> MethodModel
+
+
+@dataclass
+class Marker:
+    rule: str          # "reentry" / "lock-order" / ...
+    line: int
+    reason: str        # "" when the (why) is missing
+
+
+@dataclass
+class ModuleModel:
+    path: Path
+    module: str  # dotted module name
+    classes: dict = field(default_factory=dict)  # name -> ClassModel
+    functions: dict = field(default_factory=dict)  # name -> MethodModel
+    markers: dict = field(default_factory=dict)  # line -> [Marker]
+    syntax_error: Optional[str] = None
+
+
+# -- import resolution --------------------------------------------------------
+
+
+def _resolve_imports(tree: ast.Module, module: str) -> dict:
+    """Local name → dotted path, for modules and imported symbols."""
+    pkg_parts = module.split(".")[:-1]
+    table: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                table[local] = alias.name.split(".")[0] if alias.asname is None \
+                    else alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                src = ".".join(base + ([node.module] if node.module else []))
+            else:
+                src = node.module or ""
+            for alias in node.names:
+                local = alias.asname or alias.name
+                table[local] = f"{src}.{alias.name}" if src else alias.name
+    return table
+
+
+def _dotted(expr: ast.AST, imports: dict) -> str:
+    """Best-effort dotted name of an expression (``""`` when dynamic)."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return ""
+    head = imports.get(node.id, node.id)
+    parts.append(head)
+    return ".".join(reversed(parts))
+
+
+def _ctor_kind(dotted: str) -> str:
+    """Attr kind for a constructor dotted name ("" = not a known ctor)."""
+    kind = _CTOR_KINDS.get(dotted, "")
+    if kind:
+        return kind
+    for suffix, k in _FACTORY_SUFFIXES.items():
+        if dotted == suffix or dotted.endswith("." + suffix) \
+                or dotted.endswith("." + suffix.split(".")[-1]):
+            # "new_lock" imported bare still counts: the name is unique
+            # enough in this codebase to key on.
+            if dotted.rsplit(".", 1)[-1] == suffix.rsplit(".", 1)[-1]:
+                return k
+    return ""
+
+
+def _annotation_class(ann: ast.AST, imports: dict) -> str:
+    """Dotted class from an annotation, unwrapping Optional[...] etc."""
+    if isinstance(ann, ast.Subscript):  # Optional[X], list[X], "ClassVar[X]"
+        return _annotation_class(ann.slice, imports)
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return imports.get(ann.value, ann.value)
+    name = _dotted(ann, imports)
+    return name
+
+
+# -- per-function walking -----------------------------------------------------
+
+
+class _FnWalker:
+    """Walks one function body tracking the stack of held self-locks."""
+
+    def __init__(self, cls: Optional[ClassModel], imports: dict,
+                 method: MethodModel):
+        self.cls = cls
+        self.imports = imports
+        self.method = method
+        self.held: list[LockToken] = []
+        self.region_lines: list[int] = []
+        self.aliases: dict[str, tuple] = {}  # name -> ("attr"|"attr_ele", attr)
+
+    # - lock bookkeeping -
+
+    def _self_attr(self, expr: ast.AST) -> str:
+        """attr name iff ``expr`` is ``self.<attr>`` (else "")."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return expr.attr
+        return ""
+
+    def _lock_token(self, expr: ast.AST) -> Optional[LockToken]:
+        if self.cls is None:
+            return None
+        attr = self._self_attr(expr)
+        if attr and attr in self.cls.lock_attrs:
+            return LockToken(self.cls.qualname, attr, self.cls.lock_attrs[attr])
+        return None
+
+    def _region_line(self) -> int:
+        return self.region_lines[-1] if self.region_lines else 0
+
+    # - traversal -
+
+    def walk_body(self, stmts: list) -> None:
+        for stmt in stmts:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.With):
+            self._walk_with(stmt)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs execute later, not under this region
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._track_alias(stmt)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._track_loop_alias(stmt)
+        # Expressions hanging off this statement run under the current
+        # region; child *statements* recurse so nested withs are handled.
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self.walk_stmt(child)
+            elif isinstance(child, ast.ExceptHandler):
+                self.walk_body(child.body)
+            elif isinstance(child, ast.expr):
+                self.walk_expr(child)
+            # arguments/keywords/etc fall out of iter_child_nodes as
+            # non-stmt non-expr nodes only for defs, skipped above
+
+    def _walk_with(self, stmt: ast.With) -> None:
+        acquired: list[LockToken] = []
+        pushed_region = False
+        for item in stmt.items:
+            self.walk_expr(item.context_expr)
+            tok = self._lock_token(item.context_expr)
+            if tok is not None:
+                self.method.acquisitions.append(AcqSite(
+                    token=tok,
+                    line=item.context_expr.lineno,
+                    held_before=tuple(self.held),
+                    region_line=stmt.lineno,
+                ))
+                self.held.append(tok)
+                acquired.append(tok)
+                if not pushed_region:
+                    self.region_lines.append(stmt.lineno)
+                    pushed_region = True
+        self.walk_body(stmt.body)
+        for _ in acquired:
+            self.held.pop()
+        if pushed_region:
+            self.region_lines.pop()
+
+    def walk_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue  # body runs later
+            if isinstance(node, ast.Call):
+                self._record_call(node)
+
+    # - aliases -
+
+    def _track_alias(self, stmt: ast.stmt) -> None:
+        target = None
+        value = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            target, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            target, value = stmt.target.id, stmt.value
+        if target is None:
+            return
+        self.aliases.pop(target, None)
+        if value is None:
+            return
+        attr = self._self_attr(value)
+        if attr:
+            self.aliases[target] = ("attr", attr)
+        elif isinstance(value, ast.Subscript):
+            attr = self._self_attr(value.value)
+            if attr:
+                self.aliases[target] = ("attr_ele", attr)
+
+    def _track_loop_alias(self, stmt) -> None:
+        if not isinstance(stmt.target, ast.Name):
+            return
+        it = stmt.iter
+        # for x in self.attr / self.attr.values() / list(self.attr)
+        attr = self._self_attr(it)
+        if not attr and isinstance(it, ast.Call):
+            if isinstance(it.func, ast.Attribute):
+                attr = self._self_attr(it.func.value)
+            elif isinstance(it.func, ast.Name) and it.args:
+                attr = self._self_attr(it.args[0])
+        if attr:
+            self.aliases[stmt.target.id] = ("attr_ele", attr)
+
+    # - call recording -
+
+    def _record_call(self, call: ast.Call) -> None:
+        desc = self._describe(call)
+        if desc is None:
+            return
+        self.method.calls.append(CallSite(
+            desc=desc,
+            line=call.lineno,
+            held=tuple(self.held),
+            region_line=self._region_line(),
+            kwargs=tuple(kw.arg for kw in call.keywords if kw.arg),
+        ))
+
+    def _describe(self, call: ast.Call) -> Optional[tuple]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                # self.x(...): method call or stored-callable invocation —
+                # the analysis phase decides which, once methods are known.
+                return ("self_attr", fn.attr)
+            attr = self._self_attr(base)
+            if attr:  # self.attr.m(...)
+                return ("attr_method", attr, fn.attr)
+            if isinstance(base, ast.Name):
+                alias = self.aliases.get(base.id)
+                if alias is not None:  # q.get() where q = self._queues[i]
+                    return ("attr_method", alias[1], fn.attr)
+                dotted = _dotted(fn, self.imports)
+                if dotted:
+                    return ("name", dotted)
+                return None
+            if isinstance(base, ast.Subscript):
+                attr = self._self_attr(base.value)
+                if attr:  # self._queues[i].get()
+                    return ("attr_method", attr, fn.attr)
+            dotted = _dotted(fn, self.imports)
+            if dotted:
+                return ("name", dotted)
+            return None
+        if isinstance(fn, ast.Name):
+            if fn.id == "self":
+                return None
+            alias = self.aliases.get(fn.id)
+            if alias is not None:  # fn() where fn = self.publish / iter ele
+                return ("attr_value", alias[1])
+            return ("name", self.imports.get(fn.id, fn.id))
+        # self.something(...) arrives as Attribute(value=Name self)
+        return None
+
+
+# -- class / module extraction ------------------------------------------------
+
+
+def _extract_class(node: ast.ClassDef, module: str, imports: dict) -> ClassModel:
+    cls = ClassModel(qualname=f"{module}.{node.name}")
+    for base in node.bases:
+        dotted = _dotted(base, imports)
+        if dotted:
+            cls.bases.append(dotted)
+    # Class-body annotated fields (dataclasses): pick up lock kinds from
+    # `field(default_factory=new_lock)` and attr types from annotations.
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            attr = stmt.target.id
+            ann_cls = _annotation_class(stmt.annotation, imports)
+            kind = _ctor_kind(ann_cls)
+            if isinstance(stmt.value, ast.Call):
+                factory = next(
+                    (kw.value for kw in stmt.value.keywords
+                     if kw.arg == "default_factory"), None)
+                if factory is not None:
+                    fkind = _ctor_kind(_dotted(factory, imports))
+                    if fkind:
+                        kind = fkind
+            if kind in LOCK_KINDS:
+                cls.lock_attrs[attr] = kind
+            elif kind:
+                cls.attr_kinds[attr] = kind
+            elif ann_cls and ann_cls.rsplit(".", 1)[-1][:1].isupper():
+                cls.attr_types.setdefault(attr, ann_cls)
+
+    # First pass over methods: find self.<attr> assignments/annotations.
+    for fn in node.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(fn):
+            attr = None
+            value = None
+            ann = None
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t = sub.targets[0]
+                if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attr, value = t.attr, sub.value
+            elif isinstance(sub, ast.AnnAssign):
+                t = sub.target
+                if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attr, value, ann = t.attr, sub.value, sub.annotation
+            if attr is None:
+                continue
+            kind = ""
+            type_name = ""
+            if isinstance(value, ast.Call):
+                dotted = _dotted(value.func, imports)
+                kind = _ctor_kind(dotted)
+                if not kind and dotted and dotted.rsplit(".", 1)[-1][:1].isupper():
+                    type_name = dotted
+            elif isinstance(value, (ast.List, ast.ListComp)):
+                # self._queues = [queue.Queue(...) for ...] — element kind
+                elt = value.elts[0] if isinstance(value, ast.List) and value.elts \
+                    else getattr(value, "elt", None)
+                if isinstance(elt, ast.Call):
+                    ekind = _ctor_kind(_dotted(elt.func, imports))
+                    if ekind:
+                        kind = ekind  # list-of-<kind>: element calls resolve
+            if not kind and ann is not None:
+                ann_cls = _annotation_class(ann, imports)
+                akind = _ctor_kind(ann_cls)
+                if akind:
+                    kind = akind
+                elif ann_cls and "." in ann_cls:
+                    type_name = ann_cls
+            if kind in LOCK_KINDS:
+                cls.lock_attrs.setdefault(attr, kind)
+            elif kind:
+                cls.attr_kinds.setdefault(attr, kind)
+            elif type_name:
+                cls.attr_types.setdefault(attr, type_name)
+
+    # Second pass: walk each method with lock-region tracking.
+    for fn in node.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        method = MethodModel(qualname=f"{cls.qualname}.{fn.name}")
+        walker = _FnWalker(cls, imports, method)
+        walker.walk_body(fn.body)
+        cls.methods[fn.name] = method
+    return cls
+
+
+def extract_markers(src: str) -> dict:
+    markers: dict[int, list[Marker]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        if "lint:" not in line:
+            continue
+        for m in MARKER_RE.finditer(line):
+            markers.setdefault(i, []).append(
+                Marker(rule=m.group(1), line=i,
+                       reason=(m.group(3) or "").strip()))
+    return markers
+
+
+def extract_module(path: Path, module: str) -> ModuleModel:
+    src = path.read_text()
+    mm = ModuleModel(path=path, module=module)
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        mm.syntax_error = f"line {e.lineno}: {e.msg}"
+        return mm
+    imports = _resolve_imports(tree, module)
+    mm.markers = extract_markers(src)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mm.classes[node.name] = _extract_class(node, module, imports)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            method = MethodModel(qualname=f"{module}.{node.name}")
+            walker = _FnWalker(None, imports, method)
+            walker.walk_body(node.body)
+            mm.functions[node.name] = method
+    return mm
+
+
+def module_name_for(path: Path, root: Path) -> str:
+    """Dotted module name of ``path`` relative to package root ``root``."""
+    rel = path.relative_to(root.parent)
+    parts = list(rel.with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)

@@ -8,9 +8,10 @@ non-promoting ``peek`` so maintenance scans (Clear) don't distort recency
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Generic, Hashable, Iterator, Optional, TypeVar
+
+from .lockdep import new_lock
 
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
@@ -26,7 +27,7 @@ class LRUCache(Generic[K, V]):
             raise ValueError(f"LRU capacity must be positive, got {capacity}")
         self.capacity = capacity
         self._data: "OrderedDict[K, V]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = new_lock()
 
     def get(self, key: K, default: Optional[V] = None) -> Optional[V]:
         """Return value for ``key``, promoting it to most-recently-used."""

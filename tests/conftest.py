@@ -72,3 +72,22 @@ def _clear_jax_caches_between_modules():
     import jax
 
     jax.clear_caches()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _reset_lockdep_between_modules():
+    """Clear the lockdep order graph at module boundaries.
+
+    Under ``KVTPU_LOCKDEP=1`` the witness accumulates lock-order edges
+    process-wide. Edges observed by one module's wiring are real for
+    *that* wiring, but two modules that assemble components differently
+    can legitimately acquire the same lock roles in different orders
+    without either assembly being deadlock-prone. Module scope keeps the
+    witness sensitive within a module (where one wiring holds) and
+    unopinionated across them. No-op when the witness is disabled.
+    """
+    yield
+    from llmd_kv_cache_tpu.utils import lockdep
+
+    if lockdep.enabled():
+        lockdep.reset()

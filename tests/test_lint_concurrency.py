@@ -1,0 +1,462 @@
+"""Concurrency analysis plane: static analyzer + runtime lockdep witness.
+
+Two halves, mirroring the plane itself:
+
+- ``llmd_kv_cache_tpu.tools.conclint`` (the ``make lint`` concurrency
+  pass): each of the four rules fires exactly once on a seeded-bug
+  fixture package, ``# lint: allow-<rule> (why)`` markers suppress with
+  a reason and are themselves findings without one, and the call graph
+  resolves across modules (including ``TYPE_CHECKING``-only imports
+  used for attribute type annotations).
+- ``llmd_kv_cache_tpu.utils.lockdep`` (the ``KVTPU_LOCKDEP=1`` runtime
+  witness under ``make unit-test-race`` / ``make chaos``): cycle
+  detection, re-entry detection, hold-time budgets, flight-recorder
+  capture, and the zero-overhead-when-disabled contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from llmd_kv_cache_tpu.tools import conclint
+from llmd_kv_cache_tpu.utils import lockdep
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _write_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return pkg
+
+
+def _rules(findings) -> list[str]:
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Static pass: one fixture per rule, each firing exactly once.
+# ---------------------------------------------------------------------------
+
+
+class TestConclintRules:
+    def test_reentry_fires_once(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._state = {}
+
+                def staleness(self):
+                    with self._mu:
+                        return len(self._state)
+
+                def stats(self):
+                    with self._mu:
+                        return self.staleness()
+        """})
+        findings = conclint.analyze([str(pkg)])
+        assert _rules(findings) == [conclint.RULE_REENTRY]
+        assert "_mu" in findings[0].message
+        assert findings[0].path.endswith("a.py")
+
+    def test_rlock_reentry_is_legal(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.RLock()
+                    self._state = {}
+
+                def staleness(self):
+                    with self._mu:
+                        return len(self._state)
+
+                def stats(self):
+                    with self._mu:
+                        return self.staleness()
+        """})
+        assert conclint.analyze([str(pkg)]) == []
+
+    def test_blocking_fires_once(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def slow(self):
+                    with self._mu:
+                        time.sleep(1)
+        """})
+        findings = conclint.analyze([str(pkg)])
+        assert _rules(findings) == [conclint.RULE_BLOCKING]
+        assert "time.sleep" in findings[0].message
+
+    def test_callback_fires_once(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.publish = None
+
+                def hook(self):
+                    with self._mu:
+                        self.publish("x")
+        """})
+        findings = conclint.analyze([str(pkg)])
+        assert _rules(findings) == [conclint.RULE_CALLBACK]
+        assert "publish" in findings[0].message
+
+    def test_lock_order_cycle_across_modules(self, tmp_path):
+        """AB/BA inversion across two modules, resolved through a
+        TYPE_CHECKING-only import and a string annotation."""
+        pkg = _write_pkg(tmp_path, {
+            "a.py": """
+                import threading
+                from .b import Helper
+
+                class Pool:
+                    def __init__(self):
+                        self._mu = threading.Lock()
+                        self.helper = Helper()
+                        self._state = {}
+
+                    def stats(self):
+                        with self._mu:
+                            return len(self._state)
+
+                    def cross(self):
+                        with self._mu:
+                            self.helper.poke()
+            """,
+            "b.py": """
+                import threading
+                from typing import TYPE_CHECKING, Optional
+
+                if TYPE_CHECKING:
+                    from .a import Pool
+
+                class Helper:
+                    def __init__(self):
+                        self._hmu = threading.Lock()
+                        self.pool: Optional["Pool"] = None
+
+                    def poke(self):
+                        with self._hmu:
+                            return 1
+
+                    def back(self):
+                        with self._hmu:
+                            self.pool.stats()
+            """,
+        })
+        findings = conclint.analyze([str(pkg)])
+        assert _rules(findings) == [conclint.RULE_LOCK_ORDER]
+        msg = findings[0].message
+        assert "Pool._mu" in msg and "Helper._hmu" in msg
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        """Nesting in one global order is exactly what the rule demands."""
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+
+            class Outer:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            return 2
+        """})
+        assert conclint.analyze([str(pkg)]) == []
+
+
+# ---------------------------------------------------------------------------
+# Marker grammar: reasoned markers suppress; reasonless markers are findings.
+# ---------------------------------------------------------------------------
+
+
+class TestConclintMarkers:
+    def test_marker_with_reason_suppresses(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def slow(self):
+                    with self._mu:
+                        time.sleep(1)  # lint: allow-blocking (bounded settle poll)
+        """})
+        assert conclint.analyze([str(pkg)]) == []
+
+    def test_marker_without_reason_is_a_finding(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def slow(self):
+                    with self._mu:
+                        time.sleep(1)  # lint: allow-blocking
+        """})
+        findings = conclint.analyze([str(pkg)])
+        rules = _rules(findings)
+        # The reasonless marker does NOT suppress, and is itself reported.
+        assert conclint.RULE_BLOCKING in rules
+        assert conclint.RULE_BAD_MARKER in rules
+
+    def test_marker_on_with_line_covers_region(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.publish = None
+
+                def hook(self):
+                    with self._mu:  # lint: allow-callback (listeners are snapshot-only here)
+                        self.publish("x")
+        """})
+        assert conclint.analyze([str(pkg)]) == []
+
+
+# ---------------------------------------------------------------------------
+# The shipped tree and the CLI drivers.
+# ---------------------------------------------------------------------------
+
+
+class TestDrivers:
+    def test_library_tree_is_clean(self):
+        """The acceptance bar: the concurrency pass over the shipped
+        library reports nothing (every suppression carries a reason)."""
+        findings = conclint.analyze([str(REPO / "llmd_kv_cache_tpu")])
+        assert findings == [], [f.format() for f in findings]
+
+    def test_lint_concurrency_cli_exit_codes(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def slow(self):
+                    with self._mu:
+                        time.sleep(1)
+        """})
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "hack" / "lint_concurrency.py"), str(pkg)],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert proc.returncode == 1
+        assert "CONC-BLOCKING" in proc.stdout
+        # `path:line: RULE message` — parse the first finding line.
+        line = proc.stdout.splitlines()[0]
+        loc, rest = line.split(": ", 1)
+        assert loc.endswith("a.py:11")
+        assert rest.startswith("CONC-BLOCKING ")
+
+    def test_kvlint_json_mode(self, tmp_path):
+        pkg = _write_pkg(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def slow(self):
+                    with self._mu:
+                        time.sleep(1)
+        """})
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "hack" / "kvlint.py"),
+             "--only", "concurrency", "--json", str(pkg)],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert proc.returncode == 1
+        findings = json.loads(proc.stdout)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f["rule"] == "CONC-BLOCKING"
+        assert f["pass"] == "concurrency"
+        assert f["path"].endswith("a.py") and f["line"] == 11
+
+    def test_kvlint_all_passes_on_library(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "hack" / "kvlint.py"),
+             "llmd_kv_cache_tpu"],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "resilience:" in proc.stderr
+        assert "observability:" in proc.stderr
+        assert "concurrency:" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Runtime lockdep witness.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def witness():
+    """Arm the witness for one test; restore the env-derived state after."""
+    was = lockdep.enabled()
+    lockdep.set_enabled(True)
+    lockdep.reset()
+    yield lockdep
+    lockdep.set_enabled(was, budget_s=0)
+    lockdep.reset()
+
+
+class TestLockdep:
+    def test_reentry_raises(self, witness):
+        lk = lockdep.new_lock()
+        with lk:
+            with pytest.raises(lockdep.LockReentryViolation):
+                lk.acquire()
+
+    def test_rlock_reentry_allowed(self, witness):
+        rl = lockdep.new_rlock()
+        with rl:
+            with rl:
+                assert True
+
+    def test_lock_order_cycle_raises(self, witness):
+        a = lockdep.new_lock()
+        b = lockdep.new_lock()
+        with a:
+            with b:
+                pass
+        # The inversion is detected from the *order graph*, before any
+        # thread actually deadlocks — same thread, no contention needed.
+        errs = []
+
+        def invert():
+            try:
+                with b:
+                    with a:
+                        pass
+            except lockdep.LockOrderViolation as exc:
+                errs.append(exc)
+
+        t = threading.Thread(target=invert)
+        t.start()
+        t.join()
+        assert len(errs) == 1
+        assert "lock-order cycle" in str(errs[0])
+
+    def test_consistent_order_never_raises(self, witness):
+        a = lockdep.new_lock()
+        b = lockdep.new_lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+
+    def test_hold_budget_raises(self, witness):
+        lockdep.set_enabled(True, budget_s=0.01)
+        lk = lockdep.new_lock()
+        lk.acquire()
+        time.sleep(0.05)
+        with pytest.raises(lockdep.LockHoldBudgetViolation):
+            lk.release()
+        lockdep.set_enabled(True, budget_s=0)
+
+    def test_violation_reaches_flight_recorder(self, witness):
+        from llmd_kv_cache_tpu.telemetry.flight_recorder import (
+            KIND_LOCKDEP,
+            FlightRecorder,
+            flight_recorder,
+            set_flight_recorder,
+        )
+
+        set_flight_recorder(FlightRecorder(capacity=16))
+        try:
+            lk = lockdep.new_lock()
+            with lk:
+                with pytest.raises(lockdep.LockReentryViolation):
+                    lk.acquire()
+            kinds = [r["kind"] for r in flight_recorder().snapshot()]
+            assert KIND_LOCKDEP in kinds
+            rec = next(r for r in flight_recorder().snapshot()
+                       if r["kind"] == KIND_LOCKDEP)
+            assert rec["data"]["violation"] == "reentry"
+        finally:
+            set_flight_recorder(None)
+
+    def test_condition_wait_drops_and_reacquires(self, witness):
+        cond = lockdep.new_condition(lockdep.new_lock())
+        woke = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=2)
+                woke.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:  # acquirable because wait() released the DepLock
+            cond.notify()
+        t.join(timeout=2)
+        assert woke == [True]
+
+    def test_disabled_returns_plain_primitives(self):
+        was = lockdep.enabled()
+        lockdep.set_enabled(False)
+        try:
+            lk = lockdep.new_lock()
+            rl = lockdep.new_rlock()
+            # Zero overhead means the real C primitives, not wrappers.
+            assert type(lk) is type(threading.Lock())
+            assert isinstance(rl, type(threading.RLock()))
+        finally:
+            lockdep.set_enabled(was)
+
+    def test_site_keyed_graph_snapshot(self, witness):
+        a = lockdep.new_lock()
+        b = lockdep.new_lock()
+        with a:
+            with b:
+                pass
+        graph = lockdep.graph_snapshot()
+        assert a.site in graph
+        assert b.site in graph[a.site]
+        lockdep.reset()
+        assert lockdep.graph_snapshot() == {}
