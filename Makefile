@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -127,6 +127,16 @@ bench-workingset: native
 bench-controller: native
 	$(CPU_ENV) $(PY) bench.py --controller
 
+# Gray-failure tail-tolerance gate (resilience/cluster, PR 16): one of
+# four shards delayed 10x via a seeded delay failpoint — hedged fan-out
+# must hold the score p99 within 2x of the interleaved healthy baseline
+# (and under half the injected delay), breakers must stay closed, every
+# deadline overrun must be shed or flagged degraded, and the healthy-path
+# hedging bookkeeping must cost < 1% of the score p50 (the perf-sentinel
+# value).
+bench-graytail: native
+	$(CPU_ENV) $(PY) bench.py --graytail
+
 # Perf-regression sentinel: run the profiling + working-set gates and the
 # controller chaos arm, then diff their values and hot-function shares
 # against the committed baseline manifest. Emits machine-verdict
@@ -135,10 +145,12 @@ perf-check: native
 	$(CPU_ENV) $(PY) bench.py --pyprof-overhead > /tmp/kvtpu_pyprof_bench.json
 	$(CPU_ENV) $(PY) bench.py --workingset > /tmp/kvtpu_workingset_bench.json
 	$(CPU_ENV) $(PY) bench.py --controller > /tmp/kvtpu_controller_bench.json
+	$(CPU_ENV) $(PY) bench.py --graytail > /tmp/kvtpu_graytail_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
 	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
 	  --results workingset=/tmp/kvtpu_workingset_bench.json \
-	  --results controller=/tmp/kvtpu_controller_bench.json
+	  --results controller=/tmp/kvtpu_controller_bench.json \
+	  --results graytail=/tmp/kvtpu_graytail_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.
 verify: lint perf-check

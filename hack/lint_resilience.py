@@ -19,6 +19,13 @@ AST checks over every ``.py`` file under the given roots (default
 4. **RES-UNDOC-KNOB** — every field of a ``*Config`` dataclass under
    ``recovery/`` must appear (camelCased) in ``docs/configuration.md``;
    an undocumented knob is a default nobody can change.
+5. **RES-NO-DEADLINE** — a blocking wait with no bound: ``fut.result()``
+   without a ``timeout=`` and zero-argument ``q.get()`` park the calling
+   thread forever when the producer has died — exactly the gray-failure
+   mode the deadline plane exists to bound. Pass a timeout (cap it with
+   ``resilience.deadline.effective_timeout`` where a request budget is
+   ambient) or mark the intentional exceptions with
+   ``# lint: allow-no-deadline (why)``.
 
 A handler that is intentionally fire-and-forget (e.g. best-effort cleanup
 in a ``__del__``) may carry the explicit marker comment
@@ -41,6 +48,7 @@ from typing import NamedTuple
 
 ALLOW_MARKER = "lint: allow-swallow"
 ALLOW_NONATOMIC = "lint: allow-nonatomic"
+ALLOW_NO_DEADLINE = "lint: allow-no-deadline"
 ATOMIC_TREES = ("offload", "recovery")
 CONFIG_DOCS_PATH = Path("docs/configuration.md")
 
@@ -48,6 +56,7 @@ RULE_BARE_EXCEPT = "RES-BARE-EXCEPT"
 RULE_SWALLOW = "RES-SWALLOW"
 RULE_NONATOMIC = "RES-NONATOMIC"
 RULE_UNDOC_KNOB = "RES-UNDOC-KNOB"
+RULE_NO_DEADLINE = "RES-NO-DEADLINE"
 RULE_SYNTAX = "RES-SYNTAX"
 
 
@@ -91,6 +100,38 @@ def _open_write_mode(call: ast.Call) -> str:
     return ""
 
 
+def _unbounded_wait(call: ast.Call) -> str:
+    """Name of the blocking method iff this call waits without a bound.
+
+    ``.result()`` with neither a positional timeout nor ``timeout=`` is a
+    ``concurrent.futures`` wait that can park forever; a zero-argument
+    ``.get()`` on a queue-named receiver (``q``, ``*queue*``) is the
+    queue.Queue blocking read. The name filter keeps the non-blocking
+    zero-arg getters (``ContextVar.get()``, prometheus ``._value.get()``)
+    out of the findings.
+    """
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return ""
+    if fn.attr == "result":
+        if call.args:
+            return ""
+        if any(kw.arg == "timeout" for kw in call.keywords):
+            return ""
+        return "result"
+    if fn.attr == "get" and not call.args and not call.keywords:
+        recv = fn.value
+        name = ""
+        if isinstance(recv, ast.Name):
+            name = recv.id
+        elif isinstance(recv, ast.Attribute):
+            name = recv.attr
+        name = name.lower()
+        if name == "q" or "queue" in name:
+            return "get"
+    return ""
+
+
 def _is_swallow(handler: ast.ExceptHandler) -> bool:
     """Body is nothing but ``pass`` / ``...`` — the exception vanishes."""
     for stmt in handler.body:
@@ -123,6 +164,17 @@ def lint_file(path: Path) -> list[Problem]:
                     f"{'/'.join(ATOMIC_TREES)} can tear on crash; use "
                     "utils.atomic_io.atomic_write_bytes "
                     f"(or mark `# {ALLOW_NONATOMIC} (why)`)",
+                ))
+        if isinstance(node, ast.Call):
+            wait = _unbounded_wait(node)
+            line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+            if wait and ALLOW_NO_DEADLINE not in line:
+                problems.append(Problem(
+                    str(path), node.lineno, RULE_NO_DEADLINE,
+                    f"unbounded blocking wait — `.{wait}()` with no timeout "
+                    "parks the thread forever if the producer died; pass "
+                    "timeout= (cap via resilience.deadline.effective_timeout) "
+                    f"or mark `# {ALLOW_NO_DEADLINE} (why)`",
                 ))
         if not isinstance(node, ast.ExceptHandler):
             continue

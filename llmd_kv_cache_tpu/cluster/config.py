@@ -59,9 +59,28 @@ class ClusterConfig:
     # Ring-plan prefix cache entries (0 disables): (ring version, key
     # count, last chained key) → per-key owner plan.
     plan_cache_size: int = 2048
+    # One overall scatter-gather deadline per chunk (seconds). 0 derives
+    # it from fanout_timeout_s (the gather never outlives one RPC budget,
+    # however many failovers/hedges run inside it). The ambient request
+    # deadline, when present, caps it further.
+    fanout_deadline_s: float = 0.0
     # Inter-shard circuit breaker (resilience.policy.CircuitBreaker).
     breaker_failure_threshold: int = 3
     breaker_reset_timeout_s: float = 5.0
+    # Tail-tolerant hedged fan-out (resilience.hedging): when a shard's
+    # RPC outlives its adaptive latency-quantile trigger, the same lookup
+    # is issued to the keys' replica owner and the first response wins.
+    hedge_enabled: bool = True
+    # Latency quantile that arms the hedge trigger per shard (p95: only
+    # the slowest ~5% of RPCs ever hedge on a healthy shard).
+    hedge_quantile: float = 0.95
+    # Floor on the hedge trigger delay — never hedge faster than this
+    # even when a shard's quantile estimate collapses.
+    hedge_min_delay_s: float = 0.002
+    # Hedge budget: token bucket refilled by primary traffic. rate is the
+    # steady-state hedge fraction cap; burst bounds accumulated credit.
+    hedge_budget_rate: float = 0.1
+    hedge_budget_burst: float = 8.0
 
     def membership(self) -> list[str]:
         """Shard ids, index-aligned with shard_addresses."""
@@ -132,8 +151,26 @@ class ClusterConfig:
             )
             or DEGRADED_SERVE_SKIP,
             plan_cache_size=2048 if plan is None else plan,
+            fanout_deadline_s=d.get(
+                "fanoutDeadlineS", d.get("fanout_deadline_s", 0.0)
+            ),
             breaker_failure_threshold=3 if thresh is None else thresh,
             breaker_reset_timeout_s=d.get(
                 "breakerResetTimeoutS", d.get("breaker_reset_timeout_s", 5.0)
+            ),
+            hedge_enabled=bool(d.get(
+                "hedgeEnabled", d.get("hedge_enabled", True)
+            )),
+            hedge_quantile=d.get(
+                "hedgeQuantile", d.get("hedge_quantile", 0.95)
+            ),
+            hedge_min_delay_s=d.get(
+                "hedgeMinDelayS", d.get("hedge_min_delay_s", 0.002)
+            ),
+            hedge_budget_rate=d.get(
+                "hedgeBudgetRate", d.get("hedge_budget_rate", 0.1)
+            ),
+            hedge_budget_burst=d.get(
+                "hedgeBudgetBurst", d.get("hedge_budget_burst", 8.0)
             ),
         )

@@ -81,16 +81,31 @@ class ShardClient:
         keys: Sequence[BlockHash],
         pods: Optional[Sequence[str]] = None,
         timeout: Optional[float] = None,
+        deadline: Optional["object"] = None,
+        hedge: bool = False,
     ) -> dict:
         """Raw lookup: ``{"hits": {key: [PodEntry,...]}, "degraded": bool,
         "shard": str}``. Raises grpc.RpcError on transport failure (the
-        router's breaker/failover logic owns error handling)."""
+        router's breaker/failover logic owns error handling).
+
+        ``deadline`` (a resilience.deadline.Deadline) rides the frame as
+        the tolerant ``deadline_ms`` relative budget and caps the client
+        timeout; ``hedge`` tags the frame so shards can count hedged load
+        (both keys are ignored by older peers)."""
+        from ..resilience.deadline import Deadline
         from ..services.indexer_service import _call_rpc
 
+        frame = {"keys": [int(k) for k in keys], "pods": list(pods or [])}
+        eff_timeout = timeout if timeout is not None else self._timeout
+        if isinstance(deadline, Deadline):
+            frame["deadline_ms"] = deadline.to_wire_ms()
+            eff_timeout = deadline.cap_timeout(eff_timeout)
+        if hedge:
+            frame["hedge"] = True
         resp = _call_rpc(
             self._lookup_blocks,
-            {"keys": [int(k) for k in keys], "pods": list(pods or [])},
-            timeout if timeout is not None else self._timeout,
+            frame,
+            eff_timeout,
             self.retry_policy,
         )
         hits: dict[BlockHash, list[PodEntry]] = {}

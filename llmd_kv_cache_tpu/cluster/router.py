@@ -19,20 +19,35 @@ retried on their replica owners (``replicationFactor``), and only if no
 owner is reachable are the keys served *degraded* — treated as index
 misses under ``degradedServeMode: skip`` (the default), so scoring
 never blocks on a dead shard.
+
+Gray failures — a shard that is slow rather than dead — never trip the
+breaker, so the gather *hedges* instead ("The Tail at Scale"): each
+shard's RPC latency feeds a streaming quantile estimate, and a lookup
+that outlives its shard's ``hedgeQuantile`` trigger is re-issued to the
+keys' next replica owner; the first response wins and the loser is
+cancelled. Hedges are capped by a token-bucket budget refilled by
+primary traffic (``hedgeBudgetRate``), so a melting-down fleet cannot
+double its own load. The whole chunk gather runs under ONE overall
+deadline — ``fanoutDeadlineS`` capped by the ambient request deadline —
+rather than accumulating per-future waits; keys still unresolved at the
+deadline are served degraded, never silently late.
 """
 
 from __future__ import annotations
 
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..core.keys import BlockHash, PodEntry
+from ..resilience.deadline import Deadline, current_deadline
+from ..resilience.hedging import HedgeBudget, LatencyQuantileTracker
 from ..core.token_processor import ChunkedTokenDatabase, TokenProcessorConfig
 from ..resilience.policy import CircuitBreaker
 from ..scoring.scorer import KVBlockScorerConfig, create_scorer
 from ..telemetry import tracer
+from ..telemetry.flight_recorder import KIND_HEDGE, record as record_event
 from ..utils.logging import get_logger
 from ..utils.lru import LRUCache
 from .config import DEGRADED_SERVE_FAIL, ClusterConfig
@@ -68,6 +83,27 @@ class RouterScore:
     blocks: int = 0
     hit_blocks: int = 0
     rpcs: int = 0
+    # Hedged fan-out accounting: hedges issued for this score, and how
+    # many beat their primary (the rest were wasted-but-bounded work).
+    hedges: int = 0
+    hedge_wins: int = 0
+    # True when the overall gather deadline expired with lookups still in
+    # flight — the result is a degraded lower bound, not silently late.
+    deadline_expired: bool = False
+
+
+@dataclass
+class _Attempt:
+    """One in-flight LookupBlocks attempt inside a chunk gather."""
+
+    shard: str
+    keys: list[BlockHash]
+    keyset: frozenset
+    future: Future
+    started: float
+    kind: str  # "primary" (incl. failover) | "hedge"
+    hedged: bool = False  # a hedge decision was already made for this attempt
+    settled: bool = False
 
 
 class ShardRouter:
@@ -114,9 +150,23 @@ class ShardRouter:
         )
         self.plan_hits = 0
         self.plan_misses = 0
+        # Hedging holds extra attempts in flight, and a gray-slow shard's
+        # RPCs linger on their worker threads long after the gather moved
+        # on (cancel() cannot stop a running future) — size the pool for
+        # primary + hedge + several stale stragglers per shard, so a slow
+        # shard cannot starve the next gather's submits.
+        per_shard = 4 if config.hedge_enabled else 2
         self._executor = ThreadPoolExecutor(
-            max_workers=max(4, 2 * len(members)),
+            max_workers=max(4, per_shard * len(members)),
             thread_name_prefix="kvtpu-shard-fanout",
+        )
+        # Tail-tolerant hedging state: per-shard latency quantiles arm the
+        # trigger, the budget caps hedges at a fraction of primary load.
+        self.hedge_latency = LatencyQuantileTracker(
+            quantile=config.hedge_quantile
+        )
+        self.hedge_budget = HedgeBudget(
+            rate=config.hedge_budget_rate, burst=config.hedge_budget_burst
         )
         # Residency-aware disaggregated routing (scoring.residency): when
         # attached, ``score(role="decode")`` adds each decode pod's
@@ -160,17 +210,36 @@ class ShardRouter:
     # -- fan-out ----------------------------------------------------------
 
     def _shard_rpc(
-        self, shard: str, keys: list[BlockHash], pods: Optional[Sequence[str]]
+        self,
+        shard: str,
+        keys: list[BlockHash],
+        pods: Optional[Sequence[str]],
+        timeout: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
+        hedge: bool = False,
     ) -> dict:
         """One breaker-guarded LookupBlocks against one shard."""
         breaker = self.breakers[shard]
         if not breaker.allow():
             self._record_rpc(shard, "skipped")
             raise ConnectionError(f"breaker open for shard {shard}")
+        timeout_s = self.cfg.fanout_timeout_s if timeout is None else timeout
+        kwargs = {}
+        if deadline is not None:
+            kwargs["deadline"] = deadline
+        if hedge:
+            kwargs["hedge"] = True
         try:
-            res = self.clients[shard].lookup_blocks(
-                keys, pods, timeout=self.cfg.fanout_timeout_s
-            )
+            try:
+                res = self.clients[shard].lookup_blocks(
+                    keys, pods, timeout=timeout_s, **kwargs
+                )
+            except TypeError:
+                # Injected test doubles may predate the deadline/hedge
+                # kwargs; the wire fields are best-effort metadata.
+                res = self.clients[shard].lookup_blocks(
+                    keys, pods, timeout=timeout_s
+                )
         except Exception:
             breaker.record_failure()
             self._record_rpc(shard, "failure")
@@ -186,70 +255,224 @@ class ShardRouter:
         plan: Sequence[str],
         stats: RouterScore,
     ) -> dict[BlockHash, list[PodEntry]]:
-        """Scatter one chunk across its owning shards, failing keys over
-        to replica owners; returns the merged hit map."""
-        remaining: dict[str, list[BlockHash]] = {}
-        for key, owner in zip(keys, plan):
-            remaining.setdefault(owner, []).append(key)
+        """Scatter one chunk across its owning shards under one overall
+        gather deadline, hedging slow lookups and failing dead shards'
+        keys over to replica owners; returns the merged hit map."""
+        rf = max(1, self.cfg.replication_factor)
+        deadline = current_deadline()
+        overall_s = self.cfg.fanout_deadline_s or self.cfg.fanout_timeout_s
+        if deadline is not None:
+            overall_s = deadline.cap_timeout(overall_s)
+        gather_deadline = time.monotonic() + overall_s
 
         merged: dict[BlockHash, list[PodEntry]] = {}
-        excluded: set[str] = set()
-        dropped = False
-        for _attempt in range(max(1, self.cfg.replication_factor)):
-            if not remaining:
-                break
-            futures = {
-                shard: self._executor.submit(
-                    self._shard_rpc, shard, skeys, pods
-                )
-                for shard, skeys in remaining.items()
-            }
-            stats.rpcs += len(futures)
-            failed: dict[str, list[BlockHash]] = {}
-            for shard, fut in futures.items():
-                try:
-                    res = fut.result(timeout=self.cfg.fanout_timeout_s * 2)
-                except Exception:
-                    failed[shard] = remaining[shard]
+        resolved: set[BlockHash] = set()
+        dead: set[BlockHash] = set()
+        # Per-key shards already attempted (primary, failover, or hedge):
+        # a key visits each of its <= rf owners at most once, bounding the
+        # gather at rf attempts per key.
+        tried: dict[BlockHash, set[str]] = {
+            k: {o} for k, o in zip(keys, plan)
+        }
+        failed_shards: set[str] = set()
+        late_shards: set[str] = set()
+        # Shards whose attempt in THIS gather ran slow enough to be
+        # hedged (or failed outright): re-issues prefer other owners, so
+        # a healthy shard's natural tail hedge never routes keys INTO
+        # the straggler it is racing around.
+        suspect: set[str] = set()
+        attempts: list[_Attempt] = []
+
+        def submit(shard: str, skeys: list[BlockHash], kind: str) -> None:
+            budget_s = gather_deadline - time.monotonic()
+            timeout_s = min(self.cfg.fanout_timeout_s, max(0.001, budget_s))
+            fut = self._executor.submit(
+                self._shard_rpc, shard, skeys, pods, timeout_s, deadline,
+                kind == "hedge",
+            )
+            attempts.append(_Attempt(
+                shard=shard, keys=skeys, keyset=frozenset(skeys),
+                future=fut, started=time.monotonic(), kind=kind,
+            ))
+            stats.rpcs += 1
+            if kind != "hedge":
+                self.hedge_budget.on_primary()
+
+        def covered_elsewhere(key: BlockHash, exclude: _Attempt) -> bool:
+            return any(
+                a is not exclude and not a.settled and key in a.keyset
+                for a in attempts
+            )
+
+        def cancel_covered_losers() -> None:
+            # First response won: cancel in-flight attempts whose keys are
+            # all resolved. cancel() only stops a not-yet-running future;
+            # one mid-RPC completes harmlessly and still feeds the
+            # breaker/latency trackers from its worker thread.
+            for a in attempts:
+                if a.settled or not a.keyset.issubset(resolved):
                     continue
-                merged.update(res["hits"])
-                if res["degraded"]:
-                    stats.degraded = True
-            if not failed:
-                remaining = {}
+                a.settled = True
+                a.future.cancel()
+                if a.kind == "hedge":
+                    self._record_hedge(a.shard, "loss")
+                    record_event(KIND_HEDGE, {
+                        "shard": a.shard, "outcome": "loss",
+                    })
+
+        def next_owner(key: BlockHash) -> Optional[str]:
+            cands = [
+                s for s in self.ring.owners(key, rf) if s not in tried[key]
+            ]
+            if not cands:
+                return None
+            return next((s for s in cands if s not in suspect), cands[0])
+
+        def reroute(failed_keys: list[BlockHash]) -> None:
+            regroup: dict[str, list[BlockHash]] = {}
+            for key in failed_keys:
+                nxt = next_owner(key)
+                if nxt is None:
+                    dead.add(key)
+                else:
+                    tried[key].add(nxt)
+                    regroup.setdefault(nxt, []).append(key)
+            for shard, skeys in regroup.items():
+                submit(shard, skeys, "primary")
+
+        def settle(a: _Attempt) -> None:
+            a.settled = True
+            try:
+                res = a.future.result(timeout=0)
+            except Exception:
+                failed_shards.add(a.shard)
+                suspect.add(a.shard)
+                if a.kind == "hedge":
+                    self._record_hedge(a.shard, "failed")
+                orphans = [
+                    k for k in a.keys
+                    if k not in resolved and k not in dead
+                    and not covered_elsewhere(k, a)
+                ]
+                if orphans:
+                    reroute(orphans)
+                return
+            self.hedge_latency.observe(
+                a.shard, time.monotonic() - a.started
+            )
+            fresh = [k for k in a.keys if k not in resolved]
+            resolved.update(fresh)
+            for key, entries in res["hits"].items():
+                merged.setdefault(key, entries)
+            if res["degraded"]:
+                stats.degraded = True
+            if a.kind == "hedge" and fresh:
+                stats.hedge_wins += 1
+                self._record_hedge(a.shard, "win")
+                record_event(KIND_HEDGE, {
+                    "shard": a.shard, "outcome": "win",
+                    "keys": len(fresh),
+                })
+            cancel_covered_losers()
+
+        def maybe_hedge(a: _Attempt) -> None:
+            a.hedged = True  # one hedge decision per attempt
+            # Slow enough to hedge = suspect for the rest of the gather,
+            # whether or not the budget grants the hedge.
+            suspect.add(a.shard)
+            if not self.hedge_budget.spend():
+                self._record_hedge(a.shard, "denied")
+                return
+            regroup: dict[str, list[BlockHash]] = {}
+            for key in a.keys:
+                if key in resolved or key in dead:
+                    continue
+                nxt = next_owner(key)
+                if nxt is not None:
+                    tried[key].add(nxt)
+                    regroup.setdefault(nxt, []).append(key)
+            if not regroup:
+                return
+            for shard, skeys in regroup.items():
+                submit(shard, skeys, "hedge")
+                stats.hedges += 1
+                self._record_hedge(shard, "issued")
+                record_event(KIND_HEDGE, {
+                    "shard": shard, "outcome": "issued",
+                    "slow_shard": a.shard, "keys": len(skeys),
+                })
+
+        # Initial scatter: group keys by primary owner.
+        groups: dict[str, list[BlockHash]] = {}
+        for key, owner in zip(keys, plan):
+            groups.setdefault(owner, []).append(key)
+        for shard, skeys in groups.items():
+            submit(shard, skeys, "primary")
+
+        hedging = self.cfg.hedge_enabled and rf > 1
+        while True:
+            if all(k in resolved or k in dead for k in keys):
                 break
-            excluded.update(failed)
-            # Re-route each failed shard's keys to their next distinct
-            # owner; keys whose owners are all excluded go unserved.
-            remaining = {}
-            dead_keys = 0
-            for skeys in failed.values():
-                for key in skeys:
-                    nxt = next(
-                        (s for s in self.ring.owners(
-                            key, self.cfg.replication_factor)
-                         if s not in excluded),
-                        None,
-                    )
-                    if nxt is None:
-                        dead_keys += 1
+            live = [a for a in attempts if not a.settled]
+            if not live:
+                dead.update(
+                    k for k in keys if k not in resolved and k not in dead
+                )
+                break
+            now = time.monotonic()
+            if now >= gather_deadline:
+                # Overall gather deadline: stop waiting. The straggler
+                # RPCs finish (or time out) on their worker threads and
+                # feed breakers/latency stats; their keys are served
+                # degraded rather than late.
+                for a in live:
+                    a.settled = True
+                    a.future.cancel()
+                    late_shards.add(a.shard)
+                dead.update(
+                    k for k in keys if k not in resolved and k not in dead
+                )
+                stats.deadline_expired = True
+                break
+            wait_s = gather_deadline - now
+            if hedging:
+                for a in live:
+                    if a.hedged or a.kind == "hedge":
+                        continue
+                    trigger = self.hedge_latency.value(a.shard)
+                    if trigger is None:
+                        continue  # cold estimate: never hedge blind
+                    due_in = (a.started
+                              + max(trigger, self.cfg.hedge_min_delay_s)
+                              - now)
+                    if due_in <= 0:
+                        maybe_hedge(a)
                     else:
-                        remaining.setdefault(nxt, []).append(key)
-            if dead_keys:
-                dropped = True
-                break
+                        wait_s = min(wait_s, due_in)
+                live = [a for a in attempts if not a.settled]
+            done, _pending = wait(
+                [a.future for a in live],
+                timeout=max(0.0005, wait_s),
+                return_when=FIRST_COMPLETED,
+            )
+            if done:
+                for a in [x for x in attempts if not x.settled]:
+                    if a.future.done():
+                        settle(a)
+
         # A failed shard whose keys a replica fully served does NOT
         # degrade the result (scores are exact; the failure still shows
         # in breaker state and kvtpu_shard_rpcs_total). Only keys no
         # reachable owner could serve make scores a lower bound.
-        if remaining:
-            dropped = True
-        if dropped and excluded:
+        if dead:
+            unreachable = (failed_shards | late_shards) or set(
+                plan[i] for i, k in enumerate(keys) if k in dead
+            )
             stats.degraded = True
             stats.degraded_shards = sorted(
-                set(stats.degraded_shards) | excluded
+                set(stats.degraded_shards) | unreachable
             )
-            self._record_degraded(len(excluded))
+            self._record_degraded(len(unreachable))
         return merged
 
     # -- scoring ----------------------------------------------------------
@@ -270,6 +493,11 @@ class ShardRouter:
         """
         started = time.perf_counter()
         result = RouterScore()
+        dl = current_deadline()
+        if dl is not None:
+            # Fail fast before any fan-out work: an already-expired
+            # request must be shed by the caller, not served late.
+            dl.check("cluster.router.score")
         with tracer().span(
             "llm_d.kv_cache.cluster.fanout",
             model=model_name,
@@ -319,6 +547,7 @@ class ShardRouter:
             span.set_attribute("block_hit_count", len(merged))
             span.set_attribute("rpcs", result.rpcs)
             span.set_attribute("degraded_shards", len(result.degraded_shards))
+            span.set_attribute("hedges", result.hedges)
         self._record_fanout(time.perf_counter() - started)
         return result
 
@@ -337,6 +566,14 @@ class ShardRouter:
             from ..metrics.collector import record_shard_rpc
 
             record_shard_rpc(shard, outcome)
+        except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
+            pass
+
+    def _record_hedge(self, shard: str, outcome: str) -> None:
+        try:
+            from ..metrics.collector import record_hedge
+
+            record_hedge(shard, outcome)
         except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
             pass
 
@@ -372,6 +609,14 @@ class ShardRouter:
                 "hits": self.plan_hits,
                 "misses": self.plan_misses,
                 "size": len(self._plan_cache) if self._plan_cache else 0,
+            },
+            "hedging": {
+                "enabled": self.cfg.hedge_enabled,
+                "budget": self.hedge_budget.stats(),
+                "latency_quantiles_ms": {
+                    shard: round(v * 1e3, 3)
+                    for shard, v in self.hedge_latency.snapshot().items()
+                },
             },
         }
 

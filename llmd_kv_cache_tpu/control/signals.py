@@ -49,6 +49,11 @@ class FleetSignals:
     handoff: dict = field(default_factory=dict)
     # PR 12 what-if capacity rows ({"factor", "est_hit_ratio", ...}).
     whatif: Tuple[dict, ...] = ()
+    # Overload-shed state per site ({"indexer.score": {"shed_rate": x,
+    # "overloaded": bool, "pressure": n}, ...}): a sustained shed rate is
+    # the earliest capacity signal the controller gets — requests are
+    # already being turned away before any SLO window fills.
+    shed: Dict[str, dict] = field(default_factory=dict)
     # Topology.
     shards: Tuple[str, ...] = ()
     roles: Dict[str, str] = field(default_factory=dict)
@@ -66,6 +71,9 @@ class FleetSignals:
     def pods_with_role(self, role: str) -> List[str]:
         return sorted(p for p, r in self.roles.items() if r == role)
 
+    def shed_rate(self, site: str) -> float:
+        return float((self.shed.get(site) or {}).get("shed_rate", 0.0))
+
     def describe(self) -> dict:
         """Compact JSON-able summary (journal/span payloads)."""
         return {
@@ -78,6 +86,7 @@ class FleetSignals:
             "alert_edges": list(self.alert_edges),
             "dominant_segment": dict(self.dominant_segment),
             "handoff": dict(self.handoff),
+            "shed": {site: dict(st) for site, st in self.shed.items()},
             "shards": list(self.shards),
             "roles": dict(self.roles),
         }
@@ -95,6 +104,7 @@ class CollectorSignalSource:
         handoff=None,
         shards: Optional[Callable[[], List[str]]] = None,
         roles: Optional[Callable[[], Dict[str, str]]] = None,
+        shedders: Optional[Callable[[], Dict[str, dict]]] = None,
         clock: Callable[[], float] = time.time,
     ):
         if collector is None and slo_registry is None:
@@ -105,6 +115,9 @@ class CollectorSignalSource:
         self._handoff = handoff
         self._shards = shards or (lambda: [])
         self._roles = roles or (lambda: {})
+        # site -> CoDelShedder.stats() dict; typically
+        # ``lambda: {s.site: s.stats() for s in shedders}``.
+        self._shedders = shedders or (lambda: {})
         self._clock = clock
         self._edge_cursor = -1
 
@@ -141,6 +154,10 @@ class CollectorSignalSource:
         handoff = {}
         if self._handoff is not None:
             handoff = self._handoff.starvation()
+        try:
+            shed = dict(self._shedders())
+        except Exception:  # enrichment, never round-fatal  # lint: allow-swallow
+            shed = {}
         return FleetSignals(
             ts=self._clock(),
             slo=slo_state,
@@ -148,6 +165,7 @@ class CollectorSignalSource:
             dominant_segment=dominant,
             handoff=handoff,
             whatif=whatif,
+            shed=shed,
             shards=tuple(self._shards()),
             roles=dict(self._roles()),
         )

@@ -280,7 +280,7 @@ class Pool:
         q = self._queues[worker_index]
         budget = max(1, self.cfg.ingest_batch_max)
         while True:
-            batch = [q.get()]
+            batch = [q.get()]  # lint: allow-no-deadline (worker parks for work; shutdown via sentinel)
             shutdown = batch[0] is self._shutdown
             # Opportunistic drain: everything already queued on this shard
             # (up to the budget) is one batch; the blocking get above keeps

@@ -610,6 +610,39 @@ def record_ring_load(load: Dict[str, int]) -> None:
 
 
 # --------------------------------------------------------------------------
+# Gray-failure tolerance families (kvtpu_hedge_*, kvtpu_shed_*): hedged
+# scatter-gather outcomes and adaptive overload-shed decisions
+# (docs/resilience.md "Gray failures, deadlines & overload"). Hedge
+# outcomes: issued (hedge RPC sent), win (hedge answered first with fresh
+# keys), loss (primary answered first, hedge cancelled), failed (hedge
+# itself errored), denied (budget exhausted — no hedge sent). Shed
+# outcomes: shed (rejected outright), brownout (served degraded),
+# deadline (budget already expired at entry), late (served past its
+# deadline, flagged degraded), restore_skip (storage restore skipped for
+# deadline, recompute instead).
+# --------------------------------------------------------------------------
+
+HEDGE_ATTEMPTS = Counter(
+    "kvtpu_hedge_attempts_total",
+    "Hedged shard-RPC decisions by shard and outcome",
+    ["shard", "outcome"],  # issued|win|loss|failed|denied
+)
+SHED_DECISIONS = Counter(
+    "kvtpu_shed_decisions_total",
+    "Overload-shed and deadline decisions by site and outcome",
+    ["site", "outcome"],  # shed|brownout|deadline|late|restore_skip
+)
+
+
+def record_hedge(shard: str, outcome: str) -> None:
+    HEDGE_ATTEMPTS.labels(shard, outcome).inc()
+
+
+def record_shed(site: str, outcome: str) -> None:
+    SHED_DECISIONS.labels(site, outcome).inc()
+
+
+# --------------------------------------------------------------------------
 # Disaggregated-handoff families (kvtpu_handoff_*): prefill→decode KV
 # transfers over the offload plane — queue depth, in-flight store jobs,
 # per-chunk outcomes, and end-to-end handoff latency (prefill begin to the

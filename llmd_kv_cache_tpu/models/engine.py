@@ -44,6 +44,14 @@ from ..events.model import (
 from ..ops.pallas_paged_attention import (
     head_dim_supported as _pallas_head_dim_supported,
 )
+from ..resilience.deadline import Deadline, current_deadline
+from ..resilience.shedding import (
+    BROWNOUT,
+    PRIORITY_NORMAL,
+    SHED,
+    CoDelShedder,
+    OverloadShedError,
+)
 from ..telemetry.tracing import tracer
 from ..utils.logging import get_logger
 from .llama import (
@@ -202,6 +210,14 @@ class EngineConfig:
     # remainder locally. Decodes keep running the whole time (the wait
     # costs only that request's TTFT, never the running batch).
     handoff_wait_s: float = 10.0
+    # CoDel-style overload shedding at admission (resilience.shedding):
+    # when burst-admission delay (enqueue → first scheduler pick) stays
+    # above the target for a full interval, ``enqueue`` sheds
+    # lowest-priority work first instead of letting the queue grow
+    # without bound. 0 (default) disables the shedder entirely — no
+    # lock, no branch cost beyond one attribute load.
+    shed_target_delay_s: float = 0.0
+    shed_interval_s: float = 0.1
 
 
 @dataclass
@@ -265,6 +281,15 @@ class Request:
     # local prefill, polling the transfer tier for the prefill peer's
     # blocks in re-armed deferred-restore rounds. None once settled.
     handoff_deadline: Optional[float] = None
+    # End-to-end budget carried from the caller (ScoreRequest.deadline_ms
+    # → enqueue(deadline_s=...), or the ambient deadline_scope at enqueue
+    # time). A deferred storage restore that cannot finish inside the
+    # remaining budget is skipped — recompute beats a restore whose
+    # result arrives after the caller stopped waiting.
+    deadline: Optional[Deadline] = None
+    # resilience.shedding priority class: sheds lowest-first under
+    # admission overload.
+    priority: int = PRIORITY_NORMAL
 
     @property
     def total_len(self) -> int:
@@ -1015,6 +1040,22 @@ class MiniEngine:
         # coordinator must hear about when they settle.
         self._handoff_store_jobs: dict[int, tuple[str, list[int]]] = {}
         self.on_restore_latency: Optional[Callable[[float], None]] = None
+        # Streaming EMA of successful restore wall time (both restore
+        # paths feed it): the deferred-restore deadline gate skips the
+        # storage tier when the remaining budget is smaller than what a
+        # restore typically costs — recompute is the faster path then.
+        self._restore_latency_ema = 0.0
+
+        # Admission overload shedding (CoDel over burst-admission delay).
+        # None unless configured — the disabled path costs one attribute
+        # load per enqueue/step.
+        self.shedder: Optional[CoDelShedder] = None
+        if self.cfg.shed_target_delay_s > 0:
+            self.shedder = CoDelShedder(
+                "engine.admission",
+                target_delay_s=self.cfg.shed_target_delay_s,
+                interval_s=self.cfg.shed_interval_s,
+            )
 
         # Engine data-plane telemetry: request-lifecycle histograms
         # (TTFT/ITL/TPOT), decimated KV-pool gauge scrapes, per-request
@@ -1101,10 +1142,32 @@ class MiniEngine:
         self._finish_prefill(req)
         return req
 
+    def _record_shed(self, outcome: str, priority: int) -> None:
+        """Best-effort shed accounting: metric family + flight recorder.
+        Never lets telemetry failures interfere with admission."""
+        try:
+            from ..metrics.collector import record_shed
+
+            record_shed("engine.admission", outcome)
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
+        try:
+            from ..telemetry.flight_recorder import KIND_SHED, record
+
+            record(KIND_SHED, {
+                "site": "engine.admission",
+                "outcome": outcome,
+                "priority": priority,
+            })
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
+
     def enqueue(self, request_id: str, prompt: Sequence[int],
                 max_new_tokens: int = 16,
                 traceparent: Optional[str] = None,
-                handoff: bool = False) -> Request:
+                handoff: bool = False,
+                deadline_s: Optional[float] = None,
+                priority: int = PRIORITY_NORMAL) -> Request:
         """Admit a request for continuous batching: pages are acquired and
         the storage tier consulted from ``step()``, where prefill runs
         chunk-at-a-time interleaved with decode — a long prompt stalls
@@ -1126,7 +1189,25 @@ class MiniEngine:
         transfer tier — the KV pull overlaps queueing and the running
         decode batch. A failed or timed-out transfer falls back to local
         prefill (the request is never lost).
+
+        ``deadline_s`` attaches an end-to-end budget (falls back to the
+        ambient :func:`deadline_scope` when omitted): a deferred storage
+        restore that cannot land inside the remaining budget is skipped
+        in favor of recompute. When the admission shedder is configured
+        (``cfg.shed_target_delay_s``), sustained admission delay sheds
+        non-critical requests (:class:`OverloadShedError`) and browns out
+        the rest — admitted, but without the storage-restore attempt.
         """
+        brownout = False
+        if self.shedder is not None:
+            verdict = self.shedder.admit(priority)
+            if verdict == SHED:
+                self._record_shed("shed", priority)
+                raise OverloadShedError(
+                    "engine.admission", self.shedder.last_delay_s)
+            if verdict == BROWNOUT:
+                brownout = True
+                self._record_shed("brownout", priority)
         if traceparent is not None:
             with tracer().span(
                 "llm_d.kv_cache.engine.admission",
@@ -1146,6 +1227,16 @@ class MiniEngine:
         else:
             req = self._admit(request_id, prompt, max_new_tokens,
                               defer_restore=True)
+        req.deadline = (
+            Deadline.after(deadline_s) if deadline_s is not None
+            else current_deadline()
+        )
+        req.priority = priority
+        if brownout and req.restore_pending:
+            # Brownout: admitted, but skip the storage-tier restore —
+            # under queue pressure the offload round trip is the first
+            # cost to drop (recompute keeps the scheduler moving).
+            req.restore_pending = False
         # Burst-admission latency: with decode_burst > 1 the first prefill
         # chunk can only run once the in-flight burst drains — observed at
         # first schedule (kvcache_engine_admission_delay_seconds).
@@ -1366,6 +1457,7 @@ class MiniEngine:
         elapsed = time.monotonic() - started
         record_engine_restore("success", elapsed)
         record_offload_restore(self._offload_medium, elapsed)
+        self._observe_restore_latency(elapsed)
         if self.on_restore_latency is not None:
             try:
                 self.on_restore_latency(elapsed)
@@ -1381,6 +1473,13 @@ class MiniEngine:
         req.pages.extend(canonical)
         req.cached_len += len(canonical) * page_size
         req.computed_len = req.cached_len
+
+    def _observe_restore_latency(self, elapsed: float) -> None:
+        """Fold a successful restore's wall time into the EMA the
+        deadline gate consults (first sample seeds it directly)."""
+        ema = self._restore_latency_ema
+        self._restore_latency_ema = (
+            elapsed if ema == 0.0 else ema + 0.2 * (elapsed - ema))
 
     def _commit_restored_blocks(self, req: Request, first_missing: int,
                                 hashes: list, pages: list[int]) -> list[int]:
@@ -1408,8 +1507,29 @@ class MiniEngine:
         request already owns for those blocks (allocated at admission for
         the uncached remainder), so no extra pages are taken; on success
         ``commit_blocks`` adopts canonical pages and frees duplicates.
+
+        Deadline gate: a request whose budget has expired — or whose
+        remaining budget is smaller than what a restore typically costs
+        (streaming EMA of past successes) — skips the storage tier and
+        recomputes. A restore that lands after the caller stopped
+        waiting is pure waste; prefill compute at least keeps the pages
+        warm for the next caller.
         """
         req.restore_pending = False
+        dl = req.deadline
+        if dl is not None:
+            remaining = dl.remaining_s()
+            if remaining <= 0 or (0 < self._restore_latency_ema
+                                  and remaining < self._restore_latency_ema):
+                from ..metrics.collector import record_engine_restore
+
+                record_engine_restore("deadline_skip")
+                self._record_shed("restore_skip", req.priority)
+                logger.debug(
+                    "skipping storage restore for %s: %.3fs budget left, "
+                    "restores take ~%.3fs", req.request_id,
+                    max(0.0, remaining), self._restore_latency_ema)
+                return
         page_size = self.cfg.model.page_size
         first_missing = req.cached_len // page_size
         remaining = req.block_hashes[first_missing:]
@@ -1466,6 +1586,7 @@ class MiniEngine:
         elapsed = time.monotonic() - started
         record_engine_restore("success", elapsed)
         record_offload_restore(self._offload_medium, elapsed)
+        self._observe_restore_latency(elapsed)
         if self.on_restore_latency is not None:
             # Residency scoring's tier-discount feed (index.cost_aware
             # .observe_tier_latency when the serving assembly wired it).
@@ -1925,8 +2046,12 @@ class MiniEngine:
                     # part of this scheduling metric.
                     from ..metrics.collector import record_admission_delay
 
-                    record_admission_delay(
-                        time.monotonic() - req.enqueued_at)
+                    admission_delay = time.monotonic() - req.enqueued_at
+                    record_admission_delay(admission_delay)
+                    if self.shedder is not None:
+                        # CoDel signal: sustained admission delay above
+                        # the target trips brownout/shed at enqueue.
+                        self.shedder.observe_delay(admission_delay)
                     req.enqueued_at = None
                     if tel is not None:
                         tel.on_first_schedule(rid)

@@ -602,13 +602,13 @@ class ObjectStoreOffloadHandlers:
             for f in job.futures:
                 if f.cancelled() or f.exception() is not None:
                     success = False
-                elif not job.is_store and f.result() is None:
+                elif not job.is_store and f.result() is None:  # lint: allow-no-deadline (done() filtered above)
                     success = False  # missing object / short range
             if success and not job.is_store:
                 batch = []
                 counted = set()
                 for fut, page_ids, off, length in job.scatters:
-                    data = fut.result()
+                    data = fut.result()  # lint: allow-no-deadline (done() filtered above)
                     if id(fut) not in counted:  # span loads share a future
                         counted.add(id(fut))
                         job.nbytes += len(data)

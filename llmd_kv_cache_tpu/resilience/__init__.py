@@ -13,7 +13,14 @@ serving path alive when individual components misbehave:
 - :mod:`failover` — an Index wrapper that trips Redis ops over to the
   in-memory index when the primary's breaker opens.
 - :mod:`liveness` — per-pod last-event tracking feeding degraded-mode
-  scoring (stale pods demoted, then dropped).
+  scoring (stale pods demoted, then dropped), plus latency-EMA demotion
+  for pods that are slow rather than dead.
+- :mod:`deadline` — end-to-end request deadlines carried as tolerant
+  wire metadata and consumed at every blocking site.
+- :mod:`hedging` — per-target latency-quantile tracking and the hedge
+  budget behind the router's tail-tolerant scatter-gather.
+- :mod:`shedding` — CoDel-style queue-delay-controlled overload
+  shedding (brownout before blackout, priority-ordered).
 
 See docs/resilience.md for the failpoint catalog and defaults.
 """
@@ -40,3 +47,21 @@ from .integrity import (  # noqa: F401
 )
 from .failover import FailoverIndex  # noqa: F401
 from .liveness import PodLivenessTracker  # noqa: F401
+from .deadline import (  # noqa: F401
+    Deadline,
+    DeadlineExceeded,
+    current_deadline,
+    deadline_scope,
+    effective_timeout,
+)
+from .hedging import HedgeBudget, LatencyQuantileTracker  # noqa: F401
+from .shedding import (  # noqa: F401
+    ADMIT,
+    BROWNOUT,
+    PRIORITY_CRITICAL,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    SHED,
+    CoDelShedder,
+    OverloadShedError,
+)

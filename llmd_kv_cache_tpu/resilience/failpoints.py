@@ -11,14 +11,20 @@ environment:
     KVTPU_FAILPOINTS="offload.load.io_error=error:p=1:times=2,index.redis.op=error"
     KVTPU_FAILPOINT_SEED=1234
 
-Spec grammar per failpoint: ``name=mode[:p=<prob>][:times=<n>][:delay=<s>]``
+Spec grammar per failpoint:
+``name=mode[:p=<prob>][:times=<n>][:delay=<s>|delay_ms=<n>][:jitter=<s>|jitter_ms=<n>]``
 with modes ``error`` (raise :class:`FaultInjected`), ``delay`` (sleep),
 and ``custom`` (``should_fire`` returns True; the call site decides what
-the fault looks like — e.g. flipping bytes to tear a file).
+the fault looks like — e.g. flipping bytes to tear a file). ``jitter``
+adds a uniform ``[0, jitter]`` extension to each sleep, modeling the
+wandering latency of a gray-failing pod rather than a fixed stall.
 
 Determinism: probabilistic firing draws from a registry-owned
 ``random.Random`` seeded at construction (``KVTPU_FAILPOINT_SEED``,
-default 0), so a chaos run replays exactly.
+default 0), so a chaos run replays exactly. Jitter draws come from a
+*per-failpoint* RNG seeded from ``(registry seed, failpoint name)``, so
+one point's delay schedule replays identically regardless of how other
+points' firings interleave with it across threads.
 """
 
 from __future__ import annotations
@@ -63,6 +69,8 @@ class _Failpoint:
     probability: float = 1.0
     times: int | None = None  # remaining firings; None = unlimited
     delay_s: float = 0.0
+    jitter_s: float = 0.0  # uniform [0, jitter_s) added to each sleep
+    rng: random.Random | None = None  # per-point RNG for jitter draws
     hits: int = 0  # times the hook was reached
     fired: int = 0  # times the fault actually triggered
     lock: threading.Lock = field(default_factory=lambda: new_lock(), repr=False)
@@ -111,15 +119,21 @@ class FailpointRegistry:
         probability: float = 1.0,
         times: int | None = None,
         delay_s: float = 0.0,
+        jitter_s: float = 0.0,
     ) -> None:
         if mode not in _MODES:
             raise ValueError(f"unknown failpoint mode {mode!r}; expected one of {_MODES}")
         if not 0.0 <= probability <= 1.0:
             raise ValueError(f"probability must be in [0, 1], got {probability}")
+        if jitter_s < 0.0:
+            raise ValueError(f"jitter_s must be >= 0, got {jitter_s}")
         with self._lock:
+            # Per-point RNG keyed off (seed, name): jitter schedules replay
+            # per-point regardless of cross-point thread interleaving.
+            rng = random.Random(f"{self._seed}:{name}") if jitter_s > 0 else None
             self._points[name] = _Failpoint(
                 name=name, mode=mode, probability=probability,
-                times=times, delay_s=delay_s,
+                times=times, delay_s=delay_s, jitter_s=jitter_s, rng=rng,
             )
         logger.debug("armed failpoint %s mode=%s p=%s times=%s", name, mode, probability, times)
 
@@ -146,7 +160,7 @@ class FailpointRegistry:
 
     def _arm_from_spec(self, spec: str) -> None:
         name, _, rest = spec.partition("=")
-        mode, probability, times, delay_s = MODE_ERROR, 1.0, None, 0.0
+        mode, probability, times, delay_s, jitter_s = MODE_ERROR, 1.0, None, 0.0, 0.0
         for tok in filter(None, rest.split(":")):
             if tok in _MODES:
                 mode = tok
@@ -154,11 +168,18 @@ class FailpointRegistry:
                 probability = float(tok[2:])
             elif tok.startswith("times="):
                 times = int(tok[6:])
+            elif tok.startswith("delay_ms="):
+                delay_s = float(tok[9:]) / 1e3
             elif tok.startswith("delay="):
                 delay_s = float(tok[6:])
+            elif tok.startswith("jitter_ms="):
+                jitter_s = float(tok[10:]) / 1e3
+            elif tok.startswith("jitter="):
+                jitter_s = float(tok[7:])
             else:
                 raise ValueError(f"bad failpoint spec token {tok!r} in {spec!r}")
-        self.arm(name, mode=mode, probability=probability, times=times, delay_s=delay_s)
+        self.arm(name, mode=mode, probability=probability, times=times,
+                 delay_s=delay_s, jitter_s=jitter_s)
 
     # -- introspection ----------------------------------------------------
 
@@ -204,8 +225,12 @@ class FailpointRegistry:
             return
         self._notify(name)
         logger.warning("failpoint %s fired (mode=%s, count=%d)", name, fp.mode, fp.fired)
-        if fp.delay_s > 0.0:
-            time.sleep(fp.delay_s)
+        sleep_s = fp.delay_s
+        if fp.jitter_s > 0.0 and fp.rng is not None:
+            with fp.lock:
+                sleep_s += fp.rng.uniform(0.0, fp.jitter_s)
+        if sleep_s > 0.0:
+            time.sleep(sleep_s)
         if fp.mode == MODE_ERROR:
             raise FaultInjected(name)
 

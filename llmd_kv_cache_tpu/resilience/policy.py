@@ -107,17 +107,30 @@ class CircuitBreaker:
     CLOSED → OPEN after ``failure_threshold`` consecutive failures;
     OPEN → HALF_OPEN after ``reset_timeout_s``; one probe call is then
     admitted — success closes the breaker, failure re-opens it.
+
+    The probe slot is a *lease*, not a latch: if the prober never reports
+    back (its thread died mid-call, its process was killed, an exception
+    path swallowed the outcome), the lease expires after
+    ``probe_timeout_s`` and the next ``allow()`` claims it. Without the
+    lease a single dead prober wedges the breaker in half-open forever —
+    every caller rejected, no probe ever running (a gray failure of the
+    breaker itself).
     """
 
     target: str = "unnamed"
     failure_threshold: int = 5
     reset_timeout_s: float = 10.0
+    # Probe lease: how long a claimed half-open probe slot stays reserved
+    # before another caller may reclaim it. Must comfortably exceed the
+    # slowest legitimate probe RPC.
+    probe_timeout_s: float = 30.0
     clock: Callable[[], float] = time.monotonic
 
     _state: str = field(default=_CLOSED, init=False)
     _failures: int = field(default=0, init=False)
     _opened_at: float = field(default=0.0, init=False)
     _probing: bool = field(default=False, init=False)
+    _probe_started_at: float = field(default=0.0, init=False)
     _lock: threading.Lock = field(default_factory=lambda: new_lock(), init=False, repr=False)
 
     @property
@@ -137,9 +150,23 @@ class CircuitBreaker:
             self._maybe_half_open()
             if self._state == _CLOSED:
                 return True
-            if self._state == _HALF_OPEN and not self._probing:
-                self._probing = True
-                return True
+            if self._state == _HALF_OPEN:
+                now = self.clock()
+                if (self._probing
+                        and now - self._probe_started_at >= self.probe_timeout_s):
+                    # Probe lease expired: the prober went quiet without
+                    # reporting an outcome. Reclaim so the breaker can
+                    # still make progress (a late report from the stale
+                    # prober is harmless — it just records an outcome).
+                    logger.warning(
+                        "circuit for '%s': probe lease expired after %.1fs; "
+                        "reclaiming", self.target, self.probe_timeout_s,
+                    )
+                    self._probing = False
+                if not self._probing:
+                    self._probing = True
+                    self._probe_started_at = now
+                    return True
             return False
 
     def record_success(self) -> None:

@@ -130,6 +130,13 @@ class IndexerConfig:
     # with spanExport set, the admin endpoint serves /debug/spans for the
     # fleet telemetry collector.
     fleet_telemetry: Optional["FleetTelemetryConfig"] = None
+    # Adaptive overload shedding at the scoring service (resilience.
+    # shedding.CoDelShedder): when serving delay stays above this target
+    # for a full interval, low-priority requests shed and normal-priority
+    # ones brown out (residency fold-in skipped, response flagged
+    # degraded). 0 disables (the default).
+    shed_target_delay_s: float = 0.0
+    shed_interval_s: float = 0.1
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "IndexerConfig":
@@ -148,6 +155,12 @@ class IndexerConfig:
             admin_port=d.get("adminPort", d.get("admin_port", 0)) or 0,
             admin_host=d.get("adminHost", d.get("admin_host", "127.0.0.1"))
             or "127.0.0.1",
+            shed_target_delay_s=d.get(
+                "shedTargetDelayS", d.get("shed_target_delay_s", 0.0)
+            ) or 0.0,
+            shed_interval_s=d.get(
+                "shedIntervalS", d.get("shed_interval_s", 0.1)
+            ) or 0.1,
         )
         recovery_dict = d.get("recoveryConfig", d.get("recovery_config"))
         if recovery_dict:
@@ -398,6 +411,15 @@ class Indexer:
             self._record_prefix_cache_metrics()
             if not block_keys:
                 return {}
+
+            # End-to-end deadline: the index lookup is the one blocking
+            # site on this path — check the ambient budget before paying
+            # for it (resilience.deadline; no-op without a deadline_scope).
+            from ..resilience.deadline import current_deadline
+
+            dl = current_deadline()
+            if dl is not None:
+                dl.check("scoring.index_lookup")
 
             if self._native_score is not None:
                 scores, hit_count = self._native_score(

@@ -18,6 +18,7 @@ Two wire surfaces on one server:
 
 from __future__ import annotations
 
+import time
 from concurrent import futures
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
@@ -41,10 +42,26 @@ from ..recovery.reconcile import (
     digest_from_blocks,
     pod_blocks_from_state,
 )
+from ..resilience.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    current_deadline,
+    deadline_metadata,
+    deadline_scope,
+    effective_timeout,
+    extract_deadline,
+)
 from ..resilience.failpoints import FaultInjected, failpoints
 from ..resilience.policy import RetryExhausted, RetryPolicy, call_with_retry
+from ..resilience.shedding import (
+    BROWNOUT,
+    PRIORITY_NORMAL,
+    SHED,
+    CoDelShedder,
+)
 from ..scoring.indexer import Indexer, IndexerConfig
 from ..telemetry import attach_failpoint_listener, current_traceparent, tracer
+from ..telemetry.flight_recorder import KIND_SHED, record as record_event
 from ..utils.logging import get_logger
 from ..utils.net import grpc_target
 from . import channel_pool
@@ -59,6 +76,12 @@ PROTO_SERVICE_NAME = "indexer.v1.IndexerService"
 # Error-mode fires at the entry of every outgoing scoring RPC (chaos:
 # flaky indexer deployment). Injected faults retry like transport errors.
 FP_INDEXER_RPC = "services.indexer.rpc"
+
+# Server-side lookup hook (chaos: gray failures). Delay-mode arms a
+# slow-not-dead shard: ``hit()`` fires both the generic name and a
+# ``<name>.<shard_id>`` variant, so one shard of an in-process fleet can
+# be slowed while its peers stay healthy.
+FP_SHARD_LOOKUP = "services.indexer.lookup"
 
 # Scoring sits on the scheduler hot path: one fast retry, then give up
 # and let the picker fall back to round-robin.
@@ -91,11 +114,19 @@ def _call_rpc(rpc, request, timeout: float, policy: RetryPolicy):
     last underlying error is re-raised so callers keep the grpc.RpcError
     contract (status code inspection, etc.). Ambient W3C trace context
     rides as ``traceparent`` metadata so the server span joins the
-    caller's trace."""
+    caller's trace; the ambient request deadline rides the same way
+    (``kvtpu-deadline-ms``) and caps the transport timeout — an expired
+    deadline fails the call before any wire traffic."""
     tp = current_traceparent()
-    metadata = (("traceparent", tp),) if tp else None
+    md = (("traceparent", tp),) if tp else ()
+    md = md + tuple(deadline_metadata())
+    metadata = md or None
+    dl = current_deadline()
+    timeout = effective_timeout(timeout)
 
     def attempt():
+        if dl is not None:
+            dl.check("services.rpc")
         failpoints.hit(FP_INDEXER_RPC)
         return rpc(request, timeout=timeout, metadata=metadata)
 
@@ -140,6 +171,14 @@ class ScoreRequest:
     # bonuses when the serving indexer tracks handoffs. Same tolerance
     # pattern as ``shard``.
     role: str = ""
+    # End-to-end deadline: milliseconds of budget remaining at send time
+    # (resilience.deadline — relative, so clock skew cannot bend it).
+    # 0/absent = no deadline; old servers ignore it.
+    deadline_ms: int = 0
+    # Shedding priority (resilience.shedding.PRIORITY_*): 0 low, 1 normal
+    # (the default — also what an old peer's absent field decodes to),
+    # 2 critical (never shed).
+    priority: int = 1
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
@@ -149,6 +188,8 @@ class ScoreRequest:
                 "pod_identifiers": self.pod_identifiers,
                 "shard": self.shard,
                 "role": self.role,
+                "deadline_ms": self.deadline_ms,
+                "priority": self.priority,
             },
             use_bin_type=True,
         )
@@ -156,12 +197,22 @@ class ScoreRequest:
     @classmethod
     def from_bytes(cls, b: bytes) -> "ScoreRequest":
         d = msgpack.unpackb(b, raw=False)
+        try:
+            deadline_ms = int(d.get("deadline_ms", 0) or 0)
+        except (TypeError, ValueError):
+            deadline_ms = 0
+        try:
+            priority = int(d.get("priority", 1))
+        except (TypeError, ValueError):
+            priority = 1
         return cls(
             tokens=list(d.get("tokens", [])),
             model_name=d.get("model_name", ""),
             pod_identifiers=list(d.get("pod_identifiers", [])),
             shard=d.get("shard", "") or "",
             role=d.get("role", "") or "",
+            deadline_ms=deadline_ms,
+            priority=priority,
         )
 
 
@@ -193,13 +244,19 @@ class ScoreResponse:
     # vs indexed cache. Empty for role-agnostic requests and on the wire
     # from older servers (same tolerance pattern as ``shard``).
     residency: dict[str, float] = field(default_factory=dict)
+    # Why ``degraded`` is set, when the server knows: "" (not degraded, or
+    # an older server), "warmup", "brownout" (overload — residency fold-in
+    # skipped), "shed" (overload — not scored), "deadline" (the request's
+    # budget expired in-flight). Same tolerance pattern as ``shard``.
+    degraded_reason: str = ""
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
             {"scores": self.scores, "error": self.error,
              "degraded": self.degraded, "traceparent": self.traceparent,
              "shard": self.shard, "degraded_shards": self.degraded_shards,
-             "residency": self.residency},
+             "residency": self.residency,
+             "degraded_reason": self.degraded_reason},
             use_bin_type=True,
         )
 
@@ -214,6 +271,7 @@ class ScoreResponse:
             shard=d.get("shard", "") or "",
             degraded_shards=[str(s) for s in d.get("degraded_shards", [])],
             residency=dict(d.get("residency", {})),
+            degraded_reason=d.get("degraded_reason", "") or "",
         )
 
 
@@ -281,6 +339,18 @@ class IndexerService:
             )
         self._reconciler: Optional[AntiEntropyReconciler] = None
         self._drain_coordinator: Optional[DrainCoordinator] = None
+        # Adaptive overload shedding (resilience.shedding): serving delay
+        # feeds a CoDel controller; under sustained overload low-priority
+        # scoring sheds and normal-priority scoring browns out (residency
+        # fold-in skipped, response flagged degraded). Disabled unless
+        # shedTargetDelayS > 0.
+        self.shedder: Optional[CoDelShedder] = None
+        if self.indexer.config.shed_target_delay_s > 0:
+            self.shedder = CoDelShedder(
+                "indexer.score",
+                target_delay_s=self.indexer.config.shed_target_delay_s,
+                interval_s=self.indexer.config.shed_interval_s,
+            )
 
     @property
     def shard_id(self) -> str:
@@ -377,6 +447,8 @@ class IndexerService:
             pass
         if self.shard_index is not None:
             providers["shard"] = self.shard_index.debug_view
+        if self.shedder is not None:
+            providers["shed"] = self.shedder.stats
         health = None
         if self.recovery is not None:
             self.recovery.start()
@@ -490,10 +562,32 @@ class IndexerService:
 
     # -- RPC --
 
+    def _shed_response(self, reason: str, error: str = "") -> ScoreResponse:
+        return ScoreResponse(
+            error=error, degraded=True, degraded_reason=reason,
+            traceparent=current_traceparent() or "", shard=self.shard_id,
+        )
+
+    def _record_shed(self, site: str, outcome: str, priority: int) -> None:
+        try:
+            from ..metrics.collector import record_shed
+
+            record_shed(site, outcome)
+        except Exception:  # pragma: no cover - metrics must never break serving  # lint: allow-swallow
+            pass
+        record_event(KIND_SHED, {
+            "site": site, "outcome": outcome, "priority": priority,
+        })
+
     def get_pod_scores(self, req: ScoreRequest, context=None) -> ScoreResponse:
         # Server-side half of the W3C hop: parent under the scheduler's
         # traceparent metadata when present (ambient trace context then
-        # flows into the score_tokens child span).
+        # flows into the score_tokens child span). The request deadline
+        # (wire field first, gRPC metadata as fallback) becomes ambient
+        # the same way, so every blocking site below consumes it.
+        deadline = (Deadline.from_wire_ms(req.deadline_ms)
+                    or extract_deadline(context))
+        served_at = time.monotonic()
         with tracer().span(
             "llm_d.kv_cache.indexer.GetPodScores",
             parent_traceparent=extract_traceparent(context),
@@ -501,30 +595,66 @@ class IndexerService:
             tokens=len(req.tokens),
             role=req.role,
             process=self.process_name,
-        ):
+        ), deadline_scope(deadline) as dl:
             try:
+                if dl is not None and dl.expired():
+                    # Expired before any work: shed, never serve late.
+                    self._record_shed("indexer.score", "deadline", req.priority)
+                    return self._shed_response(
+                        "deadline", error="deadline expired before scoring"
+                    )
+                role = req.role
+                brownout = False
+                if self.shedder is not None:
+                    decision = self.shedder.admit(req.priority)
+                    if decision == SHED:
+                        self._record_shed("indexer.score", SHED, req.priority)
+                        return self._shed_response(
+                            "shed", error="overload shed"
+                        )
+                    if decision == BROWNOUT:
+                        # Brownout: serve the cheap role-agnostic score —
+                        # residency fold-in skipped — flagged degraded.
+                        self._record_shed("indexer.score", BROWNOUT, req.priority)
+                        brownout = True
+                        role = ""
                 detail: dict = {}
                 scores = self.indexer.score_tokens(
                     req.tokens,
                     req.model_name,
                     set(req.pod_identifiers) if req.pod_identifiers else None,
-                    role=req.role,
+                    role=role,
                     detail=detail,
                 )
                 # During post-restart warmup, serve best-effort scores but
                 # flag them so routers widen their fallback (the wire field
                 # decodes to False against older peers).
                 degraded = self.recovery is not None and not self.recovery.ready
+                reason = "warmup" if degraded else ""
+                if brownout:
+                    degraded, reason = True, "brownout"
+                if dl is not None and dl.expired():
+                    # Finished past the budget: still answer (the work is
+                    # done), but flagged — callers see it was late.
+                    degraded, reason = True, "deadline"
+                    self._record_shed("indexer.score", "late", req.priority)
                 # Score→serve trace continuity: hand the scheduler this
                 # span's traceparent so the chosen engine's spans join the
                 # trace ("" when no tracer is active).
                 return ScoreResponse(scores=scores, degraded=degraded,
                                      traceparent=current_traceparent() or "",
                                      shard=self.shard_id,
-                                     residency=detail.get("residency", {}))
+                                     residency=detail.get("residency", {}),
+                                     degraded_reason=reason)
+            except DeadlineExceeded as e:
+                self._record_shed("indexer.score", "deadline", req.priority)
+                return self._shed_response("deadline", error=str(e))
             except Exception as e:
                 logger.exception("GetPodScores failed")
                 return ScoreResponse(error=str(e))
+            finally:
+                if self.shedder is not None:
+                    self.shedder.observe_delay(time.monotonic() - served_at)
 
     # -- shard surface (cluster/) --
     #
@@ -534,14 +664,29 @@ class IndexerService:
     # digest-first views IndexDigestSource derives from ``dump_state``.
 
     def lookup_blocks_rpc(self, req: dict, context=None) -> dict:
+        # Gray-failure injection site: a delay-mode failpoint here turns
+        # this replica into a slow-not-dead shard. The shard-suffixed
+        # variant slows ONE replica of an in-process fleet.
+        failpoints.hit(FP_SHARD_LOOKUP)
+        if self.shard_id:
+            failpoints.hit(f"{FP_SHARD_LOOKUP}.{self.shard_id}")
         keys = [int(k) for k in req.get("keys", [])]
         pods = req.get("pods") or []
+        deadline = Deadline.from_wire_ms(req.get("deadline_ms"))
         with tracer().span(
             "llm_d.kv_cache.indexer.LookupBlocks",
             parent_traceparent=extract_traceparent(context),
             keys=len(keys),
             process=self.process_name,
         ):
+            if deadline is not None and deadline.expired():
+                # The budget died in flight (or in the queue): answer
+                # empty-but-flagged instead of doing work nobody can use.
+                self._record_shed("indexer.lookup", "deadline",
+                                  PRIORITY_NORMAL)
+                return {"hits": [], "degraded": True,
+                        "shard": self.shard_id,
+                        "degraded_reason": "deadline"}
             hits: list = []
             if keys:
                 found = self.indexer.kv_block_index.lookup(
@@ -706,12 +851,17 @@ class IndexerServiceClient:
         model_name: str,
         pod_identifiers: Optional[list[str]] = None,
         role: str = "",
+        priority: int = PRIORITY_NORMAL,
     ) -> ScoreResponse:
         """Full-response variant of :meth:`get_pod_scores`: carries the
         ``degraded`` flag and the scorer's ``traceparent`` (hand the
         latter to the chosen engine's ``enqueue`` for score→serve trace
         continuity). ``role`` targets disaggregated scoring ("decode"
-        adds transferred-prefix residency bonuses on the server)."""
+        adds transferred-prefix residency bonuses on the server). The
+        ambient deadline (resilience.deadline.deadline_scope) rides the
+        request as ``deadline_ms``; ``priority`` feeds the server's
+        overload shedder."""
+        dl = current_deadline()
         resp = _call_rpc(
             self._get_pod_scores,
             ScoreRequest(
@@ -719,6 +869,8 @@ class IndexerServiceClient:
                 model_name=model_name,
                 pod_identifiers=list(pod_identifiers or []),
                 role=role,
+                deadline_ms=dl.to_wire_ms() if dl is not None else 0,
+                priority=priority,
             ),
             self._timeout,
             self.retry_policy,

@@ -122,15 +122,30 @@ class UdsTokenizerClient:
 
         The ambient W3C trace context rides as ``traceparent`` gRPC
         metadata (injected per attempt), so the server-side span parents
-        into the caller's trace across the UDS hop.
+        into the caller's trace across the UDS hop. The ambient request
+        deadline rides as ``kvtpu-deadline-ms`` metadata the same way and
+        caps the transport timeout — an already-expired budget fails the
+        call before any wire traffic.
         """
+        from ...resilience.deadline import (
+            current_deadline,
+            deadline_metadata,
+            effective_timeout,
+        )
+
         with tracer().span("llm_d.kv_cache.tokenizer.rpc", method=method):
             tp = current_traceparent()
-            metadata = (("traceparent", tp),) if tp else None
+            md = (("traceparent", tp),) if tp else ()
+            md = md + tuple(deadline_metadata())
+            metadata = md or None
+            dl = current_deadline()
+            timeout = effective_timeout(self._timeout)
 
             def attempt():
+                if dl is not None:
+                    dl.check("services.tokenizer.rpc")
                 failpoints.hit(FP_TOKENIZER_RPC)
-                return rpc(request, timeout=self._timeout, metadata=metadata)
+                return rpc(request, timeout=timeout, metadata=metadata)
 
             try:
                 return call_with_retry(

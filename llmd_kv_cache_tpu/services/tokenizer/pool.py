@@ -84,7 +84,7 @@ class TokenizationPool:
 
     def _worker(self) -> None:
         while True:
-            task = self._queue.get()
+            task = self._queue.get()  # lint: allow-no-deadline (worker parks for work; shutdown via sentinel)
             try:
                 if task is self._stop:
                     return

@@ -51,6 +51,8 @@ KIND_OVERFLOW = "queue_overflow"
 KIND_ENGINE_REQUEST = "engine_request"
 KIND_PROFILE = "profile_capture"
 KIND_LOCKDEP = "lockdep"
+KIND_HEDGE = "hedge"
+KIND_SHED = "shed"
 
 
 class FlightRecorder:

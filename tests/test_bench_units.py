@@ -309,6 +309,7 @@ class TestPerfSentinel:
         assert "pyprof-overhead" in manifest["benches"]
         assert "workingset" in manifest["benches"]
         assert "controller" in manifest["benches"]
+        assert "graytail" in manifest["benches"]
         sentinel = self._sentinel()
         nominal = {
             "pyprof-overhead": {
@@ -320,6 +321,9 @@ class TestPerfSentinel:
             "controller": {
                 "metric": "flap_executed_actions", "value": 1,
                 "unit": "actions", "vs_baseline": 1.0},
+            "graytail": {
+                "metric": "hedging_overhead_pct", "value": 0.2,
+                "unit": "% of score p50", "vs_baseline": 1.0},
         }
         _, failed = sentinel.evaluate(manifest, nominal)
         assert failed == 0

@@ -496,8 +496,9 @@ class TestShardRouter:
         router, *_ = make_router()
         try:
             view = router.debug_view()
-            assert set(view) == {"ring", "breakers", "plan_cache"}
+            assert set(view) == {"ring", "breakers", "plan_cache", "hedging"}
             assert view["ring"]["partitions"] == 1024
+            assert view["hedging"]["enabled"] is True
         finally:
             router.close()
 

@@ -789,3 +789,140 @@ class TestTokenizerRpcFaults:
         finally:
             client.close()
             server.stop(grace=None)
+
+
+@pytest.mark.chaos
+class TestSlowShardGrayFailure:
+    """Gray failure: one of four shards answers 10× slow (delay
+    failpoints, not errors — breakers see only successes). Scoring must
+    stay fast and exact via hedged fan-out to the rf=2 replica owner,
+    with zero breaker flaps."""
+
+    FP_LOOKUP = "chaos.shard.lookup"
+    HEALTHY_S = 0.002
+    SLOW_S = 0.05  # 10x the healthy p99, well past the hedge trigger
+
+    class DelayedShardClient:
+        """In-process shard double whose lookup passes a per-shard delay
+        failpoint (the gray-failure injection surface)."""
+
+        def __init__(self, shard, store, outer):
+            self.shard = shard
+            self.store = store
+            self.outer = outer
+            self.calls = 0
+            self.hedge_calls = 0
+
+        def lookup_blocks(self, keys, pods=None, timeout=None,
+                          deadline=None, hedge=False):
+            self.calls += 1
+            if hedge:
+                self.hedge_calls += 1
+            time.sleep(self.outer.HEALTHY_S)
+            failpoints.hit(f"{self.outer.FP_LOOKUP}.{self.shard}")
+            return {
+                "hits": {k: self.store[k] for k in keys if k in self.store},
+                "degraded": False,
+                "shard": self.shard,
+            }
+
+        def close(self):
+            pass
+
+    def _make_cluster(self):
+        from llmd_kv_cache_tpu.cluster import ClusterConfig, ShardRouter
+
+        cfg = ClusterConfig(
+            shard_addresses=["s0", "s1", "s2", "s3"],
+            replication_factor=2,
+            fanout_chunk_blocks=4,
+            fanout_timeout_s=2.0,
+            hedge_min_delay_s=0.005,
+            # Deterministic chaos: plenty of hedge credit, so the only
+            # trigger under test is the latency quantile.
+            hedge_budget_rate=1.0,
+            hedge_budget_burst=64.0,
+        )
+        tp = ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+        tokens = list(range(1, 65))  # 16 blocks
+        keys = tp.tokens_to_kv_block_keys(0, tokens, MODEL)
+        ring = cfg.build_ring()
+        stores = {s: {} for s in ring.shards}
+        for k in keys:
+            for owner in ring.owners(k, cfg.replication_factor):
+                stores[owner][k] = [
+                    PodEntry(pod_identifier="pod-1", device_tier=TIER_TPU_HBM)
+                ]
+        clients = {
+            s: self.DelayedShardClient(s, stores[s], self) for s in ring.shards
+        }
+        router = ShardRouter(
+            cfg,
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK),
+            clients=clients,
+        )
+        return router, clients, tokens, keys
+
+    def test_hedging_rides_out_one_slow_shard(self):
+        router, clients, tokens, keys = self._make_cluster()
+        try:
+            # Healthy warmup: the per-shard latency quantiles need
+            # min_samples before hedging arms (cold estimates never
+            # trigger a hedge).
+            for _ in range(10):
+                res = router.score(tokens, MODEL)
+                assert res.hit_blocks == len(keys)
+            healthy = [0.0]
+            t0 = time.monotonic()
+            for _ in range(3):
+                router.score(tokens, MODEL)
+            healthy[0] = (time.monotonic() - t0) / 3
+
+            # Gray failure: s1 turns 10x slow — every call still SUCCEEDS.
+            failpoints.arm(f"{self.FP_LOOKUP}.s1", mode="delay",
+                           delay_s=self.SLOW_S)
+            hedged = 0
+            worst = 0.0
+            for _ in range(8):
+                t0 = time.monotonic()
+                res = router.score(tokens, MODEL)
+                worst = max(worst, time.monotonic() - t0)
+                hedged += res.hedges
+                # Exact scores throughout: the replica owner serves the
+                # slow shard's keys, nothing is dropped.
+                assert res.hit_blocks == len(keys)
+                assert not res.degraded
+            assert hedged > 0  # the slow shard tripped hedges
+            # Availability: hedged scores stay near the healthy baseline
+            # instead of absorbing the full injected delay per chunk.
+            assert worst < self.SLOW_S * 3
+            # Zero breaker flaps: slow is not dead — every RPC succeeded,
+            # so no breaker may have opened.
+            assert all(
+                b.state == "closed" for b in router.breakers.values()
+            )
+            # The hedges actually went somewhere: replica owners saw
+            # hedge-marked lookups.
+            assert sum(c.hedge_calls for c in clients.values()) > 0
+        finally:
+            router.close()
+
+    def test_latency_ema_demotes_the_slow_pod(self):
+        """The liveness side of the same scenario: a latency-EMA-enabled
+        tracker demotes the slow pod's scoring weight without ever
+        dropping it to zero (slow is not dead)."""
+        clock = [0.0]
+        tracker = PodLivenessTracker(
+            stale_after_s=1000.0, drop_after_s=2000.0,
+            latency_demote_after_s=0.01, latency_drop_after_s=0.1,
+            latency_floor=0.2, clock=lambda: clock[0])
+        for _ in range(10):
+            tracker.observe_latency("fast-pod", self.HEALTHY_S)
+            tracker.observe_latency("slow-pod", self.SLOW_S * 10)
+        assert tracker.factor("fast-pod") == 1.0
+        assert tracker.factor("slow-pod") == pytest.approx(0.2)
+        # Recovery: the EMA decays back once the pod heals.
+        for _ in range(200):
+            tracker.observe_latency("slow-pod", self.HEALTHY_S)
+        assert tracker.factor("slow-pod") == 1.0

@@ -382,3 +382,471 @@ class TestFailoverIndex:
         clock[0] = 10.0  # reset timeout elapsed: probe admitted
         idx.lookup([1])
         assert idx.breaker.state == "closed"
+
+
+class TestDeadline:
+    def _dl(self, budget, clock):
+        from llmd_kv_cache_tpu.resilience.deadline import Deadline
+
+        return Deadline.after(budget, clock=lambda: clock[0])
+
+    def test_remaining_and_expiry(self):
+        clock = [0.0]
+        dl = self._dl(1.0, clock)
+        assert dl.remaining_s() == pytest.approx(1.0)
+        assert not dl.expired()
+        clock[0] = 1.5
+        assert dl.expired()
+        assert dl.remaining_s() == pytest.approx(-0.5)
+
+    def test_wire_round_trip_is_relative(self):
+        from llmd_kv_cache_tpu.resilience.deadline import Deadline
+
+        clock = [100.0]
+        dl = self._dl(0.25, clock)
+        ms = dl.to_wire_ms()
+        assert ms == 250
+        # The receiving peer's clock is wildly different — the budget
+        # re-anchors on it untouched (skew-free by construction).
+        peer_clock = [5.0]
+        peer = Deadline.from_wire_ms(ms, clock=lambda: peer_clock[0])
+        assert peer.remaining_s() == pytest.approx(0.25)
+
+    def test_wire_decode_tolerates_garbage(self):
+        from llmd_kv_cache_tpu.resilience.deadline import Deadline
+
+        assert Deadline.from_wire_ms(None) is None
+        assert Deadline.from_wire_ms(0) is None
+        assert Deadline.from_wire_ms(-5) is None
+        assert Deadline.from_wire_ms("nonsense") is None
+        assert Deadline.from_wire_ms("40") is not None
+
+    def test_nearly_spent_budget_never_encodes_as_none(self):
+        clock = [0.0]
+        dl = self._dl(0.0004, clock)  # under 1 ms left
+        assert dl.to_wire_ms() == 1
+        clock[0] = 1.0
+        assert dl.to_wire_ms() == 0
+
+    def test_cap_timeout_takes_the_stricter(self):
+        clock = [0.0]
+        dl = self._dl(0.5, clock)
+        assert dl.cap_timeout(2.0) == pytest.approx(0.5)
+        assert dl.cap_timeout(0.1) == pytest.approx(0.1)
+        assert dl.cap_timeout(None) == pytest.approx(0.5)
+        clock[0] = 1.0
+        assert dl.cap_timeout(2.0) == 0.0
+
+    def test_check_raises_with_site_and_overrun(self):
+        from llmd_kv_cache_tpu.resilience.deadline import DeadlineExceeded
+
+        clock = [0.0]
+        dl = self._dl(0.1, clock)
+        dl.check("early")  # no raise
+        clock[0] = 0.35
+        with pytest.raises(DeadlineExceeded) as ei:
+            dl.check("scoring.index_lookup")
+        assert ei.value.site == "scoring.index_lookup"
+        assert ei.value.overrun_s == pytest.approx(0.25)
+        assert isinstance(ei.value, TimeoutError)  # legacy handlers catch it
+
+    def test_ambient_scope_keeps_stricter_deadline(self):
+        from llmd_kv_cache_tpu.resilience.deadline import (
+            current_deadline,
+            deadline_scope,
+        )
+
+        clock = [0.0]
+        outer = self._dl(1.0, clock)
+        inner_late = self._dl(5.0, clock)
+        inner_early = self._dl(0.2, clock)
+        assert current_deadline() is None
+        with deadline_scope(outer):
+            assert current_deadline() is outer
+            with deadline_scope(inner_late):
+                assert current_deadline() is outer  # can't extend
+            with deadline_scope(inner_early):
+                assert current_deadline() is inner_early  # can shrink
+            with deadline_scope(None):
+                assert current_deadline() is outer  # None never clears outer
+        assert current_deadline() is None
+
+    def test_effective_timeout_and_metadata(self):
+        from llmd_kv_cache_tpu.resilience.deadline import (
+            GRPC_DEADLINE_KEY,
+            deadline_metadata,
+            deadline_scope,
+            effective_timeout,
+        )
+
+        clock = [0.0]
+        assert effective_timeout(3.0) == 3.0  # no ambient deadline
+        assert deadline_metadata() == ()
+        with deadline_scope(self._dl(0.5, clock)):
+            assert effective_timeout(3.0) == pytest.approx(0.5)
+            ((key, value),) = deadline_metadata()
+            assert key == GRPC_DEADLINE_KEY
+            assert value == "500"
+
+    def test_extract_deadline_from_grpc_metadata(self):
+        from llmd_kv_cache_tpu.resilience.deadline import (
+            GRPC_DEADLINE_KEY,
+            extract_deadline,
+        )
+
+        class FakeContext:
+            def invocation_metadata(self):
+                return ((GRPC_DEADLINE_KEY, "120"), ("traceparent", "x"))
+
+        class BrokenContext:
+            def invocation_metadata(self):
+                raise RuntimeError("not a real context")
+
+        dl = extract_deadline(FakeContext())
+        assert dl is not None and 0.0 < dl.remaining_s() <= 0.12
+        assert extract_deadline(None) is None
+        assert extract_deadline(BrokenContext()) is None
+
+
+class TestLatencyQuantileTracker:
+    def test_cold_target_returns_none(self):
+        from llmd_kv_cache_tpu.resilience import LatencyQuantileTracker
+
+        t = LatencyQuantileTracker(quantile=0.95, min_samples=8)
+        assert t.value("shard-0") is None
+        for _ in range(7):
+            t.observe("shard-0", 0.01)
+        assert t.value("shard-0") is None  # still below min_samples
+        t.observe("shard-0", 0.01)
+        assert t.value("shard-0") is not None
+
+    def test_estimate_sits_in_the_upper_tail(self):
+        from llmd_kv_cache_tpu.resilience import LatencyQuantileTracker
+
+        t = LatencyQuantileTracker(quantile=0.9, min_samples=8)
+        rng = random.Random(42)
+        samples = [rng.uniform(0.001, 0.01) for _ in range(2000)]
+        for s in samples:
+            t.observe("s", s)
+        est = t.value("s")
+        below = sum(1 for s in samples if s <= est) / len(samples)
+        assert 0.75 <= below <= 1.0  # upper tail, not the median
+
+    def test_targets_are_independent(self):
+        from llmd_kv_cache_tpu.resilience import LatencyQuantileTracker
+
+        t = LatencyQuantileTracker(quantile=0.9, min_samples=4)
+        for _ in range(16):
+            t.observe("fast", 0.001)
+            t.observe("slow", 0.1)
+        assert t.value("slow") > t.value("fast") * 10
+        assert set(t.snapshot()) == {"fast", "slow"}
+
+    def test_invalid_quantile_rejected(self):
+        from llmd_kv_cache_tpu.resilience import LatencyQuantileTracker
+
+        with pytest.raises(ValueError):
+            LatencyQuantileTracker(quantile=0.3)
+        with pytest.raises(ValueError):
+            LatencyQuantileTracker(quantile=1.0)
+
+
+class TestHedgeBudget:
+    def test_hedges_capped_at_traffic_fraction(self):
+        from llmd_kv_cache_tpu.resilience import HedgeBudget
+
+        b = HedgeBudget(rate=0.1, burst=8.0)
+        granted = 0
+        for _ in range(200):
+            b.on_primary()
+            if b.spend():
+                granted += 1
+        # 200 primaries * 0.1 = 20 tokens earned (+1 initial credit).
+        assert granted <= 21
+        assert b.hedge_rate() <= 0.15
+
+    def test_burst_bounds_idle_accumulation(self):
+        from llmd_kv_cache_tpu.resilience import HedgeBudget
+
+        b = HedgeBudget(rate=1.0, burst=4.0)
+        b.on_primary(1000)  # an idle hour of credit
+        granted = sum(1 for _ in range(100) if b.spend())
+        assert granted == 4  # capped at burst
+
+    def test_denied_accounting(self):
+        from llmd_kv_cache_tpu.resilience import HedgeBudget
+
+        b = HedgeBudget(rate=0.0, burst=1.0)
+        assert b.spend()  # initial credit
+        assert not b.spend()
+        stats = b.stats()
+        assert stats["hedges"] == 1 and stats["denied"] == 1
+
+    def test_invalid_rate_rejected(self):
+        from llmd_kv_cache_tpu.resilience import HedgeBudget
+
+        with pytest.raises(ValueError):
+            HedgeBudget(rate=-0.1)
+
+
+class TestCoDelShedder:
+    def _shedder(self, clock, target=0.005, interval=0.1):
+        from llmd_kv_cache_tpu.resilience import CoDelShedder
+
+        return CoDelShedder("t", target_delay_s=target, interval_s=interval,
+                            clock=lambda: clock[0])
+
+    def test_burst_below_an_interval_never_sheds(self):
+        from llmd_kv_cache_tpu.resilience import ADMIT
+
+        clock = [0.0]
+        s = self._shedder(clock)
+        s.observe_delay(0.05)  # above target...
+        clock[0] = 0.05
+        s.observe_delay(0.05)  # ...but not yet for a full interval
+        assert s.admit() == ADMIT
+        assert not s.overloaded
+
+    def test_sustained_delay_browns_out_then_sheds(self):
+        from llmd_kv_cache_tpu.resilience import (
+            BROWNOUT,
+            SHED,
+            CoDelShedder,
+            PRIORITY_LOW,
+        )
+        from llmd_kv_cache_tpu.resilience.shedding import (
+            PRIORITY_CRITICAL,
+            _NORMAL_SHED_AFTER,
+        )
+
+        clock = [0.0]
+        s = self._shedder(clock)
+        s.observe_delay(0.05)
+        clock[0] = 0.11  # a full interval above target
+        s.observe_delay(0.05)
+        assert s.overloaded
+        assert s.admit() == BROWNOUT            # normal browns out first
+        assert s.admit(PRIORITY_LOW) == SHED    # low sheds immediately
+        assert s.admit(PRIORITY_CRITICAL) == "admit"  # critical never sheds
+        # Persisting overload ramps the control law until normal sheds too.
+        for _ in range(_NORMAL_SHED_AFTER + 2):
+            clock[0] += 0.2
+            s.observe_delay(0.05)
+        assert s.admit() == SHED
+        assert s.pressure >= _NORMAL_SHED_AFTER
+
+    def test_recovery_clears_immediately(self):
+        from llmd_kv_cache_tpu.resilience import ADMIT
+
+        clock = [0.0]
+        s = self._shedder(clock)
+        s.observe_delay(0.05)
+        clock[0] = 0.11
+        s.observe_delay(0.05)
+        assert s.overloaded
+        s.observe_delay(0.001)  # queue drained
+        assert not s.overloaded
+        assert s.admit() == ADMIT
+        assert s.pressure == 0
+
+    def test_listener_sees_transitions_and_stats_accumulate(self):
+        events = []
+        clock = [0.0]
+        s = self._shedder(clock)
+        s.add_listener(lambda event, delay: events.append(event))
+        s.observe_delay(0.05)
+        clock[0] = 0.11
+        s.observe_delay(0.05)
+        s.admit()
+        s.observe_delay(0.001)
+        assert events == ["overload", "clear"]
+        stats = s.stats()
+        assert stats["site"] == "t"
+        assert stats["brownouts"] == 1
+        assert 0.0 <= stats["shed_rate"] <= 1.0
+
+    def test_invalid_config_rejected(self):
+        from llmd_kv_cache_tpu.resilience import CoDelShedder
+
+        with pytest.raises(ValueError):
+            CoDelShedder("t", target_delay_s=0.0)
+        with pytest.raises(ValueError):
+            CoDelShedder("t", interval_s=-1.0)
+
+
+class TestFailpointDelayJitter:
+    def test_jitter_schedule_is_seed_deterministic(self):
+        def schedule(seed):
+            reg = FailpointRegistry(seed=seed)
+            reg.arm("slow.site", mode="delay", delay_s=0.0, jitter_s=0.004)
+            out = []
+            for _ in range(6):
+                fp = reg._points["slow.site"]
+                out.append(fp.rng.uniform(0.0, fp.jitter_s))
+            return out
+
+        assert schedule(7) == schedule(7)
+        assert schedule(7) != schedule(8)
+
+    def test_jitter_independent_of_probability_stream(self):
+        """The per-point jitter RNG must not perturb the registry RNG the
+        probability determinism test depends on."""
+        reg_plain = FailpointRegistry(seed=11)
+        reg_plain.arm("p", probability=0.5)
+        plain = [reg_plain.should_fire("p") for _ in range(32)]
+
+        reg_jitter = FailpointRegistry(seed=11)
+        reg_jitter.arm("slow", mode="delay", delay_s=0.0, jitter_s=0.01)
+        reg_jitter.arm("p", probability=0.5)
+        for _ in range(4):
+            reg_jitter.hit("slow")  # draws from the per-point RNG only
+        assert [reg_jitter.should_fire("p") for _ in range(32)] == plain
+
+    def test_env_spec_grammar_with_jitter(self):
+        reg = FailpointRegistry()
+        reg.configure_from_env({
+            "KVTPU_FAILPOINTS":
+                "a.b=delay:delay_ms=20:jitter_ms=5,c.d=delay:delay=0.01:jitter=0.002",
+        })
+        a = reg._points["a.b"]
+        assert a.delay_s == pytest.approx(0.02)
+        assert a.jitter_s == pytest.approx(0.005)
+        c = reg._points["c.d"]
+        assert c.delay_s == pytest.approx(0.01)
+        assert c.jitter_s == pytest.approx(0.002)
+
+    def test_negative_jitter_rejected(self):
+        reg = FailpointRegistry()
+        with pytest.raises(ValueError):
+            reg.arm("x", mode="delay", jitter_s=-0.1)
+
+
+class TestLivenessLatencyDemotion:
+    def _tracker(self, clock, demote=0.05, drop=0.5, floor=0.1):
+        return PodLivenessTracker(
+            stale_after_s=1000.0, drop_after_s=2000.0,
+            latency_demote_after_s=demote, latency_drop_after_s=drop,
+            latency_floor=floor, clock=lambda: clock[0])
+
+    def test_disabled_by_default(self):
+        t = PodLivenessTracker(stale_after_s=10.0, drop_after_s=30.0)
+        t.touch("p")
+        for _ in range(20):
+            t.observe_latency("p", 99.0)
+        assert t.factor("p") == 1.0  # latency demotion off unless configured
+
+    def test_needs_min_samples(self):
+        clock = [0.0]
+        t = self._tracker(clock)
+        t.touch("p")
+        for _ in range(4):
+            t.observe_latency("p", 10.0)
+        assert t.latency_factor("p") == 1.0  # not enough evidence yet
+        t.observe_latency("p", 10.0)
+        assert t.latency_factor("p") < 1.0
+
+    def test_slow_pod_demotes_to_floor_never_zero(self):
+        clock = [0.0]
+        t = self._tracker(clock, demote=0.05, drop=0.5, floor=0.1)
+        t.touch("p")
+        for _ in range(50):
+            t.observe_latency("p", 10.0)  # EMA converges far past drop
+        assert t.latency_factor("p") == pytest.approx(0.1)
+        assert t.factor("p") == pytest.approx(0.1)  # slow, not dead
+
+    def test_fast_pod_keeps_full_factor_and_recovers(self):
+        clock = [0.0]
+        t = self._tracker(clock)
+        t.touch("p")
+        for _ in range(10):
+            t.observe_latency("p", 0.001)
+        assert t.latency_factor("p") == 1.0
+        for _ in range(10):
+            t.observe_latency("p", 0.3)  # mid demotion band
+        mid = t.latency_factor("p")
+        assert 0.1 < mid < 1.0
+        for _ in range(200):
+            t.observe_latency("p", 0.001)  # healed: EMA decays back
+        assert t.latency_factor("p") == 1.0
+
+    def test_mark_removed_clears_latency_state(self):
+        clock = [0.0]
+        t = self._tracker(clock)
+        t.touch("p")
+        for _ in range(10):
+            t.observe_latency("p", 10.0)
+        t.mark_removed("p")
+        assert t.latency_ema("p") is None
+        assert t.factor("p") == 1.0
+
+    def test_invalid_latency_config_rejected(self):
+        with pytest.raises(ValueError):
+            PodLivenessTracker(stale_after_s=10.0, drop_after_s=30.0,
+                               latency_demote_after_s=1.0,
+                               latency_drop_after_s=0.5)
+        with pytest.raises(ValueError):
+            PodLivenessTracker(stale_after_s=10.0, drop_after_s=30.0,
+                               latency_demote_after_s=1.0,
+                               latency_drop_after_s=2.0,
+                               latency_floor=1.5)
+
+
+class TestCircuitBreakerProbeLease:
+    """Half-open probe hardening: one concurrent probe, and a lease that
+    expires so a dead prober cannot wedge the breaker (runs with the
+    lockdep witness armed — the breaker lock is a new_lock())."""
+
+    @pytest.fixture(autouse=True)
+    def _witness(self):
+        from llmd_kv_cache_tpu.utils import lockdep
+
+        was = lockdep.enabled()
+        lockdep.set_enabled(True)
+        lockdep.reset()
+        yield
+        lockdep.set_enabled(was, budget_s=0)
+        lockdep.reset()
+
+    def _open_breaker(self, clock, probe_timeout=30.0):
+        b = CircuitBreaker(target="t", failure_threshold=1,
+                           reset_timeout_s=10.0,
+                           probe_timeout_s=probe_timeout,
+                           clock=lambda: clock[0])
+        b.record_failure()
+        assert b.state == "open"
+        return b
+
+    def test_single_concurrent_probe(self):
+        clock = [0.0]
+        b = self._open_breaker(clock)
+        clock[0] = 10.0
+        assert b.allow()       # probe slot claimed
+        assert not b.allow()   # second caller rejected while lease is live
+        clock[0] = 20.0        # inside the lease window
+        assert not b.allow()
+
+    def test_dead_prober_cannot_wedge_the_breaker(self):
+        clock = [0.0]
+        b = self._open_breaker(clock, probe_timeout=30.0)
+        clock[0] = 10.0
+        assert b.allow()  # prober claims the lease... and dies silently
+        clock[0] = 39.9
+        assert not b.allow()  # lease still live
+        clock[0] = 40.0   # lease expired: the breaker makes progress again
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_stale_probers_report_is_harmless(self):
+        clock = [0.0]
+        b = self._open_breaker(clock, probe_timeout=30.0)
+        clock[0] = 10.0
+        assert b.allow()      # prober A (goes quiet)
+        clock[0] = 40.0
+        assert b.allow()      # prober B reclaims the lease
+        b.record_failure()    # A's late failure report
+        assert b.state == "open"  # re-opened, not wedged
+        clock[0] = 50.0
+        assert b.allow()      # and recovery still proceeds
+        b.record_success()
+        assert b.state == "closed"

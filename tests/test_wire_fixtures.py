@@ -246,6 +246,65 @@ class TestScoreWireCompat:
         assert d["scores"] == {"pod-1": 0.75, "pod-2": 0.25}
         assert d["error"] == ""  # legacy fields present and well-typed
 
+    def test_legacy_request_decodes_without_deadline(self):
+        """Deadline-unaware peers predate the gray-failure plane — their
+        bytes keep decoding with no budget and normal priority."""
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_legacy.bin"))
+        assert req.deadline_ms == 0
+        assert req.priority == 1
+
+    def test_deadline_request_decodes_and_ignores_future_keys(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_deadline.bin"))
+        assert req.tokens == [11, 12, 13]
+        assert req.deadline_ms == 250
+        assert req.priority == 2  # hedge_hint silently ignored
+        again = ScoreRequest.from_bytes(req.to_bytes())
+        assert (again.deadline_ms, again.priority) == (250, 2)
+
+    def test_legacy_response_decodes_without_degraded_reason(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_legacy.bin"))
+        assert resp.degraded_reason == ""
+
+    def test_brownout_response_round_trips(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_brownout.bin"))
+        assert resp.scores == {"pod-1": 0.5}
+        assert resp.degraded is True
+        assert resp.degraded_reason == "brownout"
+        again = ScoreResponse.from_bytes(resp.to_bytes())
+        assert again == resp
+
+    def test_old_peer_view_of_deadline_bytes(self):
+        """An old decoder reading deadline-bearing bytes never looks at
+        the new keys — the legacy fields stay well-typed."""
+        import msgpack
+
+        d = msgpack.unpackb(load("score_request_deadline.bin"), raw=False)
+        assert d["tokens"] == [11, 12, 13]
+        assert d["model_name"] == "llama-2-7b"
+
+    def test_lookup_frame_deadline_and_hedge_markers(self):
+        """The shard-RPC lookup frame carries ``deadline_ms``/``hedge``
+        the same tolerant way: new servers read them via ``.get``, old
+        servers never look."""
+        import msgpack
+
+        d = msgpack.unpackb(load("lookup_request_deadline.bin"), raw=False)
+        assert d["keys"] == [100, 101]
+        assert d["pods"] == ["pod-1"]
+        assert d["deadline_ms"] == 40
+        assert d["hedge"] is True
+        # An old peer's projection: the legacy keys alone are enough.
+        assert {k: d[k] for k in ("keys", "pods")} == {
+            "keys": [100, 101], "pods": ["pod-1"]}
+
 
 class TestWireToIndex:
     def test_committed_bytes_through_zmq_pool_index(self):

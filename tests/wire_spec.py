@@ -189,6 +189,34 @@ def score_fixtures() -> dict[str, bytes]:
             (s("traceparent"), s(TRACEPARENT)),
             (s("residency"), mp((s("decode-1"), f64(1.25)))),
         ),
+        # Gray-failure plane: end-to-end deadline budget + shed priority
+        # arrive the same tolerant way shard/role did — a ms budget (never
+        # an absolute timestamp: clocks skew, budgets don't) and an int
+        # priority class, plus an unknown future key decoders must ignore.
+        "score_request_deadline.bin": mp(
+            (s("tokens"), arr(u(11), u(12), u(13))),
+            (s("model_name"), s("llama-2-7b")),
+            (s("pod_identifiers"), arr(s("pod-1"))),
+            (s("deadline_ms"), u(250)),
+            (s("priority"), u(2)),
+            (s("hedge_hint"), nil()),
+        ),
+        # Brownout response: served, but flagged degraded with the reason
+        # the overload shedder attached (residency fold-in skipped).
+        "score_response_brownout.bin": mp(
+            (s("scores"), mp((s("pod-1"), f64(0.5)))),
+            (s("error"), s("")),
+            (s("degraded"), tru()),
+            (s("degraded_reason"), s("brownout")),
+        ),
+        # Shard-RPC lookup frame with deadline + hedge markers (the
+        # cluster.remote frame wire): old shards ignore both keys.
+        "lookup_request_deadline.bin": mp(
+            (s("keys"), arr(u(100), u(101))),
+            (s("pods"), arr(s("pod-1"))),
+            (s("deadline_ms"), u(40)),
+            (s("hedge"), tru()),
+        ),
     }
 
 
