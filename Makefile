@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-hotpath-fleet bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -69,6 +69,13 @@ bench: native
 # backend unlike `make bench`.
 bench-hotpath: native
 	$(CPU_ENV) $(PY) hack/bench_hotpath.py
+
+# Fleet-scale data-plane arm (ISSUE 17): batched LookupBlocksBatch
+# fan-out vs the per-chunk wire over a 4-shard in-process fleet with
+# concurrent zero-copy ingest; hard-asserts the >=5x throughput ratio
+# and the ingest-lag staleness bound internally.
+bench-hotpath-fleet: native
+	$(CPU_ENV) $(PY) hack/bench_hotpath.py --fleet
 
 # Engine-telemetry overhead gate: asserts the per-step hook cost stays
 # under 1% of the decode-step p50 (telemetry/engine_telemetry.py).
@@ -146,11 +153,13 @@ perf-check: native
 	$(CPU_ENV) $(PY) bench.py --workingset > /tmp/kvtpu_workingset_bench.json
 	$(CPU_ENV) $(PY) bench.py --controller > /tmp/kvtpu_controller_bench.json
 	$(CPU_ENV) $(PY) bench.py --graytail > /tmp/kvtpu_graytail_bench.json
+	$(CPU_ENV) $(PY) hack/bench_hotpath.py --fleet > /tmp/kvtpu_fleet_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
 	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
 	  --results workingset=/tmp/kvtpu_workingset_bench.json \
 	  --results controller=/tmp/kvtpu_controller_bench.json \
-	  --results graytail=/tmp/kvtpu_graytail_bench.json
+	  --results graytail=/tmp/kvtpu_graytail_bench.json \
+	  --results hotpath-fleet=/tmp/kvtpu_fleet_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.
 verify: lint perf-check

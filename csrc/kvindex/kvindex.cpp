@@ -415,6 +415,167 @@ class Index {
     return n;
   }
 
+  // Chunked fused scoring with transferred-residency fold-in: one native
+  // call (and one lock hold) covers the whole data plane of a score —
+  // the early-exit chunked lookup AND the per-pod consecutive-from-0
+  // residency walk that scoring/residency.py::ResidencyTracker.bonus
+  // otherwise runs per key in Python.
+  //
+  // Chunk semantics mirror the Python ``lookup_chunked`` path: keys are
+  // scanned ``chunk_size`` at a time and the scan stops at the first
+  // chunk boundary after the prefix chain broke (chunk_size <= 0 scans
+  // everything in one chunk). Scores are identical to Score() with
+  // early_exit either way — post-break keys never accumulate — but hit
+  // telemetry covers the whole breaking chunk, matching the Python
+  // chunked path rather than Score's per-key early exit.
+  //
+  // Residency claims arrive as parallel arrays of (pod id, key index,
+  // landed flag). Per pod the walk runs along key indices from 0 and
+  // stops at the first index with no claim; landed claims weigh
+  // landed_weight, in-flight ones in_flight_discount, and the pod's
+  // total is scaled by tier_discount. Only positive totals are emitted
+  // (out_res_pods/out_res_bonus, count via out_res_n). Bonuses are NOT
+  // folded into out_scores: the Python caller applies liveness weighting
+  // to the base scores first, exactly like the unfused path.
+  //
+  // out_chunks counts chunks entered, out_early_exit is 1 when the scan
+  // stopped before the last key. Returns the number of (pod, score)
+  // pairs, or -needed when out_cap is too small (retry with a bigger
+  // buffer; res_cap is exact-sized by the caller and never grows).
+  int ScoreChunked(const uint64_t* keys, int n_keys,
+                   const int32_t* filter_pods, int n_filter,
+                   const int32_t* weight_tiers, const double* weight_values,
+                   int n_weights, int chunk_size, const int32_t* claim_pods,
+                   const int32_t* claim_key_idx, const uint8_t* claim_landed,
+                   int n_claims, double landed_weight,
+                   double in_flight_discount, double tier_discount,
+                   int32_t* out_pods, double* out_scores, int out_cap,
+                   int32_t* out_hits, int32_t* out_chunks,
+                   int32_t* out_early_exit, int32_t* out_res_pods,
+                   double* out_res_bonus, int res_cap, int32_t* out_res_n) {
+    std::lock_guard<std::mutex> lk(mu_);
+
+    auto tier_weight = [&](int32_t tier) {
+      for (int i = 0; i < n_weights; ++i) {
+        if (weight_tiers[i] == tier) return weight_values[i];
+      }
+      return 1.0;
+    };
+    auto pod_allowed = [&](int32_t pod) {
+      if (n_filter == 0) return true;
+      for (int i = 0; i < n_filter; ++i) {
+        if (filter_pods[i] == pod) return true;
+      }
+      return false;
+    };
+
+    if (chunk_size <= 0 || chunk_size > n_keys) {
+      chunk_size = n_keys > 0 ? n_keys : 1;
+    }
+
+    std::unordered_map<int32_t, double> scores;   // accumulated
+    std::unordered_map<int32_t, double> current;  // this key's max weights
+    std::unordered_map<int32_t, bool> active;     // in the prefix chain
+
+    int hits = 0;
+    int chunks = 0;
+    int scanned = 0;
+    bool scoring = true;  // false once the prefix chain broke
+    bool first = true;
+    bool stopped = false;
+    for (int cs = 0; cs < n_keys && !stopped; cs += chunk_size) {
+      ++chunks;
+      int ce = std::min(cs + chunk_size, n_keys);
+      for (int ki = cs; ki < ce; ++ki) {
+        ++scanned;
+        auto it = data_.find(keys[ki]);
+        if (it == data_.end()) {
+          scoring = false;  // absent key: scan the rest of the chunk
+          continue;
+        }
+        PodSlot& slot = it->second;
+        if (slot.entries.empty()) {  // known-but-empty: Lookup stops too
+          stopped = true;
+          break;
+        }
+        ++hits;
+        key_lru_.splice(key_lru_.begin(), key_lru_, slot.lru_it);
+        if (!scoring) continue;
+
+        current.clear();
+        for (const Entry& e : slot.entries) {
+          if (!pod_allowed(e.pod)) continue;
+          double w = tier_weight(e.tier);
+          auto [cit, inserted] = current.emplace(e.pod, w);
+          if (!inserted && w > cit->second) cit->second = w;
+        }
+
+        if (first) {
+          for (auto& [pod, w] : current) {
+            scores[pod] = w;
+            active[pod] = true;
+          }
+          first = false;
+        } else {
+          for (auto& [pod, is_active] : active) {
+            if (!is_active) continue;
+            auto cit = current.find(pod);
+            if (cit != current.end()) {
+              scores[pod] += cit->second;
+            } else {
+              is_active = false;
+            }
+          }
+          bool any = false;
+          for (auto& [pod, is_active] : active) {
+            if (is_active) { any = true; break; }
+          }
+          if (!any) scoring = false;
+        }
+      }
+      if (!scoring) stopped = true;  // chunk-boundary early exit
+    }
+
+    *out_hits = hits;
+    *out_chunks = chunks;
+    *out_early_exit = scanned < n_keys ? 1 : 0;
+
+    // Residency fold-in: group sparse claims by pod, then per pod walk
+    // the key indices consecutively from 0 (ResidencyTracker.bonus).
+    int res_n = 0;
+    if (n_claims > 0) {
+      std::unordered_map<int32_t, std::unordered_map<int32_t, uint8_t>> by_pod;
+      for (int i = 0; i < n_claims; ++i) {
+        by_pod[claim_pods[i]].emplace(claim_key_idx[i], claim_landed[i]);
+      }
+      for (auto& [pod, idx_map] : by_pod) {
+        double total = 0.0;
+        for (int idx = 0; idx < n_keys; ++idx) {
+          auto cit = idx_map.find(idx);
+          if (cit == idx_map.end()) break;
+          total += cit->second ? landed_weight : in_flight_discount;
+        }
+        if (total > 0.0 && res_n < res_cap) {
+          out_res_pods[res_n] = pod;
+          out_res_bonus[res_n] = total * tier_discount;
+          ++res_n;
+        }
+      }
+    }
+    *out_res_n = res_n;
+
+    if (static_cast<int>(scores.size()) > out_cap) {
+      return -static_cast<int>(scores.size());
+    }
+    int n = 0;
+    for (auto& [pod, score] : scores) {
+      out_pods[n] = pod;
+      out_scores[n] = score;
+      ++n;
+    }
+    return n;
+  }
+
  private:
   PodSlot& TouchKey(uint64_t key) {
     auto it = data_.find(key);
@@ -634,5 +795,26 @@ int kvidx_score_ex(void* idx, const uint64_t* keys, int n_keys,
                                          weight_tiers, weight_values,
                                          n_weights, out_pods, out_scores,
                                          out_cap, out_hits, early_exit);
+}
+
+// Chunked fused scoring + residency fold-in (see Index::ScoreChunked).
+// One ctypes crossing per score regardless of prompt length: chunk-
+// granular early exit, hit/chunk counters, and the per-pod residency
+// walk all happen under one native lock hold.
+int kvidx_score_chunked(
+    void* idx, const uint64_t* keys, int n_keys, const int32_t* filter_pods,
+    int n_filter, const int32_t* weight_tiers, const double* weight_values,
+    int n_weights, int chunk_size, const int32_t* claim_pods,
+    const int32_t* claim_key_idx, const uint8_t* claim_landed, int n_claims,
+    double landed_weight, double in_flight_discount, double tier_discount,
+    int32_t* out_pods, double* out_scores, int out_cap, int32_t* out_hits,
+    int32_t* out_chunks, int32_t* out_early_exit, int32_t* out_res_pods,
+    double* out_res_bonus, int res_cap, int32_t* out_res_n) {
+  return static_cast<Index*>(idx)->ScoreChunked(
+      keys, n_keys, filter_pods, n_filter, weight_tiers, weight_values,
+      n_weights, chunk_size, claim_pods, claim_key_idx, claim_landed, n_claims,
+      landed_weight, in_flight_discount, tier_discount, out_pods, out_scores,
+      out_cap, out_hits, out_chunks, out_early_exit, out_res_pods,
+      out_res_bonus, res_cap, out_res_n);
 }
 }

@@ -1,11 +1,15 @@
-// TSAN stress for the native index: concurrent add / lookup / evict /
+// Native index test binary: a single-threaded correctness section for
+// the fused chunked-scoring entry point (run under ASan/UBSan by `make
+// asan`), then the TSAN stress — concurrent add / lookup / evict /
 // score / clear against one instance (the role `go test -race` plays for
 // the reference's fine-grained-locking index; ours is coarser-locked, so
 // this guards the lock discipline as the implementation evolves).
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <random>
 #include <thread>
 #include <vector>
@@ -28,9 +32,158 @@ void kvidx_evict(void* idx, uint64_t key, int is_engine_key,
 uint64_t kvidx_get_request_key(void* idx, uint64_t engine_key);
 void kvidx_clear(void* idx, int32_t pod);
 uint64_t kvidx_len(void* idx);
+int kvidx_score_ex(void* idx, const uint64_t* keys, int n_keys,
+                   const int32_t* filter_pods, int n_filter,
+                   const int32_t* weight_tiers, const double* weight_values,
+                   int n_weights, int32_t* out_pods, double* out_scores,
+                   int out_cap, int32_t* out_hits, int early_exit);
+int kvidx_score_chunked(
+    void* idx, const uint64_t* keys, int n_keys, const int32_t* filter_pods,
+    int n_filter, const int32_t* weight_tiers, const double* weight_values,
+    int n_weights, int chunk_size, const int32_t* claim_pods,
+    const int32_t* claim_key_idx, const uint8_t* claim_landed, int n_claims,
+    double landed_weight, double in_flight_discount, double tier_discount,
+    int32_t* out_pods, double* out_scores, int out_cap, int32_t* out_hits,
+    int32_t* out_chunks, int32_t* out_early_exit, int32_t* out_res_pods,
+    double* out_res_bonus, int res_cap, int32_t* out_res_n);
 }
 
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,      \
+                   __LINE__, #cond);                                     \
+      std::abort();                                                      \
+    }                                                                    \
+  } while (0)
+
+namespace {
+
+// kvidx_score_chunked correctness: chunk-granular early exit must be
+// score-equivalent to kvidx_score_ex, the residency walk must match the
+// Python tracker's consecutive-from-0 rule, and degenerate chunk sizes
+// (0, oversized) must behave as one full-array chunk.
+void TestScoreChunked() {
+  void* idx = kvidx_create(10000, 8, 10000);
+  int32_t pods[3];
+  pods[0] = kvidx_intern(idx, "pod-0");
+  pods[1] = kvidx_intern(idx, "pod-1");
+  pods[2] = kvidx_intern(idx, "pod-2");
+  int32_t hbm = kvidx_intern(idx, "tpu-hbm");
+  int32_t cpu = kvidx_intern(idx, "cpu");
+
+  constexpr int kKeys = 32;
+  uint64_t keys[kKeys];
+  for (int i = 0; i < kKeys; ++i) keys[i] = 1000 + i;
+  uint8_t zero_flag = 0;
+  int32_t zero_group = 0;
+  // pod-0 holds keys 0..8 in HBM, pod-1 holds 0..19 in cpu, pod-2 holds
+  // nothing; the chain breaks globally at key 20.
+  for (int i = 0; i < 9; ++i) {
+    kvidx_add(idx, nullptr, 0, &keys[i], 1, &pods[0], &hbm, &zero_flag,
+              &zero_group, 1);
+  }
+  for (int i = 0; i < 20; ++i) {
+    kvidx_add(idx, nullptr, 0, &keys[i], 1, &pods[1], &cpu, &zero_flag,
+              &zero_group, 1);
+  }
+  // resident island past the break: must never score
+  for (int i = 25; i < kKeys; ++i) {
+    kvidx_add(idx, nullptr, 0, &keys[i], 1, &pods[0], &hbm, &zero_flag,
+              &zero_group, 1);
+  }
+
+  int32_t wt[2] = {hbm, cpu};
+  double wv[2] = {2.0, 1.0};
+
+  int32_t ref_pods[16], chunk_pods[16], res_pods[16];
+  double ref_scores[16], chunk_scores[16], res_bonus[16];
+  int32_t ref_hits = 0, hits = 0, chunks = 0, early = 0, res_n = 0;
+
+  int ref_n = kvidx_score_ex(idx, keys, kKeys, nullptr, 0, wt, wv, 2,
+                             ref_pods, ref_scores, 16, &ref_hits, 1);
+  CHECK(ref_n == 2);
+
+  for (int chunk_size : {1, 4, 7, 32, 64, 0}) {
+    int n = kvidx_score_chunked(
+        idx, keys, kKeys, nullptr, 0, wt, wv, 2, chunk_size, nullptr, nullptr,
+        nullptr, 0, 1.0, 0.5, 1.0, chunk_pods, chunk_scores, 16, &hits,
+        &chunks, &early, res_pods, res_bonus, 16, &res_n);
+    CHECK(n == ref_n);
+    // same (pod, score) pairs regardless of chunk granularity
+    for (int i = 0; i < n; ++i) {
+      bool found = false;
+      for (int j = 0; j < ref_n; ++j) {
+        if (chunk_pods[i] == ref_pods[j]) {
+          CHECK(chunk_scores[i] == ref_scores[j]);
+          found = true;
+        }
+      }
+      CHECK(found);
+    }
+    CHECK(res_n == 0);
+    if (chunk_size <= 0 || chunk_size >= kKeys) {
+      // one full-array chunk: no early exit possible, every hit counted
+      CHECK(chunks == 1);
+      CHECK(early == 0);
+      CHECK(hits == 20 + 7);
+    } else {
+      // the chain breaks at key 20: the scan stops at that chunk's end
+      int break_chunk = 20 / chunk_size;
+      CHECK(chunks == break_chunk + 1);
+      CHECK(early == 1);
+      CHECK(hits <= 20 + 7);
+    }
+  }
+
+  // pod-0's score: 9 HBM keys at weight 2; pod-1: 20 cpu keys at 1.
+  for (int i = 0; i < ref_n; ++i) {
+    if (ref_pods[i] == pods[0]) CHECK(ref_scores[i] == 18.0);
+    if (ref_pods[i] == pods[1]) CHECK(ref_scores[i] == 20.0);
+  }
+
+  // Residency fold-in: pod-2 has landed claims on indices 0..2 and an
+  // in-flight claim on 3 (bonus 3*1.0 + 0.5), pod-0 claims indices 1..2
+  // only (no index-0 claim: walk breaks immediately, no bonus), pod-1
+  // claims index 0 in-flight (bonus 0.5). tier_discount scales totals.
+  int32_t cl_pods[] = {pods[2], pods[2], pods[2], pods[2],
+                       pods[0], pods[0], pods[1]};
+  int32_t cl_idx[] = {0, 1, 2, 3, 1, 2, 0};
+  uint8_t cl_landed[] = {1, 1, 1, 0, 1, 1, 0};
+  int n = kvidx_score_chunked(
+      idx, keys, kKeys, nullptr, 0, wt, wv, 2, 8, cl_pods, cl_idx, cl_landed,
+      7, 1.0, 0.5, 0.25, chunk_pods, chunk_scores, 16, &hits, &chunks, &early,
+      res_pods, res_bonus, 16, &res_n);
+  CHECK(n == ref_n);  // base scores untouched by claims
+  CHECK(res_n == 2);
+  for (int i = 0; i < res_n; ++i) {
+    if (res_pods[i] == pods[2]) CHECK(res_bonus[i] == 3.5 * 0.25);
+    if (res_pods[i] == pods[1]) CHECK(res_bonus[i] == 0.5 * 0.25);
+    CHECK(res_pods[i] != pods[0]);
+  }
+
+  // Empty key array: zero chunks of work, no early exit.
+  n = kvidx_score_chunked(idx, keys, 0, nullptr, 0, wt, wv, 2, 8, nullptr,
+                          nullptr, nullptr, 0, 1.0, 0.5, 1.0, chunk_pods,
+                          chunk_scores, 16, &hits, &chunks, &early, res_pods,
+                          res_bonus, 16, &res_n);
+  CHECK(n == 0 && hits == 0 && chunks == 0 && early == 0 && res_n == 0);
+
+  // Buffer-too-small: -needed retry contract matches kvidx_score.
+  n = kvidx_score_chunked(idx, keys, kKeys, nullptr, 0, wt, wv, 2, 8, nullptr,
+                          nullptr, nullptr, 0, 1.0, 0.5, 1.0, chunk_pods,
+                          chunk_scores, 1, &hits, &chunks, &early, res_pods,
+                          res_bonus, 16, &res_n);
+  CHECK(n == -2);
+
+  kvidx_destroy(idx);
+  std::printf("kvidx_score_chunked OK\n");
+}
+
+}  // namespace
+
 int main() {
+  TestScoreChunked();
   void* idx = kvidx_create(100000, 4, 100000);
   int32_t pods[4];
   char name[8];
@@ -61,6 +214,18 @@ int main() {
           case 2: {
             int32_t counts[4], out_entries[256];
             kvidx_lookup(idx, keys, 4, nullptr, 0, counts, out_entries, 256);
+            // fused chunked score under contention (with a claim row so
+            // the residency walk also runs inside the lock)
+            int32_t wt = tier;
+            double wv = 1.0;
+            int32_t sp[16], rp[4], claim_idx = 0;
+            double ss[16], rb[4];
+            int32_t sh = 0, sc = 0, se = 0, rn = 0;
+            uint8_t landed = 1;
+            kvidx_score_chunked(idx, keys, 4, nullptr, 0, &wt, &wv, 1, 2,
+                                &entry_pod, &claim_idx, &landed, 1, 1.0, 0.5,
+                                1.0, sp, ss, 16, &sh, &sc, &se, rp, rb, 4,
+                                &rn);
             break;
           }
           case 3:

@@ -19,6 +19,17 @@ prefix cache, full lookups, one-message-at-a-time ingestion) and enabled
   order the pool's workers actually see (events shard by pod, so one
   worker drains runs of same-pod messages)
 
+``--fleet`` switches to the fleet-scale data-plane arm (ISSUE 17): a
+4-shard in-process fleet (real IndexerService handler methods behind
+loopback clients that msgpack round-trip every frame and sleep a
+configurable simulated RTT per RPC) scored through ShardRouter with the
+batched LookupBlocksBatch fan-out vs the per-chunk wire
+(``fanoutBatchChunks=0``), while packed zero-copy event frames ingest
+concurrently through each shard's pool. Emits sustained GetPodScores/s
+for both wires, ingest lag percentiles, and the sampled hot-function
+shares; the JSON ``value`` is the batched/per-chunk throughput ratio
+(the ≥5x acceptance gate of ISSUE 17, hard-asserted here too).
+
 Pure CPU scheduling-path work; run it pinned (`taskset`) for stable
 numbers. The ≥5x acceptance gate of ISSUE 2 applies to repeat_prefix.
 """
@@ -139,6 +150,275 @@ def bench_ingest(batch_max: int, *, n_msgs: int, keys_per_msg: int,
     return {"messages_per_s": round(n_msgs / dt, 1), "wall_s": round(dt, 4)}
 
 
+class LoopbackShardClient:
+    """ShardClient stand-in that calls the real service handler methods
+    through a full msgpack round trip (both directions, exactly the
+    bytes the gRPC wire would carry) plus a simulated per-RPC network
+    RTT. No sockets: the bench isolates the *fan-out protocol* cost —
+    frames serialized, RPCs issued, windows walked — from kernel/socket
+    noise, which is the part this PR's batched wire changes."""
+
+    def __init__(self, service, rtt_s: float = 0.0):
+        self._svc = service
+        self._rtt = rtt_s
+
+    def _call(self, handler, frame: dict) -> dict:
+        import msgpack
+
+        if self._rtt:
+            time.sleep(self._rtt)
+        req = msgpack.unpackb(
+            msgpack.packb(frame, use_bin_type=True),
+            raw=False, strict_map_key=False,
+        )
+        resp = handler(req, None)
+        return msgpack.unpackb(
+            msgpack.packb(resp, use_bin_type=True),
+            raw=False, strict_map_key=False,
+        )
+
+    def lookup_blocks(self, keys, pods=None, timeout=None, deadline=None,
+                      hedge=False):
+        from llmd_kv_cache_tpu.cluster.remote import entry_from_row
+
+        frame = {"keys": [int(k) for k in keys], "pods": list(pods or [])}
+        resp = self._call(self._svc.lookup_blocks_rpc, frame)
+        hits = {
+            int(k): [entry_from_row(r) for r in rows]
+            for k, rows in resp.get("hits", [])
+        }
+        return {"hits": hits, "degraded": bool(resp.get("degraded", False)),
+                "shard": resp.get("shard", "") or ""}
+
+    def lookup_blocks_batch(self, chunks, pods=None, timeout=None,
+                            deadline=None, hedge=False):
+        from llmd_kv_cache_tpu.cluster.remote import entry_from_row
+
+        frame = {
+            "chunks": [[int(k) for k in c] for c in chunks],
+            "pods": list(pods or []),
+        }
+        resp = self._call(self._svc.lookup_blocks_batch_rpc, frame)
+        hits = {}
+        for chunk_hits in resp.get("chunks", []):
+            for k, rows in chunk_hits:
+                hits[int(k)] = [entry_from_row(r) for r in rows]
+        return {
+            "hits": hits,
+            "cont": [bool(f) for f in resp.get("cont", []) or []],
+            "degraded": bool(resp.get("degraded", False)),
+            "shard": resp.get("shard", "") or "",
+        }
+
+    def close(self):
+        pass
+
+
+def bench_fleet(args) -> dict:
+    """Fleet-scale score/ingest data-plane arm (``--fleet``)."""
+    import threading
+
+    from llmd_kv_cache_tpu.cluster.config import ClusterConfig
+    from llmd_kv_cache_tpu.cluster.router import ShardRouter
+    from llmd_kv_cache_tpu.events.model import RawMessage
+    from llmd_kv_cache_tpu.events.packed import encode_packed_batch
+    from llmd_kv_cache_tpu.services.indexer_service import IndexerService
+    from llmd_kv_cache_tpu.telemetry import (
+        InMemorySpanExporter,
+        SamplingProfiler,
+        SamplingProfilerConfig,
+        install_span_exporter,
+        merge_folded,
+        set_process_identity,
+        span_function_shares,
+        uninstall_span_exporter,
+    )
+
+    rng = random.Random(7)
+    shards = [f"shard-{i}" for i in range(4)]
+    rtt_s = args.fleet_rtt_us / 1e6
+    services = {
+        sid: IndexerService(IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK, prefix_cache_tokens=4 * 2**20,
+            ),
+            lookup_chunk_size=128,
+        ), pool_config=PoolConfig(concurrency=2))
+        for sid in shards
+    }
+    clients = {sid: LoopbackShardClient(svc, rtt_s=rtt_s)
+               for sid, svc in services.items()}
+
+    def make_router(batch_chunks: int) -> ShardRouter:
+        return ShardRouter(
+            ClusterConfig(
+                shard_addresses=shards,
+                fanout_chunk_blocks=args.fleet_chunk,
+                fanout_batch_chunks=batch_chunks,
+                # Uniform simulated RTT would arm the latency-quantile
+                # hedge trigger on every RPC; this arm measures wire
+                # shape, not tail tolerance (bench-graytail owns that).
+                hedge_enabled=False,
+            ),
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK, prefix_cache_tokens=4 * 2**20,
+            ),
+            clients=clients,
+        )
+
+    router_b = make_router(args.fleet_batch_chunks)
+    router_p = make_router(0)  # the pre-batch per-chunk Python fan-out
+
+    # Seed every shard with the keys it owns so the full prompt scans
+    # without early exit (worst case for fan-out volume).
+    base = [rng.randrange(32_000) for _ in range(args.fleet_prompt_tokens)]
+    keys = router_b.token_processor.tokens_to_kv_block_keys(0, base, MODEL)
+    plan = router_b.plan(keys)
+    by_owner: dict = {}
+    for k, owner in zip(keys, plan):
+        by_owner.setdefault(owner, []).append(k)
+    # Each block resident on ONE pod (a warm fleet holds a prefix on the
+    # pod that served it, not on every pod) — keeps the per-key row work
+    # realistic instead of 4x-inflated.
+    for owner, okeys in by_owner.items():
+        for k in okeys:
+            services[owner].indexer.kv_block_index.add(
+                None, [k], [PodEntry(PODS[int(k) % len(PODS)], "tpu-hbm")])
+
+    # Byte-equivalence gate: the batched wire must produce the identical
+    # RouterScore the per-chunk wire does, down to float bits.
+    res_b = router_b.score(base, MODEL)
+    res_p = router_p.score(base, MODEL)
+    assert res_b.scores == res_p.scores, (res_b.scores, res_p.scores)
+    assert res_b.hit_blocks == res_p.hit_blocks == len(keys)
+    assert router_b.batch_rpcs > 0 and router_b.batch_fallbacks == 0
+
+    # Concurrent zero-copy ingest: packed KZC1 frames through each
+    # shard's live pool while the routers score.
+    for svc in services.values():
+        svc.pool.start()
+    stop = threading.Event()
+    sent = {"n": 0}
+
+    def ingest_loop() -> None:
+        seq = 0
+        while not stop.is_set():
+            for i, sid in enumerate(shards):
+                seq += 1
+                tokens = [rng.randrange(32_000)
+                          for _ in range(4 * BLOCK)]
+                frame = encode_packed_batch(
+                    f"ingest-pod-{i}", MODEL,
+                    [seq * 8 + j for j in range(4)], tokens,
+                    timestamp=time.time(), block_size=BLOCK,
+                )
+                services[sid].pool.add_task(RawMessage(
+                    topic=f"kv@ingest-pod-{i}@{MODEL}",
+                    sequence=seq, payload=frame,
+                ))
+                sent["n"] += 1
+            stop.wait(0.002)
+
+    ingester = threading.Thread(target=ingest_loop, name="fleet-ingest",
+                                daemon=True)
+
+    def sustained(router, seconds: float):
+        t_end = time.perf_counter() + seconds
+        iters = 0
+        rpcs = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() < t_end:
+            rpcs += router.score(base, MODEL).rpcs
+            iters += 1
+        dt = time.perf_counter() - t0
+        return {
+            "scores_per_s": round(iters / dt, 2),
+            "rpcs_per_score": round(rpcs / max(iters, 1), 1),
+            "iters": iters,
+        }
+
+    set_process_identity("bench-router")
+    install_span_exporter(InMemorySpanExporter(max_spans=50_000))
+    profiler = SamplingProfiler(
+        SamplingProfilerConfig(enabled=True, hz=67.0, window_s=3600.0))
+    profiler.start()
+    ingester.start()
+    try:
+        per_chunk = sustained(router_p, args.fleet_seconds)
+        batched = sustained(router_b, args.fleet_seconds)
+    finally:
+        stop.set()
+        ingester.join(timeout=5.0)
+        profiler.stop()
+        uninstall_span_exporter()
+        set_process_identity(None)
+
+    # Let the pools drain the ingest backlog, then read lag.
+    deadline = time.perf_counter() + 5.0
+    while time.perf_counter() < deadline and any(
+            sum(s.pool.lag_stats()["queue_depths"]) for s in services.values()):
+        time.sleep(0.01)
+    lag_p99 = 0.0
+    lag_p50 = 0.0
+    zerocopy = 0
+    for svc in services.values():
+        st = svc.pool.lag_stats()
+        lag_p99 = max(lag_p99, st.get("lag_p99_s", 0.0))
+        lag_p50 = max(lag_p50, st.get("lag_p50_s", 0.0))
+        zerocopy += svc.pool.data_plane_debug()["zerocopy_batches"]
+        svc.pool.shutdown()
+
+    profiler.rotate(force=True)
+    windows = profiler.export_since(-1)["windows"]
+    shares = span_function_shares(
+        merge_folded([w["folded"] for w in windows]))
+    hot = {
+        span: {
+            "samples": entry["samples"],
+            "functions": dict(list(entry["functions"].items())[:5]),
+        }
+        for span, entry in shares.items()
+        if span in ("llm_d.kv_cache.cluster.fanout",
+                    "llm_d.kv_cache.events.ingest")
+    }
+
+    ratio = batched["scores_per_s"] / max(per_chunk["scores_per_s"], 1e-9)
+    # ISSUE 17 acceptance: the batched data plane must sustain >=5x the
+    # per-chunk wire, and concurrent ingest must stay inside the
+    # staleness bound. Hard-asserted so `make bench-hotpath -- --fleet`
+    # fails loudly, not just the perf sentinel.
+    assert ratio >= args.fleet_min_speedup, (
+        f"batched fan-out sustained only {ratio:.2f}x the per-chunk wire "
+        f"(need >={args.fleet_min_speedup}x): {batched} vs {per_chunk}")
+    assert lag_p99 <= args.fleet_lag_bound_s, (
+        f"ingest lag p99 {lag_p99:.3f}s breaches the "
+        f"{args.fleet_lag_bound_s}s staleness bound under score load")
+    assert zerocopy > 0, "no packed frame took the zero-copy ingest path"
+
+    return {
+        "bench": "hotpath-fleet",
+        "shards": len(shards),
+        "prompt_tokens": args.fleet_prompt_tokens,
+        "blocks": len(keys),
+        "chunk_blocks": args.fleet_chunk,
+        "batch_chunks": args.fleet_batch_chunks,
+        "rtt_us": args.fleet_rtt_us,
+        "per_chunk": per_chunk,
+        "batched": batched,
+        "batch_rpcs": router_b.batch_rpcs,
+        "batch_fallbacks": router_b.batch_fallbacks,
+        "ingest": {
+            "messages": sent["n"],
+            "zerocopy_batches": zerocopy,
+            "lag_p50_s": round(lag_p50, 4),
+            "lag_p99_s": round(lag_p99, 4),
+        },
+        "value": round(ratio, 2),
+        "unit": "batched/per-chunk sustained GetPodScores/s ratio",
+        "hot_functions": hot,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     # 100k tokens is the ISSUE's motivating scenario: a multi-turn session
@@ -150,8 +430,33 @@ def main():
                     help="score_tokens calls per appended delta (P/D "
                          "disaggregated pool picks + retries/rebalances)")
     ap.add_argument("--ingest-msgs", type=int, default=3000)
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet-scale data-plane arm instead "
+                         "(4 shards, batched vs per-chunk fan-out, "
+                         "concurrent zero-copy ingest)")
+    ap.add_argument("--fleet-prompt-tokens", type=int, default=32 * 1024)
+    ap.add_argument("--fleet-chunk", type=int, default=16,
+                    help="fanoutChunkBlocks for both wires (fine-grained "
+                         "early exit: the regime batching targets)")
+    ap.add_argument("--fleet-batch-chunks", type=int, default=16,
+                    help="fanoutBatchChunks for the batched wire")
+    ap.add_argument("--fleet-rtt-us", type=float, default=2500.0,
+                    help="simulated per-RPC network RTT (cross-host "
+                         "datacenter gRPC: ~0.5ms same-rack to ~3ms "
+                         "cross-zone; loopback would hide the fan-out "
+                         "cost the batched wire removes)")
+    ap.add_argument("--fleet-seconds", type=float, default=2.0,
+                    help="sustained-measurement window per wire")
+    ap.add_argument("--fleet-lag-bound-s", type=float, default=1.0,
+                    help="ingest lag p99 staleness bound (hard gate)")
+    ap.add_argument("--fleet-min-speedup", type=float, default=5.0,
+                    help="batched/per-chunk throughput ratio hard gate")
     args = ap.parse_args()
     rng = random.Random(7)
+
+    if args.fleet:
+        print(json.dumps(bench_fleet(args)))
+        return
 
     result = {"bench": "hotpath", "prompt_tokens": args.prompt_tokens,
               "resident_blocks": args.resident_blocks,

@@ -71,7 +71,8 @@ import urllib.request
 METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
                    "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
-                   "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_")
+                   "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_",
+                   "kvtpu_ingest_", "kvtpu_native_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -237,6 +238,15 @@ def snapshot(host: str, port: int, timeout: float = 5.0,
                 for pod, st in (ledger.get("pods") or {}).items()
             },
         }
+
+    dp = debug.get("data_plane")
+    if isinstance(dp, dict):
+        # Native data plane (/debug/data_plane): zero-copy ingest and
+        # chunked native-scoring counters. A shard serving fleet traffic
+        # with zerocopy_batches == 0 is decoding msgpack per event; a
+        # native_score_calls == 0 indexer is scoring in Python — both
+        # mean the fast path silently disengaged.
+        report["data_plane"] = dp
 
     ws_state = debug.get("workingset_state")
     if isinstance(ws_state, dict):

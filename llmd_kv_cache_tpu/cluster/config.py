@@ -55,6 +55,14 @@ class ClusterConfig:
     # to cross-shard fan-out).
     fanout_timeout_s: float = 2.0
     fanout_chunk_blocks: int = 128
+    # Batched fan-out (docs/architecture.md "Native data plane"): how many
+    # early-exit chunks ride one framed LookupBlocksBatch RPC per shard.
+    # Each gather window covers fanout_chunk_blocks * fanout_batch_chunks
+    # keys with ONE RPC per owning shard instead of one per chunk; the
+    # shard early-exits server-side at its first incomplete chunk and the
+    # router truncates the merged map in chunk order, so scores stay
+    # byte-identical to the per-chunk path. 0 disables (per-chunk RPCs).
+    fanout_batch_chunks: int = 8
     degraded_serve_mode: str = DEGRADED_SERVE_SKIP
     # Ring-plan prefix cache entries (0 disables): (ring version, key
     # count, last chained key) → per-key owner plan.
@@ -126,6 +134,7 @@ class ClusterConfig:
         parts = d.get("partitions")
         rf = d.get("replicationFactor", d.get("replication_factor"))
         chunk = d.get("fanoutChunkBlocks", d.get("fanout_chunk_blocks"))
+        batch = d.get("fanoutBatchChunks", d.get("fanout_batch_chunks"))
         plan = d.get("planCacheSize", d.get("plan_cache_size"))
         thresh = d.get("breakerFailureThreshold", d.get("breaker_failure_threshold"))
         return cls(
@@ -145,6 +154,7 @@ class ClusterConfig:
                 "fanoutTimeoutS", d.get("fanout_timeout_s", 2.0)
             ),
             fanout_chunk_blocks=128 if chunk is None else chunk,
+            fanout_batch_chunks=8 if batch is None else batch,
             degraded_serve_mode=d.get(
                 "degradedServeMode",
                 d.get("degraded_serve_mode", DEGRADED_SERVE_SKIP),

@@ -72,6 +72,7 @@ class ShardClient:
             )
 
         self._lookup_blocks = method("LookupBlocks")
+        self._lookup_blocks_batch = method("LookupBlocksBatch")
         self._list_pods = method("ListPods")
         self._pod_digest = method("GetPodDigest")
         self._pod_blocks = method("GetPodBlocks")
@@ -113,6 +114,62 @@ class ShardClient:
             hits[int(key)] = [entry_from_row(r) for r in rows]
         return {
             "hits": hits,
+            "degraded": bool(resp.get("degraded", False)),
+            "shard": resp.get("shard", "") or "",
+        }
+
+    def lookup_blocks_batch(
+        self,
+        chunks: Sequence[Sequence[BlockHash]],
+        pods: Optional[Sequence[str]] = None,
+        timeout: Optional[float] = None,
+        deadline: Optional["object"] = None,
+        hedge: bool = False,
+    ) -> dict:
+        """Framed multi-chunk lookup (the batched fan-out data plane):
+        one RPC carries a whole gather window's worth of early-exit
+        chunks and the shard answers them in order with per-chunk
+        continuation flags, early-exiting at its first incomplete chunk.
+
+        Returns ``{"hits": {key: [PodEntry,...]}, "cont": [bool,...],
+        "degraded": bool, "shard": str}`` with ``hits`` flattened across
+        the answered chunks — the router re-derives chunk boundaries from
+        its own key order, so a response missing ``cont`` (or answering
+        the flat old-frame shape) degrades gracefully to "every answered
+        key counts". Raises grpc.RpcError on transport failure, including
+        UNIMPLEMENTED from a pre-batch shard (the router's cue to fall
+        back to per-chunk ``lookup_blocks``)."""
+        from ..resilience.deadline import Deadline
+        from ..services.indexer_service import _call_rpc
+
+        frame = {
+            "chunks": [[int(k) for k in c] for c in chunks],
+            "pods": list(pods or []),
+        }
+        eff_timeout = timeout if timeout is not None else self._timeout
+        if isinstance(deadline, Deadline):
+            frame["deadline_ms"] = deadline.to_wire_ms()
+            eff_timeout = deadline.cap_timeout(eff_timeout)
+        if hedge:
+            frame["hedge"] = True
+        resp = _call_rpc(
+            self._lookup_blocks_batch,
+            frame,
+            eff_timeout,
+            self.retry_policy,
+        )
+        raw = resp.get("chunks")
+        if raw is None:
+            # Old-frame tolerance: a peer that answered the flat
+            # LookupBlocks layout — one implicit chunk.
+            raw = [resp.get("hits", [])]
+        hits: dict[BlockHash, list[PodEntry]] = {}
+        for chunk_hits in raw:
+            for key, rows in chunk_hits:
+                hits[int(key)] = [entry_from_row(r) for r in rows]
+        return {
+            "hits": hits,
+            "cont": [bool(f) for f in resp.get("cont", []) or []],
             "degraded": bool(resp.get("degraded", False)),
             "shard": resp.get("shard", "") or "",
         }

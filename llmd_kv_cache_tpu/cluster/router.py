@@ -174,6 +174,19 @@ class ShardRouter:
         # scores — the shards know nothing about in-flight handoffs, the
         # tracker is router-local state fed by the handoff coordinator.
         self.residency = None
+        # Batched fan-out (docs/architecture.md "Native data plane"): one
+        # framed multi-chunk RPC per shard per gather window instead of
+        # one RPC per chunk. Engaged only when every client speaks the
+        # batch surface — injected test doubles that implement only
+        # lookup_blocks keep the per-chunk wire untouched. Shards whose
+        # *server* predates the frame (UNIMPLEMENTED) are remembered here
+        # and served through the legacy per-chunk call from then on.
+        self._batch_capable = config.fanout_batch_chunks > 0 and all(
+            hasattr(c, "lookup_blocks_batch") for c in self.clients.values()
+        )
+        self._legacy_shards: set[str] = set()
+        self.batch_rpcs = 0
+        self.batch_fallbacks = 0
         self._publish_ring_metrics()
 
     def attach_residency(self, tracker) -> None:
@@ -248,16 +261,102 @@ class ShardRouter:
         self._record_rpc(shard, "success")
         return res
 
+    def _shard_rpc_batch(
+        self,
+        shard: str,
+        keys: list[BlockHash],
+        key_chunk: dict[BlockHash, int],
+        pods: Optional[Sequence[str]],
+        timeout: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
+        hedge: bool = False,
+    ) -> dict:
+        """One breaker-guarded LookupBlocksBatch: the shard's keys for a
+        whole gather window, framed as ordered chunks. Falls back to the
+        flat per-chunk wire *inside the same attempt* when the shard's
+        server predates the batch frame (UNIMPLEMENTED), and remembers it
+        in ``_legacy_shards`` so later gathers skip the probe."""
+        breaker = self.breakers[shard]
+        if not breaker.allow():
+            self._record_rpc(shard, "skipped")
+            raise ConnectionError(f"breaker open for shard {shard}")
+        timeout_s = self.cfg.fanout_timeout_s if timeout is None else timeout
+        by_chunk: dict[int, list[BlockHash]] = {}
+        for k in keys:
+            by_chunk.setdefault(key_chunk[k], []).append(k)
+        chunks = [by_chunk[i] for i in sorted(by_chunk)]
+        kwargs = {}
+        if deadline is not None:
+            kwargs["deadline"] = deadline
+        if hedge:
+            kwargs["hedge"] = True
+        try:
+            if shard not in self._legacy_shards:
+                try:
+                    res = self.clients[shard].lookup_blocks_batch(
+                        chunks, pods, timeout=timeout_s, **kwargs
+                    )
+                    self.batch_rpcs += 1
+                    self._record_batch_rpc("batched")
+                    breaker.record_success()
+                    self._record_rpc(shard, "success")
+                    return res
+                except Exception as e:
+                    if not self._unimplemented(e):
+                        raise
+                    # Old shard: not a failure, just an older wire. Replay
+                    # the window flat — the plain lookup has no per-chunk
+                    # state, so one call over all keys answers the same
+                    # hits the per-chunk loop would have gathered.
+                    self._legacy_shards.add(shard)
+            self.batch_fallbacks += 1
+            self._record_batch_rpc("fallback")
+            try:
+                res = self.clients[shard].lookup_blocks(
+                    keys, pods, timeout=timeout_s, **kwargs
+                )
+            except TypeError:
+                res = self.clients[shard].lookup_blocks(
+                    keys, pods, timeout=timeout_s
+                )
+        except Exception:
+            breaker.record_failure()
+            self._record_rpc(shard, "failure")
+            raise
+        breaker.record_success()
+        self._record_rpc(shard, "success")
+        return res
+
+    @staticmethod
+    def _unimplemented(exc: BaseException) -> bool:
+        try:
+            import grpc
+
+            if isinstance(exc, grpc.RpcError):
+                code = exc.code() if callable(getattr(exc, "code", None)) else None
+                return code == grpc.StatusCode.UNIMPLEMENTED
+        except Exception:  # pragma: no cover - grpc always importable here  # lint: allow-swallow
+            pass
+        return isinstance(exc, (AttributeError, NotImplementedError))
+
     def _fanout_chunk(
         self,
         keys: Sequence[BlockHash],
         pods: Optional[Sequence[str]],
         plan: Sequence[str],
         stats: RouterScore,
+        key_chunk: Optional[dict[BlockHash, int]] = None,
     ) -> dict[BlockHash, list[PodEntry]]:
         """Scatter one chunk across its owning shards under one overall
         gather deadline, hedging slow lookups and failing dead shards'
-        keys over to replica owners; returns the merged hit map."""
+        keys over to replica owners; returns the merged hit map.
+
+        With ``key_chunk`` (key → global chunk index) the unit is a whole
+        gather *window*: each shard gets ONE framed LookupBlocksBatch RPC
+        carrying its keys grouped by chunk, instead of one RPC per chunk.
+        All the per-key machinery — rf-bounded failover, hedging, the
+        overall deadline — is chunk-agnostic and applies unchanged;
+        hedged and rerouted attempts re-frame their keys the same way."""
         rf = max(1, self.cfg.replication_factor)
         deadline = current_deadline()
         overall_s = self.cfg.fanout_deadline_s or self.cfg.fanout_timeout_s
@@ -286,10 +385,16 @@ class ShardRouter:
         def submit(shard: str, skeys: list[BlockHash], kind: str) -> None:
             budget_s = gather_deadline - time.monotonic()
             timeout_s = min(self.cfg.fanout_timeout_s, max(0.001, budget_s))
-            fut = self._executor.submit(
-                self._shard_rpc, shard, skeys, pods, timeout_s, deadline,
-                kind == "hedge",
-            )
+            if key_chunk is not None:
+                fut = self._executor.submit(
+                    self._shard_rpc_batch, shard, skeys, key_chunk, pods,
+                    timeout_s, deadline, kind == "hedge",
+                )
+            else:
+                fut = self._executor.submit(
+                    self._shard_rpc, shard, skeys, pods, timeout_s, deadline,
+                    kind == "hedge",
+                )
             attempts.append(_Attempt(
                 shard=shard, keys=skeys, keyset=frozenset(skeys),
                 future=fut, started=time.monotonic(), kind=kind,
@@ -517,18 +622,39 @@ class ShardRouter:
             chunk = self.cfg.fanout_chunk_blocks
             if chunk <= 0:
                 chunk = len(keys)
-            for start in range(0, len(keys), chunk):
-                ckeys = keys[start:start + chunk]
+            # Batched fan-out: one gather window covers fanoutBatchChunks
+            # early-exit chunks with a single framed RPC per shard.
+            batch = self.cfg.fanout_batch_chunks if self._batch_capable else 0
+            window = chunk * batch if batch > 0 else chunk
+            stop = False
+            for start in range(0, len(keys), window):
+                wkeys = keys[start:start + window]
+                key_chunk = None
+                if batch > 0 and len(wkeys) > chunk:
+                    key_chunk = {
+                        k: (start + i) // chunk for i, k in enumerate(wkeys)
+                    }
                 found = self._fanout_chunk(
-                    ckeys, pod_identifiers, plan[start:start + chunk], result
+                    wkeys, pod_identifiers, plan[start:start + window],
+                    result, key_chunk=key_chunk,
                 )
-                if not found:
-                    break
-                merged.update(found)
+                # Chunk-order truncation: replay the per-chunk loop's
+                # early-exit decisions over the window's merged map, so a
+                # batched gather is byte-identical to the per-chunk wire.
                 # Same soundness argument as Index.lookup_chunked: a
                 # partial chunk proves the consecutive-from-0 run ended
                 # inside it, so later chunks cannot change any score.
-                if len(found) < len(ckeys):
+                for cstart in range(start, start + len(wkeys), chunk):
+                    ckeys = keys[cstart:cstart + chunk]
+                    cfound = {k: found[k] for k in ckeys if k in found}
+                    if not cfound:
+                        stop = True
+                        break
+                    merged.update(cfound)
+                    if len(cfound) < len(ckeys):
+                        stop = True
+                        break
+                if stop:
                     break
             if result.degraded_shards and (
                 self.cfg.degraded_serve_mode == DEGRADED_SERVE_FAIL
@@ -566,6 +692,14 @@ class ShardRouter:
             from ..metrics.collector import record_shard_rpc
 
             record_shard_rpc(shard, outcome)
+        except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
+            pass
+
+    def _record_batch_rpc(self, outcome: str) -> None:
+        try:
+            from ..metrics.collector import record_batch_rpc
+
+            record_batch_rpc(outcome)
         except Exception:  # pragma: no cover - metrics must never break fan-out  # lint: allow-swallow
             pass
 
@@ -617,6 +751,13 @@ class ShardRouter:
                     shard: round(v * 1e3, 3)
                     for shard, v in self.hedge_latency.snapshot().items()
                 },
+            },
+            "data_plane": {
+                "batch_capable": self._batch_capable,
+                "batch_chunks": self.cfg.fanout_batch_chunks,
+                "batch_rpcs": self.batch_rpcs,
+                "batch_fallbacks": self.batch_fallbacks,
+                "legacy_shards": sorted(self._legacy_shards),
             },
         }
 

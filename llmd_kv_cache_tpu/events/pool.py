@@ -90,6 +90,18 @@ class PoolConfig:
     # carry the current truth; anti-entropy repairs the hole). 0 restores
     # the old unbounded behavior — and its unbounded-memory failure mode.
     ingest_queue_max: int = 8192
+    # Zero-copy ingest (docs/architecture.md "Native data plane"): accept
+    # packed KZC1 frames (events.packed) alongside msgpack and decode
+    # them as numpy views over the received buffer — no per-key/per-token
+    # Python objects. Off turns packed frames into parse failures.
+    ingest_zero_copy: bool = True
+    # Same-host shared-memory ring (events.shm_ring): when set, a reader
+    # thread drains packed frames from this ring file in addition to the
+    # socket wire. Empty disables. The writer side creates the file; the
+    # pool attaches (and retries until it appears).
+    shm_ring_path: str = ""
+    shm_ring_bytes: int = 1 << 20
+    shm_ring_poll_s: float = 0.0005
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "PoolConfig":
@@ -97,6 +109,8 @@ class PoolConfig:
             return cls()
         batch_max = d.get("ingestBatchMax", d.get("ingest_batch_max"))
         queue_max = d.get("ingestQueueMax", d.get("ingest_queue_max"))
+        zero_copy = d.get("ingestZeroCopy", d.get("ingest_zero_copy"))
+        ring_bytes = d.get("shmRingBytes", d.get("shm_ring_bytes"))
         cfg = cls(
             zmq_endpoint=d.get("zmqEndpoint", d.get("zmq_endpoint", "")),
             topic_filter=d.get("topicFilter", d.get("topic_filter", "kv@")),
@@ -106,6 +120,12 @@ class PoolConfig:
             track_dp_rank=d.get("trackDPRank", d.get("track_dp_rank", False)),
             ingest_batch_max=64 if batch_max is None else batch_max,
             ingest_queue_max=8192 if queue_max is None else queue_max,
+            ingest_zero_copy=True if zero_copy is None else bool(zero_copy),
+            shm_ring_path=d.get("shmRingPath", d.get("shm_ring_path", "")) or "",
+            shm_ring_bytes=(1 << 20) if ring_bytes is None else ring_bytes,
+            shm_ring_poll_s=d.get(
+                "shmRingPollS", d.get("shm_ring_poll_s", 0.0005)
+            ),
             liveness_stale_after_s=d.get(
                 "livenessStaleAfterSeconds",
                 d.get("liveness_stale_after_s", 30.0),
@@ -174,6 +194,14 @@ class Pool:
         self.ingest_batches = 0
         self.ingest_messages = 0
         self.coalesced_ops = 0
+        # Native data plane accounting (kvdiag "data_plane" section):
+        # packed frames decoded zero-copy, and messages that arrived over
+        # the shared-memory ring instead of the socket wire.
+        self.zerocopy_batches = 0
+        self.shm_messages = 0
+        self._shm_ring = None
+        self._shm_stop = threading.Event()
+        self._shm_thread: Optional[threading.Thread] = None
         # Event-pipeline lag/staleness (ISSUE 3): per-pod last sequence +
         # timestamps for gap detection and index-staleness estimation, and
         # a bounded sample window for p50/p99 lag readouts (admin, bench).
@@ -206,12 +234,26 @@ class Pool:
             )
             t.start()
             self._threads.append(t)
+        if self.cfg.shm_ring_path:
+            self._shm_stop.clear()
+            self._shm_thread = threading.Thread(
+                target=self._shm_reader, name="kvevents-shm-reader",
+                daemon=True,
+            )
+            self._shm_thread.start()
         logger.info("started sharded event pool with %d workers", self.cfg.concurrency)
 
     def shutdown(self) -> None:
         """Drain queues and stop workers (idempotent)."""
         if not self._started:
             return
+        if self._shm_thread is not None:
+            self._shm_stop.set()
+            self._shm_thread.join()
+            self._shm_thread = None
+        if self._shm_ring is not None:
+            self._shm_ring.close()
+            self._shm_ring = None
         for q in self._queues:
             q.put(self._shutdown)
         for t in self._threads:
@@ -279,6 +321,12 @@ class Pool:
     def _worker(self, worker_index: int) -> None:
         q = self._queues[worker_index]
         budget = max(1, self.cfg.ingest_batch_max)
+        # One write-combining coalescer per worker for its whole lifetime
+        # (flushed at every batch boundary): same sequential semantics as
+        # a per-batch instance, without reallocating the buffers per drain
+        # — and single-message batches whose one message carries several
+        # digests now coalesce too.
+        sink = _IngestCoalescer(self.index)
         while True:
             batch = [q.get()]  # lint: allow-no-deadline (worker parks for work; shutdown via sentinel)
             shutdown = batch[0] is self._shutdown
@@ -295,22 +343,28 @@ class Pool:
             try:
                 msgs = [t for t in batch if t is not self._shutdown]
                 if msgs:
-                    self._process_raw_batch(msgs, worker_index)
+                    self._process_raw_batch(msgs, worker_index, sink)
             finally:
                 for _ in batch:
                     q.task_done()
             if shutdown:
                 return
 
-    def _process_raw_batch(self, msgs: list[RawMessage], worker_index: int = 0) -> None:
-        """Process one drained batch, write-combining through a coalescer."""
-        sink = _IngestCoalescer(self.index) if len(msgs) > 1 else None
+    def _process_raw_batch(self, msgs: list[RawMessage],
+                           worker_index: int = 0, sink=None) -> None:
+        """Process one drained batch, write-combining through a coalescer.
+
+        ``sink`` is the worker's persistent :class:`_IngestCoalescer`;
+        ``saved_ops`` accumulates across batches there, so this reports
+        the delta. A None sink (direct calls in tests) gets a throwaway.
+        """
+        if sink is None:
+            sink = _IngestCoalescer(self.index)
+        ops_before = sink.saved_ops
         for msg in msgs:
             self._process_raw_message(msg, sink)
-        coalesced = 0
-        if sink is not None:
-            sink.flush()
-            coalesced = sink.saved_ops
+        sink.flush()
+        coalesced = sink.saved_ops - ops_before
         with self._stats_mu:
             self.ingest_batches += 1
             self.ingest_messages += len(msgs)
@@ -335,6 +389,12 @@ class Pool:
             pass
 
     def _process_raw_message(self, msg: RawMessage, sink=None) -> None:
+        # Zero-copy data plane: packed KZC1 frames (events.packed) skip
+        # the msgpack adapter entirely. 4-byte sniff, no import cost on
+        # the msgpack path.
+        if self.cfg.ingest_zero_copy and msg.payload[:4] == b"KZC1":
+            self._process_packed_message(msg, sink)
+            return
         try:
             pod_id, model_name, batch = self.adapter.parse_message(msg)
         except Exception:
@@ -364,6 +424,166 @@ class Pool:
             # Catch-all: a backend failure on one message must never kill
             # the shard's worker thread.
             logger.exception("failed to process event batch from %s", pod_id)
+
+    def _process_packed_message(self, msg: RawMessage, sink=None) -> None:
+        """Zero-copy BlockStored ingest (docs/architecture.md "Native
+        data plane"): decode one packed frame into numpy views over the
+        payload buffer and feed the uint64/uint32 arrays straight through
+        the native hash chain into the index — no per-key or per-token
+        Python object is materialized on the hot path. Packed frames are
+        engine-resident stores (DEFAULT_EVENT_SOURCE_TIER) with no
+        extra-keys/LoRA/dp-rank sidecars; events needing those stay on
+        the msgpack wire."""
+        try:
+            from .packed import decode_packed_batch
+
+            pb = decode_packed_batch(msg.payload)
+        except Exception:
+            logger.exception(
+                "failed to decode packed frame on topic %s", msg.topic
+            )
+            return
+        self._track_lag(pb.pod_id, msg.sequence, pb.timestamp)
+        if self.journal_sink is not None:
+            try:
+                self.journal_sink(
+                    pb.pod_id, msg.sequence, msg.topic, msg.payload,
+                    pb.timestamp,
+                )
+            except Exception:
+                logger.exception("journal append failed for pod %s", pb.pod_id)
+        if self.liveness is not None:
+            self.liveness.touch(pb.pod_id)
+        try:
+            with self._tracer.span(
+                "llm_d.kv_cache.events.ingest",
+                pod=pb.pod_id,
+                model=pb.model_name,
+                event_count=1,
+                sequence=msg.sequence,
+                zero_copy=True,
+            ):
+                self._ingest_packed(pb, sink)
+        except Exception:
+            logger.exception(
+                "failed to process packed batch from %s", pb.pod_id
+            )
+            return
+        with self._stats_mu:
+            self.zerocopy_batches += 1
+        try:
+            from ..metrics.collector import record_zerocopy_batch
+
+            record_zerocopy_batch()
+        except Exception:  # pragma: no cover - metrics must never break ingestion  # lint: allow-swallow
+            pass
+
+    def _ingest_packed(self, pb, sink=None) -> None:
+        """Apply one decoded packed frame to the index."""
+        ops = sink if sink is not None else self.index
+        parent_request_key = EMPTY_BLOCK_HASH
+        if pb.parent_hash != 0:
+            resolved = ops.get_request_key(pb.parent_hash)
+            if resolved is None:
+                logger.debug(
+                    "no request key for packed parent %d (pod %s); dropping",
+                    pb.parent_hash, pb.pod_id,
+                )
+                return
+            parent_request_key = resolved
+        tp = self.token_processor
+        # Same chain the msgpack path derives, minus the Python detour:
+        # model-seeded root, then the native FNV chain over the uint32
+        # token view. Falls back to the ordinary token-processor path
+        # (materializing ints) when the native library is absent.
+        keys_arr = None
+        request_keys = None
+        try:
+            from ..index import native as native_mod
+
+            if native_mod.native_available():
+                parent = (parent_request_key
+                          if parent_request_key != EMPTY_BLOCK_HASH
+                          else tp._get_init_hash(pb.model_name))
+                request_keys, keys_arr = native_mod.hash_chain_with_array(
+                    parent, pb.tokens, tp.block_size
+                )
+                tp.hash_calls += len(request_keys)
+        except Exception:  # lint: allow-swallow (fall back to the Python chain)
+            request_keys, keys_arr = None, None
+        if request_keys is None:
+            request_keys = tp.tokens_to_kv_block_keys(
+                parent_request_key, [int(t) for t in pb.tokens],
+                pb.model_name,
+            )
+        if not request_keys:
+            return
+        pod_entries = [PodEntry(pod_identifier=pb.pod_id,
+                                device_tier=DEFAULT_EVENT_SOURCE_TIER)]
+        try:
+            if keys_arr is not None and getattr(
+                self.index, "accepts_key_arrays", False
+            ):
+                # Array fast path: hand the views straight to the native
+                # index. The coalescer buffers Python lists, so it is
+                # flushed (ordering preserved) and bypassed here.
+                if sink is not None:
+                    sink.flush()
+                self.index.add(pb.engine_keys, keys_arr, pod_entries)
+            else:
+                ops.add(pb.engine_keys.tolist(), request_keys, pod_entries)
+        except Exception:
+            logger.exception(
+                "failed to add packed batch to index for pod %s", pb.pod_id
+            )
+            return
+        if self.ledger is not None:
+            self.ledger.record_store(pb.pod_id, len(request_keys))
+
+    def _shm_reader(self) -> None:
+        """Drain packed frames from the shared-memory ring into the
+        normal sharded queues. Attach-side: the writer creates the ring
+        file, so keep retrying until it exists."""
+        from .shm_ring import ShmRing
+
+        poll_s = max(0.0001, self.cfg.shm_ring_poll_s)
+        seqs: dict[str, int] = {}
+        while not self._shm_stop.is_set():
+            if self._shm_ring is None:
+                try:
+                    self._shm_ring = ShmRing(self.cfg.shm_ring_path)
+                except (OSError, ValueError):  # lint: allow-swallow (writer not up yet; retry)
+                    self._shm_stop.wait(0.05)
+                    continue
+            record = self._shm_ring.read()
+            if record is None:
+                self._shm_stop.wait(poll_s)
+                continue
+            # Cheap header peek for the sharding topic; the worker decodes
+            # the same frame again (struct-only, no array copies either
+            # time).
+            try:
+                from .packed import decode_packed_batch
+
+                pb = decode_packed_batch(record)
+            except Exception:
+                logger.exception("malformed shm-ring record; skipping")
+                continue
+            seq = seqs.get(pb.pod_id, 0) + 1
+            seqs[pb.pod_id] = seq
+            with self._stats_mu:
+                self.shm_messages += 1
+            try:
+                from ..metrics.collector import record_shm_messages
+
+                record_shm_messages(1)
+            except Exception:  # pragma: no cover - metrics must never break ingestion  # lint: allow-swallow
+                pass
+            self.add_task(RawMessage(
+                topic=f"kv@{pb.pod_id}@{pb.model_name}",
+                sequence=seq,
+                payload=record,
+            ))
 
     def _track_lag(self, pod_id: str, sequence: int, event_ts: float) -> None:
         """Per-pod sequence-gap + publish→ingest lag bookkeeping.
@@ -450,6 +670,14 @@ class Pool:
                 return 0.0
             oldest = min(st["last_event_ts"] for st in self._pod_lag.values())
         return max(0.0, now - oldest)
+
+    def data_plane_debug(self) -> dict:
+        """Zero-copy / shm-ring ingest counters (kvdiag ``data_plane``)."""
+        with self._stats_mu:
+            return {
+                "zerocopy_batches": self.zerocopy_batches,
+                "shm_messages": self.shm_messages,
+            }
 
     def lag_stats(self) -> dict:
         """Lag/staleness snapshot for the admin endpoint and kvdiag."""

@@ -107,6 +107,19 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.kvidx_score_ex.restype = ctypes.c_int
         lib.kvidx_score_ex.argtypes = lib.kvidx_score.argtypes + [ctypes.c_int]
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.kvidx_score_chunked.restype = ctypes.c_int
+        lib.kvidx_score_chunked.argtypes = [
+            ctypes.c_void_p, u64p, ctypes.c_int,  # keys
+            i32p, ctypes.c_int,                   # filter pods
+            i32p, f64p, ctypes.c_int,             # tier weights
+            ctypes.c_int,                         # chunk_size
+            i32p, i32p, u8p, ctypes.c_int,        # residency claims
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,  # weights
+            i32p, f64p, ctypes.c_int, i32p,       # out pods/scores/cap/hits
+            i32p, i32p,                           # out chunks / early_exit
+            i32p, f64p, ctypes.c_int, i32p,       # out residency
+        ]
         lib.kvidx_map_len.restype = ctypes.c_uint64
         lib.kvidx_map_len.argtypes = [ctypes.c_void_p]
         lib.kvidx_dump.restype = ctypes.c_int
@@ -259,11 +272,20 @@ class NativeIndex(Index):
         except (OverflowError, TypeError, ValueError):
             return np.asarray([k & 0xFFFFFFFFFFFFFFFF for k in keys], np.uint64)
 
+    # Zero-copy ingest marker (events.pool packed path): keys may arrive
+    # as numpy uint64 views and flow to the C side without materializing
+    # per-element Python ints.
+    accepts_key_arrays = True
+
     def add(self, engine_keys, request_keys, entries) -> None:
-        if not request_keys or not entries:
+        # len()-based emptiness: request_keys may be a numpy view, whose
+        # truth value is ambiguous for more than one element.
+        if request_keys is None or len(request_keys) == 0 or not entries:
             raise ValueError("no keys or entries provided for adding to index")
         rk = self._keys_array(request_keys)
-        ek = self._keys_array(engine_keys) if engine_keys else np.empty(0, np.uint64)
+        ek = (self._keys_array(engine_keys)
+              if engine_keys is not None and len(engine_keys)
+              else np.empty(0, np.uint64))
         pods, tiers, flags, groups = self._pack_entries(entries)
         u64p = ctypes.POINTER(ctypes.c_uint64)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -407,6 +429,111 @@ class NativeIndex(Index):
                 for i in range(n)
             },
             int(hits[0]),
+        )
+
+    def score_chunked(
+        self,
+        request_keys: Sequence[BlockHash],
+        medium_weights: dict[str, float],
+        pod_identifier_set=None,
+        chunk_size: int = 0,
+        claims: Optional[Sequence[tuple[str, int, bool]]] = None,
+        landed_weight: float = 1.0,
+        in_flight_discount: float = 0.5,
+        tier_discount: float = 1.0,
+    ) -> tuple[dict[str, float], int, dict[str, float], dict[str, int]]:
+        """Chunked fused scoring with residency fold-in: the whole score
+        data plane — early-exit chunked lookup, tier-weighted prefix
+        scoring, and the per-pod consecutive-from-0 residency walk — in
+        ONE ctypes crossing and one native lock hold.
+
+        ``chunk_size`` mirrors the Python ``lookup_chunked`` granularity:
+        the scan stops at the first chunk boundary after the prefix chain
+        broke (0 scans everything). ``claims`` are sparse
+        ``(pod, key_index, landed)`` rows from
+        :meth:`~..scoring.residency.ResidencyTracker.claim_rows`.
+
+        Returns ``(scores, hit_count, residency_bonus, stats)`` where
+        ``scores`` are the BASE prefix scores (bonus not folded in — the
+        caller applies liveness weighting to the base first, exactly like
+        the unfused path), ``residency_bonus`` is pod → bonus, and
+        ``stats`` carries ``chunks`` scanned and ``early_exited``.
+        """
+        empty_stats = {"chunks": 0, "early_exited": 0}
+        if len(request_keys) == 0:  # len() so ndarray keys are accepted
+            return {}, 0, {}, empty_stats
+        keys = self._keys_array(request_keys)
+        if pod_identifier_set:
+            filt = np.asarray(
+                [self._intern(p) for p in pod_identifier_set], np.int32
+            )
+        else:
+            filt = np.empty(0, np.int32)
+        wt = np.asarray([self._intern(t) for t in medium_weights], np.int32)
+        wv = np.asarray(list(medium_weights.values()), np.float64)
+
+        n_claims = len(claims) if claims else 0
+        claim_pods = np.empty(n_claims, np.int32)
+        claim_idx = np.empty(n_claims, np.int32)
+        claim_landed = np.empty(n_claims, np.uint8)
+        res_cap = 0
+        if n_claims:
+            distinct: set[str] = set()
+            for i, (pod, idx, landed) in enumerate(claims):
+                claim_pods[i] = self._intern(pod)
+                claim_idx[i] = idx
+                claim_landed[i] = 1 if landed else 0
+                distinct.add(pod)
+            res_cap = len(distinct)
+        res_pods = np.empty(max(res_cap, 1), np.int32)
+        res_bonus = np.empty(max(res_cap, 1), np.float64)
+
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        hits = np.zeros(1, np.int32)
+        chunks = np.zeros(1, np.int32)
+        early = np.zeros(1, np.int32)
+        res_n = np.zeros(1, np.int32)
+        cap = 1024
+        while True:
+            out_pods = np.empty(cap, np.int32)
+            out_scores = np.empty(cap, np.float64)
+            n = self._lib.kvidx_score_chunked(
+                self._handle,
+                keys.ctypes.data_as(u64p), len(keys),
+                filt.ctypes.data_as(i32p), len(filt),
+                wt.ctypes.data_as(i32p), wv.ctypes.data_as(f64p), len(wt),
+                int(chunk_size),
+                claim_pods.ctypes.data_as(i32p),
+                claim_idx.ctypes.data_as(i32p),
+                claim_landed.ctypes.data_as(u8p), n_claims,
+                float(landed_weight), float(in_flight_discount),
+                float(tier_discount),
+                out_pods.ctypes.data_as(i32p),
+                out_scores.ctypes.data_as(f64p), cap,
+                hits.ctypes.data_as(i32p),
+                chunks.ctypes.data_as(i32p),
+                early.ctypes.data_as(i32p),
+                res_pods.ctypes.data_as(i32p),
+                res_bonus.ctypes.data_as(f64p), res_cap,
+                res_n.ctypes.data_as(i32p),
+            )
+            if n >= 0:
+                break
+            cap = -n  # buffer too small: exact needed size reported
+        return (
+            {
+                self._resolve(int(out_pods[i])): float(out_scores[i])
+                for i in range(n)
+            },
+            int(hits[0]),
+            {
+                self._resolve(int(res_pods[i])): float(res_bonus[i])
+                for i in range(int(res_n[0]))
+            },
+            {"chunks": int(chunks[0]), "early_exited": int(early[0])},
         )
 
     def get_request_key(self, engine_key):

@@ -79,6 +79,58 @@ def record_ingest_batch(messages: int, coalesced_ops: int) -> None:
         EVENT_INGEST_COALESCED_OPS.inc(coalesced_ops)
 
 
+# Native data-plane families (docs/architecture.md "Native data plane"):
+# zero-copy ingest batches bypassing the per-event Python decode, the
+# shared-memory ring that bypasses ZMQ entirely, and the chunk/early-exit
+# accounting of the fused native score path.
+INGEST_ZEROCOPY_BATCHES = Counter(
+    "kvtpu_ingest_zerocopy_batches_total",
+    "Packed event batches decoded as memoryview-sliced key arrays "
+    "(no per-key Python objects) and fed straight to the index",
+)
+INGEST_SHM_MESSAGES = Counter(
+    "kvtpu_ingest_shm_messages_total",
+    "Event messages consumed from the same-host shared-memory ring",
+)
+NATIVE_SCORE_CHUNKS = Counter(
+    "kvtpu_native_score_chunks_total",
+    "Chunks scanned by the fused native chunked-score path",
+)
+NATIVE_SCORE_EARLY_EXITS = Counter(
+    "kvtpu_native_score_early_exits_total",
+    "Fused native chunked scores that stopped before the last key "
+    "(prefix chain broke mid-prompt)",
+)
+SHARD_BATCH_RPCS = Counter(
+    "kvtpu_shard_batch_rpcs_total",
+    "Framed multi-chunk LookupBlocks fan-out RPCs by outcome "
+    "(batched = native frame, fallback = legacy per-chunk replay)",
+    ["outcome"],
+)
+
+
+def record_zerocopy_batch(shm: bool = False) -> None:
+    INGEST_ZEROCOPY_BATCHES.inc()
+    if shm:
+        INGEST_SHM_MESSAGES.inc()
+
+
+def record_shm_messages(count: int) -> None:
+    if count > 0:
+        INGEST_SHM_MESSAGES.inc(count)
+
+
+def record_native_score(chunks: int, early_exited: int) -> None:
+    if chunks > 0:
+        NATIVE_SCORE_CHUNKS.inc(chunks)
+    if early_exited:
+        NATIVE_SCORE_EARLY_EXITS.inc()
+
+
+def record_batch_rpc(outcome: str) -> None:
+    SHARD_BATCH_RPCS.labels(outcome).inc()
+
+
 # Event-pipeline lag & staleness (ISSUE 3): the paper's "near-real-time
 # global view" claim is only checkable if the publish→ingest delay and
 # per-pod sequence gaps are first-class metrics. Lag is measured as

@@ -254,6 +254,20 @@ class Indexer:
             if self.scorer.strategy == LONGEST_PREFIX_MATCH
             else None
         )
+        # Chunked native data plane (NativeIndex.score_chunked): early-exit
+        # chunked lookup + residency fold-in in ONE ctypes crossing. When
+        # present it supersedes the plain fused path below.
+        self._native_score_chunked = (
+            getattr(self.kv_block_index, "score_chunked", None)
+            if self.scorer.strategy == LONGEST_PREFIX_MATCH
+            else None
+        )
+        # Native data-plane counters (kvdiag `data_plane` section). Plain
+        # int bumps on the hot path — diagnostic reads tolerate the odd
+        # lost increment, a lock per score call would not pay for itself.
+        self._dp_native_calls = 0
+        self._dp_chunks = 0
+        self._dp_early_exits = 0
         # Early-exit is only sound for consecutive-from-0 prefix scoring.
         self._early_exit = (
             self.config.lookup_chunk_size > 0
@@ -421,6 +435,13 @@ class Indexer:
             if dl is not None:
                 dl.check("scoring.index_lookup")
 
+            if self._native_score_chunked is not None:
+                return self._score_native_chunked(
+                    keys_arr if keys_arr is not None else block_keys,
+                    block_keys, model_name, pod_identifiers, role, detail,
+                    span,
+                )
+
             if self._native_score is not None:
                 scores, hit_count = self._native_score(
                     keys_arr if keys_arr is not None else block_keys,
@@ -467,6 +488,75 @@ class Indexer:
                 self.workingset.record_index_lookup(
                     block_keys, key_to_pods, hits=len(key_to_pods))
             return scores
+
+    def _score_native_chunked(
+        self,
+        keys,
+        block_keys: Sequence[BlockHash],
+        model_name: str,
+        pod_identifiers: Optional[set[str]],
+        role: str,
+        detail: Optional[dict],
+        span,
+    ) -> dict[str, float]:
+        """Native chunked data plane: one C++ pass runs the early-exit
+        chunked lookup AND the residency-bonus walk; Python only folds —
+        liveness weighting applies to the base scores first, then the
+        bonus lands on top, exactly like the unfused path."""
+        apply_res = role == "decode" and self.residency is not None
+        claims = (
+            self.residency.claim_rows(block_keys, pod_identifiers)
+            if apply_res else []
+        )
+        scores, hit_count, res_bonus, dp = self._native_score_chunked(
+            keys, self.scorer.medium_weights, pod_identifiers,
+            chunk_size=(
+                self.config.lookup_chunk_size if self._early_exit else 0
+            ),
+            claims=claims,
+            landed_weight=(
+                self.residency.landed_weight if apply_res else 1.0
+            ),
+            in_flight_discount=(
+                self.residency.in_flight_discount if apply_res else 0.5
+            ),
+            tier_discount=(
+                self.residency.discount() if claims else 1.0
+            ),
+        )
+        span.set_attribute("block_hit_count", hit_count)
+        span.set_attribute("block_hit_ratio", hit_count / len(block_keys))
+        span.set_attribute("native_chunks", dp["chunks"])
+        self._dp_native_calls += 1
+        self._dp_chunks += dp["chunks"]
+        self._dp_early_exits += dp["early_exited"]
+        try:
+            from ..metrics.collector import record_native_score
+
+            record_native_score(dp["chunks"], dp["early_exited"])
+        except Exception:  # pragma: no cover - metrics must never break scoring  # lint: allow-swallow
+            pass
+        scores = self.scorer._apply_liveness(scores)
+        if res_bonus:
+            for pod, b in res_bonus.items():
+                scores[pod] = scores.get(pod, 0.0) + b
+        if apply_res and detail is not None:
+            detail["residency"] = res_bonus
+        self._record_score_decision(
+            model_name, len(block_keys), hit_count, scores
+        )
+        if self.workingset is not None:
+            self.workingset.record_index_lookup(
+                block_keys, None, hits=hit_count)
+        return scores
+
+    def data_plane_debug(self) -> dict:
+        """Native score data-plane counters (kvdiag `data_plane`)."""
+        return {
+            "native_score_calls": self._dp_native_calls,
+            "native_score_chunks": self._dp_chunks,
+            "native_score_early_exits": self._dp_early_exits,
+        }
 
     def _apply_residency(
         self,

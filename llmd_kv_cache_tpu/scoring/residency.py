@@ -106,12 +106,7 @@ class ResidencyTracker:
             if not pods:
                 return {}
             claims = {k: dict(self._claims.get(k, {})) for k in block_keys}
-        discount = 1.0
-        if self.tier_discount_fn is not None:
-            try:
-                discount = float(self.tier_discount_fn())
-            except Exception:  # pragma: no cover  # lint: allow-swallow
-                discount = 1.0
+        discount = self.discount()
         out: dict[str, float] = {}
         for pod in pods:
             total = 0.0
@@ -124,6 +119,48 @@ class ResidencyTracker:
             if total > 0.0:
                 out[pod] = total * discount
         return out
+
+    def claim_rows(
+        self,
+        block_keys: Sequence[int],
+        pod_identifiers: Optional[set[str]] = None,
+    ) -> list[tuple[str, int, bool]]:
+        """Sparse ``(pod, key_index, landed)`` rows for the native fold-in.
+
+        The same claim view :meth:`bonus` walks, flattened positionally so
+        ``kvidx_score_chunked`` can run the consecutive-from-0 walk inside
+        the index lock: a pod with no row at index 0 accumulates nothing,
+        exactly like ``bonus``'s break-at-first-unclaimed rule. Returns
+        an empty list when no (allowed) pod holds claims — callers skip
+        the native residency arguments entirely then.
+        """
+        with self._mu:
+            pods = {
+                p for p in self._pod_blocks
+                if self._pod_blocks[p]
+                and (not pod_identifiers or p in pod_identifiers)
+            }
+            if not pods:
+                return []
+            rows: list[tuple[str, int, bool]] = []
+            for idx, key in enumerate(block_keys):
+                claimants = self._claims.get(key)
+                if not claimants:
+                    continue
+                for pod, landed in claimants.items():
+                    if pod in pods:
+                        rows.append((pod, idx, landed))
+            return rows
+
+    def discount(self) -> float:
+        """Evaluate the transfer-tier restore-latency discount (1.0 when
+        absent or failing) — the scalar :meth:`bonus` multiplies in."""
+        if self.tier_discount_fn is None:
+            return 1.0
+        try:
+            return float(self.tier_discount_fn())
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            return 1.0
 
     def debug(self) -> dict:
         with self._mu:

@@ -358,6 +358,15 @@ class IndexerService:
         cc = self.indexer.config.cluster_config
         return cc.shard_id if cc is not None else ""
 
+    def _data_plane_debug(self) -> dict:
+        """Native data-plane counters (``/debug/data_plane``, kvdiag):
+        zero-copy ingest batches + shm-ring messages from the pool and
+        the chunked native-scoring call/early-exit counters from the
+        indexer, one flat view."""
+        view = dict(self.pool.data_plane_debug())
+        view.update(self.indexer.data_plane_debug())
+        return view
+
     @property
     def process_name(self) -> str:
         """Span attribution identity: an explicitly configured fleet
@@ -436,6 +445,7 @@ class IndexerService:
         providers = {
             "lag": self.pool.lag_stats,
             "ledger": self.indexer.ledger.snapshot,
+            "data_plane": self._data_plane_debug,
         }
         # Ledger counters double as kvtpu_cache_ledger_* families on
         # /metrics (scrape-time snapshot — nothing added to hot paths).
@@ -699,6 +709,61 @@ class IndexerService:
             degraded = self.recovery is not None and not self.recovery.ready
             return {"hits": hits, "degraded": degraded, "shard": self.shard_id}
 
+    def lookup_blocks_batch_rpc(self, req: dict, context=None) -> dict:
+        """Framed multi-chunk lookup: the batched fan-out data plane.
+
+        ``{"chunks": [[keys...], ...], "pods": [...], "deadline_ms": int,
+        "hedge": bool}`` in; ``{"chunks": [hits_list, ...], "cont":
+        [0|1, ...], "degraded": bool, "shard": str}`` out, where
+        ``chunks[i]`` is chunk *i*'s hit list in the LookupBlocks row
+        layout and ``cont[i]`` says every requested key of chunk *i* was
+        found on this shard. Chunks are answered in order and the scan
+        stops at the first incomplete one — a key missing on its owning
+        shard is a global miss, so later chunks cannot extend any
+        consecutive-from-0 prefix (the server-side half of the router's
+        early exit). Tolerant both directions: a flat ``keys`` frame from
+        an older peer is treated as one chunk, and newer response fields
+        are ignored by older clients.
+        """
+        failpoints.hit(FP_SHARD_LOOKUP)
+        if self.shard_id:
+            failpoints.hit(f"{FP_SHARD_LOOKUP}.{self.shard_id}")
+        raw_chunks = req.get("chunks") or []
+        if not raw_chunks and req.get("keys"):
+            raw_chunks = [req.get("keys")]
+        pods = req.get("pods") or []
+        deadline = Deadline.from_wire_ms(req.get("deadline_ms"))
+        with tracer().span(
+            "llm_d.kv_cache.indexer.LookupBlocksBatch",
+            parent_traceparent=extract_traceparent(context),
+            chunks=len(raw_chunks),
+            process=self.process_name,
+        ):
+            if deadline is not None and deadline.expired():
+                self._record_shed("indexer.lookup", "deadline",
+                                  PRIORITY_NORMAL)
+                return {"chunks": [], "cont": [], "degraded": True,
+                        "shard": self.shard_id,
+                        "degraded_reason": "deadline"}
+            podset = set(pods) if pods else None
+            out_chunks: list = []
+            cont: list = []
+            for ckeys in raw_chunks:
+                keys = [int(k) for k in ckeys]
+                found = (self.indexer.kv_block_index.lookup(keys, podset)
+                         if keys else {})
+                out_chunks.append([
+                    [int(k), [_row_from_entry(e) for e in entries]]
+                    for k, entries in found.items()
+                ])
+                complete = len(found) == len(keys)
+                cont.append(1 if complete else 0)
+                if not complete:
+                    break
+            degraded = self.recovery is not None and not self.recovery.ready
+            return {"chunks": out_chunks, "cont": cont,
+                    "degraded": degraded, "shard": self.shard_id}
+
     def list_pods_rpc(self, req: dict, context=None) -> dict:
         return {
             "pods": IndexDigestSource(self.indexer.kv_block_index).pods(),
@@ -787,6 +852,9 @@ def serve(
             # anti-entropy repair trio, all raw msgpack dicts.
             "LookupBlocks": _dict_handler(
                 lambda req, ctx: service.lookup_blocks_rpc(req, ctx)
+            ),
+            "LookupBlocksBatch": _dict_handler(
+                lambda req, ctx: service.lookup_blocks_batch_rpc(req, ctx)
             ),
             "ListPods": _dict_handler(
                 lambda req, ctx: service.list_pods_rpc(req, ctx)

@@ -344,7 +344,37 @@ class FakeShardClient:
         pass
 
 
-def make_router(cfg=None, block_size=4, populate_all=True, rf=2):
+class FakeBatchShardClient(FakeShardClient):
+    """Batch-capable stand-in: answers the framed multi-chunk wire with
+    server-side per-chunk early exit, mirroring IndexerService's
+    LookupBlocksBatch handler."""
+
+    def __init__(self, shard, store):
+        super().__init__(shard, store)
+        self.batch_calls = 0
+        self.unimplemented = False  # simulate a pre-batch shard server
+
+    def lookup_blocks_batch(self, chunks, pods=None, timeout=None,
+                            deadline=None, hedge=False):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionError(f"{self.shard} down")
+        if self.unimplemented:
+            raise NotImplementedError("old shard: no batch frame")
+        self.batch_calls += 1
+        hits, cont = {}, []
+        for ckeys in chunks:
+            chunk_hits = {k: self.store[k] for k in ckeys if k in self.store}
+            hits.update(chunk_hits)
+            cont.append(len(chunk_hits) == len(ckeys))
+            if len(chunk_hits) < len(ckeys):
+                break
+        return {"hits": hits, "cont": cont, "degraded": False,
+                "shard": self.shard}
+
+
+def make_router(cfg=None, block_size=4, populate_all=True, rf=2,
+                client_cls=FakeShardClient):
     cfg = cfg or ClusterConfig(
         shard_addresses=["s0", "s1", "s2", "s3"],
         replication_factor=rf,
@@ -359,7 +389,7 @@ def make_router(cfg=None, block_size=4, populate_all=True, rf=2):
         for k in keys:
             for owner in ring.owners(k, cfg.replication_factor):
                 stores[owner][k] = [entry()]
-    clients = {s: FakeShardClient(s, stores[s]) for s in ring.shards}
+    clients = {s: client_cls(s, stores[s]) for s in ring.shards}
     router = ShardRouter(
         cfg,
         token_processor_config=TokenProcessorConfig(block_size_tokens=block_size),
@@ -496,9 +526,143 @@ class TestShardRouter:
         router, *_ = make_router()
         try:
             view = router.debug_view()
-            assert set(view) == {"ring", "breakers", "plan_cache", "hedging"}
+            assert set(view) == {
+                "ring", "breakers", "plan_cache", "hedging", "data_plane",
+            }
             assert view["ring"]["partitions"] == 1024
             assert view["hedging"]["enabled"] is True
+            # FakeShardClient has no lookup_blocks_batch: the batched
+            # data plane must stay disengaged for injected test doubles.
+            assert view["data_plane"]["batch_capable"] is False
+            assert view["data_plane"]["batch_rpcs"] == 0
+        finally:
+            router.close()
+
+
+class TestBatchedFanout:
+    """Batched cross-shard fan-out (LookupBlocksBatch): one framed RPC per
+    shard per gather window must be byte-equivalent to the per-chunk wire,
+    and UNIMPLEMENTED peers must fall back flat without tripping breakers."""
+
+    def _routers(self):
+        batched = make_router(client_cls=FakeBatchShardClient)
+        plain = make_router()
+        return batched, plain
+
+    def test_engaged_and_byte_equal_on_full_hit(self):
+        (rb, cb, tokens, keys, _), (rp, *_rest) = self._routers()
+        try:
+            res_b = rb.score(tokens, "m")
+            res_p = rp.score(tokens, "m")
+            assert res_b.scores == res_p.scores
+            assert res_b.hit_blocks == res_p.hit_blocks == len(keys)
+            assert not res_b.degraded
+            assert rb._batch_capable
+            assert rb.batch_rpcs > 0 and rb.batch_fallbacks == 0
+            # One batched RPC per owning shard covers the whole window
+            # (16 blocks / chunk 4 fits inside the default 8-chunk batch).
+            assert res_b.rpcs == len(set(rb.plan(keys)))
+            assert res_b.rpcs < res_p.rpcs
+            assert sum(c.batch_calls for c in cb.values()) == res_b.rpcs
+        finally:
+            rb.close()
+            rp.close()
+
+    @pytest.mark.parametrize("keep", [4, 6])  # chunk-aligned and mid-chunk
+    def test_early_exit_truncation_matches_per_chunk_wire(self, keep):
+        (rb, _, tokens, keys, stores_b), (rp, _, _, _, stores_p) = \
+            self._routers()
+        try:
+            for k in keys[keep:]:
+                for stores in (stores_b, stores_p):
+                    for store in stores.values():
+                        store.pop(k, None)
+            res_b = rb.score(tokens, "m")
+            res_p = rp.score(tokens, "m")
+            assert res_b.scores == res_p.scores
+            assert res_b.hit_blocks == res_p.hit_blocks == keep
+            assert res_b.scores["pod-1"] == pytest.approx(keep)
+        finally:
+            rb.close()
+            rp.close()
+
+    def test_unimplemented_falls_back_flat_without_breaker_damage(self):
+        router, clients, tokens, keys, _ = make_router(
+            client_cls=FakeBatchShardClient)
+        try:
+            for c in clients.values():
+                c.unimplemented = True
+            res = router.score(tokens, "m")
+            # Scores are exact through the in-attempt flat replay.
+            assert res.hit_blocks == len(keys)
+            assert res.scores["pod-1"] == pytest.approx(len(keys))
+            assert not res.degraded
+            contacted = set(router.plan(keys))
+            assert router._legacy_shards == contacted
+            assert router.batch_fallbacks == len(contacted)
+            assert router.batch_rpcs == 0
+            # An old wire is not a failure: every breaker stays closed.
+            assert all(b.state == "closed" for b in router.breakers.values())
+            # Second score skips the probe entirely (legacy memoized).
+            before = sum(c.batch_calls for c in clients.values())
+            router.score(tokens, "m")
+            assert sum(c.batch_calls for c in clients.values()) == before
+            assert router.batch_fallbacks == 2 * len(contacted)
+        finally:
+            router.close()
+
+    def test_mixed_legacy_and_batch_shards(self):
+        router, clients, tokens, keys, _ = make_router(
+            client_cls=FakeBatchShardClient)
+        try:
+            victim = router.ring.owner(keys[0])
+            clients[victim].unimplemented = True
+            res = router.score(tokens, "m")
+            assert res.hit_blocks == len(keys)
+            assert router._legacy_shards == {victim}
+            assert router.batch_fallbacks == 1
+            assert router.batch_rpcs >= 1
+        finally:
+            router.close()
+
+    def test_failover_serves_from_replica_on_batched_wire(self):
+        router, clients, tokens, keys, _ = make_router(
+            client_cls=FakeBatchShardClient)
+        try:
+            victim = router.ring.owner(keys[0])
+            clients[victim].fail = True
+            res = router.score(tokens, "m")
+            assert res.hit_blocks == len(keys)
+            assert res.degraded_shards == []
+            assert res.scores["pod-1"] == pytest.approx(len(keys))
+        finally:
+            router.close()
+
+    def test_disabled_by_zero_batch_chunks(self):
+        cfg = ClusterConfig(
+            shard_addresses=["s0", "s1", "s2", "s3"],
+            replication_factor=2,
+            fanout_chunk_blocks=4,
+            fanout_batch_chunks=0,
+        )
+        router, clients, tokens, keys, _ = make_router(
+            cfg=cfg, client_cls=FakeBatchShardClient)
+        try:
+            assert not router._batch_capable
+            res = router.score(tokens, "m")
+            assert res.hit_blocks == len(keys)
+            assert router.batch_rpcs == 0
+            assert sum(c.batch_calls for c in clients.values()) == 0
+        finally:
+            router.close()
+
+    def test_debug_view_reports_batch_plane(self):
+        router, *_ = make_router(client_cls=FakeBatchShardClient)
+        try:
+            dp = router.debug_view()["data_plane"]
+            assert dp["batch_capable"] is True
+            assert dp["batch_chunks"] == router.cfg.fanout_batch_chunks > 0
+            assert dp["legacy_shards"] == []
         finally:
             router.close()
 
@@ -515,6 +679,7 @@ class TestClusterConfig:
             "replicationFactor": 3,
             "fanoutTimeoutS": 0.5,
             "fanoutChunkBlocks": 64,
+            "fanoutBatchChunks": 4,
             "degradedServeMode": "fail",
             "planCacheSize": 16,
             "breakerFailureThreshold": 7,
@@ -526,6 +691,7 @@ class TestClusterConfig:
         assert cfg.build_ring().partitions == 256
         assert cfg.degraded_serve_mode == "fail"
         assert cfg.replication_factor == 3
+        assert cfg.fanout_batch_chunks == 4
 
     def test_shard_count_validates_membership(self):
         cfg = ClusterConfig(shard_addresses=["a:1", "b:1"], shard_count=3)

@@ -1,0 +1,468 @@
+"""Native data plane (docs/architecture.md "Native data plane"): packed
+zero-copy event frames, the shared-memory event ring, the pool's
+sniff-and-dispatch ingest path, and the indexer's native chunked scoring —
+each checked for exact equivalence against the msgpack / pure-Python
+paths it replaces.
+"""
+
+import time
+
+import msgpack
+import numpy as np
+import pytest
+
+from llmd_kv_cache_tpu.core import (
+    ChunkedTokenDatabase,
+    PodEntry,
+    TokenProcessorConfig,
+)
+from llmd_kv_cache_tpu.events import Pool, PoolConfig, RawMessage
+from llmd_kv_cache_tpu.events.packed import (
+    HEADER_SIZE,
+    decode_packed_batch,
+    encode_packed_batch,
+    is_packed,
+)
+from llmd_kv_cache_tpu.events.shm_ring import ShmRing
+from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+from llmd_kv_cache_tpu.index import native
+
+BLOCK = 4
+MODEL = "model-a"
+POD = "pod-1"
+
+
+@pytest.fixture
+def processor():
+    return ChunkedTokenDatabase(TokenProcessorConfig(block_size_tokens=BLOCK))
+
+
+@pytest.fixture
+def index():
+    return InMemoryIndex(InMemoryIndexConfig(size=10_000))
+
+
+@pytest.fixture
+def pool(index, processor):
+    return Pool(PoolConfig(concurrency=2), index, processor)
+
+
+def packed_msg(pod, model, engine_keys, tokens, *, parent=0, seq=0, ts=1.0):
+    payload = encode_packed_batch(
+        pod, model, engine_keys, tokens,
+        timestamp=ts, parent_hash=parent, block_size=BLOCK,
+    )
+    return RawMessage(topic=f"kv@{pod}@{model}", sequence=seq, payload=payload)
+
+
+def msgpack_msg(pod, model, engine_keys, tokens, *, parent=0, seq=0, ts=1.0):
+    ev = ["BlockStored", list(engine_keys), parent or None, list(tokens), BLOCK]
+    payload = msgpack.packb([ts, [ev]], use_bin_type=True)
+    return RawMessage(topic=f"kv@{pod}@{model}", sequence=seq, payload=payload)
+
+
+class TestPackedCodec:
+    def test_round_trip(self):
+        eks = [2**63 + 1, 7, 0xFFFFFFFFFFFFFFFF]
+        toks = list(range(12))
+        payload = encode_packed_batch(
+            POD, MODEL, eks, toks,
+            timestamp=123.5, parent_hash=42, block_size=BLOCK,
+        )
+        assert is_packed(payload)
+        pb = decode_packed_batch(payload)
+        assert pb.pod_id == POD
+        assert pb.model_name == MODEL
+        assert pb.timestamp == 123.5
+        assert pb.parent_hash == 42
+        assert pb.block_size == BLOCK
+        assert pb.engine_keys.dtype == np.uint64
+        assert pb.tokens.dtype == np.uint32
+        assert pb.engine_keys.tolist() == eks
+        assert pb.tokens.tolist() == toks
+
+    def test_views_are_zero_copy(self):
+        payload = encode_packed_batch(POD, MODEL, [1], [1, 2, 3, 4],
+                                      timestamp=1.0)
+        pb = decode_packed_batch(payload)
+        # numpy views over the frame buffer, not copies.
+        assert pb.engine_keys.base is not None
+        assert pb.tokens.base is not None
+
+    def test_empty_arrays(self):
+        pb = decode_packed_batch(
+            encode_packed_batch(POD, MODEL, [], [], timestamp=0.0)
+        )
+        assert len(pb.engine_keys) == 0 and len(pb.tokens) == 0
+
+    def test_unicode_strings_pad_to_alignment(self):
+        pod, model = "pod-é", "m/✓"
+        pb = decode_packed_batch(
+            encode_packed_batch(pod, model, [9], [1], timestamp=2.0)
+        )
+        assert (pb.pod_id, pb.model_name) == (pod, model)
+        assert pb.engine_keys.tolist() == [9]
+
+    @pytest.mark.parametrize("payload", [
+        b"",
+        b"KZC1",
+        b"XXXX" + b"\0" * 64,
+        encode_packed_batch(POD, MODEL, [1, 2], [1], timestamp=1.0)[:-8],
+    ])
+    def test_malformed_frames_raise(self, payload):
+        with pytest.raises(ValueError):
+            decode_packed_batch(payload)
+
+    def test_is_packed_sniff(self):
+        assert not is_packed(b"")
+        assert not is_packed(b"KZC")
+        assert not is_packed(msgpack.packb([1.0, []], use_bin_type=True))
+        assert is_packed(b"KZC1garbage")  # sniff only; decode rejects later
+
+    def test_header_size_pinned(self):
+        # The wire layout is cross-version state: 36 bytes, by contract.
+        assert HEADER_SIZE == 36
+
+
+class TestZeroCopyIngest:
+    """Packed-frame ingest must leave the index in the byte-identical
+    state the msgpack BlockStored wire produces."""
+
+    def _states(self, idx, processor, tokens, engine_keys):
+        rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        return (idx.lookup(rks),
+                {ek: idx.get_request_key(ek) for ek in engine_keys})
+
+    def test_matches_msgpack_wire(self, processor):
+        tokens = list(range(8))
+        eks = [101, 102]
+        idx_packed = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        idx_msgpack = InMemoryIndex(InMemoryIndexConfig(size=10_000))
+        Pool(PoolConfig(concurrency=1), idx_packed, processor) \
+            ._process_raw_message(packed_msg(POD, MODEL, eks, tokens))
+        Pool(PoolConfig(concurrency=1), idx_msgpack, processor) \
+            ._process_raw_message(msgpack_msg(POD, MODEL, eks, tokens))
+        assert self._states(idx_packed, processor, tokens, eks) == \
+            self._states(idx_msgpack, processor, tokens, eks)
+        rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        assert idx_packed.lookup(rks)[rks[0]] == [PodEntry(POD, "tpu-hbm")]
+
+    @pytest.mark.skipif(not native.native_available(),
+                        reason="native library unavailable")
+    def test_matches_msgpack_wire_on_native_index(self, processor):
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        tokens = list(range(16))
+        eks = [301, 302, 303, 304]
+        idx_packed = NativeIndex(NativeIndexConfig(size=10_000))
+        idx_msgpack = NativeIndex(NativeIndexConfig(size=10_000))
+        pool = Pool(PoolConfig(concurrency=1), idx_packed, processor)
+        pool._process_raw_message(packed_msg(POD, MODEL, eks, tokens))
+        Pool(PoolConfig(concurrency=1), idx_msgpack, processor) \
+            ._process_raw_message(msgpack_msg(POD, MODEL, eks, tokens))
+        assert self._states(idx_packed, processor, tokens, eks) == \
+            self._states(idx_msgpack, processor, tokens, eks)
+        assert pool.zerocopy_batches == 1
+
+    def test_parent_chain_resolution(self, pool, index, processor):
+        t1, t2 = list(range(4)), list(range(4, 8))
+        pool._process_raw_message(packed_msg(POD, MODEL, [11], t1))
+        pool._process_raw_message(
+            packed_msg(POD, MODEL, [12], t2, parent=11, seq=1)
+        )
+        full_keys = processor.tokens_to_kv_block_keys(0, t1 + t2, MODEL)
+        assert set(index.lookup(full_keys)) == set(full_keys)
+
+    def test_unknown_parent_drops_frame(self, pool, index, processor):
+        pool._process_raw_message(
+            packed_msg(POD, MODEL, [12], list(range(4)), parent=999)
+        )
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk) == {}
+        # The frame decoded fine — the drop is a chain-resolution decision,
+        # so it still counts as a zero-copy batch.
+        assert pool.zerocopy_batches == 1
+
+    def test_kill_switch_disables_packed_decode(self, index, processor):
+        pool = Pool(
+            PoolConfig(concurrency=1, ingest_zero_copy=False),
+            index, processor,
+        )
+        pool._process_raw_message(packed_msg(POD, MODEL, [1], list(range(4))))
+        rk = processor.tokens_to_kv_block_keys(0, list(range(4)), MODEL)
+        assert index.lookup(rk) == {}  # parse failure, not an ingest
+        assert pool.zerocopy_batches == 0
+
+    def test_malformed_frame_does_not_kill_ingest(self, pool, index, processor):
+        pool._process_raw_message(RawMessage(
+            topic=f"kv@{POD}@{MODEL}", sequence=0, payload=b"KZC1truncated"
+        ))
+        tokens = list(range(4))
+        pool._process_raw_message(packed_msg(POD, MODEL, [81], tokens, seq=1))
+        rk = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+        assert index.lookup(rk) != {}
+        assert pool.zerocopy_batches == 1
+
+    def test_counters_and_debug_view(self, pool, index, processor):
+        for i in range(3):
+            pool._process_raw_message(
+                packed_msg(POD, MODEL, [900 + i],
+                           list(range(4 * i, 4 * i + 4)), seq=i)
+            )
+        dp = pool.data_plane_debug()
+        assert dp["zerocopy_batches"] == 3
+        assert dp["shm_messages"] == 0
+
+    def test_lag_tracked_from_packed_timestamp(self, pool, processor):
+        pool._process_raw_message(
+            packed_msg(POD, MODEL, [1], list(range(4)),
+                       ts=time.time() - 2.0)
+        )
+        assert pool.lag_stats()["pods"][POD]["lag_s"] >= 2.0
+
+    def test_full_pipeline_through_sharded_workers(self, index, processor):
+        pool = Pool(PoolConfig(concurrency=4), index, processor)
+        pool.start()
+        try:
+            tokens = list(range(8))
+            pool.add_task(packed_msg(POD, MODEL, [71, 72], tokens))
+            pool.join()
+            rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            assert set(index.lookup(rks)) == set(rks)
+            assert pool.zerocopy_batches == 1
+        finally:
+            pool.shutdown()
+
+
+class TestCoalescerHoist:
+    def test_multi_digest_single_message_coalesces(self, pool, index, processor):
+        """One message carrying several 1:1 BlockStored digests merges
+        into one index add (the per-worker persistent-coalescer change
+        made single-message batches coalesce too)."""
+        ev1 = ["BlockStored", [201], None, list(range(4)), BLOCK]
+        ev2 = ["BlockStored", [202], None, list(range(10, 14)), BLOCK]
+        payload = msgpack.packb([1.0, [ev1, ev2]], use_bin_type=True)
+        pool._process_raw_batch(
+            [RawMessage(topic=f"kv@{POD}@{MODEL}", sequence=0, payload=payload)]
+        )
+        assert pool.coalesced_ops >= 1
+        for toks in (list(range(4)), list(range(10, 14))):
+            rk = processor.tokens_to_kv_block_keys(0, toks, MODEL)
+            assert index.lookup(rk) != {}
+
+    def test_worker_coalescer_persists_across_batches(self, index, processor):
+        pool = Pool(PoolConfig(concurrency=1), index, processor)
+        pool.start()
+        try:
+            for i in range(4):
+                ev1 = ["BlockStored", [300 + 2 * i], None,
+                       list(range(8 * i, 8 * i + 4)), BLOCK]
+                ev2 = ["BlockStored", [301 + 2 * i], None,
+                       list(range(8 * i + 4, 8 * i + 8)), BLOCK]
+                pool.add_task(RawMessage(
+                    topic=f"kv@{POD}@{MODEL}", sequence=i,
+                    payload=msgpack.packb([1.0, [ev1, ev2]], use_bin_type=True),
+                ))
+            pool.join()
+            assert pool.coalesced_ops >= 4
+            for start in range(0, 32, 4):
+                rks = processor.tokens_to_kv_block_keys(
+                    0, list(range(start, start + 4)), MODEL)
+                assert set(index.lookup(rks)) == set(rks), start
+        finally:
+            pool.shutdown()
+
+
+class TestShmRing:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = ShmRing(path, capacity=1 << 14, create=True)
+        r = ShmRing(path)
+        try:
+            records = [bytes([i]) * (50 + i) for i in range(5)]
+            for rec in records:
+                assert w.write(rec)
+            for rec in records:
+                assert r.read() == rec
+            assert r.read() is None
+            assert len(r) == 0
+        finally:
+            r.close()
+            w.close()
+
+    def test_wrap_preserves_order_via_skip_marker(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = ShmRing(path, capacity=4096, create=True)
+        r = ShmRing(path)
+        try:
+            # Records of ~1500B force a skip-marker wrap every few writes.
+            for i in range(50):
+                rec = bytes([i % 251]) * 1500
+                assert w.write(rec), i
+                assert r.read() == rec, i
+        finally:
+            r.close()
+            w.close()
+
+    def test_full_ring_drops_at_writer_then_recovers(self, tmp_path):
+        path = str(tmp_path / "ring")
+        w = ShmRing(path, capacity=4096, create=True)
+        r = ShmRing(path)
+        try:
+            rec = b"x" * 1000
+            written = 0
+            while w.write(rec):
+                written += 1
+            assert 0 < written < 10  # bounded by capacity, never blocks
+            for _ in range(written):
+                assert r.read() == rec
+            assert r.read() is None
+            assert w.write(rec)  # space reclaimed once the reader caught up
+        finally:
+            r.close()
+            w.close()
+
+    def test_oversize_record_rejected(self, tmp_path):
+        w = ShmRing(str(tmp_path / "ring"), capacity=4096, create=True)
+        try:
+            assert not w.write(b"y" * 4096)
+        finally:
+            w.close()
+
+    def test_reader_rejects_foreign_file(self, tmp_path):
+        path = tmp_path / "not-a-ring"
+        path.write_bytes(b"\0" * 128)
+        with pytest.raises(ValueError):
+            ShmRing(str(path))
+
+    def test_unlink(self, tmp_path):
+        import os
+
+        path = str(tmp_path / "ring")
+        w = ShmRing(path, capacity=4096, create=True)
+        w.close()
+        ShmRing.unlink(ShmRing(path))  # attach works before unlink
+        assert not os.path.exists(path)
+
+    def test_pool_drains_ring_end_to_end(self, tmp_path, index, processor):
+        path = str(tmp_path / "ring")
+        ring = ShmRing(path, capacity=1 << 16, create=True)
+        pool = Pool(
+            PoolConfig(concurrency=2, shm_ring_path=path,
+                       shm_ring_poll_s=0.0005),
+            index, processor,
+        )
+        pool.start()
+        try:
+            tokens = list(range(8))
+            frame = encode_packed_batch(
+                POD, MODEL, [101, 102], tokens,
+                timestamp=time.time(), block_size=BLOCK,
+            )
+            assert ring.write(frame)
+            rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            deadline = time.time() + 10.0
+            while time.time() < deadline and index.lookup(rks) == {}:
+                time.sleep(0.005)
+            assert set(index.lookup(rks)) == set(rks)
+            dp = pool.data_plane_debug()
+            assert dp["shm_messages"] == 1
+            assert dp["zerocopy_batches"] == 1
+        finally:
+            pool.shutdown()
+            ring.close()
+
+
+@pytest.mark.skipif(not native.native_available(),
+                    reason="native library unavailable")
+class TestIndexerNativeChunkedEquivalence:
+    """The indexer's `_score_native_chunked` dispatch must score exactly
+    like the pure-Python path — base scores, liveness ordering, residency
+    bonus, and detail threading included."""
+
+    def _pair(self, chunk_size=4):
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+        from llmd_kv_cache_tpu.scoring.indexer import Indexer, IndexerConfig
+
+        def make(index):
+            return Indexer(
+                IndexerConfig(
+                    token_processor_config=TokenProcessorConfig(
+                        block_size_tokens=BLOCK),
+                    lookup_chunk_size=chunk_size,
+                ),
+                index=index,
+            )
+
+        nat = make(NativeIndex(NativeIndexConfig(size=10_000)))
+        py = make(InMemoryIndex(InMemoryIndexConfig(size=10_000)))
+        assert nat._native_score_chunked is not None
+        assert py._native_score_chunked is None
+        return nat, py
+
+    def _seed(self, indexers, tokens, placements):
+        keys = indexers[0].compute_block_keys(tokens, MODEL)
+        for pod, n_blocks, tier in placements:
+            for ix in indexers:
+                ix.kv_block_index.add(
+                    None, keys[:n_blocks], [PodEntry(pod, tier)]
+                )
+        return keys
+
+    def test_scores_identical_across_roles_and_filters(self):
+        nat, py = self._pair()
+        tokens = list(range(48))  # 12 blocks
+        self._seed((nat, py), tokens, [
+            ("pod-a", 12, "tpu-hbm"),
+            ("pod-b", 7, "cpu"),
+            ("pod-c", 3, "shared_storage"),
+        ])
+        for pods in (None, ["pod-a", "pod-c"], ["nope"]):
+            for role in ("", "decode"):
+                assert nat.score_tokens(tokens, MODEL, pods, role=role) == \
+                    py.score_tokens(tokens, MODEL, pods, role=role), (pods, role)
+        assert nat.data_plane_debug()["native_score_calls"] > 0
+
+    def test_residency_bonus_and_detail_identical(self):
+        from llmd_kv_cache_tpu.scoring.residency import ResidencyTracker
+
+        nat, py = self._pair()
+        tokens = list(range(32))  # 8 blocks
+        keys = self._seed((nat, py), tokens, [("pod-a", 8, "tpu-hbm")])
+        for ix in (nat, py):
+            tracker = ResidencyTracker(in_flight_discount=0.5)
+            tracker.on_landed("decode-0", keys[:5])
+            tracker.on_transfer_started("decode-1", keys[:8])
+            ix.attach_residency(tracker)
+        detail_nat, detail_py = {}, {}
+        s_nat = nat.score_tokens(tokens, MODEL, role="decode",
+                                 detail=detail_nat)
+        s_py = py.score_tokens(tokens, MODEL, role="decode",
+                               detail=detail_py)
+        assert s_nat == s_py
+        assert detail_nat["residency"] == detail_py["residency"]
+        assert detail_nat["residency"]["decode-0"] == pytest.approx(5.0)
+        # Role-agnostic requests must not leak the bonus.
+        assert nat.score_tokens(tokens, MODEL) == py.score_tokens(tokens, MODEL)
+
+    def test_early_exit_equivalence_with_chain_hole(self):
+        nat, py = self._pair(chunk_size=2)
+        tokens = list(range(40))  # 10 blocks
+        keys = self._seed((nat, py), tokens, [("pod-a", 10, "tpu-hbm")])
+        from llmd_kv_cache_tpu.core import KeyType
+
+        for ix in (nat, py):
+            ix.kv_block_index.evict(
+                keys[5], KeyType.REQUEST, [PodEntry("pod-a", "tpu-hbm")]
+            )
+        assert nat.score_tokens(tokens, MODEL) == py.score_tokens(tokens, MODEL)
+        dp = nat.data_plane_debug()
+        assert dp["native_score_early_exits"] == 1
+        assert 0 < dp["native_score_chunks"] < 5  # stopped before chunk 5
+
+    def test_chunking_disabled_still_equivalent(self):
+        nat, py = self._pair(chunk_size=0)
+        tokens = list(range(24))
+        self._seed((nat, py), tokens, [("pod-a", 6, "tpu-hbm"),
+                                       ("pod-b", 2, "cpu")])
+        assert nat.score_tokens(tokens, MODEL) == py.score_tokens(tokens, MODEL)

@@ -160,6 +160,172 @@ class TestNativeIndexSpecifics:
         assert len(result) == len(keys)
 
 
+class TestScoreChunked:
+    """kvidx_score_chunked: the one-crossing score data plane — early-exit
+    chunked lookup + tier-weighted prefix scoring + residency fold-in."""
+
+    WEIGHTS = {"tpu-hbm": 1.0, "cpu": 0.8, "shared_storage": 0.5}
+
+    def _populated(self, seed=11, n_keys=48):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        rng = np.random.default_rng(seed)
+        idx = NativeIndex(NativeIndexConfig(size=10_000))
+        keys = list(range(1, n_keys + 1))
+        pods = [f"pod-{i}" for i in range(5)]
+        tiers = list(self.WEIGHTS) + ["weird-tier"]
+        for pod in pods:
+            prefix_len = int(rng.integers(0, len(keys) + 1))
+            for k in keys[:prefix_len]:
+                tier = tiers[int(rng.integers(0, len(tiers)))]
+                idx.add([k], [k], [PodEntry(pod, tier)])
+        return idx, keys
+
+    def test_matches_python_scorer_across_chunk_sizes(self):
+        from llmd_kv_cache_tpu.scoring.scorer import LongestPrefixScorer
+
+        idx, keys = self._populated()
+        scorer = LongestPrefixScorer(self.WEIGHTS)
+        for filt in (None, {"pod-1", "pod-3"}, {"nope"}):
+            ref = scorer.score(keys, idx.lookup(keys, filt))
+            for chunk_size in (0, 1, 4, 16, 64):
+                scores, hits, bonus, stats = idx.score_chunked(
+                    keys, self.WEIGHTS, filt, chunk_size=chunk_size
+                )
+                assert scores == ref, (filt, chunk_size)
+                assert bonus == {}
+                if chunk_size > 0:
+                    assert stats["chunks"] >= 1
+
+    def test_matches_plain_fused_score(self):
+        idx, keys = self._populated(seed=5)
+        for filt in (None, {"pod-0"}):
+            chunked, hits_c, _, _ = idx.score_chunked(
+                keys, self.WEIGHTS, filt, chunk_size=0
+            )
+            fused, hits_f = idx.score(keys, self.WEIGHTS, filt)
+            assert chunked == fused
+            assert hits_c == hits_f
+
+    def test_early_exit_stops_at_chunk_boundary(self):
+        from llmd_kv_cache_tpu.core import KeyType, PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=10_000))
+        keys = list(range(1, 33))
+        for k in keys:
+            idx.add([k], [k], [PodEntry("p", "tpu-hbm")])
+        # Break the chain inside chunk 2 (keys 9-16 with chunk_size=8).
+        idx.evict(11, KeyType.ENGINE, [PodEntry("p", "tpu-hbm")])
+        scores, hits, _, stats = idx.score_chunked(
+            keys, {"tpu-hbm": 1.0}, chunk_size=8
+        )
+        assert scores == {"p": 10.0}  # prefix runs 1..10
+        assert stats["early_exited"] == 1
+        assert stats["chunks"] == 2  # chunks 3-4 never scanned
+        assert hits == 15  # scanned keys minus the hole
+
+    def test_residency_claims_match_python_tracker(self):
+        from llmd_kv_cache_tpu.scoring.residency import ResidencyTracker
+        from llmd_kv_cache_tpu.scoring.scorer import LongestPrefixScorer
+
+        idx, keys = self._populated(seed=9)
+        scorer = LongestPrefixScorer(self.WEIGHTS)
+        tracker = ResidencyTracker(landed_weight=1.0, in_flight_discount=0.5)
+        tracker.on_landed("decode-0", keys[:7])
+        tracker.on_transfer_started("decode-1", keys[:12])
+        tracker.on_landed("decode-1", keys[:3])
+        # decode-2's claims start at index 1: no consecutive-from-0 run.
+        tracker.on_landed("decode-2", keys[1:5])
+        for filt in (None, {"decode-0", "pod-1"}):
+            claims = tracker.claim_rows(keys, filt)
+            scores, _, bonus, _ = idx.score_chunked(
+                keys, self.WEIGHTS, filt,
+                claims=claims,
+                landed_weight=tracker.landed_weight,
+                in_flight_discount=tracker.in_flight_discount,
+                tier_discount=tracker.discount(),
+            )
+            assert bonus == tracker.bonus(keys, filt), filt
+            # Base scores stay pure: identical to the no-claims call.
+            assert scores == scorer.score(keys, idx.lookup(keys, filt))
+
+    def test_tier_discount_scales_bonus(self):
+        from llmd_kv_cache_tpu.scoring.residency import ResidencyTracker
+
+        idx, keys = self._populated(seed=2)
+        tracker = ResidencyTracker()
+        tracker.on_landed("decode-0", keys[:4])
+        claims = tracker.claim_rows(keys, None)
+        _, _, full, _ = idx.score_chunked(
+            keys, self.WEIGHTS, claims=claims, tier_discount=1.0
+        )
+        _, _, halved, _ = idx.score_chunked(
+            keys, self.WEIGHTS, claims=claims, tier_discount=0.5
+        )
+        assert halved == {p: pytest.approx(b * 0.5) for p, b in full.items()}
+
+    def test_overflow_retries(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=100_000, pod_cache_size=3000))
+        idx.add([1], [1], [PodEntry(f"pod-{i}", "tpu-hbm") for i in range(2000)])
+        scores, hits, bonus, _ = idx.score_chunked([1], {"tpu-hbm": 1.0})
+        assert len(scores) == 2000
+        assert hits == 1
+
+    def test_empty_keys(self):
+        idx, _ = self._populated(n_keys=2)
+        assert idx.score_chunked([], self.WEIGHTS) == (
+            {}, 0, {}, {"chunks": 0, "early_exited": 0}
+        )
+
+    def test_ndarray_keys_accepted(self):
+        idx, keys = self._populated(seed=4)
+        from_list = idx.score_chunked(keys, self.WEIGHTS, chunk_size=8)
+        from_arr = idx.score_chunked(
+            np.asarray(keys, np.uint64), self.WEIGHTS, chunk_size=8
+        )
+        assert from_arr == from_list
+
+
+class TestNativeArrayAdd:
+    """accepts_key_arrays: the zero-copy ingest path hands numpy views
+    straight to ``kvidx_add`` with no per-element int materialization."""
+
+    def test_class_advertises_capability(self):
+        from llmd_kv_cache_tpu.index.native import NativeIndex
+
+        assert NativeIndex.accepts_key_arrays is True
+
+    def test_array_add_equivalent_to_list_add(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        entries = [PodEntry("pod-z", "tpu-hbm")]
+        eks = [101, 102, 103]
+        rks = [11, 12, 13]
+        via_list = NativeIndex(NativeIndexConfig(size=1000))
+        via_list.add(eks, rks, entries)
+        via_arr = NativeIndex(NativeIndexConfig(size=1000))
+        via_arr.add(
+            np.asarray(eks, np.uint64), np.asarray(rks, np.uint64), entries
+        )
+        assert via_arr.lookup(rks) == via_list.lookup(rks)
+        for ek in eks:
+            assert via_arr.get_request_key(ek) == via_list.get_request_key(ek)
+
+    def test_empty_array_rejected_like_empty_list(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.index.native import NativeIndex, NativeIndexConfig
+
+        idx = NativeIndex(NativeIndexConfig(size=1000))
+        with pytest.raises(ValueError):
+            idx.add(None, np.empty(0, np.uint64), [PodEntry("p", "tpu-hbm")])
+
+
 class TestNoBuildGate:
     """``KVTPU_NATIVE_NO_BUILD=1`` must fail fast instead of compiling at
     import time when a prebuilt .so is missing or stale (the loud-warning

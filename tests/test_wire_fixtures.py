@@ -345,3 +345,97 @@ class TestWireToIndex:
             sub.stop()
             pool.shutdown()
             pub.close(0)
+
+
+class TestBatchedLookupWire:
+    """The framed multi-chunk LookupBlocksBatch wire (the native data
+    plane): committed bytes through the real server handler and the
+    real client parser, plus old-frame tolerance in both directions."""
+
+    def _service(self):
+        from llmd_kv_cache_tpu.core import PodEntry
+        from llmd_kv_cache_tpu.services.indexer_service import IndexerService
+
+        svc = IndexerService()
+        # Keys 100-102 resident; 103 (chunk 1's second key) missing, so
+        # the batch fixture exercises the server-side early exit.
+        svc.indexer.kv_block_index.add(
+            None, [100, 101, 102], [PodEntry("pod-1", "tpu-hbm")])
+        return svc
+
+    def test_batch_request_frame_layout(self):
+        import msgpack
+
+        d = msgpack.unpackb(load("lookup_batch_request.bin"), raw=False)
+        assert d["chunks"] == [[100, 101], [102, 103]]
+        assert d["pods"] == ["pod-1"]
+        assert d["deadline_ms"] == 40
+        assert d["hedge"] is True
+
+    def test_batch_request_through_service_handler(self):
+        """Committed request bytes drive the real handler: chunk 0 is
+        complete, chunk 1 misses key 103 → early exit, ``cont=[1,0]``."""
+        import msgpack
+
+        svc = self._service()
+        resp = svc.lookup_blocks_batch_rpc(
+            msgpack.unpackb(load("lookup_batch_request.bin"), raw=False))
+        assert resp["cont"] == [1, 0]
+        assert len(resp["chunks"]) == 2
+        assert sorted(k for k, _ in resp["chunks"][0]) == [100, 101]
+        assert [k for k, _ in resp["chunks"][1]] == [102]
+
+    def test_flat_frame_tolerated_as_one_chunk(self):
+        """An old peer's flat LookupBlocks frame reaching the batch
+        handler decodes as one implicit chunk; the deadline/hedge
+        metadata keys ride along untouched."""
+        import msgpack
+
+        svc = self._service()
+        resp = svc.lookup_blocks_batch_rpc(
+            msgpack.unpackb(load("lookup_request_deadline.bin"), raw=False))
+        assert resp["cont"] == [1]
+        assert len(resp["chunks"]) == 1
+        assert sorted(k for k, _ in resp["chunks"][0]) == [100, 101]
+
+    def _stub_client(self, response: dict):
+        """A ShardClient whose batch RPC returns the given already-
+        unpacked body — the parsing under test is the client's, the
+        transport is out of scope here."""
+        from llmd_kv_cache_tpu.cluster.remote import ShardClient
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            DEFAULT_RPC_RETRY_POLICY,
+        )
+
+        c = object.__new__(ShardClient)
+        c.address = "stub"
+        c._timeout = 1.0
+        c.retry_policy = DEFAULT_RPC_RETRY_POLICY
+        c._lookup_blocks_batch = (
+            lambda frame, timeout=None, metadata=None: response)
+        return c
+
+    def test_batch_response_client_parsing(self):
+        import msgpack
+
+        resp = msgpack.unpackb(load("lookup_batch_response.bin"), raw=False)
+        out = self._stub_client(resp).lookup_blocks_batch(
+            [[100, 101], [102, 103]])
+        assert out["cont"] == [True, False]
+        assert sorted(out["hits"]) == [100, 101, 102]
+        assert out["hits"][100][0].pod_identifier == "pod-1"
+        assert out["hits"][102][0].pod_identifier == "pod-2"
+        assert out["shard"] == "shard-0"
+
+    def test_flat_response_tolerated_by_batch_client(self):
+        """A flat pre-batch response body parses as one implicit chunk
+        with no continuation flags — safe, because the router truncates
+        from its own merged map rather than trusting ``cont``."""
+        import msgpack
+
+        resp = msgpack.unpackb(
+            load("lookup_batch_response_flat.bin"), raw=False)
+        out = self._stub_client(resp).lookup_blocks_batch([[100]])
+        assert out["cont"] == []
+        assert sorted(out["hits"]) == [100]
+        assert out["hits"][100][0].device_tier == "tpu-hbm"

@@ -217,6 +217,49 @@ def score_fixtures() -> dict[str, bytes]:
             (s("deadline_ms"), u(40)),
             (s("hedge"), tru()),
         ),
+        # Batched multi-chunk lookup frame (the native data plane): one
+        # RPC carries a whole gather window of early-exit chunks, with
+        # the same tolerant deadline/hedge metadata the flat frame grew.
+        # A pre-batch server answers this method UNIMPLEMENTED (the
+        # router's fallback cue); a flat ``keys`` frame reaching the new
+        # handler is treated as one implicit chunk.
+        "lookup_batch_request.bin": mp(
+            (s("chunks"), arr(
+                arr(u(100), u(101)),
+                arr(u(102), u(103)),
+            )),
+            (s("pods"), arr(s("pod-1"))),
+            (s("deadline_ms"), u(40)),
+            (s("hedge"), tru()),
+        ),
+        # Batched response: chunk 0 complete (cont=1), chunk 1 missing a
+        # key (cont=0) — the shard early-exited server-side, so no third
+        # chunk rides the frame. Rows are the LookupBlocks
+        # ``[key, [[pod, tier, flags, group_idx], ...]]`` layout.
+        "lookup_batch_response.bin": mp(
+            (s("chunks"), arr(
+                arr(
+                    arr(u(100), arr(arr(s("pod-1"), s("tpu-hbm"), u(0), nil()))),
+                    arr(u(101), arr(arr(s("pod-1"), s("tpu-hbm"), u(0), nil()))),
+                ),
+                arr(
+                    arr(u(102), arr(arr(s("pod-2"), s("tpu-hbm"), u(0), nil()))),
+                ),
+            )),
+            (s("cont"), arr(u(1), u(0))),
+            (s("degraded"), fal()),
+            (s("shard"), s("shard-0")),
+        ),
+        # Old-frame tolerance, response direction: a flat LookupBlocks
+        # body (no chunks/cont) that a batch-aware client must read as
+        # one implicit chunk with every answered key counting.
+        "lookup_batch_response_flat.bin": mp(
+            (s("hits"), arr(
+                arr(u(100), arr(arr(s("pod-1"), s("tpu-hbm"), u(0), nil()))),
+            )),
+            (s("degraded"), fal()),
+            (s("shard"), s("shard-0")),
+        ),
     }
 
 
