@@ -144,6 +144,13 @@ bench-controller: native
 bench-graytail: native
 	$(CPU_ENV) $(PY) bench.py --graytail
 
+# Ground-truth audit plane gate (telemetry/audit.py): the per-score
+# prediction hook must cost < 1% of the Python-path score p50 (the
+# perf-sentinel value); the once-per-request outcome append is reported
+# informationally.
+bench-audit: native
+	$(CPU_ENV) $(PY) bench.py --audit
+
 # Perf-regression sentinel: run the profiling + working-set gates and the
 # controller chaos arm, then diff their values and hot-function shares
 # against the committed baseline manifest. Emits machine-verdict
@@ -153,12 +160,14 @@ perf-check: native
 	$(CPU_ENV) $(PY) bench.py --workingset > /tmp/kvtpu_workingset_bench.json
 	$(CPU_ENV) $(PY) bench.py --controller > /tmp/kvtpu_controller_bench.json
 	$(CPU_ENV) $(PY) bench.py --graytail > /tmp/kvtpu_graytail_bench.json
+	$(CPU_ENV) $(PY) bench.py --audit > /tmp/kvtpu_audit_bench.json
 	$(CPU_ENV) $(PY) hack/bench_hotpath.py --fleet > /tmp/kvtpu_fleet_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
 	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
 	  --results workingset=/tmp/kvtpu_workingset_bench.json \
 	  --results controller=/tmp/kvtpu_controller_bench.json \
 	  --results graytail=/tmp/kvtpu_graytail_bench.json \
+	  --results audit=/tmp/kvtpu_audit_bench.json \
 	  --results hotpath-fleet=/tmp/kvtpu_fleet_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.

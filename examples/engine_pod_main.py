@@ -90,6 +90,16 @@ def main() -> None:
                              "(default 0.05)")
     parser.add_argument("--workingset-window-s", type=float, default=10.0,
                         help="window length for --workingset (default 10s)")
+    parser.add_argument("--audit", action="store_true",
+                        help="ground-truth audit: record every request's "
+                             "realized prefix outcome (HBM hit vs restored "
+                             "vs recomputed blocks) in a ring served at "
+                             "/debug/audit on --admin-port for the "
+                             "collector's score-vs-reality join; requests "
+                             "may carry the prediction they were routed on "
+                             "via a 'feedback' object in the req.json")
+    parser.add_argument("--audit-max-records", type=int, default=2048,
+                        help="audit ring depth for --audit (default 2048)")
     args = parser.parse_args()
 
     cfg = LlamaConfig.tiny()
@@ -188,6 +198,13 @@ def main() -> None:
             if tracker is not None:
                 engine.attach_workingset(tracker)
                 admin.register_workingset_source(tracker.export_since)
+        if args.audit:
+            from llmd_kv_cache_tpu.telemetry.audit import AuditLog
+
+            audit_log = AuditLog(capacity=args.audit_max_records)
+            engine.attach_audit(audit_log)
+            admin.register_audit_source(audit_log.export_since)
+            admin.register_debug("audit_state", audit_log.debug_view)
         # Fleet-controller surface: /debug/role reports this pod's
         # serving role plus the handoff coordinator's residency/
         # starvation stats; POST /debug/role?set=<role> re-roles the
@@ -240,10 +257,40 @@ def main() -> None:
                 # Prefill pods never decode: the request ends at the
                 # bootstrap token, its KV committed to the shared store.
                 max_new = 1
-            out = engine.generate(
-                req["request_id"], req["prompt"],
-                max_new_tokens=max_new,
-            )
+            if "traceparent" in req or "feedback" in req:
+                # Audit-plane path: carry the routing prediction (and the
+                # scorer's trace) onto the realized-outcome record.
+                fb = None
+                fb_dict = req.get("feedback")
+                if fb_dict:
+                    from llmd_kv_cache_tpu.services.indexer_service import (
+                        ScoreFeedback,
+                    )
+
+                    fb = ScoreFeedback(
+                        traceparent=fb_dict.get("traceparent", ""),
+                        chosen_pod=fb_dict.get("chosen_pod", ""),
+                        predicted_blocks=float(
+                            fb_dict.get("predicted_blocks", 0.0)),
+                        total_blocks=int(fb_dict.get("total_blocks", 0)),
+                        scores=dict(fb_dict.get("scores", {})),
+                        residency=dict(fb_dict.get("residency", {})),
+                        staleness_s=float(fb_dict.get("staleness_s", 0.0)),
+                    )
+                req_obj = engine.enqueue(
+                    req["request_id"], req["prompt"],
+                    max_new_tokens=max_new,
+                    traceparent=req.get("traceparent"),
+                    feedback=fb,
+                )
+                while not req_obj.done:
+                    engine.step()
+                out = req_obj.output
+            else:
+                out = engine.generate(
+                    req["request_id"], req["prompt"],
+                    max_new_tokens=max_new,
+                )
             if spec is not None:
                 engine.flush_offload()
             # Atomic publish: readers poll for the .out.json name, so it

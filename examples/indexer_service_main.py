@@ -121,6 +121,17 @@ def main() -> None:
         help="sampling rate for --pyprof (default 67 Hz)",
     )
     parser.add_argument(
+        "--audit", action="store_true",
+        help="ground-truth audit plane: record every scored request's "
+             "predicted per-pod blocks into a ring served at /debug/audit "
+             "on --admin-port, for the collector's score-vs-reality "
+             "calibration join",
+    )
+    parser.add_argument(
+        "--audit-max-records", type=int, default=2048,
+        help="audit ring depth for --audit (default 2048)",
+    )
+    parser.add_argument(
         "--workingset", action="store_true",
         help="working-set analytics: sample block reuse on the scoring "
              "path and serve reuse windows at /debug/workingset on "
@@ -176,12 +187,16 @@ def main() -> None:
         "adminPort": args.admin_port,
         "adminHost": args.admin_host,
     }
-    if args.span_export or args.pyprof or args.workingset:
+    if args.span_export or args.pyprof or args.workingset or args.audit:
         indexer_cfg_dict["fleetTelemetry"] = {
             "spanExport": args.span_export,
             "maxSpans": args.span_export_max_spans,
             "processIdentity": args.process_identity,
         }
+        if args.audit:
+            indexer_cfg_dict["fleetTelemetry"]["audit"] = True
+            indexer_cfg_dict["fleetTelemetry"]["auditMaxRecords"] = (
+                args.audit_max_records)
         if args.pyprof:
             indexer_cfg_dict["fleetTelemetry"]["pyprof"] = {
                 "enabled": True, "hz": args.pyprof_hz,

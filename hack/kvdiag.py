@@ -38,6 +38,11 @@ an on-call engineer needs into a single JSON report on stdout:
                                  capacity table (hit ratio at
                                  0.5x/1x/2x/4x HBM, never-read offload
                                  fraction, cross-pod duplicate share)
+- ``fleet.audit`` (summary)    — collector targets running the audit
+                                 plane: score-vs-reality calibration per
+                                 pod, routing-regret rate, and current
+                                 index divergence (phantom/ghost blocks)
+                                 with the degraded pods named
 - ``controller`` (summary)     — when the target is the fleet controller:
                                  the last N actions with each action's
                                  causing signal, per-action-kind cooldown
@@ -57,6 +62,10 @@ whole report.
 
 Stdlib-only on purpose: this must run inside the most degraded pod
 imaginable (``kubectl exec`` + whatever python is present).
+
+Exit codes: 0 healthy, 2 target unreachable, 3 (with ``--fleet``) at
+least one SLO alert is firing — so cron/CI can gate on
+``kvdiag --fleet --quiet``.
 """
 
 from __future__ import annotations
@@ -72,7 +81,8 @@ METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_handoff_", "kvtpu_slo_", "kvtpu_trace_",
                    "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
                    "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_",
-                   "kvtpu_ingest_", "kvtpu_native_")
+                   "kvtpu_ingest_", "kvtpu_native_", "kvtpu_audit_",
+                   "kvtpu_index_divergence_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -429,6 +439,43 @@ def fleet_summary(debug: dict) -> dict:
             },
         }
 
+    audit = debug.get("audit") or {}
+    if audit.get("joined") or audit.get("divergence") \
+            or audit.get("unjoined_outcomes"):
+        # Ground-truth audit plane: how honest the routing scores were
+        # (calibration), what routing the fleet regrets, and which pods'
+        # advertised index currently disagrees with engine truth.
+        pods = audit.get("pods") or {}
+        divergence = audit.get("divergence") or {}
+        degraded = sorted(
+            set(divergence)
+            | {pod for pod, st in pods.items()
+               if (st.get("stale_mispredicted_blocks") or 0)
+               > (st.get("fresh_mispredicted_blocks") or 0)
+               and (st.get("mean_abs_error_blocks") or 0) > 0.5})
+        out["audit"] = {
+            "joined": audit.get("joined"),
+            "unjoined_outcomes": audit.get("unjoined_outcomes"),
+            "mean_abs_error_blocks": audit.get("mean_abs_error_blocks"),
+            "regrets": audit.get("regrets"),
+            "regret_rate": audit.get("regret_rate"),
+            "calibration": {
+                pod: {
+                    "joins": st.get("joins"),
+                    "mean_abs_error_blocks": st.get("mean_abs_error_blocks"),
+                    "calibration_ratio": st.get("calibration_ratio"),
+                    "regrets": st.get("regrets"),
+                    "stale_mispredicted_blocks":
+                        st.get("stale_mispredicted_blocks"),
+                    "fresh_mispredicted_blocks":
+                        st.get("fresh_mispredicted_blocks"),
+                }
+                for pod, st in pods.items()
+            },
+            "divergence": divergence,
+            "degraded_pods": degraded,
+        }
+
     out["alerts"] = alerts
     out["slo"] = slo
     return out
@@ -524,6 +571,53 @@ def watch_loop(args, specs) -> int:
         return 0
 
 
+def firing_alerts(report: dict) -> list[dict]:
+    """Every firing SLO alert across a single- or multi-target report
+    (the ``fleet.alerts`` stanzas), each tagged with its target."""
+    found: list[dict] = []
+    if "targets" in report and isinstance(report.get("targets"), dict):
+        per = [(spec, t) for spec, t in report["targets"].items()
+               if isinstance(t, dict) and "error" not in t]
+    else:
+        per = [(report.get("endpoint", ""), report)]
+    for spec, rep in per:
+        fleet = rep.get("fleet") or {}
+        for alert in fleet.get("alerts") or []:
+            entry = dict(alert)
+            entry["target"] = spec
+            found.append(entry)
+    return found
+
+
+def _emit(report: dict, args, alerts: list[dict]) -> None:
+    """Print the report — full JSON, or (``--quiet``) one status line
+    built for scripts and CI gates."""
+    if args.quiet:
+        if alerts:
+            names = ", ".join(
+                f"{a.get('slo')}:{a.get('severity')}" for a in alerts)
+            line = f"kvdiag: {len(alerts)} alert(s) firing [{names}]"
+            degraded = sorted({
+                pod
+                for rep in ([report] if "targets" not in report
+                            else report.get("targets", {}).values())
+                if isinstance(rep, dict)
+                for pod in ((rep.get("fleet") or {}).get("audit") or {})
+                .get("degraded_pods") or []})
+            if degraded:
+                line += f" degraded_pods={','.join(degraded)}"
+        else:
+            line = "kvdiag: ok"
+        print(line)
+        return
+    payload = json.dumps(report, indent=2, default=repr)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--host", default="127.0.0.1")
@@ -536,6 +630,10 @@ def main(argv=None) -> int:
                         help="summarise the telemetry collector's surfaces "
                              "(retained traces, rollup percentiles, SLO "
                              "burn state) into a top-level fleet section")
+    parser.add_argument("--quiet", action="store_true",
+                        help="print one status line instead of the JSON "
+                             "report (pairs with the exit code: 0 ok, 2 "
+                             "unreachable, 3 SLO alert firing)")
     parser.add_argument("--timeout", type=float, default=5.0)
     parser.add_argument("--watch", type=float, default=None, metavar="N",
                         help="re-poll every N seconds and print delta "
@@ -558,13 +656,13 @@ def main(argv=None) -> int:
     if args.targets is not None:
         specs = [t.strip() for t in args.targets.split(",") if t.strip()]
         report = multi_snapshot(specs, args.timeout, fleet=args.fleet)
-        payload = json.dumps(report, indent=2, default=repr)
-        if args.out:
-            with open(args.out, "w", encoding="utf-8") as f:
-                f.write(payload + "\n")
-        else:
-            print(payload)
-        return 0 if report["reachable"] else 2
+        alerts = firing_alerts(report) if args.fleet else []
+        _emit(report, args, alerts)
+        if not report["reachable"]:
+            return 2
+        # CI/cron gate: --fleet exits nonzero while any SLO alert is
+        # firing, so "kvdiag --fleet --quiet || page" just works.
+        return 3 if alerts else 0
 
     try:
         report = snapshot(args.host, args.port, args.timeout, fleet=args.fleet)
@@ -573,13 +671,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    payload = json.dumps(report, indent=2, default=repr)
-    if args.out:
-        with open(args.out, "w", encoding="utf-8") as f:
-            f.write(payload + "\n")
-    else:
-        print(payload)
-    return 0
+    alerts = firing_alerts(report) if args.fleet else []
+    _emit(report, args, alerts)
+    return 3 if alerts else 0
 
 
 if __name__ == "__main__":

@@ -18,6 +18,13 @@ AST checks over every ``.py`` file under the given roots (default
    name, and each debug endpoint in ``REQUIRED_ENDPOINTS`` must appear
    in ``docs/observability.md``; an undocumented metric is a dashboard
    nobody will ever build.
+4. **OBS-ORPHAN-METRIC** — the reverse direction: every metric-shaped
+   name the docs mention must correspond to a family actually
+   constructed in the library, so a renamed or deleted metric can't
+   leave a ghost row in the runbook. A documented name matches a
+   constructed one exactly, as a rendered sample (``foo_bucket`` for
+   histogram ``foo``), or as a ``prefix_*`` / trailing-underscore
+   family-group mention.
 
 Runs standalone or as one pass of ``hack/kvlint.py`` (the ``make lint``
 driver). Exit status 1 when any violation is found (CI-friendly).
@@ -26,6 +33,7 @@ driver). Exit status 1 when any violation is found (CI-friendly).
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 from typing import NamedTuple
@@ -36,12 +44,12 @@ METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
                    "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_",
                    "kvtpu_hedge_", "kvtpu_shed_", "kvtpu_ingest_",
-                   "kvtpu_native_")
+                   "kvtpu_native_", "kvtpu_audit_", "kvtpu_index_divergence_")
 # Admin-plane surfaces an operator must be able to find without reading
 # the source: each literal must appear in docs/observability.md.
 REQUIRED_ENDPOINTS = ("/debug/pyprof", "/debug/pyprof/capture",
                       "/debug/workingset", "/debug/slo", "/debug/role",
-                      "/debug/controller")
+                      "/debug/controller", "/debug/audit")
 METRIC_CLASSES = frozenset({
     "Counter", "Gauge", "Histogram", "Summary",
     # The engine-telemetry histogram primitive with config-driven buckets
@@ -58,7 +66,18 @@ RULE_METRIC_NAMESPACE = "OBS-METRIC-NAMESPACE"
 RULE_UNDOC_METRIC = "OBS-UNDOC-METRIC"
 RULE_UNDOC_SPAN = "OBS-UNDOC-SPAN"
 RULE_UNDOC_ENDPOINT = "OBS-UNDOC-ENDPOINT"
+RULE_ORPHAN_METRIC = "OBS-ORPHAN-METRIC"
 RULE_SYNTAX = "OBS-SYNTAX"
+
+# Metric-shaped tokens in the docs: a project prefix followed by the rest
+# of a family name, optionally a `*` wildcard (family-group mentions).
+_DOC_METRIC_RE = re.compile(
+    r"\b(?:" + "|".join(re.escape(p) for p in sorted(
+        set(METRIC_PREFIXES))) + r")[A-Za-z0-9_]*\*?"
+)
+# Suffixes prometheus_client appends to rendered samples; a documented
+# `foo_bucket` is covered by a constructed histogram `foo`.
+_RENDERED_SUFFIXES = ("_total", "_bucket", "_sum", "_count", "_created")
 
 
 class Problem(NamedTuple):
@@ -125,18 +144,51 @@ def _module_string_consts(tree: ast.Module) -> dict[str, str]:
     return consts
 
 
-def lint_file(path: Path) -> tuple[list[Problem], list[str], list[str]]:
-    """Returns (problems, metric_names_constructed, span_names)."""
+def _resolve_metric_name(node: ast.AST, consts: dict[str, str]) -> str:
+    """Fully resolve a metric-name expression, following module string
+    constants into f-strings (``Counter(f"{_NS}_admissions_total")`` with
+    ``_NS = "kvcache_index"`` resolves to the rendered name). Returns ""
+    when any part is genuinely dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id, "")
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                parts.append(part.value)
+            elif (isinstance(part, ast.FormattedValue)
+                    and isinstance(part.value, ast.Name)
+                    and part.value.id in consts):
+                parts.append(consts[part.value.id])
+            else:
+                return ""
+        return "".join(parts)
+    return ""
+
+
+def lint_file(
+    path: Path,
+) -> tuple[list[Problem], list[str], list[str], list[str]]:
+    """Returns (problems, metric_names_constructed, span_names,
+    resolved_metric_names).
+
+    ``resolved_metric_names`` additionally includes names assembled from
+    module constants (f-strings); they feed the orphan check only — the
+    namespace/docs checks keep their original literal-only scope.
+    """
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
         return ([Problem(str(path), e.lineno or 0, RULE_SYNTAX,
-                         f"syntax error: {e.msg}")], [], [])
+                         f"syntax error: {e.msg}")], [], [], [])
     consts = _module_string_consts(tree)
     problems: list[Problem] = []
     metric_names: list[str] = []
     span_names: list[str] = []
+    resolved_names: list[str] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -169,11 +221,16 @@ def lint_file(path: Path) -> tuple[list[Problem], list[str], list[str]]:
                     f"{cls} {name!r} outside the "
                     f"{'/'.join(METRIC_PREFIXES)} namespaces",
                 ))
-    return problems, metric_names, span_names
+        elif cls:
+            resolved = _resolve_metric_name(first, consts)
+            if resolved:
+                resolved_names.append(resolved)
+    return problems, metric_names, span_names, resolved_names
 
 
 def check_docs(metric_names: list[str], span_names: list[str],
-               docs_path: Path) -> list[Problem]:
+               docs_path: Path,
+               known_metrics: list[str] | None = None) -> list[Problem]:
     if not docs_path.exists():
         return [Problem(str(docs_path), 0, RULE_UNDOC_METRIC,
                         "missing — every metric must be documented there")]
@@ -196,6 +253,40 @@ def check_docs(metric_names: list[str], span_names: list[str],
         for endpoint in REQUIRED_ENDPOINTS
         if endpoint not in text
     )
+    # Reverse direction: every metric-shaped name the docs mention must
+    # correspond to a constructed family — a rename that forgets the docs
+    # (or a doc row for a deleted metric) fails here, not in an incident.
+    known = set(metric_names) | set(known_metrics or ())
+    for doc_name in sorted(set(_DOC_METRIC_RE.findall(text))):
+        if doc_name.endswith("*") or doc_name.endswith("_"):
+            # Family-group mention ("the kvtpu_audit_* families"): any
+            # constructed family under the prefix covers it.
+            base = doc_name.rstrip("*")
+            if not any(k.startswith(base) for k in known):
+                problems.append(Problem(
+                    str(docs_path), 0, RULE_ORPHAN_METRIC,
+                    f"documented family group `{doc_name}` matches no "
+                    "constructed metric",
+                ))
+            continue
+        if doc_name in known:
+            continue
+        # Rendered-sample tolerance: `foo_bucket` is covered by
+        # histogram `foo`, `x_total` by Counter("x_total") stored as
+        # family `x` by custom collectors, etc.
+        stripped = doc_name
+        for suffix in _RENDERED_SUFFIXES:
+            if doc_name.endswith(suffix):
+                stripped = doc_name[: -len(suffix)]
+                break
+        if any(doc_name == k or stripped == k
+               or doc_name.startswith(k + "_") for k in known):
+            continue
+        problems.append(Problem(
+            str(docs_path), 0, RULE_ORPHAN_METRIC,
+            f"documented metric `{doc_name}` is not constructed anywhere "
+            "under the linted roots",
+        ))
     return problems
 
 
@@ -204,16 +295,20 @@ def collect(roots: list[Path]) -> tuple[int, int, list[Problem]]:
     problems: list[Problem] = []
     metric_names: list[str] = []
     span_names: list[str] = []
+    resolved_names: list[str] = []
     n_files = 0
     for root in roots:
         files = [root] if root.is_file() else sorted(root.rglob("*.py"))
         for f in files:
             n_files += 1
-            file_problems, file_metrics, file_spans = lint_file(f)
+            file_problems, file_metrics, file_spans, file_resolved = \
+                lint_file(f)
             problems.extend(file_problems)
             metric_names.extend(file_metrics)
             span_names.extend(file_spans)
-    problems.extend(check_docs(metric_names, span_names, DOCS_PATH))
+            resolved_names.extend(file_resolved)
+    problems.extend(check_docs(metric_names, span_names, DOCS_PATH,
+                               known_metrics=resolved_names))
     return n_files, len(set(metric_names)), problems
 
 

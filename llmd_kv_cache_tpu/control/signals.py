@@ -54,6 +54,12 @@ class FleetSignals:
     # the earliest capacity signal the controller gets — requests are
     # already being turned away before any SLO window fills.
     shed: Dict[str, dict] = field(default_factory=dict)
+    # Ground-truth audit plane (collector.audit_view()): per-pod
+    # phantom/ghost divergence now, calibration error, and the
+    # routing-regret rate — lets the policy distinguish "the index is
+    # lying about pod X" (divergence → reconcile/demote) from "capacity
+    # is short" (shed/SLO burn → scale).
+    audit: dict = field(default_factory=dict)
     # Topology.
     shards: Tuple[str, ...] = ()
     roles: Dict[str, str] = field(default_factory=dict)
@@ -74,6 +80,14 @@ class FleetSignals:
     def shed_rate(self, site: str) -> float:
         return float((self.shed.get(site) or {}).get("shed_rate", 0.0))
 
+    def divergent_pods(self) -> List[str]:
+        """Pods the divergence audit currently finds out of sync
+        (advertising phantom blocks or hiding ghost ones)."""
+        return sorted((self.audit.get("divergence") or {}).keys())
+
+    def regret_rate(self) -> float:
+        return float(self.audit.get("regret_rate", 0.0))
+
     def describe(self) -> dict:
         """Compact JSON-able summary (journal/span payloads)."""
         return {
@@ -87,6 +101,12 @@ class FleetSignals:
             "dominant_segment": dict(self.dominant_segment),
             "handoff": dict(self.handoff),
             "shed": {site: dict(st) for site, st in self.shed.items()},
+            "audit": {
+                "divergence": dict(self.audit.get("divergence") or {}),
+                "regret_rate": round(self.regret_rate(), 4),
+                "mean_abs_error_blocks": round(float(
+                    self.audit.get("mean_abs_error_blocks", 0.0)), 3),
+            } if self.audit else {},
             "shards": list(self.shards),
             "roles": dict(self.roles),
         }
@@ -134,6 +154,7 @@ class CollectorSignalSource:
                                                   self._edge_cursor))
         dominant: dict = {}
         whatif: Tuple[dict, ...] = ()
+        audit: dict = {}
         if self._collector is not None:
             best = 0.0
             for summary in self._collector.assembler.retained():
@@ -151,6 +172,10 @@ class CollectorSignalSource:
                     self._collector.workingset_view().get("whatif") or ())
             except Exception:  # enrichment, never round-fatal  # lint: allow-swallow
                 whatif = ()
+            try:
+                audit = dict(self._collector.audit_view())
+            except Exception:  # enrichment, never round-fatal  # lint: allow-swallow
+                audit = {}
         handoff = {}
         if self._handoff is not None:
             handoff = self._handoff.starvation()
@@ -166,6 +191,7 @@ class CollectorSignalSource:
             handoff=handoff,
             whatif=whatif,
             shed=shed,
+            audit=audit,
             shards=tuple(self._shards()),
             roles=dict(self._roles()),
         )

@@ -840,6 +840,117 @@ WORKINGSET_WINDOWS_DROPPED = Counter(
 
 
 # --------------------------------------------------------------------------
+# Ground-truth audit plane (kvtpu_audit_*): score-vs-reality calibration.
+# The collector's AuditJoiner (telemetry/audit.py) joins score-time
+# predictions to engine-realized outcomes per trace and lands the
+# per-request error here; the calibration curves themselves are
+# exemplar-linked BucketHistograms the joiner constructs
+# (kvtpu_audit_predicted_hit_blocks / _realized_hit_blocks /
+# _calibration_error_blocks). ``cause`` attributes mispredicted blocks to
+# the index staleness observed at score time: "stale" (event lag above
+# the configured threshold — the index hadn't caught up yet) vs "fresh"
+# (the view was current and still wrong — look at torn restores or
+# reconcile lag instead; docs/observability.md "Divergence triage").
+# --------------------------------------------------------------------------
+
+AUDIT_JOINED = Counter(
+    "kvtpu_audit_joined_total",
+    "Prediction/outcome pairs joined by the collector audit leg",
+    ["pod"],
+)
+AUDIT_MISPREDICTED_BLOCKS = Counter(
+    "kvtpu_audit_mispredicted_blocks_total",
+    "Abs(predicted - realized) hit blocks, attributed by score-time staleness",
+    ["pod", "cause"],  # stale|fresh
+)
+AUDIT_REGRETS = Counter(
+    "kvtpu_audit_regret_total",
+    "Joined requests where another pod's calibrated prediction beat the "
+    "chosen pod's realized hit",
+    ["pod"],  # the chosen (losing) pod
+)
+AUDIT_REGRET_BLOCKS = Counter(
+    "kvtpu_audit_regret_blocks_total",
+    "Estimated hit blocks forgone to routing regret",
+    ["pod"],
+)
+AUDIT_DROPPED_RECORDS = Counter(
+    "kvtpu_audit_dropped_records_total",
+    "Audit records evicted from a pod's ring before any /debug/audit pull",
+)
+
+
+def record_audit_join(pod: str, error_blocks: float, cause: str) -> None:
+    AUDIT_JOINED.labels(pod).inc()
+    if error_blocks > 0:
+        AUDIT_MISPREDICTED_BLOCKS.labels(pod, cause).inc(error_blocks)
+
+
+def record_audit_regret(pod: str, blocks: float) -> None:
+    AUDIT_REGRETS.labels(pod).inc()
+    if blocks > 0:
+        AUDIT_REGRET_BLOCKS.labels(pod).inc(blocks)
+
+
+def record_audit_dropped(count: int) -> None:
+    if count > 0:
+        AUDIT_DROPPED_RECORDS.inc(count)
+
+
+# --------------------------------------------------------------------------
+# Continuous index-divergence audit (kvtpu_index_divergence_*): the
+# always-on sampled XOR-digest audit (recovery.reconcile.DivergenceAuditor)
+# compares each pod's indexed view against ground truth WITHOUT repairing.
+# Phantom blocks: the index advertises them but the engine lacks them
+# (routing overshoots — realized hits fall short of predictions). Ghost
+# blocks: the engine holds them unindexed (routing undershoots — capacity
+# the scorer never sees). The checked/divergent counters feed the
+# ``index_divergence`` SLI burn windows in the fleet collector; the age
+# histogram observes how long each divergence episode lasted when it
+# healed (reconcile or natural convergence).
+# --------------------------------------------------------------------------
+
+DIVERGENCE_CHECKED = Counter(
+    "kvtpu_index_divergence_checked_total",
+    "Divergence-audit pod checks (one per pod per audit round)",
+    ["pod"],
+)
+DIVERGENCE_DIVERGENT = Counter(
+    "kvtpu_index_divergence_divergent_total",
+    "Audit rounds where a pod's indexed view diverged from ground truth",
+    ["pod"],
+)
+DIVERGENCE_PHANTOM_BLOCKS = Gauge(
+    "kvtpu_index_divergence_phantom_blocks",
+    "Blocks the index advertises on a pod that the engine lacks",
+    ["pod"],
+)
+DIVERGENCE_GHOST_BLOCKS = Gauge(
+    "kvtpu_index_divergence_ghost_blocks",
+    "Blocks an engine holds that its pod's index view is missing",
+    ["pod"],
+)
+DIVERGENCE_AGE_SECONDS = Histogram(
+    "kvtpu_index_divergence_age_seconds",
+    "Duration of a divergence episode at the audit round that saw it heal",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+
+
+def record_divergence_audit(pod: str, divergent: bool,
+                            phantom: int, ghost: int) -> None:
+    DIVERGENCE_CHECKED.labels(pod).inc()
+    if divergent:
+        DIVERGENCE_DIVERGENT.labels(pod).inc()
+    DIVERGENCE_PHANTOM_BLOCKS.labels(pod).set(max(phantom, 0))
+    DIVERGENCE_GHOST_BLOCKS.labels(pod).set(max(ghost, 0))
+
+
+def record_divergence_healed(age_s: float) -> None:
+    DIVERGENCE_AGE_SECONDS.observe(max(age_s, 0.0))
+
+
+# --------------------------------------------------------------------------
 # Cache-efficiency ledger export (kvtpu_cache_ledger_*): the per-pod
 # appearance/win/stored/evicted attribution the Indexer already keeps
 # (scoring.indexer.CacheEfficiencyLedger), exported as metric families via

@@ -290,6 +290,14 @@ class Request:
     # resilience.shedding priority class: sheds lowest-first under
     # admission overload.
     priority: int = PRIORITY_NORMAL
+    # Ground-truth audit (telemetry/audit.py): the ScoreFeedback this
+    # request was routed on (duck-typed, None when the scheduler passed
+    # none), the HBM prefix hit at admission, and blocks restored from
+    # the storage/transfer tier since — together they decompose the
+    # realized prefix outcome emitted at prefill finish.
+    feedback: Any = None
+    hbm_hit_blocks: int = 0
+    restored_blocks: int = 0
 
     @property
     def total_len(self) -> int:
@@ -1066,6 +1074,9 @@ class MiniEngine:
         # Working-set analytics: None until attach_workingset wires a
         # telemetry.workingset.WorkingSetTracker (same guard style).
         self.workingset = None
+        # Ground-truth audit: None until attach_audit wires a
+        # telemetry.audit.AuditLog (same guard style).
+        self.audit = None
         self._telemetry_pools: list[tuple[str, BlockManager]] = []
         tcfg = self.cfg.telemetry
         if tcfg is not None and getattr(tcfg, "enabled", True):
@@ -1132,6 +1143,15 @@ class MiniEngine:
         if self.offload_manager is not None:
             self.offload_manager.workingset = tracker
 
+    def attach_audit(self, audit_log) -> None:
+        """Wire a :class:`~..telemetry.audit.AuditLog`: every admitted
+        request's realized prefix outcome (HBM hit vs restored vs
+        recomputed blocks) is recorded at prefill finish, tagged with the
+        request's traceparent and the :class:`ScoreFeedback` it was
+        routed on, for the fleet collector's score-vs-reality join
+        (``/debug/audit``)."""
+        self.audit = audit_log
+
     def add_request(self, request_id: str, prompt: Sequence[int],
                     max_new_tokens: int = 16) -> Request:
         """Admit a request: acquire cached prefix pages, allocate the rest,
@@ -1167,7 +1187,8 @@ class MiniEngine:
                 traceparent: Optional[str] = None,
                 handoff: bool = False,
                 deadline_s: Optional[float] = None,
-                priority: int = PRIORITY_NORMAL) -> Request:
+                priority: int = PRIORITY_NORMAL,
+                feedback=None) -> Request:
         """Admit a request for continuous batching: pages are acquired and
         the storage tier consulted from ``step()``, where prefill runs
         chunk-at-a-time interleaved with decode — a long prompt stalls
@@ -1197,6 +1218,12 @@ class MiniEngine:
         (``cfg.shed_target_delay_s``), sustained admission delay sheds
         non-critical requests (:class:`OverloadShedError`) and browns out
         the rest — admitted, but without the storage-restore attempt.
+
+        ``feedback`` (a ``services.indexer_service.ScoreFeedback``, or
+        any object with its fields) is the prediction this request was
+        routed on; with an :meth:`attach_audit` log it rides the realized
+        outcome record so the fleet collector can score the prediction
+        even when the scorer's own ring already evicted it.
         """
         brownout = False
         if self.shedder is not None:
@@ -1232,6 +1259,7 @@ class MiniEngine:
             else current_deadline()
         )
         req.priority = priority
+        req.feedback = feedback
         if brownout and req.restore_pending:
             # Brownout: admitted, but skip the storage-tier restore —
             # under queue pressure the offload round trip is the first
@@ -1299,6 +1327,7 @@ class MiniEngine:
         req.pages = list(cached_pages)
         req.cached_len = len(cached_pages) * page_size
         req.computed_len = req.cached_len
+        req.hbm_hit_blocks = len(cached_pages)
         if self.workingset is not None:
             # Admission is the HBM tier's reuse stream: one access per
             # prompt block, hits = the resident prefix length.
@@ -1371,6 +1400,8 @@ class MiniEngine:
         req.output.append(first_token)
         if self.telemetry is not None:
             self.telemetry.on_first_token(req.request_id)
+        if self.audit is not None:
+            self._emit_audit_outcome(req)
         if self.cfg.role == "prefill" and self.handoff is not None:
             # Prefill pod: the request's life here ends at first token —
             # every full block is now committed (the final chunk's store
@@ -1386,6 +1417,45 @@ class MiniEngine:
         if req.max_new_tokens <= 1:
             req.done = True
             self._finish(req)
+
+    def _emit_audit_outcome(self, req: Request) -> None:
+        """Best-effort ground-truth emission at prefill finish: the
+        realized prefix decomposition (HBM hit at admission, restored
+        since, recomputed remainder) into the attached AuditLog plus a
+        KIND_AUDIT flight record. Never interferes with serving."""
+        page_size = self.cfg.model.page_size
+        total = len(req.block_hashes)
+        realized = min(req.cached_len // page_size, total)
+        hbm = min(req.hbm_hit_blocks, realized)
+        restored = min(req.restored_blocks, realized - hbm)
+        recomputed = max(total - realized, 0)
+        try:
+            self.audit.record_outcome(
+                traceparent=req.traceparent,
+                request_id=req.request_id,
+                pod=self.cfg.pod_identifier,
+                total_blocks=total,
+                hbm_blocks=hbm,
+                restored_blocks=restored,
+                recomputed_blocks=recomputed,
+                feedback=req.feedback,
+            )
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
+        try:
+            from ..telemetry.flight_recorder import KIND_AUDIT, record
+
+            record(KIND_AUDIT, {
+                "op": "outcome",
+                "request_id": req.request_id,
+                "pod": self.cfg.pod_identifier,
+                "total_blocks": total,
+                "hbm_blocks": hbm,
+                "restored_blocks": restored,
+                "recomputed_blocks": recomputed,
+            })
+        except Exception:  # pragma: no cover  # lint: allow-swallow
+            pass
 
     def _sync_caches_to_copier(self) -> None:
         """Hand the current (possibly donated-and-replaced) cache arrays to
@@ -1473,6 +1543,7 @@ class MiniEngine:
         req.pages.extend(canonical)
         req.cached_len += len(canonical) * page_size
         req.computed_len = req.cached_len
+        req.restored_blocks += len(canonical)
 
     def _observe_restore_latency(self, elapsed: float) -> None:
         """Fold a successful restore's wall time into the EMA the
@@ -1601,6 +1672,7 @@ class MiniEngine:
         req.pages[first_missing:first_missing + len(canonical)] = canonical
         req.cached_len = (first_missing + len(canonical)) * page_size
         req.computed_len = max(req.computed_len, req.cached_len)
+        req.restored_blocks += len(canonical)
         req.committed_blocks = max(req.committed_blocks,
                                    first_missing + len(canonical))
         req.prefill_pos = min(req.cached_len, len(req.prompt) - 1)
@@ -1750,6 +1822,7 @@ class MiniEngine:
         req.swa_pages.extend(canonical1)
         req.cached_len = depth_end * page_size
         req.computed_len = req.cached_len
+        req.restored_blocks += len(canonical0)
         # Blocks acquired for the OLD depth that now sit out of window
         # return to the pool (refs drop; table slots go to garbage).
         self._swa_reclaim(req)

@@ -31,6 +31,14 @@ class RecoveryConfig:
     # Anti-entropy digest-exchange cadence; <= 0 disables the loop (it
     # also needs a digest source wired in, see recovery.reconcile).
     reconcile_interval_s: float = 0.0
+    # Continuous divergence-audit cadence (recovery.reconcile.
+    # DivergenceAuditor — digest compare without repair, feeding the
+    # kvtpu_index_divergence_* families and the index_divergence SLI);
+    # <= 0 keeps it manual. Shares the reconciler's digest source.
+    divergence_audit_interval_s: float = 0.0
+    # Fraction of pods each divergence-audit round checks (rotating
+    # coverage); 1.0 audits every pod every round.
+    divergence_audit_sample: float = 1.0
     # Journal fsync cadence in records (1 = every append; higher trades
     # the crash-loss window for ingest throughput).
     journal_sync_every: int = 64
@@ -57,6 +65,14 @@ class RecoveryConfig:
             ),
             reconcile_interval_s=d.get(
                 "reconcileIntervalS", d.get("reconcile_interval_s", 0.0)
+            ),
+            divergence_audit_interval_s=d.get(
+                "divergenceAuditIntervalS",
+                d.get("divergence_audit_interval_s", 0.0)
+            ),
+            divergence_audit_sample=d.get(
+                "divergenceAuditSample",
+                d.get("divergence_audit_sample", 1.0)
             ),
             journal_sync_every=d.get(
                 "journalSyncEvery", d.get("journal_sync_every", 64)

@@ -27,11 +27,12 @@ discovery).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Protocol
 
 from ..core.keys import KeyType, PodEntry
 from ..telemetry import flight_recorder, tracer
-from ..telemetry.flight_recorder import KIND_RECOVERY
+from ..telemetry.flight_recorder import KIND_AUDIT, KIND_RECOVERY
 from ..utils.cbor import canonical_cbor_encode
 from ..utils.logging import get_logger
 
@@ -229,6 +230,156 @@ class AntiEntropyReconciler:
 
         self._thread = threading.Thread(
             target=_loop, name="kvtpu-anti-entropy", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class DivergenceAuditor:
+    """Always-on sampled divergence audit: digest compare, no repair.
+
+    The reconciler above *fixes* divergence but only tells you about it
+    after the fact (flight record + repair counters); by then the SLI
+    question — "how long was routing running on a wrong view, and on
+    which pod?" — is unanswerable. This auditor runs the same XOR-digest
+    comparison continuously WITHOUT repairing, so divergence is a
+    measured condition rather than a repair side effect:
+
+    - per pod: **phantom** blocks (the index advertises them, the
+      engine's truth lacks them — scores overshoot) and **ghost** blocks
+      (the engine holds them unindexed — scores undershoot), exported as
+      ``kvtpu_index_divergence_*`` gauges;
+    - checked/divergent counters per round, the feed for the
+      ``index_divergence`` SLI burn windows in the fleet collector;
+    - a divergence-age histogram observed when an episode heals (repair
+      or natural event-stream convergence), plus a :data:`KIND_AUDIT`
+      flight record at each divergence onset and heal.
+
+    ``sample`` audits only that fraction of pods per round (rotating, so
+    every pod is still covered within ``1/sample`` rounds) — the digest
+    is cheap but ``dump_state()`` on a huge index is not free. Repair
+    stays the reconciler's job; deployments typically run both off the
+    same :class:`DigestSource`.
+    """
+
+    def __init__(self, index, source: DigestSource, interval_s: float = 10.0,
+                 sample: float = 1.0, clock=time.time):
+        self.index = index
+        self.source = source
+        self.interval_s = interval_s
+        self.sample = min(max(sample, 0.0), 1.0) or 1.0
+        self.rounds = 0
+        self._clock = clock
+        self._cursor = 0
+        # pod -> episode-start ts, for the divergence-age histogram.
+        self._since: dict[str, float] = {}
+        # pod -> {"phantom": n, "ghost": n} as of its last audited round.
+        self._last: dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _pods_this_round(self, pods: list) -> list:
+        if not pods or self.sample >= 1.0:
+            return pods
+        n = max(1, int(len(pods) * self.sample))
+        start = self._cursor % len(pods)
+        self._cursor = (start + n) % len(pods)
+        return [pods[(start + i) % len(pods)] for i in range(n)]
+
+    def audit_once(self) -> dict:
+        """One audit round; returns its stats (and never mutates the index)."""
+        self.rounds += 1
+        now = self._clock()
+        divergent: dict[str, dict] = {}
+        with tracer().span("llm_d.kv_cache.recovery.divergence_audit") as span:
+            state = self.index.dump_state()
+            pods = set(self.source.pods())
+            if state:
+                for _rk, rows in state.get("entries", []):
+                    for row in rows:
+                        pods.add(row[0])
+            audited = self._pods_this_round(sorted(pods))
+            for pod in audited:
+                local = pod_blocks_from_state(state, pod)
+                phantom = 0
+                ghost = 0
+                if digest_from_blocks(local) != self.source.digest(pod):
+                    remote = self.source.blocks(pod)
+                    for rk, rows in local.items():
+                        phantom += len(rows - remote.get(rk, set()))
+                    for rk, rows in remote.items():
+                        ghost += len(rows - local.get(rk, set()))
+                is_div = bool(phantom or ghost)
+                if is_div:
+                    divergent[pod] = {"phantom": phantom, "ghost": ghost}
+                    if pod not in self._since:
+                        self._since[pod] = now
+                        flight_recorder().record(KIND_AUDIT, {
+                            "op": "divergence_onset", "pod": pod,
+                            "phantom": phantom, "ghost": ghost,
+                        })
+                elif pod in self._since:
+                    age = max(now - self._since.pop(pod), 0.0)
+                    flight_recorder().record(KIND_AUDIT, {
+                        "op": "divergence_healed", "pod": pod,
+                        "age_s": age,
+                    })
+                    try:
+                        from ..metrics.collector import record_divergence_healed
+
+                        record_divergence_healed(age)
+                    except Exception:  # pragma: no cover - metrics never break the audit  # lint: allow-swallow
+                        pass
+                self._last[pod] = {"phantom": phantom, "ghost": ghost}
+                try:
+                    from ..metrics.collector import record_divergence_audit
+
+                    record_divergence_audit(pod, is_div, phantom, ghost)
+                except Exception:  # pragma: no cover - metrics never break the audit  # lint: allow-swallow
+                    pass
+            span.set_attribute("pods_checked", len(audited))
+            span.set_attribute("divergent", len(divergent))
+        if divergent:
+            logger.info("divergence audit: %d pod(s) divergent: %s",
+                        len(divergent), sorted(divergent))
+        return {
+            "pods_checked": len(audited),
+            "divergent": divergent,
+        }
+
+    def debug_view(self) -> dict:
+        """JSON-able state for ``/debug/vars`` / kvdiag."""
+        now = self._clock()
+        return {
+            "rounds": self.rounds,
+            "interval_s": self.interval_s,
+            "sample": self.sample,
+            "divergent_now": {
+                pod: {**self._last.get(pod, {}),
+                      "age_s": round(max(now - since, 0.0), 3)}
+                for pod, since in self._since.items()
+            },
+        }
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def _loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.audit_once()
+                except Exception:
+                    logger.exception("divergence audit round failed; continuing")
+
+        self._thread = threading.Thread(
+            target=_loop, name="kvtpu-divergence-audit", daemon=True
         )
         self._thread.start()
 

@@ -290,6 +290,9 @@ class Indexer:
         # Working-set analytics: None until attach_workingset wires a
         # telemetry.workingset.WorkingSetTracker into the lookup path.
         self.workingset = None
+        # Ground-truth audit: None until attach_audit wires a
+        # telemetry.audit.AuditLog into the score path.
+        self.audit = None
 
     def prefix_cache_stats(self) -> Optional[dict]:
         """Token-processor prefix-cache counters (None when disabled)."""
@@ -340,6 +343,16 @@ class Indexer:
         Unsampled keys cost one dict hit each; the whole hook is gated
         <1% of score p50 by ``bench.py --workingset``."""
         self.workingset = tracker
+
+    def attach_audit(self, audit_log) -> None:
+        """Wire a telemetry.audit.AuditLog into the score path: every
+        score decision records its prediction (per-pod scores, residency
+        bonuses, and — when the log's ``staleness_fn`` is wired — the
+        index staleness at score time) so the fleet collector can join
+        it against the serving engine's realized outcome. One ring
+        append per score call, gated <1% of score p50 by
+        ``bench.py --audit``."""
+        self.audit = audit_log
 
     def attach_liveness(self, liveness) -> None:
         """Wire the event pool's PodLivenessTracker into scoring: pods whose
@@ -457,7 +470,9 @@ class Indexer:
                     scores, block_keys, pod_identifiers, role, detail
                 )
                 self._record_score_decision(
-                    model_name, len(block_keys), hit_count, scores
+                    model_name, len(block_keys), hit_count, scores,
+                    traceparent=trace_ref[0],
+                    residency=None if detail is None else detail.get("residency"),
                 )
                 if self.workingset is not None:
                     # The fused C++ path returns no per-key pod map; the
@@ -482,7 +497,9 @@ class Indexer:
                 scores, block_keys, pod_identifiers, role, detail
             )
             self._record_score_decision(
-                model_name, len(block_keys), len(key_to_pods), scores
+                model_name, len(block_keys), len(key_to_pods), scores,
+                traceparent=trace_ref[0],
+                residency=None if detail is None else detail.get("residency"),
             )
             if self.workingset is not None:
                 self.workingset.record_index_lookup(
@@ -543,7 +560,9 @@ class Indexer:
         if apply_res and detail is not None:
             detail["residency"] = res_bonus
         self._record_score_decision(
-            model_name, len(block_keys), hit_count, scores
+            model_name, len(block_keys), hit_count, scores,
+            traceparent=getattr(span, "traceparent", None),
+            residency=res_bonus if apply_res else None,
         )
         if self.workingset is not None:
             self.workingset.record_index_lookup(
@@ -589,12 +608,16 @@ class Indexer:
         total_blocks: int,
         hit_blocks: int,
         scores: dict[str, float],
+        traceparent: Optional[str] = None,
+        residency: Optional[dict] = None,
     ) -> None:
-        """Ledger + flight-recorder attribution for one score call.
+        """Ledger + flight-recorder + audit attribution for one score call.
 
-        Kept lean — one ledger lock, one ring store; ``scores`` is handed
-        to the recorder by reference (diagnostic surface, treated as
-        frozen), so the hot-path cost is the dict literal below.
+        Kept lean — one ledger lock, one ring store (plus one audit ring
+        append when an AuditLog is attached); ``scores`` is handed to the
+        recorder and the audit log by reference (diagnostic surfaces,
+        treated as frozen), so the hot-path cost is the dict literal
+        below.
         """
         self.ledger.record_score(scores, total_blocks, hit_blocks)
         self._recorder.record(
@@ -606,3 +629,10 @@ class Indexer:
                 "scores": scores,
             },
         )
+        if self.audit is not None:
+            winner = max(scores, key=scores.get) if scores else None
+            self.audit.record_prediction(
+                traceparent, model_name, total_blocks,
+                scores[winner] if winner is not None else 0.0,
+                scores, residency,
+            )

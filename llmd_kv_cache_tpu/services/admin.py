@@ -32,6 +32,13 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
   :meth:`AdminServer.register_slo_source`; without ``since`` it falls
   through to a plain registered ``slo`` level-state provider). The fleet
   controller consumes this to react to each alert transition once.
+- ``/debug/audit?since=SEQ`` — ground-truth audit records (score-time
+  predictions, engine-realized outcomes) from the process's
+  ``telemetry.audit.AuditLog`` ring, same cursor semantics as
+  ``/debug/spans`` (404 until :meth:`AdminServer.register_audit_source`;
+  without ``since`` it falls through to a plain registered ``audit``
+  provider — the collector's joined calibration/regret view). The fleet
+  telemetry collector pulls this to join predictions to outcomes.
 - ``POST /debug/<name>`` — guarded mutation endpoints (e.g. ``role``,
   ``drain``): 404 until the owner registers a handler via
   :meth:`AdminServer.register_action`; parameters ride the query string.
@@ -88,6 +95,7 @@ class AdminServer:
         self._pyprof_capture: Optional[Callable[[float], dict]] = None
         self._workingset_source: Optional[Callable[[int], dict]] = None
         self._slo_source: Optional[Callable[[int], dict]] = None
+        self._audit_source: Optional[Callable[[int], dict]] = None
         self._actions: dict[str, Callable[[Mapping[str, str]], dict]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -136,6 +144,17 @@ class AdminServer:
         through to a registered plain ``slo`` provider (level state), so
         existing consumers keep working."""
         self._slo_source = source
+
+    def register_audit_source(self, source: Callable[[int], dict]) -> None:
+        """Enable ``/debug/audit?since=``: ``source(since_seq)`` returns the
+        audit ring's ``export_since`` payload (prediction/outcome records
+        + cursor + drops), same cursor semantics as ``/debug/spans``.
+        Typically ``telemetry.audit.AuditLog.export_since``. 404 until
+        set — the audit plane is opt-in per pod
+        (``fleetTelemetry.audit``). Without ``since`` the endpoint falls
+        through to a plain registered ``audit`` provider (the collector's
+        joined calibration view), mirroring ``/debug/slo``."""
+        self._audit_source = source
 
     def register_action(
             self, name: str,
@@ -250,6 +269,23 @@ class AdminServer:
         return (200, json.dumps(payload, default=repr).encode(),
                 "application/json")
 
+    def _handle_audit(self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
+        if self._audit_source is None:
+            return (404, b'{"error": "audit export not configured"}',
+                    "application/json")
+        raw = query.get("since", ["-1"])[-1]
+        try:
+            since = int(raw)
+        except ValueError:
+            return (400, json.dumps(
+                {"error": f"bad since: {raw!r}"}).encode(), "application/json")
+        try:
+            payload = self._audit_source(since)
+        except Exception as exc:
+            return 500, json.dumps({"error": str(exc)}).encode(), "application/json"
+        return (200, json.dumps(payload, default=repr).encode(),
+                "application/json")
+
     def _handle_pyprof_capture(
             self, query: Mapping[str, list]) -> tuple[int, bytes, str]:
         if self._pyprof_capture is None:
@@ -359,6 +395,17 @@ class AdminServer:
             if path == "/debug/slo" and self._slo_source is not None and (
                     "since" in (query or {}) or "slo" not in self._providers):
                 return self._handle_slo(query or {})
+            # Same dual shape as /debug/slo: with ?since= (or no plain
+            # "audit" provider) the cursor record export answers; else the
+            # registered provider (the collector's joined view) does. An
+            # unconfigured pod 404s either way (collector pulls tolerate).
+            if path == "/debug/audit" and (
+                    self._audit_source is not None
+                    and ("since" in (query or {})
+                         or "audit" not in self._providers)
+                    or self._audit_source is None
+                    and "audit" not in self._providers):
+                return self._handle_audit(query or {})
             if path == "/debug/flight-recorder":
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"

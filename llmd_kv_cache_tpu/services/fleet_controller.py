@@ -120,6 +120,7 @@ class RemoteSignalSource:
         edges: tuple = ()
         dominant: dict = {}
         whatif: tuple = ()
+        audit: dict = {}
         if self.collector_address:
             level = self._get(self.collector_address, "/debug/slo") or {}
             for name, st in level.items():
@@ -152,6 +153,10 @@ class RemoteSignalSource:
                         }
             ws = self._get(self.collector_address, "/debug/workingset") or {}
             whatif = tuple(ws.get("whatif") or ())
+            # The collector's /debug/audit is the joined score-vs-reality
+            # view (pods serve their raw rings under the same path); an
+            # older collector without it degrades to no audit signal.
+            audit = self._get(self.collector_address, "/debug/audit") or {}
         roles: Dict[str, str] = {}
         handoff: dict = {}
         for pod, address in self.pod_admin.items():
@@ -185,6 +190,7 @@ class RemoteSignalSource:
             dominant_segment=dominant,
             handoff=handoff,
             whatif=whatif,
+            audit=audit,
             shards=tuple(self._shards()),
             roles=roles,
         )

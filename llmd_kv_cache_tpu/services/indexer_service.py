@@ -38,6 +38,7 @@ from ..recovery.manager import RecoveryManager
 from ..recovery.reconcile import (
     AntiEntropyReconciler,
     DigestSource,
+    DivergenceAuditor,
     IndexDigestSource,
     digest_from_blocks,
     pod_blocks_from_state,
@@ -275,6 +276,90 @@ class ScoreResponse:
         )
 
 
+@dataclass
+class ScoreFeedback:
+    """The prediction a request was routed on, carried to the engine.
+
+    A scheduler that routes on a :class:`ScoreResponse` builds one of
+    these (:meth:`from_response`) and hands it to the chosen engine's
+    ``enqueue(..., feedback=...)``; the engine attaches it to the
+    realized prefix outcome it records at prefill finish
+    (telemetry/audit.py), closing the score→serve loop. Every field
+    follows the ``ScoreResponse.residency`` tolerance pattern — absent
+    on the wire from older peers, ignored by them on receive — so a
+    mixed-version fleet degrades to "no calibration for that hop", never
+    a decode error.
+    """
+
+    # W3C traceparent of the scoring span — the join key the collector
+    # matches predictions to outcomes on.
+    traceparent: str = ""
+    # The pod the scheduler actually chose (not necessarily the top
+    # score — affinity/load tie-breaks are the scheduler's business).
+    chosen_pod: str = ""
+    # The chosen pod's predicted prefix score, in block units
+    # (tier-weighted, so fractional).
+    predicted_blocks: float = 0.0
+    # Prompt length in canonical blocks at score time.
+    total_blocks: int = 0
+    # The full per-pod score map — the routing-regret counterfactual
+    # needs the losing pods' predictions too.
+    scores: dict[str, float] = field(default_factory=dict)
+    # Per-pod transferred-prefix residency bonus (ScoreResponse.residency).
+    residency: dict[str, float] = field(default_factory=dict)
+    # Index staleness (event lag) at score time, for staleness-attributed
+    # calibration error.
+    staleness_s: float = 0.0
+
+    @classmethod
+    def from_response(cls, resp: "ScoreResponse", chosen_pod: str,
+                      total_blocks: int = 0,
+                      staleness_s: float = 0.0) -> "ScoreFeedback":
+        """Build feedback from the response a scheduler routed on."""
+        return cls(
+            traceparent=resp.traceparent,
+            chosen_pod=chosen_pod,
+            predicted_blocks=float(resp.scores.get(chosen_pod, 0.0)),
+            total_blocks=total_blocks,
+            scores=dict(resp.scores),
+            residency=dict(resp.residency),
+            staleness_s=staleness_s,
+        )
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb(
+            {"traceparent": self.traceparent,
+             "chosen_pod": self.chosen_pod,
+             "predicted_blocks": self.predicted_blocks,
+             "total_blocks": self.total_blocks,
+             "scores": self.scores,
+             "residency": self.residency,
+             "staleness_s": self.staleness_s},
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "ScoreFeedback":
+        d = msgpack.unpackb(b, raw=False)
+        try:
+            predicted = float(d.get("predicted_blocks", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            predicted = 0.0
+        try:
+            staleness = float(d.get("staleness_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            staleness = 0.0
+        return cls(
+            traceparent=d.get("traceparent", "") or "",
+            chosen_pod=d.get("chosen_pod", "") or "",
+            predicted_blocks=predicted,
+            total_blocks=int(d.get("total_blocks", 0) or 0),
+            scores=dict(d.get("scores", {})),
+            residency=dict(d.get("residency", {})),
+            staleness_s=staleness,
+        )
+
+
 class IndexerService:
     """Assembles indexer + event pool + subscribers; serves GetPodScores."""
 
@@ -338,6 +423,14 @@ class IndexerService:
                 rc, self.indexer.kv_block_index, self.pool
             )
         self._reconciler: Optional[AntiEntropyReconciler] = None
+        # Always-on sampled divergence audit (recovery.reconcile.
+        # DivergenceAuditor) — shares the reconciler's digest source but
+        # never repairs, only measures (kvtpu_index_divergence_*).
+        self._divergence_auditor: Optional[DivergenceAuditor] = None
+        # Ground-truth audit ring (telemetry/audit.py): score-time
+        # predictions recorded by the Indexer, exported at /debug/audit.
+        # Created in start() when fleetTelemetry.audit is set.
+        self.audit_log = None
         self._drain_coordinator: Optional[DrainCoordinator] = None
         # Adaptive overload shedding (resilience.shedding): serving delay
         # feeds a CoDel controller; under sustained overload low-priority
@@ -414,12 +507,31 @@ class IndexerService:
         self._reconciler = AntiEntropyReconciler(
             self.indexer.kv_block_index, source, interval_s=interval
         )
+        # The continuous divergence auditor shares the same digest source
+        # but is repair-free: it measures phantom/ghost block counts and
+        # divergence age so the index_divergence SLI sees drift the
+        # reconciler hasn't (or can't) repair yet.
+        self._divergence_auditor = DivergenceAuditor(
+            self.indexer.kv_block_index,
+            source,
+            interval_s=(rc.divergence_audit_interval_s
+                        if rc is not None else 0.0),
+            sample=(rc.divergence_audit_sample if rc is not None else 1.0),
+        )
 
     def reconcile_now(self) -> dict:
         """One manual anti-entropy round (admin/testing aid)."""
         if self._reconciler is None:
             raise RuntimeError("no digest source attached (attach_digest_source)")
         return self._reconciler.reconcile_once()
+
+    def audit_now(self) -> dict:
+        """One manual divergence-audit round (admin/testing aid) —
+        digest compare without repair, emitting the
+        kvtpu_index_divergence_* families."""
+        if self._divergence_auditor is None:
+            raise RuntimeError("no digest source attached (attach_digest_source)")
+        return self._divergence_auditor.audit_once()
 
     def start(self) -> None:
         """Start the event plane: workers plus, in centralized mode, a
@@ -466,6 +578,11 @@ class IndexerService:
             health = self.recovery.health
         if self._reconciler is not None and self._reconciler.interval_s > 0:
             self._reconciler.start()
+        if (self._divergence_auditor is not None
+                and self._divergence_auditor.interval_s > 0):
+            self._divergence_auditor.start()
+        if self._divergence_auditor is not None:
+            providers["divergence_audit"] = self._divergence_auditor.debug_view
         self._observability_servers = start_observability_servers(
             self.indexer.config.metrics_port,
             self.indexer.config.admin_port,
@@ -506,6 +623,22 @@ class IndexerService:
                     server.register_workingset_source(tracker.export_since)
                     server.register_debug("workingset_state",
                                           tracker.debug_view)
+            # Ground-truth audit: the Indexer records every score decision
+            # (prediction + staleness at score time) into a ring exported
+            # at /debug/audit; the collector joins these against engine
+            # outcomes for score-vs-reality calibration.
+            if ft.audit:
+                from ..telemetry.audit import AuditLog
+
+                self.audit_log = AuditLog(
+                    capacity=ft.audit_max_records,
+                    staleness_fn=self.pool.index_staleness_s,
+                )
+                self.indexer.attach_audit(self.audit_log)
+                for server in self._observability_servers:
+                    server.register_audit_source(self.audit_log.export_since)
+                    server.register_debug("audit_state",
+                                          self.audit_log.debug_view)
 
     def stop(self) -> None:
         for server in self._observability_servers:
@@ -515,6 +648,8 @@ class IndexerService:
             self._central_subscriber.stop()
         if self._reconciler is not None:
             self._reconciler.stop()
+        if self._divergence_auditor is not None:
+            self._divergence_auditor.stop()
         self.subscriber_manager.shutdown()
         if self.recovery is not None:
             # Final snapshot happens before the pool stops so lag_stats
@@ -538,6 +673,8 @@ class IndexerService:
                 stoppers.append(self._central_subscriber.stop)
             if self._reconciler is not None:
                 stoppers.append(self._reconciler.stop)
+            if self._divergence_auditor is not None:
+                stoppers.append(self._divergence_auditor.stop)
             coordinator = self._drain_coordinator = DrainCoordinator(
                 deadline_s=deadline,
                 intake_stoppers=stoppers,

@@ -52,6 +52,7 @@ from ..telemetry.rollup import (
     parse_exposition,
     rollup_percentiles,
 )
+from ..telemetry.audit import AuditJoiner
 from ..telemetry.sampling_profiler import merge_folded, span_function_shares
 from ..telemetry.slo import SLOConfig, SLORegistry
 from ..telemetry.workingset import merge_workingset_windows, whatif_table
@@ -94,6 +95,11 @@ FLEET_WORKINGSET_WINDOWS = Counter(
 FLEET_TYPE_CONFLICTS = Counter(
     "kvtpu_fleet_metric_type_conflicts_total",
     "Metric families skipped by the rollup because pods disagreed on TYPE",
+)
+FLEET_AUDIT_RECORDS = Counter(
+    "kvtpu_fleet_audit_records_total",
+    "Audit records (predictions + outcomes) pulled from pod /debug/audit "
+    "endpoints",
 )
 
 # Fleet-level serving histograms worth rolling up, per role.
@@ -156,6 +162,20 @@ class CollectorConfig:
     workingset_enabled: bool = True
     workingset_max_windows: int = 240
     whatif_factors: Tuple[float, ...] = (0.5, 1.0, 2.0, 4.0)
+    # Ground-truth audit leg: pull /debug/audit records (404 from a pod
+    # without the audit ring is tolerated, same as pyprof) and join
+    # predictions to realized outcomes per trace — calibration curves,
+    # staleness-attributed error, and the routing-regret counterfactual.
+    audit_enabled: bool = True
+    # Score-time index staleness above this attributes a misprediction to
+    # "stale" (event lag) rather than "fresh" (model error).
+    audit_stale_threshold_s: float = 1.0
+    # A losing pod's calibrated estimate must beat the chosen pod's
+    # realized hit by this many blocks before a regret is charged.
+    audit_regret_margin_blocks: float = 0.5
+    # index_divergence SLI: fraction of divergence-audit pod-checks that
+    # found the advertised index matching engine truth.
+    divergence_objective: float = 0.999
     fast_windows: Tuple[float, float] = (300.0, 3600.0)
     slow_window: float = 21600.0
     fast_threshold: float = 14.4
@@ -228,6 +248,17 @@ class CollectorConfig:
             whatif_factors=tuple(
                 float(f) for f in
                 k("whatifFactors", "whatif_factors", d.whatif_factors)),
+            audit_enabled=bool(
+                k("auditEnabled", "audit_enabled", d.audit_enabled)),
+            audit_stale_threshold_s=float(
+                k("auditStaleThresholdS", "audit_stale_threshold_s",
+                  d.audit_stale_threshold_s)),
+            audit_regret_margin_blocks=float(
+                k("auditRegretMarginBlocks", "audit_regret_margin_blocks",
+                  d.audit_regret_margin_blocks)),
+            divergence_objective=float(
+                k("divergenceObjective", "divergence_objective",
+                  d.divergence_objective)),
             fast_windows=(float(fast[0]), float(fast[1])),
             slow_window=float(k("slowWindow", "slow_window", d.slow_window)),
             fast_threshold=float(
@@ -518,6 +549,7 @@ class _TargetState:
     span_cursor: int = -1
     pyprof_cursor: int = -1
     workingset_cursor: int = -1
+    audit_cursor: int = -1
     reachable: bool = False
     families: Dict[str, MetricFamily] = field(default_factory=dict)
     last_hist_counts: Dict[str, Tuple[float, float]] = field(default_factory=dict)
@@ -584,6 +616,17 @@ class TelemetryCollector:
             name="availability",
             objective=config.availability_objective,
             description="scrape target reachable", **windows))
+        self.slos.add(SLOConfig(
+            name="index_divergence",
+            objective=config.divergence_objective,
+            description="divergence audit finds index matching engine "
+                        "truth", **windows))
+        # Score-vs-reality join: predictions and outcomes pulled from the
+        # pod audit rings land here, keyed by trace id.
+        self.joiner = AuditJoiner(
+            stale_threshold_s=config.audit_stale_threshold_s,
+            regret_margin_blocks=config.audit_regret_margin_blocks,
+        )
         self._profile_lock = new_lock()
         self._profile_windows: deque = deque(
             maxlen=max(1, config.pyprof_max_windows))
@@ -677,6 +720,22 @@ class TelemetryCollector:
                     ws.get("next_seq", state.workingset_cursor))
             except Exception as exc:
                 logger.debug("workingset pull from %s skipped: %s", name, exc)
+        # Audit leg: same enrichment contract — a 404 from a pod without
+        # the audit ring (fleetTelemetry.audit off) never trips the
+        # breaker. Records feed the score-vs-reality joiner.
+        if self.cfg.audit_enabled:
+            try:
+                audit_raw = self._fetch(
+                    f"{base}/debug/audit?since={state.audit_cursor}")
+                audit = json.loads(audit_raw)
+                records = audit.get("records", [])
+                if records:
+                    self.joiner.ingest(records)
+                    FLEET_AUDIT_RECORDS.inc(len(records))
+                state.audit_cursor = int(
+                    audit.get("next_seq", state.audit_cursor))
+            except Exception as exc:
+                logger.debug("audit pull from %s skipped: %s", name, exc)
         return True
 
     # -- SLI extraction ----------------------------------------------------
@@ -739,6 +798,54 @@ class TelemetryCollector:
                         bad=int(round(d_total - d_under)),
                     )
 
+    def _feed_divergence_sli(self) -> None:
+        """Per-round good/bad deltas from the divergence-audit counters.
+
+        Each pod-check the auditor runs increments
+        ``kvtpu_index_divergence_checked_total{pod=...}`` and, when the
+        advertised index disagreed with engine truth,
+        ``..._divergent_total{pod=...}``. Good = checks that matched, bad
+        = checks that diverged; deltas are per (target, pod) against the
+        previous scrape so restarts reset cleanly (same bookkeeping as
+        :meth:`_feed_latency_slis`).
+        """
+        tracker = self.slos.get("index_divergence")
+        if tracker is None:
+            return
+        for state in self._targets:
+            # prometheus_client stamps the counter TYPE line with the
+            # ``_total`` suffix, so parse_exposition keys the family under
+            # the suffixed name; accept the bare name too for merged or
+            # hand-written expositions.
+            checked_fam = (
+                state.families.get("kvtpu_index_divergence_checked_total")
+                or state.families.get("kvtpu_index_divergence_checked"))
+            if checked_fam is None:
+                continue
+            divergent_fam = (
+                state.families.get("kvtpu_index_divergence_divergent_total")
+                or state.families.get("kvtpu_index_divergence_divergent"))
+            div_by_pod: Dict[str, float] = {}
+            if divergent_fam is not None:
+                for (_suffix, labels), value in divergent_fam.samples.items():
+                    div_by_pod[dict(labels).get("pod", "")] = value
+            for (_suffix, labels), checked in checked_fam.samples.items():
+                pod = dict(labels).get("pod", "")
+                divergent = div_by_pod.get(pod, 0.0)
+                key = f"{state.target.name}:divergence:{pod}"
+                prev_checked, prev_div = state.last_hist_counts.get(
+                    key, (0.0, 0.0))
+                if checked < prev_checked:  # target restarted
+                    prev_checked, prev_div = 0.0, 0.0
+                d_checked = checked - prev_checked
+                d_div = min(divergent - prev_div, d_checked)
+                state.last_hist_counts[key] = (checked, divergent)
+                if d_checked > 0:
+                    tracker.record(
+                        good=int(round(d_checked - d_div)),
+                        bad=int(round(max(d_div, 0.0))),
+                    )
+
     # -- rounds ------------------------------------------------------------
 
     def scrape_once(self) -> dict:
@@ -758,6 +865,7 @@ class TelemetryCollector:
                 availability.record(
                     good=reachable, bad=len(self._targets) - reachable)
             self._feed_latency_slis()
+            self._feed_divergence_sli()
             finalized = self.assembler.finalize_idle()
             slo_state = self.slos.evaluate_all()
             self.rounds += 1
@@ -879,6 +987,36 @@ class TelemetryCollector:
                 if st.get("accesses") else 0.0)
         return merged
 
+    def audit_view(self) -> dict:
+        """Score-vs-reality audit: the joiner's calibration/regret state
+        plus the fleet's current divergence picture (phantom/ghost block
+        gauges per pod, straight from the targets' last expositions).
+
+        This is what the collector serves at ``/debug/audit`` (the pods'
+        same-named endpoint serves the raw record ring instead) and what
+        ``kvdiag --fleet`` prints as the audit section.
+        """
+        out = self.joiner.view()
+        divergence: Dict[str, dict] = {}
+        for state in self._targets:
+            for fam_name, field_name in (
+                    ("kvtpu_index_divergence_phantom_blocks", "phantom"),
+                    ("kvtpu_index_divergence_ghost_blocks", "ghost")):
+                fam = state.families.get(fam_name)
+                if fam is None:
+                    continue
+                for (_suffix, labels), value in fam.samples.items():
+                    pod = dict(labels).get("pod", "")
+                    entry = divergence.setdefault(
+                        pod, {"phantom": 0.0, "ghost": 0.0})
+                    entry[field_name] = max(entry[field_name], value)
+        out["divergence"] = {
+            pod: entry for pod, entry in sorted(divergence.items())
+            if entry["phantom"] > 0 or entry["ghost"] > 0
+        }
+        out["divergence_pods_checked"] = len(divergence)
+        return out
+
     def debug_view(self) -> dict:
         pyprof = self.profile_view()
         pyprof.pop("folded", None)  # bulk text lives at /debug/pyprof
@@ -889,6 +1027,7 @@ class TelemetryCollector:
             "rollup": self.rollup_view(),
             "pyprof": pyprof,
             "workingset": self.workingset_view(),
+            "audit": self.audit_view(),
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -910,6 +1049,11 @@ class TelemetryCollector:
             self._admin.register_debug("fleet", self.debug_view)
             self._admin.register_debug("pyprof", self.profile_view)
             self._admin.register_debug("workingset", self.workingset_view)
+            # The collector's /debug/audit serves the *joined* view (the
+            # pods' same-named endpoint serves their raw record rings —
+            # AdminServer routes plain GETs to this provider and ?since=
+            # pulls to a registered cursor source).
+            self._admin.register_debug("audit", self.audit_view)
             self._admin.start()
         if self._thread is None and self.cfg.scrape_interval_s > 0:
             self._stop.clear()

@@ -58,6 +58,15 @@ class FleetTelemetryConfig:
     # reuse sampler exported at /debug/workingset. Off by default; its
     # cost is gated <1% of score p50 by ``bench.py --workingset``.
     workingset: WorkingSetConfig = field(default_factory=WorkingSetConfig)
+    # Ground-truth audit plane (telemetry/audit.py): record score-time
+    # predictions (and, on engine pods, realized outcomes) in a ring
+    # exported at /debug/audit for the collector's score-vs-reality
+    # join. Off by default; the score-path hook is gated <1% of score
+    # p50 by ``bench.py --audit``.
+    audit: bool = False
+    # Audit ring depth; evict-oldest beyond this (drops are counted in
+    # kvtpu_audit_dropped_records_total).
+    audit_max_records: int = 2048
 
     @classmethod
     def from_dict(cls, data: Optional[dict]) -> Optional["FleetTelemetryConfig"]:
@@ -84,6 +93,10 @@ class FleetTelemetryConfig:
                 k("pyprof", "pyprof", None)),
             workingset=WorkingSetConfig.from_dict(
                 k("workingset", "workingset", None)),
+            audit=bool(k("audit", "audit", d.audit)),
+            audit_max_records=int(
+                k("auditMaxRecords", "audit_max_records",
+                  d.audit_max_records)),
         )
 
 

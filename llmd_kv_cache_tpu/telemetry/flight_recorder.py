@@ -53,6 +53,7 @@ KIND_PROFILE = "profile_capture"
 KIND_LOCKDEP = "lockdep"
 KIND_HEDGE = "hedge"
 KIND_SHED = "shed"
+KIND_AUDIT = "audit"
 
 
 class FlightRecorder:

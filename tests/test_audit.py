@@ -1,0 +1,456 @@
+"""Ground-truth audit plane (ISSUE 18): the AuditLog ring + cursor
+export, the ``/debug/audit`` endpoint contract (404 unconfigured, cursor
+semantics, provider fall-through), OpenMetrics exemplars on the
+calibration histograms, the collector's 404-tolerant audit pull, and the
+AuditJoiner's calibration / staleness-attribution / routing-regret
+math."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llmd_kv_cache_tpu.services.indexer_service import ScoreFeedback
+from llmd_kv_cache_tpu.telemetry.audit import (
+    AuditJoiner,
+    AuditLog,
+    trace_id_of,
+)
+
+TRACE_ID = "0af7651916cd43dd8448eb211c80319c"
+TRACEPARENT = f"00-{TRACE_ID}-b7ad6b7169203331-01"
+
+
+def _traceparent(i: int) -> str:
+    return f"00-{i:032x}-{i:016x}-01"
+
+
+def _prediction(log: AuditLog, i: int, scores=None, hit=3.0):
+    log.record_prediction(
+        traceparent=_traceparent(i), model="m", total_blocks=8,
+        hit_blocks=hit, scores=scores or {"pod-1": hit})
+
+
+def _outcome_rec(i: int, pod="pod-1", realized=3, total=8, feedback=None):
+    """Hand-built outcome record, shaped like AuditLog.record_outcome."""
+    rec = {
+        "kind": "outcome",
+        "trace_id": f"{i:032x}",
+        "traceparent": _traceparent(i),
+        "request_id": f"r{i}",
+        "pod": pod,
+        "total_blocks": total,
+        "hbm_blocks": realized,
+        "restored_blocks": 0,
+        "recomputed_blocks": total - realized,
+        "realized_blocks": realized,
+    }
+    if feedback is not None:
+        rec.update(feedback)
+    return rec
+
+
+# -- trace id parsing ---------------------------------------------------------
+
+
+class TestTraceIdOf:
+    def test_w3c_traceparent_yields_trace_id(self):
+        assert trace_id_of(TRACEPARENT) == TRACE_ID
+
+    def test_absent_and_malformed_yield_empty(self):
+        assert trace_id_of("") == ""
+        assert trace_id_of(None or "") == ""
+        assert trace_id_of("not-a-traceparent") == ""
+        assert trace_id_of("00-short-span-01") == ""
+
+
+# -- the ring -----------------------------------------------------------------
+
+
+class TestAuditLog:
+    def test_export_since_cursor_semantics(self):
+        log = AuditLog(capacity=16)
+        _prediction(log, 1)
+        log.record_outcome(
+            traceparent=_traceparent(1), request_id="r1", pod="pod-1",
+            total_blocks=8, hbm_blocks=2, restored_blocks=1,
+            recomputed_blocks=5)
+        first = log.export_since(-1)
+        assert [r["kind"] for r in first["records"]] == [
+            "prediction", "outcome"]
+        assert first["records"][1]["realized_blocks"] == 3  # hbm + restored
+        assert first["dropped"] == 0
+        cursor = first["next_seq"]
+        # Non-destructive: a second puller from scratch sees everything.
+        assert len(log.export_since(-1)["records"]) == 2
+        # The advancing puller sees only what arrived after its cursor.
+        assert log.export_since(cursor)["records"] == []
+        _prediction(log, 2)
+        nxt = log.export_since(cursor)
+        assert [r["trace_id"] for r in nxt["records"]] == [f"{2:032x}"]
+
+    def test_ring_eviction_counts_drops(self):
+        log = AuditLog(capacity=4)
+        for i in range(6):
+            _prediction(log, i)
+        out = log.export_since(-1)
+        assert out["dropped"] == 2
+        assert [r["seq"] for r in out["records"]] == [2, 3, 4, 5]
+        assert log.debug_view()["retained"] == 4
+
+    def test_staleness_fn_stamps_predictions_and_tolerates_errors(self):
+        log = AuditLog(capacity=4, staleness_fn=lambda: 2.5)
+        _prediction(log, 1)
+        assert log.export_since(-1)["records"][0]["staleness_s"] == 2.5
+
+        def boom():
+            raise RuntimeError("pool gone")
+
+        log2 = AuditLog(capacity=4, staleness_fn=boom)
+        _prediction(log2, 1)  # must not raise
+        assert log2.export_since(-1)["records"][0]["staleness_s"] == 0.0
+
+    def test_outcome_carries_feedback_fields(self):
+        log = AuditLog(capacity=4)
+        fb = ScoreFeedback(
+            traceparent=TRACEPARENT, chosen_pod="pod-1",
+            predicted_blocks=3.5, total_blocks=8,
+            scores={"pod-1": 3.5, "pod-2": 1.0},
+            residency={"pod-1": 0.5}, staleness_s=0.25)
+        log.record_outcome(
+            traceparent=TRACEPARENT, request_id="r1", pod="pod-1",
+            total_blocks=8, hbm_blocks=3, restored_blocks=0,
+            recomputed_blocks=5, feedback=fb)
+        rec = log.export_since(-1)["records"][0]
+        assert rec["predicted_blocks"] == 3.5
+        assert rec["scores"] == {"pod-1": 3.5, "pod-2": 1.0}
+        assert rec["staleness_s"] == 0.25
+        assert rec["trace_id"] == TRACE_ID
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            AuditLog(capacity=0)
+
+
+# -- the endpoint -------------------------------------------------------------
+
+
+class TestDebugAuditEndpoint:
+    def _admin(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        admin = AdminServer(port=0, expose_debug=True)
+        admin.start()
+        return admin
+
+    def test_404_until_configured(self):
+        admin = self._admin()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{admin.port}/debug/audit?since=-1")
+            assert exc.value.code == 404
+        finally:
+            admin.stop()
+
+    def test_cursor_export_and_bad_since(self):
+        admin = self._admin()
+        log = AuditLog(capacity=8)
+        _prediction(log, 1)
+        admin.register_audit_source(log.export_since)
+        try:
+            base = f"http://127.0.0.1:{admin.port}"
+            with urllib.request.urlopen(f"{base}/debug/audit?since=-1") as r:
+                payload = json.loads(r.read())
+            assert [rec["kind"] for rec in payload["records"]] == [
+                "prediction"]
+            cursor = payload["next_seq"]
+            with urllib.request.urlopen(
+                    f"{base}/debug/audit?since={cursor}") as r:
+                assert json.loads(r.read())["records"] == []
+            # No ?since= and no plain provider: the ring still answers.
+            with urllib.request.urlopen(f"{base}/debug/audit") as r:
+                assert len(json.loads(r.read())["records"]) == 1
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/debug/audit?since=nope")
+            assert exc.value.code == 400
+        finally:
+            admin.stop()
+
+    def test_plain_get_falls_through_to_provider(self):
+        # The collector registers both: its joined view answers plain
+        # GETs, the ring answers ?since= pulls — same dual shape as
+        # /debug/slo.
+        admin = self._admin()
+        log = AuditLog(capacity=8)
+        _prediction(log, 1)
+        admin.register_audit_source(log.export_since)
+        admin.register_debug("audit", lambda: {"joined": 7})
+        try:
+            base = f"http://127.0.0.1:{admin.port}"
+            with urllib.request.urlopen(f"{base}/debug/audit") as r:
+                assert json.loads(r.read()) == {"joined": 7}
+            with urllib.request.urlopen(f"{base}/debug/audit?since=-1") as r:
+                assert len(json.loads(r.read())["records"]) == 1
+        finally:
+            admin.stop()
+
+
+# -- collector pull tolerance -------------------------------------------------
+
+
+class TestCollectorAuditPull:
+    def _collector(self, port, **kw):
+        from llmd_kv_cache_tpu.services.telemetry_collector import (
+            CollectorConfig,
+            ScrapeTarget,
+            TelemetryCollector,
+        )
+
+        return TelemetryCollector(CollectorConfig(
+            targets=(ScrapeTarget(
+                name="pod-a", address=f"127.0.0.1:{port}"),),
+            scrape_interval_s=0.0, admin_port=0, breaker_failures=1, **kw))
+
+    def test_pull_joins_records_and_advances_cursor(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        admin = AdminServer(port=0, expose_debug=True)
+        admin.register_spans_source(
+            lambda since: {"spans": [], "next_seq": since, "dropped": 0})
+        log = AuditLog(capacity=16)
+        _prediction(log, 1, scores={"pod-a": 3.0})
+        log.record_outcome(
+            traceparent=_traceparent(1), request_id="r1", pod="pod-a",
+            total_blocks=8, hbm_blocks=3, restored_blocks=0,
+            recomputed_blocks=5)
+        admin.register_audit_source(log.export_since)
+        admin.start()
+        col = self._collector(admin.port)
+        try:
+            col.scrape_once()
+            assert col.joiner.view()["joined"] == 1
+            cursor = col._targets[0].audit_cursor
+            assert cursor >= 1
+            col.scrape_once()  # nothing new: cursor holds, no re-join
+            assert col.joiner.view()["joined"] == 1
+            assert col._targets[0].audit_cursor == cursor
+        finally:
+            admin.stop()
+
+    def test_404_from_unaudited_pod_never_trips_breaker(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        admin = AdminServer(port=0, expose_debug=True)
+        admin.register_spans_source(
+            lambda since: {"spans": [], "next_seq": since, "dropped": 0})
+        admin.start()  # audit plane off: /debug/audit 404s
+        col = self._collector(admin.port)
+        try:
+            for _ in range(3):
+                col.scrape_once()
+            state = col._targets[0]
+            assert state.breaker.allow()  # enrichment 404 is not a failure
+            assert col.joiner.view()["joined"] == 0
+        finally:
+            admin.stop()
+
+
+class TestDivergenceSLIFeed:
+    # prometheus_client stamps counter TYPE lines with the _total suffix,
+    # so parse_exposition keys the family under the suffixed name; the SLI
+    # feed must find it there (regression: it once looked up the bare name
+    # and silently fed nothing).
+    EXPOSITION = "\n".join([
+        "# TYPE kvtpu_index_divergence_checked_total counter",
+        'kvtpu_index_divergence_checked_total{pod="decode-live"} 5.0',
+        'kvtpu_index_divergence_checked_total{pod="decode-lost"} 5.0',
+        "# TYPE kvtpu_index_divergence_divergent_total counter",
+        'kvtpu_index_divergence_divergent_total{pod="decode-lost"} 5.0',
+        "",
+    ])
+
+    def test_suffixed_counter_families_feed_the_tracker(self):
+        from llmd_kv_cache_tpu.services.telemetry_collector import (
+            CollectorConfig,
+            ScrapeTarget,
+            TelemetryCollector,
+        )
+        from llmd_kv_cache_tpu.telemetry.rollup import parse_exposition
+
+        col = TelemetryCollector(CollectorConfig(
+            targets=(ScrapeTarget(name="pod-a", address="127.0.0.1:1"),),
+            scrape_interval_s=0.0, admin_port=0))
+        state = col._targets[0]
+        state.families = parse_exposition(self.EXPOSITION)
+        col._feed_divergence_sli()
+        tracker = col.slos.get("index_divergence")
+        view = tracker.debug_view()
+        assert view["error_budget_remaining"] < 1.0  # bad samples landed
+        # Second feed with unchanged counters: deltas are zero, no double
+        # counting (budget does not drop further).
+        remaining = view["error_budget_remaining"]
+        col._feed_divergence_sli()
+        assert (tracker.debug_view()["error_budget_remaining"]
+                == pytest.approx(remaining))
+
+
+# -- calibration exemplars ----------------------------------------------------
+
+
+class TestCalibrationExemplars:
+    def test_openmetrics_renders_audit_histogram_exemplars(self):
+        from prometheus_client import REGISTRY
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as generate_openmetrics,
+        )
+
+        joiner = AuditJoiner()
+        tid = "feedface" * 4
+        joiner.ingest([
+            _outcome_rec(
+                3, pod="pod-x", realized=1, total=8,
+                feedback={"predicted_blocks": 0.4,
+                          "scores": {"pod-x": 0.4}, "staleness_s": 0.0})
+            | {"trace_id": tid},
+        ])
+        assert joiner.view()["joined"] == 1
+        text = generate_openmetrics(REGISTRY).decode("utf-8")
+        for family in ("kvtpu_audit_predicted_hit_blocks",
+                       "kvtpu_audit_realized_hit_blocks",
+                       "kvtpu_audit_calibration_error_blocks"):
+            line = next(
+                l for l in text.splitlines()
+                if l.startswith(f'{family}_bucket')
+                and f'trace_id="{tid}"' in l)
+            assert "# {" in line  # OpenMetrics exemplar syntax
+
+
+# -- the join -----------------------------------------------------------------
+
+
+class TestAuditJoiner:
+    def test_prediction_outcome_join_computes_calibration(self):
+        joiner = AuditJoiner()
+        log = AuditLog(capacity=16)
+        _prediction(log, 1, scores={"pod-1": 4.0}, hit=4.0)
+        log.record_outcome(
+            traceparent=_traceparent(1), request_id="r1", pod="pod-1",
+            total_blocks=8, hbm_blocks=3, restored_blocks=0,
+            recomputed_blocks=5)
+        joins = joiner.ingest(log.export_since(-1)["records"])
+        assert joins == 1
+        view = joiner.view()
+        assert view["joined"] == 1
+        assert view["pending_predictions"] == 0
+        assert view["mean_abs_error_blocks"] == pytest.approx(1.0)
+        pod = view["pods"]["pod-1"]
+        assert pod["joins"] == 1
+        # ratio EMA moved one alpha-step from 1.0 toward 3/4.
+        assert pod["calibration_ratio"] == pytest.approx(
+            1.0 + 0.2 * (0.75 - 1.0))
+
+    def test_outcome_with_feedback_joins_without_prediction(self):
+        # The scorer's ring dropped (or never saw) the prediction; the
+        # feedback the request carried is enough.
+        joiner = AuditJoiner()
+        joins = joiner.ingest([_outcome_rec(
+            5, realized=2,
+            feedback={"predicted_blocks": 2.0, "scores": {"pod-1": 2.0},
+                      "staleness_s": 0.0})])
+        assert joins == 1
+        assert joiner.view()["unjoined_outcomes"] == 0
+
+    def test_bare_outcome_counts_unjoined(self):
+        joiner = AuditJoiner()
+        assert joiner.ingest([_outcome_rec(6)]) == 0
+        view = joiner.view()
+        assert view["joined"] == 0
+        assert view["unjoined_outcomes"] == 1
+
+    def test_staleness_attributes_error_to_stale_vs_fresh(self):
+        joiner = AuditJoiner(stale_threshold_s=1.0)
+        joiner.ingest([
+            _outcome_rec(1, realized=1, feedback={
+                "predicted_blocks": 4.0, "scores": {"pod-1": 4.0},
+                "staleness_s": 5.0}),   # stale index at score time
+            _outcome_rec(2, realized=1, feedback={
+                "predicted_blocks": 2.0, "scores": {"pod-1": 2.0},
+                "staleness_s": 0.1}),   # fresh index, still wrong
+        ])
+        pod = joiner.view()["pods"]["pod-1"]
+        assert pod["stale_mispredicted_blocks"] == pytest.approx(3.0)
+        assert pod["fresh_mispredicted_blocks"] == pytest.approx(1.0)
+
+    def test_regret_when_a_losing_pod_would_have_won(self):
+        joiner = AuditJoiner(regret_margin_blocks=0.5)
+        joiner.ingest([_outcome_rec(
+            1, pod="pod-1", realized=1, feedback={
+                "predicted_blocks": 4.0,
+                "scores": {"pod-1": 4.0, "pod-2": 8.0},
+                "staleness_s": 0.0})])
+        view = joiner.view()
+        assert view["regrets"] == 1
+        assert view["regret_rate"] == pytest.approx(1.0)
+        # pod-2's unobserved calibration defaults to 1.0: est 8.0 beats
+        # realized 1.0 by 7.0 blocks.
+        assert view["pods"]["pod-1"]["regret_blocks"] == pytest.approx(7.0)
+
+    def test_calibration_discounts_an_over_advertising_pod(self):
+        # pod-2 consistently realizes far less than predicted; once its
+        # ratio EMA collapses, its big scores stop winning counterfactuals.
+        joiner = AuditJoiner(ema_alpha=1.0)  # jump straight to the ratio
+        joiner.ingest([_outcome_rec(
+            1, pod="pod-2", realized=0, feedback={
+                "predicted_blocks": 10.0, "scores": {"pod-2": 10.0},
+                "staleness_s": 0.0})])
+        assert joiner.view()["pods"]["pod-2"]["calibration_ratio"] == 0.0
+        joiner.ingest([_outcome_rec(
+            2, pod="pod-1", realized=1, feedback={
+                "predicted_blocks": 1.0,
+                "scores": {"pod-1": 1.0, "pod-2": 10.0},
+                "staleness_s": 0.0})])
+        assert joiner.view()["regrets"] == 0  # 10.0 * 0.0 est beats nothing
+
+    def test_healthy_path_has_zero_error_and_zero_regret(self):
+        joiner = AuditJoiner()
+        log = AuditLog(capacity=16)
+        for i in range(4):
+            scores = {"pod-1": 3.0, "pod-2": 1.0}
+            _prediction(log, i, scores=scores, hit=3.0)
+            log.record_outcome(
+                traceparent=_traceparent(i), request_id=f"r{i}",
+                pod="pod-1", total_blocks=8, hbm_blocks=3,
+                restored_blocks=0, recomputed_blocks=5,
+                feedback=ScoreFeedback(
+                    traceparent=_traceparent(i), chosen_pod="pod-1",
+                    predicted_blocks=3.0, scores=scores))
+        joiner.ingest(log.export_since(-1)["records"])
+        view = joiner.view()
+        assert view["joined"] == 4
+        assert view["mean_abs_error_blocks"] == pytest.approx(0.0)
+        assert view["regrets"] == 0
+        assert view["regret_rate"] == 0.0
+        assert view["pods"]["pod-1"]["calibration_ratio"] == pytest.approx(
+            1.0)
+
+    def test_pending_predictions_are_bounded(self):
+        joiner = AuditJoiner(pending_limit=3)
+        log = AuditLog(capacity=32)
+        for i in range(5):
+            _prediction(log, i)
+        joiner.ingest(log.export_since(-1)["records"])
+        assert joiner.view()["pending_predictions"] == 3
+        # The evicted oldest can no longer join; the retained newest can.
+        assert joiner.ingest([_outcome_rec(0)]) == 0
+        assert joiner.ingest([_outcome_rec(4, realized=3)]) == 1
+
+    def test_malformed_record_does_not_poison_the_pull(self):
+        joiner = AuditJoiner()
+        joins = joiner.ingest([
+            {"kind": "outcome", "scores": "not-a-dict"},
+            _outcome_rec(1, realized=2, feedback={
+                "predicted_blocks": 2.0, "scores": {"pod-1": 2.0}}),
+        ])
+        assert joins == 1

@@ -310,6 +310,8 @@ class TestPerfSentinel:
         assert "workingset" in manifest["benches"]
         assert "controller" in manifest["benches"]
         assert "graytail" in manifest["benches"]
+        assert "audit" in manifest["benches"]
+        assert "hotpath-fleet" in manifest["benches"]
         sentinel = self._sentinel()
         nominal = {
             "pyprof-overhead": {
@@ -324,7 +326,18 @@ class TestPerfSentinel:
             "graytail": {
                 "metric": "hedging_overhead_pct", "value": 0.2,
                 "unit": "% of score p50", "vs_baseline": 1.0},
+            "audit": {
+                "metric": "audit_overhead_pct", "value": 0.6,
+                "unit": "% of score p50", "vs_baseline": 1.0},
+            "hotpath-fleet": {
+                "metric": "batched_fanout_ratio", "value": 7.0,
+                "unit": "batched/per-chunk sustained GetPodScores/s ratio",
+                "vs_baseline": 1.0},
         }
+        # The nominal set must cover the whole committed manifest — a
+        # bench added to the baseline without a result arm here is the
+        # exact silent-skip this test exists to prevent.
+        assert set(nominal) == set(manifest["benches"])
         _, failed = sentinel.evaluate(manifest, nominal)
         assert failed == 0
         missing_one = dict(nominal)

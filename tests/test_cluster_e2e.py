@@ -599,7 +599,8 @@ class TestFleetObservabilityE2E:
                 if t["trace_id"] == trace["trace_id"])
             assert dominant["self_time_s"] > 0.0
             assert set(fleet["slo"]) == {
-                "ttft", "score_latency", "restore_latency", "availability"}
+                "ttft", "score_latency", "restore_latency", "availability",
+                "index_divergence"}
             assert fleet["alerts"] == []  # healthy fleet: nothing firing
 
             # 5) Chaos: kill one shard. Scrapes of its admin endpoint
@@ -806,3 +807,282 @@ class TestWorkingSetFleetE2E:
                 collector.stop()
             for admin in pod_admins:
                 admin.stop()
+
+
+AUDIT_GRPC_PORTS = range(15990, 15994)   # clear of the port ranges above
+AUDIT_ADMIN_PORTS = range(15994, 15998)
+AUDIT_COLLECTOR_PORT = 15998
+
+
+class TestAuditChaosE2E:
+    """ISSUE 18 acceptance: the ground-truth audit plane under injected
+    event loss.
+
+    Four full-view indexer replicas (the replicated-indexer topology —
+    scoring stays exact behind any one of them, unlike the key-sharded
+    cluster above whose scatter-gather lives client-side) serve scores
+    over real gRPC with the audit ring on. The healthy path closes the
+    score->serve loop through a real engine (prediction joined to the
+    realized outcome via ScoreFeedback) with calibration error and
+    routing regret both zero. Then one engine pod's BlockStoredEvents
+    are lost before reaching any replica: the continuous divergence
+    audit reports ghost blocks on exactly that pod, the
+    ``index_divergence`` SLI burns to fast_burn, and ``kvdiag --fleet``
+    exits 3 naming the degraded pod. Anti-entropy reconciliation repairs
+    the replicas from engine truth and the alert clears.
+    """
+
+    def _make_service(self, addr, admin_port):
+        from llmd_kv_cache_tpu.core import TokenProcessorConfig
+        from llmd_kv_cache_tpu.events import PoolConfig
+        from llmd_kv_cache_tpu.scoring.indexer import IndexerConfig
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerService,
+            serve,
+        )
+        from llmd_kv_cache_tpu.telemetry import FleetTelemetryConfig
+
+        cfg = IndexerConfig(
+            token_processor_config=TokenProcessorConfig(
+                block_size_tokens=BLOCK),
+            admin_port=admin_port,
+            # audit=True: every score decision lands in the pod's
+            # AuditLog ring, exported at /debug/audit for the collector's
+            # score-vs-reality join.
+            fleet_telemetry=FleetTelemetryConfig(
+                span_export=True, audit=True),
+        )
+        svc = IndexerService(cfg, PoolConfig(concurrency=1))
+        svc.start()
+        return svc, serve(addr, svc)
+
+    def _ingest(self, services, pod, tokens, engine_base):
+        from llmd_kv_cache_tpu.events.model import BlockStoredEvent, EventBatch
+
+        n = len(tokens) // BLOCK
+        batch = EventBatch(
+            timestamp=time.time(),
+            events=[BlockStoredEvent(
+                block_hashes=list(range(engine_base, engine_base + n)),
+                tokens=list(tokens), parent_hash=0, block_size=BLOCK,
+                device_tier="gpu",
+            )],
+        )
+        for svc in services:
+            svc.pool.process_event_batch(batch, pod, MODEL)
+
+    def _kvdiag(self, *extra):
+        return subprocess.run(
+            [sys.executable, "hack/kvdiag.py",
+             "--port", str(AUDIT_COLLECTOR_PORT), "--fleet", *extra],
+            cwd=str(REPO), capture_output=True, text=True, timeout=30)
+
+    def test_event_loss_fires_divergence_sli_and_reconcile_clears_it(self):
+        from llmd_kv_cache_tpu.core.keys import PodEntry
+        from llmd_kv_cache_tpu.index import InMemoryIndex, InMemoryIndexConfig
+        from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
+        from llmd_kv_cache_tpu.models.llama import LlamaConfig
+        from llmd_kv_cache_tpu.recovery import IndexDigestSource
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            IndexerServiceClient,
+            ScoreFeedback,
+        )
+        from llmd_kv_cache_tpu.services.telemetry_collector import (
+            CollectorConfig,
+            ScrapeTarget,
+            TelemetryCollector,
+        )
+        from llmd_kv_cache_tpu.telemetry.tracing import (
+            set_process_identity,
+            uninstall_span_exporter,
+        )
+
+        addrs = [f"127.0.0.1:{p}" for p in AUDIT_GRPC_PORTS]
+        admin_ports = dict(zip(addrs, AUDIT_ADMIN_PORTS))
+        services, servers = {}, {}
+        client = None
+        collector = None
+        try:
+            for addr in addrs:
+                services[addr], servers[addr] = self._make_service(
+                    addr, admin_ports[addr])
+            assert services[addrs[0]].audit_log is not None
+
+            # Healthy event plane: three engine pods' stored blocks reach
+            # every replica.
+            live = list(range(1, 1 + 2 * BLOCK))
+            self._ingest(services.values(), "decode-live", live, 2000)
+            self._ingest(services.values(), "decode-a",
+                         list(range(401, 401 + 4 * BLOCK)), 2100)
+            self._ingest(services.values(), "decode-b",
+                         list(range(801, 801 + 4 * BLOCK)), 2200)
+
+            client = IndexerServiceClient(addrs[0])
+            assert wait_until(
+                lambda: client.score(live, MODEL).scores.get("decode-live")
+                == pytest.approx(2.0), timeout=15.0)
+
+            # Each replica audits against engine ground truth. So far the
+            # event plane was lossless, so truth == the replica's own view
+            # and every audit round is clean.
+            truths = {}
+            for addr, svc in services.items():
+                truth = InMemoryIndex(InMemoryIndexConfig())
+                truth.restore_state(svc.indexer.kv_block_index.dump_state())
+                truths[addr] = truth
+                svc.attach_digest_source(IndexDigestSource(truth))
+            assert wait_until(
+                lambda: all(not svc.audit_now()["divergent"]
+                            for svc in services.values()), timeout=10.0)
+
+            collector = TelemetryCollector(CollectorConfig(
+                targets=tuple(
+                    ScrapeTarget(name=f"indexer-{i}",
+                                 address=f"127.0.0.1:{p}",
+                                 role="indexer")
+                    for i, p in enumerate(AUDIT_ADMIN_PORTS)),
+                scrape_interval_s=0.0,
+                admin_port=AUDIT_COLLECTOR_PORT,
+                fast_windows=(0.6, 1.2),
+                slow_window=2.4,
+                breaker_reset_s=0.3,
+            ))
+            collector.start()
+            assert collector.scrape_once()["reachable"] == len(addrs)
+
+            # 1) Healthy path: score over the wire, route on the response,
+            # serve on a real engine with the ScoreFeedback attached. The
+            # engine's prefix cache holds exactly what the index promised
+            # (warm-up request below), so predicted == realized.
+            tiny = LlamaConfig.tiny()
+            assert tiny.page_size == BLOCK  # index blocks == engine pages
+            engine = MiniEngine(EngineConfig(
+                model=tiny, num_pages=64, max_pages_per_seq=16,
+                model_name=MODEL, pod_identifier="decode-live",
+                max_prefill_tokens=tiny.page_size))
+            engine.attach_audit(services[addrs[0]].audit_log)
+            # Warm-up: caches `live` in engine HBM. Its outcome carries no
+            # feedback and no trace - the joiner must count it unjoined,
+            # never score it.
+            engine.generate("audit-warm", live, max_new_tokens=2)
+
+            prompt2 = live + list(range(7001, 7001 + BLOCK))
+            resp = client.score(prompt2, MODEL)
+            assert resp.scores.get("decode-live") == pytest.approx(2.0)
+            fb = ScoreFeedback.from_response(
+                resp, "decode-live", total_blocks=len(prompt2) // BLOCK)
+            req = engine.enqueue("audit-r1", prompt2, max_new_tokens=3,
+                                 traceparent=resp.traceparent, feedback=fb)
+            deadline = time.monotonic() + 120.0
+            while not req.done and time.monotonic() < deadline:
+                engine.step()
+            assert req.done
+
+            collector.scrape_once()
+            audit = collector.audit_view()
+            assert audit["joined"] >= 1
+            assert audit["unjoined_outcomes"] >= 1  # the feedback-less warm-up
+            # Honest routing: the 2 predicted blocks were served from HBM.
+            assert audit["mean_abs_error_blocks"] == pytest.approx(0.0)
+            assert audit["regret_rate"] == 0.0
+            cal = audit["pods"]["decode-live"]
+            assert cal["calibration_ratio"] == pytest.approx(1.0)
+            assert cal["regrets"] == 0
+            assert audit["divergence"] == {}
+
+            diag = self._kvdiag()
+            assert diag.returncode == 0, diag.stderr
+            fleet = json.loads(diag.stdout)["fleet"]
+            assert fleet["alerts"] == []
+            assert "index_divergence" in fleet["slo"]
+            assert fleet["audit"]["mean_abs_error_blocks"] == \
+                pytest.approx(0.0)
+            assert fleet["audit"]["regret_rate"] == 0.0
+            assert fleet["audit"]["degraded_pods"] == []
+
+            # 2) Chaos: pod decode-lost stores three blocks but its events
+            # never reach any replica (lost on the wire). Engine truth knows;
+            # the index does not -> ghost blocks on exactly that pod.
+            lost_tokens = list(range(9001, 9001 + 3 * BLOCK))
+            lost_keys = services[addrs[0]].indexer.compute_block_keys(
+                lost_tokens, MODEL)
+            for truth in truths.values():
+                truth.add(None, lost_keys, [PodEntry("decode-lost", "gpu")])
+
+            for svc in services.values():
+                res = svc.audit_now()
+                assert set(res["divergent"]) == {"decode-lost"}, res
+                assert res["divergent"]["decode-lost"] == {
+                    "phantom": 0, "ghost": len(lost_keys)}
+
+            tracker = collector.slos.get("index_divergence")
+            deadline = time.monotonic() + 15.0
+            while (tracker.alert_severity != "fast_burn"
+                   and time.monotonic() < deadline):
+                for svc in services.values():
+                    svc.audit_now()
+                collector.scrape_once()
+                time.sleep(0.1)
+            assert tracker.alert_severity == "fast_burn", \
+                tracker.debug_view()
+            # The divergence picture names exactly the lossy pod.
+            audit = collector.audit_view()
+            assert set(audit["divergence"]) == {"decode-lost"}
+            assert audit["divergence"]["decode-lost"]["ghost"] == \
+                len(lost_keys)
+
+            # kvdiag --fleet is the pager: exit 3, the degraded pod named,
+            # and the healthy-path calibration still clean.
+            diag = self._kvdiag()
+            assert diag.returncode == 3, diag.stderr
+            fleet = json.loads(diag.stdout)["fleet"]
+            assert {a["slo"] for a in fleet["alerts"]} == \
+                {"index_divergence"}
+            assert fleet["audit"]["degraded_pods"] == ["decode-lost"]
+            assert set(fleet["audit"]["divergence"]) == {"decode-lost"}
+            assert fleet["audit"]["mean_abs_error_blocks"] == \
+                pytest.approx(0.0)
+            quiet = self._kvdiag("--quiet")
+            assert quiet.returncode == 3
+            assert "index_divergence:fast_burn" in quiet.stdout
+            assert "degraded_pods=decode-lost" in quiet.stdout
+
+            # 3) Repair: anti-entropy reconciles each replica against engine
+            # truth; the lost blocks become scoreable and the audit goes
+            # clean, so the SLI's bad samples age out and the alert clears.
+            for svc in services.values():
+                svc.reconcile_now()
+            assert client.score(lost_tokens, MODEL).scores.get(
+                "decode-lost") == pytest.approx(3.0)
+            deadline = time.monotonic() + 20.0
+            while (tracker.alert_severity is not None
+                   and time.monotonic() < deadline):
+                for svc in services.values():
+                    svc.audit_now()
+                collector.scrape_once()
+                time.sleep(0.1)
+            assert tracker.alert_severity is None, tracker.debug_view()
+            assert collector.audit_view()["divergence"] == {}
+            # The healed episode observed its divergence age.
+            from prometheus_client import REGISTRY
+            healed = REGISTRY.get_sample_value(
+                "kvtpu_index_divergence_age_seconds_count")
+            assert healed is not None and healed >= 1.0
+
+            quiet = self._kvdiag("--quiet")
+            assert quiet.returncode == 0, quiet.stdout + quiet.stderr
+            assert quiet.stdout.strip() == "kvdiag: ok"
+        finally:
+            if client is not None:
+                client.close()
+            if collector is not None:
+                collector.stop()
+            for server in servers.values():
+                server.stop(grace=0)
+            for svc in services.values():
+                try:
+                    svc.stop()
+                except Exception:
+                    pass
+            uninstall_span_exporter()
+            set_process_identity(None)

@@ -306,6 +306,68 @@ class TestScoreWireCompat:
             "keys": [100, 101], "pods": ["pod-1"]}
 
 
+class TestScoreFeedbackWire:
+    """ScoreFeedback tolerance (the audit plane's score→engine hop):
+    a minimal/older peer's bytes decode with defaults, the full field
+    set round-trips, unknown future keys are ignored, and an old peer
+    reading new bytes never sees a type change in the keys it knows."""
+
+    def test_full_feedback_decodes_and_round_trips(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreFeedback
+
+        fb = ScoreFeedback.from_bytes(load("score_feedback_full.bin"))
+        assert fb.traceparent == wire_spec.TRACEPARENT
+        assert fb.chosen_pod == "pod-1"
+        assert fb.predicted_blocks == 3.5
+        assert fb.total_blocks == 8
+        assert fb.scores == {"pod-1": 3.5, "pod-2": 1.0}
+        assert fb.residency == {"pod-1": 0.5}
+        assert fb.staleness_s == 0.25
+        assert ScoreFeedback.from_bytes(fb.to_bytes()) == fb
+
+    def test_legacy_feedback_decodes_with_defaults(self):
+        """Minimal bytes: absent fields default, an integer-typed
+        prediction coerces to float, the unknown ``audit_hint`` key is
+        silently ignored."""
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreFeedback
+
+        fb = ScoreFeedback.from_bytes(load("score_feedback_legacy.bin"))
+        assert fb.traceparent == wire_spec.TRACEPARENT
+        assert fb.chosen_pod == "pod-1"
+        assert fb.predicted_blocks == 3.0
+        assert isinstance(fb.predicted_blocks, float)
+        assert fb.total_blocks == 0
+        assert fb.scores == {}
+        assert fb.residency == {}
+        assert fb.staleness_s == 0.0
+
+    def test_old_peer_view_of_feedback_bytes(self):
+        """An old decoder reading full feedback bytes via ``.get`` never
+        looks at the fields it predates — the keys it knows stay
+        well-typed."""
+        import msgpack
+
+        d = msgpack.unpackb(load("score_feedback_full.bin"), raw=False)
+        assert d["traceparent"] == wire_spec.TRACEPARENT
+        assert d["chosen_pod"] == "pod-1"
+
+    def test_from_response_builds_the_routed_prediction(self):
+        from llmd_kv_cache_tpu.services.indexer_service import (
+            ScoreFeedback,
+            ScoreResponse,
+        )
+
+        resp = ScoreResponse.from_bytes(load("score_response_residency.bin"))
+        fb = ScoreFeedback.from_response(
+            resp, "decode-1", total_blocks=4, staleness_s=0.1)
+        assert fb.traceparent == resp.traceparent
+        assert fb.chosen_pod == "decode-1"
+        assert fb.predicted_blocks == 1.5  # the chosen pod's score
+        assert fb.scores == resp.scores
+        assert fb.residency == resp.residency
+        assert (fb.total_blocks, fb.staleness_s) == (4, 0.1)
+
+
 class TestWireToIndex:
     def test_committed_bytes_through_zmq_pool_index(self):
         """The foreign payload rides a real ZMQ PUB/SUB hop, then

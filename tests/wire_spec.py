@@ -209,6 +209,28 @@ def score_fixtures() -> dict[str, bytes]:
             (s("degraded"), tru()),
             (s("degraded_reason"), s("brownout")),
         ),
+        # Ground-truth audit plane: the ScoreFeedback a scheduler builds
+        # from the response it routed on and hands to the chosen engine —
+        # every field arrives the same tolerant way residency/shard did.
+        "score_feedback_full.bin": mp(
+            (s("traceparent"), s(TRACEPARENT)),
+            (s("chosen_pod"), s("pod-1")),
+            (s("predicted_blocks"), f64(3.5)),
+            (s("total_blocks"), u(8)),
+            (s("scores"), mp((s("pod-1"), f64(3.5)), (s("pod-2"), f64(1.0)))),
+            (s("residency"), mp((s("pod-1"), f64(0.5)))),
+            (s("staleness_s"), f64(0.25)),
+        ),
+        # A minimal/older peer's feedback: only the join key and the
+        # chosen pod, an integer-typed prediction (Go encoders emit the
+        # shortest int form for whole values), and an unknown future key
+        # decoders must ignore.
+        "score_feedback_legacy.bin": mp(
+            (s("traceparent"), s(TRACEPARENT)),
+            (s("chosen_pod"), s("pod-1")),
+            (s("predicted_blocks"), u(3)),
+            (s("audit_hint"), nil()),
+        ),
         # Shard-RPC lookup frame with deadline + hedge markers (the
         # cluster.remote frame wire): old shards ignore both keys.
         "lookup_request_deadline.bin": mp(
