@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-hotpath-fleet bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-hotpath-fleet bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail bench-fencing perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -151,6 +151,13 @@ bench-graytail: native
 bench-audit: native
 	$(CPU_ENV) $(PY) bench.py --audit
 
+# Epoch-fencing gate (cluster/membership.py): the per-score fence check
+# (MembershipTable.check_request) must cost < 1% of the score p50 — the
+# fencing plane rides the hot path on every request, so its clean path
+# has to be a lock-free cached-decision compare.
+bench-fencing: native
+	$(CPU_ENV) $(PY) bench.py --fencing
+
 # Perf-regression sentinel: run the profiling + working-set gates and the
 # controller chaos arm, then diff their values and hot-function shares
 # against the committed baseline manifest. Emits machine-verdict
@@ -161,6 +168,7 @@ perf-check: native
 	$(CPU_ENV) $(PY) bench.py --controller > /tmp/kvtpu_controller_bench.json
 	$(CPU_ENV) $(PY) bench.py --graytail > /tmp/kvtpu_graytail_bench.json
 	$(CPU_ENV) $(PY) bench.py --audit > /tmp/kvtpu_audit_bench.json
+	$(CPU_ENV) $(PY) bench.py --fencing > /tmp/kvtpu_fencing_bench.json
 	$(CPU_ENV) $(PY) hack/bench_hotpath.py --fleet > /tmp/kvtpu_fleet_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
 	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
@@ -168,6 +176,7 @@ perf-check: native
 	  --results controller=/tmp/kvtpu_controller_bench.json \
 	  --results graytail=/tmp/kvtpu_graytail_bench.json \
 	  --results audit=/tmp/kvtpu_audit_bench.json \
+	  --results fencing=/tmp/kvtpu_fencing_bench.json \
 	  --results hotpath-fleet=/tmp/kvtpu_fleet_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.

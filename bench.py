@@ -2466,6 +2466,91 @@ def bench_audit() -> dict:
     }
 
 
+def bench_fencing() -> dict:
+    """Epoch-fence overhead gate (``--fencing``, ISSUE 19).
+
+    The membership plane adds exactly one check to each serving hot
+    path: ``MembershipTable.check_request`` (score/lookup fences — an
+    epoch compare under the table lock) and ``check_write`` (event
+    ingest — the same plus a lease-validity read). Same
+    microbench-vs-p50 model as the audit/flight-recorder/pyprof gates:
+    measure the clean-path check in isolation, gate it <1% of the
+    Python-path score p50, report the write-fence and the warn-mode
+    rejection path as informational.
+    """
+    import time
+
+    from llmd_kv_cache_tpu.cluster.membership import MembershipTable
+    from llmd_kv_cache_tpu.core.keys import PodEntry
+    from llmd_kv_cache_tpu.scoring import Indexer
+
+    # -- score-path baseline (same workload as the other telemetry gates:
+    # 16-block prompt, 4 candidate pods, Python scoring path).
+    indexer = Indexer()
+    block = indexer.token_processor.block_size
+    trng = np.random.default_rng(7)
+    tokens = trng.integers(1, 30000, 16 * block).tolist()
+    block_keys = indexer.compute_block_keys(tokens, "bench")
+    entries = [PodEntry(f"pod-{i}", "gpu") for i in range(4)]
+    indexer.kv_block_index.add(None, block_keys, entries)
+
+    def score_p50_ns(n_iter=2_000):
+        samples = []
+        for _ in range(n_iter):
+            t0 = time.perf_counter_ns()
+            indexer.score_tokens(tokens, "bench")
+            samples.append(time.perf_counter_ns() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    score_p50_ns(n_iter=500)  # warm caches
+    baseline_ns = score_p50_ns()
+
+    # -- the per-request fence in isolation: the exact check the score
+    # and lookup RPC handlers make on every request, on the clean path
+    # (same-epoch stamp — what every request pays in steady state).
+    table = MembershipTable()
+    table.grant("pod-0")
+    epoch = table.epoch
+    n_calls = 20_000
+    table.check_request(epoch, "score")
+    t0 = time.perf_counter_ns()
+    for _ in range(n_calls):
+        table.check_request(epoch, "score")
+    hook_ns = (time.perf_counter_ns() - t0) / n_calls
+    overhead_pct = 100.0 * hook_ns / baseline_ns
+    # The fence must stay invisible on the score hot path.
+    assert overhead_pct < 1.0, (
+        f"epoch fence check costs {hook_ns:.0f} ns per score call — "
+        f"{overhead_pct:.2f}% of the {baseline_ns} ns score p50"
+    )
+
+    # -- informational: the ingest write fence (lease read + epoch check,
+    # once per event batch) and the warn-mode stale-stamp path (metric +
+    # flight record + bounded ring — only paid by fenced traffic).
+    t0 = time.perf_counter_ns()
+    for _ in range(n_calls):
+        table.check_write("pod-0", epoch, "events.ingest")
+    write_ns = (time.perf_counter_ns() - t0) / n_calls
+    table.observe_epoch(epoch + 1, source="bench")
+    n_reject = 2_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n_reject):
+        table.check_request(epoch, "score")
+    reject_ns = (time.perf_counter_ns() - t0) / n_reject
+
+    return {
+        "metric": "epoch-fence check overhead on the score hot path",
+        "value": round(overhead_pct, 4),
+        "unit": "% of score p50",
+        "vs_baseline": 1.0,
+        "hook_ns_per_score": round(hook_ns, 1),
+        "write_fence_ns_per_batch": round(write_ns, 1),
+        "stale_reject_ns": round(reject_ns, 1),
+        "score_p50_us": round(baseline_ns / 1e3, 1),
+    }
+
+
 def bench_disagg() -> dict:
     """Prefill/decode disaggregation vs a monolithic fleet (decode-heavy).
 
@@ -3046,6 +3131,8 @@ def _dispatch(argv: list) -> object:
         return bench_workingset()
     if "--audit" in argv:
         return bench_audit()
+    if "--fencing" in argv:
+        return bench_fencing()
     if "--flight-recorder" in argv:
         return bench_flight_recorder()
     if "--snapshot-overhead" in argv:

@@ -476,6 +476,31 @@ def fleet_summary(debug: dict) -> dict:
             "degraded_pods": degraded,
         }
 
+    membership = debug.get("membership") or {}
+    if membership:
+        # Epoch-fenced membership plane: where the pod thinks topology
+        # is, every lease's age/runway, and which traffic it fenced —
+        # the first place to look when writes silently stop landing.
+        leases = membership.get("leases") or {}
+        out["membership"] = {
+            "epoch": membership.get("epoch"),
+            "fence_mode": membership.get("fence_mode"),
+            "leases": {
+                pod: {
+                    "epoch": st.get("epoch"),
+                    "age_s": st.get("age_s"),
+                    "remaining_s": st.get("remaining_s"),
+                    "lapsed": st.get("lapsed"),
+                }
+                for pod, st in leases.items()
+            },
+            "lapsed_pods": sorted(
+                pod for pod, st in leases.items() if st.get("lapsed")),
+            "fence_rejections": membership.get("rejections"),
+            "fence_flagged": membership.get("flagged"),
+            "recent_rejections": membership.get("recent_rejections"),
+        }
+
     out["alerts"] = alerts
     out["slo"] = slo
     return out

@@ -1,8 +1,10 @@
 """Sharded indexer control plane (docs/architecture.md "Sharded control
 plane"): consistent-hash partitioning of the block index across N
-indexer shard replicas, scatter-gather scoring, and replica failover."""
+indexer shard replicas, scatter-gather scoring, replica failover, and
+the epoch-fenced membership plane (leases + fencing tokens)."""
 
 from .config import ClusterConfig
+from .membership import FenceDecision, Lease, MembershipTable
 from .ring import HashRing, assignment_fingerprint, moved_partitions, plan_owners
 from .router import DegradedShardError, RouterScore, ShardRouter
 from .sharded_index import ShardedIndex, ShardFilterIndex
@@ -10,7 +12,10 @@ from .sharded_index import ShardedIndex, ShardFilterIndex
 __all__ = [
     "ClusterConfig",
     "DegradedShardError",
+    "FenceDecision",
     "HashRing",
+    "Lease",
+    "MembershipTable",
     "RouterScore",
     "ShardRouter",
     "ShardedIndex",

@@ -89,6 +89,14 @@ class ClusterConfig:
     # steady-state hedge fraction cap; burst bounds accumulated credit.
     hedge_budget_rate: float = 0.1
     hedge_budget_burst: float = 8.0
+    # Epoch fencing (cluster.membership): how receivers treat traffic
+    # stamped with an older topology epoch than their own, and the
+    # renewable pod-lease window that fences zombies deterministically.
+    # "warn" counts/flags but serves (safe rollout default — legacy peers
+    # never stamp an epoch at all); "reject" refuses stale writes.
+    fence_mode: str = "warn"
+    lease_ttl_s: float = 30.0
+    lease_renew_s: float = 10.0
 
     def membership(self) -> list[str]:
         """Shard ids, index-aligned with shard_addresses."""
@@ -108,7 +116,7 @@ class ClusterConfig:
         except ValueError:
             raise KeyError(f"unknown shard id {shard_id!r}") from None
 
-    def build_ring(self) -> HashRing:
+    def build_ring(self, epoch: int = 0) -> HashRing:
         members = self.membership()
         if self.shard_count and self.shard_count != len(members):
             raise ValueError(
@@ -120,6 +128,7 @@ class ClusterConfig:
             virtual_nodes=self.virtual_nodes,
             partitions=self.partitions,
             load_factor=self.load_factor,
+            epoch=epoch,
         )
 
     @property
@@ -183,4 +192,8 @@ class ClusterConfig:
             hedge_budget_burst=d.get(
                 "hedgeBudgetBurst", d.get("hedge_budget_burst", 8.0)
             ),
+            fence_mode=d.get("fenceMode", d.get("fence_mode", "warn"))
+            or "warn",
+            lease_ttl_s=d.get("leaseTtlS", d.get("lease_ttl_s", 30.0)),
+            lease_renew_s=d.get("leaseRenewS", d.get("lease_renew_s", 10.0)),
         )

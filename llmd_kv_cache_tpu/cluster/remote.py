@@ -84,15 +84,19 @@ class ShardClient:
         timeout: Optional[float] = None,
         deadline: Optional["object"] = None,
         hedge: bool = False,
+        epoch: int = 0,
     ) -> dict:
         """Raw lookup: ``{"hits": {key: [PodEntry,...]}, "degraded": bool,
-        "shard": str}``. Raises grpc.RpcError on transport failure (the
-        router's breaker/failover logic owns error handling).
+        "shard": str, "epoch": int}``. Raises grpc.RpcError on transport
+        failure (the router's breaker/failover logic owns error handling).
 
         ``deadline`` (a resilience.deadline.Deadline) rides the frame as
         the tolerant ``deadline_ms`` relative budget and caps the client
-        timeout; ``hedge`` tags the frame so shards can count hedged load
-        (both keys are ignored by older peers)."""
+        timeout; ``hedge`` tags the frame so shards can count hedged load;
+        ``epoch`` stamps the caller's topology epoch (cluster.membership)
+        the same tolerant way, and the server's own epoch rides back on
+        the response for piggyback learning (all three keys are ignored
+        by older peers)."""
         from ..resilience.deadline import Deadline
         from ..services.indexer_service import _call_rpc
 
@@ -103,6 +107,8 @@ class ShardClient:
             eff_timeout = deadline.cap_timeout(eff_timeout)
         if hedge:
             frame["hedge"] = True
+        if epoch:
+            frame["epoch"] = int(epoch)
         resp = _call_rpc(
             self._lookup_blocks,
             frame,
@@ -116,6 +122,7 @@ class ShardClient:
             "hits": hits,
             "degraded": bool(resp.get("degraded", False)),
             "shard": resp.get("shard", "") or "",
+            "epoch": int(resp.get("epoch", 0) or 0),
         }
 
     def lookup_blocks_batch(
@@ -125,6 +132,7 @@ class ShardClient:
         timeout: Optional[float] = None,
         deadline: Optional["object"] = None,
         hedge: bool = False,
+        epoch: int = 0,
     ) -> dict:
         """Framed multi-chunk lookup (the batched fan-out data plane):
         one RPC carries a whole gather window's worth of early-exit
@@ -152,6 +160,8 @@ class ShardClient:
             eff_timeout = deadline.cap_timeout(eff_timeout)
         if hedge:
             frame["hedge"] = True
+        if epoch:
+            frame["epoch"] = int(epoch)
         resp = _call_rpc(
             self._lookup_blocks_batch,
             frame,
@@ -172,6 +182,7 @@ class ShardClient:
             "cont": [bool(f) for f in resp.get("cont", []) or []],
             "degraded": bool(resp.get("degraded", False)),
             "shard": resp.get("shard", "") or "",
+            "epoch": int(resp.get("epoch", 0) or 0),
         }
 
     def list_pods(self, timeout: Optional[float] = None) -> list[str]:

@@ -71,8 +71,11 @@ class HashRing:
         virtual_nodes: int = DEFAULT_VIRTUAL_NODES,
         partitions: int = DEFAULT_PARTITIONS,
         load_factor: float = DEFAULT_LOAD_FACTOR,
+        epoch: int = 0,
     ):
         members = sorted(set(shards))
+        if epoch < 0:
+            raise ValueError(f"epoch must be >= 0, got {epoch}")
         if not members:
             raise ValueError("HashRing needs at least one shard")
         if virtual_nodes <= 0 or partitions <= 0:
@@ -83,6 +86,12 @@ class HashRing:
         self.virtual_nodes = virtual_nodes
         self.partitions = partitions
         self.load_factor = load_factor
+        # Topology epoch this ring was built for (cluster.membership).
+        # Placement ignores it — two rings with the same members place
+        # identically across epochs — but it feeds ``version`` so plan
+        # caches and fingerprints distinguish "same placement, older
+        # topology" from "same ring".
+        self.epoch = epoch
         # Hard per-shard primary cap (the "bounded load").
         self.capacity = math.ceil(load_factor * partitions / len(members))
 
@@ -118,7 +127,22 @@ class HashRing:
         # rings agree on every assignment iff they agree on this.
         sig = "|".join(members).encode("utf-8")
         sig += b"/%d/%d/%d" % (virtual_nodes, partitions, int(load_factor * 1000))
+        if epoch:
+            # Appended only when set so pre-epoch processes (and journals
+            # holding their version numbers) keep hashing identically.
+            sig += b"/e%d" % epoch
         self.version = fnv1a_64(sig)
+
+    def with_epoch(self, epoch: int) -> "HashRing":
+        """Same membership and shape, new topology epoch (the router's
+        atomic swap on an epoch bump — placement provably unchanged)."""
+        return HashRing(
+            self.shards,
+            virtual_nodes=self.virtual_nodes,
+            partitions=self.partitions,
+            load_factor=self.load_factor,
+            epoch=epoch,
+        )
 
     # -- placement --------------------------------------------------------
 
@@ -182,6 +206,7 @@ class HashRing:
             "virtual_nodes": self.virtual_nodes,
             "capacity": self.capacity,
             "version": self.version,
+            "epoch": self.epoch,
             "load": self.load(),
         }
 
@@ -199,10 +224,14 @@ def moved_partitions(old: HashRing, new: HashRing) -> int:
 
 
 def assignment_fingerprint(ring: HashRing) -> int:
-    """Order-sensitive FNV digest of the full partition table — equal
-    fingerprints mean byte-identical assignment (cross-process
-    determinism checks)."""
+    """Order-sensitive FNV digest of the full partition table, salted
+    with the ring ``version`` — equal fingerprints mean byte-identical
+    assignment AND the same topology epoch, so two rings with identical
+    placement but different epochs compare unequal (a stale-epoch plan
+    can never masquerade as current just because membership round-
+    tripped). Cross-process determinism checks rely on both halves."""
     acc = b"".join(s.encode("utf-8") + b"\x00" for s in ring._table)
+    acc += b"@%d" % ring.version
     return fnv1a_64(acc)
 
 

@@ -90,6 +90,12 @@ class RouterScore:
     # True when the overall gather deadline expired with lookups still in
     # flight — the result is a degraded lower bound, not silently late.
     deadline_expired: bool = False
+    # Topology epoch this scatter-gather was pinned to (0 = no membership
+    # plane attached) and how many responses arrived stamped with a newer
+    # epoch — those are served degraded-not-fatal while the router's ring
+    # catches up for the next score.
+    epoch: int = 0
+    cross_epoch: int = 0
 
 
 @dataclass
@@ -187,6 +193,13 @@ class ShardRouter:
         self._legacy_shards: set[str] = set()
         self.batch_rpcs = 0
         self.batch_fallbacks = 0
+        # Epoch discipline (cluster.membership): each scatter-gather pins
+        # one epoch, responses stamped newer are degraded-not-fatal, and
+        # an epoch bump swaps the ring plan atomically (one attribute
+        # store — in-flight gathers keep their pinned ring snapshot).
+        self.membership = None
+        self.epoch_bumps = 0
+        self.cross_epoch_responses = 0
         self._publish_ring_metrics()
 
     def attach_residency(self, tracker) -> None:
@@ -194,23 +207,49 @@ class ShardRouter:
         role-aware decode scoring."""
         self.residency = tracker
 
+    def attach_membership(self, table) -> None:
+        """Wire a :class:`~.membership.MembershipTable`: scores stamp its
+        epoch on every shard RPC, piggybacked newer epochs are learned
+        back into it, and its bumps swap this router's ring plan."""
+        self.membership = table
+        table.add_epoch_listener(self._on_epoch_bump)
+        if table.epoch != self.ring.epoch:
+            self._on_epoch_bump(table.epoch)
+
+    def _on_epoch_bump(self, epoch: int) -> None:
+        """Atomic ring-plan swap on a topology-epoch bump. Membership is
+        unchanged (a membership change builds a whole new router config);
+        the new ring differs only in ``version``/``epoch``, so the plan
+        cache misses cleanly and in-flight gathers finish on the ring
+        object they captured."""
+        self.ring = self.ring.with_epoch(epoch)
+        self.epoch_bumps += 1
+        self._publish_ring_metrics()
+
     # -- plan cache -------------------------------------------------------
 
-    def plan(self, keys: Sequence[BlockHash]) -> tuple[str, ...]:
-        """Primary owner per key, via the chained-fingerprint plan cache."""
+    def plan(self, keys: Sequence[BlockHash],
+             ring: Optional[HashRing] = None) -> tuple[str, ...]:
+        """Primary owner per key, via the chained-fingerprint plan cache.
+
+        ``ring`` lets a scatter-gather plan against the ring snapshot it
+        pinned at entry rather than ``self.ring`` (which an epoch bump
+        may swap mid-score)."""
         if not keys:
             return ()
+        if ring is None:
+            ring = self.ring
         cache = self._plan_cache
         if cache is None:
-            return tuple(self.ring.owner(k) for k in keys)
-        cache_key = (self.ring.version, len(keys), keys[-1])
+            return tuple(ring.owner(k) for k in keys)
+        cache_key = (ring.version, len(keys), keys[-1])
         plan = cache.get(cache_key)
         hit = plan is not None
         if hit:
             self.plan_hits += 1
         else:
             self.plan_misses += 1
-            plan = tuple(self.ring.owner(k) for k in keys)
+            plan = tuple(ring.owner(k) for k in keys)
             cache.add(cache_key, plan)
         try:
             from ..metrics.collector import record_shard_plan_cache
@@ -230,6 +269,7 @@ class ShardRouter:
         timeout: Optional[float] = None,
         deadline: Optional[Deadline] = None,
         hedge: bool = False,
+        epoch: int = 0,
     ) -> dict:
         """One breaker-guarded LookupBlocks against one shard."""
         breaker = self.breakers[shard]
@@ -242,6 +282,8 @@ class ShardRouter:
             kwargs["deadline"] = deadline
         if hedge:
             kwargs["hedge"] = True
+        if epoch:
+            kwargs["epoch"] = epoch
         try:
             try:
                 res = self.clients[shard].lookup_blocks(
@@ -270,6 +312,7 @@ class ShardRouter:
         timeout: Optional[float] = None,
         deadline: Optional[Deadline] = None,
         hedge: bool = False,
+        epoch: int = 0,
     ) -> dict:
         """One breaker-guarded LookupBlocksBatch: the shard's keys for a
         whole gather window, framed as ordered chunks. Falls back to the
@@ -290,6 +333,8 @@ class ShardRouter:
             kwargs["deadline"] = deadline
         if hedge:
             kwargs["hedge"] = True
+        if epoch:
+            kwargs["epoch"] = epoch
         try:
             if shard not in self._legacy_shards:
                 try:
@@ -346,6 +391,8 @@ class ShardRouter:
         plan: Sequence[str],
         stats: RouterScore,
         key_chunk: Optional[dict[BlockHash, int]] = None,
+        ring: Optional[HashRing] = None,
+        epoch: int = 0,
     ) -> dict[BlockHash, list[PodEntry]]:
         """Scatter one chunk across its owning shards under one overall
         gather deadline, hedging slow lookups and failing dead shards'
@@ -356,7 +403,14 @@ class ShardRouter:
         carrying its keys grouped by chunk, instead of one RPC per chunk.
         All the per-key machinery — rf-bounded failover, hedging, the
         overall deadline — is chunk-agnostic and applies unchanged;
-        hedged and rerouted attempts re-frame their keys the same way."""
+        hedged and rerouted attempts re-frame their keys the same way.
+
+        ``ring``/``epoch`` are the snapshot this gather is pinned to:
+        reroutes and hedges resolve replica owners against that ring
+        even if an epoch bump swaps ``self.ring`` mid-gather, and every
+        RPC of the gather carries the same epoch stamp."""
+        if ring is None:
+            ring = self.ring
         rf = max(1, self.cfg.replication_factor)
         deadline = current_deadline()
         overall_s = self.cfg.fanout_deadline_s or self.cfg.fanout_timeout_s
@@ -388,12 +442,12 @@ class ShardRouter:
             if key_chunk is not None:
                 fut = self._executor.submit(
                     self._shard_rpc_batch, shard, skeys, key_chunk, pods,
-                    timeout_s, deadline, kind == "hedge",
+                    timeout_s, deadline, kind == "hedge", epoch,
                 )
             else:
                 fut = self._executor.submit(
                     self._shard_rpc, shard, skeys, pods, timeout_s, deadline,
-                    kind == "hedge",
+                    kind == "hedge", epoch,
                 )
             attempts.append(_Attempt(
                 shard=shard, keys=skeys, keyset=frozenset(skeys),
@@ -427,7 +481,7 @@ class ShardRouter:
 
         def next_owner(key: BlockHash) -> Optional[str]:
             cands = [
-                s for s in self.ring.owners(key, rf) if s not in tried[key]
+                s for s in ring.owners(key, rf) if s not in tried[key]
             ]
             if not cands:
                 return None
@@ -471,6 +525,19 @@ class ShardRouter:
                 merged.setdefault(key, entries)
             if res["degraded"]:
                 stats.degraded = True
+            # Cross-epoch response: the shard has moved to a newer
+            # topology than this gather pinned. Its hits still count —
+            # degraded-not-fatal — and the piggybacked epoch advances
+            # the membership table so the NEXT score plans on the new
+            # ring (the in-flight gather keeps its pinned snapshot).
+            resp_epoch = int(res.get("epoch", 0) or 0)
+            if epoch and resp_epoch > epoch:
+                stats.degraded = True
+                stats.cross_epoch += 1
+                self.cross_epoch_responses += 1
+                if self.membership is not None:
+                    self.membership.observe_epoch(
+                        resp_epoch, source=f"router:{a.shard}")
             if a.kind == "hedge" and fresh:
                 stats.hedge_wins += 1
                 self._record_hedge(a.shard, "win")
@@ -603,11 +670,18 @@ class ShardRouter:
             # Fail fast before any fan-out work: an already-expired
             # request must be shed by the caller, not served late.
             dl.check("cluster.router.score")
+        # Pin the whole scatter-gather to ONE ring/epoch snapshot: an
+        # epoch bump mid-score swaps self.ring for the next caller, but
+        # this gather's plan, failovers, and hedges all resolve against
+        # the topology it entered with.
+        ring = self.ring
+        epoch = self.membership.epoch if self.membership is not None else 0
+        result.epoch = epoch
         with tracer().span(
             "llm_d.kv_cache.cluster.fanout",
             model=model_name,
             token_count=len(tokens),
-            shard_count=len(self.ring.shards),
+            shard_count=len(ring.shards),
             role=role,
             process="router",
         ) as span:
@@ -617,7 +691,7 @@ class ShardRouter:
             result.blocks = len(keys)
             if not keys:
                 return result
-            plan = self.plan(keys)
+            plan = self.plan(keys, ring=ring)
             merged: dict[BlockHash, list[PodEntry]] = {}
             chunk = self.cfg.fanout_chunk_blocks
             if chunk <= 0:
@@ -636,7 +710,7 @@ class ShardRouter:
                     }
                 found = self._fanout_chunk(
                     wkeys, pod_identifiers, plan[start:start + window],
-                    result, key_chunk=key_chunk,
+                    result, key_chunk=key_chunk, ring=ring, epoch=epoch,
                 )
                 # Chunk-order truncation: replay the per-chunk loop's
                 # early-exit decisions over the window's merged map, so a
@@ -758,6 +832,13 @@ class ShardRouter:
                 "batch_rpcs": self.batch_rpcs,
                 "batch_fallbacks": self.batch_fallbacks,
                 "legacy_shards": sorted(self._legacy_shards),
+            },
+            "epoch": {
+                "pinned": self.ring.epoch,
+                "membership": (self.membership.epoch
+                               if self.membership is not None else None),
+                "bumps": self.epoch_bumps,
+                "cross_epoch_responses": self.cross_epoch_responses,
             },
         }
 

@@ -41,6 +41,14 @@ ACTION_KINDS = (
     ACTION_DRAIN_POD,
 )
 
+# Kinds that change ring membership and therefore mint a new topology
+# epoch (two-phase propose→commit in the controller). Re-roles and
+# drains ride the *current* epoch — they do not move partitions.
+TOPOLOGY_KINDS = (
+    ACTION_ADD_SHARD,
+    ACTION_REMOVE_SHARD,
+)
+
 
 @dataclass(frozen=True)
 class Action:

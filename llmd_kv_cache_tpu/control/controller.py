@@ -25,6 +25,10 @@ from typing import Callable, Dict, List, Optional
 
 from prometheus_client import Counter, Gauge
 
+from ..metrics.collector import record_fence_rejection
+from ..resilience.failpoints import failpoints
+from ..telemetry.flight_recorder import KIND_FENCE
+from ..telemetry.flight_recorder import record as fr_record
 from ..utils.lockdep import new_lock
 from ..utils.logging import get_logger
 from ..telemetry.tracing import tracer
@@ -33,6 +37,7 @@ from .actions import (
     ACTION_DRAIN_POD,
     ACTION_REMOVE_SHARD,
     ACTION_SET_ROLE,
+    TOPOLOGY_KINDS,
     Action,
     Actuator,
 )
@@ -40,6 +45,7 @@ from .config import ControllerConfig
 from .journal import (
     PHASE_EXECUTED,
     PHASE_FAILED,
+    PHASE_FENCED,
     PHASE_PLANNED,
     PHASE_WOULD_ACT,
     ActionJournal,
@@ -73,6 +79,12 @@ CTRL_INFLIGHT = Gauge(
 SPAN_RECONCILE = "llm_d.kv_cache.control.reconcile"
 SPAN_ACTION = "llm_d.kv_cache.control.action"
 
+# Failpoint fired between a topology action's propose (``planned``
+# journal record) and its commit fence check — ``pause`` mode here
+# simulates a controller that stalled mid-mutation while a rival
+# committed the contested epoch (the split-brain chaos suite's seam).
+FP_COMMIT_PREFIX = "controller.commit."
+
 
 class FleetController:
     """Sense → decide → act loop over a signal source and an actuator."""
@@ -84,10 +96,14 @@ class FleetController:
         config: Optional[ControllerConfig] = None,
         journal: Optional[ActionJournal] = None,
         clock: Callable[[], float] = time.time,
+        membership=None,
     ):
         self.cfg = config or ControllerConfig()
         self.source = signal_source
         self.actuator = actuator
+        # Optional cluster.membership.MembershipTable — the fleet epoch
+        # authority topology commits publish to (and fence against).
+        self.membership = membership
         # Wall clock on purpose: journal timestamps must stay comparable
         # across restarts for cooldown/budget restoration.
         self._clock = clock
@@ -111,6 +127,16 @@ class FleetController:
         # is journaled so the on-disk planned and settled records carry
         # the same action_id (unresolved_actions matches by id).
         self._action_counter = 0
+        # Highest topology epoch this controller has committed or
+        # observed (journal replay + signal polls + membership). Topology
+        # mutations propose epoch+1 and fence the commit against it.
+        self._epoch = 0
+        self._signals_epoch = 0
+        # Latched once this controller loses an epoch race: a fenced
+        # controller stops mutating and defers to the winner until it is
+        # restarted (re-admission re-reads the committed fleet epoch).
+        self.fenced = False
+        self.fence_events = 0
         self.resumed_records = 0
         if self.journal is not None:
             self._restore()
@@ -125,6 +151,11 @@ class FleetController:
         # Resume past the highest journal seq: action ids embed the
         # counter, so reuse across restarts would alias distinct actions.
         self._action_counter = max(r.seq for r in records)
+        # Resume at the highest epoch the journal ever saw — committed or
+        # merely proposed. A proposed-but-unsettled epoch must not be
+        # re-minted blindly: _resolve_pending fences it if the fleet
+        # moved past it while this controller was down.
+        self._epoch = max(self._epoch, max(r.epoch for r in records))
         for kind, ts in last_settlement_ts(records).items():
             self.policy.notify_action(kind, ts)
         now = self._clock()
@@ -170,7 +201,8 @@ class FleetController:
         return record
 
     def _record(self, action: Action, phase: str,
-                result: Optional[dict] = None) -> ActionRecord:
+                result: Optional[dict] = None,
+                epoch: Optional[int] = None) -> ActionRecord:
         self._action_counter += 1
         rec = ActionRecord(
             action_id=action.action_id(self._action_counter),
@@ -183,17 +215,85 @@ class FleetController:
             reason=action.reason,
             signal=dict(action.signal),
             result=dict(result or {}),
+            epoch=int(self._epoch if epoch is None else epoch),
         )
         return self._journal(rec)
+
+    # -- epoch fencing -----------------------------------------------------
+
+    def _fleet_epoch(self) -> int:
+        """Highest committed topology epoch this controller can see:
+        its own commits, the membership table, the last signal poll."""
+        epoch = max(self._epoch, self._signals_epoch)
+        if self.membership is not None:
+            epoch = max(epoch, int(self.membership.epoch))
+        return epoch
+
+    def _fence(self, planned: ActionRecord, action: Action,
+               fleet_epoch: int) -> ActionRecord:
+        """Journal the loss of an epoch race and latch self-fencing."""
+        self.fenced = True
+        self.fence_events += 1
+        self._epoch = max(self._epoch, fleet_epoch)
+        fenced = ActionRecord(
+            action_id=planned.action_id, seq=0, ts=self._clock(),
+            phase=PHASE_FENCED, kind=action.kind, target=action.target,
+            params=dict(action.params), reason=action.reason,
+            signal=dict(action.signal),
+            result={"ok": False, "fenced": True,
+                    "proposed_epoch": int(planned.epoch),
+                    "fleet_epoch": int(fleet_epoch)},
+            epoch=planned.epoch,
+        )
+        fenced = self._journal(fenced)
+        CTRL_ACTIONS.labels(action.kind, PHASE_FENCED).inc()
+        record_fence_rejection("controller.commit", "stale_epoch")
+        fr_record(KIND_FENCE, {
+            "site": "controller.commit", "reason": "stale_epoch",
+            "action_id": planned.action_id,
+            "proposed_epoch": int(planned.epoch),
+            "fleet_epoch": int(fleet_epoch),
+        })
+        self._pending = [p for p in self._pending
+                         if p.action_id != planned.action_id]
+        CTRL_INFLIGHT.set(len(self._pending))
+        self._history.append(fenced.to_wire())
+        logger.warning(
+            "action %s fenced: proposed epoch %d but fleet already "
+            "committed %d — another controller won the race; this "
+            "controller self-fences until restart",
+            planned.action_id, planned.epoch, fleet_epoch)
+        return fenced
 
     # -- action execution --------------------------------------------------
 
     def _execute(self, action: Action) -> ActionRecord:
-        """planned → actuate → executed/failed, traced and journaled."""
-        planned = self._record(action, PHASE_PLANNED)
+        """planned → actuate → executed/failed, traced and journaled.
+
+        Topology mutations are two-phase: *propose* journals ``planned``
+        with epoch ``fleet+1``; *commit* re-reads the fleet epoch right
+        before actuating and abandons the action (``fenced`` record,
+        self-fence latch) if a rival controller committed the contested
+        epoch in between — at most one controller's mutation lands per
+        epoch, no matter how many believe they are the leader.
+        """
+        topology = action.kind in TOPOLOGY_KINDS
+        proposed = self._fleet_epoch() + 1 if topology else None
+        planned = self._record(action, PHASE_PLANNED, epoch=proposed)
         CTRL_ACTIONS.labels(action.kind, PHASE_PLANNED).inc()
         self._pending.append(planned)
         CTRL_INFLIGHT.set(len(self._pending))
+        if topology:
+            stall = failpoints.pause_seconds(FP_COMMIT_PREFIX + action.target)
+            if stall:
+                logger.warning(
+                    "action %s stalled %.3fs between propose and commit "
+                    "(failpoint)", planned.action_id, stall)
+            fleet = max(self._signals_epoch,
+                        int(self.membership.epoch)
+                        if self.membership is not None else 0)
+            if fleet >= proposed:
+                return self._fence(planned, action, fleet)
         try:
             with tracer().span(
                 SPAN_ACTION,
@@ -208,6 +308,13 @@ class FleetController:
                 result = self.actuator.apply(action)
             phase, payload = PHASE_EXECUTED, {"ok": True, **(result or {})}
             self._charge_budget()
+            if topology:
+                # Commit: the new epoch becomes the fleet's, and every
+                # peer learns it by piggyback on the next RPC it sees.
+                self._epoch = proposed
+                if self.membership is not None:
+                    self.membership.observe_epoch(
+                        proposed, source="controller.commit")
         except Exception as exc:
             phase, payload = PHASE_FAILED, {"ok": False, "error": repr(exc)}
             logger.warning("action %s failed: %r", planned.action_id, exc)
@@ -222,6 +329,7 @@ class FleetController:
             reason=action.reason,
             signal=dict(action.signal),
             result=payload,
+            epoch=planned.epoch,
         )
         settled = self._journal(settled)
         CTRL_ACTIONS.labels(action.kind, phase).inc()
@@ -266,6 +374,12 @@ class FleetController:
     def _resolve_pending(self, signals: FleetSignals) -> None:
         pending, self._pending = self._pending, []
         for rec in pending:
+            if self.fenced:
+                # Lost an epoch race earlier in this resolution pass:
+                # keep the rest in-flight for the winner (or a restart)
+                # to verify — a fenced controller executes nothing.
+                self._pending.append(rec)
+                continue
             action = Action(kind=rec.kind, target=rec.target,
                             params=dict(rec.params),
                             reason=f"resume in-flight: {rec.reason}",
@@ -278,6 +392,7 @@ class FleetController:
                     signal=dict(rec.signal),
                     result={"ok": True, "resumed": True,
                             "already_applied": True},
+                    epoch=rec.epoch,
                 )
                 settled = self._journal(settled)
                 CTRL_ACTIONS.labels(rec.kind, PHASE_EXECUTED).inc()
@@ -285,6 +400,22 @@ class FleetController:
                 logger.info("in-flight action %s already applied; settled "
                             "without re-executing", rec.action_id)
                 continue
+            if rec.kind in TOPOLOGY_KINDS and rec.epoch:
+                # Warm-restart split-brain check: this controller died
+                # between propose and commit. If the fleet meanwhile
+                # committed the proposed epoch (or beyond) — and the
+                # world does *not* reflect our plan — a rival won it;
+                # re-executing now would mutate topology under a stale
+                # epoch. Fence instead.
+                fleet = max(self._signals_epoch,
+                            int(self.membership.epoch)
+                            if self.membership is not None else 0)
+                if fleet >= rec.epoch:
+                    self._fence(rec, Action(
+                        kind=rec.kind, target=rec.target,
+                        params=dict(rec.params), reason=rec.reason,
+                        signal=dict(rec.signal)), fleet)
+                    continue
             if self.cfg.dry_run:
                 self._dry_run(action)
                 continue
@@ -304,11 +435,34 @@ class FleetController:
         with self._mu:
             with tracer().span(SPAN_RECONCILE, dry_run=self.cfg.dry_run):
                 signals = self.source.poll()
+                self._signals_epoch = max(self._signals_epoch,
+                                          int(getattr(signals, "epoch", 0)))
+                if self.membership is not None and self._signals_epoch:
+                    self.membership.observe_epoch(
+                        self._signals_epoch, source="controller.poll")
+                if self.fenced:
+                    # A fenced controller observes but never mutates: the
+                    # epoch race proved a rival is actuating, and two
+                    # hands on the same topology is the failure mode this
+                    # plane exists to prevent. Restart to re-admit.
+                    self.rounds += 1
+                    CTRL_ROUNDS.inc()
+                    return {
+                        "ts": signals.ts,
+                        "proposed": 0,
+                        "settled": [],
+                        "budget_deferred": 0,
+                        "pending": [r.action_id for r in self._pending],
+                        "dry_run": self.cfg.dry_run,
+                        "fenced": True,
+                    }
                 self._resolve_pending(signals)
                 proposed = self.policy.decide(signals)
                 executed: List[str] = []
                 deferred = 0
                 for action in proposed:
+                    if self.fenced:
+                        break
                     if self.cfg.dry_run:
                         rec = self._dry_run(action)
                         executed.append(rec.action_id)
@@ -323,7 +477,11 @@ class FleetController:
                             self.cfg.budget_window_s, action.describe())
                         continue
                     rec = self._execute(action)
-                    executed.append(rec.action_id)
+                    if rec.phase != PHASE_FENCED:
+                        # A fenced action never landed — it lost the epoch
+                        # race, so it is settled in the journal but not a
+                        # mutation this round performed.
+                        executed.append(rec.action_id)
                 self.rounds += 1
                 CTRL_ROUNDS.inc()
                 return {
@@ -333,6 +491,7 @@ class FleetController:
                     "budget_deferred": deferred,
                     "pending": [r.action_id for r in self._pending],
                     "dry_run": self.cfg.dry_run,
+                    "fenced": self.fenced,
                 }
 
     def start(self) -> None:
@@ -370,6 +529,12 @@ class FleetController:
                 "dry_run": self.cfg.dry_run,
                 "rounds": self.rounds,
                 "resumed_records": self.resumed_records,
+                "epoch": {
+                    "current": self._epoch,
+                    "fleet": self._fleet_epoch(),
+                    "fenced": self.fenced,
+                    "fence_events": self.fence_events,
+                },
                 "budget": {
                     "limit": self.cfg.action_budget,
                     "window_s": self.cfg.budget_window_s,

@@ -47,6 +47,10 @@ PHASE_PLANNED = "planned"
 PHASE_EXECUTED = "executed"
 PHASE_FAILED = "failed"
 PHASE_WOULD_ACT = "would_act"
+# The proposer lost the epoch race: a newer committed topology epoch was
+# observed between propose (``planned``) and commit, so the action was
+# abandoned and this controller self-fenced (split-brain loser's record).
+PHASE_FENCED = "fenced"
 
 
 def _jsonable(obj) -> object:
@@ -65,13 +69,17 @@ class ActionRecord:
     action_id: str
     seq: int
     ts: float
-    phase: str  # planned|executed|failed|would_act
+    phase: str  # planned|executed|failed|would_act|fenced
     kind: str
     target: str
     params: dict = field(default_factory=dict)
     reason: str = ""
     signal: dict = field(default_factory=dict)
     result: dict = field(default_factory=dict)
+    # Topology epoch the action proposes/committed (two-phase controller
+    # mutations). 0 on records written before the epoch plane existed —
+    # decoded tolerantly like params/reason, so old journals replay.
+    epoch: int = 0
 
     def to_wire(self) -> dict:
         return {
@@ -85,6 +93,7 @@ class ActionRecord:
             "reason": self.reason,
             "signal": _jsonable(self.signal or {}),
             "result": _jsonable(self.result or {}),
+            "epoch": int(self.epoch),
         }
 
     @classmethod
@@ -100,6 +109,7 @@ class ActionRecord:
             reason=str(data.get("reason", "")),
             signal=dict(data.get("signal") or {}),
             result=dict(data.get("result") or {}),
+            epoch=int(data.get("epoch", 0) or 0),
         )
 
 
@@ -190,11 +200,13 @@ class ActionJournal:
 
 
 def unresolved_actions(records: List[ActionRecord]) -> List[ActionRecord]:
-    """``planned`` records with no later ``executed``/``failed`` for the
-    same action id — the in-flight actions a restart must re-verify."""
+    """``planned`` records with no later ``executed``/``failed``/``fenced``
+    for the same action id — the in-flight actions a restart must
+    re-verify. A fenced action is settled: a newer topology epoch already
+    won, so replay must not resurrect it."""
     settled = {
         r.action_id for r in records
-        if r.phase in (PHASE_EXECUTED, PHASE_FAILED)
+        if r.phase in (PHASE_EXECUTED, PHASE_FAILED, PHASE_FENCED)
     }
     out: List[ActionRecord] = []
     seen: set = set()

@@ -63,6 +63,11 @@ class FleetSignals:
     # Topology.
     shards: Tuple[str, ...] = ()
     roles: Dict[str, str] = field(default_factory=dict)
+    # Highest committed topology epoch observed across the fleet
+    # (cluster.membership). 0 when the deployment predates the epoch
+    # plane. The controller fences its own proposals against this: a
+    # proposal whose epoch the fleet already reached lost the race.
+    epoch: int = 0
 
     def burn(self, slo_name: str) -> float:
         return float((self.slo.get(slo_name) or {}).get("burn_slow", 0.0))
@@ -109,6 +114,7 @@ class FleetSignals:
             } if self.audit else {},
             "shards": list(self.shards),
             "roles": dict(self.roles),
+            "epoch": int(self.epoch),
         }
 
 
@@ -125,6 +131,7 @@ class CollectorSignalSource:
         shards: Optional[Callable[[], List[str]]] = None,
         roles: Optional[Callable[[], Dict[str, str]]] = None,
         shedders: Optional[Callable[[], Dict[str, dict]]] = None,
+        membership=None,
         clock: Callable[[], float] = time.time,
     ):
         if collector is None and slo_registry is None:
@@ -138,6 +145,9 @@ class CollectorSignalSource:
         # site -> CoDelShedder.stats() dict; typically
         # ``lambda: {s.site: s.stats() for s in shedders}``.
         self._shedders = shedders or (lambda: {})
+        # Optional cluster.membership.MembershipTable (the local fleet
+        # epoch authority) so polls carry the committed topology epoch.
+        self._membership = membership
         self._clock = clock
         self._edge_cursor = -1
 
@@ -194,4 +204,6 @@ class CollectorSignalSource:
             audit=audit,
             shards=tuple(self._shards()),
             roles=dict(self._roles()),
+            epoch=(int(self._membership.epoch)
+                   if self._membership is not None else 0),
         )

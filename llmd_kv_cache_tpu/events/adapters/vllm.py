@@ -67,10 +67,19 @@ class VLLMAdapter:
         if len(decoded) > 3 and isinstance(decoded[3], str):
             traceparent = decoded[3]
 
+        # Wire element [4]: publisher's topology epoch (cluster.membership)
+        # — 0/absent from engines that predate the epoch plane.
+        epoch = 0
+        if len(decoded) > 4 and decoded[4] is not None:
+            try:
+                epoch = int(decoded[4])
+            except (TypeError, ValueError):
+                epoch = 0
+
         events = [self._decode_event(raw) for raw in raw_events]
         return pod_id, model_name, EventBatch(
             timestamp=ts, events=events, data_parallel_rank=dp_rank,
-            traceparent=traceparent,
+            traceparent=traceparent, epoch=epoch,
         )
 
     def _decode_event(self, raw: Any) -> GenericEvent:

@@ -109,12 +109,18 @@ class EventBatch:
     ZMQ hop (wire element [3], after dp_rank) so ingest spans parent into
     the trace that caused the cache mutation; None when the publisher was
     untraced or the engine predates the field.
+
+    ``epoch`` is the publishing pod's topology epoch (wire element [4],
+    after traceparent; cluster.membership) — the ingest fence rejects or
+    flags batches from pods whose view of the fleet is stale. 0 when the
+    publisher predates the epoch plane (never fenced).
     """
 
     timestamp: float
     events: list[GenericEvent]
     data_parallel_rank: Optional[int] = None
     traceparent: Optional[str] = None
+    epoch: int = 0
 
 
 class EngineAdapter(Protocol):

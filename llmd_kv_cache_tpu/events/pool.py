@@ -218,6 +218,14 @@ class Pool:
         # with (pod_id, sequence, topic, payload, event_ts) for every
         # successfully parsed live message.
         self.journal_sink = None
+        # Epoch-fenced membership (cluster.membership.MembershipTable,
+        # attach_membership): live batches are write-fenced against the
+        # publishing pod's lease + stamped epoch; a zombie's post-lease
+        # writes never reach the index. Replay (warm restart) bypasses
+        # the fence — those writes were already accepted once.
+        self.membership = None
+        self.fenced_batches = 0
+        self._replaying = False
         self._tracer = tracer()
         self._recorder = flight_recorder()
 
@@ -631,8 +639,13 @@ class Pool:
         traffic. The journal sink must not be attached yet, or replayed
         records would be re-journaled.
         """
-        self._process_raw_message(RawMessage(topic=topic, sequence=sequence,
-                                             payload=payload))
+        self._replaying = True
+        try:
+            self._process_raw_message(RawMessage(topic=topic,
+                                                 sequence=sequence,
+                                                 payload=payload))
+        finally:
+            self._replaying = False
 
     def seed_sequences(self, pod_seqs: dict, event_ts: float) -> None:
         """Seed per-pod watermarks from a snapshot (recovery.manager).
@@ -671,12 +684,19 @@ class Pool:
             oldest = min(st["last_event_ts"] for st in self._pod_lag.values())
         return max(0.0, now - oldest)
 
+    def attach_membership(self, membership) -> None:
+        """Enable the ingest write fence: every live batch is checked
+        against ``membership`` (publisher lease validity + stamped epoch)
+        before its events touch the index."""
+        self.membership = membership
+
     def data_plane_debug(self) -> dict:
         """Zero-copy / shm-ring ingest counters (kvdiag ``data_plane``)."""
         with self._stats_mu:
             return {
                 "zerocopy_batches": self.zerocopy_batches,
                 "shm_messages": self.shm_messages,
+                "fenced_batches": self.fenced_batches,
             }
 
     def lag_stats(self) -> dict:
@@ -716,6 +736,20 @@ class Pool:
         during batched worker drains; all index writes/reads route through
         it so consecutive digests can be write-combined.
         """
+        if self.membership is not None and not self._replaying:
+            # Zombie fence (cluster.membership): a publisher whose lease
+            # lapsed — a pod that stalled past its TTL and resumed — or
+            # whose stamped epoch is stale gets its writes dropped (or
+            # flagged, per fenceMode) BEFORE they can poison the index
+            # with placement the fleet no longer agrees on.
+            fence = self.membership.check_write(
+                pod_identifier, batch.epoch, "events.ingest")
+            if not fence.allowed:
+                self.fenced_batches += 1
+                logger.warning(
+                    "dropped fenced event batch from pod %s (%s; epoch=%d)",
+                    pod_identifier, fence.reason, batch.epoch)
+                return
         if (
             self.cfg.track_dp_rank
             and batch.data_parallel_rank is not None

@@ -104,21 +104,26 @@ class KVEventPublisher:
         timestamp: Optional[float] = None,
         data_parallel_rank: Optional[int] = None,
         traceparent: Optional[str] = None,
+        epoch: int = 0,
     ) -> int:
         """Publish one batch; returns the sequence number used.
 
         The ambient W3C trace context (or an explicit ``traceparent``)
-        rides as wire element [3]; length-tolerant adapters on old
-        subscribers ignore it, so the wire stays engine-compatible.
+        rides as wire element [3]; the publisher's topology epoch
+        (``epoch`` > 0; cluster.membership) as wire element [4], with
+        absent middle elements padded nil. Length-tolerant adapters on
+        old subscribers ignore both, so the wire stays engine-compatible.
         """
         ts = timestamp if timestamp is not None else time.time()
         if traceparent is None:
             traceparent = current_traceparent()
         batch: list = [ts, [encode_event(e) for e in events]]
-        if data_parallel_rank is not None or traceparent is not None:
+        if data_parallel_rank is not None or traceparent is not None or epoch:
             batch.append(data_parallel_rank)
-        if traceparent is not None:
+        if traceparent is not None or epoch:
             batch.append(traceparent)
+        if epoch:
+            batch.append(int(epoch))
         payload = msgpack.packb(batch, use_bin_type=True)
         with self._lock:
             seq = self._seq

@@ -951,6 +951,52 @@ def record_divergence_healed(age_s: float) -> None:
 
 
 # --------------------------------------------------------------------------
+# Epoch-fenced membership plane (kvtpu_fence_* / kvtpu_topology_* /
+# kvtpu_lease_*): the fencing-token discipline in cluster.membership.
+# Every fence decision that refuses (or would refuse, in warn mode) a
+# stale actor's traffic counts here by receiving site and reason; the
+# topology-epoch gauge tracks the newest epoch this process has observed
+# (minted by the controller, learned by piggyback); the lease families
+# track the renewable pod leases that turn "probably dead" into
+# "provably fenced".
+# --------------------------------------------------------------------------
+
+FENCE_REJECTIONS = Counter(
+    "kvtpu_fence_rejections_total",
+    "Stale-epoch / lapsed-lease traffic refused (or flagged in warn mode)",
+    ["site", "reason"],
+)
+TOPOLOGY_EPOCH = Gauge(
+    "kvtpu_topology_epoch",
+    "Newest fleet topology epoch observed by this process",
+)
+LEASE_ACTIVE = Gauge(
+    "kvtpu_lease_active",
+    "Pod leases currently within their TTL",
+)
+LEASE_RENEWALS = Counter(
+    "kvtpu_lease_renewals_total",
+    "Successful pod lease renewals",
+)
+LEASE_EXPIRED = Counter(
+    "kvtpu_lease_expired_total",
+    "Pod leases that lapsed past their TTL (zombie fence armed)",
+)
+LEASE_READMISSIONS = Counter(
+    "kvtpu_lease_readmissions_total",
+    "Lapsed pods re-admitted through the warm-restart gate",
+)
+
+
+def record_fence_rejection(site: str, reason: str) -> None:
+    FENCE_REJECTIONS.labels(site, reason).inc()
+
+
+def record_topology_epoch(epoch: int) -> None:
+    TOPOLOGY_EPOCH.set(max(int(epoch), 0))
+
+
+# --------------------------------------------------------------------------
 # Cache-efficiency ledger export (kvtpu_cache_ledger_*): the per-pod
 # appearance/win/stored/evicted attribution the Indexer already keeps
 # (scoring.indexer.CacheEfficiencyLedger), exported as metric families via

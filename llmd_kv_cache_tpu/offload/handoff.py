@@ -63,6 +63,10 @@ class HandoffState:
     failed: bool = False
     finished: Optional[float] = None
     traceparent: Optional[str] = None
+    # Topology epoch the handoff was paired under (cluster.membership);
+    # 0 = pre-epoch caller. A pairing planned under a retired topology is
+    # suspect — the decode pod may no longer own the transferred range.
+    epoch: int = 0
 
 
 class HandoffCoordinator:
@@ -131,6 +135,7 @@ class HandoffCoordinator:
         decode_pod: str,
         total_blocks: int,
         traceparent: Optional[str] = None,
+        epoch: int = 0,
     ) -> HandoffState:
         st = HandoffState(
             request_id=request_id,
@@ -139,6 +144,7 @@ class HandoffCoordinator:
             total_blocks=max(int(total_blocks), 0),
             started=time.monotonic(),
             traceparent=traceparent,
+            epoch=int(epoch),
         )
         with self._mu:
             self._states[request_id] = st
@@ -150,6 +156,7 @@ class HandoffCoordinator:
                 prefill_pod=prefill_pod,
                 decode_pod=decode_pod,
                 total_blocks=st.total_blocks,
+                epoch=st.epoch,
                 process=prefill_pod,
             ):
                 pass  # event-style span: marks the pairing decision

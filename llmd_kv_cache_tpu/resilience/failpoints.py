@@ -12,12 +12,17 @@ environment:
     KVTPU_FAILPOINT_SEED=1234
 
 Spec grammar per failpoint:
-``name=mode[:p=<prob>][:times=<n>][:delay=<s>|delay_ms=<n>][:jitter=<s>|jitter_ms=<n>]``
+``name=mode[:p=<prob>][:times=<n>][:delay=<s>|delay_ms=<n>][:jitter=<s>|jitter_ms=<n>][:pause=<s>|pause_ms=<n>]``
 with modes ``error`` (raise :class:`FaultInjected`), ``delay`` (sleep),
-and ``custom`` (``should_fire`` returns True; the call site decides what
-the fault looks like — e.g. flipping bytes to tear a file). ``jitter``
-adds a uniform ``[0, jitter]`` extension to each sleep, modeling the
-wandering latency of a gray-failing pod rather than a fixed stall.
+``custom`` (``should_fire`` returns True; the call site decides what
+the fault looks like — e.g. flipping bytes to tear a file), and
+``pause`` (a *virtual* stop-the-world stall: :meth:`pause_seconds`
+returns the armed duration without ever sleeping, so chaos tests
+simulate a GC-paused zombie by aging its lease/clock deterministically
+instead of stalling the test for real). ``jitter`` adds a uniform
+``[0, jitter]`` extension to each sleep — and to each virtual pause —
+modeling the wandering latency of a gray-failing pod rather than a
+fixed stall.
 
 Determinism: probabilistic firing draws from a registry-owned
 ``random.Random`` seeded at construction (``KVTPU_FAILPOINT_SEED``,
@@ -46,8 +51,9 @@ ENV_SEED = "KVTPU_FAILPOINT_SEED"
 MODE_ERROR = "error"
 MODE_DELAY = "delay"
 MODE_CUSTOM = "custom"
+MODE_PAUSE = "pause"
 
-_MODES = (MODE_ERROR, MODE_DELAY, MODE_CUSTOM)
+_MODES = (MODE_ERROR, MODE_DELAY, MODE_CUSTOM, MODE_PAUSE)
 
 
 class FaultInjected(RuntimeError):
@@ -70,6 +76,7 @@ class _Failpoint:
     times: int | None = None  # remaining firings; None = unlimited
     delay_s: float = 0.0
     jitter_s: float = 0.0  # uniform [0, jitter_s) added to each sleep
+    pause_s: float = 0.0  # virtual stall length for MODE_PAUSE (never slept)
     rng: random.Random | None = None  # per-point RNG for jitter draws
     hits: int = 0  # times the hook was reached
     fired: int = 0  # times the fault actually triggered
@@ -120,6 +127,7 @@ class FailpointRegistry:
         times: int | None = None,
         delay_s: float = 0.0,
         jitter_s: float = 0.0,
+        pause_s: float = 0.0,
     ) -> None:
         if mode not in _MODES:
             raise ValueError(f"unknown failpoint mode {mode!r}; expected one of {_MODES}")
@@ -127,13 +135,16 @@ class FailpointRegistry:
             raise ValueError(f"probability must be in [0, 1], got {probability}")
         if jitter_s < 0.0:
             raise ValueError(f"jitter_s must be >= 0, got {jitter_s}")
+        if pause_s < 0.0:
+            raise ValueError(f"pause_s must be >= 0, got {pause_s}")
         with self._lock:
             # Per-point RNG keyed off (seed, name): jitter schedules replay
             # per-point regardless of cross-point thread interleaving.
             rng = random.Random(f"{self._seed}:{name}") if jitter_s > 0 else None
             self._points[name] = _Failpoint(
                 name=name, mode=mode, probability=probability,
-                times=times, delay_s=delay_s, jitter_s=jitter_s, rng=rng,
+                times=times, delay_s=delay_s, jitter_s=jitter_s,
+                pause_s=pause_s, rng=rng,
             )
         logger.debug("armed failpoint %s mode=%s p=%s times=%s", name, mode, probability, times)
 
@@ -160,7 +171,8 @@ class FailpointRegistry:
 
     def _arm_from_spec(self, spec: str) -> None:
         name, _, rest = spec.partition("=")
-        mode, probability, times, delay_s, jitter_s = MODE_ERROR, 1.0, None, 0.0, 0.0
+        mode, probability, times = MODE_ERROR, 1.0, None
+        delay_s, jitter_s, pause_s = 0.0, 0.0, 0.0
         for tok in filter(None, rest.split(":")):
             if tok in _MODES:
                 mode = tok
@@ -176,10 +188,16 @@ class FailpointRegistry:
                 jitter_s = float(tok[10:]) / 1e3
             elif tok.startswith("jitter="):
                 jitter_s = float(tok[7:])
+            elif tok.startswith("pause_ms="):
+                mode, pause_s = MODE_PAUSE, float(tok[9:]) / 1e3
+            elif tok.startswith("pause="):
+                # A duration implies the mode: ``name=pause=12`` and
+                # ``name=pause:pause=12`` both arm a 12 s virtual stall.
+                mode, pause_s = MODE_PAUSE, float(tok[6:])
             else:
                 raise ValueError(f"bad failpoint spec token {tok!r} in {spec!r}")
         self.arm(name, mode=mode, probability=probability, times=times,
-                 delay_s=delay_s, jitter_s=jitter_s)
+                 delay_s=delay_s, jitter_s=jitter_s, pause_s=pause_s)
 
     # -- introspection ----------------------------------------------------
 
@@ -217,6 +235,26 @@ class FailpointRegistry:
         if fired:
             self._notify(name)
         return fired
+
+    def pause_seconds(self, name: str) -> float:
+        """Pause-mode check: the virtual stall to apply, 0.0 when quiet.
+
+        Never sleeps — the call site ages its own clock (a lease's last
+        renewal, a liveness stamp) by the returned seconds, exactly what a
+        stop-the-world GC pause of that length would have done to it.
+        Seeded jitter extends the stall the same way it extends delay-mode
+        sleeps, so a chaos run's pause schedule replays identically.
+        """
+        fp = self._roll(name)
+        if fp is None or fp.mode != MODE_PAUSE:
+            return 0.0
+        self._notify(name)
+        logger.warning("failpoint %s fired (mode=%s, count=%d)", name, fp.mode, fp.fired)
+        stall = fp.pause_s
+        if fp.jitter_s > 0.0 and fp.rng is not None:
+            with fp.lock:
+                stall += fp.rng.uniform(0.0, fp.jitter_s)
+        return stall
 
     def hit(self, name: str) -> None:
         """Standard hook: raise/sleep per the armed mode, no-op otherwise."""

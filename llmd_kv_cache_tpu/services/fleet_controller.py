@@ -159,7 +159,17 @@ class RemoteSignalSource:
             audit = self._get(self.collector_address, "/debug/audit") or {}
         roles: Dict[str, str] = {}
         handoff: dict = {}
+        epoch = 0
         for pod, address in self.pod_admin.items():
+            # Per-pod membership view: the max committed epoch across the
+            # fleet is the controller's fence source — a warm-restarted
+            # controller learns where topology actually is before acting.
+            mem = self._get(address, "/debug/membership")
+            if mem:
+                try:
+                    epoch = max(epoch, int(mem.get("epoch", 0) or 0))
+                except (TypeError, ValueError):  # lint: allow-swallow (malformed epoch from one pod degrades to unstamped, not a dead poll)
+                    pass
             view = self._get(address, "/debug/role")
             if not view:
                 continue
@@ -193,6 +203,7 @@ class RemoteSignalSource:
             audit=audit,
             shards=tuple(self._shards()),
             roles=roles,
+            epoch=epoch,
         )
 
 
@@ -206,6 +217,7 @@ class FleetControllerService:
         add_shard: Optional[Callable[[str], object]] = None,
         remove_shard: Optional[Callable[[str], object]] = None,
         clock: Callable[[], float] = time.time,
+        membership=None,
     ):
         self.cfg = cfg
         self.source = RemoteSignalSource(
@@ -222,7 +234,8 @@ class FleetControllerService:
             timeout_s=cfg.http_timeout_s,
         )
         self.controller = FleetController(
-            self.source, self.actuator, config=cfg.controller, clock=clock)
+            self.source, self.actuator, config=cfg.controller, clock=clock,
+            membership=membership)
         self._admin: Optional[AdminServer] = None
 
     def start(self) -> None:

@@ -180,6 +180,12 @@ class ScoreRequest:
     # (the default — also what an old peer's absent field decodes to),
     # 2 critical (never shed).
     priority: int = 1
+    # Sender's topology epoch (cluster.membership). 0/absent = an
+    # unstamped (pre-epoch) peer, never fenced; a stamp older than the
+    # server's epoch is rejected or flagged per ``fenceMode``, and a
+    # newer stamp teaches the server the fleet moved on (piggyback
+    # gossip). Same tolerance pattern as ``deadline_ms``.
+    epoch: int = 0
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
@@ -191,6 +197,7 @@ class ScoreRequest:
                 "role": self.role,
                 "deadline_ms": self.deadline_ms,
                 "priority": self.priority,
+                "epoch": self.epoch,
             },
             use_bin_type=True,
         )
@@ -206,6 +213,10 @@ class ScoreRequest:
             priority = int(d.get("priority", 1))
         except (TypeError, ValueError):
             priority = 1
+        try:
+            epoch = int(d.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            epoch = 0
         return cls(
             tokens=list(d.get("tokens", [])),
             model_name=d.get("model_name", ""),
@@ -214,6 +225,7 @@ class ScoreRequest:
             role=d.get("role", "") or "",
             deadline_ms=deadline_ms,
             priority=priority,
+            epoch=epoch,
         )
 
 
@@ -248,8 +260,15 @@ class ScoreResponse:
     # Why ``degraded`` is set, when the server knows: "" (not degraded, or
     # an older server), "warmup", "brownout" (overload — residency fold-in
     # skipped), "shed" (overload — not scored), "deadline" (the request's
-    # budget expired in-flight). Same tolerance pattern as ``shard``.
+    # budget expired in-flight), "fenced" (the request carried a stale
+    # topology epoch and ``fenceMode: reject`` refused it). Same
+    # tolerance pattern as ``shard``.
     degraded_reason: str = ""
+    # The answering server's topology epoch (cluster.membership) — the
+    # piggyback half of epoch gossip: a caller seeing a higher epoch than
+    # it pinned learns the fleet moved on without any new RPC surface.
+    # 0/absent = a pre-epoch server. Same tolerance pattern as ``shard``.
+    epoch: int = 0
 
     def to_bytes(self) -> bytes:
         return msgpack.packb(
@@ -257,13 +276,18 @@ class ScoreResponse:
              "degraded": self.degraded, "traceparent": self.traceparent,
              "shard": self.shard, "degraded_shards": self.degraded_shards,
              "residency": self.residency,
-             "degraded_reason": self.degraded_reason},
+             "degraded_reason": self.degraded_reason,
+             "epoch": self.epoch},
             use_bin_type=True,
         )
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "ScoreResponse":
         d = msgpack.unpackb(b, raw=False)
+        try:
+            epoch = int(d.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            epoch = 0
         return cls(
             scores=dict(d.get("scores", {})),
             error=d.get("error", ""),
@@ -273,6 +297,7 @@ class ScoreResponse:
             degraded_shards=[str(s) for s in d.get("degraded_shards", [])],
             residency=dict(d.get("residency", {})),
             degraded_reason=d.get("degraded_reason", "") or "",
+            epoch=epoch,
         )
 
 
@@ -392,11 +417,22 @@ class IndexerService:
                 cc.shard_id,
                 replication_factor=cc.replication_factor,
             )
+        # Epoch-fenced membership (cluster.membership): the pod's view of
+        # the fleet topology epoch plus its own lease. Score/lookup
+        # requests are fenced against it and the event pool consults it
+        # before accepting writes; fenceMode decides reject vs flag.
+        self.membership = None
+        if cc is not None and cc.enabled:
+            from ..cluster.membership import MembershipTable
+
+            self.membership = MembershipTable.from_cluster_config(cc)
         self.pool = Pool(
             self.pool_config,
             self.shard_index or self.indexer.kv_block_index,
             self.indexer.token_processor,
         )
+        if self.membership is not None:
+            self.pool.attach_membership(self.membership)
         self.subscriber_manager = SubscriberManager(
             self.pool.add_task, topic_filter=self.pool_config.topic_filter
         )
@@ -569,6 +605,8 @@ class IndexerService:
             pass
         if self.shard_index is not None:
             providers["shard"] = self.shard_index.debug_view
+        if self.membership is not None:
+            providers["membership"] = self.membership.debug_view
         if self.shedder is not None:
             providers["shed"] = self.shedder.stats
         health = None
@@ -709,10 +747,16 @@ class IndexerService:
 
     # -- RPC --
 
+    def _epoch_stamp(self) -> int:
+        """This pod's topology epoch for response piggybacking (0 when
+        the membership plane is off — absent-field tolerant)."""
+        return int(self.membership.epoch) if self.membership is not None else 0
+
     def _shed_response(self, reason: str, error: str = "") -> ScoreResponse:
         return ScoreResponse(
             error=error, degraded=True, degraded_reason=reason,
             traceparent=current_traceparent() or "", shard=self.shard_id,
+            epoch=self._epoch_stamp(),
         )
 
     def _record_shed(self, site: str, outcome: str, priority: int) -> None:
@@ -750,6 +794,18 @@ class IndexerService:
                     return self._shed_response(
                         "deadline", error="deadline expired before scoring"
                     )
+                if self.membership is not None:
+                    # Epoch fence: learn a newer stamp, reject (or flag,
+                    # per fenceMode) a stale one — a router still scoring
+                    # against a retired ring plan must re-learn topology,
+                    # not route on answers sliced for the old placement.
+                    fence = self.membership.check_request(req.epoch, "score")
+                    if not fence.allowed:
+                        return self._shed_response(
+                            "fenced",
+                            error=f"stale topology epoch {req.epoch} "
+                                  f"(fleet at {fence.epoch})",
+                        )
                 role = req.role
                 brownout = False
                 if self.shedder is not None:
@@ -792,7 +848,8 @@ class IndexerService:
                                      traceparent=current_traceparent() or "",
                                      shard=self.shard_id,
                                      residency=detail.get("residency", {}),
-                                     degraded_reason=reason)
+                                     degraded_reason=reason,
+                                     epoch=self._epoch_stamp())
             except DeadlineExceeded as e:
                 self._record_shed("indexer.score", "deadline", req.priority)
                 return self._shed_response("deadline", error=str(e))
@@ -833,7 +890,18 @@ class IndexerService:
                                   PRIORITY_NORMAL)
                 return {"hits": [], "degraded": True,
                         "shard": self.shard_id,
-                        "degraded_reason": "deadline"}
+                        "degraded_reason": "deadline",
+                        "epoch": self._epoch_stamp()}
+            if self.membership is not None:
+                fence = self.membership.check_request(
+                    int(req.get("epoch", 0) or 0), "shard.lookup")
+                if not fence.allowed:
+                    # Epoch-fenced: empty-but-flagged, carrying our newer
+                    # epoch so the stale caller learns and re-plans.
+                    return {"hits": [], "degraded": True,
+                            "shard": self.shard_id,
+                            "degraded_reason": "fenced",
+                            "epoch": self._epoch_stamp()}
             hits: list = []
             if keys:
                 found = self.indexer.kv_block_index.lookup(
@@ -844,7 +912,8 @@ class IndexerService:
                     for k, entries in found.items()
                 ]
             degraded = self.recovery is not None and not self.recovery.ready
-            return {"hits": hits, "degraded": degraded, "shard": self.shard_id}
+            return {"hits": hits, "degraded": degraded,
+                    "shard": self.shard_id, "epoch": self._epoch_stamp()}
 
     def lookup_blocks_batch_rpc(self, req: dict, context=None) -> dict:
         """Framed multi-chunk lookup: the batched fan-out data plane.
@@ -881,7 +950,16 @@ class IndexerService:
                                   PRIORITY_NORMAL)
                 return {"chunks": [], "cont": [], "degraded": True,
                         "shard": self.shard_id,
-                        "degraded_reason": "deadline"}
+                        "degraded_reason": "deadline",
+                        "epoch": self._epoch_stamp()}
+            if self.membership is not None:
+                fence = self.membership.check_request(
+                    int(req.get("epoch", 0) or 0), "shard.lookup")
+                if not fence.allowed:
+                    return {"chunks": [], "cont": [], "degraded": True,
+                            "shard": self.shard_id,
+                            "degraded_reason": "fenced",
+                            "epoch": self._epoch_stamp()}
             podset = set(pods) if pods else None
             out_chunks: list = []
             cont: list = []
@@ -899,7 +977,8 @@ class IndexerService:
                     break
             degraded = self.recovery is not None and not self.recovery.ready
             return {"chunks": out_chunks, "cont": cont,
-                    "degraded": degraded, "shard": self.shard_id}
+                    "degraded": degraded, "shard": self.shard_id,
+                    "epoch": self._epoch_stamp()}
 
     def list_pods_rpc(self, req: dict, context=None) -> dict:
         return {
@@ -1028,7 +1107,8 @@ class IndexerServiceClient:
     """Scheduler-side client for GetPodScores."""
 
     def __init__(self, address: str, timeout_s: float = 5.0,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 membership=None):
         # Shared refcounted channel (services.channel_pool): constructing
         # many clients against the same indexer no longer pays per-client
         # TCP+HTTP/2 setup.
@@ -1036,6 +1116,10 @@ class IndexerServiceClient:
         self._channel = channel_pool.acquire(address)
         self._timeout = timeout_s
         self.retry_policy = retry_policy or DEFAULT_RPC_RETRY_POLICY
+        # Optional cluster.membership.MembershipTable: requests get
+        # stamped with the caller's topology epoch and a newer epoch on
+        # the response is learned (piggyback gossip).
+        self.membership = membership
         self._get_pod_scores = self._channel.unary_unary(
             f"/{SERVICE_NAME}/GetPodScores",
             request_serializer=lambda r: r.to_bytes(),
@@ -1076,10 +1160,15 @@ class IndexerServiceClient:
                 role=role,
                 deadline_ms=dl.to_wire_ms() if dl is not None else 0,
                 priority=priority,
+                epoch=(int(self.membership.epoch)
+                       if self.membership is not None else 0),
             ),
             self._timeout,
             self.retry_policy,
         )
+        if self.membership is not None and resp.epoch:
+            self.membership.observe_epoch(resp.epoch,
+                                          source=f"score:{self.address}")
         if resp.error:
             raise RuntimeError(f"GetPodScores failed: {resp.error}")
         return resp

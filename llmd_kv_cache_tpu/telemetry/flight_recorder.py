@@ -54,6 +54,7 @@ KIND_LOCKDEP = "lockdep"
 KIND_HEDGE = "hedge"
 KIND_SHED = "shed"
 KIND_AUDIT = "audit"
+KIND_FENCE = "fence"
 
 
 class FlightRecorder:

@@ -311,6 +311,7 @@ class TestPerfSentinel:
         assert "controller" in manifest["benches"]
         assert "graytail" in manifest["benches"]
         assert "audit" in manifest["benches"]
+        assert "fencing" in manifest["benches"]
         assert "hotpath-fleet" in manifest["benches"]
         sentinel = self._sentinel()
         nominal = {
@@ -328,6 +329,9 @@ class TestPerfSentinel:
                 "unit": "% of score p50", "vs_baseline": 1.0},
             "audit": {
                 "metric": "audit_overhead_pct", "value": 0.6,
+                "unit": "% of score p50", "vs_baseline": 1.0},
+            "fencing": {
+                "metric": "fence_overhead_pct", "value": 0.3,
                 "unit": "% of score p50", "vs_baseline": 1.0},
             "hotpath-fleet": {
                 "metric": "batched_fanout_ratio", "value": 7.0,

@@ -150,6 +150,53 @@ class TestHashRingMovement:
         # s3's entire share must move; s4 absorbs about one share.
         assert composed >= base.load()["s3"]
 
+    def test_epoch_bump_changes_fingerprint_not_placement(self):
+        """Epoch fencing's ring half: identical membership at different
+        topology epochs must place keys identically (an epoch bump alone
+        moves nothing) yet fingerprint unequal — a stale-epoch plan cache
+        can never be mistaken for the current one."""
+        shards = ["s0", "s1", "s2", "s3"]
+        old = HashRing(shards, epoch=1)
+        new = HashRing(shards, epoch=2)
+        assert moved_partitions(old, new) == 0
+        for k in sample_keys(200):
+            assert old.owner(k) == new.owner(k)
+        assert assignment_fingerprint(old) != assignment_fingerprint(new)
+        assert old.version != new.version
+
+    def test_back_to_back_epoch_bumps_stay_distinct(self):
+        """The controller's propose→commit mints epoch+1 per topology
+        action: two back-to-back bumps (join at e2, leave back at e3)
+        return to the original membership but NOT the original
+        fingerprint — the fence must see e3 > e1 even though placement
+        round-tripped byte-identically."""
+        shards = [f"shard-{i}" for i in range(4)]
+        base = HashRing(shards, epoch=1)
+        grown = HashRing(shards + ["shard-4"], epoch=2)
+        shrunk = HashRing(shards, epoch=3)
+        # Placement round-trips exactly...
+        for p in range(base.partitions):
+            assert base.owner_of_partition(p) == shrunk.owner_of_partition(p)
+        assert moved_partitions(base, shrunk) == 0
+        # ...but every hop has a distinct fingerprint (no ABA).
+        prints = {assignment_fingerprint(r) for r in (base, grown, shrunk)}
+        assert len(prints) == 3
+
+    def test_with_epoch_swaps_epoch_without_rebuild(self):
+        """The router's atomic swap on an epoch bump: same placement
+        object semantics, new epoch, zero partition movement."""
+        base = HashRing(["s0", "s1", "s2"], epoch=1)
+        bumped = base.with_epoch(5)
+        assert bumped.epoch == 5
+        assert bumped.shards == base.shards
+        assert moved_partitions(base, bumped) == 0
+        assert assignment_fingerprint(bumped) != assignment_fingerprint(base)
+        # Unstamped (epoch 0) rings fingerprint the pre-epoch way — the
+        # legacy value is stable across the upgrade.
+        legacy = HashRing(["s0", "s1", "s2"])
+        assert assignment_fingerprint(legacy) == assignment_fingerprint(
+            HashRing(["s0", "s1", "s2"], epoch=0))
+
     def test_plan_owners_tracks_membership_across_join_leave(self):
         """The router's fan-out plan under the controller's membership
         churn: plans differ only where ownership actually moved, and a
@@ -528,6 +575,7 @@ class TestShardRouter:
             view = router.debug_view()
             assert set(view) == {
                 "ring", "breakers", "plan_cache", "hedging", "data_plane",
+                "epoch",
             }
             assert view["ring"]["partitions"] == 1024
             assert view["hedging"]["enabled"] is True

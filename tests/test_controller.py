@@ -790,3 +790,146 @@ class TestKvdiagControllerSection:
                 == [ACTION_ADD_SHARD]
         finally:
             server.stop()
+
+
+class TestSplitBrainController:
+    """Split-brain: two controllers both believe they lead the fleet and
+    race the same topology mutation. The two-phase epoch discipline
+    (propose journals ``planned`` at fleet+1; commit re-reads the fleet
+    epoch) guarantees exactly one commits — the loser journals a
+    ``fenced`` record and latches self-fencing until restart. The race
+    window is opened deterministically: a ``controller.commit.<target>``
+    pause failpoint stalls the loser between propose and commit while a
+    one-shot listener lets the winner run to completion."""
+
+    def _controller(self, tmp_path, name, table, clock):
+        from llmd_kv_cache_tpu.control.controller import FleetController
+
+        cfg = ControllerConfig(
+            confirm_rounds=1, journal_path=str(tmp_path / f"{name}.journal"))
+        actuator = InProcessActuator(
+            add_shard=lambda t: {"ok": True, "shard": t},
+            remove_shard=lambda t: {"ok": True},
+        )
+        return FleetController(
+            QueueSource(signals(burn=2.0)), actuator, config=cfg,
+            clock=clock, membership=table)
+
+    def test_exactly_one_controller_commits(self, tmp_path):
+        from llmd_kv_cache_tpu.cluster.membership import MembershipTable
+        from llmd_kv_cache_tpu.control.controller import FP_COMMIT_PREFIX
+        from llmd_kv_cache_tpu.control.journal import PHASE_FENCED
+        from llmd_kv_cache_tpu.resilience import failpoints
+
+        clock = FakeClock()
+        # One shared membership table = the fleet's ground truth both
+        # controllers gossip through (epoch starts at genesis 1).
+        table = MembershipTable(clock=clock)
+        winner = self._controller(tmp_path, "winner", table, clock)
+        loser = self._controller(tmp_path, "loser", table, clock)
+
+        # Stall the loser between propose and commit, exactly once; while
+        # it is stalled, the winner runs its whole round (the one-shot
+        # ``times=1`` arm keeps the winner's own commit stall-free, so
+        # the listener cannot recurse).
+        failpoints.reset(seed=7)
+        failpoints.arm(FP_COMMIT_PREFIX + "shard-1", mode="pause",
+                       pause_s=5.0, times=1)
+        outcome = {}
+
+        def interleave(fp_name):
+            if fp_name.startswith(FP_COMMIT_PREFIX) and "winner" not in outcome:
+                outcome["winner"] = winner.reconcile_once()
+
+        failpoints.add_listener(interleave)
+        try:
+            outcome["loser"] = loser.reconcile_once()
+        finally:
+            failpoints.remove_listener(interleave)
+            failpoints.reset()
+        winner.stop()
+        loser.stop()
+
+        # Exactly one mutation landed, and it is the winner's.
+        assert winner.actuator.applied == [
+            (ACTION_ADD_SHARD, "shard-1", {"bootstrap": "snapshot"})]
+        assert loser.actuator.applied == []
+        assert outcome["winner"]["settled"] == ["add_shard:shard-1:1"]
+        assert outcome["winner"]["fenced"] is False
+        assert outcome["loser"]["settled"] == []
+        assert outcome["loser"]["fenced"] is True
+
+        # The fleet epoch advanced exactly once: genesis 1 → 2.
+        assert table.epoch == 2
+        assert loser.fenced is True and loser.fence_events == 1
+        assert winner.fenced is False
+
+        # Journals tell the story: both proposed epoch 2; the winner
+        # committed it, the loser's same action_id settled ``fenced``.
+        win_recs = list(ActionJournal(
+            str(tmp_path / "winner.journal")).replay())
+        assert [r.phase for r in win_recs] == [PHASE_PLANNED, PHASE_EXECUTED]
+        assert [r.epoch for r in win_recs] == [2, 2]
+        lose_recs = list(ActionJournal(
+            str(tmp_path / "loser.journal")).replay())
+        assert [r.phase for r in lose_recs] == [PHASE_PLANNED, PHASE_FENCED]
+        assert lose_recs[1].action_id == lose_recs[0].action_id
+        assert lose_recs[1].result == {
+            "ok": False, "fenced": True, "proposed_epoch": 2,
+            "fleet_epoch": 2}
+        # A fenced record SETTLES the planned one — restart replay must
+        # not treat the lost action as in-flight.
+        assert unresolved_actions(lose_recs) == []
+
+    def test_fenced_controller_holds_still_until_restart(self, tmp_path):
+        from llmd_kv_cache_tpu.cluster.membership import MembershipTable
+        from llmd_kv_cache_tpu.control.controller import FP_COMMIT_PREFIX
+        from llmd_kv_cache_tpu.resilience import failpoints
+
+        clock = FakeClock()
+        table = MembershipTable(clock=clock)
+        winner = self._controller(tmp_path, "w2", table, clock)
+        loser = self._controller(tmp_path, "l2", table, clock)
+        failpoints.reset(seed=7)
+        failpoints.arm(FP_COMMIT_PREFIX + "shard-1", mode="pause",
+                       pause_s=5.0, times=1)
+        done = {}
+
+        def interleave(fp_name):
+            if fp_name.startswith(FP_COMMIT_PREFIX) and not done:
+                done["w"] = winner.reconcile_once()
+
+        failpoints.add_listener(interleave)
+        try:
+            loser.reconcile_once()
+        finally:
+            failpoints.remove_listener(interleave)
+            failpoints.reset()
+        assert loser.fenced is True
+
+        # Latched: every further round observes, proposes nothing, acts
+        # on nothing — even though the burn signal still demands action.
+        again = loser.reconcile_once()
+        assert again == {
+            "ts": 0.0, "proposed": 0, "settled": [], "budget_deferred": 0,
+            "pending": [], "dry_run": False, "fenced": True}
+        assert loser.actuator.applied == []
+        assert loser.debug_view()["epoch"]["fenced"] is True
+        winner.stop()
+        loser.stop()
+
+        # Restart is the re-admission path: the successor replays a
+        # journal whose lost action is settled (planned+fenced), comes up
+        # un-fenced at the fleet's epoch, and can win the NEXT round —
+        # its commit mints epoch 3 on top of the rival's 2.
+        reborn = self._controller(tmp_path, "l2", table, clock)
+        assert reborn.fenced is False
+        clock.now += 3600.0  # clear cooldowns
+        summary = reborn.reconcile_once()
+        assert summary["fenced"] is False
+        (settled,) = summary["settled"]
+        assert settled.startswith("add_shard:shard-1:")
+        assert reborn.actuator.applied == [
+            (ACTION_ADD_SHARD, "shard-1", {"bootstrap": "snapshot"})]
+        assert table.epoch == 3
+        reborn.stop()

@@ -926,3 +926,135 @@ class TestSlowShardGrayFailure:
         for _ in range(200):
             tracker.observe_latency("slow-pod", self.HEALTHY_S)
         assert tracker.factor("slow-pod") == 1.0
+
+
+@pytest.mark.chaos
+class TestZombieFencing:
+    """The GC-paused zombie: a pod stalls past its lease TTL mid-ingest
+    and resumes publishing as if nothing happened. The membership fence
+    (cluster/membership.py) must drop its post-resume writes
+    *deterministically* — rejected until it re-admits through the
+    warm-restart gate, not "demoted when latency looks bad" — and
+    because the drop happens before the index, the divergence auditor's
+    phantom/ghost counters stay flat. No real sleeps anywhere: the pause
+    failpoint ages the lease virtually and the table runs a fake clock."""
+
+    def _stack(self):
+        from llmd_kv_cache_tpu.cluster.membership import MembershipTable
+
+        processor, index, pool = make_stack()
+        clk = [1000.0]
+        table = MembershipTable(
+            fence_mode="reject", lease_ttl_s=30.0, lease_renew_s=10.0,
+            clock=lambda: clk[0])
+        pool.attach_membership(table)
+        return processor, index, pool, table, clk
+
+    @staticmethod
+    def _batch(tokens, hashes, epoch=0):
+        from llmd_kv_cache_tpu.events.model import EventBatch
+
+        return EventBatch(timestamp=0.0, events=[BlockStoredEvent(
+            block_hashes=hashes, tokens=tokens, parent_hash=0,
+            block_size=BLOCK)], epoch=epoch)
+
+    def test_lapsed_lease_writes_dropped_before_index(self):
+        from llmd_kv_cache_tpu.cluster.membership import FP_RENEW_PREFIX
+        from llmd_kv_cache_tpu.recovery.reconcile import (
+            DivergenceAuditor,
+            digest_from_blocks,
+            pod_blocks_from_state,
+        )
+
+        processor, index, pool, table, clk = self._stack()
+        try:
+            table.grant("pod-z")
+            assert table.renew("pod-z") is True
+
+            # Healthy mid-ingest: the zombie-to-be indexes normally.
+            before = list(range(8))
+            rks_before = processor.tokens_to_kv_block_keys(0, before, MODEL)
+            pool.process_event_batch(
+                self._batch(before, [1, 2]), "pod-z", MODEL)
+            assert index.lookup(rks_before) != {}
+
+            # Freeze the engine's ground truth at the pre-pause state; the
+            # fence's job is to keep the index pinned to exactly this.
+            truth = pod_blocks_from_state(index.dump_state(), "pod-z")
+
+            class _TruthSource:
+                def pods(self):
+                    return ["pod-z"]
+
+                def digest(self, pod):
+                    return digest_from_blocks(truth)
+
+                def blocks(self, pod):
+                    return truth
+
+            auditor = DivergenceAuditor(
+                index, _TruthSource(), clock=lambda: clk[0])
+            assert auditor.audit_once()["divergent"] == {}
+
+            # The stop-the-world pause: one missed renewal worth 45 virtual
+            # seconds (> the 30s TTL). The failpoint ages the lease instead
+            # of sleeping, so the whole episode runs in microseconds.
+            failpoints.arm(FP_RENEW_PREFIX + "pod-z", mode="pause",
+                           pause_s=45.0)
+            assert table.renew("pod-z") is False
+            assert table.lease_valid("pod-z") is False
+
+            # Post-resume writes: dropped before the index, not demoted.
+            after = list(range(100, 108))
+            rks_after = processor.tokens_to_kv_block_keys(0, after, MODEL)
+            for _ in range(3):
+                pool.process_event_batch(
+                    self._batch(after, [7, 8]), "pod-z", MODEL)
+            assert index.lookup(rks_after) == {}
+            assert pool.data_plane_debug()["fenced_batches"] == 3
+            assert table.rejections == 3
+            assert table.debug_view()["recent_rejections"][-1]["reason"] == (
+                "lease_lapsed")
+
+            # The invariant the whole plane exists for: the index never
+            # drifted from engine truth — phantom AND ghost stay at zero.
+            assert auditor.audit_once()["divergent"] == {}
+            assert index.lookup(rks_before) != {}
+        finally:
+            pool.shutdown()
+
+    def test_readmission_requires_warm_restart_gate(self):
+        from llmd_kv_cache_tpu.cluster.membership import FP_RENEW_PREFIX
+
+        processor, index, pool, table, clk = self._stack()
+        try:
+            table.grant("pod-z")
+            failpoints.arm(FP_RENEW_PREFIX + "pod-z", mode="pause",
+                           pause_s=60.0, times=1)
+            assert table.renew("pod-z") is False
+
+            # A lapsed lease does NOT heal by renewing harder — the next
+            # (un-paused) heartbeat still bounces.
+            assert table.renew("pod-z") is False
+
+            # Re-admission is gated on warm-restart readiness: a zombie
+            # that has not re-run snapshot/journal replay stays fenced.
+            class Gate:
+                def __init__(self, ready):
+                    self.ready = ready
+
+            assert table.readmit("pod-z", Gate(ready=False)) is False
+            tokens = list(range(200, 208))
+            rks = processor.tokens_to_kv_block_keys(0, tokens, MODEL)
+            pool.process_event_batch(
+                self._batch(tokens, [11, 12]), "pod-z", MODEL)
+            assert index.lookup(rks) == {}
+
+            # Through the gate: fresh lease, writes land again.
+            assert table.readmit("pod-z", Gate(ready=True)) is True
+            assert table.lease_valid("pod-z") is True
+            pool.process_event_batch(
+                self._batch(tokens, [11, 12]), "pod-z", MODEL)
+            assert index.lookup(rks) != {}
+        finally:
+            pool.shutdown()

@@ -306,6 +306,94 @@ class TestScoreWireCompat:
             "keys": [100, 101], "pods": ["pod-1"]}
 
 
+class TestEpochWireCompat:
+    """Epoch-fence wire tolerance (the membership plane's stamp): epoch
+    rides every frame the same tolerant way ``deadline_ms`` did. Legacy
+    bytes decode to epoch 0 — the "unstamped" value that is never fenced
+    — so an un-upgraded peer interoperates by construction; in ``warn``
+    mode even genuinely stale stamps pass through (flagged, counted)."""
+
+    def test_legacy_request_decodes_unstamped(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_legacy.bin"))
+        assert req.epoch == 0
+
+    def test_epoch_request_decodes_and_ignores_future_keys(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreRequest
+
+        req = ScoreRequest.from_bytes(load("score_request_epoch.bin"))
+        assert req.tokens == [1, 2, 3]
+        assert req.epoch == 7  # lease_hint silently ignored
+        again = ScoreRequest.from_bytes(req.to_bytes())
+        assert again.epoch == 7
+
+    def test_legacy_response_decodes_unstamped(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_legacy.bin"))
+        assert resp.epoch == 0
+
+    def test_fenced_response_round_trips(self):
+        from llmd_kv_cache_tpu.services.indexer_service import ScoreResponse
+
+        resp = ScoreResponse.from_bytes(load("score_response_fenced.bin"))
+        assert resp.scores == {}
+        assert resp.degraded is True
+        assert resp.degraded_reason == "fenced"
+        assert resp.epoch == 7  # the piggyback the stale sender learns
+        again = ScoreResponse.from_bytes(resp.to_bytes())
+        assert again == resp
+
+    def test_old_peer_view_of_epoch_bytes(self):
+        """A pre-epoch decoder reading stamped bytes never looks at the
+        new key — the legacy fields stay well-typed."""
+        import msgpack
+
+        d = msgpack.unpackb(load("score_request_epoch.bin"), raw=False)
+        assert d["tokens"] == [1, 2, 3]
+        assert d["model_name"] == "llama-2-7b"
+        assert {k: d[k] for k in ("tokens", "pod_identifiers")} == {
+            "tokens": [1, 2, 3], "pod_identifiers": ["pod-1"]}
+
+    def test_lookup_frame_epoch_marker(self):
+        import msgpack
+
+        d = msgpack.unpackb(load("lookup_request_epoch.bin"), raw=False)
+        assert d["keys"] == [100, 101]
+        assert d["epoch"] == 7
+        # An old shard's projection: the legacy keys alone are enough.
+        assert {k: d[k] for k in ("keys", "pods")} == {
+            "keys": [100, 101], "pods": ["pod-1"]}
+
+    def test_event_batch_epoch_element(self):
+        """KV-event wire element [4] after traceparent carries the
+        publisher's epoch; every shorter (pre-epoch) fixture decodes to
+        epoch 0."""
+        _, _, batch = parse("vllm_epoch_stamped.bin")
+        assert batch.epoch == 7
+        assert batch.traceparent == wire_spec.TRACEPARENT
+        _, _, legacy = parse("vllm_block_stored_full.bin")
+        assert legacy.epoch == 0
+
+    def test_warn_mode_interop_with_old_peers(self):
+        """The rollout contract: a fleet in ``fenceMode: warn`` accepts
+        an old peer's unstamped traffic clean, and even a stale stamp is
+        let through flagged — nothing breaks before the knob flips."""
+        from llmd_kv_cache_tpu.cluster.membership import MembershipTable
+
+        table = MembershipTable(fence_mode="warn", epoch=7)
+        unstamped = table.check_request(0, "score")  # legacy peer
+        assert unstamped.allowed and not unstamped.flagged
+        stale = table.check_request(6, "score")
+        assert stale.allowed and stale.flagged
+        assert stale.reason == "stale_epoch"
+        # Same stamp under reject mode is refused — the knob is the only
+        # difference between rollout and enforcement.
+        hard = MembershipTable(fence_mode="reject", epoch=7)
+        assert hard.check_request(6, "score").allowed is False
+
+
 class TestScoreFeedbackWire:
     """ScoreFeedback tolerance (the audit plane's score→engine hop):
     a minimal/older peer's bytes decode with defaults, the full field

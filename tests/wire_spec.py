@@ -231,6 +231,37 @@ def score_fixtures() -> dict[str, bytes]:
             (s("predicted_blocks"), u(3)),
             (s("audit_hint"), nil()),
         ),
+        # Epoch-fenced topology plane: the monotonic fleet epoch arrives
+        # the same tolerant way ``deadline_ms`` did — epoch 0 / absent
+        # means an unstamped legacy peer and is never fenced, so the
+        # legacy fixtures above double as the old-peer half of the
+        # warn-mode interop proof. Unknown future key must be ignored.
+        "score_request_epoch.bin": mp(
+            (s("tokens"), arr(u(1), u(2), u(3))),
+            (s("model_name"), s("llama-2-7b")),
+            (s("pod_identifiers"), arr(s("pod-1"))),
+            (s("epoch"), u(7)),
+            (s("lease_hint"), nil()),
+        ),
+        # Fenced response (fenceMode: reject): shed-shaped, with the
+        # receiver's own newer epoch stamped so the stale sender learns
+        # the bump from the refusal itself (gossip-by-piggyback).
+        "score_response_fenced.bin": mp(
+            (s("scores"), mp()),
+            (s("error"), s("stale topology epoch 6 (fleet at 7)")),
+            (s("degraded"), tru()),
+            (s("degraded_reason"), s("fenced")),
+            (s("epoch"), u(7)),
+        ),
+        # Shard-RPC lookup frame with the epoch stamp riding next to the
+        # deadline budget: pre-epoch shards ignore the key, post-epoch
+        # shards fence on it.
+        "lookup_request_epoch.bin": mp(
+            (s("keys"), arr(u(100), u(101))),
+            (s("pods"), arr(s("pod-1"))),
+            (s("deadline_ms"), u(40)),
+            (s("epoch"), u(7)),
+        ),
         # Shard-RPC lookup frame with deadline + hedge markers (the
         # cluster.remote frame wire): old shards ignore both keys.
         "lookup_request_deadline.bin": mp(
@@ -348,6 +379,13 @@ def fixtures() -> dict[str, bytes]:
         "vllm_removed_cleared.bin": arr(f64(TS), removed_and_cleared, nil()),
         # Events may arrive bin-embedded (serializer nesting).
         "vllm_nested_bin.bin": arr(f64(TS), arr(binary(full_stored)), nil()),
+        # Epoch-stamped batch: wire element [4] after traceparent carries
+        # the publisher's topology epoch (cluster.membership); the
+        # publisher pads absent middles with nil. Engines that predate
+        # the epoch plane send shorter arrays — every fixture above is
+        # that legacy case and must keep decoding with epoch 0.
+        "vllm_epoch_stamped.bin": arr(
+            f64(TS), arr(index_stored), nil(), s(TRACEPARENT), u(7)),
         "vllm_wire_to_index.bin": arr(f64(TS), arr(index_stored), nil()),
         # SGLang: same positional wire, schema ends at extra_keys — a
         # longer array must NOT leak HMA fields into the decode.
