@@ -9,7 +9,7 @@ PY := python
 CPU_ENV := PYTHONPATH=. JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-hotpath-fleet bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail bench-fencing perf-check verify graft-check verify-examples chaos lint clean
+.PHONY: test unit-test-race tsan asan native bench bench-hotpath bench-hotpath-fleet bench-engine-telemetry bench-shard bench-ragged bench-fp8 bench-disagg bench-fleet bench-pyprof bench-workingset bench-controller bench-graytail bench-fencing bench-incident perf-check verify graft-check verify-examples chaos lint clean
 
 test: native
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -158,6 +158,13 @@ bench-audit: native
 bench-fencing: native
 	$(CPU_ENV) $(PY) bench.py --fencing
 
+# Incident black-box gate (telemetry/incident.py): the alert-edge
+# trigger hook (IncidentManager.maybe_open) must cost < 1% of the score
+# p50 — the evidence fan-out and the bundle write run on a detached
+# worker, and the bench proves the accepted edge never pays them.
+bench-incident: native
+	$(CPU_ENV) $(PY) bench.py --incident
+
 # Perf-regression sentinel: run the profiling + working-set gates and the
 # controller chaos arm, then diff their values and hot-function shares
 # against the committed baseline manifest. Emits machine-verdict
@@ -169,6 +176,7 @@ perf-check: native
 	$(CPU_ENV) $(PY) bench.py --graytail > /tmp/kvtpu_graytail_bench.json
 	$(CPU_ENV) $(PY) bench.py --audit > /tmp/kvtpu_audit_bench.json
 	$(CPU_ENV) $(PY) bench.py --fencing > /tmp/kvtpu_fencing_bench.json
+	$(CPU_ENV) $(PY) bench.py --incident > /tmp/kvtpu_incident_bench.json
 	$(CPU_ENV) $(PY) hack/bench_hotpath.py --fleet > /tmp/kvtpu_fleet_bench.json
 	$(PY) hack/perf_sentinel.py --baseline benchmarking/perf_baseline.json \
 	  --results pyprof-overhead=/tmp/kvtpu_pyprof_bench.json \
@@ -177,6 +185,7 @@ perf-check: native
 	  --results graytail=/tmp/kvtpu_graytail_bench.json \
 	  --results audit=/tmp/kvtpu_audit_bench.json \
 	  --results fencing=/tmp/kvtpu_fencing_bench.json \
+	  --results incident=/tmp/kvtpu_incident_bench.json \
 	  --results hotpath-fleet=/tmp/kvtpu_fleet_bench.json
 
 # The pre-merge bundle: conventions lint + the perf sentinel.

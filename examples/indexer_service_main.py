@@ -23,9 +23,6 @@ from llmd_kv_cache_tpu.utils.logging import configure_from_env
 
 def main() -> None:
     configure_from_env()
-    # kill -USR2 <pid> dumps the flight-recorder ring to the log (must be
-    # installed from the main thread, hence here and not in the service).
-    install_signal_dump()
     parser = argparse.ArgumentParser()
     parser.add_argument("--zmq-endpoint", default="tcp://0.0.0.0:5557")
     parser.add_argument("--grpc-address", default="0.0.0.0:50051")
@@ -153,7 +150,18 @@ def main() -> None:
              "surface; without it prompts are tokenized in-process "
              "(HF registry)",
     )
+    parser.add_argument(
+        "--dump-dir", default=None,
+        help="directory for SIGUSR2 flight-recorder dumps (default: "
+             "$KVTPU_DUMP_DIR, then the system temp dir); each signal "
+             "writes a fresh timestamped JSON file and logs its path",
+    )
     args = parser.parse_args()
+
+    # kill -USR2 <pid> dumps the flight-recorder ring to a file under
+    # --dump-dir (must be installed from the main thread, hence here and
+    # not in the service).
+    install_signal_dump(dump_dir=args.dump_dir)
 
     # Prompt tokenization for /indexer.v1.IndexerService/GetPodScores:
     # through the sidecar when configured (the reference's UDS path),

@@ -10,7 +10,15 @@ serves the results:
 - ``/debug/traces`` — retained traces (tail-sampled) with critical paths
 - ``/debug/rollup`` — fleet TTFT/ITL/score-latency percentiles per role
 - ``/debug/slo``    — burn rates, thresholds, alert state per SLO
+- ``/debug/anomaly``  — robust-z anomaly sentinel state per SLI series
+- ``/debug/incident`` — incident black-box state (recent bundles, clock
+  offsets); ``POST /debug/incident/open`` pulls a capture manually
 - ``/metrics``      — the ``kvtpu_fleet_*`` / ``kvtpu_slo_*`` families
+
+With ``--incident-dir`` set, every alert/anomaly fire edge snapshots
+fleet-wide evidence (flight-recorder rings, spans, profiler windows,
+membership, controller journal) into one CRC-sealed bundle there;
+``hack/kvdiag.py --incident <bundle>`` replays the triage story offline.
 
 Targets come from ``--targets`` (``name=host:port[:role]`` items) or a
 JSON config file (``--config``, the ``fleetTelemetry.collector`` block,
@@ -34,6 +42,7 @@ from llmd_kv_cache_tpu.services.telemetry_collector import (
     ScrapeTarget,
     TelemetryCollector,
 )
+from llmd_kv_cache_tpu.telemetry.incident import IncidentConfig
 from llmd_kv_cache_tpu.utils.logging import configure_from_env
 
 
@@ -64,6 +73,12 @@ def main() -> None:
     parser.add_argument("--slo-latency-threshold-s", type=float, default=2.0,
                         help="trace duration beyond which the tail sampler "
                              "always retains the trace")
+    parser.add_argument("--incident-dir", default="",
+                        help="directory for incident black-box bundles; "
+                             "unset disables alert-triggered capture")
+    parser.add_argument("--incident-max", type=int, default=16,
+                        help="keep-N retention over bundle files in "
+                             "--incident-dir (oldest deleted first)")
     args = parser.parse_args()
 
     if args.config:
@@ -79,6 +94,10 @@ def main() -> None:
             admin_port=args.admin_port,
             host=args.admin_host,
             slo_latency_threshold_s=args.slo_latency_threshold_s,
+            incident=IncidentConfig(
+                directory=args.incident_dir,
+                max_bundles=args.incident_max,
+            ),
         )
 
     collector = TelemetryCollector(cfg)

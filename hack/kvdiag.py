@@ -43,6 +43,13 @@ an on-call engineer needs into a single JSON report on stdout:
                                  pod, routing-regret rate, and current
                                  index divergence (phantom/ghost blocks)
                                  with the degraded pods named
+- ``fleet.anomaly`` (summary)  — collector targets: robust-z anomaly
+                                 sentinels (firing state, last score)
+                                 over the fleet SLI series
+- ``fleet.incidents`` (summary)— collector targets: the incident
+                                 black-box state (recent bundles,
+                                 suppression counters, per-pod clock
+                                 offsets)
 - ``controller`` (summary)     — when the target is the fleet controller:
                                  the last N actions with each action's
                                  causing signal, per-action-kind cooldown
@@ -55,6 +62,13 @@ Usage:
   python hack/kvdiag.py --port 9500 --fleet          # collector target
   python hack/kvdiag.py --targets 127.0.0.1:9400,127.0.0.1:9401
   python hack/kvdiag.py --port 9400 --watch 5        # delta lines
+  python hack/kvdiag.py --incident /var/kvtpu/incident-00000001-slo.inc
+
+``--incident <bundle>`` needs no running pod at all: it opens an
+incident black-box bundle offline and prints the skew-corrected
+cross-pod timeline, the alerts/anomalies firing at capture time, the
+dominant critical-path segment, and the first-anomalous-pod heuristic
+(this mode imports ``llmd_kv_cache_tpu`` for the bundle codec).
 
 Multi-target scrapes (``--targets``) degrade gracefully: an unreachable
 pod contributes an ``{"error": ...}`` stanza instead of aborting the
@@ -82,7 +96,8 @@ METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_fleet_", "kvtpu_pyprof_", "kvtpu_offload_",
                    "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_",
                    "kvtpu_ingest_", "kvtpu_native_", "kvtpu_audit_",
-                   "kvtpu_index_divergence_")
+                   "kvtpu_index_divergence_", "kvtpu_topology_",
+                   "kvtpu_anomaly_", "kvtpu_incident_")
 
 
 def _fetch(url: str, timeout: float) -> tuple[int, bytes]:
@@ -476,6 +491,51 @@ def fleet_summary(debug: dict) -> dict:
             "degraded_pods": degraded,
         }
 
+    anomaly = debug.get("anomaly") or {}
+    if isinstance(anomaly, dict) and anomaly:
+        # Robust-z anomaly sentinels over the fleet SLI series: the
+        # earliest gray-failure signal (fires before a burn-rate window
+        # fills) and the trigger feed for the incident black-box.
+        out["anomaly"] = {
+            "firing": sorted(
+                name for name, st in anomaly.items()
+                if isinstance(st, dict) and st.get("firing")),
+            "sentinels": {
+                name: {
+                    "firing": st.get("firing"),
+                    "fires": st.get("fires"),
+                    "last_z": st.get("last_z"),
+                    "last_value": st.get("last_value"),
+                    "samples": st.get("samples"),
+                }
+                for name, st in anomaly.items() if isinstance(st, dict)
+            },
+        }
+
+    incident = debug.get("incident") or {}
+    if incident:
+        # Incident black-box: what got captured, what got suppressed
+        # (cooldown/inflight), and the per-pod clock offsets every
+        # bundle's merged timeline is corrected with.
+        out["incidents"] = {
+            "enabled": incident.get("enabled"),
+            "directory": incident.get("directory"),
+            "opened_total": incident.get("opened_total"),
+            "capturing": incident.get("capturing"),
+            "suppressed": incident.get("suppressed"),
+            "recent": [
+                {
+                    "seq": r.get("seq"),
+                    "trigger": r.get("trigger"),
+                    "pods_captured": r.get("pods_captured"),
+                    "pods_total": r.get("pods_total"),
+                    "path": r.get("path"),
+                }
+                for r in incident.get("recent") or []
+            ],
+            "clock_offsets": incident.get("offsets"),
+        }
+
     membership = debug.get("membership") or {}
     if membership:
         # Epoch-fenced membership plane: where the pod thinks topology
@@ -643,6 +703,97 @@ def _emit(report: dict, args, alerts: list[dict]) -> None:
         print(payload)
 
 
+def incident_report(path: str, timeline_limit: int = 40,
+                    out=sys.stdout) -> int:
+    """``--incident <bundle>``: offline black-box viewer.
+
+    Loads one incident bundle (no running pod needed), verifies its CRC
+    footer, and prints the triage story: capture header, per-pod clock
+    offsets, alerts/anomalies firing at capture, the dominant
+    critical-path segment, the first-anomalous-pod heuristic, and the
+    skew-corrected merged timeline tail.
+    """
+    try:
+        from llmd_kv_cache_tpu.telemetry import incident as inc
+    except ImportError:
+        import os
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        try:
+            from llmd_kv_cache_tpu.telemetry import incident as inc
+        except ImportError as e:
+            print(f"kvdiag --incident needs the llmd_kv_cache_tpu package "
+                  f"for the bundle codec: {e}", file=sys.stderr)
+            return 2
+    try:
+        doc = inc.load_bundle(path)
+    except (OSError, inc.IncidentBundleError) as e:
+        print(f"kvdiag: cannot read incident bundle {path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    def emit(line: str = "") -> None:
+        print(line, file=out)
+
+    opened = doc.get("opened_wall")
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S",
+                          time.localtime(opened)) if opened else "?"
+    emit(f"incident #{doc.get('seq', '?')}  trigger={doc.get('trigger', '?')}"
+         f"  opened={stamp}  capture={doc.get('capture_seconds', '?')}s")
+    reason = doc.get("reason") or {}
+    if reason:
+        emit(f"  reason: {json.dumps(reason, default=repr)}")
+
+    pods = doc.get("pods") or {}
+    reachable = sorted(p for p, ev in pods.items() if ev.get("reachable"))
+    unreachable = sorted(set(pods) - set(reachable))
+    emit(f"pods: {len(reachable)}/{len(pods)} captured"
+         + (f"  unreachable={','.join(unreachable)}" if unreachable else ""))
+
+    offsets = doc.get("offsets") or {}
+    if offsets:
+        emit("clock offsets (pod wall - collector wall; error <= rtt/2):")
+        for pod in sorted(offsets):
+            st = offsets[pod]
+            emit(f"  {pod}: offset={st.get('offset_s'):+.6f}s "
+                 f"rtt={st.get('rtt_s'):.6f}s age={st.get('age_s')}s")
+
+    alerts = inc.firing_alerts(doc)
+    if alerts:
+        emit("firing at capture:")
+        for a in alerts:
+            if a.get("kind") == "slo":
+                emit(f"  slo {a.get('name')}: {a.get('severity')}")
+            else:
+                emit(f"  anomaly {a.get('name')}: z={a.get('z')} "
+                     f"value={a.get('value')}")
+    else:
+        emit("firing at capture: none")
+
+    seg = inc.dominant_segment(doc)
+    if seg:
+        emit(f"dominant segment: {seg.get('name')} "
+             f"({seg.get('process')}) self_time={seg.get('self_time_s')}s "
+             f"trace={seg.get('trace_id')}")
+
+    suspect = inc.first_anomalous_pod(doc)
+    if suspect:
+        emit(f"first anomalous pod: {suspect['pod']} "
+             f"(sentinel={suspect['sentinel']} round={suspect['round']} "
+             f"z={suspect['z']} value={suspect['value']})")
+    else:
+        emit("first anomalous pod: none identified")
+
+    timeline = inc.merged_timeline(doc, limit=timeline_limit)
+    emit(f"timeline (skew-corrected, last {len(timeline)} events):")
+    for ev in timeline:
+        detail = ev.get("detail")
+        tail = f"  {json.dumps(detail, default=repr)}" if detail else ""
+        emit(f"  {ev['ts']:.6f}  {ev['pod']:<16} {ev['source']:<10} "
+             f"{ev['label']}{tail}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--host", default="127.0.0.1")
@@ -666,7 +817,17 @@ def main(argv=None) -> int:
                              "alerts) instead of a one-shot snapshot")
     parser.add_argument("--out", default=None,
                         help="write the JSON report here instead of stdout")
+    parser.add_argument("--incident", default=None, metavar="BUNDLE",
+                        help="offline mode: print the triage story of one "
+                             "incident black-box bundle (skew-corrected "
+                             "timeline, firing alerts, dominant segment, "
+                             "first anomalous pod) — no pod needed")
+    parser.add_argument("--timeline-limit", type=int, default=40,
+                        help="with --incident: events of merged timeline "
+                             "tail to print (0 = all)")
     args = parser.parse_args(argv)
+    if args.incident is not None:
+        return incident_report(args.incident, args.timeline_limit)
     if (args.port is None) == (args.targets is None):
         parser.error("exactly one of --port / --targets is required")
     if args.watch is not None and args.watch <= 0:

@@ -45,12 +45,15 @@ METRIC_PREFIXES = ("kvcache_", "kv_offload_", "kvtpu_engine_", "kvtpu_shard_",
                    "kvtpu_workingset_", "kvtpu_cache_ledger_", "kvtpu_ctrl_",
                    "kvtpu_hedge_", "kvtpu_shed_", "kvtpu_ingest_",
                    "kvtpu_native_", "kvtpu_audit_", "kvtpu_index_divergence_",
-                   "kvtpu_fence_", "kvtpu_lease_", "kvtpu_topology_")
+                   "kvtpu_fence_", "kvtpu_lease_", "kvtpu_topology_",
+                   "kvtpu_anomaly_", "kvtpu_incident_")
 # Admin-plane surfaces an operator must be able to find without reading
 # the source: each literal must appear in docs/observability.md.
 REQUIRED_ENDPOINTS = ("/debug/pyprof", "/debug/pyprof/capture",
                       "/debug/workingset", "/debug/slo", "/debug/role",
-                      "/debug/controller", "/debug/audit")
+                      "/debug/controller", "/debug/audit",
+                      "/debug/anomaly", "/debug/incident",
+                      "/debug/incident/open", "/debug/time")
 METRIC_CLASSES = frozenset({
     "Counter", "Gauge", "Histogram", "Summary",
     # The engine-telemetry histogram primitive with config-driven buckets

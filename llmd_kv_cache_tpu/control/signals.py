@@ -60,6 +60,13 @@ class FleetSignals:
     # lying about pod X" (divergence → reconcile/demote) from "capacity
     # is short" (shed/SLO burn → scale).
     audit: dict = field(default_factory=dict)
+    # Anomaly sentinel level state (telemetry/anomaly.py:
+    # AnomalyRegistry.active()): sentinel name -> {"firing", "last_z",
+    # "last_value"}. Robust-z detectors over SLI *shape* fire well
+    # before a burn-rate window fills, so they are the policy's earliest
+    # gray-failure signal (and each fire edge also opens an incident
+    # black-box capture).
+    anomalies: Dict[str, dict] = field(default_factory=dict)
     # Topology.
     shards: Tuple[str, ...] = ()
     roles: Dict[str, str] = field(default_factory=dict)
@@ -85,6 +92,13 @@ class FleetSignals:
     def shed_rate(self, site: str) -> float:
         return float((self.shed.get(site) or {}).get("shed_rate", 0.0))
 
+    def anomaly_firing(self, sentinel: str) -> bool:
+        return bool((self.anomalies.get(sentinel) or {}).get("firing"))
+
+    def firing_anomalies(self) -> List[str]:
+        return sorted(name for name, st in self.anomalies.items()
+                      if st.get("firing"))
+
     def divergent_pods(self) -> List[str]:
         """Pods the divergence audit currently finds out of sync
         (advertising phantom blocks or hiding ghost ones)."""
@@ -106,6 +120,8 @@ class FleetSignals:
             "dominant_segment": dict(self.dominant_segment),
             "handoff": dict(self.handoff),
             "shed": {site: dict(st) for site, st in self.shed.items()},
+            "anomalies": {
+                name: dict(st) for name, st in self.anomalies.items()},
             "audit": {
                 "divergence": dict(self.audit.get("divergence") or {}),
                 "regret_rate": round(self.regret_rate(), 4),
@@ -165,6 +181,7 @@ class CollectorSignalSource:
         dominant: dict = {}
         whatif: Tuple[dict, ...] = ()
         audit: dict = {}
+        anomalies: Dict[str, dict] = {}
         if self._collector is not None:
             best = 0.0
             for summary in self._collector.assembler.retained():
@@ -186,6 +203,12 @@ class CollectorSignalSource:
                 audit = dict(self._collector.audit_view())
             except Exception:  # enrichment, never round-fatal  # lint: allow-swallow
                 audit = {}
+            registry = getattr(self._collector, "anomalies", None)
+            if registry is not None:
+                try:
+                    anomalies = dict(registry.active())
+                except Exception:  # enrichment, never round-fatal  # lint: allow-swallow
+                    anomalies = {}
         handoff = {}
         if self._handoff is not None:
             handoff = self._handoff.starvation()
@@ -202,6 +225,7 @@ class CollectorSignalSource:
             whatif=whatif,
             shed=shed,
             audit=audit,
+            anomalies=anomalies,
             shards=tuple(self._shards()),
             roles=dict(self._roles()),
             epoch=(int(self._membership.epoch)

@@ -5,6 +5,15 @@ The indexer sidecar's "open the pod and look" surface (ISSUE 3). Serves:
 - ``/metrics``   — the process's Prometheus registry (text exposition)
 - ``/healthz``   — liveness probe (200 + ``{"status": "ok"}``)
 - ``/debug/flight-recorder`` — the in-process flight recorder ring
+  (full dump; ``?since=SEQ`` switches to the cursor export used by
+  ``/debug/spans`` — records newer than the puller's cursor plus
+  ``next_seq``/``dropped`` — which is what the incident capture and the
+  collector pull)
+- ``/debug/time`` — wall + monotonic clock echo (always on with the
+  debug surface): the telemetry collector brackets it between two local
+  clock readings to estimate this pod's clock offset by RTT-halving
+  (``telemetry/incident.py``), which is how incident bundles merge
+  per-pod timelines despite skewed clocks
 - ``/debug/<name>``          — registered JSON providers (``lag``,
   ``ledger``, ``engine``, …), whatever the owning service wires in
 - ``/debug/vars``            — every provider + the flight recorder in
@@ -58,7 +67,9 @@ exposure beyond the pod is an operator decision (``host="0.0.0.0"``).
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Mapping, Optional
 from urllib.parse import parse_qs
@@ -406,7 +417,29 @@ class AdminServer:
                     or self._audit_source is None
                     and "audit" not in self._providers):
                 return self._handle_audit(query or {})
+            if path == "/debug/time":
+                # Deliberately unguarded (no registration): the echo
+                # carries no pod internals and must answer even on a pod
+                # nothing else was wired on — skew estimation is most
+                # valuable exactly when a pod is misbehaving.
+                body = json.dumps({
+                    "wall": time.time(),
+                    "mono": time.monotonic(),
+                    "pid": os.getpid(),
+                }).encode("utf-8")
+                return 200, body, "application/json"
             if path == "/debug/flight-recorder":
+                if "since" in (query or {}):
+                    raw = (query or {}).get("since", ["-1"])[-1]
+                    try:
+                        since = int(raw)
+                    except ValueError:
+                        return (400, json.dumps(
+                            {"error": f"bad since: {raw!r}"}).encode(),
+                            "application/json")
+                    payload = flight_recorder().export_since(since)
+                    return (200, json.dumps(payload, default=repr).encode(),
+                            "application/json")
                 body = flight_recorder().dump_json(indent=2).encode("utf-8")
                 return 200, body, "application/json"
             if path == "/debug/vars":

@@ -52,7 +52,13 @@ from ..telemetry.rollup import (
     parse_exposition,
     rollup_percentiles,
 )
+from ..telemetry.anomaly import AnomalyRegistry, SentinelConfig
 from ..telemetry.audit import AuditJoiner
+from ..telemetry.incident import (
+    ClockSkewEstimator,
+    IncidentConfig,
+    IncidentManager,
+)
 from ..telemetry.sampling_profiler import merge_folded, span_function_shares
 from ..telemetry.slo import SLOConfig, SLORegistry
 from ..telemetry.workingset import merge_workingset_windows, whatif_table
@@ -176,6 +182,20 @@ class CollectorConfig:
     # index_divergence SLI: fraction of divergence-audit pod-checks that
     # found the advertised index matching engine truth.
     divergence_objective: float = 0.999
+    # Anomaly sentinels (telemetry/anomaly.py): robust MAD/z detectors
+    # over the per-round SLI series (ingest lag, restore latency, hedge
+    # spend, fence rejections, shed rate) beyond the burn-rate alerts.
+    anomaly_enabled: bool = True
+    anomaly_window: int = 64
+    anomaly_min_samples: int = 8
+    anomaly_z_threshold: float = 6.0
+    anomaly_clear_threshold: float = 3.0
+    anomaly_min_consecutive: int = 2
+    # Incident black-box capture (telemetry/incident.py): alert/anomaly
+    # fire edges (and the manual /debug/incident/open action) snapshot
+    # fleet evidence into CRC-footed CBOR bundles under
+    # ``incident.directory``.
+    incident: IncidentConfig = IncidentConfig()
     fast_windows: Tuple[float, float] = (300.0, 3600.0)
     slow_window: float = 21600.0
     fast_threshold: float = 14.4
@@ -259,6 +279,24 @@ class CollectorConfig:
             divergence_objective=float(
                 k("divergenceObjective", "divergence_objective",
                   d.divergence_objective)),
+            anomaly_enabled=bool(
+                k("anomalyEnabled", "anomaly_enabled", d.anomaly_enabled)),
+            anomaly_window=int(
+                k("anomalyWindow", "anomaly_window", d.anomaly_window)),
+            anomaly_min_samples=int(
+                k("anomalyMinSamples", "anomaly_min_samples",
+                  d.anomaly_min_samples)),
+            anomaly_z_threshold=float(
+                k("anomalyZThreshold", "anomaly_z_threshold",
+                  d.anomaly_z_threshold)),
+            anomaly_clear_threshold=float(
+                k("anomalyClearThreshold", "anomaly_clear_threshold",
+                  d.anomaly_clear_threshold)),
+            anomaly_min_consecutive=int(
+                k("anomalyMinConsecutive", "anomaly_min_consecutive",
+                  d.anomaly_min_consecutive)),
+            incident=IncidentConfig.from_dict(
+                k("incident", "incident", None)),
             fast_windows=(float(fast[0]), float(fast[1])),
             slow_window=float(k("slowWindow", "slow_window", d.slow_window)),
             fast_threshold=float(
@@ -553,6 +591,13 @@ class _TargetState:
     reachable: bool = False
     families: Dict[str, MetricFamily] = field(default_factory=dict)
     last_hist_counts: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+    # Cumulative counter values from the previous round (per family key),
+    # for the anomaly sentinels' per-round rate deltas.
+    last_counters: Dict[str, float] = field(default_factory=dict)
+    # Per-sentinel recent sample series for this target — the evidence
+    # incident bundles carry so kvdiag's first-anomalous-pod heuristic
+    # can re-score each pod offline.
+    sli_history: Dict[str, deque] = field(default_factory=dict)
 
 
 class TelemetryCollector:
@@ -621,6 +666,44 @@ class TelemetryCollector:
             objective=config.divergence_objective,
             description="divergence audit finds index matching engine "
                         "truth", **windows))
+        # Anomaly sentinels: one robust-z detector per watched SLI series
+        # (fed once per scrape round), sharing the SLO registry's edge
+        # cursor contract so the controller and the incident manager
+        # consume both streams identically.
+        self.anomalies = AnomalyRegistry(clock=clock)
+        sentinel_knobs = dict(
+            window=config.anomaly_window,
+            min_samples=config.anomaly_min_samples,
+            z_threshold=config.anomaly_z_threshold,
+            clear_threshold=config.anomaly_clear_threshold,
+            min_consecutive=config.anomaly_min_consecutive,
+        )
+        for name, description, floor in (
+                ("ingest_lag", "worst per-pod event-ingest lag (s)", 0.05),
+                ("restore_latency", "worst per-pod mean KV restore (s)",
+                 0.01),
+                ("hedge_spend", "hedged shard RPCs issued per round", 1.0),
+                ("fence_rejections", "stale-epoch rejections per round",
+                 1.0),
+                ("shed_rate", "requests shed per round", 1.0)):
+            self.anomalies.add(SentinelConfig(
+                name=name, description=description,
+                absolute_floor=floor, **sentinel_knobs))
+        # Clock-skew estimation + incident black-box capture.
+        self.skew = ClockSkewEstimator()
+        self.incidents = IncidentManager(
+            config.incident,
+            fetch=self._fetch,
+            targets=lambda: [
+                (s.target.name, s.target.address, s.breaker)
+                for s in self._targets
+            ],
+            local_evidence=self.incident_evidence,
+            skew=self.skew,
+            clock=clock,
+        )
+        self._slo_edge_cursor = -1
+        self._anomaly_edge_cursor = -1
         # Score-vs-reality join: predictions and outcomes pulled from the
         # pod audit rings land here, keyed by trace id.
         self.joiner = AuditJoiner(
@@ -669,6 +752,13 @@ class TelemetryCollector:
             return False
         state.breaker.record_success()
         FLEET_SCRAPES.labels(name, "success").inc()
+        # Clock-echo leg: one tiny GET bracketed by two local clock
+        # readings refreshes this pod's skew estimate every round (the
+        # estimator rejects congested samples itself); failures are
+        # swallowed inside update() — skew is an enrichment, never a
+        # health signal.
+        self.skew.update(
+            name, lambda: json.loads(self._fetch(f"{base}/debug/time")))
         try:
             payload = json.loads(spans_raw)
             self.assembler.ingest(payload.get("spans", []))
@@ -846,6 +936,148 @@ class TelemetryCollector:
                         bad=int(round(max(d_div, 0.0))),
                     )
 
+    def _counter_sum(self, state: _TargetState, family: str,
+                     label_filter: Optional[Tuple[str, str]] = None) -> Optional[float]:
+        """Summed cumulative value of a counter family (both the bare and
+        prometheus_client's ``_total``-suffixed TYPE name are accepted),
+        optionally restricted to samples carrying ``label_filter``."""
+        fam = (state.families.get(f"{family}_total")
+               or state.families.get(family))
+        if fam is None:
+            return None
+        total = 0.0
+        for (_suffix, labels), value in fam.samples.items():
+            if label_filter is not None \
+                    and dict(labels).get(label_filter[0]) != label_filter[1]:
+                continue
+            total += value
+        return total
+
+    def _counter_delta(self, state: _TargetState, key: str,
+                       total: Optional[float]) -> float:
+        """Per-round positive delta of a cumulative counter; a backward
+        step (pod restart) resets the baseline instead of going negative."""
+        if total is None:
+            return 0.0
+        prev = state.last_counters.get(key, 0.0)
+        if total < prev:
+            prev = 0.0
+        state.last_counters[key] = total
+        return total - prev
+
+    def _anomaly_samples(self, state: _TargetState) -> Dict[str, float]:
+        """This round's per-target sentinel inputs, from the scraped
+        exposition: gauges read directly, counters as per-round deltas,
+        the restore histogram as the delta mean."""
+        out: Dict[str, float] = {}
+        # ingest lag: worst per-pod event lag gauge (absent family -> 0).
+        lag = 0.0
+        fam = (state.families.get("kvcache_event_pod_lag_seconds")
+               or state.families.get("kvcache_index_staleness_seconds"))
+        if fam is not None:
+            for _key, value in fam.samples.items():
+                lag = max(lag, value)
+        out["ingest_lag"] = lag
+        # restore latency: delta mean of the restore histogram.
+        restore = 0.0
+        fam = state.families.get("kvtpu_offload_restore_seconds")
+        if fam is not None:
+            count = sum(v for (s, _l), v in fam.samples.items()
+                        if s == "_count")
+            total = sum(v for (s, _l), v in fam.samples.items()
+                        if s == "_sum")
+            d_count = self._counter_delta(
+                state, "anomaly:restore_count", count)
+            d_sum = self._counter_delta(state, "anomaly:restore_sum", total)
+            restore = d_sum / d_count if d_count > 0 else 0.0
+        out["restore_latency"] = restore
+        out["hedge_spend"] = self._counter_delta(
+            state, "anomaly:hedge",
+            self._counter_sum(state, "kvtpu_hedge_attempts",
+                              ("outcome", "issued")))
+        out["fence_rejections"] = self._counter_delta(
+            state, "anomaly:fence",
+            self._counter_sum(state, "kvtpu_fence_rejections"))
+        out["shed_rate"] = self._counter_delta(
+            state, "anomaly:shed",
+            self._counter_sum(state, "kvtpu_shed_decisions",
+                              ("outcome", "shed")))
+        return out
+
+    def _feed_anomaly_slis(self) -> None:
+        """Per-round sentinel feeding: compute each target's SLI samples,
+        stash them in the target's bounded history (incident-bundle
+        evidence), and feed the fleet aggregate — worst pod for the
+        latency-shaped series, fleet sum for the rate-shaped ones — to
+        the sentinel registry."""
+        fleet: Dict[str, float] = {}
+        for state in self._targets:
+            if not state.families:
+                continue
+            samples = self._anomaly_samples(state)
+            for name, value in samples.items():
+                history = state.sli_history.get(name)
+                if history is None:
+                    history = state.sli_history[name] = deque(
+                        maxlen=max(2, self.cfg.anomaly_window))
+                history.append(round(value, 6))
+                if name in ("ingest_lag", "restore_latency"):
+                    fleet[name] = max(fleet.get(name, 0.0), value)
+                else:
+                    fleet[name] = fleet.get(name, 0.0) + value
+        for name, value in fleet.items():
+            self.anomalies.observe(name, value)
+
+    def _check_incident_triggers(self) -> None:
+        """Open an incident for every *new* alert/anomaly fire edge.
+
+        Both edge streams are consumed through private cursors (the same
+        payloads /debug/slo?since= pullers see), so each fire triggers at
+        most one capture attempt; the manager's per-trigger cooldown
+        absorbs flapping alerts from there.
+        """
+        slo_edges = self.slos.export_edges_since(self._slo_edge_cursor)
+        self._slo_edge_cursor = int(
+            slo_edges.get("next_seq", self._slo_edge_cursor))
+        anomaly_edges = self.anomalies.export_edges_since(
+            self._anomaly_edge_cursor)
+        self._anomaly_edge_cursor = int(
+            anomaly_edges.get("next_seq", self._anomaly_edge_cursor))
+        for edge in slo_edges.get("edges") or ():
+            if edge.get("edge") == "fire":
+                self.incidents.maybe_open(
+                    f"slo:{edge.get('slo', '?')}", reason=dict(edge))
+        for edge in anomaly_edges.get("edges") or ():
+            if edge.get("edge") == "fire":
+                self.incidents.maybe_open(
+                    f"anomaly:{edge.get('sentinel', '?')}",
+                    reason=dict(edge))
+
+    def incident_evidence(self) -> dict:
+        """Collector-side evidence embedded in every incident bundle."""
+        return {
+            "slo": self.slos.debug_view(),
+            "anomalies": self.anomalies.debug_view(),
+            "sli_history": {
+                s.target.name: {
+                    name: list(series)
+                    for name, series in s.sli_history.items()
+                }
+                for s in self._targets
+            },
+            "traces": self.assembler.debug_view(),
+            "targets": {
+                s.target.name: {
+                    "address": s.target.address,
+                    "role": s.target.role,
+                    "reachable": s.reachable,
+                    "breaker": s.breaker.state,
+                }
+                for s in self._targets
+            },
+            "rounds": self.rounds,
+        }
+
     # -- rounds ------------------------------------------------------------
 
     def scrape_once(self) -> dict:
@@ -866,8 +1098,13 @@ class TelemetryCollector:
                     good=reachable, bad=len(self._targets) - reachable)
             self._feed_latency_slis()
             self._feed_divergence_sli()
+            if self.cfg.anomaly_enabled:
+                self._feed_anomaly_slis()
             finalized = self.assembler.finalize_idle()
             slo_state = self.slos.evaluate_all()
+            # Incident triggers ride *after* evaluate_all so a burn-rate
+            # edge minted this round is captured this round, not next.
+            self._check_incident_triggers()
             self.rounds += 1
             return {
                 "reachable": reachable,
@@ -1024,6 +1261,8 @@ class TelemetryCollector:
             "rounds": self.rounds,
             "traces": self.assembler.debug_view(),
             "slo": self.slos.debug_view(),
+            "anomaly": self.anomalies.debug_view(),
+            "incident": self.incidents.debug_view(),
             "rollup": self.rollup_view(),
             "pyprof": pyprof,
             "workingset": self.workingset_view(),
@@ -1054,6 +1293,16 @@ class TelemetryCollector:
             # AdminServer routes plain GETs to this provider and ?since=
             # pulls to a registered cursor source).
             self._admin.register_debug("audit", self.audit_view)
+            self._admin.register_debug(
+                "anomaly", self.anomalies.debug_view)
+            self._admin.register_debug(
+                "incident", self.incidents.debug_view)
+            # POST /debug/incident/open — the manual black-box pull.
+            # Captures inline so the response carries the bundle path;
+            # ?force=1 bypasses the trigger cooldown, ?trigger=<name>
+            # labels the bundle.
+            self._admin.register_action(
+                "incident/open", self._incident_open_action)
             self._admin.start()
         if self._thread is None and self.cfg.scrape_interval_s > 0:
             self._stop.clear()
@@ -1069,11 +1318,28 @@ class TelemetryCollector:
                 target=loop, name="kvtpu-telemetry-collector", daemon=True)
             self._thread.start()
 
+    def _incident_open_action(self, params) -> dict:
+        trigger = str(params.get("trigger") or "manual")
+        force = str(params.get("force", "")).lower() in ("1", "true", "yes")
+        summary = self.incidents.maybe_open(
+            f"manual:{trigger}" if not trigger.startswith("manual") else trigger,
+            reason={"source": "admin", "params": dict(params)},
+            force=force,
+            synchronous=True,
+        )
+        if summary is None:
+            raise ValueError(
+                "incident suppressed (cooldown, capture in flight, or "
+                "incident.directory unset); retry with force=1 or "
+                "configure incidentConfig")
+        return summary
+
     @property
     def admin_port(self) -> int:
         return self._admin.port if self._admin is not None else 0
 
     def stop(self) -> None:
+        self.incidents.wait(timeout=5.0)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

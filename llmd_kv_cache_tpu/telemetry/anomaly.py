@@ -1,0 +1,254 @@
+"""Robust anomaly sentinels over fleet SLI series (ISSUE 20).
+
+The burn-rate alerts (telemetry/slo.py) answer "is the error budget on
+fire?" — but they only see SLIs with an explicit objective, and a slow
+gray failure (one pod's ingest lag creeping up, hedge spend doubling,
+fence rejections trickling in) can simmer for a long time without
+touching a budget. The sentinels watch the *shape* of each series
+instead: every scrape round the collector feeds one sample per sentinel,
+and the detector compares it against the series' own recent history with
+a **robust z-score**:
+
+    z = 0.6745 * (x - median) / MAD
+
+where MAD is the median absolute deviation of the window — median/MAD
+instead of mean/stddev so the baseline is not dragged by the very
+outliers being hunted (a single 100x spike barely moves the median). A
+sentinel *fires* after ``min_consecutive`` samples beyond
+``z_threshold`` (one blip is noise) and *clears* once the score falls
+back under ``clear_threshold`` (hysteresis, so a value hovering at the
+threshold cannot flap the edge stream).
+
+Edges are seq-stamped into a bounded ring with the exact cursor contract
+of ``SLORegistry.export_edges_since`` so the fleet controller and the
+incident manager consume one uniform edge stream; level state folds into
+``FleetSignals.anomalies`` (control/signals.py).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from prometheus_client import Counter, Gauge
+
+from ..utils.lockdep import new_lock
+
+ANOMALY_ACTIVE = Gauge(
+    "kvtpu_anomaly_active",
+    "1 while the sentinel's robust-z anomaly is firing",
+    ["sentinel"],
+)
+ANOMALY_EDGES = Counter(
+    "kvtpu_anomaly_edges_total",
+    "Sentinel anomaly transitions by edge (fire/clear)",
+    ["sentinel", "edge"],
+)
+ANOMALY_SCORE = Gauge(
+    "kvtpu_anomaly_score",
+    "Latest robust z-score of the sentinel's series",
+    ["sentinel"],
+)
+
+# 0.6745 ~= Phi^-1(0.75): scales MAD to the stddev of a normal series so
+# z thresholds read in familiar sigma units.
+_MAD_TO_SIGMA = 0.6745
+
+
+def robust_z(value: float, history: List[float]) -> float:
+    """Robust z-score of ``value`` against ``history`` (median/MAD).
+
+    A zero MAD (constant history — the common case for a healthy counter
+    rate of 0) falls back to the mean absolute deviation, and when that
+    is zero too, any deviation at all is scored infinite: a series that
+    has literally never moved and suddenly does *is* the anomaly.
+    """
+    if not history:
+        return 0.0
+    ordered = sorted(history)
+    n = len(ordered)
+    median = (ordered[n // 2] if n % 2
+              else 0.5 * (ordered[n // 2 - 1] + ordered[n // 2]))
+    deviations = sorted(abs(x - median) for x in ordered)
+    mad = (deviations[n // 2] if n % 2
+           else 0.5 * (deviations[n // 2 - 1] + deviations[n // 2]))
+    if mad <= 0.0:
+        mad = sum(deviations) / n / _MAD_TO_SIGMA
+    if mad <= 0.0:
+        return float("inf") if value != median else 0.0
+    return _MAD_TO_SIGMA * (value - median) / mad
+
+
+@dataclass(frozen=True)
+class SentinelConfig:
+    """One watched SLI series."""
+
+    name: str
+    description: str = ""
+    # Samples of history kept (and required before any verdict).
+    window: int = 64
+    min_samples: int = 8
+    # Fire above z_threshold, clear below clear_threshold (hysteresis).
+    z_threshold: float = 6.0
+    clear_threshold: float = 3.0
+    # Consecutive anomalous samples before the fire edge (blip filter).
+    min_consecutive: int = 2
+    # |value - median| must also exceed this before firing — keeps a
+    # microsecond-scale wiggle on an all-but-constant series from scoring
+    # "infinite sigma" (units of the series itself).
+    absolute_floor: float = 0.0
+
+
+class AnomalySentinel:
+    """Edge-triggered robust-z detector over one scalar series."""
+
+    def __init__(
+        self,
+        config: SentinelConfig,
+        clock: Callable[[], float] = time.monotonic,
+        on_edge: Optional[Callable[[dict], None]] = None,
+    ):
+        self.config = config
+        self._clock = clock
+        self._on_edge = on_edge
+        self._lock = new_lock()
+        self._history: deque = deque(maxlen=max(2, config.window))
+        self._streak = 0
+        self.firing = False
+        self.last_value = 0.0
+        self.last_z = 0.0
+        self.fires = 0
+
+    def observe(self, value: float) -> Optional[dict]:
+        """Ingest one sample; returns the edge record when one fired."""
+        cfg = self.config
+        value = float(value)
+        edge: Optional[dict] = None
+        with self._lock:
+            history = list(self._history)
+            z = robust_z(value, history) if len(history) >= cfg.min_samples \
+                else 0.0
+            ordered = sorted(history)
+            median = (0.0 if not ordered else
+                      ordered[len(ordered) // 2] if len(ordered) % 2 else
+                      0.5 * (ordered[len(ordered) // 2 - 1]
+                             + ordered[len(ordered) // 2]))
+            anomalous = (abs(z) >= cfg.z_threshold
+                         and abs(value - median) >= cfg.absolute_floor)
+            self._streak = self._streak + 1 if anomalous else 0
+            prev = self.firing
+            if not prev and self._streak >= max(1, cfg.min_consecutive):
+                self.firing = True
+                self.fires += 1
+            elif prev and abs(z) < cfg.clear_threshold:
+                self.firing = False
+                self._streak = 0
+            if self.firing != prev:
+                edge = {
+                    "ts": self._clock(),
+                    "sentinel": cfg.name,
+                    "edge": "fire" if self.firing else "clear",
+                    "value": round(value, 6),
+                    "median": round(median, 6),
+                    "z": round(min(z, 1e9), 3),
+                }
+            # Anomalous samples never feed the baseline — neither while
+            # firing (a long incident cannot launder itself into
+            # "normal") nor during the pre-fire streak: on a tight
+            # series the first outlier would inflate the MAD fallback
+            # enough that the second consecutive sample scores back
+            # under threshold and min_consecutive could never be met.
+            if not self.firing and not anomalous:
+                self._history.append(value)
+            self.last_value = value
+            self.last_z = z if z != float("inf") else 1e9
+        ANOMALY_SCORE.labels(cfg.name).set(round(min(z, 1e9), 3))
+        ANOMALY_ACTIVE.labels(cfg.name).set(1.0 if self.firing else 0.0)
+        if edge is not None:
+            ANOMALY_EDGES.labels(cfg.name, edge["edge"]).inc()
+            if self._on_edge is not None:
+                # Outside the lock's critical work: the sink may re-enter.
+                self._on_edge(edge)
+        return edge
+
+    def debug_view(self) -> dict:
+        with self._lock:
+            return {
+                "sentinel": self.config.name,
+                "description": self.config.description,
+                "firing": self.firing,
+                "fires": self.fires,
+                "last_value": round(self.last_value, 6),
+                "last_z": round(self.last_z, 3),
+                "samples": len(self._history),
+            }
+
+
+class AnomalyRegistry:
+    """The collector's sentinels, sharing one seq-stamped edge ring.
+
+    Cursor contract mirrors ``SLORegistry.export_edges_since`` exactly
+    (``seq > since``; ``next_seq`` = last stamped seq; bounded ring with
+    a drop counter) so ``/debug/slo?since=`` consumers can treat both
+    streams identically.
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        max_edges: int = 512,
+    ):
+        self.clock = clock
+        self.sentinels: Dict[str, AnomalySentinel] = {}
+        self.max_edges = max_edges
+        self._edges: deque = deque()
+        self._edge_lock = new_lock()
+        self._edge_seq = 0
+        self.edges_dropped = 0
+
+    def add(self, config: SentinelConfig) -> AnomalySentinel:
+        sentinel = AnomalySentinel(
+            config, clock=self.clock, on_edge=self._record_edge)
+        self.sentinels[config.name] = sentinel
+        return sentinel
+
+    def get(self, name: str) -> Optional[AnomalySentinel]:
+        return self.sentinels.get(name)
+
+    def observe(self, name: str, value: float) -> Optional[dict]:
+        sentinel = self.sentinels.get(name)
+        return sentinel.observe(value) if sentinel is not None else None
+
+    def active(self) -> Dict[str, dict]:
+        """Level state per sentinel (the ``FleetSignals.anomalies`` feed)."""
+        return {
+            name: {
+                "firing": s.firing,
+                "last_value": round(s.last_value, 6),
+                "last_z": round(min(s.last_z, 1e9), 3),
+            }
+            for name, s in self.sentinels.items()
+        }
+
+    def debug_view(self) -> dict:
+        return {name: s.debug_view() for name, s in self.sentinels.items()}
+
+    def _record_edge(self, edge: dict) -> None:
+        with self._edge_lock:
+            edge = dict(edge)
+            edge["seq"] = self._edge_seq
+            self._edge_seq += 1
+            self._edges.append(edge)
+            while len(self._edges) > self.max_edges:
+                self._edges.popleft()
+                self.edges_dropped += 1
+
+    def export_edges_since(self, since: int = -1) -> dict:
+        with self._edge_lock:
+            return {
+                "edges": [dict(e) for e in self._edges if e["seq"] > since],
+                "next_seq": self._edge_seq - 1,
+                "dropped": self.edges_dropped,
+            }

@@ -27,7 +27,9 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import os
 import signal
+import tempfile
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -55,10 +57,18 @@ KIND_HEDGE = "hedge"
 KIND_SHED = "shed"
 KIND_AUDIT = "audit"
 KIND_FENCE = "fence"
+KIND_ANOMALY = "anomaly"
+KIND_INCIDENT = "incident"
 
 
 class FlightRecorder:
-    """Fixed-capacity ring of ``(seq, ts, kind, data)`` tuples."""
+    """Fixed-capacity ring of ``(seq, ts, mono, kind, data)`` tuples.
+
+    ``ts`` is wall-clock (``time.time()``) so records from different pods
+    can be merged onto one fleet timeline (after the collector's per-pod
+    skew correction, telemetry/incident.py); ``mono`` is the same pod's
+    ``time.monotonic()`` so records align with span start/end stamps and
+    survive local wall-clock steps."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         if capacity <= 0:
@@ -74,23 +84,44 @@ class FlightRecorder:
     def record(self, kind: str, data: Optional[dict] = None) -> int:
         """Append one record; returns its sequence number.
 
-        Hot-path budget: one ``next()``, one ``time.time()``, one tuple
-        build, one list store. ``data`` is kept by reference — treat it as
-        frozen after handoff (callers on the hot path pass freshly built
-        dicts they do not mutate afterwards).
+        Hot-path budget: one ``next()``, one ``time.time()``, one
+        ``time.monotonic()``, one tuple build, one list store. ``data`` is
+        kept by reference — treat it as frozen after handoff (callers on
+        the hot path pass freshly built dicts they do not mutate
+        afterwards).
         """
         seq = next(self._count)
-        self._slots[seq % self._capacity] = (seq, time.time(), kind, data)
+        self._slots[seq % self._capacity] = (
+            seq, time.time(), time.monotonic(), kind, data)
         return seq
+
+    def _live(self) -> list[tuple]:
+        live = [s for s in list(self._slots) if s is not None]
+        live.sort(key=lambda rec: rec[0])
+        return live
 
     def snapshot(self) -> list[dict[str, Any]]:
         """Records currently in the ring, oldest first."""
-        live = [s for s in list(self._slots) if s is not None]
-        live.sort(key=lambda rec: rec[0])
         return [
-            {"seq": seq, "ts": ts, "kind": kind, "data": data}
-            for seq, ts, kind, data in live
+            {"seq": seq, "ts": ts, "mono": mono, "kind": kind, "data": data}
+            for seq, ts, mono, kind, data in self._live()
         ]
+
+    def export_since(self, since: int = -1) -> dict[str, Any]:
+        """Records with ``seq > since`` plus the resume cursor — the
+        ``/debug/flight-recorder?since=`` payload, with the same
+        non-destructive per-puller cursor semantics as ``/debug/spans``:
+        ``next_seq`` is the newest seq present (echo it back next pull)
+        and ``dropped`` counts records evicted from the ring so far."""
+        live = self._live()
+        records = [
+            {"seq": seq, "ts": ts, "mono": mono, "kind": kind, "data": data}
+            for seq, ts, mono, kind, data in live
+            if seq > since
+        ]
+        next_seq = live[-1][0] if live else since
+        dropped = max(0, live[-1][0] + 1 - len(live)) if live else 0
+        return {"records": records, "next_seq": next_seq, "dropped": dropped}
 
     def dump_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(
@@ -138,11 +169,16 @@ def install_signal_dump(
     signum: int = signal.SIGUSR2,
     path: Optional[str] = None,
     recorder: Optional[FlightRecorder] = None,
+    dump_dir: Optional[str] = None,
 ) -> Callable:
     """Dump the ring as JSON on ``signum`` (default ``SIGUSR2``).
 
-    Writes to ``path`` when given, else to this module's logger at WARNING
-    (operators strace a wedged pod with ``kill -USR2`` and read the log).
+    Writes to ``path`` when given. Otherwise each signal writes a fresh
+    timestamped file under ``dump_dir`` (default: ``$KVTPU_DUMP_DIR``,
+    falling back to the system temp dir) and logs the file path — a
+    1024-record ring serialized onto a single ``logger.warning`` line
+    used to be truncated by every log shipper that touched it, so the
+    payload never goes to the log anymore, only its location does.
     Returns the previous handler so callers can restore it. Must be called
     from the main thread (CPython restriction on ``signal.signal``).
     """
@@ -150,14 +186,22 @@ def install_signal_dump(
 
     def _handler(_signum, _frame):
         payload = rec.dump_json()
-        if path:
-            try:
-                with open(path, "w") as fh:
-                    fh.write(payload)
-            except OSError as exc:
-                logger.error("flight-recorder dump to %s failed: %s", path, exc)
+        target = path
+        if not target:
+            directory = (dump_dir or os.environ.get("KVTPU_DUMP_DIR")
+                         or tempfile.gettempdir())
+            stamp = time.strftime("%Y%m%dT%H%M%S")
+            target = os.path.join(
+                directory, f"kvtpu-flight-{os.getpid()}-{stamp}.json")
+        try:
+            with open(target, "w") as fh:
+                fh.write(payload)
+        except OSError as exc:
+            logger.error("flight-recorder dump to %s failed: %s", target, exc)
         else:
-            logger.warning("flight-recorder dump (SIGUSR2): %s", payload)
+            logger.warning(
+                "flight-recorder dump (signal %d) written to %s (%d bytes)",
+                _signum, target, len(payload))
 
     return signal.signal(signum, _handler)
 

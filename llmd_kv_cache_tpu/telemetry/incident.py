@@ -1,0 +1,695 @@
+"""Incident black-box: alert-triggered fleet evidence capture (ISSUE 20).
+
+When an alert or anomaly sentinel fires, the evidence an operator needs
+— each pod's flight-recorder ring, recent spans, profiler window, audit
+records, membership view, the controller journal — is scattered across
+per-pod rings that keep rotating while the human is still getting paged.
+The :class:`IncidentManager` captures all of it *at the edge*: one
+fan-out over the fleet's admin endpoints (with the PR 1 retry/breaker
+semantics the collector already applies to scrapes), snapshotted into a
+single self-contained **bundle** file:
+
+    +----------------------+----------------------+------------------+
+    | magic "KVTPUINC1\\n"  | canonical CBOR doc   | CRC footer (1    |
+    | (10 bytes)           | (the evidence)       | slot, integrity) |
+    +----------------------+----------------------+------------------+
+
+— the PR 4 snapshot format with its own magic, written via
+``utils.atomic_io`` so a torn write can never publish a half bundle.
+Per-trigger cooldowns and a keep-N retention cap bound the disk cost of
+a flapping alert; capture runs on a detached worker thread so the
+trigger edge itself costs microseconds (bench.py ``--incident`` gates
+it).
+
+Cross-pod timelines need one clock. ``/debug/time`` (services/admin.py)
+echoes each pod's wall + monotonic clocks; :class:`ClockSkewEstimator`
+brackets the echo between two local readings and halves the RTT —
+the NTP offset estimate ``remote_wall - (t0 + rtt/2)``, whose error is
+bounded by ``rtt/2`` under asymmetric routing. Bundles carry the offset
+table so ``kvdiag --incident`` can merge flight records, span edges and
+controller actions from every pod onto one corrected timeline offline
+(:func:`merged_timeline`, :func:`first_anomalous_pod`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from prometheus_client import Counter, Gauge
+
+from ..resilience.integrity import (
+    IntegrityError,
+    build_footer,
+    footer_size,
+    parse_footer,
+)
+from ..utils.atomic_io import atomic_write_bytes
+from ..utils.cbor import CBORDecodeError, canonical_cbor_decode, canonical_cbor_encode
+from ..utils.lockdep import new_lock
+from ..utils.logging import get_logger
+from .anomaly import robust_z
+from .flight_recorder import KIND_INCIDENT, flight_recorder
+
+logger = get_logger("telemetry.incident")
+
+INCIDENT_OPENED = Counter(
+    "kvtpu_incident_opened_total",
+    "Incident captures started, by trigger",
+    ["trigger"],
+)
+INCIDENT_SUPPRESSED = Counter(
+    "kvtpu_incident_suppressed_total",
+    "Incident triggers suppressed before capture, by reason",
+    ["reason"],  # cooldown|disabled|inflight
+)
+INCIDENT_BUNDLE_BYTES = Gauge(
+    "kvtpu_incident_bundle_bytes",
+    "Size of the most recently written incident bundle",
+)
+INCIDENT_CAPTURE_SECONDS = Gauge(
+    "kvtpu_incident_capture_seconds",
+    "Wall duration of the most recent evidence capture fan-out",
+)
+INCIDENT_PODS_CAPTURED = Gauge(
+    "kvtpu_incident_pods_captured",
+    "Pods that contributed evidence to the most recent bundle",
+)
+
+BUNDLE_MAGIC = b"KVTPUINC1\n"
+BUNDLE_VERSION = 1
+_NAME_RE = re.compile(r"^incident-(\d{8})(?:-[A-Za-z0-9_.]+)?\.inc$")
+_TRIGGER_SAFE_RE = re.compile(r"[^A-Za-z0-9_.]+")
+
+
+class IncidentBundleError(Exception):
+    """Bundle file malformed or failed verification."""
+
+
+def encode_bundle(doc: dict) -> bytes:
+    """Serialize an evidence document to the on-disk bundle format."""
+    body = canonical_cbor_encode(doc)
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return BUNDLE_MAGIC + body + build_footer([crc])
+
+
+def decode_bundle(blob: bytes) -> dict:
+    """Parse + verify one bundle; raise :class:`IncidentBundleError`."""
+    if not blob.startswith(BUNDLE_MAGIC):
+        raise IncidentBundleError(
+            "bad magic (not an incident bundle, or truncated head)")
+    tail = footer_size(1)
+    if len(blob) < len(BUNDLE_MAGIC) + tail:
+        raise IncidentBundleError("truncated bundle (magic + footer missing)")
+    body = blob[len(BUNDLE_MAGIC):-tail]
+    try:
+        (want,) = parse_footer(blob[-tail:], 1)
+    except IntegrityError as e:
+        raise IncidentBundleError(f"bad checksum footer: {e}") from e
+    got = zlib.crc32(body) & 0xFFFFFFFF
+    if got != want:
+        raise IncidentBundleError(
+            f"body crc mismatch: footer={want:#010x} data={got:#010x}")
+    try:
+        doc = canonical_cbor_decode(body)
+    except CBORDecodeError as e:
+        raise IncidentBundleError(f"undecodable bundle body: {e}") from e
+    if not isinstance(doc, dict):
+        raise IncidentBundleError(
+            f"bundle body is {type(doc).__name__}, expected map")
+    return doc
+
+
+def load_bundle(path: str) -> dict:
+    with open(path, "rb") as fh:
+        return decode_bundle(fh.read())
+
+
+# -- clock-skew estimation ---------------------------------------------------
+
+
+def estimate_offset(
+    t0_wall: float, rtt_s: float, remote_wall: float
+) -> float:
+    """NTP-style RTT-halved offset: ``remote_wall - local_wall`` at the
+    instant the remote stamped its clock, assuming the request and the
+    response each took half the round trip. Under asymmetric routing
+    (request a, response b, rtt = a + b) the error is ``(b - a) / 2``,
+    always bounded by ``rtt / 2``."""
+    return remote_wall - (t0_wall + rtt_s / 2.0)
+
+
+@dataclass
+class _OffsetState:
+    offset_s: float = 0.0
+    rtt_s: float = float("inf")
+    updated_mono: float = 0.0
+    samples: int = 0
+
+
+class ClockSkewEstimator:
+    """Per-pod clock offsets from ``/debug/time`` echoes.
+
+    Plain NTP filtering: a new sample replaces the stored estimate when
+    its RTT is comparable to (or better than) the stored one — a
+    congested round trip widens the error bound, so it must not clobber
+    a tight estimate — **unless** the stored estimate has aged past
+    ``max_age_s``, because clocks drift and a stale tight estimate is
+    eventually worse than a fresh loose one.
+    """
+
+    def __init__(
+        self,
+        mono: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        rtt_slack: float = 1.5,
+        max_age_s: float = 120.0,
+    ):
+        self._mono = mono
+        self._wall = wall
+        self._rtt_slack = rtt_slack
+        self._max_age_s = max_age_s
+        self._lock = new_lock()
+        self._pods: Dict[str, _OffsetState] = {}
+
+    def update(self, pod: str, fetch_time: Callable[[], dict]) -> Optional[float]:
+        """One echo round against ``pod``; returns the accepted offset
+        (or None when the sample was rejected or the fetch failed)."""
+        t0_mono = self._mono()
+        t0_wall = self._wall()
+        try:
+            payload = fetch_time()
+            remote_wall = float(payload["wall"])
+        except Exception as exc:
+            logger.debug("time echo from %s failed: %s", pod, exc)
+            return None
+        t1_mono = self._mono()
+        rtt = max(0.0, t1_mono - t0_mono)
+        offset = estimate_offset(t0_wall, rtt, remote_wall)
+        with self._lock:
+            state = self._pods.setdefault(pod, _OffsetState())
+            age = t0_mono - state.updated_mono
+            accept = (
+                state.samples == 0
+                or rtt <= state.rtt_s * self._rtt_slack
+                or age >= self._max_age_s
+            )
+            state.samples += 1
+            if not accept:
+                return None
+            state.offset_s = offset
+            state.rtt_s = rtt
+            state.updated_mono = t1_mono
+            return offset
+
+    def offsets(self) -> Dict[str, dict]:
+        """The bundle's offset table: ``pod -> {offset_s, rtt_s, age_s}``.
+        ``offset_s`` is *pod wall minus local wall*; subtract it from a
+        pod timestamp to land on the local (collector) timeline."""
+        now = self._mono()
+        with self._lock:
+            return {
+                pod: {
+                    "offset_s": round(st.offset_s, 6),
+                    "rtt_s": round(st.rtt_s, 6),
+                    "age_s": round(max(0.0, now - st.updated_mono), 3),
+                    "samples": st.samples,
+                }
+                for pod, st in self._pods.items()
+                if st.samples > 0 and st.rtt_s != float("inf")
+            }
+
+
+# -- the incident manager ----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class IncidentConfig:
+    """``fleetTelemetry.collector.incident`` config block."""
+
+    enabled: bool = True
+    # Bundle directory; empty disables capture entirely (triggers are
+    # counted as suppressed so the silence is visible).
+    directory: str = ""
+    # A trigger that fired within cooldown_s of its previous capture is
+    # suppressed — a flapping alert must not spam the disk.
+    cooldown_s: float = 300.0
+    # Keep-N retention over bundle files (oldest deleted first).
+    max_bundles: int = 16
+    # Evidence caps per pod (entries, newest kept).
+    flight_tail: int = 512
+    spans_tail: int = 256
+    journal_tail: int = 64
+
+    @classmethod
+    def from_dict(cls, data: Optional[dict]) -> "IncidentConfig":
+        if not data:
+            return cls()
+
+        def k(camel: str, snake: str, default):
+            if camel in data:
+                return data[camel]
+            if snake in data:
+                return data[snake]
+            return default
+
+        d = cls()
+        return cls(
+            enabled=bool(k("enabled", "enabled", d.enabled)),
+            directory=str(k("directory", "directory", d.directory)),
+            cooldown_s=float(k("cooldownS", "cooldown_s", d.cooldown_s)),
+            max_bundles=int(k("maxBundles", "max_bundles", d.max_bundles)),
+            flight_tail=int(k("flightTail", "flight_tail", d.flight_tail)),
+            spans_tail=int(k("spansTail", "spans_tail", d.spans_tail)),
+            journal_tail=int(k("journalTail", "journal_tail", d.journal_tail)),
+        )
+
+
+class IncidentManager:
+    """Edge-triggered black-box capture over the fleet admin plane.
+
+    ``targets()`` yields ``(name, address, breaker)`` triples (the
+    collector's scrape targets and their PR 1 breakers); ``fetch(url)``
+    is the collector's retrying transport. ``local_evidence()`` returns
+    the collector-side snapshot (alert/anomaly state, per-pod SLI
+    history, retained traces) embedded in every bundle.
+    """
+
+    # Per-pod evidence legs: (key, path). The flight recorder is the
+    # required leg — a pod that cannot even serve its ring is recorded
+    # unreachable (and its breaker charged); everything else is
+    # enrichment, 404-tolerated exactly like the collector's scrape legs.
+    _REQUIRED_LEG = ("flight_recorder", "/debug/flight-recorder?since=-1")
+    _ENRICHMENT_LEGS = (
+        ("time", "/debug/time"),
+        ("spans", "/debug/spans?since=-1"),
+        ("pyprof", "/debug/pyprof?since=-1"),
+        ("audit", "/debug/audit?since=-1"),
+        ("membership", "/debug/membership"),
+        ("controller", "/debug/controller"),
+    )
+
+    def __init__(
+        self,
+        config: IncidentConfig,
+        fetch: Callable[[str], bytes],
+        targets: Callable[[], List[Tuple[str, str, object]]],
+        local_evidence: Optional[Callable[[], dict]] = None,
+        skew: Optional[ClockSkewEstimator] = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        max_recent: int = 32,
+    ):
+        self.cfg = config
+        self._fetch = fetch
+        self._targets = targets
+        self._local_evidence = local_evidence or (lambda: {})
+        self.skew = skew if skew is not None else ClockSkewEstimator()
+        self._clock = clock
+        self._wall = wall
+        self._lock = new_lock()
+        self._last_open: Dict[str, float] = {}
+        self._recent: deque = deque(maxlen=max_recent)
+        self._suppressed: Dict[str, int] = {}
+        # Exported lazily: maybe_open rides the collector's edge stream
+        # (bench.py --incident gates it <1% of the score p50) and one
+        # prometheus child.inc() alone costs most of that budget. The
+        # Python-side counts above are exact and always visible in
+        # /debug/incident; the prometheus counters catch up at every
+        # accepted trigger and debug_view()/offsets scrape.
+        self._suppress_counters = {
+            reason: INCIDENT_SUPPRESSED.labels(reason)
+            for reason in ("disabled", "cooldown", "inflight")
+        }
+        self._suppress_published: Dict[str, int] = {}
+        self._inflight: Optional[threading.Thread] = None
+        self._seq = 0
+        self.opened = 0
+
+    # -- triggering --------------------------------------------------------
+
+    def maybe_open(
+        self,
+        trigger: str,
+        reason: Optional[dict] = None,
+        force: bool = False,
+        synchronous: bool = False,
+    ) -> Optional[dict]:
+        """Open an incident for ``trigger`` unless suppressed.
+
+        This is the edge-stream hook and must stay cheap: it takes one
+        lock, checks the cooldown table, and hands the fan-out to a
+        detached worker thread (``synchronous=True`` — tests, the manual
+        admin action — captures inline and returns the summary).
+        Returns the accepted-trigger stub (or the finished summary when
+        synchronous), ``None`` when suppressed.
+        """
+        now = self._clock()
+        if not force:
+            # Lock-free steady-state fast path: a trigger still inside
+            # its cooldown window is what every edge of a flapping alert
+            # pays. The dict read is GIL-atomic, and a racing capture
+            # can only have stamped a *newer* ``last`` — which still
+            # suppresses — so the check never wrongly accepts; a miss
+            # falls through to the locked re-check below.
+            last = self._last_open.get(trigger)
+            if last is not None and now - last < self.cfg.cooldown_s:
+                self._suppress("cooldown")
+                return None
+        with self._lock:
+            if not self.cfg.enabled or not self.cfg.directory:
+                self._suppress("disabled")
+                return None
+            last = self._last_open.get(trigger)
+            if not force and last is not None \
+                    and now - last < self.cfg.cooldown_s:
+                self._suppress("cooldown")
+                return None
+            if self._inflight is not None and self._inflight.is_alive():
+                self._suppress("inflight")
+                return None
+            self._last_open[trigger] = now
+            self._seq += 1
+            seq = self._seq
+            self.opened += 1
+        INCIDENT_OPENED.labels(trigger).inc()
+        self._sync_suppressed()
+        if synchronous:
+            return self._capture(seq, trigger, reason or {})
+        worker = threading.Thread(
+            target=self._capture,
+            args=(seq, trigger, reason or {}),
+            name=f"kvtpu-incident-{seq}",
+            daemon=True,
+        )
+        with self._lock:
+            self._inflight = worker
+        worker.start()
+        return {"seq": seq, "trigger": trigger, "state": "capturing"}
+
+    def _suppress(self, why: str) -> None:
+        # Unlocked read-modify-write: callers on the fast path hold no
+        # lock, so a concurrent bump can lose one count in the *local*
+        # dict — acceptable for a suppression tally, and in practice the
+        # edge stream is the collector's single scrape thread.
+        self._suppressed[why] = self._suppressed.get(why, 0) + 1
+
+    def _sync_suppressed(self) -> None:
+        """Catch the prometheus counters up to the exact local tally."""
+        for why, n in list(self._suppressed.items()):
+            delta = n - self._suppress_published.get(why, 0)
+            if delta > 0:
+                self._suppress_counters[why].inc(delta)
+                self._suppress_published[why] = n
+
+    def wait(self, timeout: float = 10.0) -> None:
+        """Join any in-flight capture (tests, orderly shutdown)."""
+        with self._lock:
+            worker = self._inflight
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=timeout)
+
+    # -- capture -----------------------------------------------------------
+
+    def _capture_pod(self, name: str, address: str, breaker) -> dict:
+        evidence: dict = {"reachable": False}
+        if breaker is not None and not breaker.allow():
+            evidence["error"] = "breaker open"
+            return evidence
+        base = f"http://{address}"
+        key, path = self._REQUIRED_LEG
+        try:
+            payload = json.loads(self._fetch(base + path))
+            records = payload.get("records")
+            if isinstance(records, list) \
+                    and len(records) > self.cfg.flight_tail:
+                payload["records"] = records[-self.cfg.flight_tail:]
+                payload["truncated"] = len(records) - self.cfg.flight_tail
+            evidence[key] = payload
+            evidence["reachable"] = True
+            if breaker is not None:
+                breaker.record_success()
+        except Exception as exc:
+            evidence["error"] = str(exc)
+            if breaker is not None:
+                breaker.record_failure()
+            return evidence
+        for key, path in self._ENRICHMENT_LEGS:
+            try:
+                payload = json.loads(self._fetch(base + path))
+            except Exception:  # enrichment leg, 404/timeout tolerated  # lint: allow-swallow
+                continue
+            if key == "spans":
+                spans = payload.get("spans")
+                if isinstance(spans, list) \
+                        and len(spans) > self.cfg.spans_tail:
+                    payload["spans"] = spans[-self.cfg.spans_tail:]
+                    payload["truncated"] = len(spans) - self.cfg.spans_tail
+            evidence[key] = payload
+        return evidence
+
+    def _capture(self, seq: int, trigger: str, reason: dict) -> dict:
+        start = self._clock()
+        pods: Dict[str, dict] = {}
+        captured = 0
+        for name, address, breaker in self._targets():
+            evidence = self._capture_pod(name, address, breaker)
+            pods[name] = evidence
+            captured += int(bool(evidence.get("reachable")))
+        try:
+            local = self._local_evidence()
+        except Exception as exc:  # evidence, never capture-fatal
+            local = {"error": str(exc)}
+        journal = local.get("controller_journal")
+        if isinstance(journal, list) and len(journal) > self.cfg.journal_tail:
+            local["controller_journal"] = journal[-self.cfg.journal_tail:]
+        doc = {
+            "version": BUNDLE_VERSION,
+            "seq": seq,
+            "trigger": trigger,
+            "reason": reason,
+            "opened_wall": self._wall(),
+            "opened_mono": self._clock(),
+            "offsets": self.skew.offsets(),
+            "collector": local,
+            "pods": pods,
+        }
+        duration = self._clock() - start
+        doc["capture_seconds"] = round(duration, 6)
+        summary = {
+            "seq": seq,
+            "trigger": trigger,
+            "opened_wall": doc["opened_wall"],
+            "pods_captured": captured,
+            "pods_total": len(pods),
+            "capture_seconds": doc["capture_seconds"],
+            "path": "",
+            "bytes": 0,
+        }
+        try:
+            summary["path"], summary["bytes"] = self._write(seq, trigger, doc)
+        except Exception as exc:
+            summary["error"] = str(exc)
+            logger.error("incident bundle write failed: %s", exc)
+        INCIDENT_CAPTURE_SECONDS.set(duration)
+        INCIDENT_PODS_CAPTURED.set(captured)
+        with self._lock:
+            self._recent.append(summary)
+        flight_recorder().record(KIND_INCIDENT, {
+            "trigger": trigger,
+            "pods": captured,
+            "path": summary["path"],
+        })
+        logger.warning(
+            "incident %d (%s): %d/%d pod(s) captured in %.3fs -> %s",
+            seq, trigger, captured, len(pods), duration,
+            summary["path"] or summary.get("error", "<unwritten>"))
+        return summary
+
+    def _write(self, seq: int, trigger: str, doc: dict) -> Tuple[str, int]:
+        safe = _TRIGGER_SAFE_RE.sub("_", trigger).strip("_") or "manual"
+        path = os.path.join(
+            self.cfg.directory, f"incident-{seq:08d}-{safe}.inc")
+        os.makedirs(self.cfg.directory, exist_ok=True)
+        blob = encode_bundle(doc)
+        atomic_write_bytes(path, blob)
+        INCIDENT_BUNDLE_BYTES.set(len(blob))
+        self._prune()
+        return path, len(blob)
+
+    def _prune(self) -> None:
+        try:
+            names = os.listdir(self.cfg.directory)
+        except OSError:
+            return
+        bundles = sorted(
+            (int(m.group(1)), n)
+            for n in names
+            if (m := _NAME_RE.match(n)) is not None
+        )
+        excess = len(bundles) - max(1, self.cfg.max_bundles)
+        for _seq, name in bundles[:max(0, excess)]:
+            try:
+                os.unlink(os.path.join(self.cfg.directory, name))
+            except OSError:  # racing another pruner  # lint: allow-swallow
+                pass
+
+    # -- read surface ------------------------------------------------------
+
+    def debug_view(self) -> dict:
+        """The collector's ``/debug/incident`` payload (and the
+        ``incidents`` section of ``kvdiag --fleet``)."""
+        self._sync_suppressed()
+        with self._lock:
+            recent = list(self._recent)
+            suppressed = dict(self._suppressed)
+            inflight = self._inflight is not None and self._inflight.is_alive()
+        return {
+            "enabled": bool(self.cfg.enabled and self.cfg.directory),
+            "directory": self.cfg.directory,
+            "cooldown_s": self.cfg.cooldown_s,
+            "max_bundles": self.cfg.max_bundles,
+            "opened_total": self.opened,
+            "capturing": inflight,
+            "suppressed": suppressed,
+            "recent": recent,
+            "offsets": self.skew.offsets(),
+        }
+
+
+# -- offline bundle analysis (kvdiag --incident) -----------------------------
+
+
+def merged_timeline(doc: dict, limit: int = 0) -> List[dict]:
+    """Skew-corrected cross-pod event list, oldest first.
+
+    Every event timestamp is mapped onto the *collector's* wall clock by
+    subtracting the source pod's estimated offset (``offsets`` table in
+    the bundle; pods without an estimate merge uncorrected). Sources:
+    flight-recorder records, span start/end edges, and controller journal
+    records from the collector evidence.
+    """
+    offsets = doc.get("offsets") or {}
+    events: List[dict] = []
+
+    def off(pod: str) -> float:
+        return float((offsets.get(pod) or {}).get("offset_s", 0.0))
+
+    for pod, evidence in (doc.get("pods") or {}).items():
+        shift = off(pod)
+        flight = (evidence.get("flight_recorder") or {}).get("records") or ()
+        for rec in flight:
+            events.append({
+                "ts": float(rec.get("ts", 0.0)) - shift,
+                "pod": pod,
+                "source": "flight",
+                "label": str(rec.get("kind", "")),
+                "detail": rec.get("data"),
+            })
+        spans = (evidence.get("spans") or {}).get("spans") or ()
+        for span in spans:
+            name = str(span.get("name", ""))
+            start = span.get("start_time")
+            end = span.get("end_time")
+            if start is not None:
+                events.append({
+                    "ts": float(start) - shift, "pod": pod,
+                    "source": "span", "label": f"{name} start",
+                    "detail": None,
+                })
+            if end is not None:
+                events.append({
+                    "ts": float(end) - shift, "pod": pod,
+                    "source": "span", "label": f"{name} end",
+                    "detail": None,
+                })
+    journal = (doc.get("collector") or {}).get("controller_journal") or ()
+    for rec in journal:
+        events.append({
+            "ts": float(rec.get("ts", 0.0)),
+            "pod": "controller",
+            "source": "controller",
+            "label": f"{rec.get('action', rec.get('kind', 'action'))} "
+                     f"{rec.get('phase', '')}".strip(),
+            "detail": {k: rec[k] for k in ("action_id", "epoch")
+                       if k in rec},
+        })
+    events.sort(key=lambda e: e["ts"])
+    if limit > 0 and len(events) > limit:
+        events = events[-limit:]
+    return events
+
+
+def firing_alerts(doc: dict) -> List[dict]:
+    """Alerts + anomalies that were firing at capture time."""
+    out: List[dict] = []
+    collector = doc.get("collector") or {}
+    for name, state in (collector.get("slo") or {}).items():
+        severity = (state.get("alert") or {}).get("severity")
+        if severity:
+            out.append({"kind": "slo", "name": name, "severity": severity})
+    for name, state in (collector.get("anomalies") or {}).items():
+        if state.get("firing"):
+            out.append({
+                "kind": "anomaly", "name": name,
+                "z": state.get("last_z"), "value": state.get("last_value"),
+            })
+    return out
+
+
+def dominant_segment(doc: dict) -> dict:
+    """The largest critical-path self-time segment across the bundle's
+    retained traces (the 'where was the time going' one-liner)."""
+    best: dict = {}
+    traces = ((doc.get("collector") or {}).get("traces") or {})
+    for summary in traces.get("retained") or ():
+        for seg in summary.get("critical_path") or ():
+            if seg.get("self_time_s", 0.0) > best.get("self_time_s", 0.0):
+                best = dict(seg)
+                best["trace_id"] = summary.get("trace_id", "")
+    return best
+
+
+def first_anomalous_pod(
+    doc: dict,
+    z_threshold: float = 4.0,
+    min_samples: int = 6,
+) -> Optional[dict]:
+    """Name the pod whose SLI series went anomalous first.
+
+    The bundle carries each pod's recent per-sentinel sample series
+    (``collector.sli_history``: pod -> sentinel -> [values]). For every
+    series, walk forward scoring each sample against the samples before
+    it (the same robust z the live sentinels use) and note the earliest
+    round that crossed ``z_threshold``; the pod with the earliest
+    crossing — ties broken by the larger score — is the primary suspect.
+    """
+    history = ((doc.get("collector") or {}).get("sli_history") or {})
+    best: Optional[dict] = None
+    for pod, series_by_sentinel in history.items():
+        for sentinel, series in (series_by_sentinel or {}).items():
+            values = [float(v) for v in series]
+            for i in range(min_samples, len(values)):
+                z = robust_z(values[i], values[:i])
+                if abs(z) < z_threshold:
+                    continue
+                candidate = {
+                    "pod": pod,
+                    "sentinel": sentinel,
+                    "round": i,
+                    "z": round(min(abs(z), 1e9), 3),
+                    "value": round(values[i], 6),
+                }
+                if best is None or candidate["round"] < best["round"] or (
+                        candidate["round"] == best["round"]
+                        and candidate["z"] > best["z"]):
+                    best = candidate
+                break  # first crossing of this series is the one that counts
+    return best

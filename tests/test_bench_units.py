@@ -313,6 +313,7 @@ class TestPerfSentinel:
         assert "audit" in manifest["benches"]
         assert "fencing" in manifest["benches"]
         assert "hotpath-fleet" in manifest["benches"]
+        assert "incident" in manifest["benches"]
         sentinel = self._sentinel()
         nominal = {
             "pyprof-overhead": {
@@ -337,6 +338,9 @@ class TestPerfSentinel:
                 "metric": "batched_fanout_ratio", "value": 7.0,
                 "unit": "batched/per-chunk sustained GetPodScores/s ratio",
                 "vs_baseline": 1.0},
+            "incident": {
+                "metric": "incident_trigger_overhead_pct", "value": 0.55,
+                "unit": "% of score p50", "vs_baseline": 1.0},
         }
         # The nominal set must cover the whole committed manifest — a
         # bench added to the baseline without a result arm here is the

@@ -464,7 +464,7 @@ class TestFleetObservabilityE2E:
         for svc in services:
             svc.pool.process_event_batch(batch, pod, MODEL)
 
-    def test_fleet_trace_assembly_and_burn_rate_alert(self):
+    def test_fleet_trace_assembly_and_burn_rate_alert(self, tmp_path):
         from llmd_kv_cache_tpu.models.engine import EngineConfig, MiniEngine
         from llmd_kv_cache_tpu.models.llama import LlamaConfig
         from llmd_kv_cache_tpu.offload.handoff import HandoffCoordinator
@@ -475,6 +475,11 @@ class TestFleetObservabilityE2E:
             CollectorConfig,
             ScrapeTarget,
             TelemetryCollector,
+        )
+        from llmd_kv_cache_tpu.telemetry.incident import (
+            IncidentConfig,
+            firing_alerts,
+            load_bundle,
         )
         from llmd_kv_cache_tpu.telemetry.tracing import (
             set_process_identity,
@@ -546,6 +551,7 @@ class TestFleetObservabilityE2E:
                 fast_windows=(0.6, 1.2),
                 slow_window=2.4,
                 breaker_reset_s=0.3,
+                incident=IncidentConfig(directory=str(tmp_path)),
             ))
             collector.start()  # admin endpoint only; rounds driven below
             round1 = collector.scrape_once()
@@ -620,6 +626,34 @@ class TestFleetObservabilityE2E:
             slo_view = collector.slos.debug_view()["availability"]
             assert slo_view["alert"]["fires"] >= 1
             assert slo_view["error_budget_remaining"] < 1.0
+
+            # 5b) The fire edge auto-opened an incident: the black box
+            # fanned out over the live admin plane and bundled evidence
+            # from every still-reachable shard, with the skew offsets
+            # the scrape loop estimated from each shard's /debug/time.
+            collector.incidents.wait(timeout=15.0)
+            assert collector.incidents.opened >= 1
+            summary = next(
+                s for s in collector.incidents.debug_view()["recent"]
+                if s["trigger"] == "slo:availability")
+            assert summary["pods_captured"] >= len(addrs) - 1
+            doc = load_bundle(summary["path"])
+            alive = [f"shard-{i}" for i in range(len(addrs) - 1)]
+            for name in alive:
+                assert doc["pods"][name]["reachable"], doc["pods"][name]
+                assert "flight_recorder" in doc["pods"][name]
+            assert doc["pods"][f"shard-{len(addrs) - 1}"]["reachable"] \
+                is False
+            assert set(doc["offsets"]) >= set(alive)
+            assert any(a["name"] == "availability"
+                       for a in firing_alerts(doc))
+            # The offline viewer replays the bundle with no pod running.
+            diag = subprocess.run(
+                [sys.executable, "hack/kvdiag.py",
+                 "--incident", summary["path"]],
+                cwd=str(REPO), capture_output=True, text=True, timeout=30)
+            assert diag.returncode == 0, diag.stderr
+            assert "slo:availability" in diag.stdout
 
             # 6) Recovery: same identity, fresh service. Good rounds
             # resume, the bad samples age out of the fast windows, and

@@ -1,0 +1,736 @@
+"""Incident black-box coverage (ISSUE 20).
+
+Unit-drives each layer in isolation — the robust-z anomaly sentinels
+(streak filter, hysteresis, baseline exclusion, shared edge-ring cursor
+contract), the NTP-style clock-skew estimator against fake clocks, the
+bundle codec (round trip + corruption), the IncidentManager's
+cooldown/retention/breaker semantics over a canned transport, the
+offline analysis helpers, and the ``/debug/time`` + ``POST
+/debug/incident/open`` admin contracts — then composes them in a chaos
+end-to-end: one gray pod in a four-pod fleet auto-opens exactly one
+incident (cooldown proven by flap injection), the bundle carries
+evidence from every reachable pod, and ``kvdiag --incident`` names the
+injected pod offline.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from llmd_kv_cache_tpu.services.telemetry_collector import (
+    CollectorConfig,
+    ScrapeTarget,
+    TelemetryCollector,
+)
+from llmd_kv_cache_tpu.telemetry.anomaly import (
+    AnomalyRegistry,
+    SentinelConfig,
+    robust_z,
+)
+from llmd_kv_cache_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    install_signal_dump,
+)
+from llmd_kv_cache_tpu.telemetry.incident import (
+    ClockSkewEstimator,
+    IncidentBundleError,
+    IncidentConfig,
+    IncidentManager,
+    decode_bundle,
+    encode_bundle,
+    estimate_offset,
+    first_anomalous_pod,
+    firing_alerts,
+    load_bundle,
+    merged_timeline,
+)
+from llmd_kv_cache_tpu.telemetry.rollup import parse_exposition
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+class SeqClock:
+    """Monotonic stub fed an explicit reading per call (repeats the last
+    reading once exhausted) — lets a test script every clock bracket."""
+
+    def __init__(self, values):
+        self.values = list(values)
+        self.last = 0.0
+
+    def __call__(self):
+        if self.values:
+            self.last = self.values.pop(0)
+        return self.last
+
+
+def _load_kvdiag():
+    spec = importlib.util.spec_from_file_location(
+        "kvdiag", Path(__file__).resolve().parents[1] / "hack" / "kvdiag.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- robust z ----------------------------------------------------------------
+
+
+class TestRobustZ:
+    def test_outliers_do_not_drag_the_baseline(self):
+        # One 100x spike in the window barely moves median/MAD, so a
+        # normal sample still scores ~0 (mean/stddev would be wrecked).
+        history = [1.0, 1.1, 0.9, 1.0, 1.05, 0.95, 100.0, 1.0]
+        assert abs(robust_z(1.0, history)) < 1.0
+        assert robust_z(100.0, history) > 6.0
+
+    def test_constant_series_scores_any_move_infinite(self):
+        history = [2.0] * 10
+        assert robust_z(2.0, history) == 0.0
+        assert robust_z(2.5, history) == float("inf")
+
+    def test_signed_and_empty(self):
+        history = [10.0, 10.5, 9.5, 10.0, 10.2, 9.8]
+        assert robust_z(20.0, history) > 0
+        assert robust_z(0.0, history) < 0
+        assert robust_z(5.0, []) == 0.0
+
+
+# -- anomaly sentinels -------------------------------------------------------
+
+
+def _registry(clock=None, **knobs):
+    reg = AnomalyRegistry(clock=clock or FakeClock())
+    cfg = dict(name="lag", window=32, min_samples=4, z_threshold=6.0,
+               clear_threshold=3.0, min_consecutive=2)
+    cfg.update(knobs)
+    reg.add(SentinelConfig(**cfg))
+    return reg
+
+
+class TestAnomalySentinel:
+    def test_single_blip_filtered_two_consecutive_fire(self):
+        reg = _registry()
+        s = reg.get("lag")
+        for v in (1.0, 1.1, 0.9, 1.0, 1.05, 0.95):
+            assert s.observe(v) is None
+        # One blip: anomalous but streak < min_consecutive.
+        assert s.observe(50.0) is None
+        assert not s.firing
+        # Back to normal resets the streak; a later lone blip still no-op.
+        assert s.observe(1.0) is None
+        assert s.observe(50.0) is None
+        # Two consecutive -> fire edge with the full record.
+        edge = s.observe(50.0)
+        assert edge is not None and edge["edge"] == "fire"
+        assert edge["sentinel"] == "lag" and edge["z"] > 6.0
+        assert s.firing and s.fires == 1
+
+    def test_hysteresis_and_baseline_exclusion(self):
+        reg = _registry()
+        s = reg.get("lag")
+        for v in (1.0, 1.1, 0.9, 1.0, 1.05, 0.95):
+            s.observe(v)
+        s.observe(50.0)
+        assert s.observe(50.0)["edge"] == "fire"
+        # A long incident: none of these land in the baseline window,
+        # so the series cannot launder 50.0 into "normal".
+        for _ in range(30):
+            assert s.observe(50.0) is None and s.firing
+        # Recovery clears (z back under clear_threshold) because the
+        # baseline is still the healthy ~1.0 series.
+        edge = s.observe(1.0)
+        assert edge is not None and edge["edge"] == "clear"
+        assert not s.firing
+        assert s.debug_view()["samples"] < 10  # firing samples excluded
+
+    def test_min_samples_gate_and_absolute_floor(self):
+        reg = _registry(min_samples=8, absolute_floor=0.5)
+        s = reg.get("lag")
+        # No verdicts before the baseline exists.
+        for _ in range(6):
+            assert s.observe(100.0) is None and not s.firing
+        reg2 = _registry(absolute_floor=0.5)
+        s2 = reg2.get("lag")
+        for v in (1.0, 1.0, 1.0, 1.0, 1.0, 1.0):
+            s2.observe(v)
+        # Constant series: a wiggle under the floor scores "infinite
+        # sigma" but must not fire.
+        assert s2.observe(1.01) is None
+        assert s2.observe(1.01) is None and not s2.firing
+        assert s2.observe(2.0) is None
+        assert s2.observe(2.0)["edge"] == "fire"
+
+    def test_edge_ring_shares_the_slo_cursor_contract(self):
+        clock = FakeClock()
+        reg = _registry(clock=clock)
+        s = reg.get("lag")
+        for v in (1.0, 1.1, 0.9, 1.0, 1.05, 0.95, 50.0, 50.0, 1.0):
+            s.observe(v)
+            clock.now += 1.0
+        out = reg.export_edges_since(-1)
+        assert [e["edge"] for e in out["edges"]] == ["fire", "clear"]
+        assert [e["seq"] for e in out["edges"]] == [0, 1]
+        assert out["next_seq"] == 1 and out["dropped"] == 0
+        # Cursor resume: nothing new, then only the fresh edge.
+        assert reg.export_edges_since(out["next_seq"])["edges"] == []
+        s.observe(50.0)
+        s.observe(50.0)
+        fresh = reg.export_edges_since(out["next_seq"])
+        assert [e["edge"] for e in fresh["edges"]] == ["fire"]
+        assert fresh["edges"][0]["seq"] == 2
+
+    def test_registry_active_feeds_fleet_signals_shape(self):
+        reg = _registry()
+        s = reg.get("lag")
+        for v in (1.0, 1.1, 0.9, 1.0, 1.05, 50.0, 50.0):
+            s.observe(v)
+        active = reg.active()
+        assert active["lag"]["firing"] is True
+        assert active["lag"]["last_value"] == 50.0
+        assert active["lag"]["last_z"] >= 6.0
+        assert reg.debug_view()["lag"]["fires"] == 1
+
+
+# -- clock-skew estimation ---------------------------------------------------
+
+
+class TestClockSkew:
+    def test_symmetric_rtt_recovers_exact_offset(self):
+        # Pod clock runs +5 s ahead; request and response each take 50 ms.
+        mono = SeqClock([0.0, 0.1, 0.1])
+        est = ClockSkewEstimator(mono=mono, wall=lambda: 100.0)
+        offset = est.update("p", lambda: {"wall": 105.05})
+        assert offset == pytest.approx(5.0)
+        view = est.offsets()["p"]
+        assert view["offset_s"] == pytest.approx(5.0)
+        assert view["rtt_s"] == pytest.approx(0.1)
+        assert view["samples"] == 1
+
+    def test_asymmetric_routing_error_bounded_by_half_rtt(self):
+        # Request leg 80 ms, response 20 ms: the pod stamps its clock at
+        # t=0.08, not rtt/2 — the estimate is off by (b-a)/2 = 30 ms,
+        # inside the documented rtt/2 bound.
+        mono = SeqClock([0.0, 0.1, 0.1])
+        est = ClockSkewEstimator(mono=mono, wall=lambda: 100.0)
+        offset = est.update("p", lambda: {"wall": 105.08})
+        assert offset is not None
+        assert abs(offset - 5.0) <= 0.1 / 2
+        assert estimate_offset(0.0, 0.1, 5.08) == pytest.approx(5.03)
+
+    def test_congested_sample_rejected_until_estimate_ages_out(self):
+        mono = SeqClock([10.0, 10.01,  # update 1: rtt 10ms, accept
+                         20.0, 21.0,   # update 2: rtt 1s, reject
+                         25.0,         # offsets() read
+                         200.0, 201.0,  # update 3: stale -> accept
+                         202.0])        # final offsets() read
+        est = ClockSkewEstimator(mono=mono, wall=lambda: 0.0, max_age_s=120.0)
+        assert est.update("p", lambda: {"wall": 5.005}) == pytest.approx(5.0)
+        # A congested RTT would widen the error bound: keep the tight one.
+        assert est.update("p", lambda: {"wall": 7.5}) is None
+        assert est.offsets()["p"]["offset_s"] == pytest.approx(5.0)
+        # Clocks drift: past max_age_s a fresh loose sample beats a stale
+        # tight one.
+        assert est.update("p", lambda: {"wall": 3.5}) == pytest.approx(3.0)
+        view = est.offsets()["p"]
+        assert view["offset_s"] == pytest.approx(3.0)
+        assert view["rtt_s"] == pytest.approx(1.0)
+        assert view["samples"] == 3
+
+    def test_failed_echo_returns_none_and_stays_out_of_the_table(self):
+        est = ClockSkewEstimator()
+        assert est.update("down", lambda: (_ for _ in ()).throw(
+            OSError("refused"))) is None
+        assert "down" not in est.offsets()
+
+
+# -- bundle codec ------------------------------------------------------------
+
+
+class TestBundleCodec:
+    DOC = {"version": 1, "seq": 7, "trigger": "slo:ttft",
+           "pods": {"pod-0": {"reachable": True}},
+           "offsets": {"pod-0": {"offset_s": 0.25}}}
+
+    def test_round_trip(self, tmp_path):
+        blob = encode_bundle(self.DOC)
+        assert decode_bundle(blob) == self.DOC
+        path = tmp_path / "incident-00000007-slo_ttft.inc"
+        path.write_bytes(blob)
+        assert load_bundle(str(path)) == self.DOC
+
+    def test_corruption_and_truncation_raise(self):
+        blob = encode_bundle(self.DOC)
+        flipped = bytearray(blob)
+        flipped[len(blob) // 2] ^= 0xFF
+        with pytest.raises(IncidentBundleError):
+            decode_bundle(bytes(flipped))
+        with pytest.raises(IncidentBundleError):
+            decode_bundle(b"NOTABUNDLE" + blob)
+        with pytest.raises(IncidentBundleError):
+            decode_bundle(blob[:8])
+
+    def test_config_from_dict_camel_case(self):
+        cfg = IncidentConfig.from_dict({
+            "directory": "/tmp/x", "cooldownS": 60, "maxBundles": 3,
+            "flightTail": 10, "spansTail": 5, "journalTail": 2})
+        assert cfg.directory == "/tmp/x" and cfg.cooldown_s == 60.0
+        assert cfg.max_bundles == 3 and cfg.flight_tail == 10
+        assert cfg.spans_tail == 5 and cfg.journal_tail == 2
+        assert IncidentConfig.from_dict(None) == IncidentConfig()
+
+
+# -- the incident manager over a canned transport ----------------------------
+
+
+def _canned_fetch(url: str) -> bytes:
+    if "flight-recorder" in url:
+        return json.dumps({"records": [
+            {"seq": i, "ts": 1000.0 + i, "mono": float(i), "kind": "score",
+             "data": {"i": i}} for i in range(8)
+        ], "next_seq": 7, "dropped": 0}).encode()
+    if "/debug/time" in url:
+        return json.dumps({"wall": time.time(), "mono": 1.0,
+                           "pid": 1}).encode()
+    raise OSError("404")
+
+
+class _FakeBreaker:
+    def __init__(self, allow=True):
+        self._allow = allow
+        self.successes = 0
+        self.failures = 0
+
+    def allow(self):
+        return self._allow
+
+    def record_success(self):
+        self.successes += 1
+
+    def record_failure(self):
+        self.failures += 1
+
+
+def _manager(tmp_path, clock, fetch=_canned_fetch, pods=2, breaker=None,
+             **cfg):
+    config = IncidentConfig(directory=str(tmp_path), cooldown_s=300.0, **cfg)
+    targets = [(f"pod-{i}", f"10.0.0.{i}:9400", breaker)
+               for i in range(pods)]
+    return IncidentManager(config, fetch=fetch, targets=lambda: targets,
+                           local_evidence=lambda: {"rounds": 1},
+                           clock=clock, wall=lambda: 1234.5)
+
+
+class TestIncidentManager:
+    def test_synchronous_capture_writes_a_verified_bundle(self, tmp_path):
+        mgr = _manager(tmp_path, FakeClock(), flight_tail=5)
+        summary = mgr.maybe_open("slo:ttft", reason={"burn": 20.0},
+                                 synchronous=True)
+        assert summary["pods_captured"] == 2 and summary["pods_total"] == 2
+        assert summary["bytes"] > 0
+        doc = load_bundle(summary["path"])
+        assert doc["trigger"] == "slo:ttft" and doc["reason"]["burn"] == 20.0
+        assert doc["opened_wall"] == 1234.5
+        pod = doc["pods"]["pod-0"]
+        assert pod["reachable"] is True
+        # flight_tail keeps the newest 5 of 8 and says what it dropped.
+        assert len(pod["flight_recorder"]["records"]) == 5
+        assert pod["flight_recorder"]["truncated"] == 3
+        assert pod["flight_recorder"]["records"][-1]["seq"] == 7
+        # 404ing enrichment legs tolerated, time leg captured.
+        assert "spans" not in pod and "time" in pod
+        assert doc["collector"] == {"rounds": 1}
+
+    def test_cooldown_flap_suppression_and_force(self, tmp_path):
+        clock = FakeClock()
+        mgr = _manager(tmp_path, clock)
+        assert mgr.maybe_open("slo:ttft", synchronous=True) is not None
+        # Flap inside the window: suppressed, tallied, no second bundle.
+        clock.now += 10.0
+        assert mgr.maybe_open("slo:ttft", synchronous=True) is None
+        assert mgr.debug_view()["suppressed"]["cooldown"] == 1
+        # A different trigger has its own cooldown entry.
+        assert mgr.maybe_open("anomaly:lag", synchronous=True) is not None
+        # force bypasses; expiry reopens naturally.
+        assert mgr.maybe_open("slo:ttft", force=True,
+                              synchronous=True) is not None
+        clock.now += 400.0
+        assert mgr.maybe_open("slo:ttft", synchronous=True) is not None
+        assert mgr.opened == 4
+
+    def test_disabled_without_directory(self, tmp_path):
+        mgr = IncidentManager(IncidentConfig(directory=""),
+                              fetch=_canned_fetch, targets=lambda: [],
+                              clock=FakeClock())
+        assert mgr.maybe_open("slo:ttft") is None
+        view = mgr.debug_view()
+        assert view["enabled"] is False
+        assert view["suppressed"]["disabled"] == 1
+
+    def test_retention_keeps_newest_n(self, tmp_path):
+        mgr = _manager(tmp_path, FakeClock(), max_bundles=2)
+        for i in range(4):
+            mgr.maybe_open(f"t{i}", synchronous=True)
+        names = sorted(p.name for p in tmp_path.glob("incident-*.inc"))
+        assert names == ["incident-00000003-t2.inc",
+                         "incident-00000004-t3.inc"]
+
+    def test_required_leg_charges_breaker_enrichment_does_not(self, tmp_path):
+        def flaky(url):
+            raise OSError("connection refused")
+
+        breaker = _FakeBreaker()
+        mgr = _manager(tmp_path, FakeClock(), fetch=flaky, breaker=breaker)
+        summary = mgr.maybe_open("slo:ttft", synchronous=True)
+        assert summary["pods_captured"] == 0
+        doc = load_bundle(summary["path"])
+        assert doc["pods"]["pod-0"]["reachable"] is False
+        assert "refused" in doc["pods"]["pod-0"]["error"]
+        assert breaker.failures == 2 and breaker.successes == 0
+        # An open breaker skips the pod without even dialing.
+        mgr2 = _manager(tmp_path, FakeClock(), fetch=_canned_fetch,
+                        breaker=_FakeBreaker(allow=False))
+        doc2 = load_bundle(
+            mgr2.maybe_open("x", synchronous=True)["path"])
+        assert doc2["pods"]["pod-0"]["error"] == "breaker open"
+
+    def test_async_capture_returns_stub_and_recents(self, tmp_path):
+        mgr = _manager(tmp_path, FakeClock())
+        stub = mgr.maybe_open("slo:ttft")
+        assert stub["state"] == "capturing"
+        mgr.wait(timeout=10.0)
+        view = mgr.debug_view()
+        assert view["opened_total"] == 1 and not view["capturing"]
+        assert view["recent"][-1]["trigger"] == "slo:ttft"
+        assert os.path.exists(view["recent"][-1]["path"])
+
+    def test_lazy_prometheus_sync_catches_up_at_debug_view(self, tmp_path):
+        clock = FakeClock()
+        mgr = _manager(tmp_path, clock)
+        child = mgr._suppress_counters["cooldown"]
+        before = child._value.get()
+        mgr.maybe_open("t", synchronous=True)
+        clock.now += 1.0
+        for _ in range(5):
+            assert mgr.maybe_open("t") is None
+        # The hot path only bumped the local tally; the scrape syncs it.
+        view = mgr.debug_view()
+        assert view["suppressed"]["cooldown"] == 5
+        assert child._value.get() == before + 5
+
+
+# -- offline analysis --------------------------------------------------------
+
+
+def _analysis_doc():
+    return {
+        "version": 1, "seq": 3, "trigger": "anomaly:ingest_lag",
+        "offsets": {"pod-1": {"offset_s": 5.0, "rtt_s": 0.002}},
+        "pods": {
+            "pod-0": {"reachable": True, "flight_recorder": {"records": [
+                {"ts": 1000.5, "kind": "score", "data": {"n": 1}}]},
+                "spans": {"spans": [{"name": "s", "start_time": 1000.8,
+                                     "end_time": 1000.9}]}},
+            # pod-1's clock runs +5 s: raw stamps look *later* than
+            # pod-0's even though its events happened first.
+            "pod-1": {"reachable": True, "flight_recorder": {"records": [
+                {"ts": 1005.25, "kind": "shed", "data": {"n": 2}}]}},
+        },
+        "collector": {
+            "controller_journal": [{"ts": 1000.7, "action": "drain",
+                                    "phase": "executed", "epoch": 4}],
+            "slo": {"ttft": {"alert": {"severity": "page"}},
+                    "availability": {"alert": {}}},
+            "anomalies": {"ingest_lag": {"firing": True, "last_z": 9.0,
+                                         "last_value": 2.0},
+                          "shed_rate": {"firing": False}},
+            "sli_history": {
+                "pod-0": {"ingest_lag": [0.02, 0.021, 0.02, 0.022, 0.02,
+                                         0.021, 0.02, 0.021]},
+                "pod-1": {"ingest_lag": [0.02, 0.021, 0.02, 0.022, 0.02,
+                                         0.021, 2.0, 2.1]},
+            },
+        },
+    }
+
+class TestOfflineAnalysis:
+    def test_merged_timeline_corrects_skew(self):
+        events = merged_timeline(_analysis_doc())
+        # Corrected: pod-1 @1000.25, pod-0 @1000.5, journal @1000.7,
+        # span start/end @1000.8/.9.
+        assert [(e["pod"], e["source"]) for e in events] == [
+            ("pod-1", "flight"), ("pod-0", "flight"),
+            ("controller", "controller"), ("pod-0", "span"),
+            ("pod-0", "span")]
+        assert events[0]["ts"] == pytest.approx(1000.25)
+        assert merged_timeline(_analysis_doc(), limit=2) == events[-2:]
+
+    def test_firing_alerts_and_first_anomalous_pod(self):
+        doc = _analysis_doc()
+        alerts = firing_alerts(doc)
+        assert {"kind": "slo", "name": "ttft", "severity": "page"} in alerts
+        assert any(a["kind"] == "anomaly" and a["name"] == "ingest_lag"
+                   for a in alerts)
+        assert len(alerts) == 2  # non-firing entries stay out
+        suspect = first_anomalous_pod(doc)
+        assert suspect["pod"] == "pod-1"
+        assert suspect["sentinel"] == "ingest_lag"
+        assert suspect["round"] == 6 and suspect["z"] > 4.0
+
+
+# -- admin contracts: /debug/time + POST /debug/incident/open ----------------
+
+
+class TestAdminContracts:
+    def test_debug_time_echo_and_live_skew_round(self):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        admin = AdminServer(port=0, expose_debug=True)
+        admin.start()
+        try:
+            url = f"http://127.0.0.1:{admin.port}/debug/time"
+            with urllib.request.urlopen(url) as r:
+                payload = json.loads(r.read())
+            assert abs(payload["wall"] - time.time()) < 5.0
+            assert isinstance(payload["mono"], float)
+            assert payload["pid"] == os.getpid()
+
+            # A real loopback round: offset of our own clock is ~0.
+            def fetch_time():
+                with urllib.request.urlopen(url) as r:
+                    return json.loads(r.read())
+
+            offset = ClockSkewEstimator().update("self", fetch_time)
+            assert offset is not None and abs(offset) < 1.0
+        finally:
+            admin.stop()
+
+    def test_manual_open_action_maps_suppression_to_400(self, tmp_path):
+        from llmd_kv_cache_tpu.services.admin import AdminServer
+
+        clock = FakeClock()
+        col = TelemetryCollector(CollectorConfig(
+            targets=(), scrape_interval_s=0.0, admin_port=0,
+            incident=IncidentConfig(directory=str(tmp_path))), clock=clock)
+        col.incidents._fetch = _canned_fetch
+        admin = AdminServer(port=0, expose_debug=True)
+        admin.register_action("incident/open", col._incident_open_action)
+        admin.start()
+        try:
+            url = (f"http://127.0.0.1:{admin.port}"
+                   "/debug/incident/open?trigger=drill")
+            req = urllib.request.Request(url, data=b"", method="POST")
+            with urllib.request.urlopen(req) as r:
+                summary = json.loads(r.read())
+            assert summary["trigger"] == "manual:drill"
+            assert os.path.exists(summary["path"])
+            # Cooldown window: the retry must come back 400, not 500.
+            clock.now += 1.0
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    urllib.request.Request(url, data=b"", method="POST"))
+            assert exc.value.code == 400
+            # force=1 punches through.
+            with urllib.request.urlopen(urllib.request.Request(
+                    url + "&force=1", data=b"", method="POST")) as r:
+                assert json.loads(r.read())["seq"] == 2
+        finally:
+            admin.stop()
+
+
+# -- flight recorder satellites ----------------------------------------------
+
+
+class TestFlightRecorderSatellites:
+    def test_records_carry_wall_stamps_and_cursor_resumes(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(6):
+            rec.record("score", {"i": i})
+        out = rec.export_since(-1)
+        assert [r["data"]["i"] for r in out["records"]] == [2, 3, 4, 5]
+        assert out["dropped"] == 2
+        assert all(abs(r["ts"] - time.time()) < 60.0 for r in out["records"])
+        assert all(isinstance(r["mono"], float) for r in out["records"])
+        # Cursor: nothing new, then only the fresh record; non-destructive.
+        cursor = out["next_seq"]
+        assert rec.export_since(cursor)["records"] == []
+        rec.record("shed", {"i": 6})
+        fresh = rec.export_since(cursor)
+        assert [r["data"]["i"] for r in fresh["records"]] == [6]
+        assert rec.export_since(cursor)["records"] == fresh["records"]
+
+    def test_signal_dump_writes_timestamped_file_under_dump_dir(
+            self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        rec.record("score", {"hello": 1})
+        prev = install_signal_dump(signal.SIGUSR2, recorder=rec,
+                                   dump_dir=str(tmp_path))
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            deadline = time.time() + 5.0
+            files = []
+            while time.time() < deadline:
+                files = list(tmp_path.glob("kvtpu-flight-*.json"))
+                if files:
+                    break
+                time.sleep(0.01)
+            assert files, "signal dump wrote no file"
+            assert f"-{os.getpid()}-" in files[0].name
+            payload = json.loads(files[0].read_text())
+            assert payload["records"][0]["data"] == {"hello": 1}
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
+
+
+# -- chaos end-to-end --------------------------------------------------------
+
+
+LAG_TMPL = """\
+# TYPE kvcache_event_pod_lag_seconds gauge
+kvcache_event_pod_lag_seconds{{pod="{name}"}} {lag}
+"""
+
+
+class TestChaosE2E:
+    """One gray pod in a four-pod fleet: the ingest-lag sentinel fires,
+    exactly one incident auto-opens (the flap re-fire lands in cooldown),
+    the bundle carries evidence from every reachable pod on a
+    skew-corrected timeline, and kvdiag names the injected pod offline.
+    """
+
+    GRAY = "pod-2"
+
+    def _fleet(self, tmp_path, clock):
+        col = TelemetryCollector(CollectorConfig(
+            targets=tuple(
+                ScrapeTarget(name=f"pod-{i}", address=f"10.0.0.{i}:9400",
+                             role="decode") for i in range(4)),
+            scrape_interval_s=0.0, admin_port=0,
+            anomaly_window=32, anomaly_min_samples=4,
+            anomaly_z_threshold=6.0, anomaly_clear_threshold=3.0,
+            anomaly_min_consecutive=2,
+            incident=IncidentConfig(directory=str(tmp_path),
+                                    cooldown_s=3600.0),
+        ), clock=clock)
+
+        def fleet_fetch(url):
+            for i in range(4):
+                if url.startswith(f"http://10.0.0.{i}:9400"):
+                    name = f"pod-{i}"
+                    break
+            else:
+                raise OSError("unknown target")
+            if "flight-recorder" in url:
+                # The gray pod's clock runs +5 s: its raw stamp looks
+                # later than pod-0's even though its event came first.
+                ts = 1005.25 if name == self.GRAY else 1000.5 + i
+                return json.dumps({"records": [
+                    {"seq": 0, "ts": ts, "mono": 1.0, "kind": "score",
+                     "data": {"pod": name}}],
+                    "next_seq": 0, "dropped": 0}).encode()
+            raise OSError("404")
+
+        col.incidents._fetch = fleet_fetch
+        return col
+
+    def _round(self, col, clock, lag_by_pod):
+        for state in col._targets:
+            lag = lag_by_pod(state.target.name)
+            state.families = parse_exposition(
+                LAG_TMPL.format(name=state.target.name, lag=lag))
+        col._feed_anomaly_slis()
+        col._check_incident_triggers()
+        clock.now += 5.0
+
+    def _healthy(self, rnd):
+        def lag(name):
+            i = int(name.split("-")[1])
+            return 0.02 + 0.001 * ((rnd + i) % 3)
+        return lag
+
+    def _gray(self, rnd):
+        healthy = self._healthy(rnd)
+        return lambda name: 2.0 if name == self.GRAY else healthy(name)
+
+    def test_gray_pod_opens_exactly_one_incident(self, tmp_path):
+        clock = FakeClock()
+        col = self._fleet(tmp_path, clock)
+        # Prime the skew table: the gray pod answers +5 s ahead.
+        for state in col._targets:
+            ahead = 5.0 if state.target.name == self.GRAY else 0.0
+            assert col.skew.update(
+                state.target.name,
+                lambda ahead=ahead: {"wall": time.time() + ahead},
+            ) is not None
+
+        for rnd in range(8):            # healthy baseline
+            self._round(col, clock, self._healthy(rnd))
+        assert col.incidents.opened == 0
+        for rnd in range(8, 10):        # gray failure: 2 rounds -> fire
+            self._round(col, clock, self._gray(rnd))
+        col.incidents.wait(timeout=10.0)
+        assert col.incidents.opened == 1
+
+        # Flap: recover for one round (clear edge), fail again (re-fire)
+        # — the fresh fire edge lands inside the cooldown window.
+        self._round(col, clock, self._healthy(10))
+        for rnd in range(11, 13):
+            self._round(col, clock, self._gray(rnd))
+        col.incidents.wait(timeout=10.0)
+        assert col.incidents.opened == 1
+        assert col.incidents.debug_view()["suppressed"]["cooldown"] >= 1
+
+        bundles = list(tmp_path.glob("incident-*.inc"))
+        assert len(bundles) == 1
+        doc = load_bundle(str(bundles[0]))
+        assert doc["trigger"] == "anomaly:ingest_lag"
+        assert doc["reason"]["edge"] == "fire"
+
+        # Evidence from every reachable pod.
+        assert set(doc["pods"]) == {f"pod-{i}" for i in range(4)}
+        assert all(p["reachable"] for p in doc["pods"].values())
+        assert sum(1 for p in doc["pods"].values()
+                   if "flight_recorder" in p) == 4
+
+        # The offset table rode along and the merged timeline is
+        # skew-corrected: the gray pod's event sorts first despite its
+        # raw stamp being the latest.
+        assert doc["offsets"][self.GRAY]["offset_s"] == pytest.approx(
+            5.0, abs=0.2)
+        events = merged_timeline(doc)
+        flight = [e for e in events if e["source"] == "flight"]
+        assert flight[0]["pod"] == self.GRAY
+        assert flight[0]["ts"] == pytest.approx(1000.25, abs=0.3)
+
+        # The black box names the injured pod.
+        suspect = first_anomalous_pod(doc)
+        assert suspect is not None and suspect["pod"] == self.GRAY
+        assert suspect["sentinel"] == "ingest_lag"
+
+        # And so does the offline viewer, end to end.
+        kvdiag = _load_kvdiag()
+        out = io.StringIO()
+        assert kvdiag.incident_report(str(bundles[0]), out=out) == 0
+        text = out.getvalue()
+        assert "first anomalous pod: pod-2" in text
+        assert "anomaly:ingest_lag" in text
+        assert "4/4" in text
+
+    def test_kvdiag_incident_rejects_corrupt_bundle(self, tmp_path):
+        bad = tmp_path / "incident-00000001-x.inc"
+        bad.write_bytes(b"KVTPUINC1\n" + b"garbage")
+        kvdiag = _load_kvdiag()
+        out = io.StringIO()
+        assert kvdiag.incident_report(str(bad), out=out) == 2
